@@ -1,0 +1,105 @@
+"""Benchmark: Llama train-step MFU on the available TPU chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = measured MFU / 0.40 (the BASELINE.md north-star: Llama-3-8B
+pretrain at >=40% MFU on v5p-64; single-chip runs use a memory-scaled config
+with identical per-layer structure)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+# bf16 peak FLOPs per chip by generation
+PEAK_FLOPS = {
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6": 918e12,
+    "cpu": 1e12,  # nominal, for smoke runs off-TPU
+}
+
+
+def chip_peak() -> float:
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu").lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return PEAK_FLOPS["cpu"]
+
+
+def main():
+    from paddle_tpu.models import llama
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # ~460M-param config: Llama-3 block structure, memory-scaled for 16GB HBM
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=4,
+        )
+        batch, seq = 8, 2048
+        warmup_steps, bench_steps = 2, 10
+    else:
+        cfg = llama.LlamaConfig.tiny()
+        batch, seq = 2, 128
+        warmup_steps, bench_steps = 1, 2
+
+    mesh = llama.make_mesh(dp=1, mp=1, sharding=1, sep=1, devices=jax.devices()[:1])
+    step_fn, opt_init, param_shardings, data_sharding = llama.build_train_step(cfg, mesh)
+    params = jax.device_put(llama.init_params(cfg, jax.random.key(0)), param_shardings)
+    opt_state = jax.jit(opt_init)(params)
+
+    rs = np.random.RandomState(0)
+    ids = jax.device_put(jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq))), data_sharding)
+    labels = jax.device_put(jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq))), data_sharding)
+
+    # warmup (compile).  NOTE: on the axon relay platform block_until_ready()
+    # does not actually synchronize — a host scalar fetch is the only reliable
+    # barrier, so timing is bracketed by float() fetches.
+    for _ in range(warmup_steps):
+        loss, params, opt_state = step_fn(params, opt_state, ids, labels)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(bench_steps):
+        loss, params, opt_state = step_fn(params, opt_state, ids, labels)
+    loss_val = float(loss)  # drains the queue: real end-to-end step time
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * bench_steps
+    tok_per_sec = tokens / dt
+    flops_tok = llama.flops_per_token(cfg) + llama.attn_flops_per_token(cfg, seq)
+    achieved = tok_per_sec * flops_tok
+    mfu = achieved / chip_peak()
+
+    result = {
+        "metric": "llama_train_mfu_single_chip",
+        "value": round(mfu * 100, 2),
+        "unit": "% MFU",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {
+            "tokens_per_sec_per_chip": round(tok_per_sec, 1),
+            "loss": loss_val,
+            "params_m": round(llama.count_params(params) / 1e6, 1),
+            "batch": batch,
+            "seq": seq,
+            "backend": jax.default_backend(),
+            "device": getattr(jax.devices()[0], "device_kind", "?"),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's capabilities.
+
+Top-level namespace mirrors ``paddle.*``: tensor ops at the root, ``nn``,
+``optimizer``, ``amp``, ``io``, ``autograd``, ``jit``, ``static``, ``distributed``,
+``vision``, ``incubate`` as subpackages.  Compute is JAX/XLA (+Pallas kernels);
+see SURVEY.md for the design mapping to the reference.
+"""
+
+from __future__ import annotations
+
+# core
+from .core import device
+from .core.device import (
+    get_device,
+    set_device,
+)
+from .core.dtype import (
+    bfloat16,
+    bool_ as bool,  # noqa: A001 - paddle exposes paddle.bool
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    float8_e4m3fn,
+    float8_e5m2,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .core.flags import get_flags, set_flags
+from .core.rng import get_rng_state, seed, set_rng_state
+from .core.tensor import (
+    Parameter,
+    Tensor,
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+    to_tensor,
+)
+
+# ops: populate the root namespace like paddle.*
+from . import ops as _ops_pkg
+from .ops.creation import *  # noqa: F401,F403
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.manipulation import *  # noqa: F401,F403
+from .ops.math import *  # noqa: F401,F403
+
+# re-export every registered op by name (covers the _unary/_binary generated ones)
+from .ops.registry import OPS as _OPS
+
+for _name, _od in list(_OPS.items()):
+    if _name not in globals():
+        globals()[_name] = _od.fn
+del _name, _od
+
+# subpackages (imported after root ops so they can use them)
+from . import amp  # noqa: E402
+from . import autograd  # noqa: E402
+from . import distributed  # noqa: E402
+from . import framework  # noqa: E402
+from . import incubate  # noqa: E402
+from . import io  # noqa: E402
+from . import jit  # noqa: E402
+from . import metric  # noqa: E402
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import profiler  # noqa: E402
+from . import static  # noqa: E402
+from . import vision  # noqa: E402
+from . import hapi  # noqa: E402
+from .framework.io_utils import load, save  # noqa: E402
+from .hapi import Model, summary  # noqa: E402
+from .jit import to_static  # noqa: E402
+
+disable_static = lambda *a, **k: None  # eager is the default and only "dygraph" mode
+enable_static = lambda *a, **k: None  # static = jit tracing; kept for API parity
+in_dynamic_mode = lambda: True
+
+grad = autograd.grad
+
+__version__ = "0.1.0"

@@ -1,0 +1,251 @@
+"""AMP: auto_cast + GradScaler (reference: python/paddle/amp/ — auto_cast at
+auto_cast.py:1006, O1/O2 white/black lists in amp_lists.py, GradScaler in
+grad_scaler.py; hooks generated per-op in eager_gen.py:645).
+
+TPU-native realization: bf16 is the native mixed-precision dtype (no loss scaling
+needed); ``auto_cast`` installs a dispatch-level dtype policy consulted by the op
+wrappers.  fp16 + GradScaler is kept for API parity and exercises the same code
+path."""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, _unwrap
+
+__all__ = [
+    "auto_cast",
+    "amp_guard",
+    "GradScaler",
+    "decorate",
+    "is_bfloat16_supported",
+    "is_float16_supported",
+    "white_list",
+    "black_list",
+]
+
+# O1 op lists (mirrors python/paddle/amp/amp_lists.py semantics)
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "flash_attention", "sdpa", "addmm",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "mean", "sum", "softmax",
+    "log_softmax", "cross_entropy", "softmax_with_cross_entropy", "layer_norm",
+    "batch_norm", "group_norm", "instance_norm", "rms_norm", "norm", "cumsum",
+    "pow", "square", "reciprocal", "rsqrt", "erf", "erfinv",
+}
+
+
+def white_list():
+    return {"float16": {"O1": WHITE_LIST, "O2": WHITE_LIST}, "bfloat16": {"O1": WHITE_LIST, "O2": WHITE_LIST}}
+
+
+def black_list():
+    return {"float16": {"O1": BLACK_LIST, "O2": BLACK_LIST}, "bfloat16": {"O1": BLACK_LIST, "O2": BLACK_LIST}}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = np.dtype("bfloat16")
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def _cast_inputs(name: str, vals):
+    """Called from op dispatch: cast inputs per the active policy."""
+    if not _state.enabled:
+        return vals
+    target = None
+    if name in _state.custom_black or (name in BLACK_LIST and name not in _state.custom_white):
+        target = np.dtype("float32")
+    elif _state.level == "O2" or name in WHITE_LIST or name in _state.custom_white:
+        target = _state.dtype
+    if target is None:
+        return vals
+    out = []
+    for v in vals:
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating) and v.dtype != target:
+            out.append(v.astype(target))
+        else:
+            out.append(v)
+    return out
+
+
+# register the dispatch-level cast hook
+from ..core import tensor as _core_tensor
+
+_core_tensor._amp_cast_hook = _cast_inputs
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16", use_promote=True):
+    prev = (_state.enabled, _state.dtype, _state.level, _state.custom_white, _state.custom_black)
+    _state.enabled = bool(enable)
+    _state.dtype = dtypes.convert_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white, _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the amp dtype (master weights kept by
+    the optimizer when multi_precision=True)."""
+    dt = dtypes.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if dtypes.is_floating(p.dtype) and np.dtype(p.dtype) == np.float32:
+                    p._value = _unwrap(p).astype(dt)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+class GradScaler:
+    """Loss scaler for fp16 (reference: python/paddle/amp/grad_scaler.py).
+    bf16 training doesn't need it; kept for parity and fp16 experiments."""
+
+    def __init__(
+        self,
+        enable=True,
+        init_loss_scaling=65536.0,
+        incr_ratio=2.0,
+        decr_ratio=0.5,
+        incr_every_n_steps=2000,
+        decr_every_n_nan_or_inf=1,
+        use_dynamic_loss_scaling=True,
+    ):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = optimizer._parameter_list or []
+        found = False
+        for p in params:
+            if p._grad is not None:
+                g = p._grad / self._scale
+                if bool(jnp.any(~jnp.isfinite(g))):
+                    found = True
+                p._grad = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._found_inf:
+            self.unscale_(optimizer)
+        if self._found_inf:
+            self._update_on_inf()
+            self._found_inf = False
+            return
+        optimizer.step()
+        self._update_on_good()
+
+    def update(self):
+        pass
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.unscale_(optimizer)
+        self.step(optimizer)
+
+    def _update_on_inf(self):
+        if self._dynamic:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+
+    def _update_on_good(self):
+        if self._dynamic:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale))
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+# debugging helpers (reference: python/paddle/amp/debugging.py)
+def enable_operator_stats_collection():
+    pass
+
+
+def disable_operator_stats_collection():
+    pass
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    v = _unwrap(tensor)
+    has_inf = bool(jnp.any(jnp.isinf(v)))
+    has_nan = bool(jnp.any(jnp.isnan(v)))
+    return has_inf, has_nan

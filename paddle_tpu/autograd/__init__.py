@@ -1,0 +1,238 @@
+"""Autograd public API (reference: python/paddle/autograd/ — backward, grad,
+PyLayer, functional jacobian/hessian/vjp/jvp).
+
+The eager tape lives in paddle_tpu.core.tensor; functional transforms delegate
+to JAX's native AD, which is the TPU-idiomatic replacement for the reference's
+GradNode graph (`paddle/fluid/eager/backward.cc:106`)."""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import (
+    Tensor,
+    _unwrap,
+    apply_op,
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    run_backward,
+    set_grad_enabled,
+)
+
+__all__ = [
+    "backward",
+    "grad",
+    "PyLayer",
+    "PyLayerContext",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "jacobian",
+    "hessian",
+    "vjp",
+    "jvp",
+]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """``paddle.autograd.backward``: seed multiple roots then sweep the tape once."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        run_backward(t, g, retain_graph=True if len(tensors) > 1 else retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """``paddle.grad``: gradients of outputs w.r.t. inputs without touching .grad."""
+    single_out = isinstance(outputs, Tensor)
+    single_in = isinstance(inputs, Tensor)
+    outs = [outputs] if single_out else list(outputs)
+    ins = [inputs] if single_in else list(inputs)
+
+    # stash and clear .grad, run backward, collect, restore
+    saved = [(t, t._grad, t._retain_grads) for t in ins]
+    for t in ins:
+        t._grad = None
+        t._retain_grads = True
+    try:
+        gts = grad_outputs if grad_outputs is not None else [None] * len(outs)
+        for o, g in zip(outs, gts):
+            run_backward(o, g, retain_graph=True if retain_graph is None else retain_graph)
+        results = []
+        for t in ins:
+            if t._grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the input tensors received no gradient "
+                        "(set allow_unused=True to return None)"
+                    )
+                results.append(None)
+            else:
+                results.append(Tensor(t._grad))
+    finally:
+        for t, g, r in saved:
+            t._grad, t._retain_grads = g, r
+    return results[0] if single_in else results
+
+
+class PyLayerContext:
+    """Context passed to PyLayer.forward/backward (saved-tensor store)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.__dict__["_attrs"] = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    # arbitrary attribute stashing, like the reference PyLayerContext
+    saved_tensors = property(lambda self: self._saved)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined differentiable function (reference:
+    `paddle/fluid/pybind/eager_py_layer.cc`, python surface paddle.autograd.PyLayer).
+
+    Implemented as a custom tape node: forward runs under no_grad, backward calls
+    the user's static backward method with wrapped cotangents.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core import tensor as T
+
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = (
+            is_grad_enabled()
+            and any(not t.stop_gradient for t in tensor_inputs)
+        )
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        outs = [o if isinstance(o, Tensor) else Tensor(o) for o in outs]
+        if needs_grad:
+            parents = [t for t in tensor_inputs if not t.stop_gradient]
+
+            def vjp_fn(couts):
+                cot = couts if isinstance(couts, tuple) else (couts,)
+                with no_grad():
+                    gin = cls.backward(ctx, *[Tensor(c) for c in cot])
+                gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+                gvals = [None if g is None else _unwrap(g) for g in gin]
+                # align returned grads with differentiable tensor inputs
+                it = iter(gvals)
+                aligned = []
+                produced = list(gvals)
+                if len(produced) == len(parents):
+                    aligned = produced
+                else:
+                    # user returned one grad per tensor input; filter to parents
+                    k = 0
+                    for t in tensor_inputs:
+                        g = produced[k] if k < len(produced) else None
+                        k += 1
+                        if not t.stop_gradient:
+                            aligned.append(g)
+                return tuple(aligned)
+
+            node = T.TapeNode(
+                cls.__name__, vjp_fn, parents, [(o.shape, o.dtype) for o in outs]
+            )
+            for i, o in enumerate(outs):
+                o.stop_gradient = False
+                o._node = node
+                o._out_idx = i
+        return tuple(outs) if multi else outs[0]
+
+
+# ---- functional API (paddle.autograd.functional analog → native JAX) ----
+
+
+def _as_fun(func):
+    def f(*vals):
+        outs = func(*[Tensor(v) for v in vals])
+        if isinstance(outs, (tuple, list)):
+            return tuple(_unwrap(o) for o in outs)
+        return _unwrap(outs)
+
+    return f
+
+
+def jacobian(func, xs, create_graph=False):
+    single = isinstance(xs, Tensor)
+    vals = [_unwrap(xs)] if single else [_unwrap(x) for x in xs]
+    jac = jax.jacrev(_as_fun(func), argnums=tuple(range(len(vals))))(*vals)
+    if single:
+        return Tensor(jac[0]) if isinstance(jac, tuple) else Tensor(jac)
+    return jax.tree_util.tree_map(Tensor, jac)
+
+
+def hessian(func, xs, create_graph=False):
+    single = isinstance(xs, Tensor)
+    vals = [_unwrap(xs)] if single else [_unwrap(x) for x in xs]
+    h = jax.hessian(_as_fun(func), argnums=tuple(range(len(vals))))(*vals)
+    if single:
+        while isinstance(h, tuple):
+            h = h[0]
+        return Tensor(h)
+    return jax.tree_util.tree_map(Tensor, h)
+
+
+def vjp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    vals = [_unwrap(xs)] if single else [_unwrap(x) for x in xs]
+    out, vjp_fn = jax.vjp(_as_fun(func), *vals)
+    if v is None:
+        v = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v = jax.tree_util.tree_map(_unwrap, v)
+    grads = vjp_fn(v)
+    outs = jax.tree_util.tree_map(Tensor, out)
+    gs = [Tensor(g) for g in grads]
+    return outs, (gs[0] if single else gs)
+
+
+def jvp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    vals = [_unwrap(xs)] if single else [_unwrap(x) for x in xs]
+    if v is None:
+        tangents = [jnp.ones_like(x) for x in vals]
+    else:
+        vs = [v] if isinstance(v, Tensor) else list(v)
+        tangents = [_unwrap(t) for t in vs]
+    out, jv = jax.jvp(_as_fun(func), tuple(vals), tuple(tangents))
+    return jax.tree_util.tree_map(Tensor, out), jax.tree_util.tree_map(Tensor, jv)

@@ -1,0 +1,88 @@
+"""Dtype system.
+
+Analog of the reference's ``phi::DataType`` (paddle/phi/common/data_type.h) and the
+Python-level dtype aliases.  We alias straight onto numpy/jax dtypes — on TPU the
+set that matters is {bfloat16, float32, int32, bool, (u)int8, fp8} and XLA owns
+layout, so no DataLayout enum is needed (documented mapping, SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .flags import flag
+
+# Canonical dtype objects are jnp dtypes so arrays interoperate directly.
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_STR_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize str/np/jnp dtype specifiers to a numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR_TO_DTYPE:
+            raise ValueError(f"unsupported dtype string {dtype!r}")
+        return np.dtype(_STR_TO_DTYPE[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def get_default_dtype():
+    return convert_dtype(flag("FLAGS_default_dtype"))
+
+
+def set_default_dtype(dtype) -> None:
+    from .flags import set_flags
+
+    set_flags({"FLAGS_default_dtype": dtype_name(convert_dtype(dtype))})
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.floating)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.complexfloating)
+
+
+def is_inexact(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.inexact)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.integer)

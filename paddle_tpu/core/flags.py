@@ -1,0 +1,98 @@
+"""Process-level flag registry.
+
+TPU-native analog of the reference's gflags-compatible flag system
+(`paddle/common/flags.h:38`, `paddle/common/flags.cc` — ~183 exported ``FLAGS_*``,
+surfaced in Python via ``paddle.set_flags`` / ``paddle.get_flags``).
+
+Flags are plain Python values registered at import time; every flag can be
+overridden by an environment variable of the same name (``FLAGS_check_nan_inf=1``)
+at first access, mirroring the reference's env-var override behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+_lock = threading.Lock()
+_registry: dict[str, "_Flag"] = {}
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "doc", "_env_checked")
+
+    def __init__(self, name: str, default: Any, doc: str):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.doc = doc
+        self._env_checked = False
+
+    def get(self) -> Any:
+        if not self._env_checked:
+            self._env_checked = True
+            env = os.environ.get(self.name)
+            if env is not None:
+                self.value = _coerce(env, self.default)
+        return self.value
+
+
+def _coerce(text: str, like: Any) -> Any:
+    if isinstance(like, bool):
+        return text.lower() in ("1", "true", "yes", "on")
+    if isinstance(like, int):
+        return int(text)
+    if isinstance(like, float):
+        return float(text)
+    return text
+
+
+def define_flag(name: str, default: Any, doc: str = "") -> None:
+    """Register a flag (analog of PD_DEFINE_* / PHI_DEFINE_EXPORTED_*)."""
+    with _lock:
+        if name not in _registry:
+            _registry[name] = _Flag(name, default, doc)
+
+
+def get_flags(names):
+    """Mirror of ``paddle.get_flags``: accepts a name or list of names."""
+    single = isinstance(names, str)
+    if single:
+        names = [names]
+    out = {}
+    for n in names:
+        if n not in _registry:
+            raise ValueError(f"unknown flag {n!r}")
+        out[n] = _registry[n].get()
+    return out
+
+
+def set_flags(flags: dict) -> None:
+    """Mirror of ``paddle.set_flags``."""
+    with _lock:
+        for name, value in flags.items():
+            if name not in _registry:
+                raise ValueError(f"unknown flag {name!r}")
+            f = _registry[name]
+            f._env_checked = True
+            f.value = _coerce(value, f.default) if isinstance(value, str) else value
+
+
+def flag(name: str) -> Any:
+    """Fast internal accessor."""
+    return _registry[name].get()
+
+
+# -- Core flags (subset of the reference's catalogue that is meaningful on TPU) --
+define_flag("FLAGS_check_nan_inf", False, "Check outputs of every op for NaN/Inf.")
+define_flag("FLAGS_check_nan_inf_level", 0, "0: error on nan/inf; >0: log only.")
+define_flag("FLAGS_set_to_1d", False, "Treat 0-D tensors as 1-D in numpy conversion.")
+define_flag("FLAGS_default_dtype", "float32", "Default floating point dtype.")
+define_flag("FLAGS_benchmark", False, "Block-until-ready after every eager op.")
+define_flag("FLAGS_eager_jit_ops", True, "Route eager op dispatch through cached jax.jit.")
+define_flag("FLAGS_log_level", 0, "VLOG-style verbosity for framework internals.")
+define_flag("FLAGS_use_pallas_kernels", True, "Use Pallas kernels for fused ops on TPU.")
+define_flag("FLAGS_embedding_deterministic", False, "Deterministic embedding grad scatter.")
+define_flag("FLAGS_cudnn_deterministic", False, "Accepted for API parity; no-op on TPU.")
+define_flag("FLAGS_max_inflight_collectives", 8, "Eager collective pipelining depth.")

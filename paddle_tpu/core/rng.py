@@ -1,0 +1,77 @@
+"""Random number generation.
+
+Analog of the reference's ``phi::Generator`` (`paddle/phi/core/generator.h:32`),
+whose state is {device, seed, offset}: every random kernel consumes the current
+(seed, offset) pair and bumps the offset.  The TPU-native realization maps that
+exact state onto stateless JAX PRNG: ``key = fold_in(key(seed), offset)`` with a
+monotonically increasing offset — deterministic, checkpointable, and replayable
+(which is what recompute's RNG-state tracker needs, see
+`fleet/recompute/recompute.py:116` in the reference).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class Generator:
+    """Counter-based RNG with reference-compatible {seed, offset} state."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._seed = int(seed)
+        self._offset = 0
+
+    def manual_seed(self, seed: int) -> "Generator":
+        with self._lock:
+            self._seed = int(seed)
+            self._offset = 0
+        return self
+
+    def seed(self) -> int:
+        return self._seed
+
+    def get_state(self) -> tuple[int, int]:
+        with self._lock:
+            return (self._seed, self._offset)
+
+    def set_state(self, state) -> None:
+        with self._lock:
+            self._seed, self._offset = int(state[0]), int(state[1])
+
+    def next_key(self) -> jax.Array:
+        """Draw the next PRNG key, bumping the offset (kernel-consume semantics)."""
+        with self._lock:
+            k = jax.random.fold_in(jax.random.key(self._seed), self._offset)
+            self._offset += 1
+            return k
+
+    def peek_key(self, offset_delta: int = 0) -> jax.Array:
+        with self._lock:
+            return jax.random.fold_in(jax.random.key(self._seed), self._offset + offset_delta)
+
+
+_default = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default
+
+
+def seed(value: int) -> Generator:
+    """``paddle.seed`` analog: reset the global generator."""
+    return _default.manual_seed(value)
+
+
+def get_rng_state():
+    return _default.get_state()
+
+
+def set_rng_state(state) -> None:
+    _default.set_state(state)
+
+
+def next_key() -> jax.Array:
+    return _default.next_key()

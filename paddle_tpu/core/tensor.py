@@ -1,0 +1,587 @@
+"""Tensor type + eager autograd tape.
+
+This replaces three reference layers with one TPU-native design (SURVEY.md §3.1/§3.2):
+
+- ``phi::DenseTensor`` (`paddle/phi/core/dense_tensor.h:37`) → a thin wrapper over a
+  ``jax.Array`` (PJRT owns memory/layout/streams; no allocator to build).
+- the generated eager AD functions + GradNode graph
+  (`paddle/fluid/eager/grad_node_info.h:197`, `eager_gen.py:367`) → every traced op
+  is dispatched through :func:`apply_op`, which uses ``jax.vjp`` to run the forward
+  *and* capture the exact backward closure; nodes form a tape ordered by creation id.
+- ``egr::RunBackward`` (`paddle/fluid/eager/backward.cc:106` — in-degree map + ready
+  queue) → reverse-creation-order sweep over reachable nodes (a tape is already a
+  topological order, so no in-degree bookkeeping is needed).
+
+Eager mode is the debugging/UX surface; the performance path is tracing the same ops
+under ``jit``/``to_static`` where this tape is bypassed entirely (grad_enabled off)
+and XLA sees pure jnp code.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .device import current_device
+from .flags import flag
+
+__all__ = [
+    "Tensor",
+    "Parameter",
+    "to_tensor",
+    "apply_op",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+]
+
+_node_counter = itertools.count()
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _grad_state.enabled = bool(mode)
+
+
+class _GradModeGuard:
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _GradModeGuard(self._mode):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def no_grad(fn=None):
+    """Context manager / decorator disabling tape recording (``paddle.no_grad``)."""
+    guard = _GradModeGuard(False)
+    return guard if fn is None else guard(fn)
+
+
+def enable_grad(fn=None):
+    guard = _GradModeGuard(True)
+    return guard if fn is None else guard(fn)
+
+
+class TapeNode:
+    """One recorded op: holds the vjp closure and edges to parent tensors."""
+
+    __slots__ = ("id", "op_name", "vjp_fn", "parents", "out_avals", "n_out")
+
+    def __init__(self, op_name, vjp_fn, parents, out_avals):
+        self.id = next(_node_counter)
+        self.op_name = op_name
+        self.vjp_fn = vjp_fn
+        self.parents = parents  # list[Tensor] — only the differentiable inputs
+        self.out_avals = out_avals  # list[(shape, dtype)]
+        self.n_out = len(out_avals)
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+class Tensor:
+    """Eager tensor: value + (optional) producer node on the autograd tape."""
+
+    __slots__ = (
+        "_value",
+        "_node",
+        "_out_idx",
+        "stop_gradient",
+        "_grad",
+        "_retain_grads",
+        "_hooks",
+        "name",
+        "persistable",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: str | None = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = value
+        self._node: TapeNode | None = None
+        self._out_idx = 0
+        self.stop_gradient = stop_gradient
+        self._grad: jax.Array | None = None
+        self._retain_grads = False
+        self._hooks: list[Callable] | None = None
+        self.name = name
+        self.persistable = False
+
+    # -- basic properties -------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        from .device import Place
+
+        devs = getattr(self._value, "devices", None)
+        if devs is not None and not _is_tracer(self._value):
+            try:
+                return Place(next(iter(self._value.devices())))
+            except Exception:
+                pass
+        return Place(current_device())
+
+    @property
+    def T(self):
+        from .. import ops
+
+        return ops.manipulation.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def grad(self) -> "Tensor | None":
+        return None if self._grad is None else Tensor(self._grad)
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = None if value is None else _unwrap(value)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    def value(self):
+        return self._value
+
+    # -- conversion -------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def astype(self, dtype) -> "Tensor":
+        from .. import ops
+
+        return ops.manipulation.cast(self, dtype)
+
+    cast = astype
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True)
+        return t
+
+    def clone(self) -> "Tensor":
+        return apply_op("clone", lambda x: jnp.copy(x), [self])
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._value), self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        # accepts dtype or device strings for script compatibility
+        t = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a.split(":")[0] in ("cpu", "tpu", "gpu"):
+                from .device import _parse
+
+                t = Tensor(jax.device_put(t._value, _parse(a)), t.stop_gradient)
+            elif a is not None:
+                t = t.astype(a)
+        return t
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # -- autograd ---------------------------------------------------------
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook: Callable):
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(h):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        run_backward(self, grad_tensor, retain_graph)
+
+    # -- python protocol ---------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        grad_note = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={list(self.shape)}, dtype={self.dtype.name}{grad_note},\n"
+            f"       {np.asarray(jax.device_get(self._value)) if not _is_tracer(self._value) else self._value})"
+        )
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __index__(self):
+        return int(self._value)
+
+    def __format__(self, spec):
+        return format(self.item() if self.ndim == 0 else np.asarray(self._value), spec)
+
+    def __hash__(self):
+        return id(self)
+
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return apply_op("getitem", lambda x: x[idx], [self])
+
+    def _snapshot(self) -> "Tensor":
+        """Copy of this tensor's (value, tape position) — required before
+        in-place mutation so the recorded op's parent is the *pre-mutation*
+        tensor (otherwise the tape would contain a self-cycle)."""
+        s = Tensor(self._value, stop_gradient=self.stop_gradient)
+        s._node = self._node
+        s._out_idx = self._out_idx
+        return s
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        inputs = [self._snapshot()]
+        if isinstance(value, Tensor):
+            inputs.append(value)
+
+            def fn(x, v):
+                return x.at[idx].set(v.astype(x.dtype))
+
+        else:
+
+            def fn(x):
+                return x.at[idx].set(jnp.asarray(value, x.dtype))
+
+        out = apply_op("setitem", fn, inputs)
+        # in-place semantics: this tensor becomes the op output on the tape
+        self._value = out._value
+        self._node = out._node
+        self._out_idx = out._out_idx
+        self.stop_gradient = out.stop_gradient
+
+    # arithmetic dunders are installed by paddle_tpu.ops at import time
+    def __array__(self, dtype=None):
+        a = np.asarray(jax.device_get(self._value))
+        return a.astype(dtype) if dtype is not None else a
+
+    # jax pytree protocol is registered below so Tensors flow through jit/vmap.
+
+
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (t.stop_gradient,)
+
+
+def _tensor_unflatten(aux, children):
+    return Tensor(children[0], stop_gradient=aux[0])
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (analog of ``paddle.base.framework.EagerParamBase``)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, value, trainable: bool = True, name: str | None = None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.persistable = True
+
+    def set_value(self, value):
+        v = _unwrap(value)
+        self._value = jnp.asarray(v, self.dtype).reshape(self.shape)
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda p: ((p._value,), (p.trainable,)),
+    lambda aux, ch: Parameter(ch[0], trainable=aux[0]),
+)
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, tuple):
+        return tuple(_unwrap(i) for i in idx)
+    if isinstance(idx, list) and any(isinstance(i, Tensor) for i in idx):
+        return [_unwrap(i) for i in idx]
+    return _unwrap(idx)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """``paddle.to_tensor`` analog."""
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = v.astype(dtypes.convert_dtype(dtype))
+        return Tensor(v, stop_gradient=stop_gradient)
+    if isinstance(data, (jnp.ndarray, jax.Array)) and not isinstance(data, np.ndarray):
+        v = data
+    else:
+        a = np.asarray(data)
+        if dtype is None and a.dtype == np.float64:
+            a = a.astype(dtypes.get_default_dtype())
+        v = jnp.asarray(a)
+    if dtype is not None:
+        v = v.astype(dtypes.convert_dtype(dtype))
+    if place is not None and not _is_tracer(v):
+        from .device import _parse
+
+        v = jax.device_put(v, _parse(place) if isinstance(place, str) else place.device)
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+# ---------------------------------------------------------------------------
+# op dispatch
+# ---------------------------------------------------------------------------
+
+# set by paddle_tpu.amp at import time: (op_name, vals) -> vals with AMP casts
+_amp_cast_hook = None
+
+
+def _check_nan_inf(name: str, vals) -> None:
+    for v in vals:
+        if jnp.issubdtype(v.dtype, jnp.inexact) and not _is_tracer(v):
+            if bool(jnp.any(~jnp.isfinite(v))):
+                msg = f"Operator {name} output contains NaN/Inf"
+                if flag("FLAGS_check_nan_inf_level") > 0:
+                    print("WARNING:", msg)
+                else:
+                    raise FloatingPointError(msg)
+
+
+def apply_op(
+    name: str,
+    fn: Callable,
+    inputs: Sequence[Any],
+    n_outputs: int | None = None,
+    **static_kwargs,
+):
+    """Dispatch one op through the eager tape.
+
+    ``fn`` is a pure jnp function taking the unwrapped inputs positionally plus
+    ``static_kwargs``.  Replaces the generated per-op AD function of the
+    reference (`eager_gen.py:367`): forward runs via ``jax.vjp`` when any input
+    requires grad, capturing the exact XLA backward; otherwise ``fn`` runs
+    directly (and is traceable, so the same ops work under jit).  The AMP policy
+    hook (registered by paddle_tpu.amp) mirrors the AMP_LOGIC_TEMPLATE stage of
+    the reference's generated AD functions (`eager_gen.py:645`).
+    """
+    vals = [_unwrap(x) for x in inputs]
+    if _amp_cast_hook is not None:
+        vals = _amp_cast_hook(name, vals)
+    tracing = any(_is_tracer(v) for v in vals)
+    record = (
+        _grad_state.enabled
+        and not tracing
+        and any(
+            isinstance(x, Tensor)
+            and not x.stop_gradient
+            and dtypes.is_inexact(x.dtype)
+            for x in inputs
+        )
+    )
+    if not record:
+        out = fn(*vals, **static_kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        if flag("FLAGS_check_nan_inf"):
+            _check_nan_inf(name, outs)
+        wrapped = [Tensor(o, stop_gradient=True) for o in outs]
+        return tuple(wrapped) if multi else wrapped[0]
+
+    diff_mask = [
+        isinstance(x, Tensor) and not x.stop_gradient and dtypes.is_inexact(x.dtype)
+        for x in inputs
+    ]
+    diff_vals = [v for v, m in zip(vals, diff_mask) if m]
+
+    def closed(*dvals):
+        it = iter(dvals)
+        full = [next(it) if m else v for m, v in zip(diff_mask, vals)]
+        return fn(*full, **static_kwargs)
+
+    out, vjp_fn = jax.vjp(closed, *diff_vals)
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    if flag("FLAGS_check_nan_inf"):
+        _check_nan_inf(name, outs)
+    parents = [x for x, m in zip(inputs, diff_mask) if m]
+    node = TapeNode(name, vjp_fn, parents, [(o.shape, o.dtype) for o in outs])
+    wrapped = []
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=not dtypes.is_inexact(o.dtype))
+        if not t.stop_gradient:
+            t._node = node
+            t._out_idx = i
+        wrapped.append(t)
+    return tuple(wrapped) if multi else wrapped[0]
+
+
+# ---------------------------------------------------------------------------
+# backward engine
+# ---------------------------------------------------------------------------
+
+def run_backward(tensor: Tensor, grad_tensor=None, retain_graph: bool = False):
+    """Reverse sweep over the tape (analog of egr::RunBackward, backward.cc:106)."""
+    if tensor.stop_gradient:
+        raise RuntimeError("backward() on a tensor with stop_gradient=True")
+    if grad_tensor is None:
+        if tensor.size != 1:
+            raise RuntimeError(
+                "grad can be implicitly created only for scalar outputs; "
+                f"got shape {tensor.shape}"
+            )
+        seed = jnp.ones(tensor.shape, tensor._value.dtype)
+    else:
+        seed = jnp.asarray(_unwrap(grad_tensor), tensor._value.dtype)
+
+    def _route(t: Tensor, g):
+        if t._hooks:
+            for h in t._hooks:
+                r = h(Tensor(g))
+                if r is not None:
+                    g = _unwrap(r)
+        if t._node is None or t._retain_grads:
+            t._grad = g if t._grad is None else t._grad + g
+        return g
+
+    if tensor._node is None:
+        _route(tensor, seed)
+        return
+
+    # collect reachable nodes; tape ids give topological order for free
+    nodes: dict[int, TapeNode] = {}
+    stack = [tensor._node]
+    while stack:
+        n = stack.pop()
+        if n.id in nodes:
+            continue
+        nodes[n.id] = n
+        for p in n.parents:
+            if p._node is not None:
+                stack.append(p._node)
+
+    # cotangent accumulator keyed by (node_id, out_idx)
+    cots: dict[tuple[int, int], Any] = {(tensor._node.id, tensor._out_idx): seed}
+    _route(tensor, seed)
+
+    for nid in sorted(nodes, reverse=True):
+        node = nodes[nid]
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to backward through the graph a second time "
+                "(set retain_graph=True)"
+            )
+        couts = []
+        any_set = False
+        for i, (shape, dt) in enumerate(node.out_avals):
+            g = cots.pop((nid, i), None)
+            if g is None:
+                g = jnp.zeros(shape, dt)
+            else:
+                any_set = True
+            couts.append(g)
+        if not any_set:
+            continue
+        in_grads = node.vjp_fn(tuple(couts) if node.n_out > 1 else couts[0])
+        if not retain_graph:
+            node.vjp_fn = None
+        for p, g in zip(node.parents, in_grads):
+            if g is None:
+                continue
+            g = _route(p, g)
+            if p._node is not None:
+                key = (p._node.id, p._out_idx)
+                cots[key] = g if key not in cots else cots[key] + g

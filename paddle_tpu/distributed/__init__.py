@@ -1,0 +1,71 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/).
+
+Surface: collectives + Group, init_parallel_env/rank queries, DataParallel,
+fleet (hybrid parallel), auto_parallel (DTensor/GSPMD), sharding (ZeRO),
+checkpoint (sharded save/load with reshard-on-load), launch."""
+
+from . import fleet  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_local,
+    dtensor_to_local,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    unshard_dtensor,
+)
+from .collective import (  # noqa: F401
+    Group,
+    P2POp,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    batch_isend_irecv,
+    broadcast,
+    from_rank_list,
+    gather,
+    get_group,
+    irecv,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    stream,
+    to_rank_list,
+    wait,
+)
+from .env import (  # noqa: F401
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from .parallel import DataParallel  # noqa: F401
+
+
+def get_backend():
+    return "xla"  # collectives are XLA ops over ICI/DCN (no NCCL)
+
+
+def is_available():
+    return True
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn analog.  Single-controller SPMD: one process
+    drives all local devices, so spawn degenerates to a direct call (the
+    reference forks one proc per GPU; that model doesn't apply to PJRT)."""
+    func(*args)

@@ -1,0 +1,11 @@
+from .api import (  # noqa: F401
+    dtensor_from_local,
+    dtensor_to_local,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    unshard_dtensor,
+)
+from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
+from .process_mesh import ProcessMesh  # noqa: F401

@@ -1,0 +1,79 @@
+"""ProcessMesh (reference: paddle/phi/core/distributed/auto_parallel/process_mesh.h:34,
+python surface python/paddle/distributed/auto_parallel/process_mesh.py).
+
+TPU-native: a ProcessMesh *is* a ``jax.sharding.Mesh`` — an N-D array of devices
+with named axes; GSPMD handles propagation over it."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._shape = arr.shape
+        self._dim_names = list(dim_names)
+        self._process_ids = arr.reshape(-1).tolist()
+        devices = jax.devices()
+        dev_arr = np.empty(arr.shape, dtype=object)
+        flat = arr.reshape(-1)
+        for i, pid in enumerate(flat):
+            dev_arr.reshape(-1)[i] = devices[int(pid) % len(devices)]
+        self._jax_mesh = Mesh(dev_arr, axis_names=tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        axis = self._dim_names.index(dim_name)
+        arr = self.mesh
+        moved = np.moveaxis(arr, axis, 0)
+        names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+        if index is None:
+            return ProcessMesh(moved, names)
+        sub_names = [n for n in self._dim_names if n != dim_name]
+        return ProcessMesh(moved[index], sub_names)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and other._shape == self._shape
+            and other._process_ids == self._process_ids
+            and other._dim_names == self._dim_names
+        )
+
+    def __hash__(self):
+        return hash((self._shape, tuple(self._process_ids), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={list(self._shape)}, dim_names={self._dim_names})"

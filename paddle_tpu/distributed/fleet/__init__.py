@@ -1,0 +1,121 @@
+"""fleet: hybrid-parallel facade (reference: python/paddle/distributed/fleet/ —
+fleet.init at fleet.py:218, distributed_model at model.py:33,
+distributed_optimizer at optimizer.py:96)."""
+
+from __future__ import annotations
+
+import jax
+
+from .strategy import DistributedStrategy, Strategy  # noqa: F401
+from .topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    ParallelMode,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+
+_fleet_state = {"initialized": False, "strategy": None, "hcg": None}
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    """fleet.init (fleet.py:218): build the hybrid topology mesh from strategy."""
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    ndev = jax.device_count()
+    degrees = {
+        "data": hc.get("dp_degree", 1) or 1,
+        "pipe": hc.get("pp_degree", 1) or 1,
+        "sharding": hc.get("sharding_degree", 1) or 1,
+        "sep": hc.get("sep_degree", 1) or 1,
+        "model": hc.get("mp_degree", 1) or 1,
+    }
+    import numpy as np
+
+    prod = int(np.prod(list(degrees.values())))
+    if prod == 1 and ndev > 1:
+        degrees["data"] = ndev
+        prod = ndev
+    if prod > ndev:
+        raise ValueError(
+            f"hybrid degrees {degrees} need {prod} devices but only {ndev} present "
+            "(use XLA_FLAGS=--xla_force_host_platform_device_count=N for CPU tests)"
+        )
+    topo = CommunicateTopology(
+        ["data", "pipe", "sharding", "sep", "model"],
+        [degrees["data"], degrees["pipe"], degrees["sharding"], degrees["sep"], degrees["model"]],
+    )
+    hcg = HybridCommunicateGroup(topo)
+    set_hybrid_communicate_group(hcg)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)
+    return None
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+def get_hybrid_parallel_mesh():
+    """The jax Mesh of the current hybrid topology (TPU-native accessor)."""
+    hcg = _fleet_state["hcg"]
+    return hcg.mesh if hcg is not None else None
+
+
+def distributed_model(model):
+    """fleet/model.py:33 — wrap per strategy.  Under GSPMD the wrapper's job
+    (grad sync) happens inside the jitted step; eager wrappers keep semantics."""
+    from .meta_parallel import PipelineParallel, TensorParallel
+    from ..parallel import DataParallel
+
+    hcg = _fleet_state["hcg"]
+    if hcg is None:
+        return model
+    if hcg.get_pipe_parallel_world_size() > 1 and hasattr(model, "forward_backward_pipeline"):
+        return model
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg)
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model, group=hcg.get_data_parallel_group())
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """fleet/optimizer.py:96 — wrap with the hybrid-aware optimizer."""
+    from .hybrid_optimizer import HybridParallelOptimizer
+
+    hcg = _fleet_state["hcg"]
+    if hcg is None:
+        return optimizer
+    return HybridParallelOptimizer(optimizer, hcg, _fleet_state["strategy"])
+
+
+def get_rank():
+    from ..env import get_rank as _gr
+
+    return _gr()
+
+
+def worker_num():
+    return jax.device_count()
+
+
+def worker_index():
+    from ..env import get_rank as _gr
+
+    return _gr()
+
+
+def barrier_worker():
+    from ..collective import barrier
+
+    barrier()
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, *args, **kwargs):
+        pass
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=False, **kwargs):
+        self.is_collective = is_collective

@@ -1,0 +1,56 @@
+"""HybridParallelOptimizer + distributed-aware grad clip (reference:
+fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:275 and
+HybridParallelClipGrad at :48 — global-norm allreduce across mp/pp/sharding
+groups)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, _unwrap, no_grad
+from ...nn.clip import ClipGradByGlobalNorm
+
+
+class HybridParallelClipGrad:
+    """Global-norm clip whose norm is reduced across all model-parallel axes.
+
+    In the stacked-eager single-controller world every parameter's full value is
+    visible, so the global norm is exact; inside pjit, grads are sharded and the
+    sum-of-squares psum is inserted by GSPMD when this runs in the step fn."""
+
+    def __init__(self, clip, hcg):
+        self._clip = clip
+        self._hcg = hcg
+
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if optimizer._grad_clip is not None and isinstance(optimizer._grad_clip, ClipGradByGlobalNorm):
+            optimizer._grad_clip = HybridParallelClipGrad(optimizer._grad_clip, hcg)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    @no_grad()
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        return self._inner_opt.minimize(loss)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)

@@ -1,0 +1,44 @@
+"""Meta-parallel wrappers (reference: fleet/meta_parallel/ — TensorParallel,
+SegmentParallel at segment_parallel.py:26; PipelineParallel lives in
+paddle_tpu.distributed.fleet.pipeline)."""
+
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+class TensorParallel(MetaParallelBase):
+    """Under GSPMD, TP layers already carry their mesh shardings; this wrapper
+    exists for fleet API parity (broadcast of non-distributed params happens via
+    replicated sharding)."""
+
+
+class SegmentParallel(MetaParallelBase):
+    """sep-axis wrapper (segment_parallel.py:26): sequence dim sharded over the
+    'sep' mesh axis; attention runs ring/alltoall via the sep collectives."""
+
+
+from .pipeline import PipelineParallel  # noqa: E402,F401

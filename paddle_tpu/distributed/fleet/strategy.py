@@ -1,0 +1,72 @@
+"""DistributedStrategy (reference: fleet/base/distributed_strategy.py:284, backed
+by distributed_strategy.proto).  A plain config object here — the fields that
+drive behavior are hybrid_configs {dp/mp/pp/sharding/sep degree}, amp, recompute,
+and the pipeline scheduler knobs."""
+
+from __future__ import annotations
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+            "order": ["dp", "pp", "sharding", "sep", "mp"],
+        }
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 65536.0,
+            "use_dynamic_loss_scaling": True,
+            "custom_white_list": [],
+            "custom_black_list": [],
+            "use_pure_fp16": False,
+            "use_pure_bf16": False,
+        }
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.pipeline = False
+        self.pipeline_configs = {
+            "accumulate_steps": 1,
+            "micro_batch_size": 1,
+            "schedule_mode": "1F1B",
+        }
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "degree": 1, "offload": False}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.dgc = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.heter_ccl_mode = False
+        self.without_graph_optimization = True
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items() if not k.startswith("_")}
+        return f"DistributedStrategy({fields})"
+
+
+class Strategy:
+    """Semi-auto strategy (reference: auto_parallel/strategy.py:191)."""
+
+    def __init__(self, config=None):
+        class _Sub:
+            def __init__(self, **kw):
+                self.__dict__.update(kw)
+
+        self.sharding = _Sub(enable=False, degree=1, stage=1)
+        self.amp = _Sub(enable=False, dtype="bfloat16", level="O1")
+        self.recompute = _Sub(enable=False)
+        self.pipeline = _Sub(enable=False, schedule_mode="1F1B", accumulate_steps=1, micro_batch_size=1)
+        self.gradient_merge = _Sub(enable=False, k_steps=1)
+        self.fused_passes = _Sub(enable=False, fused_passes_list=[])
+        if config:
+            for k, v in config.items():
+                setattr(self, k, v)

@@ -1,0 +1,215 @@
+"""Hybrid-parallel topology (reference:
+python/paddle/distributed/fleet/base/topology.py — CommunicateTopology with axis
+order [data, pipe, sharding, sep, model] at :73-79, HybridCommunicateGroup :189).
+
+TPU-native: the topology IS a named device mesh.  Axis order is preserved; each
+"communication group" is a mesh axis (or fused axes) rather than an NCCL ring —
+collectives over it ride ICI inside pjit programs (SURVEY.md §7 mapping)."""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..collective import Group, new_group
+
+_HYBRID_AXES = ["data", "pipe", "sharding", "sep", "model"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = hybrid_group_names or list(_HYBRID_AXES)
+        self._dims = list(dims) if dims is not None else [jax.device_count(), 1, 1, 1, 1]
+        self._world_size = int(np.prod(self._dims))
+        shape = tuple(self._dims)
+        self._coord_map = {}
+        for rank, coord in enumerate(itertools.product(*[range(d) for d in shape])):
+            self._coord_map[coord] = rank
+        self._rank_map = {v: k for k, v in self._coord_map.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return self._coord_map[coord]
+
+    def get_coord(self, rank):
+        return self._rank_map[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord_map.items() if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """All rank-groups along `axis_name` (one per combination of the others)."""
+        axis = self._parallel_names.index(axis_name)
+        others = [i for i in range(len(self._dims)) if i != axis]
+        groups = []
+        for combo in itertools.product(*[range(self._dims[i]) for i in others]):
+            ranks = []
+            for k in range(self._dims[axis]):
+                coord = [0] * len(self._dims)
+                for i, o in zip(combo, others):
+                    coord[o] = i
+                coord[axis] = k
+                ranks.append(self._coord_map[tuple(coord)])
+            groups.append(ranks)
+        return groups
+
+    def get_fused_ranks(self, fused_axes):
+        """Ranks grouped by the cartesian product of `fused_axes` (topology.py:165)."""
+        axes = [self._parallel_names.index(a) for a in fused_axes]
+        others = [i for i in range(len(self._dims)) if i not in axes]
+        groups = []
+        for combo in itertools.product(*[range(self._dims[i]) for i in others]):
+            ranks = []
+            for fused_combo in itertools.product(*[range(self._dims[i]) for i in axes]):
+                coord = [0] * len(self._dims)
+                for i, o in zip(combo, others):
+                    coord[o] = i
+                for i, a in zip(fused_combo, axes):
+                    coord[a] = i
+                ranks.append(self._coord_map[tuple(coord)])
+            groups.append(sorted(ranks))
+        return groups
+
+
+class HybridCommunicateGroup:
+    """The reference's hub object (topology.py:189) adapted to the mesh world.
+
+    Exposes the same query surface (degrees, ranks, per-axis comm groups) plus
+    the jax Mesh that pjit programs shard over.  The single-controller "rank" is
+    0; per-device ranks resolve inside shard_map via lax.axis_index."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.nranks = topology.world_size()
+        self.global_rank = 0
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep")
+        self._mp_degree = topology.get_dim("model")
+
+        # the device mesh with the canonical axis order
+        devices = np.asarray(jax.devices()[: self.nranks])
+        mesh_shape = [self._dp_degree, self._pp_degree, self._sharding_degree, self._sep_degree, self._mp_degree]
+        self.mesh = Mesh(
+            devices.reshape(mesh_shape),
+            axis_names=("data", "pipe", "sharding", "sep", "model"),
+        )
+        # per-axis groups (axis-name keyed; single-controller has one logical group per axis)
+        self._groups = {
+            name: Group(list(range(self._topo.get_dim(name))), axis_name=name, gid=None)
+            for name in self._topo.get_hybrid_group_names()
+        }
+
+    # --- degrees ---
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # --- ranks (single-controller: 0; in-program: lax.axis_index(axis)) ---
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    # --- groups ---
+    def get_data_parallel_group(self):
+        return self._groups["data"]
+
+    def get_model_parallel_group(self):
+        return self._groups["model"]
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pipe"]
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._groups["model"]
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # pipeline neighbors (in-program p2p uses ppermute over 'pipe')
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        from . import base
+
+        if self._mp_degree == 1 and self._pp_degree == 1 and self._sharding_degree == 1 and self._dp_degree > 1:
+            return ParallelMode.DATA_PARALLEL
+        return ParallelMode.HYBRID_PARALLEL
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    HYBRID_PARALLEL = 4
+
+
+_hcg: HybridCommunicateGroup | None = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup | None:
+    return _hcg

@@ -1,0 +1,68 @@
+"""DataParallel (reference: python/paddle/distributed/parallel.py:219 with C++
+EagerReducer bucketing, paddle/fluid/distributed/collective/reducer.h:88).
+
+TPU-native: under jit/pjit, data parallelism is a mesh axis — gradients are
+psum'd by GSPMD and XLA's latency-hiding scheduler overlaps the all-reduce with
+backward compute (the EagerReducer's job).  This wrapper keeps the eager API:
+after backward, ``apply_collective_grads`` averages grads across the dp group
+(stacked-eager convention or in-program axis)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, no_grad
+from ..nn.layer_base import Layer
+from .collective import ReduceOp, all_reduce
+from .env import get_world_size, init_parallel_env  # noqa: F401
+
+
+class DataParallel(Layer):
+    def __init__(
+        self,
+        layers,
+        strategy=None,
+        comm_buffer_size=25,
+        last_comm_buffer_size=1,
+        find_unused_parameters=False,
+        group=None,
+    ):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @no_grad()
+    def apply_collective_grads(self):
+        n = self.group.nranks if self.group is not None else get_world_size()
+        if n <= 1:
+            return
+        for p in self._layers.parameters():
+            if p._grad is not None:
+                g = Tensor(p._grad)
+                all_reduce(g, op=ReduceOp.SUM, group=self.group)
+                p._grad = g._value / n
+
+    # delegate the Layer surface to the wrapped module
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def no_sync(self):
+        from contextlib import nullcontext
+
+        return nullcontext()

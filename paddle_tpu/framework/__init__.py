@@ -1,0 +1,6 @@
+"""framework: misc core utilities surfaced at ``paddle.framework`` in the
+reference (random seeds, save/load io)."""
+
+from ..core.rng import get_rng_state, seed, set_rng_state  # noqa: F401
+from . import io_utils  # noqa: F401
+from .io_utils import load, save  # noqa: F401

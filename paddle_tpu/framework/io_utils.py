@@ -1,0 +1,41 @@
+"""paddle.save / paddle.load analogs (reference: python/paddle/framework/io.py:773,1020).
+
+State dicts are stored as pickled dicts of numpy arrays — portable across hosts
+and framework versions (the distributed sharded checkpoint with reshard-on-load
+lives in paddle_tpu.distributed.checkpoint)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor, _unwrap
+
+_PROTOCOL = 4
+
+
+def _to_storable(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(_unwrap(obj))
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_storable(v) for v in obj)
+    if hasattr(obj, "state_dict") and callable(obj.state_dict):
+        return _to_storable(obj.state_dict())
+    return obj
+
+
+def save(obj, path, protocol=_PROTOCOL, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_storable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return pickle.load(f)

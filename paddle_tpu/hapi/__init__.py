@@ -1,0 +1,147 @@
+"""hapi: Keras-like high-level API (reference: python/paddle/hapi/model.py —
+Model.fit :1472, evaluate :2200, predict; callbacks; summary)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, no_grad, to_tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+
+__all__ = ["Model", "summary"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+
+    def _as_loader(self, data, batch_size, shuffle):
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        raise TypeError(f"unsupported data {type(data)}")
+
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = self.network(*[to_tensor(i) for i in inputs])
+        loss = self._loss(outs, to_tensor(labels))
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        metrics = [float(loss)]
+        for m in self._metrics:
+            res = m.update(m.compute(outs, to_tensor(labels)))
+            metrics.append(res)
+        return metrics
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = self.network(*[to_tensor(i) for i in inputs])
+        loss = self._loss(outs, to_tensor(labels))
+        res = [float(loss)]
+        for m in self._metrics:
+            res.append(m.update(m.compute(outs, to_tensor(labels))))
+        return res
+
+    def fit(
+        self,
+        train_data=None,
+        eval_data=None,
+        batch_size=1,
+        epochs=1,
+        eval_freq=1,
+        log_freq=10,
+        save_dir=None,
+        save_freq=1,
+        verbose=2,
+        shuffle=True,
+        num_workers=0,
+        callbacks=None,
+    ):
+        loader = self._as_loader(train_data, batch_size, shuffle)
+        history = []
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                x, y = batch[0], batch[1]
+                metrics = self.train_batch(x, y)
+                if verbose and step % log_freq == 0:
+                    print(f"epoch {epoch} step {step}: loss {metrics[0]:.4f}")
+            history.append(metrics)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0, callbacks=None):
+        loader = self._as_loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            res = self.eval_batch(batch[0], batch[1])
+            losses.append(res[0])
+        out = {"loss": [float(np.mean(losses))]}
+        for m in self._metrics:
+            out[m.name()] = m.accumulate()
+        if verbose:
+            print("eval:", out)
+        return out
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return self.network(*[to_tensor(i) for i in inputs])
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False)
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(x).numpy())
+        return [np.concatenate(outs)] if stack_outputs else outs
+
+    def save(self, path, training=True):
+        from ..framework.io_utils import save
+
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io_utils import load
+
+        self.network.set_state_dict(load(path + ".pdparams"))
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size)
+
+
+def summary(net, input_size=None, dtypes=None):
+    total = 0
+    trainable = 0
+    for p in net.parameters():
+        total += p.size
+        if p.trainable:
+            trainable += p.size
+    info = {"total_params": total, "trainable_params": trainable}
+    print(f"Total params: {total:,} (trainable {trainable:,})")
+    return info

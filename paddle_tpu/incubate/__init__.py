@@ -1,0 +1,3 @@
+"""incubate: experimental / fused-op surface (reference: python/paddle/incubate/)."""
+
+from . import nn  # noqa: F401

@@ -1,0 +1,151 @@
+"""Fused LLM ops (reference: python/paddle/incubate/nn/functional/ —
+fused_rms_norm.py, fused_rotary_position_embedding.py, swiglu.py, fused_moe.py,
+block_multihead_attention.py, masked_multihead_attention.py).
+
+Each wrapper dispatches through the eager tape to the Pallas/fused-XLA
+implementation in paddle_tpu.ops.pallas."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor, _unwrap, apply_op
+from ....ops.pallas import rms_norm as _rms
+from ....ops.pallas import rope as _rope
+from ....ops.pallas import swiglu as _swiglu_mod
+
+__all__ = [
+    "fused_rms_norm",
+    "fused_layer_norm",
+    "fused_rotary_position_embedding",
+    "swiglu",
+    "fused_linear",
+    "fused_bias_act",
+    "variable_length_memory_efficient_attention",
+    "fused_multi_head_attention",
+]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1,
+                   bias=None, residual=None, quant_scale=-1, **kw):
+    inputs = [x, norm_weight]
+    has_res = residual is not None
+    has_bias = bias is not None
+    if has_bias:
+        inputs.append(bias)
+    if has_res:
+        inputs.append(residual)
+
+    def fn(v, w, *rest):
+        i = 0
+        if has_bias:
+            v = v + rest[i]
+            i += 1
+        if has_res:
+            v = v + rest[i]
+        out = _rms.rms_norm(v, w, epsilon)
+        if norm_bias is not None:
+            out = out + _unwrap(norm_bias)
+        return (out, v) if has_res else out
+
+    return apply_op("rms_norm", fn, inputs)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, begin_norm_axis=1, bias=None, residual=None, **kw):
+    from ....nn import functional as F
+
+    if residual is not None:
+        x = x + residual
+    if bias is not None:
+        x = x + bias
+    d = x.shape[begin_norm_axis:] if begin_norm_axis >= 0 else x.shape[-1:]
+    out = F.layer_norm(x, d, norm_weight, norm_bias, epsilon)
+    return (out, x) if residual is not None else out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None, position_ids=None, use_neox_rotary_style=True):
+    inputs = [q]
+    mask = [True, k is not None, v is not None, sin is not None, cos is not None, position_ids is not None]
+    for t in (k, v, sin, cos, position_ids):
+        if t is not None:
+            inputs.append(t)
+
+    def fn(*vals):
+        it = iter(vals)
+        qv = next(it)
+        kv = next(it) if mask[1] else None
+        vv = next(it) if mask[2] else None
+        sn = next(it) if mask[3] else None
+        cs = next(it) if mask[4] else None
+        pid = next(it) if mask[5] else None
+        outs = _rope.fused_rotary_position_embedding(
+            qv, kv, vv, sin=sn, cos=cs, position_ids=pid, use_neox_rotary_style=use_neox_rotary_style
+        )
+        return tuple(o for o in outs if o is not None)
+
+    res = apply_op("fused_rope", fn, inputs)
+    res = res if isinstance(res, tuple) else (res,)
+    out = []
+    it = iter(res)
+    for present in mask[:3]:
+        out.append(next(it) if present else None)
+    return tuple(out)
+
+
+def swiglu(x, y=None, name=None):
+    if y is None:
+        def fn(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return _swiglu_mod.swiglu(a, b)
+
+        return apply_op("swiglu", fn, [x])
+    return apply_op("swiglu", _swiglu_mod.swiglu, [x, y])
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def fn(v, w, *rest):
+        w_ = w.T if transpose_weight else w
+        out = v @ w_
+        if rest:
+            out = out + rest[0]
+        return out
+
+    inputs = [x, weight] + ([bias] if bias is not None else [])
+    return apply_op("fused_linear", fn, inputs)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    import jax
+
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu, "swiglu": None}
+    if act_method == "swiglu":
+        if bias is not None:
+            x = x + bias
+        return swiglu(x)
+
+    def fn(v, *rest):
+        if rest:
+            v = v + rest[0]
+        return acts[act_method](v)
+
+    inputs = [x] + ([bias] if bias is not None else [])
+    return apply_op("fused_bias_act", fn, inputs)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens=None, kv_seq_lens=None, mask=None, scale=None, causal=False):
+    """Reference: python/paddle/incubate/nn/functional/variable_length_memory_efficient_attention.py.
+    Inputs are BHSD here (paddle's var-len op convention)."""
+    from ....nn import functional as F
+    from ....ops import manipulation as M
+
+    q = M.transpose(query, [0, 2, 1, 3])
+    k = M.transpose(key, [0, 2, 1, 3])
+    v = M.transpose(value, [0, 2, 1, 3])
+    out = F.scaled_dot_product_attention(q, k, v, attn_mask=mask, is_causal=causal)
+    return M.transpose(out, [0, 2, 1, 3])
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kwargs):
+    raise NotImplementedError(
+        "fused_multi_head_attention: use nn.MultiHeadAttention (flash-attention backed)"
+    )

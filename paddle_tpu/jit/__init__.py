@@ -1,0 +1,261 @@
+"""jit: dynamic-to-static bridge (reference: python/paddle/jit/ — @to_static via
+SOT bytecode tracing, jit/sot/translate.py:37).
+
+TPU-native design: Python tracing is native to JAX, so the reference's 18.6k-LoC
+bytecode simulator is unnecessary (SURVEY.md §7 mapping).  ``to_static`` wraps a
+function or Layer into a cached ``jax.jit`` executable whose implicit state
+(parameters/buffers) is passed as pytree arguments — so parameter updates are
+picked up without retracing, and the same wrapper serves inference and the
+jitted train step (paddle_tpu.jit.TrainStep)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, _unwrap, no_grad
+from ..nn.layer_base import Layer
+
+__all__ = [
+    "to_static",
+    "not_to_static",
+    "functional_state",
+    "functional_call",
+    "TrainStep",
+    "save",
+    "load",
+]
+
+
+def functional_state(layer: Layer):
+    """Extract (params, buffers) as flat name→array dicts (the pytree state)."""
+    params = {name: _unwrap(p) for name, p in layer.named_parameters()}
+    buffers = {name: _unwrap(b) for name, b in layer.named_buffers()}
+    return params, buffers
+
+
+class _SwapState:
+    """Temporarily substitute layer parameters/buffers with given arrays
+    (typically tracers) — the functional bridge for eager Layers."""
+
+    def __init__(self, layer: Layer, params: dict, buffers: dict):
+        self.layer = layer
+        self.params = params
+        self.buffers = buffers
+        self._saved = {}
+
+    def __enter__(self):
+        named_p = dict(self.layer.named_parameters())
+        named_b = dict(self.layer.named_buffers())
+        for name, val in self.params.items():
+            t = named_p[name]
+            self._saved[id(t)] = (t, t._value)
+            t._value = val
+        for name, val in self.buffers.items():
+            t = named_b[name]
+            if id(t) not in self._saved:
+                self._saved[id(t)] = (t, t._value)
+            t._value = val
+        return self
+
+    def __exit__(self, *exc):
+        for t, v in self._saved.values():
+            t._value = v
+        return False
+
+
+def functional_call(layer: Layer, params: dict, buffers: dict, *args, **kwargs):
+    """Run ``layer(*args)`` as a pure function of (params, buffers, args)."""
+    wrapped = jax.tree_util.tree_map(
+        lambda a: Tensor(a) if isinstance(a, (jax.Array, jnp.ndarray)) else a, args
+    )
+    with no_grad(), _SwapState(layer, params, buffers):
+        out = layer(*wrapped, **kwargs)
+    return jax.tree_util.tree_map(
+        lambda o: _unwrap(o) if isinstance(o, Tensor) else o, out,
+        is_leaf=lambda o: isinstance(o, Tensor),
+    )
+
+
+class StaticFunction:
+    """Result of @to_static: a compiled callable with paddle-like surface."""
+
+    def __init__(self, function: Callable, layer: Layer | None = None, input_spec=None, **jit_kwargs):
+        self._function = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._jit_kwargs = jit_kwargs
+        self._jitted = None
+        functools.update_wrapper(self, function)
+
+    def _build(self):
+        layer = self._layer
+
+        if layer is None:
+            fn = self._function
+
+            @jax.jit
+            def pure(arg_vals, kwarg_vals):
+                args = jax.tree_util.tree_map(
+                    lambda a: Tensor(a) if isinstance(a, (jax.Array, jnp.ndarray)) else a, arg_vals
+                )
+                kwargs = jax.tree_util.tree_map(
+                    lambda a: Tensor(a) if isinstance(a, (jax.Array, jnp.ndarray)) else a, kwarg_vals
+                )
+                with no_grad():
+                    out = fn(*args, **kwargs)
+                return jax.tree_util.tree_map(
+                    lambda o: _unwrap(o) if isinstance(o, Tensor) else o, out,
+                    is_leaf=lambda o: isinstance(o, Tensor),
+                )
+
+            self._jitted = pure
+        else:
+            fn = self._function
+
+            @jax.jit
+            def pure(params, buffers, arg_vals, kwarg_vals):
+                args = jax.tree_util.tree_map(
+                    lambda a: Tensor(a) if isinstance(a, (jax.Array, jnp.ndarray)) else a, arg_vals
+                )
+                kwargs = jax.tree_util.tree_map(
+                    lambda a: Tensor(a) if isinstance(a, (jax.Array, jnp.ndarray)) else a, kwarg_vals
+                )
+                with no_grad(), _SwapState(layer, params, buffers):
+                    out = fn(*args, **kwargs)
+                return jax.tree_util.tree_map(
+                    lambda o: _unwrap(o) if isinstance(o, Tensor) else o, out,
+                    is_leaf=lambda o: isinstance(o, Tensor),
+                )
+
+            self._jitted = pure
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted is None:
+            self._build()
+        arg_vals = jax.tree_util.tree_map(
+            lambda a: _unwrap(a) if isinstance(a, Tensor) else a, args,
+            is_leaf=lambda a: isinstance(a, Tensor),
+        )
+        kwarg_vals = jax.tree_util.tree_map(
+            lambda a: _unwrap(a) if isinstance(a, Tensor) else a, kwargs,
+            is_leaf=lambda a: isinstance(a, Tensor),
+        )
+        if self._layer is None:
+            out = self._jitted(arg_vals, kwarg_vals)
+        else:
+            params, buffers = functional_state(self._layer)
+            out = self._jitted(params, buffers, arg_vals, kwarg_vals)
+        return jax.tree_util.tree_map(
+            lambda o: Tensor(o) if isinstance(o, (jax.Array, jnp.ndarray)) else o, out
+        )
+
+    @property
+    def code(self):
+        return "<jax.jit compiled>"
+
+    def concrete_program(self):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, full_graph=True, **kwargs):
+    """``paddle.jit.to_static`` analog: decorate a function or Layer."""
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            sf = StaticFunction(obj.forward, layer=obj, input_spec=input_spec)
+            obj.forward = sf
+            return obj
+        # plain function or unbound method
+        return StaticFunction(obj, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class TrainStep:
+    """Fully-jitted train step: loss + grads + optimizer update in one XLA program
+    (the performance path; the eager tape is the debugging path).
+
+    Example::
+
+        step = TrainStep(model, loss_fn, opt)
+        for batch in loader:
+            loss = step(x, y)      # params updated in place (device-side)
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer, donate: bool = True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        params, buffers = functional_state(model)
+        self._params = params
+        self._buffers = buffers
+        self._opt_state = optimizer.init_state_pytree(params)
+        self._named = dict(model.named_parameters())
+
+        def compute_loss(params, buffers, args):
+            wrapped = [Tensor(a) if isinstance(a, (jax.Array, jnp.ndarray)) else a for a in args]
+            with no_grad(), _SwapState(model, params, buffers):
+                out = loss_fn(*wrapped)
+            loss = out[0] if isinstance(out, (tuple, list)) else out
+            return _unwrap(loss) if isinstance(loss, Tensor) else loss
+
+        opt = optimizer
+
+        @functools.partial(jax.jit, donate_argnums=(0, 2) if donate else ())
+        def step(params, buffers, opt_state, lr, args):
+            loss, grads = jax.value_and_grad(compute_loss)(params, buffers, args)
+            new_params, new_opt_state = opt.apply_gradients_pytree(params, grads, opt_state, lr)
+            return loss, new_params, new_opt_state
+
+        self._step = step
+
+    def __call__(self, *args):
+        arg_vals = [(_unwrap(a) if isinstance(a, Tensor) else a) for a in args]
+        lr = self.optimizer.get_lr()
+        loss, self._params, self._opt_state = self._step(
+            self._params, self._buffers, self._opt_state, lr, tuple(arg_vals)
+        )
+        return Tensor(loss)
+
+    def sync_to_model(self):
+        """Write the device-side params back into the eager model tensors."""
+        for name, val in self._params.items():
+            self._named[name]._value = val
+
+    @property
+    def params(self):
+        return self._params
+
+
+# ---- jit.save / jit.load (reference: paddle.jit.save TranslatedLayer) ----
+
+def save(layer, path, input_spec=None, **config):
+    """Serialize a Layer's state + class info (weights-level save; the compiled
+    executable is rebuilt by jit on load — XLA compile cache makes this cheap)."""
+    import pickle
+
+    state = {}
+    if isinstance(layer, Layer):
+        import numpy as np
+
+        state = {k: np.asarray(_unwrap(v)) for k, v in layer.state_dict().items()}
+    with open(path + ".pdparams", "wb") as f:
+        pickle.dump(state, f)
+
+
+def load(path, **config):
+    import pickle
+
+    with open(path + ".pdparams", "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,155 @@
+"""Metrics (reference: python/paddle/metric/ — Accuracy, Precision, Recall, Auc)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, _unwrap
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    import jax.numpy as jnp
+
+    logits = _unwrap(input)
+    lab = _unwrap(label)
+    if lab.ndim == logits.ndim:
+        lab = lab.squeeze(-1)
+    topk = jnp.argsort(-logits, axis=-1)[..., :k]
+    hit = jnp.any(topk == lab[..., None], axis=-1)
+    return Tensor(jnp.mean(hit.astype(jnp.float32)))
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = topk if isinstance(topk, (tuple, list)) else (topk,)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label):
+        p = np.asarray(_unwrap(pred))
+        l = np.asarray(_unwrap(label))
+        if l.ndim == p.ndim:
+            l = l.squeeze(-1)
+        maxk = max(self.topk)
+        top = np.argsort(-p, axis=-1)[..., :maxk]
+        correct = top == l[..., None]
+        return Tensor(np.asarray(correct, np.float32))
+
+    def update(self, correct):
+        c = np.asarray(_unwrap(correct)) if isinstance(correct, Tensor) else np.asarray(correct)
+        n = c.shape[0]
+        for i, k in enumerate(self.topk):
+            self.total[i] += float(c[..., :k].any(axis=-1).sum())
+            self.count[i] += n
+        return self.accumulate()
+
+    def accumulate(self):
+        res = [t / c if c else 0.0 for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (np.asarray(_unwrap(preds)) > 0.5).astype(np.int64).reshape(-1)
+        l = np.asarray(_unwrap(labels)).astype(np.int64).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (np.asarray(_unwrap(preds)) > 0.5).astype(np.int64).reshape(-1)
+        l = np.asarray(_unwrap(labels)).astype(np.int64).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self.num_thresholds = num_thresholds
+        self._name = name or "auc"
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(_unwrap(preds))
+        if p.ndim == 2:
+            p = p[:, -1]
+        l = np.asarray(_unwrap(labels)).reshape(-1)
+        idx = (p * self.num_thresholds).astype(np.int64).clip(0, self.num_thresholds)
+        for i, lab in zip(idx, l):
+            if lab:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # trapezoid over thresholds descending
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name

@@ -1,0 +1,5 @@
+"""Model zoo: the BASELINE.md config ladder lives here (LeNet/ResNet in
+paddle_tpu.vision.models; Llama + MoE families here)."""
+
+from . import llama  # noqa: F401
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401

@@ -1,0 +1,365 @@
+"""Llama family (BASELINE config #3; north-star Llama-3-8B pretrain).
+
+Reference recipe surface: PaddleNLP llm/ on top of the reference framework's
+fused ops (fused_rms_norm, fused_rotary_position_embedding, swiglu — see
+python/paddle/incubate/nn/functional/) and fleet hybrid parallelism.
+
+TPU-first design:
+- the eager Layer graph (LlamaForCausalLM) is the UX/debug surface;
+- the *training path* is :func:`build_train_step` — a pure pjit-compiled
+  function over a named mesh ("dp", "sharding"/zero, "mp"/tensor, "sep"/context)
+  where every weight carries a PartitionSpec (Megatron-style column/row splits
+  over "mp"), activations shard batch over "dp" and sequence over "sep", and
+  GSPMD inserts the all-reduces/all-gathers the reference does with NCCL.
+- attention = Pallas flash attention (ops/pallas/flash_attention.py);
+  rms_norm/rope/swiglu = fused kernels from ops/pallas.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.pallas import flash_attention as fa
+from ..ops.pallas import rms_norm as rms
+from ..ops.pallas import rope as rope_mod
+from ..ops.pallas import swiglu as swiglu_mod
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def llama3_8b():
+        return LlamaConfig(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+        )
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, kv_heads=2, inter=128, seq=128):
+        return LlamaConfig(
+            vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+            num_hidden_layers=layers, num_attention_heads=heads,
+            num_key_value_heads=kv_heads, max_position_embeddings=seq,
+        )
+
+
+# ---------------------------------------------------------------------------
+# pure functional core (the pjit training path)
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: LlamaConfig, key=None) -> dict:
+    """Parameter pytree.  Layer weights are stacked over a leading layer dim so
+    the transformer stack runs as one lax.scan (single compiled block, fast
+    compile, and the natural shape for pipeline stacking over 'pp')."""
+    key = key if key is not None else jax.random.key(0)
+    k = iter(jax.random.split(key, 16))
+    h, i, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    nh, nkv, hd, L = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim, cfg.num_hidden_layers
+    std = 0.02
+
+    def init(kk, shape):
+        return (jax.random.normal(kk, shape, jnp.float32) * std).astype(cfg.dtype)
+
+    params = {
+        "embed": init(next(k), (v, h)),
+        "final_norm": jnp.ones((h,), cfg.dtype),
+        "layers": {
+            "input_norm": jnp.ones((L, h), cfg.dtype),
+            "post_norm": jnp.ones((L, h), cfg.dtype),
+            "wq": init(next(k), (L, h, nh * hd)),
+            "wk": init(next(k), (L, h, nkv * hd)),
+            "wv": init(next(k), (L, h, nkv * hd)),
+            "wo": init(next(k), (L, nh * hd, h)),
+            "w_gate": init(next(k), (L, h, i)),
+            "w_up": init(next(k), (L, h, i)),
+            "w_down": init(next(k), (L, i, h)),
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = init(next(k), (h, v))
+    return params
+
+
+def param_specs(cfg: LlamaConfig) -> dict:
+    """PartitionSpecs = the Megatron TP sharding map of the reference's mp_layers
+    (ColumnParallelLinear splits output dim over 'mp', RowParallelLinear splits
+    input dim; VocabParallelEmbedding splits vocab), plus ZeRO over 'sharding'
+    on the other dim (fleet sharding stage 3 analog)."""
+    return {
+        "embed": P("mp", "sharding"),          # vocab-parallel embedding
+        "final_norm": P(None),
+        "layers": {
+            "input_norm": P(None, None),
+            "post_norm": P(None, None),
+            "wq": P(None, "sharding", "mp"),   # column parallel
+            "wk": P(None, "sharding", "mp"),
+            "wv": P(None, "sharding", "mp"),
+            "wo": P(None, "mp", "sharding"),   # row parallel
+            "w_gate": P(None, "sharding", "mp"),
+            "w_up": P(None, "sharding", "mp"),
+            "w_down": P(None, "mp", "sharding"),
+        },
+        "lm_head": P("sharding", "mp"),
+    }
+
+
+def _layer_forward(cfg: LlamaConfig, x, layer_params, cos, sin, use_flash=True):
+    """One transformer block; x: [b, s, h]."""
+    lp = layer_params
+    b, s, h = x.shape
+    nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+
+    # attention
+    xn = rms.rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    q = (xn @ lp["wq"]).reshape(b, s, nh, hd)
+    kk = (xn @ lp["wk"]).reshape(b, s, nkv, hd)
+    vv = (xn @ lp["wv"]).reshape(b, s, nkv, hd)
+    q, kk = rope_mod.apply_rotary_pos_emb(q, kk, cos, sin)
+    if use_flash:
+        attn = fa.flash_attention_bshd(q, kk, vv, causal=True)
+    else:
+        attn = fa._composed_attention(q, kk, vv, None, True, 1.0 / math.sqrt(hd))
+    attn = attn.reshape(b, s, nh * hd)
+    x = x + attn @ lp["wo"]
+
+    # mlp (swiglu)
+    xn = rms.rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+    gate = xn @ lp["w_gate"]
+    up = xn @ lp["w_up"]
+    x = x + swiglu_mod.swiglu(gate, up) @ lp["w_down"]
+    return x
+
+
+def forward(cfg: LlamaConfig, params, input_ids, use_flash=True, remat=True):
+    """Logits for [b, s] token ids.  The layer stack is a lax.scan over the
+    stacked layer weights with jax.checkpoint (activation recompute ≙ the
+    reference's recompute_sequential over transformer blocks)."""
+    x = jnp.take(params["embed"], input_ids, axis=0).astype(cfg.dtype)
+    b, s, h = x.shape
+    cos, sin = rope_mod.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_theta, dtype=cfg.dtype)
+
+    def body(carry, lp):
+        out = _layer_forward(cfg, carry, lp, cos, sin, use_flash)
+        return out, None
+
+    scan_body = jax.checkpoint(body) if remat else body
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T.astype(cfg.dtype)
+    return x @ head
+
+
+def loss_fn(cfg: LlamaConfig, params, input_ids, labels):
+    logits = forward(cfg, params, input_ids).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def make_mesh(dp=1, mp=1, sharding=1, sep=1, pp=1, devices=None):
+    """Build the hybrid mesh with the reference's canonical axis set."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = dp * mp * sharding * sep * pp
+    assert devices.size >= n, f"need {n} devices, have {devices.size}"
+    arr = devices[:n].reshape(dp, pp, sharding, sep, mp)
+    return Mesh(arr, axis_names=("dp", "pp", "sharding", "sep", "mp"))
+
+
+def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
+                     beta1=0.9, beta2=0.95, grad_clip=1.0):
+    """The pjit-compiled train step: forward+backward+AdamW, all sharded.
+
+    Data: [b, s] sharded ('dp'+'sharding' on batch, 'sep' on sequence).
+    GSPMD propagates the Megatron weight specs through the scan; gradient psum
+    over 'dp' and optimizer-state sharding over 'sharding' (ZeRO-1/2) come out
+    of the same spec algebra — no per-op SPMD rules needed (SURVEY.md §3.4)."""
+    specs = param_specs(cfg)
+    data_spec = P(("dp", "sharding"), "sep")
+
+    def to_named(tree_specs):
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), tree_specs,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+
+    param_shardings = to_named(specs)
+
+    def opt_init(params):
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "m": jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            "v": jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            # master fp32 weights (multi_precision AdamW semantics)
+            "master": jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params),
+        }
+
+    def train_step(params, opt_state, input_ids, labels):
+        loss, grads = jax.value_and_grad(lambda p: loss_fn(cfg, p, input_ids, labels))(params)
+        g32 = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        # global-norm clip (HybridParallelClipGrad semantics; psum over all axes
+        # is implicit — the sharded sum-of-squares reduces globally under GSPMD)
+        leaves = jax.tree_util.tree_leaves(g32)
+        gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+        scale_f = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-6))
+        step = opt_state["step"] + 1
+        b1c = 1 - beta1**step.astype(jnp.float32)
+        b2c = 1 - beta2**step.astype(jnp.float32)
+
+        def upd(g, m, v, master):
+            g = g * scale_f
+            m2 = beta1 * m + (1 - beta1) * g
+            v2 = beta2 * v + (1 - beta2) * g * g
+            update = (m2 / b1c) / (jnp.sqrt(v2 / b2c) + 1e-8)
+            master2 = master * (1 - lr * weight_decay) - lr * update
+            return m2, v2, master2
+
+        flat_g, treedef = jax.tree_util.tree_flatten(g32)
+        flat_m = treedef.flatten_up_to(opt_state["m"])
+        flat_v = treedef.flatten_up_to(opt_state["v"])
+        flat_w = treedef.flatten_up_to(opt_state["master"])
+        new_m, new_v, new_w = [], [], []
+        for g, m, v, w in zip(flat_g, flat_m, flat_v, flat_w):
+            m2, v2, w2 = upd(g, m, v, w)
+            new_m.append(m2)
+            new_v.append(v2)
+            new_w.append(w2)
+        unf = lambda leaves_: jax.tree_util.tree_unflatten(treedef, leaves_)
+        new_params = jax.tree_util.tree_map(
+            lambda w, p: w.astype(p.dtype), unf(new_w), params
+        )
+        new_opt = {"step": step, "m": unf(new_m), "v": unf(new_v), "master": unf(new_w)}
+        return loss, new_params, new_opt
+
+    opt_shardings = {
+        "step": NamedSharding(mesh, P()),
+        "m": param_shardings,
+        "v": param_shardings,
+        "master": param_shardings,
+    }
+    data_sharding = NamedSharding(mesh, data_spec)
+    jitted = jax.jit(
+        train_step,
+        in_shardings=(param_shardings, opt_shardings, data_sharding, data_sharding),
+        out_shardings=(NamedSharding(mesh, P()), param_shardings, opt_shardings),
+        donate_argnums=(0, 1),
+    )
+    return jitted, opt_init, param_shardings, data_sharding
+
+
+def flops_per_token(cfg: LlamaConfig) -> float:
+    """Training FLOPs/token ≈ 6 * active params + attention quadratic term."""
+    h, i, v, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_hidden_layers
+    nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    per_layer = h * (nh * hd) + 2 * h * (nkv * hd) + (nh * hd) * h + 3 * h * i
+    dense = L * per_layer + v * h  # + embed (lookup free)
+    return 6.0 * dense
+
+
+def attn_flops_per_token(cfg: LlamaConfig, seq: int) -> float:
+    # 2 matmuls of [s, hd] x [hd, s] per head, fwd+bwd(2x) => 6 * 2 * s * hd * nh
+    return 6.0 * 2.0 * seq * cfg.head_dim * cfg.num_attention_heads * cfg.num_hidden_layers
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# eager Layer surface (paddle-style UX over the same functional core)
+# ---------------------------------------------------------------------------
+
+from ..core.tensor import Parameter, Tensor, apply_op, _unwrap  # noqa: E402
+from ..nn.layer_base import Layer  # noqa: E402
+
+
+class LlamaModel(Layer):
+    """Eager wrapper: parameters are paddle Tensors; forward dispatches the
+    functional core through the tape (so .backward()/optimizers work), and the
+    same weights feed build_train_step for the pjit path."""
+
+    def __init__(self, config: LlamaConfig, seed: int = 0):
+        super().__init__()
+        self.config = config
+        raw = init_params(config, jax.random.key(seed))
+        self._tree_names = []
+        flat, self._treedef = jax.tree_util.tree_flatten_with_path(raw)
+        for path, val in flat:
+            name = "_".join(str(getattr(p, "key", p)) for p in path)
+            self.add_parameter(name, Parameter(val))
+            self._tree_names.append(name)
+
+    def _params_tree(self, vals=None):
+        leaves = [
+            self._parameters[n]._value if vals is None else vals[i]
+            for i, n in enumerate(self._tree_names)
+        ]
+        import jax.tree_util as jtu
+
+        return jtu.tree_unflatten(jtu.tree_structure(init_spec_like(self.config)), leaves)
+
+    def forward(self, input_ids):
+        cfg = self.config
+        tensors = [self._parameters[n] for n in self._tree_names]
+
+        def fn(ids, *leaf_vals):
+            params = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(init_spec_like(cfg)), list(leaf_vals)
+            )
+            return forward(cfg, params, ids, remat=False)
+
+        return apply_op("llama_forward", fn, [input_ids] + tensors)
+
+
+def init_spec_like(cfg: LlamaConfig):
+    """Abstract pytree with the same structure as init_params (no allocation)."""
+    h, i, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    s = {
+        "embed": 0,
+        "final_norm": 0,
+        "layers": {
+            "input_norm": 0, "post_norm": 0, "wq": 0, "wk": 0, "wv": 0,
+            "wo": 0, "w_gate": 0, "w_up": 0, "w_down": 0,
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        s["lm_head"] = 0
+    return s
+
+
+class LlamaForCausalLM(LlamaModel):
+    def forward(self, input_ids, labels=None):
+        logits = super().forward(input_ids)
+        if labels is None:
+            return logits
+        from ..nn import functional as F
+        from ..ops.manipulation import reshape
+
+        b, s, v = logits.shape
+        loss = F.cross_entropy(reshape(logits, (b * s, v)), reshape(labels, (b * s,)))
+        return logits, loss

@@ -1,0 +1,99 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py —
+ClipGradByGlobalNorm used by every LLM recipe; the distributed-aware variant
+lives in paddle_tpu.distributed.fleet.HybridParallelClipGrad)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, _unwrap
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm", "clip_grad_norm_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """params_grads: list of (param, grad Tensor) pairs → same with clipped grads."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor(jnp.clip(_unwrap(g), self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            gv = _unwrap(g)
+            n = jnp.sqrt(jnp.sum(gv.astype(jnp.float32) ** 2))
+            factor = jnp.where(n > self.clip_norm, self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((p, Tensor((gv * factor).astype(gv.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        self.auto_skip_clip = auto_skip_clip
+
+    def _global_norm_sq(self, params_grads):
+        sq = 0.0
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                continue
+            gv = _unwrap(g)
+            sq = sq + jnp.sum(gv.astype(jnp.float32) ** 2)
+        return sq
+
+    def __call__(self, params_grads):
+        sq = self._global_norm_sq(params_grads)
+        if isinstance(sq, float):  # no clippable grads
+            return params_grads
+        gn = jnp.sqrt(sq)
+        factor = jnp.where(gn > self.clip_norm, self.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+            else:
+                gv = _unwrap(g)
+                out.append((p, Tensor((gv * factor.astype(jnp.float32)).astype(gv.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """torch-style utility paddle also exposes (paddle.nn.utils.clip_grad_norm_)."""
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    grads = [p._grad for p in params if p._grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack([jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type) for g in grads])) ** (
+            1.0 / norm_type
+        )
+    factor = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        if p._grad is not None:
+            p._grad = (p._grad * factor).astype(p._grad.dtype)
+    return Tensor(total)

@@ -1,0 +1,1086 @@
+"""Functional neural-net ops (reference: python/paddle/nn/functional/ surface;
+kernels: phi conv/pool/norm/softmax/activation families → XLA; fused LLM ops
+live in paddle_tpu.incubate.nn.functional backed by Pallas)."""
+
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtype as dtypes
+from ...core import rng
+from ...core.tensor import Tensor, apply_op, _unwrap
+from ...ops.manipulation import pad  # noqa: F401  (exported as F.pad)
+from ...ops.registry import register_op
+
+__all__: list[str] = []
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+# ============================ activations ============================
+
+def _act(name, jfn):
+    def op(x, name=None):
+        return apply_op(name or op.__name__, jfn, [x])
+
+    op.__name__ = name
+    globals()[name] = op
+    __all__.append(name)
+    return op
+
+
+_act("relu", jax.nn.relu)
+_act("relu6", lambda v: jnp.clip(v, 0, 6))
+_act("sigmoid", jax.nn.sigmoid)
+_act("tanh", jnp.tanh)
+_act("silu", jax.nn.silu)
+_act("swish", jax.nn.silu)
+_act("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)))
+_act("softsign", jax.nn.soft_sign)
+_act("tanhshrink", lambda v: v - jnp.tanh(v))
+_act("log_sigmoid", jax.nn.log_sigmoid)
+_act("hardswish", lambda v: v * jnp.clip(v + 3, 0, 6) / 6)
+_act("hardsigmoid", lambda v: jnp.clip(v / 6 + 0.5, 0, 1))
+
+
+@_export
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu", lambda v: jax.nn.gelu(v, approximate=approximate), [x])
+
+
+@_export
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu", lambda v: jax.nn.leaky_relu(v, negative_slope), [x])
+
+
+@_export
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda v: jax.nn.elu(v, alpha), [x])
+
+
+@_export
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda v: jax.nn.celu(v, alpha), [x])
+
+
+@_export
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return apply_op(
+        "selu", lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), [x]
+    )
+
+
+@_export
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+
+    return apply_op("prelu", fn, [x, weight])
+
+
+@_export
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        "softplus",
+        lambda v: jnp.where(v * beta > threshold, v, jax.nn.softplus(v * beta) / beta),
+        [x],
+    )
+
+
+@_export
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "softshrink",
+        lambda v: jnp.where(v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, 0.0)),
+        [x],
+    )
+
+
+@_export
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "hardshrink", lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), [x]
+    )
+
+
+@_export
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op("hardtanh", lambda v: jnp.clip(v, min, max), [x])
+
+
+@_export
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op(
+        "thresholded_relu", lambda v: jnp.where(v > threshold, v, value), [x]
+    )
+
+
+@_export
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            v = v.astype(dtypes.convert_dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+
+    return apply_op("softmax", fn, [x])
+
+
+@_export
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            v = v.astype(dtypes.convert_dtype(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return apply_op("log_softmax", fn, [x])
+
+
+@_export
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    key = rng.next_key()
+
+    def fn(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard + jax.lax.stop_gradient(y) - y + (y - jax.lax.stop_gradient(y))
+            # straight-through: hard value, soft gradient
+            y = y_hard - jax.lax.stop_gradient(y) + y if False else y_hard + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply_op("gumbel_softmax", fn, [x])
+
+
+@_export
+def glu(x, axis=-1, name=None):
+    return apply_op("glu", lambda v: jax.nn.glu(v, axis=axis), [x])
+
+
+@_export
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        shape = list(v.shape)
+        c = shape[axis]
+        shape[axis : axis + 1] = [c // groups, groups]
+        return jnp.max(v.reshape(shape), axis=axis + 1)
+
+    return apply_op("maxout", fn, [x])
+
+
+@_export
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        n = jnp.linalg.norm(v, ord=p, axis=axis, keepdims=True)
+        return v / jnp.maximum(n, epsilon)
+
+    return apply_op("normalize", fn, [x])
+
+
+@_export
+def temperature_scaled_softmax(x, temperature=1.0, axis=-1):
+    return softmax(x / temperature if temperature != 1.0 else x, axis=axis)
+
+
+# ============================ linear / embedding ============================
+
+@_export
+def linear(x, weight, bias=None, name=None):
+    if bias is None:
+        return apply_op("linear", lambda v, w: v @ w, [x, weight])
+    return apply_op("linear", lambda v, w, b: v @ w + b, [x, weight, bias])
+
+
+@_export
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def fn(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply_op("embedding", fn, [x, weight])
+
+
+@_export
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+@_export
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l):
+        k = l.shape[-1]
+        u = 1.0 / k if prior_dist is None else _unwrap(prior_dist)
+        return (1 - epsilon) * l + epsilon * u
+
+    return apply_op("label_smooth", fn, [label])
+
+
+@_export
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    inputs = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return apply_op("bilinear", fn, inputs)
+
+
+# ============================ dropout ============================
+
+@_export
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(_unwrap(x))
+    key = rng.next_key()
+
+    def fn(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in [a % v.ndim for a in axes] else 1 for i, s in enumerate(v.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return apply_op("dropout", fn, [x])
+
+
+@_export
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ch_axis = 1 if data_format == "NCHW" else 3
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+@_export
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ch_axis = 1 if data_format == "NCDHW" else 4
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+@_export
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = rng.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / _math.sqrt((1 - p) * (1 + p * alpha_p**2))) if p < 1 else 1.0
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return apply_op("alpha_dropout", fn, [x])
+
+
+# ============================ convolution ============================
+
+def _conv_nd(v, w, stride, padding, dilation, groups, data_format, ndim):
+    if data_format[-1] == "C":  # NHWC-style
+        lhs_spec = "N" + "DHW"[3 - ndim :] + "C" if ndim == 3 else ("NHWC" if ndim == 2 else "NWC")
+    else:
+        lhs_spec = "NC" + "DHW"[3 - ndim :] if ndim == 3 else ("NCHW" if ndim == 2 else "NCW")
+    rhs_spec = "OI" + "DHW"[3 - ndim :] if ndim == 3 else ("OIHW" if ndim == 2 else "OIW")
+    out_spec = lhs_spec
+    if isinstance(padding, str):
+        pad_cfg = padding.upper()
+    else:
+        p = _pair(padding, ndim)
+        if len(p) == ndim:
+            pad_cfg = [(pi, pi) for pi in p]
+        else:  # explicit lo/hi pairs
+            pad_cfg = [(p[2 * i], p[2 * i + 1]) for i in range(ndim)]
+    return jax.lax.conv_general_dilated(
+        v,
+        w,
+        window_strides=_pair(stride, ndim),
+        padding=pad_cfg,
+        rhs_dilation=_pair(dilation, ndim),
+        dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+        feature_group_count=groups,
+    )
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, ndim, name):
+    def fn(v, w, *rest):
+        out = _conv_nd(v, w, stride, padding, dilation, groups, data_format, ndim)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if data_format[1] == "C" else out.ndim - 1] = b.size
+            out = out + b.reshape(shape)
+        return out
+
+    inputs = [x, weight] + ([bias] if bias is not None else [])
+    return apply_op(name, fn, inputs)
+
+
+@_export
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, "NCW" if data_format == "NCL" else "NWC", 1, "conv1d")
+
+
+@_export
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2, "conv2d")
+
+
+@_export
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, ndim, name):
+    def fn(v, w, *rest):
+        strides = _pair(stride, ndim)
+        pads = _pair(padding, ndim)
+        dil = _pair(dilation, ndim)
+        opad = _pair(output_padding, ndim)
+        # weight layout paddle: [in, out//groups, *k]; grad-style transposed conv
+        k = w.shape[2:]
+        pad_cfg = [
+            (dil[i] * (k[i] - 1) - pads[i], dil[i] * (k[i] - 1) - pads[i] + opad[i])
+            for i in range(ndim)
+        ]
+        if data_format[-1] == "C":
+            lhs_spec = {1: "NWC", 2: "NHWC", 3: "NDHWC"}[ndim]
+        else:
+            lhs_spec = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+        rhs_spec = {1: "IOW", 2: "IOHW", 3: "IODHW"}[ndim]
+        if groups > 1:
+            w_ = w.reshape((groups, w.shape[0] // groups) + w.shape[1:])
+            outs = []
+            ch_ax = 1 if data_format[1] == "C" else v.ndim - 1
+            vs = jnp.split(v, groups, axis=ch_ax)
+            for g in range(groups):
+                outs.append(
+                    jax.lax.conv_general_dilated(
+                        vs[g], jnp.flip(w_[g], axis=tuple(range(2, 2 + ndim))).swapaxes(0, 1) if False else w_[g],
+                        window_strides=(1,) * ndim,
+                        padding=pad_cfg,
+                        lhs_dilation=strides,
+                        rhs_dilation=dil,
+                        dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
+                    )
+                )
+            out = jnp.concatenate(outs, axis=ch_ax)
+        else:
+            out = jax.lax.conv_general_dilated(
+                v,
+                w,
+                window_strides=(1,) * ndim,
+                padding=pad_cfg,
+                lhs_dilation=strides,
+                rhs_dilation=dil,
+                dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
+            )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if data_format[1] == "C" else out.ndim - 1] = b.size
+            out = out + b.reshape(shape)
+        return out
+
+    inputs = [x, weight] + ([bias] if bias is not None else [])
+    return apply_op(name, fn, inputs)
+
+
+@_export
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, "NCW", 1, "conv1d_transpose")
+
+
+@_export
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, 2, "conv2d_transpose")
+
+
+@_export
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, 3, "conv3d_transpose")
+
+
+# ============================ pooling ============================
+
+def _pool(x, ksize, stride, padding, ndim, data_format, reducer, init, name, count_include_pad=True, ceil_mode=False):
+    ks = _pair(ksize, ndim)
+    st = _pair(stride if stride is not None else ksize, ndim)
+    pd = _pair(padding, ndim)
+
+    def fn(v):
+        if data_format[1] == "C":
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+        else:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
+        if reducer == "max":
+            neg = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(v, neg, jax.lax.max, window, strides, pads)
+        s = jax.lax.reduce_window(v.astype(jnp.float32), 0.0, jax.lax.add, window, strides, pads)
+        if count_include_pad:
+            denom = float(np.prod(ks))
+            return (s / denom).astype(v.dtype)
+        ones = jnp.ones_like(v, jnp.float32)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        return (s / cnt).astype(v.dtype)
+
+    return apply_op(name, fn, [x])
+
+
+@_export
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "NCW", "max", None, "max_pool1d")
+
+
+@_export
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "max", None, "max_pool2d")
+
+
+@_export
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "max", None, "max_pool3d")
+
+
+@_export
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "NCW", "avg", None, "avg_pool1d", count_include_pad=not exclusive)
+
+
+@_export
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", None, "avg_pool2d", count_include_pad=not exclusive)
+
+
+@_export
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", None, "avg_pool3d", count_include_pad=not exclusive)
+
+
+def _adaptive_pool(x, output_size, ndim, data_format, mode, name):
+    out_sz = _pair(output_size, ndim)
+
+    def fn(v):
+        spatial_start = 2 if data_format[1] == "C" else 1
+        out = v
+        for i in range(ndim):
+            ax = spatial_start + i
+            in_s, out_s = out.shape[ax], out_sz[i]
+            if out_s == in_s:
+                continue
+            if in_s % out_s == 0:
+                k = in_s // out_s
+                new_shape = out.shape[:ax] + (out_s, k) + out.shape[ax + 1 :]
+                r = out.reshape(new_shape)
+                out = jnp.max(r, axis=ax + 1) if mode == "max" else jnp.mean(r, axis=ax + 1)
+            else:
+                # generic adaptive: gather variable windows
+                starts = (np.arange(out_s) * in_s) // out_s
+                ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+                pieces = []
+                for s, e in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                    red = jnp.max(seg, axis=ax, keepdims=True) if mode == "max" else jnp.mean(seg, axis=ax, keepdims=True)
+                    pieces.append(red)
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+
+    return apply_op(name, fn, [x])
+
+
+@_export
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "avg", "adaptive_avg_pool1d")
+
+
+@_export
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg", "adaptive_avg_pool2d")
+
+
+@_export
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg", "adaptive_avg_pool3d")
+
+
+@_export
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "max", "adaptive_max_pool1d")
+
+
+@_export
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", "max", "adaptive_max_pool2d")
+
+
+@_export
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", "max", "adaptive_max_pool3d")
+
+
+# ============================ normalization ============================
+
+@_export
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+
+    def fn(v, *rest):
+        axes = tuple(range(v.ndim - n_axes, v.ndim))
+        mean = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((v.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * rest[i]
+            i += 1
+        if bias is not None:
+            out = out + rest[i]
+        return out
+
+    inputs = [x] + [t for t in (weight, bias) if t is not None]
+    return apply_op("layer_norm", fn, inputs)
+
+
+@_export
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-05,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    ch_axis = 1 if data_format[1] == "C" else _unwrap(x).ndim - 1
+
+    if training and not use_global_stats:
+        # compute batch stats and update running stats in-place (eager semantics)
+        def fn(v, *rest):
+            axes = tuple(i for i in range(v.ndim) if i != ch_axis)
+            m = jnp.mean(v.astype(jnp.float32), axis=axes)
+            var = jnp.var(v.astype(jnp.float32), axis=axes)
+            shape = [1] * v.ndim
+            shape[ch_axis] = v.shape[ch_axis]
+            out = (v.astype(jnp.float32) - m.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+            out = out.astype(v.dtype)
+            i = 0
+            if weight is not None:
+                out = out * rest[i].reshape(shape)
+                i += 1
+            if bias is not None:
+                out = out + rest[i].reshape(shape)
+            return out
+
+        v = _unwrap(x)
+        axes = tuple(i for i in range(v.ndim) if i != ch_axis)
+        batch_mean = jnp.mean(v.astype(jnp.float32), axis=axes)
+        batch_var = jnp.var(v.astype(jnp.float32), axis=axes)
+        if running_mean is not None and not isinstance(batch_mean, jax.core.Tracer):
+            rm, rv = _unwrap(running_mean), _unwrap(running_var)
+            running_mean._value = (momentum * rm + (1 - momentum) * batch_mean).astype(rm.dtype)
+            running_var._value = (momentum * rv + (1 - momentum) * batch_var).astype(rv.dtype)
+        inputs = [x] + [t for t in (weight, bias) if t is not None]
+        return apply_op("batch_norm", fn, inputs)
+
+    def fn(v, m, var, *rest):
+        shape = [1] * v.ndim
+        shape[ch_axis] = v.shape[ch_axis]
+        out = (v - m.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape).astype(v.dtype) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        return out
+
+    inputs = [x, running_mean, running_var] + [t for t in (weight, bias) if t is not None]
+    return apply_op("batch_norm", fn, inputs)
+
+
+@_export
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    ch_axis = 1 if data_format[1] == "C" else _unwrap(x).ndim - 1
+
+    def fn(v, *rest):
+        axes = tuple(i for i in range(2, v.ndim)) if ch_axis == 1 else tuple(range(1, v.ndim - 1))
+        m = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((v.astype(jnp.float32) - m) * jax.lax.rsqrt(var + eps)).astype(v.dtype)
+        shape = [1] * v.ndim
+        shape[ch_axis] = v.shape[ch_axis]
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        return out
+
+    inputs = [x] + [t for t in (weight, bias) if t is not None]
+    return apply_op("instance_norm", fn, inputs)
+
+
+@_export
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+    def fn(v, *rest):
+        if data_format[1] != "C":
+            v_ = jnp.moveaxis(v, -1, 1)
+        else:
+            v_ = v
+        n, c = v_.shape[:2]
+        g = num_groups
+        r = v_.reshape((n, g, c // g) + v_.shape[2:])
+        axes = tuple(range(2, r.ndim))
+        m = jnp.mean(r.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(r.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((r.astype(jnp.float32) - m) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        out = out.reshape(v_.shape)
+        shape = [1, c] + [1] * (v_.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        if data_format[1] != "C":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    inputs = [x] + [t for t in (weight, bias) if t is not None]
+    return apply_op("group_norm", fn, inputs)
+
+
+@_export
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def fn(v):
+        ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+        sq = jnp.square(v)
+        half = size // 2
+        mv = jnp.moveaxis(sq, ch_axis, -1)
+        padded = jnp.pad(mv, [(0, 0)] * (mv.ndim - 1) + [(half, size - half - 1)])
+        win = sum(
+            jax.lax.slice_in_dim(padded, i, i + mv.shape[-1], axis=mv.ndim - 1)
+            for i in range(size)
+        )
+        div = (k + alpha * win / size) ** beta
+        return v / jnp.moveaxis(div, -1, ch_axis)
+
+    return apply_op("local_response_norm", fn, [x])
+
+
+# ============================ losses ============================
+
+@_export
+def mse_loss(input, label, reduction="mean", name=None):
+    def fn(a, b):
+        d = (a - b) ** 2
+        return _reduce_loss(d, reduction)
+
+    return apply_op("mse_loss", fn, [input, label])
+
+
+def _reduce_loss(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+@_export
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op("l1_loss", lambda a, b: _reduce_loss(jnp.abs(a - b), reduction), [input, label])
+
+
+@_export
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta if False else jnp.where(
+            d < delta, 0.5 * d * d, delta * (d - 0.5 * delta)
+        )
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("smooth_l1_loss", fn, [input, label])
+
+
+@_export
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    inputs = [input, label] + ([weight] if weight is not None else [])
+
+    def fn(logits, lab, *rest):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape and jnp.issubdtype(lab.dtype, jnp.floating)):
+            sl = lab
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                sl = sl * (1 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(sl * logp, axis=axis)
+            valid = None
+        else:
+            lab_ = lab.squeeze(axis) if (lab.ndim == logits.ndim and lab.shape[axis] == 1) else lab
+            k = logits.shape[axis]
+            valid = lab_ != ignore_index
+            safe = jnp.where(valid, lab_, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis).astype(jnp.int32), axis=axis
+            ).squeeze(axis)
+            if label_smoothing > 0:
+                smooth = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+            loss = jnp.where(valid, -picked, 0.0)
+            if rest:  # class weights
+                w = rest[0]
+                wsel = jnp.where(valid, jnp.take(w, safe), 0.0)
+                loss = loss * wsel
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wsel), 1e-12)
+        if reduction == "mean":
+            if valid is not None:
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.mean(loss)
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("cross_entropy", fn, inputs)
+
+
+@_export
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+@_export
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    inputs = [input, label] + ([weight] if weight is not None else [])
+
+    def fn(logp, lab, *rest):
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(logp, safe[:, None].astype(jnp.int32), axis=1).squeeze(1)
+        loss = jnp.where(valid, -picked, 0.0)
+        if rest:
+            w = jnp.take(rest[0], safe)
+            loss = loss * jnp.where(valid, w, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("nll_loss", fn, inputs)
+
+
+@_export
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    inputs = [input, label] + ([weight] if weight is not None else [])
+
+    def fn(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("binary_cross_entropy", fn, inputs)
+
+
+@_export
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    inputs = [logit, label] + [t for t in (weight, pos_weight) if t is not None]
+
+    def fn(z, y, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        # stable bce-with-logits
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            loss = -(y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("bce_with_logits", fn, inputs)
+
+
+@_export
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(logp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            loss = jnp.where(y > 0, y * (jnp.log(jnp.maximum(y, 1e-30)) - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("kl_div", fn, [input, label])
+
+
+@_export
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return apply_op("cosine_similarity", fn, [x1, x2])
+
+
+@_export
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply_op(
+        "margin_ranking_loss",
+        lambda a, b, y: _reduce_loss(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        [input, other, label],
+    )
+
+
+@_export
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply_op(
+        "hinge_embedding_loss",
+        lambda a, y: _reduce_loss(jnp.where(y == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+        [input, label],
+    )
+
+
+@_export
+def square_error_cost(input, label, name=None):
+    return apply_op("square_error_cost", lambda a, b: (a - b) ** 2, [input, label])
+
+
+@_export
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    inputs = [logit, label] + ([normalizer] if normalizer is not None else [])
+
+    def fn(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("sigmoid_focal_loss", fn, inputs)
+
+
+# ============================ vision helpers ============================
+
+@_export
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    def fn(v):
+        chlast = data_format[-1] == "C"
+        v_ = v if chlast else jnp.moveaxis(v, 1, -1)
+        spatial = v_.shape[1:-1]
+        if size is not None:
+            out_sz = _pair(size, len(spatial))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+            out_sz = tuple(int(s * f) for s, f in zip(spatial, sf))
+        method = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear", "bicubic": "cubic", "linear": "linear", "area": "linear"}[mode]
+        out = jax.image.resize(v_, (v_.shape[0],) + out_sz + (v_.shape[-1],), method=method)
+        return out if chlast else jnp.moveaxis(out, -1, 1)
+
+    return apply_op("interpolate", fn, [x])
+
+
+@_export
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+@_export
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            out = v.reshape(n, c // (r * r), r, r, h, w)
+            out = out.transpose(0, 1, 4, 2, 5, 3)
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        out = v.reshape(n, h, w, r, r, c // (r * r))
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply_op("pixel_shuffle", fn, [x])
+
+
+@_export
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(v):
+        n, c, h, w = v.shape
+        out = v.reshape(n, c, h // r, r, w // r, r)
+        out = out.transpose(0, 1, 3, 5, 2, 4)
+        return out.reshape(n, c * r * r, h // r, w // r)
+
+    return apply_op("pixel_unshuffle", fn, [x])
+
+
+@_export
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    def fn(v, g):
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            ix = (gx + 1) * 0.5 * (w - 1)
+            iy = (gy + 1) * 0.5 * (h - 1)
+        else:
+            ix = ((gx + 1) * w - 1) * 0.5
+            iy = ((gy + 1) * h - 1) * 0.5
+        ix0 = jnp.floor(ix).astype(jnp.int32)
+        iy0 = jnp.floor(iy).astype(jnp.int32)
+        ix1, iy1 = ix0 + 1, iy0 + 1
+        wx1 = ix - ix0
+        wy1 = iy - iy0
+        wx0, wy0 = 1 - wx1, 1 - wy1
+
+        def sample(iy_, ix_):
+            mask = (ix_ >= 0) & (ix_ < w) & (iy_ >= 0) & (iy_ < h)
+            ixc = jnp.clip(ix_, 0, w - 1)
+            iyc = jnp.clip(iy_, 0, h - 1)
+            out = v[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [n, gh, gw, c]
+            return jnp.where(mask[..., None], out, 0.0)
+
+        out = (
+            sample(iy0, ix0) * (wy0 * wx0)[..., None]
+            + sample(iy0, ix1) * (wy0 * wx1)[..., None]
+            + sample(iy1, ix0) * (wy1 * wx0)[..., None]
+            + sample(iy1, ix1) * (wy1 * wx1)[..., None]
+        )
+        return jnp.moveaxis(out, -1, 1)
+
+    return apply_op("grid_sample", fn, [x, grid])
+
+
+# ============================ attention ============================
+
+@_export
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+):
+    """Inputs [batch, seq, heads, head_dim] (paddle convention).  Dispatches to the
+    Pallas flash-attention kernel on TPU when enabled, else XLA-composed attention."""
+    from ...core.flags import flag
+
+    if flag("FLAGS_use_pallas_kernels"):
+        try:
+            from ...ops.pallas import flash_attention as fa
+
+            inputs = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+
+            def fn(q, k, v, *rest):
+                return fa.flash_attention_bshd(q, k, v, rest[0] if rest else None, is_causal)
+
+            return apply_op("flash_attention", fn, inputs)
+        except Exception:
+            pass
+
+    inputs = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+
+    def fn(q, k, v, *rest):
+        qh = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        scale = 1.0 / _math.sqrt(qh.shape[-1])
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        if is_causal:
+            s_q, s_k = logits.shape[-2], logits.shape[-1]
+            causal = jnp.tril(jnp.ones((s_q, s_k), bool))
+            logits = jnp.where(causal, logits, -jnp.inf)
+        if rest:
+            m = rest[0]
+            if m.dtype == jnp.bool_:
+                logits = jnp.where(m, logits, -jnp.inf)
+            else:
+                logits = logits + m
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+        return jnp.swapaxes(out, 1, 2)
+
+    return apply_op("sdpa", fn, inputs)
+
+
+# sequence mask utility
+@_export
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    v = _unwrap(lengths)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(v))
+    mask = jnp.arange(m)[None, :] < v[..., None]
+    return Tensor(mask.astype(dtypes.convert_dtype(dtype)))

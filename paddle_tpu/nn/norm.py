@@ -1,0 +1,232 @@
+"""Normalization layers (reference: python/paddle/nn/layer/norm.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import functional as F
+from . import initializer as I
+from .layer_base import Layer
+
+__all__ = [
+    "BatchNorm",
+    "BatchNorm1D",
+    "BatchNorm2D",
+    "BatchNorm3D",
+    "SyncBatchNorm",
+    "LayerNorm",
+    "GroupNorm",
+    "InstanceNorm1D",
+    "InstanceNorm2D",
+    "InstanceNorm3D",
+    "RMSNorm",
+    "LocalResponseNorm",
+    "SpectralNorm",
+]
+
+
+class _BatchNormBase(Layer):
+    def __init__(
+        self,
+        num_features,
+        momentum=0.9,
+        epsilon=1e-05,
+        weight_attr=None,
+        bias_attr=None,
+        data_format="NCHW",
+        use_global_stats=None,
+        name=None,
+    ):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr, default_initializer=I.Constant(1.0)
+        )
+        self.bias = self.create_parameter((num_features,), attr=bias_attr, is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,), jnp.float32)))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,), jnp.float32)))
+
+    def forward(self, x):
+        return F.batch_norm(
+            x,
+            self._mean,
+            self._variance,
+            self.weight,
+            self.bias,
+            training=self.training,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+            data_format=self.data_format,
+            use_global_stats=self.use_global_stats,
+        )
+
+    def extra_repr(self):
+        return f"num_features={self.num_features}, momentum={self.momentum}, epsilon={self.epsilon}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05, weight_attr=None, bias_attr=None, data_format="NCL", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr, "NCW" if data_format == "NCL" else data_format, use_global_stats, name)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05, weight_attr=None, bias_attr=None, data_format="NCDHW", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr, data_format, use_global_stats, name)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm.  In jit/pjit training the mesh handles stat sync
+    (psum over the dp axis); eager single-process falls back to local stats.
+    Reference: python/paddle/nn/layer/norm.py SyncBatchNorm."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
+            new = SyncBatchNorm(
+                layer.num_features, layer.momentum, layer.epsilon, data_format=layer.data_format
+            )
+            new.set_state_dict(layer.state_dict())
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            self.normalized_shape, attr=weight_attr, default_initializer=I.Constant(1.0)
+        )
+        self.bias = self.create_parameter(self.normalized_shape, attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias, self.epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={list(self.normalized_shape)}, epsilon={self.epsilon}"
+
+
+class RMSNorm(Layer):
+    """Root-mean-square norm (the LLM workhorse; fused Pallas path in
+    paddle_tpu.incubate.nn.functional.fused_rms_norm; reference fused op:
+    python/paddle/incubate/nn/functional/fused_rms_norm.py)."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (hidden_size,), attr=weight_attr, default_initializer=I.Constant(1.0)
+        )
+
+    def forward(self, x):
+        from ..incubate.nn import functional as IF
+
+        return IF.fused_rms_norm(x, self.weight, epsilon=self.epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05, weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.num_channels = num_channels
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_channels,), attr=weight_attr, default_initializer=I.Constant(1.0)
+        )
+        self.bias = self.create_parameter((num_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.epsilon, self.weight, self.bias, self.data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9, weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.epsilon = epsilon
+        self.data_format = data_format
+        if weight_attr is False:
+            self.weight = None
+            self.bias = None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), attr=weight_attr, default_initializer=I.Constant(1.0)
+            )
+            self.bias = self.create_parameter((num_features,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias, eps=self.epsilon, data_format=self.data_format)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0, data_format="NCHW", name=None):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.local_response_norm(x, self.size, self.alpha, self.beta, self.k, self.data_format)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12, dtype="float32"):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        self.weight_u = self.create_parameter((h,), default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v = self.create_parameter((w,), default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ..ops import manipulation as M
+
+        w = M.moveaxis(weight, self.dim, 0)
+        mat = M.reshape(w, (w.shape[0], -1))
+        u, v = self.weight_u, self.weight_v
+        for _ in range(self.power_iters):
+            v = F.normalize(mat.T @ u, axis=0, epsilon=self.epsilon)
+            u = F.normalize(mat @ v, axis=0, epsilon=self.epsilon)
+        sigma = (u @ mat @ v)
+        return weight / sigma

@@ -1,0 +1,313 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native design: the whole sequence loop is one ``jax.lax.scan`` per layer and
+direction — compiles to a single fused XLA while-loop instead of a Python loop of
+kernel launches (the reference relies on cuDNN RNN kernels here)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from . import initializer as I
+from .layer_base import Layer
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "LSTMCell", "GRUCell", "SimpleRNNCell", "RNN"]
+
+
+def _rnn_params(layer, input_size, hidden_size, gates, suffix, weight_attr=None, bias_attr=None):
+    std = 1.0 / math.sqrt(hidden_size)
+    wi = layer.create_parameter(
+        (gates * hidden_size, input_size), attr=weight_attr, default_initializer=I.Uniform(-std, std)
+    )
+    wh = layer.create_parameter(
+        (gates * hidden_size, hidden_size), attr=weight_attr, default_initializer=I.Uniform(-std, std)
+    )
+    bi = layer.create_parameter(
+        (gates * hidden_size,), attr=bias_attr, is_bias=True, default_initializer=I.Uniform(-std, std)
+    )
+    bh = layer.create_parameter(
+        (gates * hidden_size,), attr=bias_attr, is_bias=True, default_initializer=I.Uniform(-std, std)
+    )
+    layer.add_parameter(f"weight_ih_{suffix}", wi)
+    layer.add_parameter(f"weight_hh_{suffix}", wh)
+    layer.add_parameter(f"bias_ih_{suffix}", bi)
+    layer.add_parameter(f"bias_hh_{suffix}", bh)
+    return wi, wh, bi, bh
+
+
+def _lstm_step(h, c, x_t, wi, wh, bi, bh):
+    z = x_t @ wi.T + h @ wh.T + bi + bh
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+def _gru_step(h, x_t, wi, wh, bi, bh):
+    xz = x_t @ wi.T + bi
+    hz = h @ wh.T + bh
+    xr, xu, xn = jnp.split(xz, 3, axis=-1)
+    hr, hu, hn = jnp.split(hz, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    u = jax.nn.sigmoid(xu + hu)
+    n = jnp.tanh(xn + r * hn)
+    return (1 - u) * n + u * h
+
+
+def _simple_step(h, x_t, wi, wh, bi, bh, act):
+    z = x_t @ wi.T + h @ wh.T + bi + bh
+    return jnp.tanh(z) if act == "tanh" else jax.nn.relu(z)
+
+
+class _RNNBase(Layer):
+    MODE = "LSTM"
+    GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
+
+    def __init__(
+        self,
+        input_size,
+        hidden_size,
+        num_layers=1,
+        direction="forward",
+        time_major=False,
+        dropout=0.0,
+        activation="tanh",
+        weight_attr=None,
+        bias_attr=None,
+        name=None,
+    ):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gates = self.GATES[self.MODE if self.MODE != "RNN" else f"RNN_{activation.upper()}"]
+        self._weights = []
+        for layer_i in range(num_layers):
+            for d in range(self.bidirect):
+                in_sz = input_size if layer_i == 0 else hidden_size * self.bidirect
+                suffix = f"l{layer_i}" + ("_reverse" if d == 1 else "")
+                self._weights.append(
+                    _rnn_params(self, in_sz, hidden_size, gates, suffix, weight_attr, bias_attr)
+                )
+
+    def _scan_layer(self, seq_len):
+        mode = self.MODE
+        act = self.activation
+
+        def run(x, h0, c0, wi, wh, bi, bh, reverse):
+            # x: [seq, batch, in]
+            xs = jnp.flip(x, axis=0) if reverse else x
+
+            if mode == "LSTM":
+
+                def step(carry, x_t):
+                    h, c = carry
+                    h2, c2 = _lstm_step(h, c, x_t, wi, wh, bi, bh)
+                    return (h2, c2), h2
+
+                (hT, cT), ys = jax.lax.scan(step, (h0, c0), xs)
+            elif mode == "GRU":
+
+                def step(h, x_t):
+                    h2 = _gru_step(h, x_t, wi, wh, bi, bh)
+                    return h2, h2
+
+                hT, ys = jax.lax.scan(step, h0, xs)
+                cT = hT
+            else:
+
+                def step(h, x_t):
+                    h2 = _simple_step(h, x_t, wi, wh, bi, bh, act)
+                    return h2, h2
+
+                hT, ys = jax.lax.scan(step, h0, xs)
+                cT = hT
+            if reverse:
+                ys = jnp.flip(ys, axis=0)
+            return ys, hT, cT
+
+        return run
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        n_states = self.num_layers * self.bidirect
+        is_lstm = self.MODE == "LSTM"
+        weight_tensors = [t for ws in self._weights for t in ws]
+
+        def fn(x, *flat):
+            ws = [flat[i * 4 : (i + 1) * 4] for i in range(len(self._weights))]
+            k = len(self._weights) * 4
+            if initial_states is not None:
+                if is_lstm:
+                    h0_all, c0_all = flat[k], flat[k + 1]
+                else:
+                    h0_all = flat[k]
+                    c0_all = jnp.zeros_like(h0_all)
+            else:
+                b = x.shape[0] if not self.time_major else x.shape[1]
+                h0_all = jnp.zeros((n_states, b, self.hidden_size), x.dtype)
+                c0_all = jnp.zeros_like(h0_all)
+
+            xs = x if self.time_major else jnp.swapaxes(x, 0, 1)  # [seq, batch, in]
+            run = self._scan_layer(xs.shape[0])
+            hs, cs = [], []
+            out = xs
+            idx = 0
+            for layer_i in range(self.num_layers):
+                outs_dir = []
+                for d in range(self.bidirect):
+                    wi, wh, bi, bh = ws[idx]
+                    ys, hT, cT = run(out, h0_all[idx], c0_all[idx], wi, wh, bi, bh, d == 1)
+                    outs_dir.append(ys)
+                    hs.append(hT)
+                    cs.append(cT)
+                    idx += 1
+                out = outs_dir[0] if self.bidirect == 1 else jnp.concatenate(outs_dir, axis=-1)
+            final_h = jnp.stack(hs)
+            final_c = jnp.stack(cs)
+            out = out if self.time_major else jnp.swapaxes(out, 0, 1)
+            if is_lstm:
+                return out, final_h, final_c
+            return out, final_h
+
+        inputs_list = [inputs] + weight_tensors
+        if initial_states is not None:
+            if is_lstm:
+                inputs_list += [initial_states[0], initial_states[1]]
+            else:
+                inputs_list += [initial_states]
+        res = apply_op(self.MODE.lower(), fn, inputs_list)
+        if is_lstm:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN"
+
+    @property
+    def GATES(self):
+        return {"RNN_TANH": 1, "RNN_RELU": 1}
+
+
+SimpleRNN.GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.wi, self.wh, self.bi, self.bh = None, None, None, None
+        ws = _rnn_params(self, input_size, hidden_size, 4, "cell", weight_attr, bias_attr)
+        self._ws = ws
+
+    def forward(self, inputs, states=None):
+        wi, wh, bi, bh = (
+            self._parameters["weight_ih_cell"],
+            self._parameters["weight_hh_cell"],
+            self._parameters["bias_ih_cell"],
+            self._parameters["bias_hh_cell"],
+        )
+        if states is None:
+            b = inputs.shape[0]
+            z = Tensor(jnp.zeros((b, self.hidden_size), jnp.float32))
+            states = (z, z)
+
+        def fn(x, h, c, wi_, wh_, bi_, bh_):
+            return _lstm_step(h, c, x, wi_, wh_, bi_, bh_)
+
+        h2, c2 = apply_op("lstm_cell", fn, [inputs, states[0], states[1], wi, wh, bi, bh])
+        return h2, (h2, c2)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        _rnn_params(self, input_size, hidden_size, 3, "cell", weight_attr, bias_attr)
+
+    def forward(self, inputs, states=None):
+        wi, wh, bi, bh = (
+            self._parameters["weight_ih_cell"],
+            self._parameters["weight_hh_cell"],
+            self._parameters["bias_ih_cell"],
+            self._parameters["bias_hh_cell"],
+        )
+        if states is None:
+            states = Tensor(jnp.zeros((inputs.shape[0], self.hidden_size), jnp.float32))
+
+        def fn(x, h, wi_, wh_, bi_, bh_):
+            return _gru_step(h, x, wi_, wh_, bi_, bh_)
+
+        h2 = apply_op("gru_cell", fn, [inputs, states, wi, wh, bi, bh])
+        return h2, h2
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        _rnn_params(self, input_size, hidden_size, 1, "cell", weight_attr, bias_attr)
+
+    def forward(self, inputs, states=None):
+        wi, wh, bi, bh = (
+            self._parameters["weight_ih_cell"],
+            self._parameters["weight_hh_cell"],
+            self._parameters["bias_ih_cell"],
+            self._parameters["bias_hh_cell"],
+        )
+        if states is None:
+            states = Tensor(jnp.zeros((inputs.shape[0], self.hidden_size), jnp.float32))
+
+        def fn(x, h, wi_, wh_, bi_, bh_):
+            return _simple_step(h, x, wi_, wh_, bi_, bh_, self.activation)
+
+        h2 = apply_op("rnn_cell", fn, [inputs, states, wi, wh, bi, bh])
+        return h2, h2
+
+
+class RNN(Layer):
+    """Wrap a cell into a sequence runner (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        seq_axis = 0 if self.time_major else 1
+        length = inputs.shape[seq_axis]
+        idxs = range(length - 1, -1, -1) if self.is_reverse else range(length)
+        outs = []
+        states = initial_states
+        from ..ops import manipulation as M
+
+        for i in idxs:
+            x_t = M.squeeze(M.slice(inputs, [seq_axis], [i], [i + 1]), axis=seq_axis)
+            y, states = self.cell(x_t, states)
+            outs.append(y)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = M.stack(outs, axis=seq_axis)
+        return out, states

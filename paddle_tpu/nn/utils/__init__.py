@@ -1,0 +1,30 @@
+"""nn.utils (reference: python/paddle/nn/utils/)."""
+
+from ..clip import clip_grad_norm_  # noqa: F401
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...ops.manipulation import concat, reshape
+
+    return concat([reshape(p, (-1,)) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    import jax.numpy as jnp
+
+    from ...core.tensor import _unwrap
+
+    v = _unwrap(vec)
+    for p in parameters:
+        n = p.size
+        p._value = jnp.reshape(v[offset : offset + n], p.shape).astype(p.dtype)
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    return layer  # placeholder: spectral/weight norm reparameterization
+
+
+def remove_weight_norm(layer, name="weight"):
+    return layer

@@ -1,0 +1,100 @@
+"""Op library: imports all op modules, installs Tensor methods and operator
+dunders (the role of the reference's generated ``eager_method.cc`` ~400 tensor
+methods + ``math_op_patch.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from . import creation, linalg, manipulation, math, registry
+from .registry import OPS, install_tensor_methods, op_names
+
+
+def _binop(name, jfn, reverse=False):
+    def method(self, other):
+        if reverse:
+            return apply_op(name, lambda a, b: jfn(b, a), [self, other])
+        return apply_op(name, jfn, [self, other])
+
+    return method
+
+
+def _install_dunders():
+    T = Tensor
+    T.__add__ = _binop("add", jnp.add)
+    T.__radd__ = _binop("add", jnp.add, reverse=True)
+    T.__sub__ = _binop("subtract", jnp.subtract)
+    T.__rsub__ = _binop("subtract", jnp.subtract, reverse=True)
+    T.__mul__ = _binop("multiply", jnp.multiply)
+    T.__rmul__ = _binop("multiply", jnp.multiply, reverse=True)
+    T.__truediv__ = _binop("divide", jnp.divide)
+    T.__rtruediv__ = _binop("divide", jnp.divide, reverse=True)
+    T.__floordiv__ = _binop("floor_divide", jnp.floor_divide)
+    T.__rfloordiv__ = _binop("floor_divide", jnp.floor_divide, reverse=True)
+    T.__mod__ = _binop("remainder", jnp.remainder)
+    T.__rmod__ = _binop("remainder", jnp.remainder, reverse=True)
+    T.__pow__ = _binop("pow", jnp.power)
+    T.__rpow__ = _binop("pow", jnp.power, reverse=True)
+    T.__matmul__ = _binop("matmul", jnp.matmul)
+    T.__rmatmul__ = _binop("matmul", jnp.matmul, reverse=True)
+    T.__and__ = _binop("bitwise_and", jnp.bitwise_and)
+    T.__or__ = _binop("bitwise_or", jnp.bitwise_or)
+    T.__xor__ = _binop("bitwise_xor", jnp.bitwise_xor)
+    T.__lshift__ = _binop("lshift", jnp.left_shift)
+    T.__rshift__ = _binop("rshift", jnp.right_shift)
+    T.__eq__ = _binop("equal", jnp.equal)
+    T.__ne__ = _binop("not_equal", jnp.not_equal)
+    T.__lt__ = _binop("less_than", jnp.less)
+    T.__le__ = _binop("less_equal", jnp.less_equal)
+    T.__gt__ = _binop("greater_than", jnp.greater)
+    T.__ge__ = _binop("greater_equal", jnp.greater_equal)
+    T.__neg__ = lambda self: apply_op("neg", jnp.negative, [self])
+    T.__pos__ = lambda self: self
+    T.__abs__ = lambda self: apply_op("abs", jnp.abs, [self])
+    T.__invert__ = lambda self: apply_op("bitwise_not", jnp.bitwise_not, [self])
+
+    # common method aliases matching paddle Tensor surface
+    T.add = math.add
+    T.subtract = math.subtract
+    T.multiply = math.multiply
+    T.divide = math.divide
+    T.matmul = linalg.matmul
+    T.dot = math.dot
+    T.exp = math.exp
+    T.log = math.log
+    T.mean = math.mean
+    T.sum = math.sum
+    T.pow = math.pow
+    T.sqrt = math.sqrt
+    T.rsqrt = math.rsqrt
+    T.tanh = math.tanh
+    T.sigmoid = math.sigmoid
+    T.abs = math.abs
+    T.square = math.square
+    T.unsqueeze = manipulation.unsqueeze
+    T.squeeze = manipulation.squeeze
+    T.reshape = manipulation.reshape
+    T.transpose = manipulation.transpose
+    T.flatten = manipulation.flatten
+    T.cast = manipulation.cast
+    T.astype = manipulation.cast
+    T.gather = manipulation.gather
+    T.split = manipulation.split
+    T.equal = math.equal
+    T.not_equal = math.not_equal
+    T.greater_than = math.greater_than
+    T.less_than = math.less_than
+    T.logical_and = math.logical_and
+    T.logical_or = math.logical_or
+    T.logical_not = math.logical_not
+    T.isnan = math.isnan
+    T.isinf = math.isinf
+    T.isfinite = math.isfinite
+    T.norm = linalg.norm
+
+
+_install_dunders()
+install_tensor_methods(Tensor)
+
+__all__ = ["creation", "math", "manipulation", "linalg", "registry", "OPS", "op_names"]

@@ -1,0 +1,313 @@
+"""Tensor creation ops (reference: paddle/phi/kernels full/empty/arange families,
+python surface python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core import rng
+from ..core.tensor import Tensor, apply_op, to_tensor, _unwrap
+from .registry import register_op
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else dtypes.get_default_dtype()
+    return dtypes.convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(_unwrap(s)) for s in shape)
+
+
+@register_op("zeros")
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+@register_op("ones")
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+@register_op("full")
+def full(shape, fill_value, dtype=None, name=None):
+    fill = _unwrap(fill_value)
+    if dtype is None:
+        dtype = dtypes.get_default_dtype() if isinstance(fill, float) else None
+    return Tensor(jnp.full(_shape(shape), fill, _dt(dtype) if dtype is not None else None))
+
+
+@register_op("empty")
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+@register_op("zeros_like")
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros(_unwrap(x).shape, _dt(dtype, np.dtype(_unwrap(x).dtype))))
+
+
+@register_op("ones_like")
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones(_unwrap(x).shape, _dt(dtype, np.dtype(_unwrap(x).dtype))))
+
+
+@register_op("full_like")
+def full_like(x, fill_value, dtype=None, name=None):
+    v = _unwrap(x)
+    return Tensor(jnp.full(v.shape, _unwrap(fill_value), _dt(dtype, np.dtype(v.dtype))))
+
+
+@register_op("empty_like")
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+@register_op("arange")
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = _unwrap(start), _unwrap(end), _unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            dtypes.get_default_dtype()
+            if any(isinstance(v, float) for v in (start, end, step))
+            else np.dtype("int64")
+        )
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+
+
+@register_op("linspace")
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(_unwrap(start), _unwrap(stop), int(_unwrap(num)), dtype=_dt(dtype)))
+
+
+@register_op("logspace")
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(
+        jnp.logspace(_unwrap(start), _unwrap(stop), int(_unwrap(num)), base=_unwrap(base), dtype=_dt(dtype))
+    )
+
+
+@register_op("eye")
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=_dt(dtype)))
+
+
+@register_op("diag")
+def diag(x, offset=0, padding_value=0, name=None):
+    def fn(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, v.dtype))
+            return out
+        return jnp.diagonal(v, offset=offset)
+
+    return apply_op("diag", fn, [x])
+
+
+@register_op("diagflat")
+def diagflat(x, offset=0, name=None):
+    return apply_op("diagflat", lambda v: jnp.diagflat(v, k=offset), [x])
+
+
+@register_op("diag_embed")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def fn(v):
+        n = v.shape[-1] + abs(offset)
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(v)
+        return jnp.moveaxis(out, (-2, -1), (dim1, dim2)) if (dim1, dim2) != (-2, -1) else out
+
+    return apply_op("diag_embed", fn, [x])
+
+
+@register_op("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(
+        "diagonal", lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), [x]
+    )
+
+
+@register_op("tril", tensor_method="tril")
+def tril(x, diagonal=0, name=None):
+    return apply_op("tril", lambda v: jnp.tril(v, k=diagonal), [x])
+
+
+@register_op("triu", tensor_method="triu")
+def triu(x, diagonal=0, name=None):
+    return apply_op("triu", lambda v: jnp.triu(v, k=diagonal), [x])
+
+
+@register_op("tril_indices")
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
+    r = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack(r).astype(_dt(dtype)))
+
+
+@register_op("triu_indices")
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    r = jnp.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack(r).astype(_dt(dtype)))
+
+
+@register_op("meshgrid")
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = jnp.meshgrid(*[_unwrap(a) for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+@register_op("assign")
+def assign(x, output=None, name=None):
+    out = apply_op("assign", lambda v: jnp.copy(v), [to_tensor(x) if not isinstance(x, Tensor) else x])
+    if output is not None:
+        output._value = out._value
+        output._node = out._node
+        output._out_idx = out._out_idx
+        output.stop_gradient = out.stop_gradient
+        return output
+    return out
+
+
+@register_op("clone", tensor_method=None)
+def clone(x, name=None):
+    return x.clone()
+
+
+@register_op("numel", tensor_method="numel")
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+@register_op("one_hot")
+def one_hot(x, num_classes, name=None):
+    return apply_op(
+        "one_hot",
+        lambda v: jax.nn.one_hot(v, num_classes, dtype=dtypes.get_default_dtype()),
+        [x],
+    )
+
+
+@register_op("complex")
+def complex(real, imag, name=None):
+    return apply_op("complex", lambda r, i: jax.lax.complex(r, i), [real, imag])
+
+
+@register_op("as_complex")
+def as_complex(x, name=None):
+    return apply_op("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]), [x])
+
+
+@register_op("as_real")
+def as_real(x, name=None):
+    return apply_op("as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), [x])
+
+
+# ---- random creation (consumes the global {seed, offset} Generator) ----
+
+
+@register_op("rand")
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(rng.next_key(), _shape(shape), _dt(dtype)))
+
+
+@register_op("randn")
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(rng.next_key(), _shape(shape), _dt(dtype)))
+
+
+@register_op("randint")
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(
+        jax.random.randint(rng.next_key(), _shape(shape), int(low), int(high)).astype(
+            _dt(dtype, np.dtype("int64"))
+        )
+    )
+
+
+@register_op("randint_like")
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    v = _unwrap(x)
+    return randint(low, high, v.shape, dtype or v.dtype)
+
+
+@register_op("randperm")
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(rng.next_key(), int(n)).astype(_dt(dtype)))
+
+
+@register_op("uniform")
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else rng.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype), float(min), float(max)))
+
+
+@register_op("normal")
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = _unwrap(mean), _unwrap(std)
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(rng.next_key(), shp) * s + m)
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(
+        jax.random.normal(rng.next_key(), shp, dtypes.get_default_dtype()) * std + mean
+    )
+
+
+@register_op("standard_normal")
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+@register_op("bernoulli")
+def bernoulli(x, name=None):
+    key = rng.next_key()
+    return apply_op(
+        "bernoulli",
+        lambda v: jax.random.bernoulli(key, v).astype(v.dtype),
+        [x.detach() if isinstance(x, Tensor) else x],
+    )
+
+
+@register_op("multinomial")
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    v = _unwrap(x)
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    key = rng.next_key()
+    if replacement or num_samples == 1:
+        shape = v.shape[:-1] + (num_samples,)
+        return Tensor(jax.random.categorical(key, logits, axis=-1, shape=shape).astype(jnp.int64))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, v.shape)
+    return Tensor(jnp.argsort(-(logits + g), axis=-1)[..., :num_samples].astype(jnp.int64))
+
+
+@register_op("poisson")
+def poisson(x, name=None):
+    key = rng.next_key()
+    return Tensor(jax.random.poisson(key, _unwrap(x)).astype(_unwrap(x).dtype))
+
+
+@register_op("exponential_")
+def exponential_(x, lam=1.0, name=None):
+    key = rng.next_key()
+    v = jax.random.exponential(key, _unwrap(x).shape, _unwrap(x).dtype) / lam
+    x._value = v
+    return x

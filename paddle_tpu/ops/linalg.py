@@ -1,0 +1,236 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py; phi matmul/blas
+kernels → MXU via XLA dot_general)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op, _unwrap
+from .registry import register_op
+
+
+@register_op("matmul", tensor_method="matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply_op("matmul", fn, [x, y])
+
+
+@register_op("mm", tensor_method="mm")
+def mm(x, y, name=None):
+    return apply_op("mm", jnp.matmul, [x, y])
+
+
+@register_op("bmm", tensor_method="bmm")
+def bmm(x, y, name=None):
+    return apply_op("bmm", jnp.matmul, [x, y])
+
+
+@register_op("mv", tensor_method="mv")
+def mv(x, vec, name=None):
+    return apply_op("mv", jnp.matmul, [x, vec])
+
+
+@register_op("norm", tensor_method="norm")
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = 2.0 if axis is not None or True else "fro"
+
+    def fn(v):
+        if axis is None:
+            vv = v.reshape(-1)
+            if p == "fro" or p == 2.0:
+                return jnp.sqrt(jnp.sum(vv.astype(jnp.float32) ** 2)).astype(v.dtype)
+            if p == float("inf"):
+                return jnp.max(jnp.abs(vv))
+            if p == float("-inf"):
+                return jnp.min(jnp.abs(vv))
+            return jnp.sum(jnp.abs(vv) ** p) ** (1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(v * v, axis=ax, keepdims=keepdim))
+        return jnp.linalg.norm(v, ord=p, axis=ax, keepdims=keepdim)
+
+    return apply_op("norm", fn, [x])
+
+
+@register_op("dist")
+def dist(x, y, p=2, name=None):
+    return apply_op("dist", lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), [x, y])
+
+
+@register_op("histogram")
+def histogram(input, bins=100, min=0, max=0, name=None):
+    v = _unwrap(input)
+    lo, hi = (float(min), float(max)) if (min != 0 or max != 0) else (float(jnp.min(v)), float(jnp.max(v)))
+    h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+    return Tensor(h.astype(jnp.int64))
+
+
+@register_op("bincount")
+def bincount(x, weights=None, minlength=0, name=None):
+    v = _unwrap(x)
+    w = _unwrap(weights) if weights is not None else None
+    return Tensor(jnp.bincount(v, weights=w, minlength=minlength))
+
+
+@register_op("multi_dot")
+def multi_dot(x, name=None):
+    return apply_op("multi_dot", lambda *vs: jnp.linalg.multi_dot(list(vs)), list(x))
+
+
+@register_op("matrix_power")
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power", lambda v: jnp.linalg.matrix_power(v, n), [x])
+
+
+@register_op("det")
+def det(x, name=None):
+    return apply_op("det", jnp.linalg.det, [x])
+
+
+@register_op("slogdet")
+def slogdet(x, name=None):
+    def fn(v):
+        sign, logabs = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logabs])
+
+    return apply_op("slogdet", fn, [x])
+
+
+@register_op("inv", aliases=("inverse",))
+def inv(x, name=None):
+    return apply_op("inv", jnp.linalg.inv, [x])
+
+
+@register_op("pinv")
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op("pinv", lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), [x])
+
+
+@register_op("cholesky")
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+
+    return apply_op("cholesky", fn, [x])
+
+
+@register_op("cholesky_solve")
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+
+    return apply_op("cholesky_solve", fn, [x, y])
+
+
+@register_op("qr")
+def qr(x, mode="reduced", name=None):
+    return apply_op("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)), [x], n_outputs=2)
+
+
+@register_op("svd")
+def svd(x, full_matrices=False, name=None):
+    def fn(v):
+        u, s, vh = jnp.linalg.svd(v, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+    return apply_op("svd", fn, [x], n_outputs=3)
+
+
+@register_op("eigh")
+def eigh(x, UPLO="L", name=None):
+    return apply_op("eigh", lambda v: tuple(jnp.linalg.eigh(v, symmetrize_input=True)), [x], n_outputs=2)
+
+
+@register_op("eigvalsh")
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op("eigvalsh", lambda v: jnp.linalg.eigvalsh(v), [x])
+
+
+@register_op("eig")
+def eig(x, name=None):
+    v = np.asarray(_unwrap(x))
+    w, vec = np.linalg.eig(v)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
+
+
+@register_op("eigvals")
+def eigvals(x, name=None):
+    v = np.asarray(_unwrap(x))
+    return Tensor(jnp.asarray(np.linalg.eigvals(v)))
+
+
+@register_op("solve")
+def solve(x, y, name=None):
+    return apply_op("solve", jnp.linalg.solve, [x, y])
+
+
+@register_op("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return apply_op("triangular_solve", fn, [x, y])
+
+
+@register_op("lstsq")
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int64), sv
+
+    return apply_op("lstsq", fn, [x, y], n_outputs=4)
+
+
+@register_op("matrix_rank")
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    v = _unwrap(x)
+    return Tensor(jnp.linalg.matrix_rank(v, rtol=tol).astype(jnp.int64))
+
+
+@register_op("cond")
+def cond(x, p=None, name=None):
+    return Tensor(jnp.linalg.cond(_unwrap(x), p=p))
+
+
+@register_op("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op(
+        "cov",
+        lambda v: jnp.cov(
+            v,
+            rowvar=rowvar,
+            ddof=1 if ddof else 0,
+            fweights=_unwrap(fweights) if fweights is not None else None,
+            aweights=_unwrap(aweights) if aweights is not None else None,
+        ),
+        [x],
+    )
+
+
+@register_op("corrcoef")
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), [x])
+
+
+@register_op("lu")
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, (piv + 1).astype(jnp.int32)
+
+    lu_t, piv_t = apply_op("lu", fn, [x], n_outputs=2)
+    if get_infos:
+        return lu_t, piv_t, Tensor(jnp.zeros((), jnp.int32))
+    return lu_t, piv_t

@@ -1,0 +1,602 @@
+"""Shape/layout/indexing ops (reference: python/paddle/tensor/manipulation.py and
+the phi reshape/concat/gather/scatter kernel families)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, apply_op, _unwrap
+from .registry import register_op
+
+
+def _ints(seq):
+    if isinstance(seq, Tensor):
+        return tuple(int(v) for v in np.asarray(seq._value).reshape(-1))
+    if isinstance(seq, (int, np.integer)):
+        return (int(seq),)
+    return tuple(int(_unwrap(v)) for v in seq)
+
+
+@register_op("cast", tensor_method=None)
+def cast(x, dtype, name=None):
+    dt = dtypes.convert_dtype(dtype)
+    return apply_op("cast", lambda v: v.astype(dt), [x])
+
+
+@register_op("reshape", tensor_method="reshape")
+def reshape(x, shape, name=None):
+    shp = _ints(shape)
+    return apply_op("reshape", lambda v: jnp.reshape(v, shp), [x])
+
+
+@register_op("reshape_", tensor_method="reshape_")
+def reshape_(x, shape, name=None):
+    out = reshape(x._snapshot() if isinstance(x, Tensor) else x, shape)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+@register_op("flatten", tensor_method="flatten")
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        if nd == 0:
+            return v.reshape(1)
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1 :]
+        return v.reshape(new_shape)
+
+    return apply_op("flatten", fn, [x])
+
+
+@register_op("squeeze", tensor_method="squeeze")
+def squeeze(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = _ints(axis if isinstance(axis, (list, tuple)) else [axis])
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+
+    return apply_op("squeeze", fn, [x])
+
+
+@register_op("unsqueeze", tensor_method="unsqueeze")
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis if isinstance(axis, (list, tuple, Tensor)) else [axis])
+
+    def fn(v):
+        out = v
+        for a in sorted(a if a >= 0 else a + out.ndim + 1 for a in axes):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return apply_op("unsqueeze", fn, [x])
+
+
+@register_op("transpose", tensor_method="transpose")
+def transpose(x, perm, name=None):
+    p = _ints(perm)
+    return apply_op("transpose", lambda v: jnp.transpose(v, p), [x])
+
+
+@register_op("moveaxis")
+def moveaxis(x, source, destination, name=None):
+    return apply_op(
+        "moveaxis", lambda v: jnp.moveaxis(v, _ints(source), _ints(destination)), [x]
+    )
+
+
+@register_op("swapaxes", aliases=("swapdims",))
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op("swapaxes", lambda v: jnp.swapaxes(v, int(axis0), int(axis1)), [x])
+
+
+@register_op("t", tensor_method="t")
+def t(x, name=None):
+    return apply_op("t", lambda v: v.T, [x])
+
+
+@register_op("concat")
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    ax = int(_unwrap(axis))
+    return apply_op("concat", lambda *vs: jnp.concatenate(vs, axis=ax), tensors)
+
+
+@register_op("stack")
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply_op("stack", lambda *vs: jnp.stack(vs, axis=axis), tensors)
+
+
+@register_op("hstack")
+def hstack(x, name=None):
+    return apply_op("hstack", lambda *vs: jnp.hstack(vs), list(x))
+
+
+@register_op("vstack")
+def vstack(x, name=None):
+    return apply_op("vstack", lambda *vs: jnp.vstack(vs), list(x))
+
+
+@register_op("split")
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(_unwrap(axis))
+    v = _unwrap(x)
+    dim = v.shape[ax]
+    if isinstance(num_or_sections, int):
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(_unwrap(s)) for s in num_or_sections]
+        total_known = sum(s for s in sections if s != -1)
+        sections = [s if s != -1 else dim - total_known for s in sections]
+    offsets = np.cumsum([0] + sections)
+
+    outs = []
+    for i in range(len(sections)):
+        lo, hi = int(offsets[i]), int(offsets[i + 1])
+        outs.append(
+            apply_op(
+                "split",
+                lambda v, lo=lo, hi=hi: jax.lax.slice_in_dim(v, lo, hi, axis=ax),
+                [x],
+            )
+        )
+    return outs
+
+
+@register_op("chunk", tensor_method="chunk")
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+@register_op("unbind")
+def unbind(x, axis=0, name=None):
+    v = _unwrap(x)
+    n = v.shape[axis]
+    return [
+        apply_op("unbind", lambda v, i=i: jnp.take(v, i, axis=axis), [x]) for i in range(n)
+    ]
+
+
+@register_op("unstack")
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+@register_op("tile", tensor_method="tile")
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return apply_op("tile", lambda v: jnp.tile(v, reps), [x])
+
+
+@register_op("expand", tensor_method="expand")
+def expand(x, shape, name=None):
+    shp = _ints(shape)
+
+    def fn(v):
+        tgt = list(shp)
+        off = len(tgt) - v.ndim
+        for i in range(v.ndim):
+            if tgt[off + i] == -1:
+                tgt[off + i] = v.shape[i]
+        return jnp.broadcast_to(v, tuple(tgt))
+
+    return apply_op("expand", fn, [x])
+
+
+@register_op("expand_as", tensor_method="expand_as")
+def expand_as(x, y, name=None):
+    return expand(x, _unwrap(y).shape)
+
+
+@register_op("broadcast_to")
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+@register_op("broadcast_tensors")
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(_unwrap(t).shape) for t in inputs]
+    out_shape = jnp.broadcast_shapes(*shapes)
+    return [expand(t, out_shape) for t in inputs]
+
+
+@register_op("flip", tensor_method="flip", aliases=("reverse",))
+def flip(x, axis, name=None):
+    axes = _ints(axis if isinstance(axis, (list, tuple)) else [axis])
+    return apply_op("flip", lambda v: jnp.flip(v, axis=axes), [x])
+
+
+@register_op("rot90")
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), [x])
+
+
+@register_op("roll", tensor_method="roll")
+def roll(x, shifts, axis=None, name=None):
+    sh = _ints(shifts) if isinstance(shifts, (list, tuple, Tensor)) else int(_unwrap(shifts))
+    ax = None if axis is None else (_ints(axis) if isinstance(axis, (list, tuple)) else int(axis))
+    return apply_op("roll", lambda v: jnp.roll(v, sh, axis=ax), [x])
+
+
+@register_op("gather")
+def gather(x, index, axis=0, name=None):
+    ax = int(_unwrap(axis))
+    return apply_op(
+        "gather", lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i, axis=ax), [x, index]
+    )
+
+
+@register_op("gather_nd")
+def gather_nd(x, index, name=None):
+    def fn(v, idx):
+        k = idx.shape[-1]
+        return v[tuple(jnp.moveaxis(idx, -1, 0))] if k > 0 else v
+
+    return apply_op("gather_nd", fn, [x, index])
+
+
+@register_op("scatter")
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        base = v.at[i].set(jnp.zeros_like(u))
+        return base.at[i].add(u)
+
+    return apply_op("scatter", fn, [x, index, updates])
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, i, u):
+        return v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply_op("scatter_nd_add", fn, [x, index, updates])
+
+
+@register_op("scatter_nd")
+def scatter_nd(index, updates, shape, name=None):
+    shp = _ints(shape)
+
+    def fn(i, u):
+        z = jnp.zeros(shp, u.dtype)
+        return z.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply_op("scatter_nd", fn, [index, updates])
+
+
+@register_op("index_select")
+def index_select(x, index, axis=0, name=None):
+    return apply_op("index_select", lambda v, i: jnp.take(v, i, axis=axis), [x, index])
+
+
+@register_op("index_sample")
+def index_sample(x, index, name=None):
+    return apply_op(
+        "index_sample",
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=1),
+        [x, index],
+    )
+
+
+@register_op("index_add")
+def index_add(x, index, axis, value, name=None):
+    def fn(v, i, u):
+        return jnp.moveaxis(jnp.moveaxis(v, axis, 0).at[i].add(jnp.moveaxis(u, axis, 0)), 0, axis)
+
+    return apply_op("index_add", fn, [x, index, value])
+
+
+@register_op("index_put")
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_t = [i for i in indices]
+
+    def fn(v, u, *idx):
+        if accumulate:
+            return v.at[tuple(idx)].add(u)
+        return v.at[tuple(idx)].set(u)
+
+    return apply_op("index_put", fn, [x, value] + idx_t)
+
+
+@register_op("take_along_axis")
+def take_along_axis(x, indices, axis, broadcast=True, name=None):
+    return apply_op(
+        "take_along_axis",
+        lambda v, i: jnp.take_along_axis(v, i, axis=axis),
+        [x, indices],
+    )
+
+
+@register_op("put_along_axis")
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None):
+    def fn(v, i, u):
+        u = jnp.broadcast_to(u, i.shape) if jnp.ndim(u) else jnp.full(i.shape, u, v.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, i, u.astype(v.dtype), axis=axis, inplace=False)
+        dims = list(range(v.ndim))
+        onto = jnp.moveaxis(v, axis, 0)
+        # generic path: scatter add/mul via .at on moved axis
+        full_idx = jnp.moveaxis(i, axis, 0)
+        upd = jnp.moveaxis(u.astype(v.dtype), axis, 0)
+        grid = jnp.meshgrid(*[jnp.arange(s) for s in full_idx.shape], indexing="ij")
+        coords = (full_idx,) + tuple(grid[1:])
+        if reduce == "add":
+            return jnp.moveaxis(onto.at[coords].add(upd), 0, axis)
+        if reduce == "mul" or reduce == "multiply":
+            return jnp.moveaxis(onto.at[coords].multiply(upd), 0, axis)
+        raise ValueError(f"unsupported reduce {reduce!r}")
+
+    return apply_op("put_along_axis", fn, [x, indices, values])
+
+
+@register_op("masked_select")
+def masked_select(x, mask, name=None):
+    v, m = _unwrap(x), _unwrap(mask)
+    idx = np.nonzero(np.asarray(m).reshape(-1))[0]
+    return apply_op(
+        "masked_select", lambda v, m: jnp.take(v.reshape(-1), jnp.asarray(idx)), [x, mask]
+    )
+
+
+@register_op("masked_fill", tensor_method="masked_fill")
+def masked_fill(x, mask, value, name=None):
+    inputs = [x, mask]
+    if isinstance(value, Tensor):
+        inputs.append(value)
+
+        def fn(v, m, u):
+            return jnp.where(m, u.astype(v.dtype), v)
+
+    else:
+
+        def fn(v, m):
+            return jnp.where(m, jnp.asarray(value, v.dtype), v)
+
+    return apply_op("masked_fill", fn, inputs)
+
+
+@register_op("where")
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op("where", lambda c, a, b: jnp.where(c, a, b), [condition, x, y])
+
+
+@register_op("nonzero")
+def nonzero(x, as_tuple=False, name=None):
+    v = np.asarray(_unwrap(x))
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i, jnp.int64)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=-1), jnp.int64))
+
+
+@register_op("repeat_interleave", tensor_method="repeat_interleave")
+def repeat_interleave(x, repeats, axis=None, name=None):
+    reps = _unwrap(repeats)
+    return apply_op(
+        "repeat_interleave",
+        lambda v: jnp.repeat(v.reshape(-1) if axis is None else v, reps, axis=0 if axis is None else axis),
+        [x],
+    )
+
+
+@register_op("slice")
+def slice(x, axes, starts, ends, name=None):
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+
+    def fn(v):
+        out = v
+        for a, s, e in zip(axes, starts, ends):
+            dim = v.shape[a]
+            s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+            e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+            out = jax.lax.slice_in_dim(out, s2, e2, axis=a)
+        return out
+
+    return apply_op("slice", fn, [x])
+
+
+@register_op("strided_slice")
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+
+    axes, starts, ends, strides = _ints(axes), _ints(starts), _ints(ends), _ints(strides)
+
+    def fn(v):
+        sl = [builtins.slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            sl[a] = builtins.slice(s, e, st)
+        return v[tuple(sl)]
+
+    return apply_op("strided_slice", fn, [x])
+
+
+@register_op("unique")
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    v = np.asarray(_unwrap(x))
+    res = np.unique(
+        v, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis
+    )
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(out)
+
+
+@register_op("unique_consecutive")
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    v = np.asarray(_unwrap(x)).reshape(-1) if axis is None else np.asarray(_unwrap(x))
+    keep = np.ones(v.shape[0], bool)
+    keep[1:] = np.any(v[1:] != v[:-1], axis=tuple(range(1, v.ndim))) if v.ndim > 1 else v[1:] != v[:-1]
+    uniq = v[keep]
+    outs = [Tensor(jnp.asarray(uniq))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv, np.int64)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, v.shape[0]))
+        outs.append(Tensor(jnp.asarray(counts, np.int64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+@register_op("sort", tensor_method="sort")
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(v):
+        out = jnp.sort(v, axis=axis, stable=stable)
+        return jnp.flip(out, axis=axis) if descending else out
+
+    return apply_op("sort", fn, [x])
+
+
+@register_op("argsort", tensor_method="argsort")
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    v = _unwrap(x)
+    out = jnp.argsort(v, axis=axis, stable=stable or descending)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return Tensor(out.astype(jnp.int64))
+
+
+@register_op("argmax", tensor_method="argmax")
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    v = _unwrap(x)
+    out = jnp.argmax(v, axis=None if axis is None else int(_unwrap(axis)), keepdims=keepdim)
+    return Tensor(out.astype(dtypes.convert_dtype(dtype)))
+
+
+@register_op("argmin", tensor_method="argmin")
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    v = _unwrap(x)
+    out = jnp.argmin(v, axis=None if axis is None else int(_unwrap(axis)), keepdims=keepdim)
+    return Tensor(out.astype(dtypes.convert_dtype(dtype)))
+
+
+@register_op("topk", tensor_method="topk")
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    kk = int(_unwrap(k))
+
+    def fn(v):
+        vv = jnp.moveaxis(v, axis, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vv, kk)
+        else:
+            vals, idx = jax.lax.top_k(-vv, kk)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+
+    vals, idx = apply_op("topk", fn, [x], n_outputs=2)
+    return vals, Tensor(idx._value.astype(jnp.int64))
+
+
+@register_op("searchsorted")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    return apply_op(
+        "searchsorted",
+        lambda s, v: jnp.searchsorted(s, v, side="right" if right else "left").astype(
+            jnp.int32 if out_int32 else jnp.int64
+        ),
+        [sorted_sequence, values],
+    )
+
+
+@register_op("bucketize")
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+@register_op("kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        srt = jnp.sort(v, axis=axis)
+        idxsrt = jnp.argsort(v, axis=axis)
+        vals = jnp.take(srt, k - 1, axis=axis)
+        idx = jnp.take(idxsrt, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+
+    vals, idx = apply_op("kthvalue", fn, [x], n_outputs=2)
+    return vals, Tensor(idx._value.astype(jnp.int64))
+
+
+@register_op("mode")
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = np.asarray(_unwrap(x))
+    mv = np.moveaxis(v, axis, -1)
+    flat = mv.reshape(-1, mv.shape[-1])
+    vals = np.empty(flat.shape[0], v.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for r in range(flat.shape[0]):
+        u, c = np.unique(flat[r], return_counts=True)
+        m = u[np.argmax(c)]
+        vals[r] = m
+        idxs[r] = np.nonzero(flat[r] == m)[0][-1]
+    out_shape = mv.shape[:-1] + ((1,) if keepdim else ())
+    return (
+        Tensor(jnp.asarray(vals.reshape(out_shape))),
+        Tensor(jnp.asarray(idxs.reshape(out_shape))),
+    )
+
+
+@register_op("tensordot")
+def tensordot(x, y, axes=2, name=None):
+    return apply_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), [x, y])
+
+
+@register_op("unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = _ints(kernel_sizes) if not isinstance(kernel_sizes, int) else (kernel_sizes, kernel_sizes)
+    st = _ints(strides) if not isinstance(strides, int) else (strides, strides)
+    pd = _ints(paddings) if not isinstance(paddings, int) else (paddings, paddings)
+    dl = _ints(dilations) if not isinstance(dilations, int) else (dilations, dilations)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+        patches = jax.lax.conv_general_dilated_patches(
+            v, ks, st, "VALID", rhs_dilation=dl, dimension_numbers=("NCHW", "OIHW", "NCHW")
+        )
+        l = patches.shape[2] * patches.shape[3]
+        return patches.reshape(n, -1, l)
+
+    return apply_op("unfold", fn, [x])
+
+
+@register_op("pad", tensor_method=None)
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad semantics: `pad` is [lo,hi] pairs from last dim backwards
+    when len(pad)==2*ndim is False; full numpy spec when list of pairs."""
+    p = _ints(pad) if not isinstance(pad, int) else (pad,)
+
+    def fn(v):
+        nd = v.ndim
+        pairs = [(p[2 * i], p[2 * i + 1]) for i in range(len(p) // 2)]
+        if len(p) == 2 * nd:
+            cfg = pairs  # full spec pads dim 0 → dim N-1 (paddle constant-mode form)
+        else:
+            # short spec: pairs pad spatial dims, first pair = innermost spatial dim
+            cfg = [(0, 0)] * nd
+            spatial = list(range(1, nd - 1)) if data_format[-1] == "C" else list(range(2, nd))
+            for pair, d in zip(pairs, reversed(spatial)):
+                cfg[d] = pair
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, cfg, mode="constant", constant_values=value)
+        return jnp.pad(v, cfg, mode=jmode)
+
+    return apply_op("pad", fn, [x])

@@ -1,0 +1,375 @@
+"""Elementwise math + reductions (reference: paddle/phi/kernels elementwise/reduce
+families; python surface python/paddle/tensor/math.py ~7k LoC).
+
+Every op is a pure jnp composition dispatched through the eager tape; XLA fuses
+the elementwise chains (the role CINN/KPS played for the reference)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, apply_op, _unwrap
+from .registry import register_op
+
+_module = __import__(__name__)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = np.asarray(axis._value)
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(_unwrap(a)) for a in axis)
+    return int(_unwrap(axis))
+
+
+def _unary(name, jfn, method=None, aliases=()):
+    def op(x, name=None):
+        return apply_op(name or op.__name__, jfn, [x])
+
+    op.__name__ = name
+    op.__qualname__ = name
+    register_op(name, tensor_method=method or name, aliases=aliases)(op)
+    globals()[name] = op
+    return op
+
+
+def _binary(name, jfn, method=None, aliases=()):
+    def op(x, y, name=None):
+        return apply_op(name or op.__name__, jfn, [x, y])
+
+    op.__name__ = name
+    op.__qualname__ = name
+    register_op(name, tensor_method=method or name, aliases=aliases)(op)
+    globals()[name] = op
+    return op
+
+
+# ---- unary ----
+_unary("exp", jnp.exp)
+_unary("expm1", jnp.expm1)
+_unary("log", jnp.log)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("log1p", jnp.log1p)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda v: jax.lax.rsqrt(v))
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("neg", jnp.negative)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("asin", jnp.arcsin)
+_unary("acos", jnp.arccos)
+_unary("atan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("asinh", jnp.arcsinh)
+_unary("acosh", jnp.arccosh)
+_unary("atanh", jnp.arctanh)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("round", jnp.round)
+_unary("trunc", jnp.trunc)
+_unary("square", jnp.square)
+_unary("reciprocal", jnp.reciprocal)
+_unary("erf", jax.scipy.special.erf)
+_unary("erfinv", jax.scipy.special.erfinv)
+_unary("lgamma", jax.scipy.special.gammaln)
+_unary("digamma", jax.scipy.special.digamma)
+_unary("i0", lambda v: jax.scipy.special.i0(v))
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("logit", jax.scipy.special.logit)
+_unary("isfinite", jnp.isfinite)
+_unary("isinf", jnp.isinf)
+_unary("isnan", jnp.isnan)
+_unary("logical_not", jnp.logical_not)
+_unary("bitwise_not", jnp.bitwise_not)
+_unary("conj", jnp.conj)
+_unary("real", jnp.real)
+_unary("imag", jnp.imag)
+_unary("angle", jnp.angle)
+_unary("frac", lambda v: v - jnp.trunc(v))
+_unary("deg2rad", jnp.deg2rad)
+_unary("rad2deg", jnp.rad2deg)
+
+# ---- binary ----
+_binary("add", jnp.add)
+_binary("subtract", jnp.subtract, aliases=("sub",))
+_binary("multiply", jnp.multiply, aliases=("mul",))
+_binary("divide", jnp.divide, aliases=("div",))
+_binary("floor_divide", jnp.floor_divide)
+_binary("remainder", jnp.remainder, aliases=("mod", "floor_mod"))
+_binary("pow", jnp.power, aliases=("power",))
+_binary("maximum", jnp.maximum)
+_binary("minimum", jnp.minimum)
+_binary("fmax", jnp.fmax)
+_binary("fmin", jnp.fmin)
+_binary("atan2", jnp.arctan2)
+_binary("logical_and", jnp.logical_and)
+_binary("logical_or", jnp.logical_or)
+_binary("logical_xor", jnp.logical_xor)
+_binary("bitwise_and", jnp.bitwise_and)
+_binary("bitwise_or", jnp.bitwise_or)
+_binary("bitwise_xor", jnp.bitwise_xor)
+_binary("equal", jnp.equal)
+_binary("not_equal", jnp.not_equal)
+_binary("greater_than", jnp.greater)
+_binary("greater_equal", jnp.greater_equal)
+_binary("less_than", jnp.less)
+_binary("less_equal", jnp.less_equal)
+_binary("gcd", jnp.gcd)
+_binary("lcm", jnp.lcm)
+_binary("hypot", jnp.hypot)
+_binary("copysign", jnp.copysign)
+_binary("nextafter", jnp.nextafter)
+_binary("heaviside", jnp.heaviside)
+_binary("logaddexp", jnp.logaddexp)
+_binary("inner", jnp.inner)
+_binary("outer", lambda a, b: jnp.outer(a, b))
+_binary("kron", jnp.kron)
+_binary("dot", lambda a, b: jnp.sum(a * b, axis=-1) if a.ndim > 1 else jnp.dot(a, b))
+
+
+@register_op("scale", tensor_method="scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def fn(v, s, b):
+        out = v * jnp.asarray(s, v.dtype) + jnp.asarray(b, v.dtype) if bias_after_scale else (
+            v + jnp.asarray(b, v.dtype)
+        ) * jnp.asarray(s, v.dtype)
+        return out
+
+    return apply_op("scale", fn, [x, scale, bias])
+
+
+@register_op("clip", tensor_method="clip")
+def clip(x, min=None, max=None, name=None):
+    lo = _unwrap(min) if min is not None else None
+    hi = _unwrap(max) if max is not None else None
+    return apply_op("clip", lambda v: jnp.clip(v, lo, hi), [x])
+
+
+@register_op("lerp", tensor_method="lerp")
+def lerp(x, y, weight, name=None):
+    return apply_op("lerp", lambda a, b, w: a + w * (b - a), [x, y, weight])
+
+
+@register_op("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), [x])
+
+
+@register_op("multiplex")
+def multiplex(inputs, index, name=None):
+    def fn(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)  # [k, batch, ...]
+        return stacked[idx.reshape(-1), jnp.arange(xs[0].shape[0])]
+
+    return apply_op("multiplex", fn, [index] + list(inputs))
+
+
+@register_op("increment")
+def increment(x, value=1.0, name=None):
+    src = x._snapshot() if isinstance(x, Tensor) else x
+    out = apply_op("increment", lambda v: v + jnp.asarray(value, v.dtype), [src])
+    x._value = out._value
+    x._node = out._node
+    x._out_idx = out._out_idx
+    return x
+
+
+@register_op("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(
+        "addmm", lambda i, a, b: beta * i + alpha * (a @ b), [input, x, y]
+    )
+
+
+@register_op("trace", tensor_method="trace")
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(
+        "trace", lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), [x]
+    )
+
+
+@register_op("cross")
+def cross(x, y, axis=-1, name=None):
+    return apply_op("cross", lambda a, b: jnp.cross(a, b, axis=axis), [x, y])
+
+
+@register_op("diff")
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    inputs = [x]
+    has_pre = prepend is not None
+    has_app = append is not None
+    if has_pre:
+        inputs.append(prepend)
+    if has_app:
+        inputs.append(append)
+
+    def fn(v, *extra):
+        i = 0
+        pre = extra[i] if has_pre else None
+        i += int(has_pre)
+        app = extra[i] if has_app else None
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+
+    return apply_op("diff", fn, inputs)
+
+
+# ---- reductions ----
+
+
+def _reduce(op_name, jfn, method=None, int_out=False):
+    def op(x, axis=None, keepdim=False, name=None):
+        ax = _axis(axis)
+        return apply_op(op_name, lambda v: jfn(v, axis=ax, keepdims=keepdim), [x])
+
+    name = op_name
+
+    op.__name__ = name
+    register_op(name, tensor_method=method or name)(op)
+    globals()[name] = op
+    return op
+
+
+_reduce("sum", lambda v, axis, keepdims: jnp.sum(v, axis=axis, keepdims=keepdims))
+_reduce("mean", lambda v, axis, keepdims: jnp.mean(v, axis=axis, keepdims=keepdims))
+_reduce("prod", lambda v, axis, keepdims: jnp.prod(v, axis=axis, keepdims=keepdims))
+_reduce("max", lambda v, axis, keepdims: jnp.max(v, axis=axis, keepdims=keepdims), method="max")
+_reduce("min", lambda v, axis, keepdims: jnp.min(v, axis=axis, keepdims=keepdims), method="min")
+_reduce("amax", lambda v, axis, keepdims: jnp.max(v, axis=axis, keepdims=keepdims))
+_reduce("amin", lambda v, axis, keepdims: jnp.min(v, axis=axis, keepdims=keepdims))
+_reduce("any", lambda v, axis, keepdims: jnp.any(v, axis=axis, keepdims=keepdims))
+_reduce("all", lambda v, axis, keepdims: jnp.all(v, axis=axis, keepdims=keepdims))
+_reduce("logsumexp", lambda v, axis, keepdims: jax.scipy.special.logsumexp(v, axis=axis, keepdims=keepdims))
+_reduce("nansum", lambda v, axis, keepdims: jnp.nansum(v, axis=axis, keepdims=keepdims))
+_reduce("nanmean", lambda v, axis, keepdims: jnp.nanmean(v, axis=axis, keepdims=keepdims))
+
+
+@register_op("std", tensor_method="std")
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op(
+        "std", lambda v: jnp.std(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), [x]
+    )
+
+
+@register_op("var", tensor_method="var")
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op(
+        "var", lambda v: jnp.var(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), [x]
+    )
+
+
+@register_op("median", tensor_method="median")
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+    return apply_op("median", lambda v: jnp.median(v, axis=ax, keepdims=keepdim), [x])
+
+
+@register_op("quantile")
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op(
+        "quantile", lambda v: jnp.quantile(v, jnp.asarray(q), axis=ax, keepdims=keepdim), [x]
+    )
+
+
+@register_op("count_nonzero")
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return Tensor(jnp.count_nonzero(_unwrap(x), axis=ax, keepdims=keepdim).astype(jnp.int64))
+
+
+@register_op("cumsum", tensor_method="cumsum")
+def cumsum(x, axis=None, dtype=None, name=None):
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v, dtype=dtypes.convert_dtype(dtype) if dtype else None)
+        return jnp.cumsum(v, axis=_axis(axis), dtype=dtypes.convert_dtype(dtype) if dtype else None)
+
+    return apply_op("cumsum", fn, [x])
+
+
+@register_op("cumprod", tensor_method="cumprod")
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply_op(
+        "cumprod",
+        lambda v: jnp.cumprod(v, axis=_axis(dim), dtype=dtypes.convert_dtype(dtype) if dtype else None),
+        [x],
+    )
+
+
+@register_op("cummax")
+def cummax(x, axis=None, dtype="int64", name=None):
+    def fn(v):
+        if axis is None:
+            vv = v.reshape(-1)
+            return jax.lax.cummax(vv, axis=0)
+        return jax.lax.cummax(v, axis=_axis(axis))
+
+    values = apply_op("cummax", fn, [x])
+    return values
+
+
+@register_op("logcumsumexp")
+def logcumsumexp(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = _axis(axis)
+        return jax.lax.associative_scan(jnp.logaddexp, v, axis=ax)
+
+    return apply_op("logcumsumexp", fn, [x])
+
+
+# ---- comparison convenience ----
+
+
+@register_op("allclose", tensor_method="allclose")
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(_unwrap(x), _unwrap(y), rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+@register_op("isclose", tensor_method="isclose")
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        [x, y],
+    )
+
+
+@register_op("equal_all")
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(_unwrap(x), _unwrap(y)))
+
+
+@register_op("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(
+        "nan_to_num", lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), [x]
+    )
+
+
+@register_op("einsum")
+def einsum(equation, *operands, name=None):
+    ops_in = list(operands)
+    return apply_op("einsum", lambda *vs: jnp.einsum(equation, *vs), ops_in)
+
+
+@register_op("broadcast_shape")
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

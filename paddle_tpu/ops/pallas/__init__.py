@@ -1,0 +1,27 @@
+"""Pallas TPU kernel library.
+
+The irreducible native-kernel set identified in SURVEY.md §2 ("Native-component
+summary"): flash attention, fused rms_norm, rotary embedding, swiglu, and MoE
+dispatch.  Everything else in the reference's 525k-LoC kernel library lowers
+through XLA.  Each kernel here:
+
+- runs compiled on TPU, and in interpreter mode on CPU (so the OpTest-style
+  suite can check parity against numpy/XLA oracles without hardware);
+- has a jax.custom_vjp so it composes with both the eager tape and jit/grad.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret=True off-TPU so kernels stay testable on CPU CI."""
+    return not on_tpu()

@@ -1,0 +1,204 @@
+"""Flash attention (Pallas TPU kernel).
+
+Replaces the reference's CUDA flash-attn v2/v3 integration
+(`paddle/phi/kernels/gpu/flash_attn_kernel.cu`, dynload
+`paddle/phi/backends/dynload/flashattn.h`) with a TPU-native online-softmax
+kernel: Q/K/V tiles stream HBM→VMEM, logits never materialize in HBM, the MXU
+does the two matmuls per tile and the VPU the online rescale.
+
+Layout: public entry takes BSHD ([batch, seq, heads, head_dim], the paddle
+convention); the kernel runs BHSD grids of (batch*heads, q_blocks, kv_blocks).
+
+Backward: custom_vjp recomputes per-tile probabilities from the saved
+log-sum-exp (standard flash backward recurrence) in plain XLA — numerically
+exact, O(S) memory for residuals.  A full Pallas backward kernel is the next
+optimization step.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU-capable installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+from . import interpret_mode
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, causal, bq, bkv, kv_len):
+    """Grid: (bh, num_q_blocks, num_kv_blocks); kv is innermost (sequential)."""
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    if causal:
+        # whole block is masked out iff last q row < first kv col
+        run = (q_idx + 1) * bq - 1 >= kv_idx * bkv
+    else:
+        run = q_idx >= 0  # always true, as a traced predicate
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)  # [bkv, d]
+        v = v_ref[0].astype(jnp.float32)  # [bkv, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bkv]
+        if causal:
+            rows = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            cols = kv_idx * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_scr[:]  # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # [bq, bkv]
+        alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+        l_new = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(kv_idx == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l_safe)  # [bq, 1]
+
+
+def _flash_fwd(q, k, v, scale, causal):
+    """q,k,v: [bh, s, d] fp32/bf16 → (out [bh, sq, d], lse [bh, sq])."""
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    bq_sz = sq if sq <= 128 else 128
+    bkv_sz = skv if skv <= 128 else 128
+    n_q = pl.cdiv(sq, bq_sz)
+    n_kv = pl.cdiv(skv, bkv_sz)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq_sz, bkv=bkv_sz, kv_len=skv
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq_sz, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bkv_sz, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bkv_sz, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq_sz, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq_sz, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _VMEM((bq_sz, 1), jnp.float32),
+            _VMEM((bq_sz, 1), jnp.float32),
+            _VMEM((bq_sz, d), jnp.float32),
+        ]
+        if _VMEM is not None
+        else [],
+        interpret=interpret_mode(),
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_bhsd(q, k, v, scale, causal):
+    out, _ = _flash_fwd(q, k, v, scale, causal)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal):
+    out, lse = _flash_fwd(q, k, v, scale, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(scale, causal, res, do):
+    q, k, v, out, lse = res
+    qf, kf, vf, of, dof = (t.astype(jnp.float32) for t in (q, k, v, out, do))
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    if causal:
+        sq, skv = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, skv), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse[..., None])  # exact probs from saved lse
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+    delta = jnp.sum(of * dof, axis=-1, keepdims=True)  # [b, q, 1]
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention_bhsd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention_bshd(q, k, v, attn_mask=None, causal=False, scale=None):
+    """Public entry: q,k,v [batch, seq, heads, head_dim] (paddle layout).
+
+    GQA/MQA: if kv heads < q heads, kv is broadcast per group.  A non-None
+    additive/bool attn_mask falls back to the XLA-composed path (masked flash
+    is a follow-up kernel)."""
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    skv = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    tileable = (sq <= 128 and skv <= 128) or (sq % 128 == 0 and skv % 128 == 0)
+    if attn_mask is not None or not tileable or d % 8 != 0:
+        return _composed_attention(q, k, v, attn_mask, causal, scale)
+    if hkv != hq:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # BSHD -> (b*h, s, d)
+    qh = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * hq, -1, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * hq, -1, d)
+    out = _flash_attention_bhsd(qh, kh, vh, scale, causal)
+    return out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+
+
+def _composed_attention(q, k, v, attn_mask, causal, scale):
+    qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    if kh.shape[1] != qh.shape[1]:
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32), kh.astype(jnp.float32)) * scale
+    if causal:
+        m = jnp.tril(jnp.ones((logits.shape[-2], logits.shape[-1]), bool))
+        logits = jnp.where(m, logits, NEG_INF)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, NEG_INF)
+        else:
+            logits = logits + attn_mask.astype(jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
+    return out.astype(q.dtype).transpose(0, 2, 1, 3)

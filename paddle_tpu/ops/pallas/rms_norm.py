@@ -1,0 +1,73 @@
+"""Fused RMSNorm (Pallas TPU kernel).
+
+Reference fused op: python/paddle/incubate/nn/functional/fused_rms_norm.py
+(CUDA kernel phi/kernels/fusion).  One pass over rows in VMEM: mean-of-squares,
+rsqrt, scale — fp32 accumulation regardless of input dtype.
+Backward via custom_vjp in closed form (XLA fuses it into a few kernels).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import interpret_mode
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    o_ref[:] = (x * inv * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _rms_fwd_pallas(x2d, w, eps):
+    rows, d = x2d.shape
+    br = rows if rows <= 256 else 256
+    if rows % br != 0:
+        br = rows  # single block fallback
+    return pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        grid=(pl.cdiv(rows, br),),
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((br, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x2d.dtype),
+        interpret=interpret_mode(),
+    )(x2d, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rms_norm(x, weight, eps=1e-6):
+    """x: [..., d], weight: [d]."""
+    shape = x.shape
+    out = _rms_fwd_pallas(x.reshape(-1, shape[-1]), weight, eps)
+    return out.reshape(shape)
+
+
+def _rms_vjp_fwd(x, weight, eps):
+    return rms_norm(x, weight, eps), (x, weight)
+
+
+def _rms_vjp_bwd(eps, res, g):
+    x, w = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    d = x.shape[-1]
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    xhat = xf * inv
+    gw = gf * wf
+    # d/dx [x * inv]: inv * (gw - xhat * mean(gw * xhat))
+    dx = inv * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
+    dw = jnp.sum(gf * xhat, axis=tuple(range(x.ndim - 1)))
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+rms_norm.defvjp(_rms_vjp_fwd, _rms_vjp_bwd)

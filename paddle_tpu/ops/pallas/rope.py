@@ -1,0 +1,62 @@
+"""Fused rotary position embedding (reference fused op:
+python/paddle/incubate/nn/functional/fused_rotary_position_embedding.py,
+fused_ops.yaml:428).
+
+The rotate-half formulation used by Llama-family models.  This op is pure
+elementwise-on-pairs — XLA fuses it perfectly into neighboring matmuls, so the
+"kernel" is jnp (documented mapping per SURVEY.md §7: don't hand-write what XLA
+already fuses); the Pallas escape hatch stays available for a fused
+rope+attention prologue later."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(seq_len, head_dim, base=10000.0, position_ids=None, dtype=jnp.float32):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    pos = (
+        jnp.arange(seq_len, dtype=jnp.float32)[None, :]
+        if position_ids is None
+        else position_ids.astype(jnp.float32)
+    )
+    freqs = jnp.einsum("bs,d->bsd", pos, inv_freq)  # [b, s, d/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary_pos_emb(q, k, cos, sin):
+    """q,k: [b, s, h, d]; cos,sin: [b_or_1, s, d] → broadcast over heads."""
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    q2 = q * c + _rotate_half(q) * s
+    k2 = k * c + _rotate_half(k) * s
+    return q2.astype(q.dtype), k2.astype(k.dtype)
+
+
+def fused_rotary_position_embedding(
+    q, k=None, v=None, sin=None, cos=None, position_ids=None, use_neox_rotary_style=True
+):
+    """Paddle-compatible entry (v passes through untouched)."""
+    b, s, h, d = q.shape
+    if cos is None or sin is None:
+        cos, sin = rope_cos_sin(s, d, position_ids=position_ids, dtype=q.dtype)
+    else:
+        cos = cos.reshape(cos.shape[0] if cos.ndim > 2 else 1, -1, d)
+        sin = sin.reshape(sin.shape[0] if sin.ndim > 2 else 1, -1, d)
+    outs = []
+    c = cos[:, :, None, :]
+    sn = sin[:, :, None, :]
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+        elif t is v:
+            outs.append(t)
+        else:
+            outs.append((t * c + _rotate_half(t) * sn).astype(t.dtype))
+    return tuple(outs)

@@ -1,0 +1,34 @@
+"""Fused SwiGLU (reference fused op: python/paddle/incubate/nn/functional/swiglu.py).
+
+silu(x) * y with fp32 inner math; elementwise — XLA fuses it into the
+surrounding matmuls (mapping documented per SURVEY.md §7), custom_vjp keeps the
+backward a single fused expression instead of the chain-rule graph."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.custom_vjp
+def swiglu(x, y):
+    xf = x.astype(jnp.float32)
+    return (jax.nn.silu(xf) * y.astype(jnp.float32)).astype(x.dtype)
+
+
+def _fwd(x, y):
+    return swiglu(x, y), (x, y)
+
+
+def _bwd(res, g):
+    x, y = res
+    xf, yf, gf = x.astype(jnp.float32), y.astype(jnp.float32), g.astype(jnp.float32)
+    sig = jax.nn.sigmoid(xf)
+    silu = xf * sig
+    dsilu = sig * (1 + xf * (1 - sig))
+    return ((gf * yf * dsilu).astype(x.dtype), (gf * silu).astype(y.dtype))
+
+
+swiglu.defvjp(_fwd, _bwd)

@@ -1,0 +1,55 @@
+"""Op registry.
+
+TPU-native analog of the reference's single-source-of-truth op registry
+(`paddle/phi/ops/yaml/ops.yaml` + the api/pybind/AD code generators).  There is
+no codegen step: XLA is the kernel library and ``jax.vjp`` is the backward
+generator, so an "op" here is just a Python wrapper over a pure jnp function
+dispatched through the eager tape (:func:`paddle_tpu.core.tensor.apply_op`).
+The registry keeps the same queryable structure (name → definition) that the
+reference's KernelFactory offers, and drives Tensor-method installation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+OPS: dict[str, "OpDef"] = {}
+
+
+@dataclass
+class OpDef:
+    name: str
+    fn: Callable  # the public python-level wrapper
+    tensor_method: str | None = None
+    aliases: tuple = field(default_factory=tuple)
+
+
+def register_op(name: str, tensor_method: str | bool | None = None, aliases=()):
+    """Decorator: register a public op wrapper under ``name``.
+
+    ``tensor_method``: install on Tensor as a method (True → same name).
+    """
+
+    def deco(fn):
+        method = name if tensor_method is True else tensor_method
+        OPS[name] = OpDef(name, fn, method, tuple(aliases))
+        for a in aliases:
+            OPS[a] = OPS[name]
+        return fn
+
+    return deco
+
+
+def install_tensor_methods(tensor_cls) -> None:
+    seen = set()
+    for od in OPS.values():
+        if id(od) in seen:
+            continue
+        seen.add(id(od))
+        if od.tensor_method and not hasattr(tensor_cls, od.tensor_method):
+            setattr(tensor_cls, od.tensor_method, od.fn)
+
+
+def op_names() -> list[str]:
+    return sorted(OPS)

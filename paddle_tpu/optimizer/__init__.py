@@ -1,0 +1,456 @@
+"""Optimizers (reference: python/paddle/optimizer/ — SGD/Momentum/Adam/AdamW/Lamb
++ fused multi-tensor paths in phi/kernels/fusion).
+
+Design: each optimizer's math is a *pure function* over arrays
+(``p, g, state -> p', state'``).  Eager ``opt.step()`` applies it per parameter;
+the jit/pjit training path reuses exactly the same function over the whole
+parameter pytree (the fused multi-tensor kernel of the reference is subsumed by
+XLA fusing the pytree-wide update into one kernel)."""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor, _unwrap, no_grad
+from ..nn.clip import ClipGradBase
+from . import lr as lr_mod
+from .lr import LRScheduler
+
+__all__ = [
+    "Optimizer",
+    "SGD",
+    "Momentum",
+    "Adagrad",
+    "Adadelta",
+    "RMSProp",
+    "Adam",
+    "AdamW",
+    "Adamax",
+    "NAdam",
+    "RAdam",
+    "Lamb",
+    "lr",
+]
+lr = lr_mod
+
+
+class Optimizer:
+    """Base optimizer (reference: python/paddle/optimizer/optimizer.py)."""
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        parameters=None,
+        weight_decay=None,
+        grad_clip=None,
+        multi_precision=False,
+        name=None,
+    ):
+        self._lr = learning_rate
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        self._weight_decay = self._parse_wd(weight_decay)
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: dict[int, dict] = {}
+        self._master_weights: dict[int, jnp.ndarray] = {}
+        self._step_count = 0
+
+    @staticmethod
+    def _parse_wd(weight_decay):
+        if weight_decay is None:
+            return 0.0
+        if isinstance(weight_decay, (int, float)):
+            return float(weight_decay)
+        # regularizer object with _coeff (L2Decay)
+        return float(getattr(weight_decay, "_coeff", getattr(weight_decay, "coeff", 0.0)))
+
+    # ---- lr ----
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        self._lr = float(value)
+
+    # ---- state ----
+    def _state_names(self) -> list[str]:
+        return []
+
+    def _init_param_state(self, p) -> dict:
+        return {name: jnp.zeros(p.shape, jnp.float32) for name in self._state_names()}
+
+    def _get_state(self, p) -> dict:
+        key = id(p)
+        if key not in self._accumulators:
+            self._accumulators[key] = self._init_param_state(p)
+            if self._multi_precision and p.dtype != np.float32:
+                self._master_weights[key] = _unwrap(p).astype(jnp.float32)
+        return self._accumulators[key]
+
+    # ---- the pure update rule: override in subclasses ----
+    def _update(self, p, g, state: dict, lr: float, step: int):
+        """p, g are float32 arrays; returns (new_p, new_state)."""
+        raise NotImplementedError
+
+    # functional entry for the jit path: same math over a pytree
+    def init_state_pytree(self, params):
+        names = self._state_names()
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "acc": jax.tree_util.tree_map(
+                lambda p: {n: jnp.zeros(jnp.shape(p), jnp.float32) for n in names}, params
+            ),
+        }
+
+    def apply_gradients_pytree(self, params, grads, opt_state, lr=None):
+        lr_val = self.get_lr() if lr is None else lr
+        step = opt_state["step"] + 1
+
+        def upd(p, g, st):
+            if g is None:
+                return p, st
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32)
+            new_p, new_st = self._update(p32, g32, st, lr_val, step)
+            return new_p.astype(p.dtype), new_st
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_flatten(grads)[0]
+        flat_s = treedef.flatten_up_to(opt_state["acc"])
+        new_p, new_s = [], []
+        for p, g, st in zip(flat_p, flat_g, flat_s):
+            np_, ns_ = upd(p, g, st)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (
+            jax.tree_util.tree_unflatten(treedef, new_p),
+            {"step": step, "acc": jax.tree_util.tree_unflatten(treedef, new_s)},
+        )
+
+    # ---- eager step ----
+    @no_grad()
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer constructed without parameters")
+        params_grads = [
+            (p, Tensor(p._grad)) for p in params if p._grad is not None and p.trainable
+        ]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        lr_val = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            st = self._get_state(p)
+            key = id(p)
+            if key in self._master_weights:
+                p32 = self._master_weights[key]
+            else:
+                p32 = _unwrap(p).astype(jnp.float32)
+            g32 = _unwrap(g).astype(jnp.float32)
+            self._current_param_name = p.name
+            new_p, new_st = self._update(p32, g32, st, lr_val, self._step_count)
+            self._accumulators[key] = new_st
+            if key in self._master_weights:
+                self._master_weights[key] = new_p
+            p._value = new_p.astype(p.dtype)
+
+    _current_param_name = None
+
+    def clear_grad(self, set_to_zero=True):
+        if self._parameter_list is not None:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # ---- checkpointing ----
+    def state_dict(self) -> dict:
+        out = {"step": self._step_count, "accumulators": {}, "master_weights": {}}
+        if self._parameter_list is not None:
+            for i, p in enumerate(self._parameter_list):
+                key = id(p)
+                name = p.name or f"param_{i}"
+                if key in self._accumulators:
+                    out["accumulators"][name] = {
+                        k: np.asarray(v) for k, v in self._accumulators[key].items()
+                    }
+                if key in self._master_weights:
+                    out["master_weights"][name] = np.asarray(self._master_weights[key])
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state: dict):
+        self._step_count = int(state.get("step", 0))
+        accs = state.get("accumulators", {})
+        masters = state.get("master_weights", {})
+        if self._parameter_list is not None:
+            for i, p in enumerate(self._parameter_list):
+                name = p.name or f"param_{i}"
+                if name in accs:
+                    self._accumulators[id(p)] = {
+                        k: jnp.asarray(v) for k, v in accs[name].items()
+                    }
+                if name in masters:
+                    self._master_weights[id(p)] = jnp.asarray(masters[name])
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+
+
+class SGD(Optimizer):
+    def _update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _state_names(self):
+        return ["velocity"]
+
+    def _update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            p = p - lr * (g + self._momentum * v)
+        else:
+            p = p - lr * v
+        return p, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _state_names(self):
+        return ["moment"]
+
+    def _init_param_state(self, p):
+        return {"moment": jnp.full(p.shape, self._init_acc, jnp.float32)}
+
+    def _update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        m = state["moment"] + g * g
+        p = p - lr * g / (jnp.sqrt(m) + self._epsilon)
+        return p, {"moment": m}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _state_names(self):
+        return ["avg_squared_grad", "avg_squared_update"]
+
+    def _update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        eg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
+        dx = jnp.sqrt(state["avg_squared_update"] + self._epsilon) / jnp.sqrt(eg + self._epsilon) * g
+        eu = self._rho * state["avg_squared_update"] + (1 - self._rho) * dx * dx
+        return p - lr * dx, {"avg_squared_grad": eg, "avg_squared_update": eu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _state_names(self):
+        return ["mean_square", "mean_grad", "momentum"]
+
+    def _update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        return p - mom, {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _state_names(self):
+        return ["moment1", "moment2"] + (["moment2_max"] if self._amsgrad else [])
+
+    def _update(self, p, g, state, lr, step):
+        if self._weight_decay:  # coupled L2 (paddle Adam semantics)
+            g = g + self._weight_decay * p
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        mhat = m / (1 - b1**step)
+        if self._amsgrad:
+            vmax = jnp.maximum(state["moment2_max"], v)
+            vhat = vmax / (1 - b2**step)
+            new_state = {"moment1": m, "moment2": v, "moment2_max": vmax}
+        else:
+            vhat = v / (1 - b2**step)
+            new_state = {"moment1": m, "moment2": v}
+        p = p - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return p, new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py;
+    fused kernel phi/kernels/fusion/fused_adam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None, lazy_mode=False, multi_precision=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, lazy_mode, multi_precision, amsgrad=amsgrad, name=name)
+        self._wd = float(weight_decay) if not hasattr(weight_decay, "_coeff") else float(weight_decay._coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+        self._current_param_name = None
+
+    def _update(self, p, g, state, lr, step):
+        decay = self._wd
+        if self._apply_decay_param_fun is not None and self._current_param_name is not None:
+            if not self._apply_decay_param_fun(self._current_param_name):
+                decay = 0.0
+        b1, b2 = self._beta1, self._beta2
+        p = p * (1 - lr * decay)
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        mhat = m / (1 - b1**step)
+        if self._amsgrad:
+            vmax = jnp.maximum(state["moment2_max"], v)
+            vhat = vmax / (1 - b2**step)
+            new_state = {"moment1": m, "moment2": v, "moment2_max": vmax}
+        else:
+            vhat = v / (1 - b2**step)
+            new_state = {"moment1": m, "moment2": v}
+        p = p - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return p, new_state
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _state_names(self):
+        return ["moment", "inf_norm"]
+
+    def _update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        p = p - lr / (1 - self._beta1**step) * m / (u + self._epsilon)
+        return p, {"moment": m, "inf_norm": u}
+
+
+class NAdam(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-8, momentum_decay=0.004, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._momentum_decay = momentum_decay
+
+    def _state_names(self):
+        return ["moment1", "moment2"]
+
+    def _update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        b1, b2 = self._beta1, self._beta2
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (step * self._momentum_decay))
+        mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((step + 1) * self._momentum_decay))
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        mhat = mu_t1 * m / (1 - b1 ** (step + 1)) + (1 - mu_t) * g / (1 - b1**step)
+        vhat = v / (1 - b2**step)
+        p = p - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return p, {"moment1": m, "moment2": v}
+
+
+class RAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _state_names(self):
+        return ["moment1", "moment2"]
+
+    def _update(self, p, g, state, lr, step):
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        mhat = m / (1 - b1**step)
+        rho_inf = 2 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * step * (b2**step) / (1 - b2**step)
+        if rho_t > 5:
+            l_t = jnp.sqrt((1 - b2**step)) / (jnp.sqrt(v) + self._epsilon)
+            r_t = math.sqrt(
+                ((rho_t - 4) * (rho_t - 2) * rho_inf) / ((rho_inf - 4) * (rho_inf - 2) * rho_t)
+            )
+            p = p - lr * r_t * mhat * l_t
+        else:
+            p = p - lr * mhat
+        return p, {"moment1": m, "moment2": v}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _state_names(self):
+        return ["moment1", "moment2"]
+
+    def _update(self, p, g, state, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        mhat = m / (1 - b1**step)
+        vhat = v / (1 - b2**step)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._lamb_wd * p
+        w_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m, "moment2": v}

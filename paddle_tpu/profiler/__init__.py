@@ -1,0 +1,229 @@
+"""Profiler (reference: python/paddle/profiler/profiler.py:358 + C++ host/CUPTI
+tracers merged into chrome://tracing JSON, chrometracing_logger.h:32).
+
+TPU-native realization (SURVEY.md §5): device-side tracing is jax.profiler
+(XPlane → TensorBoard/Perfetto); this module keeps the reference's *API surface*
+— ``RecordEvent`` spans, a ``Profiler`` with scheduler states, and chrome-trace
+JSON export of the host-side spans."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from enum import Enum
+
+import jax
+
+__all__ = [
+    "Profiler",
+    "RecordEvent",
+    "ProfilerState",
+    "ProfilerTarget",
+    "make_scheduler",
+    "export_chrome_tracing",
+    "load_profiler_result",
+]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+_events_lock = threading.Lock()
+_events: list[dict] = []
+_recording = threading.local()
+
+
+def _now_us():
+    return time.perf_counter_ns() / 1000.0
+
+
+class RecordEvent:
+    """Span marker (reference: paddle.profiler.RecordEvent ≙ C++ RecordEvent,
+    platform/profiler/host_tracer.cc).  Also forwards to jax.profiler traces so
+    spans show up inside XPlane timelines."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._jax_ctx = None
+
+    def begin(self):
+        self._t0 = _now_us()
+        try:
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        except Exception:
+            self._jax_ctx = None
+
+    def end(self):
+        if self._t0 is None:
+            return
+        t1 = _now_us()
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+        with _events_lock:
+            _events.append(
+                {
+                    "name": self.name,
+                    "ph": "X",
+                    "ts": self._t0,
+                    "dur": t1 - self._t0,
+                    "pid": os.getpid(),
+                    "tid": threading.get_ident() % 100000,
+                    "cat": "host",
+                }
+            )
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0, skip_first: int = 0):
+    """Mirror of paddle.profiler.make_scheduler (scheduler states profiler.py:89)."""
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        period = closed + ready + record
+        if repeat and s >= period * repeat:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        fname = f"{worker_name or 'worker'}_{os.getpid()}.json"
+        prof.export(os.path.join(dir_name, fname))
+
+    return handler
+
+
+class Profiler:
+    def __init__(
+        self,
+        *,
+        targets=None,
+        scheduler=None,
+        on_trace_ready=None,
+        record_shapes=False,
+        profile_memory=False,
+        with_flops=False,
+        timer_only=False,
+    ):
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.step_num = 0
+        self.state = ProfilerState.CLOSED
+        self._jax_dir = None
+        self._started = False
+
+    def start(self):
+        self._update_state()
+        if self.state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._start_device_trace()
+
+    def _start_device_trace(self):
+        if not self._started:
+            self._jax_dir = os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+            try:
+                jax.profiler.start_trace(self._jax_dir)
+                self._started = True
+            except Exception:
+                self._started = False
+
+    def _stop_device_trace(self):
+        if self._started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._started = False
+
+    def _update_state(self):
+        if self.scheduler is None:
+            self.state = ProfilerState.RECORD
+        else:
+            self.state = (
+                self.scheduler(self.step_num)
+                if callable(self.scheduler)
+                else ProfilerState.RECORD
+            )
+
+    def step(self, num_samples=None):
+        self.step_num += 1
+        prev = self.state
+        self._update_state()
+        if prev != ProfilerState.RECORD and self.state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._start_device_trace()
+        if prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) and self.state == ProfilerState.CLOSED:
+            self._stop_device_trace()
+        if prev == ProfilerState.RECORD_AND_RETURN and self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def stop(self):
+        self._stop_device_trace()
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def export(self, path: str, format: str = "json"):
+        with _events_lock:
+            events = list(_events)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        with _events_lock:
+            events = list(_events)
+        agg: dict[str, list[float]] = {}
+        for e in events:
+            agg.setdefault(e["name"], []).append(e["dur"])
+        lines = [f"{'name':<50} {'calls':>8} {'total(ms)':>12} {'avg(ms)':>12}"]
+        for name, durs in sorted(agg.items(), key=lambda kv: -sum(kv[1])):
+            lines.append(
+                f"{name[:50]:<50} {len(durs):>8} {sum(durs)/1000:>12.3f} {sum(durs)/len(durs)/1000:>12.3f}"
+            )
+        return "\n".join(lines)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def load_profiler_result(filename: str):
+    with open(filename) as f:
+        return json.load(f)

@@ -1,0 +1,79 @@
+"""Static-graph API shims (reference: python/paddle/static/).
+
+The reference's Program/Executor machinery (PIR + StandaloneExecutor,
+standalone_executor.cc:171) is subsumed by jax.jit tracing + the XLA compile
+cache (SURVEY.md §7 mapping: "PIR + pd_op_to_kernel + PirInterpreter →
+StableHLO module + pjit compile cache").  These shims keep script-level API
+compatibility: InputSpec for to_static signatures, and no-op Program scopes."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import dtype as dtypes
+
+__all__ = ["InputSpec", "Program", "program_guard", "default_main_program", "default_startup_program", "name_scope"]
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = tuple(shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, ndarray.dtype, name)
+
+
+class Program:
+    def __init__(self):
+        self._ops = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return Program()
+
+
+_main = Program()
+_startup = Program()
+
+
+def default_main_program():
+    return _main
+
+
+def default_startup_program():
+    return _startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

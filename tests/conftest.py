@@ -1,0 +1,32 @@
+"""Test bootstrap: force CPU backend with 8 virtual devices (mirrors the
+reference's gloo-on-CPU multi-process CI substitution,
+test_parallel_dygraph_dataparallel.py:67 — see SURVEY.md §4.2).
+
+Note: the environment's sitecustomize pins jax_platforms to the TPU plugin, so
+the env var alone is not enough — we override the config after importing jax,
+before any backend is initialized."""
+
+import os
+import warnings
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+warnings.filterwarnings("ignore", message=".*dtype int64 requested.*")
+warnings.filterwarnings("ignore", message=".*Platform 'axon'.*")
+
+# exact f32 matmuls for numpy-oracle comparisons (the perf path uses bf16 anyway)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    assert jax.device_count() == 8
+    return jax.devices()

@@ -1,0 +1,87 @@
+"""OpTest harness (mirror of the reference's test/legacy_test/op_test.py:418):
+numpy-oracle forward check + numeric-vs-analytic gradient check per op.
+
+check_output: run the paddle_tpu op eagerly AND under jit, compare both to the
+numpy oracle (the reference compares eager and static paths the same way,
+op_test.py:2143).
+check_grad: analytic grads from the eager tape vs central-difference numeric
+grads (op_test.py:3075)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor, _unwrap
+
+
+def check_output(op_fn, np_fn, inputs, atol=1e-5, rtol=1e-5, kwargs=None, jit_check=True):
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(np.asarray(a)) for a in inputs]
+    out = op_fn(*tensors, **kwargs)
+    expect = np_fn(*[np.asarray(a) for a in inputs])
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    expects = expect if isinstance(expect, (tuple, list)) else [expect]
+    for o, e in zip(outs, expects):
+        np.testing.assert_allclose(o.numpy(), e, atol=atol, rtol=rtol, err_msg="eager mismatch")
+    if jit_check:
+        import jax
+
+        jitted = jax.jit(lambda *vs: [_unwrap(t) for t in _aslist(op_fn(*[Tensor(v) for v in vs], **kwargs))])
+        jouts = jitted(*[np.asarray(a) for a in inputs])
+        for o, e in zip(jouts, expects):
+            np.testing.assert_allclose(np.asarray(o), e, atol=atol, rtol=rtol, err_msg="jit mismatch")
+
+
+def _aslist(x):
+    return list(x) if isinstance(x, (tuple, list)) else [x]
+
+
+def check_grad(op_fn, inputs, grad_inputs=None, eps=1e-3, atol=1e-3, rtol=1e-2, kwargs=None, output_index=0):
+    """Central-difference numeric grad vs tape grad for float64 stability."""
+    kwargs = kwargs or {}
+    arrays = [
+        np.asarray(a, np.float64) if np.issubdtype(np.asarray(a).dtype, np.floating) else np.asarray(a)
+        for a in inputs
+    ]
+    grad_idx = (
+        [i for i, a in enumerate(arrays) if np.issubdtype(a.dtype, np.floating)]
+        if grad_inputs is None
+        else grad_inputs
+    )
+
+    def scalar_out(*arrs):
+        ts = [paddle.to_tensor(a.astype(np.float32)) for a in arrs]
+        out = op_fn(*ts, **kwargs)
+        out = _aslist(out)[output_index]
+        return out
+
+    def _cast(a, f32=True):
+        if np.issubdtype(a.dtype, np.floating):
+            return a.astype(np.float32) if f32 else a
+        return a
+
+    # analytic
+    tensors = [paddle.to_tensor(_cast(a), stop_gradient=(i not in grad_idx)) for i, a in enumerate(arrays)]
+    out = _aslist(op_fn(*tensors, **kwargs))[output_index]
+    loss = out.sum() if out.size > 1 else out
+    loss.backward()
+    analytic = [tensors[i].grad.numpy() if tensors[i].grad is not None else None for i in grad_idx]
+
+    # numeric (float64 central difference through numpy-driven eager calls)
+    for gi, an in zip(grad_idx, analytic):
+        a = arrays[gi]
+        num = np.zeros_like(a)
+        flat = a.reshape(-1)
+        nflat = num.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            with paddle.no_grad():
+                up = float(_aslist(op_fn(*[paddle.to_tensor(_cast(x, f32=False)) for x in arrays], **kwargs))[output_index].sum())
+            flat[j] = orig - eps
+            with paddle.no_grad():
+                down = float(_aslist(op_fn(*[paddle.to_tensor(_cast(x, f32=False)) for x in arrays], **kwargs))[output_index].sum())
+            flat[j] = orig
+            nflat[j] = (up - down) / (2 * eps)
+        np.testing.assert_allclose(an, num, atol=atol, rtol=rtol, err_msg=f"grad mismatch for input {gi}")

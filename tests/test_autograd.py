@@ -1,0 +1,122 @@
+"""Autograd engine tests (mirrors test/legacy_test autograd + PyLayer suites)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_backward_chain_and_accumulation():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * x
+    z = y + x  # x used twice -> grads accumulate
+    z.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.array([1.0, 2, 3]) + 1)
+
+
+def test_backward_twice_raises_and_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward(retain_graph=False)
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])  # accumulated twice
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_no_grad_and_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 3
+    assert y.stop_gradient
+    z = (x * 2).detach()
+    assert z.stop_gradient
+
+
+def test_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = paddle.to_tensor([4.0], stop_gradient=False)
+    z = (x * y).sum()
+    gx, gy = paddle.grad(z, [x, y], retain_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [4.0])
+    np.testing.assert_allclose(gy.numpy(), [3.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_multi_output_op_grad():
+    x = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+    t = paddle.to_tensor(x, stop_gradient=False)
+    vals, idx = paddle.topk(t, 2)
+    vals.sum().backward()
+    g = np.zeros_like(x)
+    top_idx = np.argsort(-x, axis=1)[:, :2]
+    np.put_along_axis(g, top_idx, 1.0, axis=1)
+    np.testing.assert_allclose(t.grad.numpy(), g)
+
+
+def test_hook_and_retain_grads():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    y.retain_grads()
+    y.register_hook(lambda g: g * 10)
+    y.sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [10.0, 10.0])
+    np.testing.assert_allclose(x.grad.numpy(), [20.0, 20.0])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            ctx.save_for_backward(a)
+            return a * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            (a,) = ctx.saved_tensor()
+            return g * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_functional_jacobian_vjp_jvp():
+    def f(a):
+        return (a * a).sum()
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    jac = paddle.autograd.jacobian(f, x)
+    np.testing.assert_allclose(jac.numpy(), [2.0, 4.0])
+    out, g = paddle.autograd.vjp(f, x)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+    out, jv = paddle.autograd.jvp(f, x)
+    np.testing.assert_allclose(jv.numpy(), 6.0)
+    h = paddle.autograd.hessian(f, x)
+    np.testing.assert_allclose(h.numpy(), np.eye(2) * 2)
+
+
+def test_double_backward_via_functional():
+    # higher-order: grad of grad through jax (functional path)
+    def f(a):
+        return (a**3).sum()
+
+    x = paddle.to_tensor([2.0])
+    h = paddle.autograd.hessian(f, x)
+    np.testing.assert_allclose(h.numpy(), [[12.0]])
+
+
+def test_stop_gradient_propagation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([1.0])  # stop_gradient True
+    z = x + y
+    assert not z.stop_gradient
+    w = y * 2
+    assert w.stop_gradient
+
+
+def test_int_tensor_no_grad():
+    x = paddle.to_tensor([1, 2, 3])
+    y = x + 1
+    assert y.stop_gradient

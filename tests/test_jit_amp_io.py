@@ -1,0 +1,158 @@
+"""jit/to_static, TrainStep, amp, io, save/load tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, io, jit, nn, optimizer
+
+rng = np.random.RandomState(5)
+
+
+def test_to_static_function():
+    @paddle.jit.to_static
+    def f(a, b):
+        return a * 2 + b
+
+    x = paddle.to_tensor([1.0, 2.0])
+    y = paddle.to_tensor([0.5, 0.5])
+    np.testing.assert_allclose(f(x, y).numpy(), [2.5, 4.5])
+    # second call hits the jit cache
+    np.testing.assert_allclose(f(y, x).numpy(), [2.0, 3.0])
+
+
+def test_to_static_layer_sees_param_updates():
+    layer = nn.Linear(3, 2)
+    layer_static = paddle.jit.to_static(layer)
+    x = paddle.to_tensor(rng.rand(4, 3).astype(np.float32))
+    out1 = layer_static(x).numpy()
+    # mutate params in place — compiled fn must see the new values
+    layer.weight.set_value(layer.weight.numpy() * 0)
+    out2 = layer_static(x).numpy()
+    np.testing.assert_allclose(out2, np.broadcast_to(layer.bias.numpy(), out2.shape), rtol=1e-5)
+    assert not np.allclose(out1, out2)
+
+
+def test_train_step_matches_eager():
+    def build():
+        paddle.seed(123)
+        m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        o = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        return m, o
+
+    x = rng.rand(8, 4).astype(np.float32)
+    y = rng.rand(8, 1).astype(np.float32)
+
+    # eager reference
+    m1, o1 = build()
+    for _ in range(3):
+        loss = nn.MSELoss()(m1(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+    eager_w = m1.state_dict()["0.weight"].numpy()
+
+    # jitted TrainStep
+    m2, o2 = build()
+    loss_fn = lambda xb, yb: nn.MSELoss()(m2(xb), yb)
+    step = jit.TrainStep(m2, loss_fn, o2)
+    for _ in range(3):
+        step(paddle.to_tensor(x), paddle.to_tensor(y))
+    step.sync_to_model()
+    jit_w = m2.state_dict()["0.weight"].numpy()
+    np.testing.assert_allclose(eager_w, jit_w, rtol=1e-4, atol=1e-5)
+
+
+def test_auto_cast_o1():
+    layer = nn.Linear(4, 4)
+    x = paddle.to_tensor(rng.rand(2, 4).astype(np.float32))
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = layer(x)
+        assert out.dtype == paddle.bfloat16  # linear is white-listed
+        s = paddle.sum(out)  # black-listed -> fp32
+        assert s.dtype == np.float32
+    out = layer(x)
+    assert out.dtype == np.float32
+
+
+def test_grad_scaler_fp16_flow():
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=1024.0)
+    loss = (w * 2).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), [0.8], rtol=1e-5)  # grad 2 unscaled
+
+
+def test_amp_decorate_o2():
+    layer = nn.Linear(4, 4)
+    amp.decorate(layer, level="O2", dtype="bfloat16")
+    assert layer.weight.dtype == paddle.bfloat16
+
+
+def test_dataloader_batching_and_shuffle():
+    class Sq(io.Dataset):
+        def __getitem__(self, i):
+            return np.float32([i]), np.int64(i)
+
+        def __len__(self):
+            return 10
+
+    dl = io.DataLoader(Sq(), batch_size=4, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 2
+    xb, yb = batches[0]
+    assert xb.shape == (4, 1)
+    dl2 = io.DataLoader(Sq(), batch_size=4, shuffle=True, num_workers=2)
+    xs = np.concatenate([b[0].numpy() for b in dl2]).ravel()
+    assert sorted(xs.tolist()) == list(range(10))
+
+
+def test_distributed_batch_sampler():
+    class Ds(io.Dataset):
+        def __getitem__(self, i):
+            return np.float32([i])
+
+        def __len__(self):
+            return 16
+
+    samplers = [
+        io.DistributedBatchSampler(Ds(), batch_size=2, num_replicas=4, rank=r) for r in range(4)
+    ]
+    seen = []
+    for s in samplers:
+        for batch in s:
+            seen.extend(batch)
+    assert sorted(seen) == list(range(16))
+
+
+def test_save_load_roundtrip(tmp_path):
+    layer = nn.Linear(3, 3)
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(layer.state_dict(), path)
+    state = paddle.load(path)
+    layer2 = nn.Linear(3, 3)
+    layer2.set_state_dict(state)
+    np.testing.assert_allclose(layer2.weight.numpy(), layer.weight.numpy())
+
+    opt = optimizer.Adam(parameters=layer.parameters())
+    (layer(paddle.to_tensor(rng.rand(2, 3).astype(np.float32)))).sum().backward()
+    opt.step()
+    paddle.save(opt.state_dict(), str(tmp_path / "opt.pdopt"))
+    od = paddle.load(str(tmp_path / "opt.pdopt"))
+    assert od["step"] == 1
+
+
+def test_rng_seed_reproducible():
+    paddle.seed(7)
+    a = paddle.randn([4]).numpy()
+    paddle.seed(7)
+    b = paddle.randn([4]).numpy()
+    np.testing.assert_allclose(a, b)
+    state = paddle.get_rng_state()
+    c = paddle.randn([4]).numpy()
+    paddle.set_rng_state(state)
+    np.testing.assert_allclose(paddle.randn([4]).numpy(), c)

@@ -1,0 +1,208 @@
+"""nn layer tests (mirrors test/legacy_test test_layers / norm / conv suites)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+rng = np.random.RandomState(3)
+
+
+def test_linear_forward_backward():
+    layer = nn.Linear(4, 3)
+    x = paddle.to_tensor(rng.rand(2, 4).astype(np.float32))
+    out = layer(x)
+    expect = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5)
+    out.sum().backward()
+    assert layer.weight.grad is not None and layer.weight.grad.shape == (4, 3)
+
+
+def test_conv2d_matches_manual():
+    layer = nn.Conv2D(2, 3, 3, padding=1)
+    x = paddle.to_tensor(rng.rand(1, 2, 5, 5).astype(np.float32))
+    out = layer(x)
+    assert out.shape == (1, 3, 5, 5)
+    out.sum().backward()
+    assert layer.weight.grad.shape == layer.weight.shape
+
+    # oracle via scipy correlate on one output channel
+    from scipy import signal
+
+    w = layer.weight.numpy()
+    b = layer.bias.numpy()
+    o = np.zeros((5, 5), np.float32)
+    for ic in range(2):
+        o += signal.correlate2d(x.numpy()[0, ic], w[1, ic], mode="same")
+    np.testing.assert_allclose(out.numpy()[0, 1], o + b[1], rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.to_tensor(rng.rand(4, 3, 2, 2).astype(np.float32))
+    bn.train()
+    out = bn(x)
+    xn = x.numpy()
+    mean = xn.mean(axis=(0, 2, 3), keepdims=True)
+    var = xn.var(axis=(0, 2, 3), keepdims=True)
+    np.testing.assert_allclose(out.numpy(), (xn - mean) / np.sqrt(var + 1e-5), rtol=1e-4, atol=1e-5)
+    # running stats updated
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+    bn.eval()
+    out_eval = bn(x)
+    rm = bn._mean.numpy().reshape(1, 3, 1, 1)
+    rv = bn._variance.numpy().reshape(1, 3, 1, 1)
+    np.testing.assert_allclose(out_eval.numpy(), (xn - rm) / np.sqrt(rv + 1e-5), rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm_groupnorm_rmsnorm():
+    x = rng.rand(2, 4, 8).astype(np.float32)
+    ln = nn.LayerNorm(8)
+    out = ln(paddle.to_tensor(x))
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), (x - mean) / np.sqrt(var + 1e-5), rtol=1e-4, atol=1e-5)
+
+    gn = nn.GroupNorm(2, 4)
+    img = rng.rand(2, 4, 3, 3).astype(np.float32)
+    out = gn(paddle.to_tensor(img))
+    r = img.reshape(2, 2, 2, 3, 3)
+    m = r.mean(axis=(2, 3, 4), keepdims=True)
+    v = r.var(axis=(2, 3, 4), keepdims=True)
+    np.testing.assert_allclose(out.numpy(), ((r - m) / np.sqrt(v + 1e-5)).reshape(img.shape), rtol=1e-4, atol=1e-5)
+
+    rms = nn.RMSNorm(8)
+    out = rms(paddle.to_tensor(x, stop_gradient=False))
+    expect = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4, atol=1e-5)
+    out.sum().backward()
+    assert rms.weight.grad is not None
+
+
+def test_embedding_dropout():
+    emb = nn.Embedding(10, 4)
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+    out = emb(ids)
+    np.testing.assert_allclose(out.numpy(), emb.weight.numpy()[ids.numpy()])
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+    paddle.seed(0)
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    y = d(x)
+    kept = float((y.numpy() != 0).mean())
+    assert 0.4 < kept < 0.6
+    np.testing.assert_allclose(y.numpy()[y.numpy() != 0], 2.0)  # upscale_in_train
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_pools():
+    x = rng.rand(1, 1, 4, 4).astype(np.float32)
+    mp = nn.MaxPool2D(2, 2)
+    out = mp(paddle.to_tensor(x))
+    expect = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(out.numpy(), expect)
+    ap = nn.AvgPool2D(2, 2)
+    np.testing.assert_allclose(
+        ap(paddle.to_tensor(x)).numpy(), x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5)), rtol=1e-6
+    )
+    aap = nn.AdaptiveAvgPool2D((1, 1))
+    np.testing.assert_allclose(
+        aap(paddle.to_tensor(x)).numpy().squeeze(), x.mean(axis=(2, 3)).squeeze(), rtol=1e-6
+    )
+
+
+def test_sequential_layerlist_state_dict():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = model.state_dict()
+    assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+    model2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+    np.testing.assert_allclose(model2.state_dict()["0.weight"].numpy(), sd["0.weight"].numpy())
+
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll.parameters()) == 6
+    assert len(list(model.named_parameters())) == 4
+
+
+def test_lstm_gru():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = paddle.to_tensor(rng.rand(2, 5, 4).astype(np.float32), stop_gradient=False)
+    out, (h, c) = lstm(x)
+    assert out.shape == (2, 5, 8)
+    assert h.shape == (2, 2, 8)
+    out.sum().backward()
+    assert lstm._parameters["weight_ih_l0"].grad is not None
+
+    gru = nn.GRU(4, 8, direction="bidirect")
+    out, h = gru(x.detach())
+    assert out.shape == (2, 5, 16)
+
+
+def test_multihead_attention_and_transformer():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(rng.rand(2, 6, 16).astype(np.float32), stop_gradient=False)
+    out = mha(x)
+    assert out.shape == (2, 6, 16)
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+    enc_layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    out = enc(x.detach())
+    assert out.shape == (2, 6, 16)
+
+
+def test_losses():
+    logits = rng.rand(4, 5).astype(np.float32)
+    labels = np.array([0, 2, 1, 4])
+    loss = nn.CrossEntropyLoss()(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    # numpy oracle
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expect = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(loss.numpy(), expect, rtol=1e-5)
+
+    x = rng.rand(3, 4).astype(np.float32)
+    y = rng.rand(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        nn.MSELoss()(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(), ((x - y) ** 2).mean(), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        nn.L1Loss()(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(), np.abs(x - y).mean(), rtol=1e-6
+    )
+    # bce with logits stability
+    z = (rng.rand(4) * 20 - 10).astype(np.float32)
+    t = (rng.rand(4) > 0.5).astype(np.float32)
+    out = nn.BCEWithLogitsLoss()(paddle.to_tensor(z), paddle.to_tensor(t))
+    p = 1 / (1 + np.exp(-z))
+    expect = -(t * np.log(p) + (1 - t) * np.log(1 - p)).mean()
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4)
+
+
+def test_clip_grad_by_global_norm():
+    p1 = paddle.Parameter(np.ones((2, 2), np.float32))
+    p2 = paddle.Parameter(np.ones((3,), np.float32))
+    g1 = paddle.to_tensor(np.full((2, 2), 3.0, np.float32))
+    g2 = paddle.to_tensor(np.full((3,), 4.0, np.float32))
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    out = clip([(p1, g1), (p2, g2)])
+    total = np.sqrt((9 * 4) + (16 * 3))
+    np.testing.assert_allclose(out[0][1].numpy(), 3.0 / total, rtol=1e-5)
+    np.testing.assert_allclose(out[1][1].numpy(), 4.0 / total, rtol=1e-5)
+
+
+def test_activation_layers():
+    x = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+    for cls, fn in [
+        (nn.ReLU, lambda a: np.maximum(a, 0)),
+        (nn.Sigmoid, lambda a: 1 / (1 + np.exp(-a))),
+        (nn.Tanh, np.tanh),
+        (nn.SiLU, lambda a: a / (1 + np.exp(-a))),
+    ]:
+        np.testing.assert_allclose(cls()(x).numpy(), fn(x.numpy()), rtol=1e-4, atol=1e-6)
+    sm = nn.Softmax(-1)(x).numpy()
+    np.testing.assert_allclose(sm.sum(-1), 1.0, rtol=1e-5)

@@ -1,0 +1,140 @@
+"""Op unit tests: shape/indexing ops (mirrors test/legacy_test reshape/concat/gather suites)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+rng = np.random.RandomState(11)
+
+
+def test_reshape_flatten_squeeze():
+    x = rng.rand(2, 3, 4).astype(np.float32)
+    check_output(paddle.reshape, lambda a: a.reshape(6, 4), [x], kwargs={"shape": [6, 4]})
+    check_output(paddle.reshape, lambda a: a.reshape(2, -1), [x], kwargs={"shape": [2, -1]})
+    check_output(paddle.flatten, lambda a: a.reshape(2, 12), [x], kwargs={"start_axis": 1})
+    y = rng.rand(2, 1, 4).astype(np.float32)
+    check_output(paddle.squeeze, lambda a: a.squeeze(1), [y], kwargs={"axis": 1})
+    check_output(paddle.unsqueeze, lambda a: a[:, None], [x], kwargs={"axis": 1})
+    check_grad(paddle.reshape, [x], kwargs={"shape": [4, 6]})
+
+
+def test_transpose_concat_stack_split():
+    x = rng.rand(2, 3).astype(np.float32)
+    y = rng.rand(2, 3).astype(np.float32)
+    check_output(paddle.transpose, lambda a: a.T, [x], kwargs={"perm": [1, 0]})
+    out = paddle.concat([paddle.to_tensor(x), paddle.to_tensor(y)], axis=0)
+    np.testing.assert_allclose(out.numpy(), np.concatenate([x, y], 0))
+    out = paddle.stack([paddle.to_tensor(x), paddle.to_tensor(y)], axis=1)
+    np.testing.assert_allclose(out.numpy(), np.stack([x, y], 1))
+    parts = paddle.split(paddle.to_tensor(x), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1)
+    parts = paddle.split(paddle.to_tensor(x), [1, -1], axis=1)
+    assert parts[1].shape == (2, 2)
+
+    # grads flow through concat
+    a = paddle.to_tensor(x, stop_gradient=False)
+    b = paddle.to_tensor(y, stop_gradient=False)
+    paddle.concat([a, b], axis=0).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.ones_like(x))
+
+
+def test_tile_expand_flip_roll():
+    x = rng.rand(2, 3).astype(np.float32)
+    check_output(paddle.tile, lambda a: np.tile(a, (2, 1)), [x], kwargs={"repeat_times": [2, 1]})
+    check_output(paddle.expand, lambda a: np.broadcast_to(a, (4, 2, 3)), [x], kwargs={"shape": [4, 2, 3]})
+    check_output(paddle.flip, lambda a: a[::-1], [x], kwargs={"axis": 0})
+    check_output(paddle.roll, lambda a: np.roll(a, 1, 0), [x], kwargs={"shifts": 1, "axis": 0})
+
+
+def test_gather_scatter():
+    x = rng.rand(5, 3).astype(np.float32)
+    idx = np.array([0, 2, 4])
+    check_output(
+        paddle.gather, lambda a, i: a[i], [x, idx], kwargs={"axis": 0},
+    )
+    # gather_nd
+    index = np.array([[0, 1], [2, 2]])
+    check_output(paddle.gather_nd, lambda a, i: a[tuple(i.T)], [x, index])
+    # scatter overwrite
+    updates = rng.rand(2, 3).astype(np.float32)
+    sc = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(np.array([1, 3])), paddle.to_tensor(updates))
+    expect = x.copy()
+    expect[[1, 3]] = updates
+    np.testing.assert_allclose(sc.numpy(), expect)
+    # grads through gather
+    check_grad(paddle.gather, [x, idx], grad_inputs=[0], kwargs={"axis": 0})
+
+
+def test_indexing_setitem():
+    x = rng.rand(4, 5).astype(np.float32)
+    t = paddle.to_tensor(x, stop_gradient=False)
+    y = t[1:3, ::2]
+    np.testing.assert_allclose(y.numpy(), x[1:3, ::2])
+    y.sum().backward()
+    g = np.zeros_like(x)
+    g[1:3, ::2] = 1
+    np.testing.assert_allclose(t.grad.numpy(), g)
+
+    t2 = paddle.to_tensor(x.copy())
+    t2[0] = 7.0
+    assert np.allclose(t2.numpy()[0], 7.0)
+    # setitem keeps autograd
+    a = paddle.to_tensor(x.copy(), stop_gradient=False)
+    b = a * 2
+    b[0] = 0.0
+    b.sum().backward()
+    g = np.full_like(x, 2.0)
+    g[0] = 0.0
+    np.testing.assert_allclose(a.grad.numpy(), g)
+
+
+def test_sort_topk_argmax():
+    x = rng.rand(3, 6).astype(np.float32)
+    check_output(paddle.sort, lambda a: np.sort(a, -1), [x])
+    check_output(paddle.argsort, lambda a: np.argsort(a, -1), [x])
+    vals, idx = paddle.topk(paddle.to_tensor(x), 2)
+    np.testing.assert_allclose(vals.numpy(), np.sort(x, -1)[:, ::-1][:, :2], rtol=1e-6)
+    check_output(paddle.argmax, lambda a: np.argmax(a), [x])
+    check_output(paddle.argmin, lambda a: np.argmin(a, 1), [x], kwargs={"axis": 1})
+
+
+def test_where_masked():
+    x = rng.rand(3, 4).astype(np.float32)
+    y = rng.rand(3, 4).astype(np.float32)
+    cond = x > 0.5
+    check_output(paddle.where, lambda c, a, b: np.where(c, a, b), [cond, x, y])
+    out = paddle.masked_fill(paddle.to_tensor(x), paddle.to_tensor(cond), 0.0)
+    np.testing.assert_allclose(out.numpy(), np.where(cond, 0.0, x))
+    ms = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(cond))
+    np.testing.assert_allclose(ms.numpy(), x[cond])
+    nz = paddle.nonzero(paddle.to_tensor(cond))
+    np.testing.assert_array_equal(nz.numpy(), np.stack(np.nonzero(cond), -1))
+
+
+def test_take_along_put_along():
+    x = rng.rand(3, 4).astype(np.float32)
+    idx = rng.randint(0, 4, (3, 2))
+    check_output(
+        paddle.take_along_axis,
+        lambda a, i: np.take_along_axis(a, i, 1),
+        [x, idx],
+        kwargs={"axis": 1},
+    )
+
+
+def test_unique_pad():
+    x = np.array([1, 3, 1, 2, 3], np.int64)
+    out = paddle.unique(paddle.to_tensor(x))
+    np.testing.assert_array_equal(out.numpy(), np.unique(x))
+    y = rng.rand(1, 1, 3, 3).astype(np.float32)
+    padded = paddle.nn.functional.pad(paddle.to_tensor(y), [1, 1, 2, 2])
+    assert padded.shape == (1, 1, 7, 5)
+
+
+def test_cast_one_hot():
+    x = rng.rand(3, 4).astype(np.float32)
+    assert paddle.cast(paddle.to_tensor(x), "int32").dtype == np.int32
+    oh = paddle.one_hot(paddle.to_tensor(np.array([0, 2])), 3)
+    np.testing.assert_allclose(oh.numpy(), np.eye(3, dtype=np.float32)[[0, 2]])

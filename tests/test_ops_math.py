@@ -1,0 +1,150 @@
+"""Op unit tests: math/reduction (mirrors test/legacy_test elementwise/reduce suites)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+rng = np.random.RandomState(7)
+
+
+UNARY_CASES = [
+    ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt), ("tanh", np.tanh),
+    ("sin", np.sin), ("cos", np.cos), ("abs", np.abs), ("floor", np.floor),
+    ("ceil", np.ceil), ("square", np.square), ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("rsqrt", lambda x: 1 / np.sqrt(x)), ("log1p", np.log1p), ("expm1", np.expm1),
+]
+
+
+@pytest.mark.parametrize("name,np_fn", UNARY_CASES, ids=[c[0] for c in UNARY_CASES])
+def test_unary_forward(name, np_fn):
+    x = rng.rand(3, 4).astype(np.float32) + 0.5
+    # XLA CPU transcendentals are fp32-approximate; oracle is numpy double
+    check_output(getattr(paddle, name), np_fn, [x], atol=5e-5, rtol=5e-4)
+
+
+@pytest.mark.parametrize("name", ["exp", "tanh", "sqrt", "sigmoid", "log"])
+def test_unary_grad(name):
+    x = rng.rand(2, 3).astype(np.float32) + 0.5
+    check_grad(getattr(paddle, name), [x])
+
+
+BINARY_CASES = [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ("pow", np.power), ("atan2", np.arctan2),
+]
+
+
+@pytest.mark.parametrize("name,np_fn", BINARY_CASES, ids=[c[0] for c in BINARY_CASES])
+def test_binary_forward(name, np_fn):
+    x = rng.rand(3, 4).astype(np.float32) + 0.5
+    y = rng.rand(3, 4).astype(np.float32) + 0.5
+    check_output(getattr(paddle, name), np_fn, [x, y])
+
+
+def test_binary_broadcast():
+    x = rng.rand(3, 4).astype(np.float32)
+    y = rng.rand(4).astype(np.float32)
+    check_output(paddle.add, np.add, [x, y])
+    check_grad(paddle.add, [x, y])
+    check_grad(paddle.multiply, [x, y])
+
+
+@pytest.mark.parametrize(
+    "name,np_fn",
+    [
+        ("sum", np.sum),
+        ("mean", np.mean),
+        ("max", np.max),
+        ("min", np.min),
+        ("prod", np.prod),
+    ],
+)
+def test_reduce_all(name, np_fn):
+    x = rng.rand(3, 4).astype(np.float32)
+    check_output(getattr(paddle, name), np_fn, [x])
+
+
+def test_reduce_axis_keepdim():
+    x = rng.rand(2, 3, 4).astype(np.float32)
+    check_output(
+        paddle.sum, lambda a: np.sum(a, axis=(1, 2), keepdims=True), [x],
+        kwargs={"axis": [1, 2], "keepdim": True},
+    )
+    check_output(paddle.mean, lambda a: np.mean(a, axis=1), [x], kwargs={"axis": 1})
+    check_grad(paddle.sum, [x], kwargs={"axis": 1})
+
+
+def test_matmul():
+    a = rng.rand(3, 4).astype(np.float32)
+    b = rng.rand(4, 5).astype(np.float32)
+    check_output(paddle.matmul, np.matmul, [a, b])
+    check_grad(paddle.matmul, [a, b])
+    # batched + transpose flags
+    a3 = rng.rand(2, 3, 4).astype(np.float32)
+    b3 = rng.rand(2, 5, 4).astype(np.float32)
+    check_output(
+        paddle.matmul,
+        lambda x, y: np.matmul(x, np.swapaxes(y, -1, -2)),
+        [a3, b3],
+        kwargs={"transpose_y": True},
+    )
+
+
+def test_scale_clip_lerp():
+    x = rng.rand(3, 4).astype(np.float32)
+    check_output(paddle.scale, lambda a: a * 2.0 + 1.0, [x], kwargs={"scale": 2.0, "bias": 1.0})
+    check_output(paddle.clip, lambda a: np.clip(a, 0.3, 0.7), [x], kwargs={"min": 0.3, "max": 0.7})
+    y = rng.rand(3, 4).astype(np.float32)
+    check_output(paddle.lerp, lambda a, b: a + 0.4 * (b - a), [x, y], kwargs={"weight": 0.4})
+
+
+def test_cumsum_cumprod():
+    x = rng.rand(3, 4).astype(np.float32)
+    check_output(paddle.cumsum, lambda a: np.cumsum(a, axis=1), [x], kwargs={"axis": 1})
+    check_output(paddle.cumprod, lambda a: np.cumprod(a, axis=0), [x], kwargs={"dim": 0})
+    check_grad(paddle.cumsum, [x], kwargs={"axis": 1})
+
+
+def test_comparison_logical():
+    x = rng.rand(3, 4).astype(np.float32)
+    y = rng.rand(3, 4).astype(np.float32)
+    check_output(paddle.equal, np.equal, [x, x])
+    check_output(paddle.greater_than, np.greater, [x, y])
+    check_output(paddle.logical_and, np.logical_and, [x > 0.5, y > 0.5])
+    assert bool(paddle.allclose(paddle.to_tensor(x), paddle.to_tensor(x)))
+    assert bool(paddle.equal_all(paddle.to_tensor(x), paddle.to_tensor(x)))
+
+
+def test_std_var_median():
+    x = rng.rand(4, 5).astype(np.float32)
+    check_output(paddle.std, lambda a: np.std(a, ddof=1), [x], atol=1e-4)
+    check_output(paddle.var, lambda a: np.var(a, ddof=1, axis=1), [x], kwargs={"axis": 1}, atol=1e-4)
+    check_output(paddle.median, np.median, [x])
+
+
+def test_einsum():
+    a = rng.rand(3, 4).astype(np.float32)
+    b = rng.rand(4, 5).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_logsumexp_isnan():
+    x = rng.rand(3, 4).astype(np.float32)
+    from scipy.special import logsumexp as sp_lse  # scipy ships with numpy stack
+
+    check_output(paddle.logsumexp, lambda a: sp_lse(a), [x], atol=1e-5)
+    y = x.copy()
+    y[0, 0] = np.nan
+    assert bool(paddle.isnan(paddle.to_tensor(y)).any())
+
+
+def test_dunders_and_scalars():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (2 * x + 1) / 2 - 0.5
+    z = (y**2).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * (np.array([1.0, 2.0])), rtol=1e-6)
