@@ -158,6 +158,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False
 
     def scale(self, var):
         if not self._enable:
@@ -165,7 +166,7 @@ class GradScaler:
         return var * self._scale
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if not self._enable or self._unscaled:
             return
         params = optimizer._parameter_list or []
         found = False
@@ -176,16 +177,18 @@ class GradScaler:
                     found = True
                 p._grad = g
         self._found_inf = found
+        self._unscaled = True
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        if not self._found_inf:
-            self.unscale_(optimizer)
-        if self._found_inf:
+        self.unscale_(optimizer)  # no-op if the caller already unscaled
+        found = self._found_inf
+        self._found_inf = False
+        self._unscaled = False
+        if found:
             self._update_on_inf()
-            self._found_inf = False
             return
         optimizer.step()
         self._update_on_good()

@@ -45,8 +45,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         tensors = [tensors]
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
-    for t, g in zip(tensors, grad_tensors):
-        run_backward(t, g, retain_graph=True if len(tensors) > 1 else retain_graph)
+    last = len(tensors) - 1
+    for i, (t, g) in enumerate(zip(tensors, grad_tensors)):
+        # earlier roots must keep the graph alive; the final sweep honors the caller
+        run_backward(t, g, retain_graph=True if i < last else retain_graph)
 
 
 def grad(

@@ -553,9 +553,10 @@ def run_backward(tensor: Tensor, grad_tensor=None, retain_graph: bool = False):
             if p._node is not None:
                 stack.append(p._node)
 
-    # cotangent accumulator keyed by (node_id, out_idx)
+    # cotangent accumulator keyed by (node_id, out_idx); seed AFTER routing so a
+    # hook on the root tensor affects propagated gradients too
+    seed = _route(tensor, seed)
     cots: dict[tuple[int, int], Any] = {(tensor._node.id, tensor._out_idx): seed}
-    _route(tensor, seed)
 
     for nid in sorted(nodes, reverse=True):
         node = nodes[nid]
@@ -572,6 +573,8 @@ def run_backward(tensor: Tensor, grad_tensor=None, retain_graph: bool = False):
                 g = jnp.zeros(shape, dt)
             else:
                 any_set = True
+                if g.dtype != dt:  # AMP boundary: cotangent must match primal dtype
+                    g = g.astype(dt)
             couts.append(g)
         if not any_set:
             continue

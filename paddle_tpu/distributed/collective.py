@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.tensor import Tensor, _unwrap, apply_op
+from ..core.tensor import Tensor, _unwrap, apply_op, no_grad
 from .env import get_world_size
 
 __all__ = [
@@ -183,6 +183,9 @@ def _lax_reduce(v, op, axis_name):
 # ---- collectives ----
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """NOTE eager mode: non-differentiable (reference parity) — executed under
+    no_grad so the tape records nothing; in-program (traced) use lowers to
+    lax collectives which ARE differentiable under jax.grad."""
     group = group or _default_group()
     v = _unwrap(tensor)
     if _is_traced(v) and _axis_in_scope(group.axis_name):
@@ -193,7 +196,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         red = _reduce_stacked(val, op)
         return jnp.broadcast_to(red, val.shape)
 
-    out = apply_op("all_reduce", fn, [tensor])
+    with no_grad():
+        out = apply_op("all_reduce", fn, [tensor])
     if isinstance(tensor, Tensor):
         tensor._value = out._value  # paddle all_reduce is in-place
         return tensor
@@ -210,7 +214,8 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
         red = _reduce_stacked(val, op)[0]
         return val.at[group.ranks.index(dst) if dst in group.ranks else dst].set(red)
 
-    out = apply_op("reduce", fn, [tensor])
+    with no_grad():
+        out = apply_op("reduce", fn, [tensor])
     if isinstance(tensor, Tensor):
         tensor._value = out._value
         return tensor
@@ -240,7 +245,8 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
         cat = jnp.concatenate(parts, axis=axis)
         return jnp.broadcast_to(cat[None], (val.shape[0],) + cat.shape)
 
-    return apply_op("all_gather", fn, [x])
+    with no_grad():
+        return apply_op("all_gather", fn, [x])
 
 
 def all_gather_object(obj_list, obj, group=None):
@@ -261,7 +267,8 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_o
         chunks = jnp.stack(jnp.split(red, val.shape[0], axis=axis), axis=0)
         return chunks  # slot i = its reduced chunk
 
-    return apply_op("reduce_scatter", fn, [tensor])
+    with no_grad():
+        return apply_op("reduce_scatter", fn, [tensor])
 
 
 def alltoall(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
@@ -273,7 +280,8 @@ def alltoall(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
         if _is_traced(v) and _axis_in_scope(group.axis_name):
             out = jax.lax.all_to_all(v, group.axis_name, split_axis=0, concat_axis=0, tiled=True)
             return Tensor(out)
-        return apply_op("alltoall", lambda val: jnp.swapaxes(val, 0, 1), [x])
+        with no_grad():
+            return apply_op("alltoall", lambda val: jnp.swapaxes(val, 0, 1), [x])
     # list API: in_tensor_list[i] is this "rank"'s message to rank i — with the
     # stacked convention inputs are [nranks][nranks, ...]
     ins = [_unwrap(t) for t in in_tensor_list]
@@ -297,7 +305,8 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
         blocks = val.reshape((val.shape[0], n, -1) + val.shape[2:])
         return jnp.swapaxes(blocks, 0, 1).reshape(val.shape)
 
-    res = apply_op("alltoall_single", fn, [in_tensor])
+    with no_grad():
+        res = apply_op("alltoall_single", fn, [in_tensor])
     if out_tensor is not None:
         out_tensor._value = res._value
         return out_tensor
@@ -316,7 +325,8 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     def fn(val):
         return jnp.broadcast_to(val[idx][None], val.shape)
 
-    out = apply_op("broadcast", fn, [tensor])
+    with no_grad():
+        out = apply_op("broadcast", fn, [tensor])
     if isinstance(tensor, Tensor):
         tensor._value = out._value
         return tensor

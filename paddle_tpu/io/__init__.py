@@ -364,15 +364,25 @@ class DataLoader:
                     for b in self._iter_batches():
                         q.put(b)
                 else:
+                    import collections
                     import concurrent.futures as cf
 
+                    max_pending = self.num_workers * self.prefetch_factor
                     with cf.ThreadPoolExecutor(self.num_workers) as ex:
-                        futures = [
-                            ex.submit(lambda ix: self.collate_fn([self.dataset[i] for i in ix]), idxs)
-                            for idxs in self.batch_sampler
-                        ]
-                        for f in futures:
-                            q.put(f.result())
+                        pending: collections.deque = collections.deque()
+                        for idxs in self.batch_sampler:
+                            pending.append(
+                                ex.submit(
+                                    lambda ix: self.collate_fn([self.dataset[i] for i in ix]),
+                                    idxs,
+                                )
+                            )
+                            # bound in-flight work so memory stays O(prefetch),
+                            # not O(epoch); q.put also blocks at queue maxsize
+                            while len(pending) >= max_pending:
+                                q.put(pending.popleft().result())
+                        while pending:
+                            q.put(pending.popleft().result())
             except BaseException as e:  # surface worker errors to the consumer
                 q.put(e)
             finally:

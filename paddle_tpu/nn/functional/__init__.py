@@ -169,9 +169,8 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             idx = jnp.argmax(y, axis=axis, keepdims=True)
             y_hard = jnp.zeros_like(y)
             y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
-            y = y_hard + jax.lax.stop_gradient(y) - y + (y - jax.lax.stop_gradient(y))
-            # straight-through: hard value, soft gradient
-            y = y_hard - jax.lax.stop_gradient(y) + y if False else y_hard + y - jax.lax.stop_gradient(y)
+            # straight-through: hard value forward, soft gradient backward
+            y = y_hard + y - jax.lax.stop_gradient(y)
         return y
 
     return apply_op("gumbel_softmax", fn, [x])
@@ -390,7 +389,7 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, 
             for g in range(groups):
                 outs.append(
                     jax.lax.conv_general_dilated(
-                        vs[g], jnp.flip(w_[g], axis=tuple(range(2, 2 + ndim))).swapaxes(0, 1) if False else w_[g],
+                        vs[g], w_[g],
                         window_strides=(1,) * ndim,
                         padding=pad_cfg,
                         lhs_dilation=strides,
@@ -741,9 +740,7 @@ def l1_loss(input, label, reduction="mean", name=None):
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
     def fn(a, b):
         d = jnp.abs(a - b)
-        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta if False else jnp.where(
-            d < delta, 0.5 * d * d, delta * (d - 0.5 * delta)
-        )
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
         return _reduce_loss(loss, reduction)
 
     return apply_op("smooth_l1_loss", fn, [input, label])

@@ -123,7 +123,8 @@ class Optimizer:
             return new_p.astype(p.dtype), new_st
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
-        flat_g = jax.tree_util.tree_flatten(grads)[0]
+        # keep None gradients as leaves so flat_g stays aligned with flat_p
+        flat_g = jax.tree_util.tree_flatten(grads, is_leaf=lambda x: x is None)[0]
         flat_s = treedef.flatten_up_to(opt_state["acc"])
         new_p, new_s = [], []
         for p, g, st in zip(flat_p, flat_g, flat_s):
@@ -421,15 +422,18 @@ class RAdam(Optimizer):
         v = b2 * state["moment2"] + (1 - b2) * g * g
         mhat = m / (1 - b1**step)
         rho_inf = 2 / (1 - b2) - 1
-        rho_t = rho_inf - 2 * step * (b2**step) / (1 - b2**step)
-        if rho_t > 5:
-            l_t = jnp.sqrt((1 - b2**step)) / (jnp.sqrt(v) + self._epsilon)
-            r_t = math.sqrt(
-                ((rho_t - 4) * (rho_t - 2) * rho_inf) / ((rho_inf - 4) * (rho_inf - 2) * rho_t)
-            )
-            p = p - lr * r_t * mhat * l_t
-        else:
-            p = p - lr * mhat
+        step_f = jnp.asarray(step, jnp.float32)
+        rho_t = rho_inf - 2 * step_f * (b2**step_f) / (1 - b2**step_f)
+        # traced-safe branch (step is a tracer on the jit path)
+        l_t = jnp.sqrt(1 - b2**step_f) / (jnp.sqrt(v) + self._epsilon)
+        safe_rho = jnp.maximum(rho_t, 4.0 + 1e-3)
+        r_t = jnp.sqrt(
+            ((safe_rho - 4) * (safe_rho - 2) * rho_inf)
+            / ((rho_inf - 4) * (rho_inf - 2) * safe_rho)
+        )
+        rect = p - lr * r_t * mhat * l_t
+        plain = p - lr * mhat
+        p = jnp.where(rho_t > 5.0, rect, plain)
         return p, {"moment1": m, "moment2": v}
 
 
