@@ -127,6 +127,10 @@ class Tensor:
         "_hooks",
         "name",
         "persistable",
+        "dist_attr",  # DTensor metadata (distributed.auto_parallel)
+        "partition_spec",  # mesh sharding hint set by TP layers
+        "sequence_parallel",  # sequence-parallel marker (fleet mpu)
+        "dp_stacked_grad",  # grad uses the stacked per-rank convention
         "__weakref__",
     )
 

@@ -31,9 +31,15 @@ class MetaParallelBase(Layer):
 
 
 class TensorParallel(MetaParallelBase):
-    """Under GSPMD, TP layers already carry their mesh shardings; this wrapper
-    exists for fleet API parity (broadcast of non-distributed params happens via
-    replicated sharding)."""
+    """GSPMD activation of the mpu TP layers: wrapping places every parameter
+    with a partition_spec onto the hybrid mesh (fleet API parity with
+    meta_parallel TensorParallel)."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        from .mpu import shard_parameters_to_mesh
+
+        shard_parameters_to_mesh(layers, hcg.mesh if hcg is not None else None)
 
 
 class SegmentParallel(MetaParallelBase):

@@ -1,0 +1,364 @@
+"""Tensor-parallel (Megatron) layers + sequence-parallel utilities.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/ —
+VocabParallelEmbedding (mp_layers.py:49), ColumnParallelLinear (:336),
+RowParallelLinear (:543), ParallelCrossEntropy (:744), the identity/allreduce
+autograd ops in mp_ops.py, per-rank RNG (random.py:34 RNGStatesTracker); and
+fleet/utils/sequence_parallel_utils.py (ScatterOp/GatherOp :85-137,
+ColumnSequenceParallelLinear :429, RowSequenceParallelLinear :564).
+
+TPU-native realization: two execution modes from one class —
+
+- **GSPMD mode** (default, the performance path): the layer is an ordinary
+  Linear/Embedding whose weight carries a ``partition_spec`` over the 'model'
+  mesh axis (column → shard output dim, row → shard input dim);
+  :func:`shard_parameters_to_mesh` places the weights on the hybrid mesh and
+  GSPMD then inserts exactly the identity-fwd/allreduce-bwd (f) and
+  allreduce-fwd (g) conversions the reference implements by hand in mp_ops.py.
+- **shard_map mode** (explicit): when called inside shard_map with the 'model'
+  axis bound, forward uses explicit lax collectives — this is also what the
+  reference's eager TP does, and what tests assert against.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import rng as rng_mod
+from ...core.tensor import apply_op
+from ...nn import initializer as I
+from ...nn.layer_base import Layer
+
+__all__ = [
+    "shard_parameters_to_mesh",
+    "VocabParallelEmbedding",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "ParallelCrossEntropy",
+    "RNGStatesTracker",
+    "get_rng_state_tracker",
+    "scatter_to_sequence_parallel",
+    "gather_from_sequence_parallel",
+    "mark_as_sequence_parallel_parameter",
+]
+
+
+def _axis_bound(name):
+    try:
+        jax.lax.axis_index(name)
+        return True
+    except Exception:
+        return False
+
+
+def _mp_info(mp_group):
+    """(axis_name, degree) for the model-parallel group."""
+    from .topology import get_hybrid_communicate_group
+
+    if mp_group is not None:
+        return mp_group.axis_name, mp_group.nranks
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return "model", hcg.get_model_parallel_world_size()
+    return "model", 1
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over 'mp' (mp_layers.py:49).
+
+    Weight spec: P('mp', None).  In shard_map mode each rank holds rows
+    [rank*per, (rank+1)*per), masks out-of-range ids, and psums the result."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self.axis_name, self.world_size = _mp_info(mp_group)
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim),
+            attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        self.weight.partition_spec = ("model", None)
+
+    def forward(self, x):
+        axis = self.axis_name
+
+        def fn(ids, w):
+            if _axis_bound(axis):
+                per = w.shape[0]  # local rows
+                rank = jax.lax.axis_index(axis)
+                start = rank * per
+                local = ids - start
+                in_range = (local >= 0) & (local < per)
+                safe = jnp.where(in_range, local, 0)
+                emb = jnp.take(w, safe, axis=0)
+                emb = jnp.where(in_range[..., None], emb, 0.0)
+                return jax.lax.psum(emb, axis)
+            return jnp.take(w, ids, axis=0)
+
+        return apply_op("vocab_parallel_embedding", fn, [x, self.weight])
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with output dim sharded over 'mp' (mp_layers.py:336).
+
+    gather_output=True all-gathers the sharded output (g-op); False keeps it
+    sharded for a following RowParallelLinear."""
+
+    def __init__(
+        self,
+        in_features,
+        out_features,
+        weight_attr=None,
+        has_bias=None,
+        gather_output=True,
+        fuse_matmul_bias=False,
+        mp_group=None,
+        name=None,
+    ):
+        super().__init__()
+        self.axis_name, self.world_size = _mp_info(mp_group)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        # weight holds the FULL logical shape; GSPMD shards it by spec.  Inside
+        # shard_map tests, weights are passed pre-sharded per rank.
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr, default_initializer=I.XavierNormal()
+        )
+        self.weight.partition_spec = (None, "model")
+        self.bias = (
+            self.create_parameter((out_features,), attr=None, is_bias=True)
+            if (has_bias is None or has_bias)
+            else None
+        )
+        if self.bias is not None:
+            self.bias.partition_spec = ("model",)
+
+    def forward(self, x):
+        axis = self.axis_name
+        gather = self.gather_output
+        has_bias = self.bias is not None
+        inputs = [x, self.weight] + ([self.bias] if has_bias else [])
+
+        def fn(v, w, *rest):
+            out = v @ w  # f-op: identity fwd (grad allreduce comes from AD of psum-consumers)
+            if rest:
+                out = out + rest[0]
+            if gather and _axis_bound(axis):
+                out = jax.lax.all_gather(out, axis, axis=out.ndim - 1, tiled=True)
+            return out
+
+        return apply_op("column_parallel_linear", fn, inputs)
+
+
+class RowParallelLinear(Layer):
+    """Linear with input dim sharded over 'mp' (mp_layers.py:543): local matmul
+    then allreduce of the partial sums; bias added after the reduce."""
+
+    def __init__(
+        self,
+        in_features,
+        out_features,
+        weight_attr=None,
+        has_bias=True,
+        input_is_parallel=False,
+        fuse_matmul_bias=False,
+        mp_group=None,
+        name=None,
+    ):
+        super().__init__()
+        self.axis_name, self.world_size = _mp_info(mp_group)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr, default_initializer=I.XavierNormal()
+        )
+        self.weight.partition_spec = ("model", None)
+        self.bias = (
+            self.create_parameter((out_features,), attr=None, is_bias=True) if has_bias else None
+        )
+
+    def forward(self, x):
+        axis = self.axis_name
+        has_bias = self.bias is not None
+        input_is_parallel = self.input_is_parallel
+        inputs = [x, self.weight] + ([self.bias] if has_bias else [])
+
+        def fn(v, w, *rest):
+            if _axis_bound(axis):
+                if not input_is_parallel:
+                    # split the replicated input along the feature dim
+                    rank = jax.lax.axis_index(axis)
+                    per = w.shape[0]
+                    v = jax.lax.dynamic_slice_in_dim(v, rank * per, per, axis=v.ndim - 1)
+                out = v @ w
+                out = jax.lax.psum(out, axis)
+            else:
+                out = v @ w
+            if rest:
+                out = out + rest[0]
+            return out
+
+        return apply_op("row_parallel_linear", fn, inputs)
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax cross-entropy over vocab sharded on 'mp' (mp_layers.py:744):
+    logits stay sharded; max/sum-exp/own-logit are psum/pmax'd."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.axis_name, self.world_size = _mp_info(mp_group)
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        axis = self.axis_name
+        ignore = self.ignore_index
+
+        def fn(logits, lab):
+            if _axis_bound(axis):
+                rank = jax.lax.axis_index(axis)
+                per = logits.shape[-1]
+                m = jax.lax.pmax(jnp.max(logits, axis=-1, keepdims=True), axis)
+                e = jnp.exp(logits.astype(jnp.float32) - m)
+                denom = jax.lax.psum(jnp.sum(e, axis=-1, keepdims=True), axis)
+                start = rank * per
+                local = lab - start
+                in_range = (local >= 0) & (local < per)
+                safe = jnp.where(in_range, local, 0)
+                own = jnp.take_along_axis(
+                    logits.astype(jnp.float32), safe[..., None].astype(jnp.int32), axis=-1
+                )[..., 0]
+                own = jnp.where(in_range, own - m[..., 0], 0.0)
+                own = jax.lax.psum(own, axis)
+                loss = jnp.log(denom[..., 0]) - own
+            else:
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+                loss = -jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            valid = lab != ignore
+            return jnp.where(valid, loss, 0.0)[..., None]
+
+        return apply_op("parallel_cross_entropy", fn, [input, label])
+
+
+class RNGStatesTracker:
+    """Per-rank RNG streams for TP-deterministic dropout (mpu/random.py:34).
+
+    'global' stream = same seed on all mp ranks (dropout on replicated
+    activations); 'local' stream = seed offset by mp rank (dropout on sharded
+    activations)."""
+
+    def __init__(self):
+        self.states: dict[str, rng_mod.Generator] = {}
+
+    def add(self, name, seed):
+        if name in self.states:
+            raise ValueError(f"state {name!r} already exists")
+        self.states[name] = rng_mod.Generator(seed)
+
+    def get_states_tracker(self):
+        return {k: g.get_state() for k, g in self.states.items()}
+
+    def set_states_tracker(self, states):
+        for k, s in states.items():
+            self.states.setdefault(k, rng_mod.Generator(0)).set_state(s)
+
+    def rng_state(self, name="model_parallel_rng"):
+        from contextlib import contextmanager
+
+        if name not in self.states:
+            self.add(name, np.random.randint(1 << 30))
+
+        @contextmanager
+        def guard():
+            import paddle_tpu.core.rng as global_rng
+
+            prev = global_rng._default
+            global_rng._default = self.states[name]
+            try:
+                yield
+            finally:
+                global_rng._default = prev
+
+        return guard()
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None, mp_rank=0):
+    """Seed the tracker streams (reference: mpu/random.py model_parallel_random_seed).
+    Registers the stream names the reference uses: 'global_seed' (same on every
+    mp rank) and 'local_seed'/'model_parallel_rng' (offset by the mp rank so
+    dropout on sharded activations decorrelates across ranks)."""
+    import random as pyrandom
+
+    seed = seed or pyrandom.randint(0, 1 << 30)
+    global _tracker
+    _tracker = RNGStatesTracker()
+    _tracker.add("global_seed", seed)
+    local = seed + 1024 + mp_rank
+    _tracker.add("local_seed", local)
+    _tracker.add("model_parallel_rng", local)
+
+
+# ---- sequence parallel utilities (sequence_parallel_utils.py) ----
+
+def scatter_to_sequence_parallel(x, axis_name="model"):
+    """ScatterOp (:85): split the sequence dim across the axis (inside
+    shard_map); identity when the axis is not bound."""
+
+    def fn(v):
+        if _axis_bound(axis_name):
+            n = jax.lax.axis_size(axis_name)
+            rank = jax.lax.axis_index(axis_name)
+            per = v.shape[0] // n
+            return jax.lax.dynamic_slice_in_dim(v, rank * per, per, axis=0)
+        return v
+
+    return apply_op("sp_scatter", fn, [x])
+
+
+def gather_from_sequence_parallel(x, axis_name="model"):
+    """GatherOp/AllGatherOp (:105): all-gather the sequence dim."""
+
+    def fn(v):
+        if _axis_bound(axis_name):
+            return jax.lax.all_gather(v, axis_name, axis=0, tiled=True)
+        return v
+
+    return apply_op("sp_gather", fn, [x])
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.sequence_parallel = True
+    return param
+
+
+def shard_parameters_to_mesh(layer, mesh=None):
+    """Place every parameter carrying a ``partition_spec`` onto the hybrid mesh
+    (the GSPMD-mode activation of the TP layers): device_put with
+    NamedSharding(mesh, spec); parameters without a spec are replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if mesh is None:
+        from .topology import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        if hcg is None:
+            return layer
+        mesh = hcg.mesh
+    for _, p in layer.named_parameters():
+        spec = getattr(p, "partition_spec", None)
+        pspec = PartitionSpec(*spec) if spec else PartitionSpec()
+        p._value = jax.device_put(p._value, NamedSharding(mesh, pspec))
+    return layer

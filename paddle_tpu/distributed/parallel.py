@@ -37,14 +37,22 @@ class DataParallel(Layer):
 
     @no_grad()
     def apply_collective_grads(self):
+        """Average gradients across data-parallel replicas.
+
+        Single-controller SPMD holds ONE model replica per process — the real
+        gradient psum happens inside the jitted step via the 'dp' mesh axis
+        (GSPMD inserts it; the EagerReducer's bucketing/overlap is XLA's
+        latency-hiding scheduler).  This eager method is therefore a no-op
+        unless a gradient was explicitly built with the stacked per-rank
+        convention (leading dim == nranks AND param marked stacked)."""
         n = self.group.nranks if self.group is not None else get_world_size()
         if n <= 1:
             return
         for p in self._layers.parameters():
-            if p._grad is not None:
+            if p._grad is not None and getattr(p, "dp_stacked_grad", False):
                 g = Tensor(p._grad)
-                all_reduce(g, op=ReduceOp.SUM, group=self.group)
-                p._grad = g._value / n
+                all_reduce(g, op=ReduceOp.AVG, group=self.group)
+                p._grad = g._value
 
     # delegate the Layer surface to the wrapped module
     def state_dict(self, *args, **kwargs):

@@ -1,0 +1,131 @@
+"""Context parallelism: ring attention + Ulysses (all-to-all) attention.
+
+Reference: the 'sep' topology axis (fleet/base/topology.py:77,
+SegmentParallel meta_parallel/segment_parallel.py:26).  The reference keeps the
+attention-level kernels out-of-core (composed in PaddleNLP over sep-axis
+collectives); here they are in-core and TPU-native (SURVEY.md §5 "Long
+context"):
+
+- **ring_attention**: q stays local (seq sharded over the axis); K/V blocks
+  rotate around the ring with ``lax.ppermute`` over ICI while an online-softmax
+  accumulator (the flash-attention recurrence in fp32) folds in one block per
+  step — seq-length memory is O(S/n) per chip and comm overlaps compute.
+- **ulysses_attention**: ``lax.all_to_all`` swaps the shard dim from sequence to
+  heads, runs full-sequence local attention (the Pallas flash kernel), and swaps
+  back — the alltoall-over-heads scheme.
+
+Both are meant to run inside ``shard_map`` with the sequence axis bound (see
+paddle_tpu.models.llama / tests).  Differentiable via jax.grad (pure lax ops,
+custom vjp comes from the composed graph).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _block_attn_update(q, k, v, m, l, acc, scale, mask):
+    """One online-softmax accumulation step.
+    q: [b, sq, h, d]; k/v: [b, skv, h, d]; m,l: [b, h, sq, 1]; acc: [b, h, sq, d].
+    mask: [sq, skv] bool (True = attend) or None."""
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [b, h, sq, d]
+    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    # guard fully-masked rows (m_new == NEG_INF)
+    m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(jnp.where(m_new <= NEG_INF / 2, NEG_INF, s - m_safe))
+    alpha = jnp.where(m_new <= NEG_INF / 2, 1.0, jnp.exp(m - m_new))
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True, scale=None):
+    """Ring attention over the bound mesh axis.
+
+    q, k, v: LOCAL shards [b, s_local, h, d]; the global sequence is the
+    concatenation over the axis in axis-index order.  Returns the local output
+    shard [b, s_local, h, d]."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    # GQA: keep the COMPACT kv rotating on the ring (h/h_kv less ICI traffic)
+    # and expand to q heads locally per received block.
+    kv_rep = h // k.shape[2]
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+    perm = [(i, (i + 1) % n) for i in range(n)]  # kv blocks rotate to the next rank
+
+    def body(r, carry):
+        kk, vv, m, l, acc = carry
+        src = (idx - r) % n  # which global block this kv currently is
+        if causal:
+            # global causal mask between my q rows and this kv block's columns
+            q_glob = idx * s_loc + rows
+            k_glob = src * s_loc + cols
+            mask = q_glob >= k_glob
+        else:
+            mask = None
+        k_full = jnp.repeat(kk, kv_rep, axis=2) if kv_rep > 1 else kk
+        v_full = jnp.repeat(vv, kv_rep, axis=2) if kv_rep > 1 else vv
+        m, l, acc = _block_attn_update(q, k_full, v_full, m, l, acc, scale, mask)
+        kk = jax.lax.ppermute(kk, axis_name, perm)
+        vv = jax.lax.ppermute(vv, axis_name, perm)
+        return kk, vv, m, l, acc
+
+    m0 = jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    try:  # mark the accumulators device-varying over the ring axis (shard_map typing)
+        m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), (axis_name,), to="varying")
+    except Exception:
+        pass
+    _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe).astype(q.dtype)
+    return jnp.swapaxes(out, 1, 2)  # back to [b, s_local, h, d]
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True, scale=None, use_flash=True):
+    """Ulysses: alltoall heads<->sequence, local full-seq attention, alltoall back.
+
+    q,k,v: LOCAL shards [b, s_local, h, d] with h divisible by the axis size."""
+    n = jax.lax.axis_size(axis_name)
+    if k.shape[2] != q.shape[2] and k.shape[2] < n:
+        # GQA with fewer kv heads than ranks: repeat kv heads so the head
+        # alltoall divides evenly (same pre-repeat as ring_attention)
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    def seq2head(t):
+        # [b, s_loc, h, d] -> [b, s_glob, h/n, d]
+        return jax.lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def head2seq(t):
+        return jax.lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    if use_flash:
+        from .pallas import flash_attention as fa
+
+        out = fa.flash_attention_bshd(qg, kg, vg, causal=causal, scale=scale)
+    else:
+        from .pallas.flash_attention import _composed_attention
+
+        out = _composed_attention(qg, kg, vg, None, causal, scale or 1.0 / math.sqrt(q.shape[-1]))
+    return head2seq(out)

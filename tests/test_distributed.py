@@ -1,0 +1,452 @@
+"""Distributed tests on the 8-device virtual CPU mesh (mirrors test/collective/
+— collective parity vs numpy on N ranks; test/auto_parallel/reshard_*; fleet
+topology tests; pipeline schedule golden strings)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet import topology as topo
+
+rng = np.random.RandomState(9)
+
+
+def _mesh1d(n=8, name="x"):
+    return Mesh(np.array(jax.devices()[:n]), axis_names=(name,))
+
+
+# ---------------- eager stacked-view collectives (paddle API shape) ----------------
+
+def test_eager_all_reduce_and_broadcast():
+    locals_ = [rng.rand(3).astype(np.float32) for _ in range(4)]
+    x = dist.from_rank_list([paddle.to_tensor(v) for v in locals_])
+    dist.all_reduce(x)
+    for t in dist.to_rank_list(x):
+        np.testing.assert_allclose(t.numpy(), sum(locals_), rtol=1e-6)
+
+    x = dist.from_rank_list([paddle.to_tensor(v) for v in locals_])
+    dist.broadcast(x, src=2)
+    for t in dist.to_rank_list(x):
+        np.testing.assert_allclose(t.numpy(), locals_[2])
+
+
+def test_eager_all_gather_reduce_scatter_alltoall():
+    g = dist.new_group(list(range(4)))
+    locals_ = [rng.rand(2).astype(np.float32) for _ in range(4)]
+    x = dist.from_rank_list([paddle.to_tensor(v) for v in locals_], g)
+    out = []
+    dist.all_gather(out, x, group=g)
+    assert len(out) == 4
+    # reduce_scatter: each rank gets its chunk of the sum
+    stacked = [np.tile(v, 4) for v in locals_]  # each rank holds 8 elems
+    x = dist.from_rank_list([paddle.to_tensor(v) for v in stacked], g)
+    rs = dist.reduce_scatter(x, group=g)
+    total = np.sum(stacked, axis=0)
+    for i, t in enumerate(dist.to_rank_list(rs, g)):
+        np.testing.assert_allclose(t.numpy(), total[i * 2 : (i + 1) * 2], rtol=1e-6)
+    # alltoall on stacked [n, n, k] view: transpose of rank blocks
+    msgs = rng.rand(4, 4, 2).astype(np.float32)
+    out = dist.alltoall(paddle.to_tensor(msgs))
+    np.testing.assert_allclose(out.numpy(), msgs.swapaxes(0, 1))
+
+
+# ---------------- in-jit collectives over a real device mesh ----------------
+
+def test_shard_map_collectives_match_numpy(eight_devices):
+    mesh = _mesh1d(8)
+    g = dist.Group(list(range(8)), axis_name="x")
+    data = rng.rand(8, 4).astype(np.float32)
+
+    @jax.jit
+    def run(arr):
+        def inner(local):
+            t = paddle.Tensor(local)
+            s = dist.all_reduce(t, group=g)
+            ag = dist.all_gather(paddle.Tensor(local), group=g, axis=0)
+            rsc = dist.reduce_scatter(paddle.Tensor(jnp.tile(local, (8, 1))), group=g, axis=0)
+            return s.value(), ag.value(), rsc.value()
+
+        return shard_map(
+            inner, mesh=mesh, in_specs=P("x", None),
+            out_specs=(P("x", None), P("x", None), P("x", None)),
+        )(arr)
+
+    s, ag, rsc = run(data)
+    # all_reduce: every rank row = column-sum  → stacked back: 8 identical rows
+    np.testing.assert_allclose(np.asarray(s)[0], data.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(s), np.tile(data.sum(0), (8, 1)), rtol=1e-5)
+    # all_gather tiled on axis 0: full data on every rank → global [64, 4]
+    np.testing.assert_allclose(np.asarray(ag)[:8], data, rtol=1e-6)
+    # reduce_scatter of tile(local,(8,1)): rank i gets sum over ranks of row i
+    np.testing.assert_allclose(np.asarray(rsc)[0], data.sum(0), rtol=1e-5)
+
+
+def test_shard_map_ppermute_send_semantics(eight_devices):
+    mesh = _mesh1d(4)
+    data = np.arange(4, dtype=np.float32).reshape(4, 1)
+
+    @jax.jit
+    def ring(arr):
+        def inner(local):
+            return jax.lax.ppermute(local, "x", [(i, (i + 1) % 4) for i in range(4)])
+
+        return shard_map(inner, mesh=mesh, in_specs=P("x", None), out_specs=P("x", None))(arr)
+
+    out = ring(data)
+    np.testing.assert_allclose(np.asarray(out).ravel(), [3, 0, 1, 2])
+
+
+# ---------------- DTensor: shard_tensor / reshard ----------------
+
+def test_shard_tensor_and_reshard(eight_devices):
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+    data = rng.rand(8, 12).astype(np.float32)
+    t = dist.shard_tensor(data, mesh, [dist.Shard(0), dist.Shard(1)])
+    np.testing.assert_allclose(t.numpy(), data)  # global view intact
+    shard0 = t.value().addressable_shards[0]
+    assert shard0.data.shape == (4, 3)  # 8/2 x 12/4
+
+    r = dist.reshard(t, mesh, [dist.Replicate(), dist.Replicate()])
+    assert r.value().addressable_shards[0].data.shape == (8, 12)
+    np.testing.assert_allclose(r.numpy(), data)
+
+    s2 = dist.reshard(r, mesh, [dist.Shard(1), dist.Shard(0)])
+    assert s2.value().addressable_shards[0].data.shape == (2, 6)
+
+    local = dist.dtensor_to_local(s2)
+    assert local.shape == (2, 6)
+    un = dist.unshard_dtensor(s2)
+    np.testing.assert_allclose(un.numpy(), data)
+
+
+def test_shard_layer_replicates_params(eight_devices):
+    mesh = dist.ProcessMesh(np.arange(8), dim_names=["x"])
+    layer = paddle.nn.Linear(4, 4)
+    dist.shard_layer(layer, mesh)
+    out = layer(paddle.to_tensor(rng.rand(2, 4).astype(np.float32)))
+    assert out.shape == (2, 4)
+
+
+# ---------------- topology / fleet ----------------
+
+def test_communicate_topology_groups():
+    t = topo.CommunicateTopology(["data", "pipe", "sharding", "sep", "model"], [2, 2, 1, 1, 2])
+    assert t.world_size() == 8
+    assert t.get_dim("model") == 2
+    # comm groups along 'model': pairs of adjacent ranks
+    groups = t.get_comm_list("model")
+    assert len(groups) == 4 and all(len(g) == 2 for g in groups)
+    flat = sorted(r for g in groups for r in g)
+    assert flat == list(range(8))
+    # coord roundtrip
+    for r in range(8):
+        assert t.get_rank(**dict(zip(t.get_hybrid_group_names(), t.get_coord(r)))) == r
+    # fused dp+sep groups (topology.py:256)
+    fused = t.get_fused_ranks(["data", "sep"])
+    assert all(len(g) == 2 for g in fused)
+
+
+def test_fleet_init_and_hcg(eight_devices):
+    import paddle_tpu.distributed.fleet as fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1, "sharding_degree": 2, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.get_sharding_parallel_world_size() == 2
+    assert hcg.mesh.shape == {"data": 2, "pipe": 1, "sharding": 2, "sep": 1, "model": 2}
+
+
+# ---------------- TP layers under shard_map (hybrid_parallel_mp_layers analog) --------
+
+def test_column_row_parallel_linear_parity(eight_devices):
+    from paddle_tpu.distributed.fleet import mpu
+
+    n = 4
+    mesh = Mesh(np.array(jax.devices()[:n]), axis_names=("model",))
+    in_f, out_f = 8, 12
+    w1 = rng.rand(in_f, out_f).astype(np.float32)
+    w2 = rng.rand(out_f, in_f).astype(np.float32)
+    x = rng.rand(2, in_f).astype(np.float32)
+
+    # dense oracle
+    expect = (x @ w1) @ w2
+
+    @jax.jit
+    def run(xv, w1v, w2v):
+        def inner(xl, w1l, w2l):
+            # column: local out = x @ w1_shard ; keep parallel, feed row layer
+            h = xl @ w1l
+            out = h @ w2l
+            return jax.lax.psum(out, "model")
+
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(None, None), P(None, "model"), P("model", None)),
+            out_specs=P(None, None),
+        )(xv, w1v, w2v)
+
+    got = run(x, w1, w2)
+    np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-4, atol=1e-5)
+
+    # the Layer classes in shard_map mode
+    col = mpu.ColumnParallelLinear(in_f, out_f, has_bias=False, gather_output=False)
+    row = mpu.RowParallelLinear(out_f, in_f, has_bias=False, input_is_parallel=True)
+    col.weight.set_value(w1)
+    row.weight.set_value(w2)
+
+    @jax.jit
+    def run_layers(xv, w1v, w2v):
+        def inner(xl, w1l, w2l):
+            col.weight._value = w1l
+            row.weight._value = w2l
+            h = col(paddle.Tensor(xl))
+            out = row(h)
+            return out.value()
+
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(None, None), P(None, "model"), P("model", None)),
+            out_specs=P(None, None),
+        )(xv, w1v, w2v)
+
+    got2 = run_layers(x, w1, w2)
+    np.testing.assert_allclose(np.asarray(got2), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_vocab_parallel_embedding_parity(eight_devices):
+    from paddle_tpu.distributed.fleet import mpu
+
+    n = 4
+    mesh = Mesh(np.array(jax.devices()[:n]), axis_names=("model",))
+    vocab, dim = 16, 6
+    table = rng.rand(vocab, dim).astype(np.float32)
+    ids = rng.randint(0, vocab, (3, 5))
+    emb = mpu.VocabParallelEmbedding(vocab, dim)
+
+    @jax.jit
+    def run(idv, wv):
+        def inner(idl, wl):
+            emb.weight._value = wl
+            return emb(paddle.Tensor(idl)).value()
+
+        return shard_map(
+            inner, mesh=mesh, in_specs=(P(None, None), P("model", None)), out_specs=P(None, None)
+        )(idv, wv)
+
+    got = run(ids, table)
+    np.testing.assert_allclose(np.asarray(got), table[ids], rtol=1e-6)
+
+
+def test_parallel_cross_entropy_parity(eight_devices):
+    from paddle_tpu.distributed.fleet import mpu
+
+    n = 4
+    mesh = Mesh(np.array(jax.devices()[:n]), axis_names=("model",))
+    b, v = 6, 16
+    logits = rng.rand(b, v).astype(np.float32) * 4
+    labels = rng.randint(0, v, (b,))
+    # numpy oracle
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expect = -np.log(p[np.arange(b), labels])
+    pce = mpu.ParallelCrossEntropy()
+
+    @jax.jit
+    def run(lg, lb):
+        def inner(lgl, lbl):
+            return pce(paddle.Tensor(lgl), paddle.Tensor(lbl)).value()
+
+        return shard_map(
+            inner, mesh=mesh, in_specs=(P(None, "model"), P(None)), out_specs=P(None, None)
+        )(lg, lb)
+
+    got = np.asarray(run(logits, labels))[:, 0]
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+# ---------------- ring / ulysses attention (sep axis) ----------------
+
+def _full_attention(q, k, v, causal=True):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    qh = q.transpose(0, 2, 1, 3).astype(np.float64)
+    kh = k.transpose(0, 2, 1, 3).astype(np.float64)
+    vh = v.transpose(0, 2, 1, 3).astype(np.float64)
+    s = np.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if causal:
+        sq = s.shape[-2]
+        mask = np.tril(np.ones((sq, sq), bool))
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    out = np.einsum("bhqk,bhkd->bhqd", p, vh)
+    return out.transpose(0, 2, 1, 3).astype(np.float32)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_full(eight_devices, causal):
+    from paddle_tpu.ops.ring_attention import ring_attention
+
+    n = 4
+    mesh = Mesh(np.array(jax.devices()[:n]), axis_names=("sep",))
+    b, s, h, d = 2, 32, 4, 8
+    q = rng.randn(b, s, h, d).astype(np.float32) * 0.3
+    k = rng.randn(b, s, h, d).astype(np.float32) * 0.3
+    v = rng.randn(b, s, h, d).astype(np.float32) * 0.3
+    expect = _full_attention(q, k, v, causal)
+
+    @jax.jit
+    def run(qv, kv, vv):
+        return shard_map(
+            lambda a, b_, c: ring_attention(a, b_, c, "sep", causal=causal),
+            mesh=mesh,
+            in_specs=(P(None, "sep"), P(None, "sep"), P(None, "sep")),
+            out_specs=P(None, "sep"),
+        )(qv, kv, vv)
+
+    got = np.asarray(run(q, k, v))
+    np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_ulysses_attention_matches_full(eight_devices):
+    from paddle_tpu.ops.ring_attention import ulysses_attention
+
+    n = 4
+    mesh = Mesh(np.array(jax.devices()[:n]), axis_names=("sep",))
+    b, s, h, d = 2, 32, 4, 8
+    q = rng.randn(b, s, h, d).astype(np.float32) * 0.3
+    k = rng.randn(b, s, h, d).astype(np.float32) * 0.3
+    v = rng.randn(b, s, h, d).astype(np.float32) * 0.3
+    expect = _full_attention(q, k, v, True)
+
+    @jax.jit
+    def run(qv, kv, vv):
+        return shard_map(
+            lambda a, b_, c: ulysses_attention(a, b_, c, "sep", causal=True, use_flash=False),
+            mesh=mesh,
+            in_specs=(P(None, "sep"), P(None, "sep"), P(None, "sep")),
+            out_specs=P(None, "sep"),
+        )(qv, kv, vv)
+
+    got = np.asarray(run(q, k, v))
+    np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_ring_attention_grad_finite(eight_devices):
+    from paddle_tpu.ops.ring_attention import ring_attention
+
+    n = 2
+    mesh = Mesh(np.array(jax.devices()[:n]), axis_names=("sep",))
+    b, s, h, d = 1, 16, 2, 4
+    q = rng.randn(b, s, h, d).astype(np.float32) * 0.3
+    k = rng.randn(b, s, h, d).astype(np.float32) * 0.3
+    v = rng.randn(b, s, h, d).astype(np.float32) * 0.3
+
+    def loss(qv, kv, vv):
+        out = shard_map(
+            lambda a, b_, c: ring_attention(a, b_, c, "sep", causal=True),
+            mesh=mesh,
+            in_specs=(P(None, "sep"), P(None, "sep"), P(None, "sep")),
+            out_specs=P(None, "sep"),
+        )(qv, kv, vv)
+        return jnp.sum(out**2)
+
+    g = jax.jit(jax.grad(loss))(q, k, v)
+    assert np.isfinite(np.asarray(g)).all()
+    # numeric spot-check on one element
+    eps = 1e-2
+    qp = q.copy(); qp[0, 3, 1, 2] += eps
+    qm = q.copy(); qm[0, 3, 1, 2] -= eps
+    lp = float(jax.jit(loss)(qp, k, v)); lm = float(jax.jit(loss)(qm, k, v))
+    np.testing.assert_allclose(np.asarray(g)[0, 3, 1, 2], (lp - lm) / (2 * eps), rtol=0.05, atol=1e-3)
+
+
+# ---------------- pipeline schedules (golden strings) ----------------
+
+def test_pipeline_schedules_golden():
+    from paddle_tpu.distributed.fleet.pipeline import (
+        format_schedule, schedule_1f1b, schedule_fthenb, schedule_zero_bubble,
+    )
+
+    s = format_schedule(schedule_fthenb(2, 3))
+    assert s == "stage0: F0 F1 F2 B0 B1 B2\nstage1: F0 F1 F2 B0 B1 B2"
+
+    s = format_schedule(schedule_1f1b(2, 4))
+    # stage0 warms up 1 forward; stage1 none
+    assert s.splitlines()[0] == "stage0: F0 F1 B0 F2 B1 F3 B2 B3"
+    assert s.splitlines()[1] == "stage1: F0 B0 F1 B1 F2 B2 F3 B3"
+
+    zb = schedule_zero_bubble(2, 4)
+    # every microbatch gets F, B and W on every stage
+    for stage in zb:
+        phases = {}
+        for t in stage:
+            phases.setdefault(t.phase, []).append(t.mb)
+        assert sorted(phases["F"]) == [0, 1, 2, 3]
+        assert sorted(phases["B"]) == [0, 1, 2, 3]
+        assert sorted(phases["W"]) == [0, 1, 2, 3]
+        # W for a microbatch never precedes its B
+        for mbi in range(4):
+            assert stage.index(next(t for t in stage if t.phase == "W" and t.mb == mbi)) > stage.index(
+                next(t for t in stage if t.phase == "B" and t.mb == mbi)
+            )
+
+
+def test_pipeline_layer_and_train_batch():
+    from paddle_tpu.distributed.fleet.pipeline import LayerDesc, PipelineLayer, PipelineParallel
+    from paddle_tpu import nn, optimizer
+
+    descs = [
+        LayerDesc(nn.Linear, 8, 16),
+        LayerDesc(nn.ReLU),
+        LayerDesc(nn.Linear, 16, 16),
+        LayerDesc(nn.ReLU),
+        LayerDesc(nn.Linear, 16, 4),
+    ]
+    pipe = PipelineLayer(descs, num_stages=2, loss_fn=nn.MSELoss())
+    assert pipe.segment_parts[0] == 0 and pipe.segment_parts[-1] == 5
+
+    class Strat:
+        pipeline_configs = {"accumulate_steps": 2, "schedule_mode": "1F1B"}
+
+    pp = PipelineParallel(pipe, strategy=Strat())
+    sched = pp.static_scheduler(4)
+    assert "stage0" in sched and "stage1" in sched
+
+    opt = optimizer.SGD(learning_rate=0.01, parameters=pipe.parameters())
+    x = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+    l0 = float(pp.train_batch((x, y), opt))
+    for _ in range(10):
+        l = float(pp.train_batch((x, y), opt))
+    assert l < l0
+
+
+# ---------------- DataParallel eager wrapper ----------------
+
+def test_data_parallel_grad_sync():
+    g = dist.new_group(list(range(2)))
+    model = paddle.nn.Linear(3, 1, bias_attr=False)
+    dp = dist.DataParallel(model, group=g)
+    x = paddle.to_tensor(rng.rand(2, 3).astype(np.float32))
+    dp(x).sum().backward()
+    g0 = model.weight.grad.numpy().copy()
+    # single replica: apply_collective_grads must be a no-op (dp psum lives in jit)
+    dp.apply_collective_grads()
+    np.testing.assert_allclose(np.asarray(model.weight._grad), g0)
+    # stacked per-rank convention: leading dim = nranks, marked → averaged
+    model.weight.dp_stacked_grad = True
+    stacked = np.stack([g0, 3 * g0])  # pretend rank grads
+    model.weight._grad = paddle.to_tensor(stacked).value()
+    dp.apply_collective_grads()
+    np.testing.assert_allclose(
+        np.asarray(model.weight._grad), np.stack([2 * g0, 2 * g0]), rtol=1e-6
+    )
