@@ -5,6 +5,7 @@ fleet (hybrid parallel), auto_parallel (DTensor/GSPMD), sharding (ZeRO),
 checkpoint (sharded save/load with reshard-on-load), launch."""
 
 from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     Partial,
     Placement,

@@ -1,0 +1,136 @@
+"""Dygraph sharding optimizers (reference:
+fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py —
+``DygraphShardingOptimizer`` at :54 (stage-1, whole-param assignment) and
+``DygraphShardingOptimizerV2`` at :592 (param-buffer slicing,
+``shard_split_param``)).
+
+TPU-native: the rank→param assignment is kept (it is real, testable placement
+logic and drives the sharded checkpoint layout); the comm ops of the reference
+(broadcast of updated params, reduce-scatter of grads) are placement changes
+XLA materializes as ICI collectives."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding
+
+from ..sharding import shard_spec_for, _sharding_mesh
+
+__all__ = ["DygraphShardingOptimizer", "DygraphShardingOptimizerV2"]
+
+
+def balanced_partition(sizes, k):
+    """Greedy size-balanced assignment of items to k buckets (the reference's
+    `_partition_parameters`, dygraph_sharding_optimizer.py:99): items in
+    descending size order, each to the currently lightest bucket.
+    Returns bucket->item-index list."""
+    order = sorted(range(len(sizes)), key=lambda i: -sizes[i])
+    buckets = [[] for _ in range(k)]
+    loads = [0] * k
+    for i in order:
+        b = int(np.argmin(loads))
+        buckets[b].append(i)
+        loads[b] += sizes[i]
+    for b in buckets:
+        b.sort()
+    return buckets
+
+
+class DygraphShardingOptimizer:
+    """Stage-1 sharding: each sharding rank owns the optimizer states of a
+    size-balanced subset of parameters."""
+
+    def __init__(self, optimizer, hcg=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        params = optimizer._parameter_list or []
+        self._parameter_list = list(params)
+        self.mesh, self.axis = _sharding_mesh()
+        self._sharding_degree = (
+            hcg.get_sharding_parallel_world_size() if hcg is not None else self.mesh.shape[self.axis]
+        )
+        self._rank2params = self._partition_parameters()
+
+    def _partition_parameters(self):
+        sizes = [int(np.prod(p.shape)) if p.shape else 1 for p in self._parameter_list]
+        buckets = balanced_partition(sizes, max(self._sharding_degree, 1))
+        return {
+            rank: [self._parameter_list[i] for i in idxs]
+            for rank, idxs in enumerate(buckets)
+        }
+
+    @property
+    def rank2params(self):
+        return self._rank2params
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def _shard_states(self):
+        # optimizer states of rank-r's params are placed on the rank-r slice of
+        # the sharding axis; single-controller realization: shard the arrays
+        for key, st in list(self._inner_opt._accumulators.items()):
+            self._inner_opt._accumulators[key] = {
+                k: (
+                    jax.device_put(v, NamedSharding(self.mesh, shard_spec_for(v.shape, self.mesh, self.axis)))
+                    if not isinstance(v, jax.core.Tracer)
+                    else v
+                )
+                for k, v in st.items()
+            }
+
+    def step(self):
+        self._inner_opt.step()
+        self._shard_states()
+
+    def reduce_gradients(self, parameter_list=None, hcg=None):
+        """Grad sync point (reference :318): with stacked-eager dp the psum is
+        already in the step function; here we only re-place grads sharded."""
+        for p in parameter_list or self._parameter_list:
+            if p._grad is not None and not isinstance(p._grad, jax.core.Tracer):
+                spec = shard_spec_for(p._grad.shape, self.mesh, self.axis)
+                p._grad = jax.device_put(p._grad, NamedSharding(self.mesh, spec))
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+
+class DygraphShardingOptimizerV2(DygraphShardingOptimizer):
+    """V2 = param-buffer slicing (`shard_split_param`): every param's flat
+    buffer is split evenly across sharding ranks instead of whole-param
+    assignment — smoother balance, same API (reference :592)."""
+
+    def __init__(self, optimizer, hcg=None):
+        super().__init__(optimizer, hcg)
+        self.comm_buffer_size_MB = 256
+
+    def _partition_parameters(self):
+        # every param belongs to every rank (1/k slice each)
+        return {
+            rank: list(self._parameter_list)
+            for rank in range(max(self._sharding_degree, 1))
+        }
+
+    def local_slice(self, p, rank):
+        """The [start, end) of rank's slice of p's flat buffer."""
+        n = int(np.prod(p.shape)) if p.shape else 1
+        k = max(self._sharding_degree, 1)
+        per = (n + k - 1) // k
+        start = min(rank * per, n)
+        return start, min(start + per, n)

@@ -1,0 +1,245 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference: ``RecomputeFunction`` PyLayer (fleet/recompute/recompute.py:128),
+non-reentrant variant (:327), ``recompute_sequential`` (:630), RNG-state replay
+via ``switch_rng_state_tracker`` (:116), and the offload variant
+(fleet/recompute/recompute_hybrid.py).
+
+TPU-native design — two execution modes, one API:
+
+- **traced** (inputs are jax tracers, i.e. inside jit/pjit): lowers to
+  ``jax.checkpoint`` over the pure function — XLA rematerializes the segment in
+  the backward pass.  This is the performance path; the reference's hand-built
+  forward-replay is exactly what ``jax.checkpoint`` does natively.
+- **eager** (tape mode): a custom tape node whose forward runs under ``no_grad``
+  (activations are dropped) and whose backward replays the function on detached
+  inputs with the tape enabled, then backpropagates the incoming cotangents —
+  the same structure as the reference PyLayer, with {seed, offset} RNG snapshot
+  +restore so dropout masks replay identically (Generator semantics,
+  paddle/phi/core/generator.h:32).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ...core import rng as _rng
+from ...core.tensor import Tensor, TapeNode, _unwrap, is_grad_enabled, no_grad
+from .mpu import get_rng_state_tracker
+
+__all__ = ["recompute", "recompute_sequential", "recompute_hybrid", "switch_rng_state_tracker"]
+
+
+@contextlib.contextmanager
+def switch_rng_state_tracker(rng_state, tracker_states):
+    """Swap in a saved RNG snapshot for the replay, restoring on exit
+    (reference: fleet/recompute/recompute.py:116)."""
+    cur = _rng.get_rng_state()
+    tracker = get_rng_state_tracker()
+    cur_tracker = tracker.get_states_tracker()
+    _rng.set_rng_state(rng_state)
+    tracker.set_states_tracker(tracker_states)
+    try:
+        yield
+    finally:
+        _rng.set_rng_state(cur)
+        tracker.set_states_tracker(cur_tracker)
+
+
+def _tensor_leaves(args, kwargs):
+    leaves = []
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, Tensor):
+            leaves.append(a)
+    return leaves
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function`` without storing intermediate activations; recompute them
+    in the backward pass.  API-compatible with ``paddle.distributed.fleet.utils
+    .recompute`` — accepts ``use_reentrant`` and ``preserve_rng_state``."""
+    kwargs.pop("use_reentrant", True)  # both variants share the replay engine here
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    offload_to_host = kwargs.pop("_offload", False)
+
+    tensor_inputs = _tensor_leaves(args, kwargs)
+    vals = [_unwrap(t) for t in tensor_inputs]
+    tracing = any(isinstance(v, jax.core.Tracer) for v in vals)
+
+    if tracing:
+        # in-program: pure-function remat via jax.checkpoint
+        def pure(*tvals):
+            it = iter(tvals)
+            new_args = [Tensor(next(it)) if isinstance(a, Tensor) else a for a in args]
+            new_kwargs = {
+                k: (Tensor(next(it)) if isinstance(v, Tensor) else v)
+                for k, v in kwargs.items()
+            }
+            out = function(*new_args, **new_kwargs)
+            if isinstance(out, (tuple, list)):
+                return tuple(None if o is None else _unwrap(o) for o in out)
+            return _unwrap(out)
+
+        out = jax.checkpoint(pure)(*vals)
+        if isinstance(out, tuple):
+            return tuple(None if o is None else Tensor(o) for o in out)
+        return Tensor(out)
+
+    parents = [t for t in tensor_inputs if not t.stop_gradient]
+    # parameters captured in the function's closure (a Layer) also make the
+    # output differentiable — their grads accumulate during the replay backward
+    closure_requires_grad = False
+    if hasattr(function, "parameters") and callable(function.parameters):
+        closure_requires_grad = any(
+            not p.stop_gradient for p in function.parameters()
+        )
+    needs_grad = is_grad_enabled() and (parents or closure_requires_grad)
+
+    if preserve_rng_state:
+        saved_rng = _rng.get_rng_state()
+        saved_tracker = get_rng_state_tracker().get_states_tracker()
+
+    with no_grad():
+        out = function(*args, **kwargs)
+    if not needs_grad:
+        return out
+
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    # non-Tensor outputs (None, python scalars — e.g. a block's (hidden, None)
+    # cache slot) pass through untouched; only tensors join the tape node
+    tensor_out_idx = [
+        i for i, o in enumerate(outs) if isinstance(o, Tensor) or hasattr(o, "shape")
+    ]
+    outs = [
+        (o if isinstance(o, Tensor) else Tensor(o)) if i in tensor_out_idx else o
+        for i, o in enumerate(outs)
+    ]
+
+    # saved inputs for the replay — detached; optionally parked in host RAM
+    # (recompute_hybrid's offload, reference recompute_hybrid.py)
+    def park(v):
+        if offload_to_host:
+            cpu = jax.devices("cpu")[0] if jax.devices("cpu") else None
+            return jax.device_put(v, cpu) if cpu is not None else v
+        return v
+
+    saved_args = [
+        (park(_unwrap(a)), True) if isinstance(a, Tensor) else (a, False) for a in args
+    ]
+    saved_kwargs = {
+        k: ((park(_unwrap(v)), True) if isinstance(v, Tensor) else (v, False))
+        for k, v in kwargs.items()
+    }
+    grad_flags = {
+        id(t): not t.stop_gradient for t in tensor_inputs
+    }
+
+    def vjp_fn(couts):
+        cot = couts if isinstance(couts, tuple) else (couts,)
+        # rebuild detached inputs that require grad where the originals did
+        replay_parents = []
+
+        def revive(v, was_tensor, orig):
+            if not was_tensor:
+                return v
+            t = Tensor(jax.device_put(v), stop_gradient=not grad_flags.get(id(orig), False))
+            if not t.stop_gradient:
+                replay_parents.append(t)
+            return t
+
+        new_args = [revive(v, f, o) for (v, f), o in zip(saved_args, args)]
+        new_kwargs = {
+            k: revive(v, f, kwargs[k]) for k, (v, f) in saved_kwargs.items()
+        }
+
+        ctx = (
+            switch_rng_state_tracker(saved_rng, saved_tracker)
+            if preserve_rng_state
+            else contextlib.nullcontext()
+        )
+        with ctx:
+            replay_out = function(*new_args, **new_kwargs)
+        replay_outs = (
+            list(replay_out) if isinstance(replay_out, (tuple, list)) else [replay_out]
+        )
+        replay_outs = [replay_outs[i] for i in tensor_out_idx]
+        from ... import autograd
+
+        live = [
+            (o, Tensor(c))
+            for o, c in zip(replay_outs, cot)
+            if isinstance(o, Tensor) and not o.stop_gradient and c is not None
+        ]
+        if live:
+            autograd.backward([o for o, _ in live], [c for _, c in live])
+        grads = []
+        it = iter(replay_parents)
+        for t in parents:
+            rp = next(it, None)
+            grads.append(None if rp is None or rp._grad is None else rp._grad)
+        return tuple(grads)
+
+    tape_outs = [outs[i] for i in tensor_out_idx]
+    node = TapeNode(
+        "recompute", vjp_fn, parents, [(o.shape, o.dtype) for o in tape_outs]
+    )
+    for i, o in enumerate(tape_outs):
+        o.stop_gradient = False
+        o._node = node
+        o._out_idx = i
+    return tuple(outs) if multi else outs[0]
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Chunk a Sequential into segments, recomputing each (reference
+    fleet/recompute/recompute.py:630).  ``ctx`` = {"segments": N,
+    "preserve_rng_state": bool}."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else int(ctx)
+    preserve = ctx.get("preserve_rng_state", True) if isinstance(ctx, dict) else True
+    layers = list(functions) if not hasattr(functions, "children") else list(functions.children())
+    if not layers:
+        layers = [functions]
+    seg_size = max(1, len(layers) // max(segments, 1))
+
+    class _Segment:
+        """Callable segment exposing parameters() so recompute sees the
+        closure params as grad roots."""
+
+        def __init__(self, start, end):
+            self.layers = layers[start:end]
+
+        def parameters(self):
+            for lyr in self.layers:
+                if hasattr(lyr, "parameters"):
+                    yield from lyr.parameters()
+
+        def __call__(self, *xs):
+            out = xs if len(xs) > 1 else xs[0]
+            for lyr in self.layers:
+                out = lyr(*out) if isinstance(out, tuple) else lyr(out)
+            return out
+
+    def run_segment(start, end):
+        return _Segment(start, end)
+
+    out = args
+    i = 0
+    while i < len(layers):
+        end = min(i + seg_size, len(layers))
+        seg = run_segment(i, end)
+        cur = out if isinstance(out, tuple) else (out,)
+        out = recompute(seg, *cur, preserve_rng_state=preserve, **kwargs)
+        i = end
+    return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Recompute with input offload to host RAM (reference recompute_hybrid.py).
+    ``ctx`` carries {"offload_indices": [...], "mp_group": ...} — on TPU the
+    hybrid-parallel RNG determinism comes from the shared tracker, so only the
+    offload knob matters here."""
+    offload = bool(ctx.get("offload_indices")) if isinstance(ctx, dict) else False
+    return recompute(function, *args, _offload=offload, **kwargs)

@@ -1,0 +1,8 @@
+"""fleet.utils (reference: python/paddle/distributed/fleet/utils/__init__.py —
+exposes `recompute` plus helper modules)."""
+
+from ..recompute import recompute, recompute_sequential  # noqa: F401
+from . import tensor_fusion_helper  # noqa: F401
+from .tensor_fusion_helper import fused_parameters  # noqa: F401
+
+__all__ = ["recompute", "recompute_sequential", "fused_parameters", "tensor_fusion_helper"]
