@@ -6,6 +6,8 @@ checkpoint (sharded save/load with reshard-on-load), launch."""
 
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     Partial,
     Placement,

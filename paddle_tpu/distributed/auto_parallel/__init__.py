@@ -1,6 +1,8 @@
 from .api import (  # noqa: F401
     dtensor_from_local,
     dtensor_to_local,
+    moe_global_mesh_tensor,
+    moe_sub_mesh_tensors,
     reshard,
     shard_layer,
     shard_optimizer,

@@ -198,3 +198,50 @@ class ShardingStage2(ShardingStage1):
 
 class ShardingStage3(ShardingStage1):
     pass
+
+
+# ---- MoE sub-mesh APIs (reference: auto_parallel/api.py:495,688 + moe_utils.py) ----
+
+def moe_sub_mesh_tensors(dist_tensor, global_mesh, local_mesh_dim, global_placements):
+    """Split a global expert tensor into per-submesh local tensors — one per
+    slice of `global_mesh` along `local_mesh_dim` (reference api.py:688).
+    The split dim is the tensor dim that `local_mesh_dim` shards."""
+    if local_mesh_dim < 0:
+        local_mesh_dim += global_mesh.ndim
+    axis_name = global_mesh.dim_names[local_mesh_dim]
+    n = global_mesh.shape[local_mesh_dim]
+    placements = _normalize_placements(global_mesh, global_placements)
+    pl = placements[local_mesh_dim]
+    if not isinstance(pl, Shard):
+        raise ValueError(
+            f"global_placements[{local_mesh_dim}] must be Shard for MoE expert split, got {pl}"
+        )
+    split_dim = pl.dim
+    v = _unwrap(dist_tensor)
+    pieces = jnp.split(v, n, axis=split_dim)
+    out = []
+    for i, piece in enumerate(pieces):
+        sub_mesh = global_mesh.get_mesh_with_dim(axis_name, i)
+        sub_placements = [
+            p for j, p in enumerate(placements) if j != local_mesh_dim
+        ]
+        out.append(shard_tensor(Tensor(piece), sub_mesh, sub_placements))
+    return out
+
+
+def moe_global_mesh_tensor(local_tensor_list, mesh, placements, local_mesh_dim=-1):
+    """Inverse of moe_sub_mesh_tensors: assemble per-submesh expert tensors
+    into one global dist tensor (reference api.py:495)."""
+    if local_mesh_dim < 0:
+        local_mesh_dim += mesh.ndim
+    placements = _normalize_placements(mesh, placements)
+    pl = placements[local_mesh_dim]
+    if not isinstance(pl, Shard):
+        raise ValueError(
+            f"placements[{local_mesh_dim}] must be Shard for MoE expert concat, got {pl}"
+        )
+    split_dim = pl.dim
+    # locals live on disjoint sub-meshes — hop through host to reassemble
+    vals = [np.asarray(_unwrap(t)) for t in local_tensor_list]
+    glob = jnp.asarray(np.concatenate(vals, axis=split_dim))
+    return shard_tensor(Tensor(glob), mesh, placements)

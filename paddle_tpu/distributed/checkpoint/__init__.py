@@ -1,0 +1,217 @@
+"""Distributed (sharded) checkpoint with reshard-on-load.
+
+Reference: python/paddle/distributed/checkpoint/ — ``save_state_dict``
+(save_state_dict.py:135: dedup across ranks, async save) and
+``load_state_dict`` (load_state_dict.py:526: builds ReadItems from the overlap
+of stored chunks and target shards, then transfers) with the global manifest in
+metadata.py.
+
+TPU-native: a value saved from a mesh-sharded ``jax.Array`` is written one
+chunk per *distinct* device shard (replicas dedup'd by global offset — the
+reference's cross-rank dedup), each with its global offset.  On load, the
+target's NamedSharding defines the wanted shards; the overlap solver assembles
+each from any stored layout — so a checkpoint written on a dp8 mesh restores
+onto tp4×dp2, a different chip count, or a single host unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+from ...core.tensor import Tensor, _unwrap
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .utils import (
+    ReadItem,
+    compute_read_items,
+    flatten_state_dict,
+    slices_of,
+)
+
+__all__ = ["save_state_dict", "load_state_dict", "Metadata", "LocalTensorMetadata", "LocalTensorIndex"]
+
+_METADATA_FILE = "0.metadata"
+_pending_saves: list[threading.Thread] = []
+
+
+def _as_jax_array(v):
+    if isinstance(v, Tensor):
+        return _unwrap(v)
+    if isinstance(v, (jnp.ndarray, np.ndarray)):
+        return jnp.asarray(v) if isinstance(v, np.ndarray) else v
+    return None
+
+
+def _chunks_of(arr):
+    """Distinct (global_offset, np_data) chunks of a jax array — one per
+    unique device shard; replicated arrays yield a single chunk."""
+    chunks = {}
+    sharding = getattr(arr, "sharding", None)
+    if sharding is not None and hasattr(arr, "addressable_shards") and arr.addressable_shards:
+        for shard in arr.addressable_shards:
+            idx = shard.index  # tuple of slices into the global array
+            offset = tuple(
+                (sl.start or 0) if isinstance(sl, slice) else 0 for sl in idx
+            )
+            if offset not in chunks:
+                chunks[offset] = np.asarray(shard.data)
+    else:
+        chunks[(0,) * arr.ndim] = np.asarray(arr)
+    return chunks
+
+
+def save_state_dict(
+    state_dict,
+    path,
+    process_group=None,
+    coordinator_rank=0,
+    unique_id=None,
+    async_save=False,
+):
+    """Write a sharded checkpoint under `path/`: per-shard data files plus a
+    global metadata manifest."""
+    os.makedirs(path, exist_ok=True)
+    flat = flatten_state_dict(state_dict)
+
+    md = Metadata()
+    # bucket chunks by owning "virtual rank" so the on-disk layout matches the
+    # reference's one-file-per-rank shape (and load exercises multi-file merge)
+    files: dict[str, dict[str, np.ndarray]] = {}
+    for key, v in flat.items():
+        arr = _as_jax_array(v)
+        if arr is None:  # python scalars etc. go into the metadata directly
+            md.tensor_info[key] = {"python_value": v}
+            continue
+        chunk_map = _chunks_of(arr)
+        md.tensor_info[key] = {
+            "global_shape": tuple(arr.shape),
+            "dtype": str(arr.dtype),
+        }
+        metas = []
+        for i, (offset, data) in enumerate(sorted(chunk_map.items())):
+            fname = f"{i}_0.distcp"
+            store_key = f"{key}@{','.join(map(str, offset))}"
+            files.setdefault(fname, {})[store_key] = data
+            metas.append(LocalTensorMetadata(offset, tuple(data.shape), str(data.dtype)))
+            md.storage_metadata[LocalTensorIndex(key, offset)] = fname
+        md.state_dict_metadata[key] = metas
+
+    def _write():
+        for fname, payload in files.items():
+            np.savez(os.path.join(path, fname + ".npz"), **payload)
+        with open(os.path.join(path, _METADATA_FILE), "wb") as f:
+            pickle.dump(md.to_dict(), f, protocol=4)
+
+    if async_save:
+        # data already copied to host numpy above — the thread only does IO
+        # (reference async save forks a subprocess, save_state_dict.py:288)
+        t = threading.Thread(target=_write, daemon=True)
+        t.start()
+        _pending_saves.append(t)
+    else:
+        _write()
+
+
+def wait_async_save():
+    """Block until queued async saves finish (tests + clean shutdown)."""
+    while _pending_saves:
+        _pending_saves.pop().join()
+
+
+def _load_metadata(path) -> Metadata:
+    with open(os.path.join(path, _METADATA_FILE), "rb") as f:
+        return Metadata.from_dict(pickle.load(f))
+
+
+def _target_shards(v):
+    """[(global_offset, shape, device or None), ...] the target wants filled."""
+    arr = _as_jax_array(v)
+    if arr is None:
+        return None
+    sharding = getattr(arr, "sharding", None)
+    if sharding is not None and hasattr(arr, "addressable_shards") and arr.addressable_shards:
+        out = []
+        for shard in arr.addressable_shards:
+            offset = tuple((sl.start or 0) if isinstance(sl, slice) else 0 for sl in shard.index)
+            out.append((offset, tuple(np.asarray(shard.data.shape)), shard.device))
+        return out
+    return [((0,) * arr.ndim, tuple(arr.shape), None)]
+
+
+def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, unique_id=None):
+    """Fill `state_dict`'s values in place from the checkpoint at `path`,
+    resharding stored chunks onto each value's current sharding."""
+    md = _load_metadata(path)
+    flat = flatten_state_dict(state_dict)
+
+    file_cache: dict[str, np.lib.npyio.NpzFile] = {}
+
+    def read_chunk(item: ReadItem):
+        f = file_cache.get(item.file)
+        if f is None:
+            f = np.load(os.path.join(path, item.file + ".npz"))
+            file_cache[item.file] = f
+        store_key = f"{item.tensor_key}@{','.join(map(str, item.chunk_offset))}"
+        return f[store_key]
+
+    def set_leaf(dotted_key, value):
+        parts = dotted_key.split(".")
+        cur = state_dict
+        for p in parts[:-1]:
+            cur = cur[p]
+        cur[parts[-1]] = value
+
+    for key, v in flat.items():
+        if key not in md.state_dict_metadata:
+            if key in md.tensor_info and "python_value" in md.tensor_info[key]:
+                set_leaf(key, md.tensor_info[key]["python_value"])
+                continue
+            raise KeyError(f"{key!r} not found in checkpoint at {path}")
+        info = md.tensor_info[key]
+        targets = _target_shards(v)
+        if targets is None:
+            continue
+        arr = _as_jax_array(v)
+        if tuple(arr.shape) != tuple(info["global_shape"]):
+            raise ValueError(
+                f"shape mismatch loading {key!r}: checkpoint holds "
+                f"{tuple(info['global_shape'])}, target is {tuple(arr.shape)}"
+            )
+        dtype = arr.dtype
+
+        assembled = []
+        for offset, shape, device in targets:
+            buf = np.zeros(shape, dtype=np.dtype(info["dtype"]))
+            for item in compute_read_items(md, key, offset, shape):
+                data = read_chunk(item)
+                buf[slices_of(item.dst_slice)] = data[slices_of(item.src_slice)]
+            assembled.append((offset, buf, device))
+
+        sharding = getattr(arr, "sharding", None)
+        if (
+            isinstance(sharding, NamedSharding)
+            and assembled
+            and assembled[0][2] is not None
+        ):
+            shards = [
+                jax.device_put(jnp.asarray(buf, dtype), dev)
+                for _, buf, dev in assembled
+            ]
+            new = jax.make_array_from_single_device_arrays(
+                tuple(info["global_shape"]), sharding, shards
+            )
+        else:
+            new = jnp.asarray(assembled[0][1], dtype)
+
+        if isinstance(v, Tensor):
+            v._value = new
+        else:
+            set_leaf(key, new)
+    return state_dict

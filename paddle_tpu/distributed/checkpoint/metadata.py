@@ -1,0 +1,67 @@
+"""Checkpoint metadata types.
+
+Reference: python/paddle/distributed/checkpoint/metadata.py —
+``LocalTensorMetadata`` (chunk global_offset + local_shape),
+``LocalTensorIndex`` (tensor key + offset → storage file) and ``Metadata``
+(the global manifest written once per checkpoint).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class LocalTensorMetadata:
+    """One stored chunk of a (possibly sharded) tensor."""
+
+    global_offset: tuple
+    local_shape: tuple
+    dtype: str
+
+    @property
+    def global_end(self):
+        return tuple(o + s for o, s in zip(self.global_offset, self.local_shape))
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    tensor_key: str
+    global_offset: tuple
+
+
+@dataclass
+class Metadata:
+    # tensor_key -> list of chunk metadata (the union across all saving ranks)
+    state_dict_metadata: dict = field(default_factory=dict)
+    # (tensor_key, global_offset) -> file name holding that chunk
+    storage_metadata: dict = field(default_factory=dict)
+    # tensor_key -> {"global_shape": tuple, "dtype": str}
+    tensor_info: dict = field(default_factory=dict)
+
+    def to_dict(self):
+        return {
+            "state_dict_metadata": {
+                k: [(m.global_offset, m.local_shape, m.dtype) for m in v]
+                for k, v in self.state_dict_metadata.items()
+            },
+            "storage_metadata": {
+                (i.tensor_key, i.global_offset): f
+                for i, f in self.storage_metadata.items()
+            },
+            "tensor_info": self.tensor_info,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        md = cls()
+        md.state_dict_metadata = {
+            k: [LocalTensorMetadata(tuple(o), tuple(s), dt) for o, s, dt in v]
+            for k, v in d["state_dict_metadata"].items()
+        }
+        md.storage_metadata = {
+            LocalTensorIndex(k, tuple(o)): f
+            for (k, o), f in d["storage_metadata"].items()
+        }
+        md.tensor_info = d.get("tensor_info", {})
+        return md

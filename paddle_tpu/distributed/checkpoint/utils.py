@@ -1,0 +1,80 @@
+"""Checkpoint helpers: state-dict flattening and the chunk-overlap solver.
+
+Reference: python/paddle/distributed/checkpoint/utils.py (flatten) and the
+ReadItem construction inside load_state_dict.py:394-444 — for every target
+shard, intersect with every stored chunk and emit copy regions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+def flatten_state_dict(state_dict, prefix=""):
+    """Nested dicts -> {"a.b.c": leaf} (reference utils.flatten_state_dict)."""
+    flat = {}
+    for k, v in state_dict.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            flat.update(flatten_state_dict(v, key))
+        else:
+            flat[key] = v
+    return flat
+
+
+def unflatten_state_dict(flat):
+    out = {}
+    for k, v in flat.items():
+        parts = k.split(".")
+        cur = out
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = v
+    return out
+
+
+@dataclass(frozen=True)
+class ReadItem:
+    """One copy region: stored chunk slice -> target shard slice."""
+
+    tensor_key: str
+    file: str
+    chunk_offset: tuple      # chunk's global offset
+    src_slice: tuple         # slice within the stored chunk (per-dim (start, len))
+    dst_slice: tuple         # slice within the target shard (per-dim (start, len))
+
+
+def overlap(src_off, src_shape, dst_off, dst_shape):
+    """Intersection of two boxes in global index space.
+    Returns (src_slice, dst_slice) as per-dim (start, len) tuples, or None."""
+    src_sl, dst_sl = [], []
+    for so, ss, do, ds in zip(src_off, src_shape, dst_off, dst_shape):
+        lo = max(so, do)
+        hi = min(so + ss, do + ds)
+        if hi <= lo:
+            return None
+        src_sl.append((lo - so, hi - lo))
+        dst_sl.append((lo - do, hi - lo))
+    return tuple(src_sl), tuple(dst_sl)
+
+
+def compute_read_items(metadata, tensor_key, dst_offset, dst_shape):
+    """All ReadItems needed to fill the target shard [dst_offset, +dst_shape)
+    of `tensor_key` from stored chunks (the reshard-on-load solver)."""
+    items = []
+    for chunk in metadata.state_dict_metadata.get(tensor_key, []):
+        ov = overlap(chunk.global_offset, chunk.local_shape, dst_offset, dst_shape)
+        if ov is None:
+            continue
+        src_sl, dst_sl = ov
+        from .metadata import LocalTensorIndex
+
+        f = metadata.storage_metadata[LocalTensorIndex(tensor_key, chunk.global_offset)]
+        items.append(
+            ReadItem(tensor_key, f, chunk.global_offset, src_sl, dst_sl)
+        )
+    return items
+
+
+def slices_of(spans):
+    return tuple(slice(s, s + l) for s, l in spans)

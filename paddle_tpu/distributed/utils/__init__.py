@@ -1,0 +1,3 @@
+"""distributed.utils (reference: python/paddle/distributed/utils/)."""
+
+from .moe_utils import global_gather, global_scatter  # noqa: F401

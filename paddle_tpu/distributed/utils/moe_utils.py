@@ -1,0 +1,85 @@
+"""MoE dispatch collectives (reference: python/paddle/distributed/utils/
+moe_utils.py — ``global_scatter``/``global_gather`` backed by the
+global_scatter/global_gather CUDA kernels + NCCL all-to-all).
+
+Reference semantics: each rank holds rows grouped by destination
+(rank-major, expert-minor); ``local_count[i*n_expert+j]`` = rows this rank
+sends to expert j of rank i; ``global_count`` = rows it receives.  The NCCL
+all-to-all transposes the (src, dst) block matrix.
+
+TPU-native: the in-mesh MoE path routes densely (see incubate MoELayer) and
+GSPMD emits the ICI all-to-all.  These functions keep the explicit
+row-exchange API on the single controller, where the whole world's rows are
+visible at once:
+
+- 1-D ``local_count`` (the per-rank reference form, world folded to 1):
+  the exchange is the identity permutation (already dst-major).
+- 2-D ``local_count[src, dst_bucket]`` (all source ranks' counts stacked,
+  ``x`` = concat of every source's buffer): performs the real (src, dst) ->
+  (dst, src) block transpose — the all-to-all.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, _unwrap, no_grad
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _count_matrix(c):
+    arr = np.asarray(_unwrap(c)).astype(np.int64)
+    return arr.reshape(1, -1) if arr.ndim == 1 else arr
+
+
+def _split_rows(xv, counts_flat):
+    offs = np.cumsum([0] + list(counts_flat))
+    return [xv[offs[i] : offs[i + 1]] for i in range(len(counts_flat))]
+
+
+def _transpose_blocks(xv, cmat):
+    """Rows grouped (src-major, dst-bucket-minor) -> (dst-major, src-minor)."""
+    S, B = cmat.shape  # B = world * n_expert buckets per source
+    pieces = _split_rows(xv, cmat.reshape(-1))  # index = src*B + bucket
+    out = []
+    for b in range(B):
+        for s in range(S):
+            p = pieces[s * B + b]
+            if p.shape[0]:
+                out.append(p)
+    return jnp.concatenate(out, axis=0) if out else xv[:0]
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Exchange expert-bound rows; result holds received rows dst-major."""
+    with no_grad():
+        xv = _unwrap(x)
+        cmat = _count_matrix(local_count)
+        if cmat.shape[0] == 1:
+            return Tensor(xv)  # single source: already dst-major
+        return Tensor(_transpose_blocks(xv, cmat))
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse of global_scatter: received rows return to source order."""
+    with no_grad():
+        xv = _unwrap(x)
+        cmat = _count_matrix(global_count)
+        if cmat.shape[0] == 1:
+            return Tensor(xv)
+        # invert the (src,dst)->(dst,src) transpose: transpose the count
+        # matrix's role and regroup
+        S, B = cmat.shape
+        # received layout: dst-major blocks of sizes cmat[s, b] ordered (b, s)
+        sizes = [cmat[s, b] for b in range(B) for s in range(S)]
+        pieces = _split_rows(xv, sizes)
+        out = []
+        for s in range(S):
+            for b in range(B):
+                p = pieces[b * S + s]
+                if p.shape[0]:
+                    out.append(p)
+        return Tensor(jnp.concatenate(out, axis=0) if out else xv[:0])

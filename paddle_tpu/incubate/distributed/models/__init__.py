@@ -1,0 +1,3 @@
+"""incubate.distributed.models (reference: python/paddle/incubate/distributed/models/)."""
+
+from . import moe  # noqa: F401

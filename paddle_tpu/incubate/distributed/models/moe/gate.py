@@ -1,0 +1,109 @@
+"""MoE gates (reference: python/paddle/incubate/distributed/models/moe/gate/ —
+naive_gate.py, gshard_gate.py, switch_gate.py over BaseGate).
+
+Each gate maps token features [T, d] -> (topk_value [T, k], topk_idx [T, k])
+and stashes its load-balancing auxiliary loss on ``self.loss`` (the reference
+collects it via get_loss on backward)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp  # noqa: F401
+
+from .....core.tensor import apply_op
+from .....nn import functional as F
+from .....nn.layer_base import Layer
+from .....ops import manipulation as _manip
+
+
+class BaseGate(Layer):
+    def __init__(self, num_expert, world_size=1):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = world_size * num_expert
+        self.loss = None
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+
+class NaiveGate(BaseGate):
+    """Plain top-k softmax gate, no aux loss (naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(num_expert, world_size)
+        from .....nn.common import Linear
+
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp, return_all_scores=False):
+        gate_logits = self.gate(inp)
+        gate_val, gate_idx = _manip.topk(gate_logits, self.top_k, axis=-1)
+        gate_val = F.softmax(gate_val, axis=-1)
+        if return_all_scores:
+            return gate_val, gate_idx, gate_logits
+        return gate_val, gate_idx
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with capacity + load-balance loss (gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2, capacity=(1.2, 2.4), group=None):
+        super().__init__(num_expert, world_size)
+        from .....nn.common import Linear
+
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = topk
+        self.capacity = capacity
+
+    def forward(self, x):
+        logits = self.gate(x)
+        probs = F.softmax(logits, axis=-1)
+        gate_val, gate_idx = _manip.topk(probs, self.top_k, axis=-1)
+
+        n = self.tot_expert
+
+        def aux(p, idx):
+            me = jnp.mean(p, axis=0)
+            oh = jnp.zeros((idx.shape[0], n), p.dtype).at[
+                jnp.arange(idx.shape[0]), idx[:, 0]
+            ].set(1.0)
+            ce = jnp.mean(oh, axis=0)
+            return jnp.sum(me * ce) * n
+
+        self.loss = apply_op("gshard_aux_loss", aux, [probs, gate_idx])
+        return gate_val, gate_idx
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch-transformer gate with capacity + aux loss (switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1, capacity=(1.2, 2.4), group=None):
+        super().__init__(num_expert, world_size)
+        from .....nn.common import Linear
+
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = 1
+        self.capacity = capacity
+
+    def forward(self, x):
+        logits = self.gate(x)
+        probs = F.softmax(logits, axis=-1)
+        gate_val, gate_idx = _manip.topk(probs, 1, axis=-1)
+
+        n = self.tot_expert
+
+        def aux(p, idx):
+            oh = jnp.zeros((idx.shape[0], n), p.dtype).at[
+                jnp.arange(idx.shape[0]), idx[:, 0]
+            ].set(1.0)
+            freq = jnp.mean(oh, axis=0)
+            pmean = jnp.mean(p, axis=0)
+            return jnp.sum(freq * pmean) * n
+
+        self.loss = apply_op("switch_aux_loss", aux, [probs, gate_idx])
+        return gate_val, gate_idx

@@ -149,3 +149,65 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kwargs):
     raise NotImplementedError(
         "fused_multi_head_attention: use nn.MultiHeadAttention (flash-attention backed)"
     )
+
+
+def fused_moe(
+    x,
+    gate_weight,
+    ffn1_weight,
+    ffn2_weight,
+    ffn1_bias=None,
+    ffn2_bias=None,
+    gate_bias=None,
+    moe_topk=2,
+    norm_topk_prob=True,
+    group_moe=False,
+):
+    """Fused mixture-of-experts FFN (reference:
+    python/paddle/incubate/nn/functional/fused_moe.py over the fused_moe_kernel).
+
+    Dense GShard-style routing: one-hot dispatch einsums feed a single batched
+    [E, ...] expert GEMM pair — the layout XLA tiles onto the MXU; under an
+    'expert'-sharded mesh GSPMD inserts the all-to-alls the CUDA kernel does by
+    hand.  ffn1_weight [E, d, 2h or h], ffn2_weight [E, h, d]."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(xv, gw, w1, w2, *rest):
+        it = iter(rest)
+        b1 = next(it) if ffn1_bias is not None else None
+        b2 = next(it) if ffn2_bias is not None else None
+        gb = next(it) if gate_bias is not None else None
+        orig = xv.shape
+        d = orig[-1]
+        t = xv.reshape(-1, d)
+        logits = t @ gw + (gb if gb is not None else 0.0)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        topv, topi = jax.lax.top_k(probs, moe_topk)
+        if norm_topk_prob:
+            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+        E = gw.shape[-1]
+        # scatter normalized top-k back to a full [T, E] combine matrix
+        full = jnp.zeros((t.shape[0], E), jnp.float32)
+        full = full.at[jnp.arange(t.shape[0])[:, None], topi].set(topv)
+        # batched expert FFN on all tokens (dense; capacity-free == no drops)
+        h = jnp.einsum("td,edh->eth", t, w1)
+        if b1 is not None:
+            h = h + b1[:, None, :]
+        # swiglu if ffn1 packs 2x hidden, else gelu
+        if w1.shape[-1] == 2 * w2.shape[1]:
+            a, b = jnp.split(h, 2, axis=-1)
+            h = jax.nn.silu(a) * b
+        else:
+            h = jax.nn.gelu(h)
+        y = jnp.einsum("eth,ehd->etd", h, w2)
+        if b2 is not None:
+            y = y + b2[:, None, :]
+        out = jnp.einsum("etd,te->td", y, full.astype(y.dtype))
+        return out.reshape(orig)
+
+    inputs = [x, gate_weight, ffn1_weight, ffn2_weight]
+    for extra in (ffn1_bias, ffn2_bias, gate_bias):
+        if extra is not None:
+            inputs.append(extra)
+    return apply_op("fused_moe", fn, inputs)

@@ -1,0 +1,159 @@
+"""Distributed checkpoint: save sharded -> load under a different topology
+(mirrors test/auto_parallel/test_dist_checkpoint_utils.py + the reshard-on-load
+matrix).  Overlap solver unit tests mirror load_state_dict.py:394-444."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.checkpoint.metadata import LocalTensorMetadata, Metadata, LocalTensorIndex
+from paddle_tpu.distributed.checkpoint.utils import compute_read_items, overlap
+
+rng = np.random.RandomState(11)
+
+
+def _mesh(shape, names):
+    devs = np.asarray(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, axis_names=names)
+
+
+def _sharded(value, mesh, spec):
+    return jax.device_put(jnp.asarray(value), NamedSharding(mesh, spec))
+
+
+def test_overlap_solver():
+    assert overlap((0,), (4,), (2,), (4,)) == (((2, 2),), ((0, 2),))
+    assert overlap((0, 0), (2, 8), (0, 4), (2, 4)) == (((0, 2), (4, 4)), ((0, 2), (0, 4)))
+    assert overlap((0,), (4,), (4,), (4,)) is None
+
+
+def test_compute_read_items_cross_topology():
+    md = Metadata()
+    # stored as 2 row-chunks of an (8, 4) tensor
+    md.state_dict_metadata["w"] = [
+        LocalTensorMetadata((0, 0), (4, 4), "float32"),
+        LocalTensorMetadata((4, 0), (4, 4), "float32"),
+    ]
+    md.storage_metadata = {
+        LocalTensorIndex("w", (0, 0)): "a",
+        LocalTensorIndex("w", (4, 0)): "b",
+    }
+    # target wants rows 2..6 — spans both chunks
+    items = compute_read_items(md, "w", (2, 0), (4, 4))
+    assert len(items) == 2
+    files = {i.file for i in items}
+    assert files == {"a", "b"}
+
+
+def test_save_load_replicated_roundtrip(tmp_path):
+    w = rng.rand(6, 5).astype(np.float32)
+    b = rng.rand(5).astype(np.float32)
+    sd = {"linear": {"weight": paddle.to_tensor(w), "bias": paddle.to_tensor(b)}}
+    ckpt.save_state_dict(sd, str(tmp_path))
+    target = {
+        "linear": {
+            "weight": paddle.to_tensor(np.zeros_like(w)),
+            "bias": paddle.to_tensor(np.zeros_like(b)),
+        }
+    }
+    ckpt.load_state_dict(target, str(tmp_path))
+    np.testing.assert_allclose(target["linear"]["weight"].numpy(), w)
+    np.testing.assert_allclose(target["linear"]["bias"].numpy(), b)
+
+
+def test_save_sharded_load_other_topology(tmp_path):
+    # save sharded over 8-way axis0; load sharded over (2,4) mesh axis1
+    w = rng.rand(8, 8).astype(np.float32)
+    m1 = _mesh((8,), ("x",))
+    saved = {"w": _sharded(w, m1, P("x", None))}
+    ckpt.save_state_dict(saved, str(tmp_path))
+
+    m2 = _mesh((2, 4), ("a", "b"))
+    target = {"w": _sharded(np.zeros_like(w), m2, P(None, "b"))}
+    ckpt.load_state_dict(target, str(tmp_path))
+    np.testing.assert_allclose(np.asarray(target["w"]), w)
+    # target sharding preserved
+    assert isinstance(target["w"].sharding, NamedSharding)
+    assert target["w"].sharding.spec == P(None, "b")
+
+
+def test_save_sharded_load_replicated_and_back(tmp_path):
+    w = rng.rand(4, 6).astype(np.float32)
+    m = _mesh((4,), ("x",))
+    ckpt.save_state_dict({"w": _sharded(w, m, P("x"))}, str(tmp_path / "s"))
+    tgt = {"w": paddle.to_tensor(np.zeros_like(w))}
+    ckpt.load_state_dict(tgt, str(tmp_path / "s"))
+    np.testing.assert_allclose(tgt["w"].numpy(), w)
+
+    # replicated save -> sharded load
+    ckpt.save_state_dict({"w": paddle.to_tensor(w)}, str(tmp_path / "r"))
+    tgt2 = {"w": _sharded(np.zeros_like(w), m, P("x"))}
+    ckpt.load_state_dict(tgt2, str(tmp_path / "r"))
+    np.testing.assert_allclose(np.asarray(tgt2["w"]), w)
+
+
+def test_async_save(tmp_path):
+    w = rng.rand(3, 3).astype(np.float32)
+    ckpt.save_state_dict({"w": paddle.to_tensor(w)}, str(tmp_path), async_save=True)
+    ckpt.wait_async_save()
+    tgt = {"w": paddle.to_tensor(np.zeros_like(w))}
+    ckpt.load_state_dict(tgt, str(tmp_path))
+    np.testing.assert_allclose(tgt["w"].numpy(), w)
+
+
+def test_missing_key_raises(tmp_path):
+    ckpt.save_state_dict({"a": paddle.to_tensor(np.ones(2, np.float32))}, str(tmp_path))
+    import pytest
+
+    with pytest.raises(KeyError):
+        ckpt.load_state_dict({"b": paddle.to_tensor(np.zeros(2, np.float32))}, str(tmp_path))
+
+
+def test_nested_optimizer_state(tmp_path):
+    sd = {
+        "model": {"w": paddle.to_tensor(rng.rand(4, 4).astype(np.float32))},
+        "opt": {
+            "moment1": {"w": paddle.to_tensor(rng.rand(4, 4).astype(np.float32))},
+            "step": 7,
+        },
+    }
+    ckpt.save_state_dict(sd, str(tmp_path))
+    tgt = {
+        "model": {"w": paddle.to_tensor(np.zeros((4, 4), np.float32))},
+        "opt": {
+            "moment1": {"w": paddle.to_tensor(np.zeros((4, 4), np.float32))},
+            "step": 0,
+        },
+    }
+    ckpt.load_state_dict(tgt, str(tmp_path))
+    np.testing.assert_allclose(tgt["model"]["w"].numpy(), sd["model"]["w"].numpy())
+    np.testing.assert_allclose(
+        tgt["opt"]["moment1"]["w"].numpy(), sd["opt"]["moment1"]["w"].numpy()
+    )
+
+
+def test_python_scalar_restored(tmp_path):
+    sd = {"opt": {"step": 7, "w": paddle.to_tensor(np.ones(2, np.float32))}}
+    ckpt.save_state_dict(sd, str(tmp_path))
+    tgt = {"opt": {"step": 0, "w": paddle.to_tensor(np.zeros(2, np.float32))}}
+    ckpt.load_state_dict(tgt, str(tmp_path))
+    assert tgt["opt"]["step"] == 7
+
+
+def test_nested_raw_array_restored(tmp_path):
+    ckpt.save_state_dict({"m": {"w": jnp.arange(6, dtype=jnp.float32)}}, str(tmp_path))
+    tgt = {"m": {"w": jnp.zeros(6, jnp.float32)}}
+    ckpt.load_state_dict(tgt, str(tmp_path))
+    np.testing.assert_allclose(np.asarray(tgt["m"]["w"]), np.arange(6, dtype=np.float32))
+
+
+def test_shape_mismatch_raises(tmp_path):
+    import pytest
+
+    ckpt.save_state_dict({"w": paddle.to_tensor(np.ones((4, 4), np.float32))}, str(tmp_path))
+    with pytest.raises(ValueError, match="shape mismatch"):
+        ckpt.load_state_dict({"w": paddle.to_tensor(np.zeros((8, 4), np.float32))}, str(tmp_path))
