@@ -9,17 +9,23 @@ from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .auto_parallel import (  # noqa: F401
+    DistModel,
     Partial,
     Placement,
     ProcessMesh,
     Replicate,
     Shard,
+    ShardDataloader,
     dtensor_from_local,
     dtensor_to_local,
+    get_mesh,
     reshard,
+    set_mesh,
+    shard_dataloader,
     shard_layer,
     shard_optimizer,
     shard_tensor,
+    to_static,
     unshard_dtensor,
 )
 from .collective import (  # noqa: F401

@@ -11,3 +11,11 @@ from .api import (  # noqa: F401
 )
 from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
 from .process_mesh import ProcessMesh  # noqa: F401
+from .static_engine import (  # noqa: F401
+    DistModel,
+    ShardDataloader,
+    get_mesh,
+    set_mesh,
+    shard_dataloader,
+    to_static,
+)

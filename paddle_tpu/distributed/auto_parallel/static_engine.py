@@ -1,0 +1,357 @@
+"""Semi-auto static engine: ``dist.to_static`` → :class:`DistModel`.
+
+Reference: python/paddle/distributed/auto_parallel/api.py — ``to_static``
+(:2952) returning ``DistModel`` (:2254), which wraps the static ``Engine``
+(auto_parallel/static/engine.py:99).  The reference pipeline
+(`engine.py:669` ``_parallel_pir``) is: trace to PIR → mix2dist pass →
+backward build → partition pass → reshard pass → optimization passes →
+StandaloneExecutor.
+
+TPU-native collapse of that pipeline (SURVEY.md §3.4): the whole program —
+forward, loss, backward, optimizer update — is traced ONCE into a single XLA
+module under ``jax.jit`` on the target :class:`ProcessMesh`.  GSPMD performs
+what apply_partition_pass + ReshardPasses do in the reference: sharding
+propagation from the committed input shardings (params placed by
+``shard_tensor``; batches placed by :class:`ShardDataloader`) and collective
+insertion where producer/consumer shardings disagree.  The optimizer update
+lives in the same module, so ZeRO-style sharded states inherit parameter
+shardings with zero extra code (reference shard_optimizer + ShardingStage1-3
+markers are honored by resharding the optimizer-state pytree).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor, _unwrap, no_grad
+from .api import DistAttr, ShardingStage1, ShardingStage2, ShardingStage3, _partition_spec
+from .placement import Partial, Replicate, Shard
+from .process_mesh import ProcessMesh
+
+__all__ = ["DistModel", "to_static", "ShardDataloader", "shard_dataloader", "set_mesh", "get_mesh"]
+
+_global_mesh: ProcessMesh | None = None
+
+
+def set_mesh(mesh: ProcessMesh):
+    """Set the default process mesh (reference: dist.auto_parallel.set_mesh)."""
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> ProcessMesh | None:
+    return _global_mesh
+
+
+def _infer_mesh(layer) -> ProcessMesh | None:
+    """Find the mesh the model was sharded over (first param with dist_attr)."""
+    for _, p in layer.named_parameters():
+        attr = getattr(p, "dist_attr", None)
+        if attr is not None:
+            return attr.process_mesh
+    return _global_mesh
+
+
+def _batch_sharding(mesh: ProcessMesh, shard_dims, ndim: int) -> NamedSharding:
+    """Sharding for one input tensor: batch dim 0 split over `shard_dims`
+    (a mesh axis name or list of names); everything else replicated."""
+    if shard_dims is None:
+        # default: shard over the first mesh axis (the reference defaults to
+        # the mesh dim named by `shard_dims` or dim 0 of the mesh)
+        shard_dims = mesh.dim_names[0]
+    entry = tuple(shard_dims) if isinstance(shard_dims, (list, tuple)) else shard_dims
+    spec = [None] * ndim
+    if ndim > 0:
+        spec[0] = entry
+    return NamedSharding(mesh.jax_mesh, PartitionSpec(*spec))
+
+
+class ShardDataloader:
+    """Wrap a DataLoader so each produced batch is a DTensor sharded over the
+    data-parallel mesh axis (reference: auto_parallel/api.py:3200).
+
+    ``shard_dims``: mesh axis name (or list of names) the batch dim is split
+    over; ``None`` shards over the mesh's first axis.  ``is_dataset_splitted``
+    declares the loader already yields only this rank's shard (multi-host);
+    single-controller runs always see the global batch.
+    """
+
+    def __init__(self, dataloader, meshes, input_keys=None, shard_dims=None, is_dataset_splitted=False):
+        self._loader = dataloader
+        self._meshes = meshes if isinstance(meshes, (list, tuple)) else [meshes]
+        self._input_keys = input_keys
+        self._shard_dims = shard_dims
+        self._is_splitted = is_dataset_splitted
+
+    @property
+    def mesh(self) -> ProcessMesh:
+        return self._meshes[0]
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _place(self, item, mesh):
+        if isinstance(item, dict):
+            return {k: self._place(v, mesh) for k, v in item.items()}
+        if isinstance(item, (list, tuple)):
+            return type(item)(self._place(v, mesh) for v in item)
+        v = _unwrap(item) if isinstance(item, Tensor) else jnp.asarray(np.asarray(item))
+        sharding = _batch_sharding(mesh, self._shard_dims, v.ndim)
+        if self._is_splitted and jax.process_count() > 1:
+            # loader already yields this process's shard of the batch:
+            # assemble the global array from per-process local data
+            v = jax.make_array_from_process_local_data(sharding, np.asarray(v))
+        else:
+            # single-controller (or unsplitted loader): the yielded batch IS
+            # the global batch; device_put splits it over the mesh
+            v = jax.device_put(v, sharding)
+        t = Tensor(v)
+        ndim = t.ndim
+        placements = []
+        for ax_name in mesh.dim_names:
+            wanted = self._shard_dims if self._shard_dims is not None else mesh.dim_names[0]
+            wanted = [wanted] if isinstance(wanted, str) else list(wanted)
+            placements.append(Shard(0) if ax_name in wanted and ndim > 0 else Replicate())
+        t.dist_attr = DistAttr(mesh, placements)
+        return t
+
+    def __iter__(self):
+        for batch in self._loader:
+            if isinstance(batch, dict) and self._input_keys:
+                # reference semantics: input_keys orders the fed tensors
+                batch = tuple(batch[k] for k in self._input_keys)
+            if isinstance(batch, (list, tuple)) and len(self._meshes) > 1:
+                # pipeline: inputs go to the first-stage mesh, labels to the last
+                placed = [self._place(v, self._meshes[0]) for v in batch[:-1]]
+                placed.append(self._place(batch[-1], self._meshes[-1]))
+                yield type(batch)(placed)
+            else:
+                yield self._place(batch, self._meshes[0])
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None, is_dataset_splitted=False):
+    return ShardDataloader(dataloader, meshes, input_keys, shard_dims, is_dataset_splitted)
+
+
+def _sharding_of(x):
+    """NamedSharding currently committed on a value, if any."""
+    v = _unwrap(x)
+    s = getattr(v, "sharding", None)
+    return s if isinstance(s, NamedSharding) else None
+
+
+class DistModel:
+    """Compiled distributed model (reference DistModel, api.py:2254).
+
+    Modes mirror the reference: ``train()`` → ``__call__(*batch)`` runs
+    loss+backward+update as ONE pjit program; ``eval()`` → loss only;
+    ``predict()`` → forward outputs.  The program is cached per mode.
+    """
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None, strategy=None, input_spec=None):
+        from ...jit import functional_state
+
+        self.network = layer
+        self._loader = loader
+        self._loss_fn = loss
+        self._optimizer = optimizer
+        self._strategy = strategy
+        self._mode = "train" if (loss is not None and optimizer is not None) else (
+            "eval" if loss is not None else "predict"
+        )
+        # keep the eager layer's training flag in sync — the jitted program
+        # bakes dropout/BN mode in at trace time (cached per mode)
+        layer.train() if self._mode == "train" else layer.eval()
+        self._mesh = _infer_mesh(layer)
+        params, buffers = functional_state(layer)
+        # the train step donates its param buffers; copy so the eager layer's
+        # (possibly aliased) arrays are never invalidated by donation
+        self._params = {k: jnp.copy(v) for k, v in params.items()}
+        self._buffers = buffers
+        self._named = dict(layer.named_parameters())
+        self._opt_state = None
+        if optimizer is not None:
+            self._opt_state = optimizer.init_state_pytree(params)
+            self._shard_opt_state()
+        self._steps = {}
+
+    # -- mode switches (reference api.py: DistModel.train/eval/predict) ----
+    def train(self):
+        if self._loss_fn is None or self._optimizer is None:
+            raise RuntimeError("train() requires both loss and optimizer")
+        self._mode = "train"
+        self.network.train()
+        return self
+
+    def eval(self):
+        if self._loss_fn is None:
+            raise RuntimeError("eval() requires a loss")
+        self._mode = "eval"
+        self.network.eval()
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+        return self
+
+    # -- sharding of optimizer states (ZeRO via GSPMD) ---------------------
+    def _shard_opt_state(self):
+        """Optimizer moment tensors inherit each parameter's sharding; with a
+        ShardingStage1/2/3 shard_fn on the optimizer they are additionally
+        split over the data-parallel axis (ZeRO: the reference's
+        shard_optimizer + ShardingStage* markers, api.py:1430-1735)."""
+        if self._mesh is None or self._opt_state is None:
+            return
+        shard_fn = getattr(self._optimizer, "_shard_fn", None)
+        acc = self._opt_state.get("acc")
+        if acc is None:
+            return
+
+        zero = isinstance(shard_fn, (ShardingStage1, ShardingStage2, ShardingStage3))
+        # the axis optimizer states are split over: prefer an axis literally
+        # named "dp" (the reference shards over the data-parallel dim),
+        # else the ShardingStage marker's mesh first axis, else mesh axis 0
+        zero_mesh = getattr(shard_fn, "mesh", None) or self._mesh
+        if "dp" in zero_mesh.dim_names:
+            dp_axis = "dp"
+        else:
+            dp_axis = zero_mesh.dim_names[0]
+        dp_size = self._mesh.get_dim_size(dp_axis) if dp_axis in self._mesh.dim_names else 1
+
+        def place(pname, state_dict):
+            p = self._named.get(pname)
+            psh = _sharding_of(p) if p is not None else None
+            out = {}
+            for k, v in state_dict.items():
+                # base spec: inherit the parameter's sharding where ranks match
+                if psh is not None and v.ndim == len(psh.spec):
+                    spec = list(psh.spec) + [None] * (v.ndim - len(psh.spec))
+                else:
+                    spec = [None] * v.ndim
+                if zero and v.ndim >= 1 and spec[0] is None and dp_size > 1 and v.shape[0] % dp_size == 0:
+                    # ZeRO: additionally split dim 0 over dp where it is free
+                    spec[0] = dp_axis
+                out[k] = jax.device_put(v, NamedSharding(self._mesh.jax_mesh, PartitionSpec(*spec)))
+            return out
+
+        self._opt_state = {
+            "step": self._opt_state["step"],
+            "acc": {name: place(name, st) for name, st in acc.items()},
+        }
+
+    # -- program build ------------------------------------------------------
+    def _build(self, mode: str):
+        from ...jit import functional_call
+
+        layer, loss_fn, opt = self.network, self._loss_fn, self._optimizer
+
+        def fwd(params, buffers, args):
+            return functional_call(layer, params, buffers, *args)
+
+        def compute_loss(params, buffers, args):
+            # last positional is the label by convention (reference DistModel
+            # feeds (inputs..., labels...) and calls loss(outputs, labels))
+            *inputs, label = args
+            out, new_buffers = functional_call(
+                layer, params, buffers, *inputs, return_new_buffers=True
+            )
+            lbl = Tensor(label) if isinstance(label, (jax.Array, jnp.ndarray)) else label
+            o = out[0] if isinstance(out, (tuple, list)) else out
+            with no_grad():
+                l = loss_fn(Tensor(o), lbl)
+            return _unwrap(l) if isinstance(l, Tensor) else l, new_buffers
+
+        if mode == "train":
+
+            @functools.partial(jax.jit, donate_argnums=(0, 2))
+            def step(params, buffers, opt_state, lr, args):
+                (loss, new_buffers), grads = jax.value_and_grad(
+                    compute_loss, has_aux=True
+                )(params, buffers, args)
+                new_p, new_s = opt.apply_gradients_pytree(params, grads, opt_state, lr)
+                return loss, new_p, new_s, new_buffers
+
+            return step
+        if mode == "eval":
+            return jax.jit(lambda params, buffers, args: compute_loss(params, buffers, args)[0])
+        return jax.jit(fwd)
+
+    def _step_fn(self, mode):
+        if mode not in self._steps:
+            self._steps[mode] = self._build(mode)
+        return self._steps[mode]
+
+    def __call__(self, *args):
+        vals = tuple(_unwrap(a) if isinstance(a, Tensor) else jnp.asarray(np.asarray(a)) for a in args)
+        ctx = self._mesh.jax_mesh if self._mesh is not None else contextlib.nullcontext()
+        with ctx:
+            if self._mode == "train":
+                lr = self._optimizer.get_lr()
+                loss, self._params, self._opt_state, self._buffers = self._step_fn("train")(
+                    self._params, self._buffers, self._opt_state, lr, vals
+                )
+                lr_sched = getattr(self._optimizer, "_learning_rate", None)
+                if hasattr(lr_sched, "step"):
+                    lr_sched.step()
+                return Tensor(loss)
+            if self._mode == "eval":
+                return Tensor(self._step_fn("eval")(self._params, self._buffers, vals))
+            out = self._step_fn("predict")(self._params, self._buffers, vals)
+            return jax.tree_util.tree_map(
+                lambda o: Tensor(o) if isinstance(o, (jax.Array, jnp.ndarray)) else o, out
+            )
+
+    # -- inspection / state -------------------------------------------------
+    def dist_main_program(self, mode=None):
+        """The compiled program text for `mode` (analog of the reference's
+        ``DistModel.dist_main_program`` returning the PIR program): the jitted
+        step lowered to StableHLO for the current input shapes, if built."""
+        mode = mode or self._mode
+        fn = self._steps.get(mode)
+        return None if fn is None else "<compiled jax program: %s>" % mode
+
+    def state_dict(self, mode="all"):
+        self._sync_to_model()
+        return self.network.state_dict()
+
+    def set_state_dict(self, state_dict):
+        self.network.set_state_dict(state_dict)
+        from ...jit import functional_state
+
+        params, self._buffers = functional_state(self.network)
+        # copy: the donated train step must never invalidate the eager layer's
+        # live arrays (same reason as in __init__)
+        self._params = {k: jnp.copy(v) for k, v in params.items()}
+        if self._optimizer is not None:
+            self._opt_state = self._optimizer.init_state_pytree(self._params)
+            self._shard_opt_state()
+
+    def _sync_to_model(self):
+        named_b = dict(self.network.named_buffers())
+        for name, val in self._params.items():
+            # copy: the next donated step deletes self._params' buffers
+            self._named[name]._value = jnp.copy(val)
+        for name, val in self._buffers.items():
+            if name in named_b:
+                named_b[name]._value = val
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None, input_spec=None):
+    """``paddle.distributed.to_static`` analog (api.py:2952): returns a
+    :class:`DistModel` whose call runs the fully-parallelized program."""
+    opt = optimizer
+    inner = getattr(opt, "_inner", None)
+    if inner is not None:  # _ShardOptimizer from shard_optimizer()
+        shard_fn = getattr(opt, "_shard_fn", None)
+        opt = inner
+        opt._shard_fn = shard_fn
+    return DistModel(layer, loader, loss, opt, strategy, input_spec)

@@ -40,6 +40,13 @@ __all__ = ["save_state_dict", "load_state_dict", "Metadata", "LocalTensorMetadat
 _METADATA_FILE = "0.metadata"
 _pending_saves: list[threading.Thread] = []
 
+# writer threads are daemonic (a hung disk must not block an aborting job),
+# so flush them at normal interpreter exit or a checkpoint written at the
+# tail of a script could be silently truncated
+import atexit  # noqa: E402
+
+atexit.register(lambda: wait_async_save())
+
 
 def _as_jax_array(v):
     if isinstance(v, Tensor):
@@ -177,7 +184,11 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
         info = md.tensor_info[key]
         targets = _target_shards(v)
         if targets is None:
-            continue
+            raise ValueError(
+                f"target for {key!r} is a non-tensor ({type(v).__name__}) but the "
+                f"checkpoint stores a tensor of shape {tuple(info['global_shape'])}; "
+                "pass a tensor-valued leaf to receive it"
+            )
         arr = _as_jax_array(v)
         if tuple(arr.shape) != tuple(info["global_shape"]):
             raise ValueError(
@@ -187,11 +198,15 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
         dtype = arr.dtype
 
         assembled = []
+        buf_cache: dict[tuple, np.ndarray] = {}  # replicas share one host buffer
         for offset, shape, device in targets:
-            buf = np.zeros(shape, dtype=np.dtype(info["dtype"]))
-            for item in compute_read_items(md, key, offset, shape):
-                data = read_chunk(item)
-                buf[slices_of(item.dst_slice)] = data[slices_of(item.src_slice)]
+            buf = buf_cache.get((offset, shape))
+            if buf is None:
+                buf = np.zeros(shape, dtype=np.dtype(info["dtype"]))
+                for item in compute_read_items(md, key, offset, shape):
+                    data = read_chunk(item)
+                    buf[slices_of(item.dst_slice)] = data[slices_of(item.src_slice)]
+                buf_cache[(offset, shape)] = buf
             assembled.append((offset, buf, device))
 
         sharding = getattr(arr, "sharding", None)

@@ -80,7 +80,7 @@ class MoELayer(Layer):
         mp_group=None,
         recompute_interval=0,
         top_k=2,
-        capacity_factor=1.2,
+        capacity_factor=None,
         **kwargs,
     ):
         super().__init__()
@@ -127,12 +127,16 @@ class MoELayer(Layer):
         xf = _manip.reshape(x, [-1, d])
         T = xf.shape[0]
         E = self.num_expert * self.world_size
-        # gate-level capacity tuple (train, eval) wins over the layer factor
+        # an explicitly-passed layer capacity_factor wins; otherwise use the
+        # gate's (train, eval) capacity tuple, else the 1.2 GShard default
         # (reference gshard_gate.py/switch_gate.py capacity semantics)
         cap_factor = self.capacity_factor
         gate_cap = getattr(self.gate, "capacity", None)
-        if isinstance(gate_cap, (tuple, list)) and len(gate_cap) == 2:
-            cap_factor = gate_cap[0] if self.training else gate_cap[1]
+        if cap_factor is None:
+            if isinstance(gate_cap, (tuple, list)) and len(gate_cap) == 2:
+                cap_factor = gate_cap[0] if self.training else gate_cap[1]
+            else:
+                cap_factor = 1.2
         capacity = max(1, int(cap_factor * T / E) * getattr(self.gate, "top_k", 2))
 
         gate_val, gate_idx = self.gate(xf)

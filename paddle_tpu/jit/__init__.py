@@ -37,6 +37,19 @@ def functional_state(layer: Layer):
     return params, buffers
 
 
+# Stack of active _SwapState instances: in-place buffer updates (e.g.
+# BatchNorm running stats) may assign tracer values ONLY to tensors that are
+# part of an active swap — those are captured functionally before the swap
+# exits; any other tensor would be permanently corrupted by a leaked tracer.
+_active_swaps: list = []
+
+
+def in_functional_swap(tensor=None) -> bool:
+    if tensor is None:
+        return bool(_active_swaps)
+    return any(id(tensor) in s._saved for s in _active_swaps)
+
+
 class _SwapState:
     """Temporarily substitute layer parameters/buffers with given arrays
     (typically tracers) — the functional bridge for eager Layers."""
@@ -59,25 +72,39 @@ class _SwapState:
             if id(t) not in self._saved:
                 self._saved[id(t)] = (t, t._value)
             t._value = val
+        _active_swaps.append(self)
         return self
 
+    def current_buffers(self) -> dict:
+        """Buffer values as of now — includes in-place updates made during the
+        swapped call (the BN running-stat path)."""
+        return {name: _unwrap(b) for name, b in self.layer.named_buffers()}
+
     def __exit__(self, *exc):
+        _active_swaps.remove(self)
         for t, v in self._saved.values():
             t._value = v
         return False
 
 
-def functional_call(layer: Layer, params: dict, buffers: dict, *args, **kwargs):
-    """Run ``layer(*args)`` as a pure function of (params, buffers, args)."""
+def functional_call(layer: Layer, params: dict, buffers: dict, *args,
+                    return_new_buffers: bool = False, **kwargs):
+    """Run ``layer(*args)`` as a pure function of (params, buffers, args).
+
+    With ``return_new_buffers=True`` also returns the post-call buffer values,
+    capturing in-place updates (BatchNorm running stats) functionally —
+    otherwise those updates are discarded when the swap exits."""
     wrapped = jax.tree_util.tree_map(
         lambda a: Tensor(a) if isinstance(a, (jax.Array, jnp.ndarray)) else a, args
     )
-    with no_grad(), _SwapState(layer, params, buffers):
+    with no_grad(), _SwapState(layer, params, buffers) as swap:
         out = layer(*wrapped, **kwargs)
-    return jax.tree_util.tree_map(
+        new_buffers = swap.current_buffers() if return_new_buffers else None
+    out = jax.tree_util.tree_map(
         lambda o: _unwrap(o) if isinstance(o, Tensor) else o, out,
         is_leaf=lambda o: isinstance(o, Tensor),
     )
+    return (out, new_buffers) if return_new_buffers else out
 
 
 class StaticFunction:
@@ -198,40 +225,49 @@ class TrainStep:
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         params, buffers = functional_state(model)
-        self._params = params
+        # copy: the donated step must never invalidate the eager model's arrays
+        self._params = {k: jnp.copy(v) for k, v in params.items()} if donate else params
         self._buffers = buffers
         self._opt_state = optimizer.init_state_pytree(params)
         self._named = dict(model.named_parameters())
 
         def compute_loss(params, buffers, args):
             wrapped = [Tensor(a) if isinstance(a, (jax.Array, jnp.ndarray)) else a for a in args]
-            with no_grad(), _SwapState(model, params, buffers):
+            with no_grad(), _SwapState(model, params, buffers) as swap:
                 out = loss_fn(*wrapped)
+                new_buffers = swap.current_buffers()
             loss = out[0] if isinstance(out, (tuple, list)) else out
-            return _unwrap(loss) if isinstance(loss, Tensor) else loss
+            return _unwrap(loss) if isinstance(loss, Tensor) else loss, new_buffers
 
         opt = optimizer
 
         @functools.partial(jax.jit, donate_argnums=(0, 2) if donate else ())
         def step(params, buffers, opt_state, lr, args):
-            loss, grads = jax.value_and_grad(compute_loss)(params, buffers, args)
+            (loss, new_buffers), grads = jax.value_and_grad(compute_loss, has_aux=True)(
+                params, buffers, args
+            )
             new_params, new_opt_state = opt.apply_gradients_pytree(params, grads, opt_state, lr)
-            return loss, new_params, new_opt_state
+            return loss, new_params, new_opt_state, new_buffers
 
         self._step = step
 
     def __call__(self, *args):
         arg_vals = [(_unwrap(a) if isinstance(a, Tensor) else a) for a in args]
         lr = self.optimizer.get_lr()
-        loss, self._params, self._opt_state = self._step(
+        loss, self._params, self._opt_state, self._buffers = self._step(
             self._params, self._buffers, self._opt_state, lr, tuple(arg_vals)
         )
         return Tensor(loss)
 
     def sync_to_model(self):
-        """Write the device-side params back into the eager model tensors."""
+        """Write the device-side params/buffers back into the eager model."""
+        named_b = dict(self.model.named_buffers())
         for name, val in self._params.items():
-            self._named[name]._value = val
+            # copy: the next donated step deletes self._params' buffers
+            self._named[name]._value = jnp.copy(val)
+        for name, val in self._buffers.items():
+            if name in named_b:
+                named_b[name]._value = val
 
     @property
     def params(self):

@@ -618,7 +618,16 @@ def batch_norm(
         axes = tuple(i for i in range(v.ndim) if i != ch_axis)
         batch_mean = jnp.mean(v.astype(jnp.float32), axis=axes)
         batch_var = jnp.var(v.astype(jnp.float32), axis=axes)
-        if running_mean is not None and not isinstance(batch_mean, jax.core.Tracer):
+        from ...jit import in_functional_swap
+
+        # tracer-valued updates are allowed only for buffers belonging to an
+        # active functional swap (jit.functional_call / TrainStep / DistModel)
+        # — those are captured before the swap exits; anywhere else a tracer
+        # assignment would permanently corrupt eager state, so skip it
+        if running_mean is not None and (
+            not isinstance(batch_mean, jax.core.Tracer)
+            or (in_functional_swap(running_mean) and in_functional_swap(running_var))
+        ):
             rm, rv = _unwrap(running_mean), _unwrap(running_var)
             running_mean._value = (momentum * rm + (1 - momentum) * batch_mean).astype(rm.dtype)
             running_var._value = (momentum * rv + (1 - momentum) * batch_var).astype(rv.dtype)
