@@ -55,6 +55,9 @@ from .collective import (  # noqa: F401
     to_rank_list,
     wait,
 )
+from . import launch  # noqa: F401
+from .comm_watchdog import CommTaskManager, comm_task, enable_comm_watchdog  # noqa: F401
+from .store import TCPStore  # noqa: F401
 from .env import (  # noqa: F401
     ParallelEnv,
     get_rank,

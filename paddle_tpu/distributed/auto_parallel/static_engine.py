@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import contextlib
 import functools
-from typing import Any, Callable, Sequence
 
 import numpy as np
 
@@ -32,8 +31,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ...core.tensor import Tensor, _unwrap, no_grad
-from .api import DistAttr, ShardingStage1, ShardingStage2, ShardingStage3, _partition_spec
-from .placement import Partial, Replicate, Shard
+from .api import DistAttr, ShardingStage1, ShardingStage2, ShardingStage3
+from .placement import Replicate, Shard
 from .process_mesh import ProcessMesh
 
 __all__ = ["DistModel", "to_static", "ShardDataloader", "shard_dataloader", "set_mesh", "get_mesh"]
@@ -299,7 +298,7 @@ class DistModel:
                 loss, self._params, self._opt_state, self._buffers = self._step_fn("train")(
                     self._params, self._buffers, self._opt_state, lr, vals
                 )
-                lr_sched = getattr(self._optimizer, "_learning_rate", None)
+                lr_sched = getattr(self._optimizer, "_lr", None)
                 if hasattr(lr_sched, "step"):
                     lr_sched.step()
                 return Tensor(loss)
@@ -319,12 +318,29 @@ class DistModel:
         fn = self._steps.get(mode)
         return None if fn is None else "<compiled jax program: %s>" % mode
 
+    _OPT_PREFIX = "__opt__."
+
     def state_dict(self, mode="all"):
-        self._sync_to_model()
-        return self.network.state_dict()
+        """mode ∈ {"all", "param", "opt"} (reference api.py DistModel.state_dict):
+        "opt" entries are flattened as ``__opt__.<param>.<state>`` + ``__opt__.step``
+        so the whole dict round-trips through save/load_state_dict."""
+        out = {}
+        if mode in ("all", "param"):
+            self._sync_to_model()
+            out.update(self.network.state_dict())
+        if mode in ("all", "opt") and self._opt_state is not None:
+            out[self._OPT_PREFIX + "step"] = Tensor(self._opt_state["step"])
+            for pname, states in self._opt_state["acc"].items():
+                for sname, v in states.items():
+                    out[f"{self._OPT_PREFIX}{pname}.{sname}"] = Tensor(v)
+        return out
 
     def set_state_dict(self, state_dict):
-        self.network.set_state_dict(state_dict)
+        opt_entries = {k[len(self._OPT_PREFIX):]: v for k, v in state_dict.items()
+                       if k.startswith(self._OPT_PREFIX)}
+        param_entries = {k: v for k, v in state_dict.items()
+                         if not k.startswith(self._OPT_PREFIX)}
+        self.network.set_state_dict(param_entries)
         from ...jit import functional_state
 
         params, self._buffers = functional_state(self.network)
@@ -332,7 +348,21 @@ class DistModel:
         # live arrays (same reason as in __init__)
         self._params = {k: jnp.copy(v) for k, v in params.items()}
         if self._optimizer is not None:
-            self._opt_state = self._optimizer.init_state_pytree(self._params)
+            if opt_entries:
+                if self._opt_state is None:
+                    self._opt_state = self._optimizer.init_state_pytree(self._params)
+                if "step" in opt_entries:
+                    self._opt_state["step"] = jnp.asarray(_unwrap(opt_entries["step"]), jnp.int32)
+                for key, v in opt_entries.items():
+                    if key == "step":
+                        continue
+                    pname, sname = key.rsplit(".", 1)
+                    if pname in self._opt_state["acc"] and sname in self._opt_state["acc"][pname]:
+                        self._opt_state["acc"][pname][sname] = jnp.asarray(
+                            _unwrap(v), self._opt_state["acc"][pname][sname].dtype
+                        )
+            # no opt entries: keep the existing moments — silently zeroing them
+            # would corrupt a resumed Adam run (bias correction restarts)
             self._shard_opt_state()
 
     def _sync_to_model(self):

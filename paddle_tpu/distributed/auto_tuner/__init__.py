@@ -1,0 +1,18 @@
+"""Auto-tuner: black-box search over hybrid-parallel configurations.
+
+Reference: python/paddle/distributed/auto_tuner/ — ``AutoTuner``
+(tuner.py:21), candidate pruning (prune.py), cost model (cost_model.py),
+trial recording (recorder.py); launched via
+``paddle.distributed.launch --auto_tuner_json`` (launch/main.py
+_build_pod_with_tuner).
+
+TPU-native: the search space is mesh shapes (dp/mp/pp/sharding/sep degrees
+over the chip count), micro-batch size, recompute on/off, and the trial is a
+jit-compiled step timed on-device; ICI topology constraints (axis sizes must
+tile the physical torus) replace the reference's GPU-count divisibility rules.
+"""
+
+from .tuner import AutoTuner, TunerConfig  # noqa: F401
+from .prune import prune_candidates, default_prune_rules  # noqa: F401
+from .cost_model import estimate_cost  # noqa: F401
+from .recorder import HistoryRecorder  # noqa: F401

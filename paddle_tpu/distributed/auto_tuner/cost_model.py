@@ -1,0 +1,47 @@
+"""Trial ordering cost model (reference: auto_tuner/cost_model.py).
+
+Scores a candidate BEFORE running it so the search tries promising configs
+first.  The model is the standard TPU roofline split (scaling-book recipe):
+compute time from FLOPs/chip over MXU throughput, comm time from bytes over
+ICI bandwidth per parallel axis, pipeline bubble from (pp-1)/micro_batches."""
+
+from __future__ import annotations
+
+__all__ = ["estimate_cost"]
+
+
+def estimate_cost(cand, ctx) -> float:
+    """Relative step-time estimate (seconds; only ordering matters).
+
+    ctx keys (all optional, sensible defaults): num_params, global_batch_size,
+    seq_len, hidden_size, num_layers, flops_per_chip (bf16 MXU), ici_gbps.
+    """
+    params = ctx.get("num_params", 1e9)
+    gbs = ctx.get("global_batch_size", 256)
+    seq = ctx.get("seq_len", 2048)
+    flops_chip = ctx.get("flops_per_chip", 200e12)
+    ici = ctx.get("ici_gbps", 100e9)
+
+    dp, mp, pp = cand["dp_degree"], cand["mp_degree"], cand["pp_degree"]
+    shard = cand.get("sharding_degree", 1)
+    n = dp * mp * pp
+
+    # compute: 6 * params * tokens forward+backward, split over chips
+    tokens = gbs * seq
+    flops = 6.0 * params * tokens
+    if cand.get("use_recompute"):
+        flops *= 4.0 / 3.0  # one extra forward
+    t_compute = flops / (n * flops_chip)
+
+    # comm per step:
+    #  dp/sharding: grad reduce-scatter+all-gather ~ 2 * params/(mp*pp) * 2B
+    #  mp: 4 allreduces of activations per layer ~ handled as fraction of compute
+    #  pp: p2p activations, small
+    p_local = params / (mp * pp)
+    t_dp = (2.0 * p_local * 2.0) / ici * (dp > 1 or shard > 1)
+    t_mp = t_compute * 0.08 * (mp > 1)  # empirical overlap-adjusted fraction
+    micro = max(1, cand.get("accumulate_steps", gbs // dp))
+    bubble = (pp - 1) / (micro + pp - 1) if pp > 1 else 0.0
+    t_pp = t_compute * bubble
+
+    return t_compute + t_dp + t_mp + t_pp

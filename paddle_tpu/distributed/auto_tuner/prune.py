@@ -1,0 +1,106 @@
+"""Candidate pruning rules (reference: auto_tuner/prune.py — registered
+``@register_prune`` functions; same rule semantics, TPU constraints)."""
+
+from __future__ import annotations
+
+__all__ = ["default_prune_rules", "prune_candidates", "register_prune"]
+
+_PRUNE_RULES = []
+
+
+def register_prune(fn):
+    _PRUNE_RULES.append(fn)
+    return fn
+
+
+@register_prune
+def prune_by_device_count(cand, ctx) -> str | None:
+    """dp*mp*pp*sharding*sep must exactly tile the chip count."""
+    total = (cand["dp_degree"] * cand["mp_degree"] * cand["pp_degree"]
+             * cand.get("sep_degree", 1))
+    n = ctx.get("num_devices", 1)
+    if total != n:
+        return f"degrees product {total} != device count {n}"
+    # ZeRO shards over the dp axis — its degree must divide dp
+    if cand["dp_degree"] % cand.get("sharding_degree", 1) != 0:
+        return "sharding_degree must divide dp_degree"
+    return None
+
+
+@register_prune
+def prune_by_mp_width(cand, ctx) -> str | None:
+    """mp must divide attention heads and hidden size (Megatron constraint)."""
+    heads = ctx.get("num_attention_heads")
+    hidden = ctx.get("hidden_size")
+    mp = cand["mp_degree"]
+    if heads and heads % mp != 0:
+        return f"mp {mp} does not divide num heads {heads}"
+    if hidden and hidden % mp != 0:
+        return f"mp {mp} does not divide hidden {hidden}"
+    return None
+
+
+@register_prune
+def prune_by_pp_layers(cand, ctx) -> str | None:
+    layers = ctx.get("num_layers")
+    pp = cand["pp_degree"]
+    if layers and layers % pp != 0:
+        return f"pp {pp} does not divide layers {layers}"
+    return None
+
+
+@register_prune
+def prune_by_micro_batch(cand, ctx) -> str | None:
+    """global batch = dp * accumulate * micro — micro must tile local batch."""
+    gbs = ctx.get("global_batch_size")
+    if not gbs:
+        return None
+    local = gbs // cand["dp_degree"] if gbs % cand["dp_degree"] == 0 else None
+    if local is None:
+        return f"dp {cand['dp_degree']} does not divide global batch {gbs}"
+    mbs = cand.get("micro_batch_size", local)
+    if local % mbs != 0:
+        return f"micro batch {mbs} does not divide local batch {local}"
+    return None
+
+
+@register_prune
+def prune_by_memory(cand, ctx) -> str | None:
+    """Coarse HBM estimate (reference prune.py prune_by_memory_estimation):
+    params/(mp*pp*zero) * (2 bytes + 16 optimizer) + activations/(recompute?)."""
+    params = ctx.get("num_params")
+    hbm = ctx.get("hbm_bytes_per_chip")
+    if not params or not hbm:
+        return None
+    mp, pp = cand["mp_degree"], cand["pp_degree"]
+    shard = cand.get("sharding_degree", 1)
+    stage = cand.get("sharding_stage", 1)
+    p_local = params / (mp * pp)
+    weight_b = 2 * p_local / (shard if stage >= 3 else 1)
+    grad_b = 2 * p_local / (shard if stage >= 2 else 1)
+    opt_b = 16 * p_local / shard
+    act = ctx.get("activation_bytes", 0) / (mp * pp)
+    if cand.get("use_recompute"):
+        act *= 0.25
+    need = weight_b + grad_b + opt_b + act
+    if need > hbm * 0.92:
+        return f"memory estimate {need / 2**30:.1f}GiB > chip HBM"
+    return None
+
+
+def default_prune_rules():
+    return list(_PRUNE_RULES)
+
+
+def prune_candidates(candidates, ctx, rules=None):
+    """Return (kept, pruned) where pruned is [(cand, reason)]."""
+    rules = rules if rules is not None else default_prune_rules()
+    kept, pruned = [], []
+    for c in candidates:
+        reason = None
+        for r in rules:
+            reason = r(c, ctx)
+            if reason:
+                break
+        (pruned if reason else kept).append((c, reason) if reason else c)
+    return kept, pruned

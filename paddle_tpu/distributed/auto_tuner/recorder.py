@@ -1,0 +1,50 @@
+"""Trial history (reference: auto_tuner/recorder.py HistoryRecorder —
+stores per-trial config + metric, sorts, persists to csv/json)."""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+
+__all__ = ["HistoryRecorder"]
+
+
+class HistoryRecorder:
+    def __init__(self, metric_name: str = "step_time", mode: str = "min"):
+        self.metric_name = metric_name
+        self.mode = mode
+        self.history: list[dict] = []
+
+    def add(self, cand: dict, metric: float | None, error: str | None = None):
+        rec = dict(cand)
+        rec[self.metric_name] = metric
+        rec["has_error"] = error is not None
+        rec["error_info"] = error
+        self.history.append(rec)
+
+    def best(self) -> dict | None:
+        ok = [r for r in self.history if not r["has_error"] and r[self.metric_name] is not None]
+        if not ok:
+            return None
+        return (min if self.mode == "min" else max)(ok, key=lambda r: r[self.metric_name])
+
+    def sorted(self) -> list[dict]:
+        ok = [r for r in self.history if not r["has_error"]]
+        return sorted(ok, key=lambda r: r[self.metric_name], reverse=(self.mode == "max"))
+
+    def store_history(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if path.endswith(".json"):
+            with open(path, "w") as f:
+                json.dump(self.history, f, indent=2, default=str)
+        else:
+            keys = sorted({k for r in self.history for k in r})
+            with open(path, "w", newline="") as f:
+                w = csv.DictWriter(f, fieldnames=keys)
+                w.writeheader()
+                w.writerows(self.history)
+
+    def load_history(self, path: str):
+        with open(path) as f:
+            self.history = json.load(f) if path.endswith(".json") else list(csv.DictReader(f))

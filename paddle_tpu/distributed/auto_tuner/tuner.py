@@ -1,0 +1,121 @@
+"""AutoTuner search driver (reference: auto_tuner/tuner.py:21).
+
+The reference enumerates {dp, mp, pp, sharding stage/degree, micro batch,
+recompute} from a json config, prunes, and launches each surviving candidate
+as a trial job.  Here the trial runner is pluggable: by default a candidate is
+*scored* by the cost model; pass ``run_trial`` to actually execute one (e.g.
+build a mesh of that shape, jit one step on tiny shapes, time it — the
+driver-style dryrun), and the tuner records the measured metric.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from .cost_model import estimate_cost
+from .prune import prune_candidates
+from .recorder import HistoryRecorder
+
+__all__ = ["AutoTuner", "TunerConfig"]
+
+
+class TunerConfig:
+    """Search-space spec (reference: the ``--auto_tuner_json`` schema)."""
+
+    def __init__(
+        self,
+        num_devices: int,
+        dp_degree="auto",
+        mp_degree="auto",
+        pp_degree="auto",
+        sharding_degree="auto",
+        sharding_stage=(1, 2, 3),
+        micro_batch_size="auto",
+        use_recompute=(False, True),
+        global_batch_size=None,
+        model_ctx=None,
+        max_trials=0,
+        metric="step_time",
+        mode="min",
+    ):
+        self.num_devices = num_devices
+        self.global_batch_size = global_batch_size
+        self.model_ctx = dict(model_ctx or {})
+        self.max_trials = max_trials
+        self.metric = metric
+        self.mode = mode
+
+        def axis(v):
+            if v == "auto":
+                return [d for d in _divisors(num_devices)]
+            return list(v) if isinstance(v, (list, tuple)) else [v]
+
+        self.dp = axis(dp_degree)
+        self.mp = axis(mp_degree)
+        self.pp = axis(pp_degree)
+        self.sharding = axis(sharding_degree)
+        self.stages = list(sharding_stage) if isinstance(sharding_stage, (list, tuple)) else [sharding_stage]
+        if micro_batch_size == "auto":
+            gbs = global_batch_size or 32
+            self.mbs = [m for m in (1, 2, 4, 8, 16, 32) if m <= gbs]
+        else:
+            self.mbs = list(micro_batch_size) if isinstance(micro_batch_size, (list, tuple)) else [micro_batch_size]
+        self.recompute = list(use_recompute) if isinstance(use_recompute, (list, tuple)) else [use_recompute]
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class AutoTuner:
+    def __init__(self, config: TunerConfig, run_trial=None, prune_rules=None):
+        self.cfg = config
+        self.run_trial = run_trial
+        self.prune_rules = prune_rules
+        self.recorder = HistoryRecorder(config.metric, config.mode)
+        self._ctx = {
+            "num_devices": config.num_devices,
+            "global_batch_size": config.global_batch_size,
+            **config.model_ctx,
+        }
+
+    # -- candidate generation (reference tuner.py search space build) ------
+    def candidates(self) -> list[dict]:
+        cands = []
+        for dp, mp, pp, sh, st, mbs, rc in itertools.product(
+            self.cfg.dp, self.cfg.mp, self.cfg.pp, self.cfg.sharding,
+            self.cfg.stages, self.cfg.mbs, self.cfg.recompute,
+        ):
+            cands.append({
+                "dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+                "sharding_degree": sh, "sharding_stage": st,
+                "micro_batch_size": mbs, "use_recompute": rc,
+            })
+        # dedup after pruning-irrelevant collapses (sharding degree 1 → stage moot)
+        uniq = []
+        seen = set()
+        for c in cands:
+            key = tuple(sorted((k, v) for k, v in c.items() if not (c["sharding_degree"] == 1 and k == "sharding_stage")))
+            if key not in seen:
+                seen.add(key)
+                uniq.append(c)
+        kept, self.pruned = prune_candidates(uniq, self._ctx, self.prune_rules)
+        # cost-model ordering: most promising first
+        kept.sort(key=lambda c: estimate_cost(c, self._ctx))
+        return kept
+
+    def tune(self) -> dict | None:
+        """Run the search; returns the best candidate record."""
+        cands = self.candidates()
+        if self.cfg.max_trials:
+            cands = cands[: self.cfg.max_trials]
+        for cand in cands:
+            if self.run_trial is None:
+                self.recorder.add(cand, estimate_cost(cand, self._ctx))
+                continue
+            try:
+                metric = self.run_trial(cand)
+                self.recorder.add(cand, metric)
+            except Exception as e:  # a failing trial prunes, not aborts
+                self.recorder.add(cand, None, error=str(e))
+        return self.recorder.best()

@@ -441,3 +441,37 @@ class stream:
     broadcast = staticmethod(broadcast)
     send = staticmethod(send)
     recv = staticmethod(recv)
+
+
+# ---- watchdog instrumentation (reference: every ProcessGroup task is tracked
+# by CommTaskManager, comm_task_manager.cc:66; here the host-side eager
+# collectives are the trackable unit — see distributed/comm_watchdog.py) ----
+
+def _watched(fn):
+    import functools
+    import inspect
+
+    from .comm_watchdog import comm_task
+
+    sig = inspect.signature(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:  # group may be passed positionally — bind to find it
+            group = sig.bind(*args, **kwargs).arguments.get("group")
+        except TypeError:
+            group = kwargs.get("group")
+        with comm_task(fn.__name__, group):
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+for _name in (
+    "all_reduce", "all_gather", "reduce_scatter", "alltoall", "alltoall_single",
+    "broadcast", "reduce", "scatter", "gather", "send", "recv", "barrier",
+):
+    globals()[_name] = _watched(globals()[_name])
+    if hasattr(stream, _name):  # the stream.* aliases must be watched too
+        setattr(stream, _name, staticmethod(globals()[_name]))
+del _name

@@ -1,0 +1,135 @@
+"""Collective watchdog.
+
+Reference: ``CommTaskManager`` (paddle/phi/core/distributed/
+comm_task_manager.cc:66,137) — a daemon thread tracks every in-flight NCCL
+task; on timeout it dumps per-rank collective state (started/completed,
+op type, sequence number) so the stuck rank can be located
+(FLAGS_enable_async_trace).
+
+TPU-native: in-program collectives are scheduled by XLA, so the hang mode the
+reference guards against (one rank missing a collective) surfaces as a host
+blocked in a device fetch.  The watchdog therefore tracks *host-side* comm
+tasks — eager collective calls, store rendezvous, checkpoint barriers — via
+the :func:`comm_task` context manager, and a daemon thread dumps all tasks
+that have been in flight past the timeout (op name, group, seq, elapsed),
+mirroring the reference's dump format.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+from ..core.flags import define_flag, flag
+
+__all__ = ["CommTaskManager", "comm_task", "enable_comm_watchdog"]
+
+define_flag("FLAGS_comm_watchdog_timeout", 600.0, "seconds before a comm task is reported stuck")
+define_flag("FLAGS_enable_async_trace", False, "enable the collective watchdog thread")
+
+
+class _Task:
+    __slots__ = ("name", "group", "seq", "start")
+
+    def __init__(self, name, group, seq):
+        self.name = name
+        self.group = group
+        self.seq = seq
+        self.start = time.monotonic()
+
+
+class CommTaskManager:
+    """Singleton watchdog (reference comm_task_manager.cc:66)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __new__(cls):
+        with cls._lock:
+            if cls._instance is None:
+                inst = super().__new__(cls)
+                inst._tasks = {}
+                inst._seq = 0
+                inst._mu = threading.Lock()
+                inst._thread = None
+                inst._stop = threading.Event()
+                cls._instance = inst
+            return cls._instance
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def start_task(self, name: str, group=None) -> int:
+        with self._mu:
+            self._seq += 1
+            seq = self._seq
+            self._tasks[seq] = _Task(name, getattr(group, "name", group), seq)
+        if flag("FLAGS_enable_async_trace"):
+            self._ensure_thread()
+        return seq
+
+    def end_task(self, seq: int):
+        with self._mu:
+            self._tasks.pop(seq, None)
+
+    # -- the watchdog loop (reference comm_task_manager.cc:137) ------------
+    def _loop(self):
+        while not self._stop.wait(5.0):
+            timeout = float(flag("FLAGS_comm_watchdog_timeout"))
+            now = time.monotonic()
+            with self._mu:
+                stuck = [t for t in self._tasks.values() if now - t.start > timeout]
+            if stuck:
+                self.dump(stuck)
+
+    def dump(self, tasks=None, file=None):
+        """Dump in-flight comm state (the stuck-rank locator)."""
+        file = file or sys.stderr
+        with self._mu:
+            tasks = list(self._tasks.values()) if tasks is None else tasks
+        now = time.monotonic()
+        print("==== comm watchdog: in-flight collective tasks ====", file=file)
+        for t in tasks:
+            print(
+                f"  seq={t.seq} op={t.name} group={t.group} "
+                f"elapsed={now - t.start:.1f}s state=started",
+                file=file,
+            )
+        print("===================================================", file=file)
+
+    def pending(self) -> int:
+        with self._mu:
+            return len(self._tasks)
+
+    def shutdown(self):
+        self._stop.set()
+
+
+class comm_task:
+    """Context manager wrapping one host-side comm operation."""
+
+    def __init__(self, name: str, group=None):
+        self.name = name
+        self.group = group
+        self._seq = None
+
+    def __enter__(self):
+        self._seq = CommTaskManager().start_task(self.name, self.group)
+        return self
+
+    def __exit__(self, *exc):
+        CommTaskManager().end_task(self._seq)
+        return False
+
+
+def enable_comm_watchdog(timeout: float | None = None):
+    from ..core import flags as _flags
+
+    _flags.set_flags({"FLAGS_enable_async_trace": True})
+    if timeout is not None:
+        _flags.set_flags({"FLAGS_comm_watchdog_timeout": timeout})
+    CommTaskManager()._ensure_thread()

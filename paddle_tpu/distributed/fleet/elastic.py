@@ -1,0 +1,131 @@
+"""Elastic training manager.
+
+Reference: ``ElasticManager`` (python/paddle/distributed/fleet/elastic/
+manager.py:125) — etcd node registry, heartbeat lease (lease_heartbeat
+:254), host-set watch, endpoint rewrite + trainer restart, scale-in/out
+levels (_update_elastic_scale_out :484).
+
+TPU-native: etcd is replaced by the job :class:`~paddle_tpu.distributed.store.
+TCPStore` (the same rendezvous store the launcher uses).  Each node registers
+``elastic/{job}/nodes/{host}`` and refreshes a heartbeat timestamp; the watch
+loop detects dead nodes (stale heartbeat) and joiners, recomputes the
+endpoint list, and signals the controller to restart trainers with rewritten
+``PADDLE_TRAINER_ENDPOINTS`` — on TPU pods a membership change also forces a
+fresh ``jax.distributed`` init, since the ICI mesh shape is baked into
+compiled programs (SURVEY.md §5 "Failure detection").
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..store import TCPStore
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, args=None, store: TCPStore | None = None,
+                 job_id: str | None = None, host: str | None = None,
+                 np: int | None = None, heartbeat_interval: float = 3.0,
+                 lease_ttl: float = 10.0):
+        self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default")
+        self.host = host or os.environ.get("POD_IP", "127.0.0.1")
+        self.np = np or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.heartbeat_interval = heartbeat_interval
+        self.lease_ttl = lease_ttl
+        self.store = store
+        self.enable = store is not None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._need_restart = threading.Event()
+        self.hosts: list[str] = []
+
+    # -- registry / heartbeat (reference manager.py:254 lease_heartbeat) ----
+    def _key(self, *parts):
+        return "/".join(("elastic", self.job_id) + parts)
+
+    def register(self):
+        if not self.enable:
+            return
+        self.store.set(self._key("nodes", self.host), str(time.time()))
+        t = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        w = threading.Thread(target=self._watch_loop, daemon=True)
+        w.start()
+        self._threads.append(w)
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self.store.set(self._key("nodes", self.host), str(time.time()))
+            except Exception:
+                pass
+
+    def _alive_hosts(self) -> list[str]:
+        now = time.time()
+        hosts = []
+        for k in self.store.keys(self._key("nodes") + "/"):
+            v = self.store.get(k)
+            if v is None:
+                continue
+            try:
+                ts = float(v.decode())
+            except ValueError:
+                continue
+            if now - ts <= self.lease_ttl:
+                hosts.append(k.rsplit("/", 1)[1])
+        return sorted(hosts)
+
+    # -- watch (reference manager.py host watch + endpoint rewrite) ---------
+    def _watch_loop(self):
+        self.hosts = self._alive_hosts()
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                current = self._alive_hosts()
+            except Exception:
+                continue
+            if current != self.hosts:
+                self.hosts = current
+                self._rewrite_endpoints(current)
+                self._need_restart.set()
+
+    def _rewrite_endpoints(self, hosts):
+        eps = ",".join(f"{h}:6170" for h in hosts)
+        os.environ["PADDLE_TRAINER_ENDPOINTS"] = eps
+        os.environ["PADDLE_TRAINERS_NUM"] = str(len(hosts))
+
+    # -- controller interface ----------------------------------------------
+    def wait(self, timeout: float | None = None) -> str:
+        """Block until a membership change requires restart (or timeout)."""
+        if not self.enable:
+            return ElasticStatus.COMPLETED
+        if self._need_restart.wait(timeout):
+            self._need_restart.clear()
+            n = len(self.hosts)
+            if n == 0:
+                return ElasticStatus.ERROR
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    def should_restart(self) -> bool:
+        return self._need_restart.is_set()
+
+    def exit(self, completed: bool = True):
+        self._stop.set()
+        if self.enable:
+            try:
+                self.store.delete_key(self._key("nodes", self.host))
+            except Exception:
+                pass

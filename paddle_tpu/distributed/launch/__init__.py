@@ -1,0 +1,14 @@
+"""Launch CLI (reference: python/paddle/distributed/launch/ — main.py:23,
+controllers/collective.py:26).
+
+``python -m paddle_tpu.distributed.launch [--nnodes N] [--nproc_per_node M]
+[--master ip:port] train.py args...`` builds the pod for this node, exports the
+``PADDLE_*`` environment per process, starts and watches them.
+
+TPU note: on TPU pods the natural layout is ONE process per host with all
+local chips attached (jax.distributed), so ``--nproc_per_node`` defaults to 1;
+N-proc-per-node is supported for CPU simulation and tests (each proc gets a
+disjoint slice of devices via JAX_VISIBLE_DEVICES-style env).
+"""
+
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,198 @@
+"""Collective controller + pod/process management.
+
+Reference: launch/controllers/collective.py:26 (build pod, per-proc env),
+launch/controllers/controller.py (run/watch loop), launch/job/pod.py.
+
+Flow: rendezvous through the job TCPStore (master node serves it) → each node
+registers its endpoint → controller computes the global rank layout → spawns
+``nproc_per_node`` local processes with the ``PADDLE_*`` env → watches them,
+restarting per elastic policy (controllers/watcher.py)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ..store import TCPStore
+
+__all__ = ["Context", "CollectiveController", "ProcContainer"]
+
+
+class Context:
+    def __init__(self, args):
+        self.args = args
+        nn = str(args.nnodes)
+        if ":" in nn:
+            lo, hi = nn.split(":")
+            self.min_nodes, self.max_nodes = int(lo), int(hi)
+        else:
+            self.min_nodes = self.max_nodes = int(nn)
+        self.elastic = args.elastic_level >= 0 or self.min_nodes != self.max_nodes
+
+
+class ProcContainer:
+    """One training process (reference: launch/job/container.py)."""
+
+    def __init__(self, cmd, env, log_path):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc: subprocess.Popen | None = None
+        self._log_f = None
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        self._log_f = open(self.log_path, "ab", buffering=0)
+        self.proc = subprocess.Popen(
+            self.cmd, env=self.env, stdout=self._log_f, stderr=subprocess.STDOUT
+        )
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def returncode(self):
+        return None if self.proc is None else self.proc.poll()
+
+    def terminate(self, grace=10.0):
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                break
+            time.sleep(0.1)
+        if self.proc.poll() is None:
+            self.proc.kill()
+        if self._log_f:
+            self._log_f.close()
+            self._log_f = None
+
+
+class CollectiveController:
+    """Reference CollectiveController (controllers/collective.py:26)."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.args = ctx.args
+        self.pod: list[ProcContainer] = []
+        self.store: TCPStore | None = None
+        self.node_rank = 0
+        self.nnodes = 1
+
+    # ---- rendezvous -----------------------------------------------------
+    def _rendezvous(self):
+        args = self.args
+        if args.master is None or self.ctx.max_nodes == 1:
+            self.node_rank, self.nnodes = 0, 1
+            self.endpoints = [f"{args.host}"]
+            return
+        host, port = args.master.split(":")
+        is_master = args.rank in (0, -1) and host in (args.host, "127.0.0.1", "localhost")
+        try:
+            self.store = TCPStore(host, int(port), is_master=is_master,
+                                  world_size=self.ctx.max_nodes, timeout=120)
+        except (TimeoutError, OSError):
+            # master already served by another proc on this host — join as client
+            self.store = TCPStore(host, int(port), is_master=False, timeout=120)
+        ns = f"job/{args.job_id}"
+        if args.rank >= 0:
+            self.node_rank = args.rank
+        else:
+            self.node_rank = self.store.add(f"{ns}/node_counter") - 1
+        self.store.set(f"{ns}/node/{self.node_rank}", args.host)
+        self.nnodes = self.ctx.min_nodes
+        # barrier: wait for min_nodes registrations
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if len(self.store.keys(f"{ns}/node/")) >= self.nnodes:
+                break
+            time.sleep(0.2)
+        self.endpoints = []
+        for r in range(self.nnodes):
+            v = self.store.get(f"{ns}/node/{r}")
+            self.endpoints.append(v.decode() if v else "")
+
+    # ---- pod build ------------------------------------------------------
+    def build_pod(self):
+        args = self.args
+        nproc = args.nproc_per_node
+        world = self.nnodes * nproc
+        devices = args.devices.split(",") if args.devices else None
+        master_addr = (args.master or f"{args.host}:8476").split(":")[0]
+        master_port = (args.master or ":8476").split(":")[1]
+        self.pod = []
+        for local in range(nproc):
+            rank = self.node_rank * nproc + local
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local),
+                "PADDLE_NODE_RANK": str(self.node_rank),
+                "PADDLE_MASTER": f"{master_addr}:{master_port}",
+                "MASTER_ADDR": master_addr,
+                "MASTER_PORT": master_port,
+                "RANK": str(rank),
+                "WORLD_SIZE": str(world),
+                "PADDLE_CURRENT_ENDPOINT": f"{args.host}:{6170 + local}",
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(
+                    f"{ep}:{6170 + l}" for ep in getattr(self, "endpoints", [args.host])
+                    for l in range(nproc)
+                ),
+            })
+            if devices:
+                per = max(1, len(devices) // nproc)
+                mine = devices[local * per:(local + 1) * per]
+                env["JAX_VISIBLE_DEVICES"] = ",".join(mine)
+                env["CUDA_VISIBLE_DEVICES"] = ",".join(mine)
+            script = args.training_script
+            if script.endswith(".py"):
+                cmd = [sys.executable, "-u", script] + args.training_script_args
+            else:
+                cmd = [script] + args.training_script_args
+            log = os.path.join(args.log_dir, f"workerlog.{local}")
+            self.pod.append(ProcContainer(cmd, env, log))
+
+    # ---- run/watch loop --------------------------------------------------
+    def run(self) -> int:
+        self._rendezvous()
+        restarts = 0
+        while True:
+            self.build_pod()
+            for c in self.pod:
+                c.start()
+            rc = self._watch()
+            if rc == 0:
+                return 0
+            restarts += 1
+            if self.args.elastic_level < 0 or restarts > self.args.max_restart:
+                return rc
+            print(f"[launch] pod failed (rc={rc}); restart {restarts}/{self.args.max_restart}",
+                  file=sys.stderr)
+            for c in self.pod:
+                c.terminate()
+            time.sleep(2)
+
+    def _watch(self) -> int:
+        """Reference watcher (controllers/watcher.py): any proc exit !=0 kills
+        the pod; all-zero exit ends the job."""
+        try:
+            while True:
+                codes = [c.returncode for c in self.pod]
+                if any(rc not in (None, 0) for rc in codes):
+                    bad = next(rc for rc in codes if rc not in (None, 0))
+                    for c in self.pod:
+                        c.terminate()
+                    return bad
+                if all(rc == 0 for rc in codes):
+                    return 0
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            for c in self.pod:
+                c.terminate()
+            return 130

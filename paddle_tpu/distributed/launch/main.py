@@ -1,0 +1,60 @@
+"""Launcher entry (reference: launch/main.py:23 + context/args).
+
+Controller selection mirrors the reference (controllers/__init__.py): the
+collective controller is the default and only TPU-relevant one (the reference's
+ps/rpc/ipu controllers serve the parameter-server stack, out of the TPU
+north-star path — SURVEY.md §1)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .controller import CollectiveController, Context
+
+__all__ = ["launch", "main"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch distributed training jobs",
+    )
+    p.add_argument("--master", default=None,
+                   help="ip:port of the rendezvous store; default: this node (rank 0 serves)")
+    p.add_argument("--rank", type=int, default=int(os.environ.get("PADDLE_NODE_RANK", -1)),
+                   help="node rank; -1 = assign via the master store")
+    p.add_argument("--nnodes", type=str, default=os.environ.get("PADDLE_NNODES", "1"),
+                   help="number of nodes, or an elastic range 'min:max'")
+    p.add_argument("--nproc_per_node", type=int,
+                   default=int(os.environ.get("PADDLE_NPROC_PER_NODE", "1")),
+                   help="processes per node (TPU default 1: one proc owns all local chips)")
+    p.add_argument("--job_id", default=os.environ.get("PADDLE_JOB_ID", "default"),
+                   help="job id namespacing store keys")
+    p.add_argument("--devices", default=os.environ.get("PADDLE_DEVICES"),
+                   help="comma list of device ids to split across local procs")
+    p.add_argument("--log_dir", default="log", help="per-process log directory")
+    p.add_argument("--max_restart", type=int, default=3,
+                   help="max restarts before giving up (elastic)")
+    p.add_argument("--elastic_level", type=int, default=-1,
+                   help="-1 off, 0 restart failed pod, 1 allow scale in/out")
+    p.add_argument("--host", default=os.environ.get("POD_IP", "127.0.0.1"))
+    p.add_argument("training_script", help="script to run (or -m module)")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def launch(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    ctx = Context(args)
+    controller = CollectiveController(ctx)
+    return controller.run()
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

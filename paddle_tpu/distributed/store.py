@@ -1,0 +1,195 @@
+"""Rendezvous key-value store.
+
+Reference: ``TCPStore`` (paddle/phi/core/distributed/store/tcp_store.h:121,
+socket.cpp) — a master process serves a KV map over TCP; clients set/get/add/
+wait keys to bootstrap process groups before any collective backend exists.
+
+TPU mapping: multi-host JAX bootstraps through the PJRT coordination service
+(jax.distributed), but the framework still needs a tiny host-side KV store for
+the launch CLI, elastic membership, and checkpoint coordination — exactly the
+role the reference's TCPStore plays next to NCCL.  Wire protocol is
+length-prefixed pickle: (cmd, key, value) → (status, value).
+
+A C++ implementation of the same wire protocol (paddle_tpu/native) is used
+automatically when the native extension is built; this file is the pure-Python
+server/client and the fallback.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+__all__ = ["TCPStore", "MasterDaemon"]
+
+_HDR = struct.Struct("!I")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class MasterDaemon:
+    """The store server (reference MasterDaemon, tcp_store.cc)."""
+
+    def __init__(self, port: int, world_size: int = 1, host: str = ""):
+        self._data: dict[str, bytes] = {}
+        self._lock = threading.Condition()
+        self._world_size = world_size
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(128)
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            while True:
+                cmd, key, value = _recv_msg(conn)
+                with self._lock:
+                    if cmd == "set":
+                        self._data[key] = value
+                        self._lock.notify_all()
+                        _send_msg(conn, ("ok", None))
+                    elif cmd == "get":
+                        _send_msg(conn, ("ok", self._data.get(key)))
+                    elif cmd == "add":
+                        cur = int(self._data.get(key, b"0").decode() or 0)
+                        cur += int(value)
+                        self._data[key] = str(cur).encode()
+                        self._lock.notify_all()
+                        _send_msg(conn, ("ok", cur))
+                    elif cmd == "delete":
+                        existed = self._data.pop(key, None) is not None
+                        self._lock.notify_all()
+                        _send_msg(conn, ("ok", existed))
+                    elif cmd == "keys":
+                        prefix = key or ""
+                        _send_msg(conn, ("ok", [k for k in self._data if k.startswith(prefix)]))
+                    elif cmd == "wait":
+                        deadline = time.monotonic() + (value or 300.0)
+                        while key not in self._data:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                break
+                            self._lock.wait(min(remaining, 1.0))
+                        if key in self._data:
+                            _send_msg(conn, ("ok", self._data[key]))
+                        else:
+                            _send_msg(conn, ("timeout", None))
+                    else:
+                        _send_msg(conn, ("error", f"unknown cmd {cmd!r}"))
+        except (ConnectionError, EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TCPStore:
+    """Client (+ embedded server when ``is_master``).
+
+    API mirrors the reference's pybind surface: set/get/add/wait/delete_key/
+    num_keys, values are bytes.
+    """
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        self.host = host
+        self.timeout = timeout
+        self._daemon = None
+        if is_master:
+            self._daemon = MasterDaemon(port, world_size)
+            port = self._daemon.port
+        self.port = port
+        deadline = time.monotonic() + timeout
+        last_err = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=timeout)
+                break
+            except OSError as e:
+                last_err = e
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"cannot reach store at {host}:{port}: {e}")
+                time.sleep(0.2)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def _call(self, cmd, key, value=None):
+        with self._lock:
+            _send_msg(self._sock, (cmd, key, value))
+            status, out = _recv_msg(self._sock)
+        if status == "timeout":
+            raise TimeoutError(f"store wait({key!r}) timed out")
+        if status == "error":
+            raise RuntimeError(out)
+        return out
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        self._call("set", key, value)
+
+    def get(self, key: str):
+        return self._call("get", key)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return self._call("add", key, amount)
+
+    def wait(self, key: str, timeout: float | None = None):
+        return self._call("wait", key, timeout or self.timeout)
+
+    def delete_key(self, key: str) -> bool:
+        return self._call("delete", key)
+
+    def keys(self, prefix: str = ""):
+        return self._call("keys", prefix)
+
+    def num_keys(self) -> int:
+        return len(self.keys())
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._daemon is not None:
+            self._daemon.stop()

@@ -1,0 +1,81 @@
+"""Auto-tuner tests (mirrors test/auto_tuner/: pruning rules + search)."""
+
+import numpy as np
+
+from paddle_tpu.distributed.auto_tuner import AutoTuner, TunerConfig, estimate_cost, prune_candidates
+
+
+def _ctx(**kw):
+    base = {"num_devices": 8, "global_batch_size": 32, "num_attention_heads": 16,
+            "hidden_size": 512, "num_layers": 8}
+    base.update(kw)
+    return base
+
+
+def test_prune_device_count_and_divisibility():
+    cands = [
+        {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2, "sharding_degree": 1, "micro_batch_size": 4},
+        {"dp_degree": 8, "mp_degree": 2, "pp_degree": 1, "sharding_degree": 1, "micro_batch_size": 4},  # 16 != 8
+        {"dp_degree": 4, "mp_degree": 2, "pp_degree": 1, "sharding_degree": 3, "micro_batch_size": 4},  # 3 ∤ 4
+        {"dp_degree": 1, "mp_degree": 32, "pp_degree": 1, "sharding_degree": 1, "micro_batch_size": 4},  # heads
+    ]
+    kept, pruned = prune_candidates(cands, _ctx())
+    assert kept == [cands[0]]
+    assert len(pruned) == 3
+    reasons = " | ".join(r for _, r in pruned)
+    assert "device count" in reasons and "divide" in reasons
+
+
+def test_prune_by_memory_estimate():
+    cands = [{"dp_degree": 8, "mp_degree": 1, "pp_degree": 1, "sharding_degree": 1,
+              "sharding_stage": 1, "micro_batch_size": 1}]
+    # 8B params, 16 GiB chips: pure DP replication cannot fit
+    kept, pruned = prune_candidates(cands, _ctx(num_params=8e9, hbm_bytes_per_chip=16 * 2**30))
+    assert not kept and "HBM" in pruned[0][1]
+
+
+def test_cost_model_prefers_parallelism_for_big_models():
+    ctx = _ctx(num_params=8e9, seq_len=2048)
+    pure_dp = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1, "sharding_degree": 8,
+               "sharding_stage": 1, "micro_batch_size": 4, "use_recompute": False}
+    with_pp_no_accum = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 8, "sharding_degree": 1,
+                        "sharding_stage": 1, "micro_batch_size": 4, "accumulate_steps": 1,
+                        "use_recompute": False}
+    # a pipeline with 1 microbatch is mostly bubble — must cost more
+    assert estimate_cost(pure_dp, ctx) < estimate_cost(with_pp_no_accum, ctx)
+
+
+def test_autotuner_search_with_trial_runner():
+    cfg = TunerConfig(num_devices=8, global_batch_size=32,
+                      sharding_stage=(1,), use_recompute=(False,),
+                      model_ctx=_ctx())
+    # synthetic trial: best at dp=4, mp=2
+    def run(c):
+        return abs(c["dp_degree"] - 4) + abs(c["mp_degree"] - 2) + 0.01 * c["micro_batch_size"]
+
+    tuner = AutoTuner(cfg, run_trial=run)
+    best = tuner.best = tuner.tune()
+    assert best is not None
+    assert best["dp_degree"] == 4 and best["mp_degree"] == 2
+    assert all(r["has_error"] is False for r in tuner.recorder.history)
+
+
+def test_autotuner_trial_error_is_recorded_not_fatal(tmp_path):
+    cfg = TunerConfig(num_devices=4, global_batch_size=16, sharding_stage=(1,),
+                      use_recompute=(False,))
+
+    calls = {"n": 0}
+
+    def run(c):
+        calls["n"] += 1
+        if c["mp_degree"] > 1:
+            raise RuntimeError("OOM")
+        return float(c["dp_degree"])
+
+    tuner = AutoTuner(cfg, run_trial=run)
+    best = tuner.tune()
+    assert best is not None and best["mp_degree"] == 1
+    assert any(r["has_error"] for r in tuner.recorder.history)
+    out = tmp_path / "hist.json"
+    tuner.recorder.store_history(str(out))
+    assert out.exists()

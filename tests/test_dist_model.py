@@ -178,3 +178,38 @@ def test_trainstep_bn_and_model_arrays_survive_donation():
     step(x, y)  # sync must not hand donated aliases back
     for _, p in model.named_parameters():
         p.numpy()
+
+
+def test_dist_model_state_roundtrip_and_lr_schedule():
+    """Optimizer moments + LR schedule must survive save/restore (resume)."""
+    from paddle_tpu.optimizer.lr import StepDecay
+
+    model = _make_model()
+    loss_fn = nn.MSELoss()
+    sched = StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+    opt = optimizer.Adam(learning_rate=sched, parameters=model.parameters())
+    dm = dist.to_static(model, None, loss_fn, opt)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 8).astype(np.float32))
+    y = paddle.to_tensor(np.zeros((8, 1), np.float32))
+    dm(x, y)
+    dm(x, y)
+    assert opt.get_lr() < 0.1  # scheduler actually stepped
+    sd = dm.state_dict("all")
+    assert any(k.startswith("__opt__.") for k in sd)
+
+    model2 = _make_model()
+    opt2 = optimizer.Adam(learning_rate=0.05, parameters=model2.parameters())
+    dm2 = dist.to_static(model2, None, loss_fn, opt2)
+    dm2.set_state_dict(sd)
+    assert int(dm2._opt_state["step"]) == 2
+    k = next(iter(dm2._opt_state["acc"]))
+    assert float(abs(dm2._opt_state["acc"][k]["moment1"]).sum()) > 0
+
+
+def test_stream_collectives_are_watched():
+    import paddle_tpu as paddle
+
+    mgr = dist.CommTaskManager()
+    before = mgr.pending()
+    dist.stream.all_reduce(paddle.to_tensor(np.ones((2,), np.float32)))
+    assert mgr.pending() == before

@@ -1,0 +1,108 @@
+"""Launch CLI, TCPStore, elastic manager, comm watchdog tests
+(mirrors test/collective/fleet elastic + launch unit tests)."""
+
+import io
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+from paddle_tpu.distributed.store import TCPStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_tcp_store_set_get_add_wait():
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    client = TCPStore("127.0.0.1", master.port)
+    client.set("k1", b"v1")
+    assert master.get("k1") == b"v1"
+    assert client.add("cnt", 2) == 2
+    assert client.add("cnt", 3) == 5
+    assert client.wait("k1") == b"v1"
+    with pytest.raises(TimeoutError):
+        client.wait("missing", timeout=0.3)
+    assert client.delete_key("k1") is True
+    assert client.get("k1") is None
+    assert set(master.keys()) == {"cnt"}
+    client.close()
+    master.close()
+
+
+def test_launch_single_node(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os\n"
+        "print('rank', os.environ['PADDLE_TRAINER_ID'], 'of', os.environ['PADDLE_TRAINERS_NUM'])\n"
+    )
+    log_dir = tmp_path / "logs"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(log_dir), str(script)],
+        cwd=REPO, env={**os.environ, "PYTHONPATH": REPO}, capture_output=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr.decode()
+    logs = sorted(os.listdir(log_dir))
+    assert logs == ["workerlog.0", "workerlog.1"]
+    body = (log_dir / "workerlog.0").read_text() + (log_dir / "workerlog.1").read_text()
+    assert "rank 0 of 2" in body and "rank 1 of 2" in body
+
+
+def test_launch_propagates_failure(tmp_path):
+    script = tmp_path / "boom.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--log_dir", str(tmp_path / "logs"), str(script)],
+        cwd=REPO, env={**os.environ, "PYTHONPATH": REPO}, capture_output=True, timeout=120,
+    )
+    assert r.returncode == 3
+
+
+def test_elastic_manager_membership_and_restart_signal():
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    m1 = ElasticManager(store=master, job_id="j1", host="hostA",
+                        heartbeat_interval=0.1, lease_ttl=0.6)
+    m1.register()
+    time.sleep(0.3)
+    assert m1.hosts == ["hostA"]
+    # second node joins
+    store2 = TCPStore("127.0.0.1", master.port)
+    m2 = ElasticManager(store=store2, job_id="j1", host="hostB",
+                        heartbeat_interval=0.1, lease_ttl=0.6)
+    m2.register()
+    status = m1.wait(timeout=3.0)
+    assert status == ElasticStatus.RESTART
+    assert m1.hosts == ["hostA", "hostB"]
+    # node B dies (heartbeat stops + key removed)
+    m2.exit()
+    status = m1.wait(timeout=3.0)
+    assert status == ElasticStatus.RESTART
+    assert m1.hosts == ["hostA"]
+    assert os.environ["PADDLE_TRAINERS_NUM"] == "1"
+    m1.exit()
+    master.close()
+
+
+def test_comm_watchdog_tracks_and_dumps():
+    mgr = dist.CommTaskManager()
+    with dist.comm_task("all_reduce_test", group=None):
+        assert mgr.pending() >= 1
+        buf = io.StringIO()
+        mgr.dump(file=buf)
+        assert "all_reduce_test" in buf.getvalue()
+    assert mgr.pending() == 0
+
+
+def test_eager_collective_is_watched():
+    import paddle_tpu as paddle
+
+    mgr = dist.CommTaskManager()
+    before = mgr.pending()
+    out = dist.all_reduce(paddle.to_tensor(np.ones((4,), np.float32)))
+    assert mgr.pending() == before  # task opened and closed
