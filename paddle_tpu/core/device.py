@@ -10,6 +10,8 @@ controls where eager ops place their outputs, and memory stats
 
 from __future__ import annotations
 
+import threading
+
 import jax
 import numpy as np
 
@@ -125,3 +127,43 @@ def empty_cache() -> None:
 def synchronize(device=None) -> None:
     """Block until all pending work on the device is complete."""
     (jax.device_put(np.zeros((), np.int32), device or current_device())).block_until_ready()
+
+
+# ---- host-side stat registry (native C++ when built: paddle_tpu/native/src/
+# stats.cc — the analog of the reference's STAT_ADD/STAT_GET counter macros in
+# paddle/phi/core/memory/stats.h, applied to host quantities: IPC queue depth,
+# checkpoint bytes in flight, pinned batches) ----
+
+_host_stats: dict = {}
+_host_stats_lock = threading.Lock()
+
+
+def _stat_lib():
+    from .. import native
+
+    return native.load()
+
+
+def host_stat_update(name: str, delta: int) -> int:
+    lib = _stat_lib()
+    if lib is not None:
+        return int(lib.pt_stat_update(name.encode(), int(delta)))
+    with _host_stats_lock:
+        cur, peak = _host_stats.get(name, (0, 0))
+        cur += int(delta)
+        _host_stats[name] = (cur, max(peak, cur))
+        return cur
+
+
+def host_stat_current(name: str) -> int:
+    lib = _stat_lib()
+    if lib is not None:
+        return int(lib.pt_stat_current(name.encode()))
+    return _host_stats.get(name, (0, 0))[0]
+
+
+def host_stat_peak(name: str) -> int:
+    lib = _stat_lib()
+    if lib is not None:
+        return int(lib.pt_stat_peak(name.encode()))
+    return _host_stats.get(name, (0, 0))[1]

@@ -7,31 +7,43 @@ wait keys to bootstrap process groups before any collective backend exists.
 TPU mapping: multi-host JAX bootstraps through the PJRT coordination service
 (jax.distributed), but the framework still needs a tiny host-side KV store for
 the launch CLI, elastic membership, and checkpoint coordination — exactly the
-role the reference's TCPStore plays next to NCCL.  Wire protocol is
-length-prefixed pickle: (cmd, key, value) → (status, value).
+role the reference's TCPStore plays next to NCCL.
 
-A C++ implementation of the same wire protocol (paddle_tpu/native) is used
-automatically when the native extension is built; this file is the pure-Python
-server/client and the fallback.
+Two interoperable implementations of one wire protocol:
+  * native C++ server/client (paddle_tpu/native/src/tcp_store.cc) — default;
+  * this file's pure-Python server/client — fallback when the native library
+    cannot be built (PADDLE_TPU_NATIVE=0 or no toolchain).
+
+Wire protocol (little-endian; responses reuse the request frame layout with
+an empty key):
+  request : u32 frame_len | u8 cmd | u32 key_len | key | u32 val_len | val
+  response: u32 frame_len | u8 status(0 ok,1 timeout,2 error) |
+            u32 key_len=0 | u32 val_len | val
+  cmd: 0 set, 1 get(blocking, val=ascii timeout-ms), 2 add(val=ascii delta),
+       3 delete, 4 keys(key=prefix, '\n'-joined reply), 5 wait, 6 get_nowait
 """
 
 from __future__ import annotations
 
-import os
-import pickle
+import ctypes
 import socket
 import struct
 import threading
 import time
 
+from .. import native as _native
+
 __all__ = ["TCPStore", "MasterDaemon"]
 
-_HDR = struct.Struct("!I")
+_U32 = struct.Struct("<I")
+
+CMD_SET, CMD_GET, CMD_ADD, CMD_DELETE, CMD_KEYS, CMD_WAIT, CMD_GET_NOWAIT = range(7)
+ST_OK, ST_TIMEOUT, ST_ERROR = range(3)
 
 
-def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=4)
-    sock.sendall(_HDR.pack(len(payload)) + payload)
+def _send_frame(sock, tag: int, key: bytes, val: bytes) -> None:
+    frame = bytes([tag]) + _U32.pack(len(key)) + key + _U32.pack(len(val)) + val
+    sock.sendall(_U32.pack(len(frame)) + frame)
 
 
 def _recv_exact(sock, n):
@@ -44,16 +56,22 @@ def _recv_exact(sock, n):
     return buf
 
 
-def _recv_msg(sock):
-    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return pickle.loads(_recv_exact(sock, n))
+def _recv_frame(sock):
+    (n,) = _U32.unpack(_recv_exact(sock, _U32.size))
+    frame = _recv_exact(sock, n)
+    tag = frame[0]
+    klen = _U32.unpack_from(frame, 1)[0]
+    key = frame[5:5 + klen]
+    vlen = _U32.unpack_from(frame, 5 + klen)[0]
+    val = frame[9 + klen:9 + klen + vlen]
+    return tag, key, val
 
 
 class MasterDaemon:
-    """The store server (reference MasterDaemon, tcp_store.cc)."""
+    """Pure-Python store server (reference MasterDaemon, tcp_store.cc)."""
 
     def __init__(self, port: int, world_size: int = 1, host: str = ""):
-        self._data: dict[str, bytes] = {}
+        self._data: dict[bytes, bytes] = {}
         self._lock = threading.Condition()
         self._world_size = world_size
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -71,45 +89,46 @@ class MasterDaemon:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
 
     def _handle(self, conn):
         try:
             while True:
-                cmd, key, value = _recv_msg(conn)
+                cmd, key, value = _recv_frame(conn)
+                status, out = ST_OK, b""
                 with self._lock:
-                    if cmd == "set":
+                    if cmd == CMD_SET:
                         self._data[key] = value
                         self._lock.notify_all()
-                        _send_msg(conn, ("ok", None))
-                    elif cmd == "get":
-                        _send_msg(conn, ("ok", self._data.get(key)))
-                    elif cmd == "add":
-                        cur = int(self._data.get(key, b"0").decode() or 0)
-                        cur += int(value)
+                    elif cmd == CMD_GET_NOWAIT:
+                        out = self._data.get(key, b"")
+                    elif cmd == CMD_ADD:
+                        cur = int(self._data.get(key, b"0") or b"0")
+                        cur += int(value or b"1")
                         self._data[key] = str(cur).encode()
+                        out = self._data[key]
                         self._lock.notify_all()
-                        _send_msg(conn, ("ok", cur))
-                    elif cmd == "delete":
-                        existed = self._data.pop(key, None) is not None
+                    elif cmd == CMD_DELETE:
+                        out = b"1" if self._data.pop(key, None) is not None else b"0"
                         self._lock.notify_all()
-                        _send_msg(conn, ("ok", existed))
-                    elif cmd == "keys":
-                        prefix = key or ""
-                        _send_msg(conn, ("ok", [k for k in self._data if k.startswith(prefix)]))
-                    elif cmd == "wait":
-                        deadline = time.monotonic() + (value or 300.0)
+                    elif cmd == CMD_KEYS:
+                        out = b"\n".join(k for k in self._data if k.startswith(key))
+                    elif cmd in (CMD_GET, CMD_WAIT):
+                        timeout_ms = int(value or b"300000")
+                        deadline = time.monotonic() + timeout_ms / 1000.0
                         while key not in self._data:
                             remaining = deadline - time.monotonic()
                             if remaining <= 0:
                                 break
                             self._lock.wait(min(remaining, 1.0))
                         if key in self._data:
-                            _send_msg(conn, ("ok", self._data[key]))
+                            out = self._data[key]
                         else:
-                            _send_msg(conn, ("timeout", None))
+                            status = ST_TIMEOUT
                     else:
-                        _send_msg(conn, ("error", f"unknown cmd {cmd!r}"))
+                        status, out = ST_ERROR, b"unknown cmd"
+                _send_frame(conn, status, b"", out)
         except (ConnectionError, EOFError, OSError):
             pass
         finally:
@@ -123,11 +142,27 @@ class MasterDaemon:
             pass
 
 
+class _NativeServer:
+    def __init__(self, port: int):
+        self._lib = _native.load()
+        self._h = self._lib.pt_store_server_start(port)
+        if not self._h:
+            raise OSError(f"native store server failed to bind port {port}")
+        self.port = self._lib.pt_store_server_port(self._h)
+
+    def stop(self):
+        if self._h:
+            self._lib.pt_store_server_stop(self._h)
+            self._h = None
+
+
 class TCPStore:
     """Client (+ embedded server when ``is_master``).
 
     API mirrors the reference's pybind surface: set/get/add/wait/delete_key/
-    num_keys, values are bytes.
+    num_keys; values are bytes.  Uses the native C++ implementation when
+    available, the Python one otherwise — both ends interoperate (same wire
+    protocol).
     """
 
     def __init__(self, host: str, port: int, is_master: bool = False,
@@ -135,61 +170,144 @@ class TCPStore:
         self.host = host
         self.timeout = timeout
         self._daemon = None
+        self._lib = _native.load()
         if is_master:
-            self._daemon = MasterDaemon(port, world_size)
+            if self._lib is not None:
+                try:
+                    self._daemon = _NativeServer(port)
+                except OSError:
+                    self._daemon = MasterDaemon(port, world_size)
+            else:
+                self._daemon = MasterDaemon(port, world_size)
             port = self._daemon.port
         self.port = port
+        self._sock = None
+        self._client = None
+        if self._lib is not None:
+            self._client = self._lib.pt_store_client_connect(
+                (host or "127.0.0.1").encode(), port, int(timeout * 1000))
+            if not self._client:
+                raise TimeoutError(f"cannot reach store at {host}:{port}")
+            self._lock = threading.Lock()
+            return
         deadline = time.monotonic() + timeout
-        last_err = None
         while True:
             try:
                 self._sock = socket.create_connection((host, port), timeout=timeout)
                 break
             except OSError as e:
-                last_err = e
                 if time.monotonic() > deadline:
                     raise TimeoutError(f"cannot reach store at {host}:{port}: {e}")
                 time.sleep(0.2)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
 
-    def _call(self, cmd, key, value=None):
+    # -- python-path round trip ------------------------------------------
+    def _call(self, cmd, key: bytes, value: bytes = b""):
         with self._lock:
-            _send_msg(self._sock, (cmd, key, value))
-            status, out = _recv_msg(self._sock)
-        if status == "timeout":
+            _send_frame(self._sock, cmd, key, value)
+            status, _, out = _recv_frame(self._sock)
+        if status == ST_TIMEOUT:
             raise TimeoutError(f"store wait({key!r}) timed out")
-        if status == "error":
-            raise RuntimeError(out)
+        if status == ST_ERROR:
+            raise RuntimeError(out.decode(errors="replace"))
         return out
 
+    @staticmethod
+    def _as_bytes(v) -> bytes:
+        if isinstance(v, str):
+            return v.encode()
+        if isinstance(v, int):
+            return str(v).encode()
+        return bytes(v)
+
     def set(self, key: str, value) -> None:
-        if isinstance(value, str):
-            value = value.encode()
-        self._call("set", key, value)
+        value = self._as_bytes(value)
+        if self._client:
+            with self._lock:
+                rc = self._lib.pt_store_set(self._client, key.encode(), value,
+                                            len(value))
+            if rc != ST_OK:
+                raise RuntimeError(f"store set({key!r}) failed")
+            return
+        self._call(CMD_SET, key.encode(), value)
 
     def get(self, key: str):
-        return self._call("get", key)
+        """Non-blocking read: returns the value or None (blocking read = wait)."""
+        return self.get_nowait(key)
+
+    def get_nowait(self, key: str):
+        if self._client:
+            ptr, length = ctypes.c_void_p(), ctypes.c_int64()
+            with self._lock:
+                rc = self._lib.pt_store_get_nowait(self._client, key.encode(),
+                                                   ctypes.byref(ptr),
+                                                   ctypes.byref(length))
+            if rc != ST_OK:
+                raise RuntimeError(f"store get_nowait({key!r}) failed")
+            out = _native.take_buf(self._lib, ptr.value, length.value)
+        else:
+            out = self._call(CMD_GET_NOWAIT, key.encode())
+        return out if out else None
 
     def add(self, key: str, amount: int = 1) -> int:
-        return self._call("add", key, amount)
+        if self._client:
+            with self._lock:
+                v = self._lib.pt_store_add(self._client, key.encode(), amount)
+            if v == -(2**63):
+                raise RuntimeError(f"store add({key!r}) failed")
+            return int(v)
+        return int(self._call(CMD_ADD, key.encode(), str(amount).encode()))
 
     def wait(self, key: str, timeout: float | None = None):
-        return self._call("wait", key, timeout or self.timeout)
+        t = timeout or self.timeout
+        if self._client:
+            with self._lock:
+                rc = self._lib.pt_store_wait(self._client, key.encode(),
+                                             int(t * 1000))
+            if rc == ST_TIMEOUT:
+                raise TimeoutError(f"store wait({key!r}) timed out")
+            if rc != ST_OK:
+                raise RuntimeError(f"store wait({key!r}) failed")
+            return self.get_nowait(key)
+        return self._call(CMD_WAIT, key.encode(), str(int(t * 1000)).encode())
 
     def delete_key(self, key: str) -> bool:
-        return self._call("delete", key)
+        if self._client:
+            with self._lock:
+                return bool(self._lib.pt_store_delete(self._client, key.encode()))
+        return self._call(CMD_DELETE, key.encode()) == b"1"
 
     def keys(self, prefix: str = ""):
-        return self._call("keys", prefix)
+        if self._client:
+            ptr, length = ctypes.c_void_p(), ctypes.c_int64()
+            with self._lock:
+                rc = self._lib.pt_store_keys(self._client, prefix.encode(),
+                                             ctypes.byref(ptr), ctypes.byref(length))
+            if rc != ST_OK:
+                raise RuntimeError("store keys() failed")
+            out = _native.take_buf(self._lib, ptr.value, length.value)
+        else:
+            out = self._call(CMD_KEYS, prefix.encode())
+        return sorted(k.decode() for k in out.split(b"\n") if k) if out else []
 
     def num_keys(self) -> int:
         return len(self.keys())
 
+    @property
+    def is_native(self) -> bool:
+        return self._client is not None
+
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        if self._client:
+            self._lib.pt_store_client_close(self._client)
+            self._client = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
         if self._daemon is not None:
             self._daemon.stop()
+            self._daemon = None

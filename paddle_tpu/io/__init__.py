@@ -3,23 +3,34 @@ io/reader.py:262, worker pool io/dataloader/worker.py, samplers, and
 DistributedBatchSampler).
 
 TPU-native notes: batches are collated into numpy on the host and transferred to
-device once per step (single h2d per batch); worker parallelism uses a thread
-pool feeding a bounded prefetch queue — on TPU the step time is device-bound and
-the GIL-free numpy/PIL work in threads is sufficient, without the reference's
-shared-memory + signal machinery (io/dataloader/worker.py)."""
+device once per step (single h2d per batch).  Two worker modes:
+
+* ``worker_mode="thread"`` (default): thread pool feeding a bounded prefetch
+  queue — on TPU the step time is device-bound and GIL-free numpy/PIL work in
+  threads is usually sufficient.
+* ``worker_mode="process"``: true multiprocess workers like the reference
+  (io/dataloader/worker.py); each worker computes its slice of batches and
+  ships pickled samples to the parent over a native shared-memory ring
+  (paddle_tpu/native/src/shm_queue.cc — the analog of the reference's
+  ``use_shared_memory=True`` mmap path), falling back to multiprocessing
+  pipes when the native library is unavailable."""
 
 from __future__ import annotations
 
 import bisect
 import itertools
+import os
+import pickle
 import queue
 import threading
+import traceback
 from typing import Iterable, Sequence
 
 import numpy as np
 
 from ..core import rng as _rng
 from ..core.tensor import Tensor, to_tensor
+from .. import native as _native
 
 __all__ = [
     "Dataset",
@@ -314,11 +325,25 @@ class DataLoader:
         timeout=0,
         worker_init_fn=None,
         persistent_workers=False,
+        worker_mode="thread",
+        mp_start_method=None,
     ):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout or 300.0
+        self.worker_init_fn = worker_init_fn
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(f"worker_mode must be 'thread' or 'process', got {worker_mode!r}")
+        self.worker_mode = worker_mode
+        # "fork" matches the reference's Linux workers and avoids re-importing
+        # jax per worker; it is unsafe if dataset code touches jax/XLA state in
+        # the child (fork of a threaded process) — pass "spawn" for such
+        # datasets (dataset must then be picklable).
+        self.mp_start_method = mp_start_method or os.environ.get(
+            "PADDLE_TPU_MP_START_METHOD", "fork")
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -353,6 +378,9 @@ class DataLoader:
     def __iter__(self):
         if self.num_workers == 0:
             yield from self._iter_batches()
+            return
+        if self.worker_mode == "process" and not self._iterable:
+            yield from self._iter_process_workers()
             return
         # threaded prefetch pipeline
         q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
@@ -397,3 +425,118 @@ class DataLoader:
             if isinstance(item, BaseException):
                 raise item
             yield item
+
+    # -- multiprocess workers (reference io/dataloader/worker.py) ---------
+    def _iter_process_workers(self):
+        """Round-robin batch assignment: worker w computes batches w, w+N, …
+        and the parent pops worker queues in order, so global batch order is
+        deterministic (the reference reorders via _order_ bookkeeping;
+        per-worker FIFO + round-robin pop achieves the same)."""
+        import multiprocessing as mp
+
+        batches = list(self.batch_sampler)
+        nw = min(self.num_workers, max(1, len(batches)))
+        ctx = mp.get_context(self.mp_start_method)
+        use_shm = self.use_shared_memory and _native.available()
+        capacity = 32 << 20
+
+        channels, procs = [], []
+        try:
+            for w in range(nw):
+                my_batches = batches[w::nw]
+                if use_shm:
+                    name = f"/pt_dl_{os.getpid()}_{id(self)}_{w}"
+                    q = _native.ShmQueue(name, capacity=capacity, create=True)
+                    channels.append(("shm", q))
+                    p = ctx.Process(
+                        target=_shm_worker_loop,
+                        args=(self.dataset, my_batches, name, w, nw,
+                              self.worker_init_fn, self.timeout),
+                        daemon=True,
+                    )
+                else:
+                    mpq = ctx.Queue(maxsize=self.prefetch_factor)
+                    channels.append(("mpq", mpq))
+                    p = ctx.Process(
+                        target=_mpq_worker_loop,
+                        args=(self.dataset, my_batches, mpq, w, nw,
+                              self.worker_init_fn),
+                        daemon=True,
+                    )
+                p.start()
+                procs.append(p)
+
+            for i in range(len(batches)):
+                w = i % nw
+                kind, ch = channels[w]
+                try:
+                    if kind == "shm":
+                        payload = ch.pop(timeout=self.timeout)
+                        msg = pickle.loads(payload) if payload is not None else ("end",)
+                    else:
+                        msg = ch.get(timeout=self.timeout)
+                except (TimeoutError, queue.Empty):
+                    alive = procs[w].is_alive()
+                    raise RuntimeError(
+                        f"DataLoader worker {w} timed out after {self.timeout}s "
+                        f"(worker process {'alive' if alive else 'DEAD'}; if the "
+                        f"dataset touches jax/XLA state, use "
+                        f"mp_start_method='spawn')")
+                if msg[0] == "exc":
+                    raise RuntimeError(
+                        f"DataLoader worker {w} failed:\n{msg[1]}")
+                if msg[0] == "end":
+                    raise RuntimeError(
+                        f"DataLoader worker {w} ended early (batch {i})")
+                yield self.collate_fn(msg[1])
+        finally:
+            for kind, ch in channels:
+                if kind == "shm":
+                    ch.close()
+                    ch.destroy()
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+
+
+def _set_worker_env(dataset, worker_id, num_workers, worker_init_fn):
+    _worker_info.info = _WorkerInfo(worker_id, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+
+
+def _shm_worker_loop(dataset, batches, shm_name, worker_id, num_workers,
+                     worker_init_fn, timeout):
+    try:
+        q = _native.ShmQueue(shm_name, create=False)
+    except OSError:
+        return
+    try:
+        _set_worker_env(dataset, worker_id, num_workers, worker_init_fn)
+        for idxs in batches:
+            samples = [dataset[i] for i in idxs]
+            q.push(pickle.dumps(("batch", samples), protocol=4), timeout=timeout)
+        q.push(pickle.dumps(("end",), protocol=4), timeout=timeout)
+    except BaseException:
+        try:
+            q.push(pickle.dumps(("exc", traceback.format_exc()), protocol=4),
+                   timeout=10)
+        except Exception:
+            pass
+    finally:
+        q.destroy()
+
+
+def _mpq_worker_loop(dataset, batches, mpq, worker_id, num_workers,
+                     worker_init_fn):
+    try:
+        _set_worker_env(dataset, worker_id, num_workers, worker_init_fn)
+        for idxs in batches:
+            mpq.put(("batch", [dataset[i] for i in idxs]))
+        mpq.put(("end",))
+    except BaseException:
+        try:
+            mpq.put(("exc", traceback.format_exc()))
+        except Exception:
+            pass

@@ -1,0 +1,200 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference framework's host runtime is C++ (TCPStore rendezvous —
+paddle/phi/core/distributed/store/tcp_store.h:121; shared-memory dataloader
+IPC — python/paddle/io/dataloader/worker.py + mmap_allocator; host tracer —
+paddle/fluid/platform/profiler/host_tracer.cc; memory stats —
+paddle/phi/core/memory/stats.h).  This package is the TPU-native equivalent:
+the device path belongs to XLA/PJRT, the host-side runtime is this C++
+library.
+
+The library is compiled on first use with g++ (source ships in src/); if the
+toolchain or the build fails, ``load()`` returns None and pure-Python
+fallbacks (paddle_tpu.distributed.store, threading DataLoader, Python tracer)
+take over.  Set PADDLE_TPU_NATIVE=0 to force the fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libpaddle_tpu_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_attempted = False
+
+
+def _declare(lib):
+    c = ctypes
+    lib.pt_store_server_start.restype = c.c_void_p
+    lib.pt_store_server_start.argtypes = [c.c_int]
+    lib.pt_store_server_port.restype = c.c_int
+    lib.pt_store_server_port.argtypes = [c.c_void_p]
+    lib.pt_store_server_stop.argtypes = [c.c_void_p]
+    lib.pt_store_client_connect.restype = c.c_void_p
+    lib.pt_store_client_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.pt_store_set.restype = c.c_int
+    lib.pt_store_set.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]
+    lib.pt_store_get.restype = c.c_int
+    lib.pt_store_get.argtypes = [c.c_void_p, c.c_char_p, c.c_int64,
+                                 c.POINTER(c.c_void_p), c.POINTER(c.c_int64)]
+    lib.pt_store_get_nowait.restype = c.c_int
+    lib.pt_store_get_nowait.argtypes = [c.c_void_p, c.c_char_p,
+                                        c.POINTER(c.c_void_p), c.POINTER(c.c_int64)]
+    lib.pt_store_add.restype = c.c_int64
+    lib.pt_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.pt_store_wait.restype = c.c_int
+    lib.pt_store_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.pt_store_delete.restype = c.c_int
+    lib.pt_store_delete.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_store_keys.restype = c.c_int
+    lib.pt_store_keys.argtypes = [c.c_void_p, c.c_char_p,
+                                  c.POINTER(c.c_void_p), c.POINTER(c.c_int64)]
+    lib.pt_store_client_close.argtypes = [c.c_void_p]
+    lib.pt_buf_free.argtypes = [c.c_void_p]
+
+    lib.pt_shmq_create.restype = c.c_void_p
+    lib.pt_shmq_create.argtypes = [c.c_char_p, c.c_uint64]
+    lib.pt_shmq_open.restype = c.c_void_p
+    lib.pt_shmq_open.argtypes = [c.c_char_p]
+    lib.pt_shmq_push.restype = c.c_int
+    lib.pt_shmq_push.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64, c.c_int64]
+    lib.pt_shmq_pop.restype = c.c_int
+    lib.pt_shmq_pop.argtypes = [c.c_void_p, c.POINTER(c.c_void_p),
+                                c.POINTER(c.c_uint64), c.c_int64]
+    lib.pt_shmq_size_bytes.restype = c.c_uint64
+    lib.pt_shmq_size_bytes.argtypes = [c.c_void_p]
+    lib.pt_shmq_close.argtypes = [c.c_void_p]
+    lib.pt_shmq_destroy.argtypes = [c.c_void_p]
+
+    lib.pt_trace_intern.restype = c.c_uint32
+    lib.pt_trace_intern.argtypes = [c.c_char_p]
+    lib.pt_trace_begin.argtypes = [c.c_uint32]
+    lib.pt_trace_span.argtypes = [c.c_uint32, c.c_int64, c.c_int64]
+    lib.pt_trace_now_us.restype = c.c_int64
+    lib.pt_trace_span_count.restype = c.c_int64
+    lib.pt_trace_dump.restype = c.c_int64
+    lib.pt_trace_dump.argtypes = [c.c_char_p, c.c_int]
+    lib.pt_trace_enabled.restype = c.c_int
+
+    lib.pt_stat_update.restype = c.c_int64
+    lib.pt_stat_update.argtypes = [c.c_char_p, c.c_int64]
+    lib.pt_stat_set.argtypes = [c.c_char_p, c.c_int64]
+    lib.pt_stat_current.restype = c.c_int64
+    lib.pt_stat_current.argtypes = [c.c_char_p]
+    lib.pt_stat_peak.restype = c.c_int64
+    lib.pt_stat_peak.argtypes = [c.c_char_p]
+    lib.pt_stat_reset_peak.argtypes = [c.c_char_p]
+    lib.pt_stat_report.restype = c.c_int64
+    lib.pt_stat_report.argtypes = [c.POINTER(c.c_void_p)]
+    return lib
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(
+            ["make", "-C", _DIR, "-s"],
+            capture_output=True, text=True, timeout=120,
+        )
+        return r.returncode == 0 and os.path.exists(_SO)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def load():
+    """Returns the ctypes library, building it if needed; None on failure."""
+    global _lib, _load_attempted
+    if _lib is not None:
+        return _lib
+    if _load_attempted:
+        return None
+    with _lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        if os.environ.get("PADDLE_TPU_NATIVE", "1") == "0":
+            return None
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            _lib = _declare(ctypes.CDLL(_SO))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def take_buf(lib, ptr, length) -> bytes:
+    """Copies a malloc'd native buffer into bytes and frees it."""
+    if not ptr or length <= 0:
+        if ptr:
+            lib.pt_buf_free(ptr)
+        return b""
+    out = ctypes.string_at(ptr, length)
+    lib.pt_buf_free(ptr)
+    return out
+
+
+class ShmQueue:
+    """SPSC shared-memory ring buffer (producer or consumer endpoint).
+
+    Reference analog: the shared-memory batch transport in the reference
+    DataLoader (io/dataloader/worker.py, use_shared_memory=True).
+    """
+
+    def __init__(self, name: str, capacity: int = 64 << 20, create: bool = True):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self.name = name
+        if create:
+            self._h = self._lib.pt_shmq_create(name.encode(), capacity)
+        else:
+            self._h = self._lib.pt_shmq_open(name.encode())
+        if not self._h:
+            raise OSError(f"shm queue {name!r} {'create' if create else 'open'} failed")
+        self._owner = create
+
+    def push(self, data: bytes, timeout: float = 300.0) -> None:
+        rc = self._lib.pt_shmq_push(self._h, data, len(data), int(timeout * 1000))
+        if rc == 1:
+            raise TimeoutError(f"shm push timed out ({len(data)} bytes)")
+        if rc == 3:
+            raise ValueError(f"message of {len(data)} bytes exceeds ring capacity")
+        if rc != 0:
+            raise BrokenPipeError("shm queue closed")
+
+    def pop(self, timeout: float = 300.0) -> bytes | None:
+        """Returns the next message, or None when closed and drained."""
+        ptr = ctypes.c_void_p()
+        length = ctypes.c_uint64()
+        rc = self._lib.pt_shmq_pop(self._h, ctypes.byref(ptr),
+                                   ctypes.byref(length), int(timeout * 1000))
+        if rc == 1:
+            raise TimeoutError("shm pop timed out")
+        if rc == 2:
+            return None
+        return take_buf(self._lib, ptr.value, length.value)
+
+    def qsize_bytes(self) -> int:
+        return int(self._lib.pt_shmq_size_bytes(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.pt_shmq_close(self._h)
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.pt_shmq_destroy(self._h)
+            self._h = None
+
+
+__all__ = ["load", "available", "take_buf", "ShmQueue"]

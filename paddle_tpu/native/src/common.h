@@ -1,0 +1,25 @@
+// Common helpers for the paddle_tpu native runtime library.
+//
+// Reference mapping: the reference framework's host-side runtime is C++
+// (paddle/phi/core/distributed/store/tcp_store.h, platform/profiler/,
+// phi/core/memory/stats.h, fluid/framework/data_feed).  This library is the
+// TPU-native equivalent: the device path is XLA/PJRT, but rendezvous, IPC,
+// tracing and stats stay native for the same reasons the reference keeps
+// them native (latency, no GIL, usable before Python is up).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#define PT_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace pt {
+
+inline int64_t now_us() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+}  // namespace pt

@@ -1,0 +1,209 @@
+// Shared-memory SPSC ring buffer for DataLoader worker→consumer transfer.
+//
+// Reference: the reference DataLoader moves batches from multiprocess workers
+// to the main process through shared memory with signal-based cleanup
+// (python/paddle/io/dataloader/worker.py, `use_shared_memory=True`;
+// `paddle/fluid/memory/allocation/mmap_allocator.*` provides the shm blocks).
+// Here the same role is a fixed-capacity ring in a POSIX shm segment: one
+// producer (worker process) pushes length-prefixed pickled batches, one
+// consumer (main process) pops them — no per-batch file descriptors, no
+// serialization through a Python multiprocessing.Queue pipe.
+//
+// Layout: [Header | data bytes]; head/tail are free-running byte offsets
+// (mod capacity). A record is u32 len + payload; len==kWrapMarker means
+// "skip to start of ring".
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <thread>
+
+#include "common.h"
+
+namespace {
+
+constexpr uint32_t kWrapMarker = 0xFFFFFFFFu;
+constexpr uint64_t kMagic = 0x70745F73686D7131ULL;  // "pt_shmq1"
+
+struct alignas(64) Header {
+  uint64_t magic;
+  uint64_t capacity;  // data bytes
+  alignas(64) std::atomic<uint64_t> head;  // producer cursor (bytes written)
+  alignas(64) std::atomic<uint64_t> tail;  // consumer cursor (bytes read)
+  alignas(64) std::atomic<uint32_t> closed;
+};
+
+struct Queue {
+  Header* hdr = nullptr;
+  char* data = nullptr;
+  size_t map_size = 0;
+  std::string name;
+  bool owner = false;
+};
+
+bool sleep_until_deadline(const std::chrono::steady_clock::time_point& dl) {
+  if (std::chrono::steady_clock::now() >= dl) return false;
+  std::this_thread::sleep_for(std::chrono::microseconds(50));
+  return true;
+}
+
+}  // namespace
+
+PT_EXPORT void* pt_shmq_create(const char* name, uint64_t capacity) {
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t total = sizeof(Header) + capacity;
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* q = new Queue();
+  q->hdr = new (mem) Header();
+  q->hdr->magic = kMagic;
+  q->hdr->capacity = capacity;
+  q->hdr->head.store(0);
+  q->hdr->tail.store(0);
+  q->hdr->closed.store(0);
+  q->data = static_cast<char*>(mem) + sizeof(Header);
+  q->map_size = total;
+  q->name = name;
+  q->owner = true;
+  return q;
+}
+
+PT_EXPORT void* pt_shmq_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < static_cast<off_t>(sizeof(Header))) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* hdr = static_cast<Header*>(mem);
+  if (hdr->magic != kMagic) {
+    munmap(mem, static_cast<size_t>(st.st_size));
+    return nullptr;
+  }
+  auto* q = new Queue();
+  q->hdr = hdr;
+  q->data = static_cast<char*>(mem) + sizeof(Header);
+  q->map_size = static_cast<size_t>(st.st_size);
+  q->name = name;
+  q->owner = false;
+  return q;
+}
+
+// Returns 0 on success, 1 on timeout, 2 on closed/error, 3 message too large.
+PT_EXPORT int pt_shmq_push(void* handle, const char* buf, uint64_t len,
+                           int64_t timeout_ms) {
+  auto* q = static_cast<Queue*>(handle);
+  Header* h = q->hdr;
+  const uint64_t cap = h->capacity;
+  uint64_t need = 4 + len;
+  if (need + 4 > cap) return 3;  // +4: room for a wrap marker
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    if (h->closed.load(std::memory_order_acquire)) return 2;
+    uint64_t head = h->head.load(std::memory_order_relaxed);
+    uint64_t tail = h->tail.load(std::memory_order_acquire);
+    uint64_t pos = head % cap;
+    uint64_t contig = cap - pos;
+    uint64_t effective = (contig >= need) ? need : contig + need;
+    if (cap - (head - tail) >= effective) {
+      if (contig < need) {
+        // not enough contiguous room: wrap marker (if it fits), skip to start
+        if (contig >= 4) {
+          uint32_t marker = kWrapMarker;
+          memcpy(q->data + pos, &marker, 4);
+        }
+        head += contig;
+        pos = 0;
+      }
+      uint32_t len32 = static_cast<uint32_t>(len);
+      memcpy(q->data + pos, &len32, 4);
+      memcpy(q->data + pos + 4, buf, len);
+      h->head.store(head + need, std::memory_order_release);
+      return 0;
+    }
+    if (!sleep_until_deadline(deadline)) return 1;
+  }
+}
+
+// Returns 0 on success (*out malloc'd, caller frees with pt_buf_free),
+// 1 on timeout, 2 on closed-and-drained.
+PT_EXPORT int pt_shmq_pop(void* handle, char** out, uint64_t* out_len,
+                          int64_t timeout_ms) {
+  auto* q = static_cast<Queue*>(handle);
+  Header* h = q->hdr;
+  const uint64_t cap = h->capacity;
+  *out = nullptr;
+  *out_len = 0;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    uint64_t tail = h->tail.load(std::memory_order_relaxed);
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    if (head == tail) {
+      if (h->closed.load(std::memory_order_acquire)) return 2;
+      if (!sleep_until_deadline(deadline)) return 1;
+      continue;
+    }
+    uint64_t pos = tail % cap;
+    uint64_t contig = cap - pos;
+    if (contig < 4) {  // implicit wrap: marker didn't fit
+      h->tail.store(tail + contig, std::memory_order_release);
+      continue;
+    }
+    uint32_t len32;
+    memcpy(&len32, q->data + pos, 4);
+    if (len32 == kWrapMarker) {
+      h->tail.store(tail + contig, std::memory_order_release);
+      continue;
+    }
+    *out = static_cast<char*>(malloc(len32));
+    memcpy(*out, q->data + pos + 4, len32);
+    *out_len = len32;
+    h->tail.store(tail + 4 + len32, std::memory_order_release);
+    return 0;
+  }
+}
+
+PT_EXPORT uint64_t pt_shmq_size_bytes(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  return q->hdr->head.load() - q->hdr->tail.load();
+}
+
+PT_EXPORT void pt_shmq_close(void* handle) {
+  if (handle)
+    static_cast<Queue*>(handle)->hdr->closed.store(1, std::memory_order_release);
+}
+
+PT_EXPORT void pt_shmq_destroy(void* handle) {
+  if (!handle) return;
+  auto* q = static_cast<Queue*>(handle);
+  bool unlink = q->owner;
+  std::string name = q->name;
+  munmap(q->hdr, q->map_size);
+  if (unlink) shm_unlink(name.c_str());
+  delete q;
+}

@@ -1,0 +1,83 @@
+// Runtime counter/stat registry with peak tracking.
+//
+// Reference: phi/core/memory/stats.h — per-device current/peak memory
+// counters (STAT_ADD/STAT_GET macros, `paddle.device.cuda.max_memory_allocated`
+// reads them).  On TPU the device allocator lives inside PJRT, so the
+// native registry tracks host-side quantities (pinned batches in flight,
+// checkpoint bytes, IPC queue depths) and mirrors device stats pushed down
+// from Python (jax memory_stats snapshots) so tooling has one place to read.
+
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "common.h"
+
+namespace {
+
+struct Stat {
+  int64_t current = 0;
+  int64_t peak = 0;
+};
+
+std::mutex g_mu;
+std::map<std::string, Stat> g_stats;
+
+}  // namespace
+
+PT_EXPORT int64_t pt_stat_update(const char* name, int64_t delta) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Stat& s = g_stats[name];
+  s.current += delta;
+  if (s.current > s.peak) s.peak = s.current;
+  return s.current;
+}
+
+PT_EXPORT void pt_stat_set(const char* name, int64_t value) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Stat& s = g_stats[name];
+  s.current = value;
+  if (value > s.peak) s.peak = value;
+}
+
+PT_EXPORT int64_t pt_stat_current(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_stats.find(name);
+  return it == g_stats.end() ? 0 : it->second.current;
+}
+
+PT_EXPORT int64_t pt_stat_peak(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_stats.find(name);
+  return it == g_stats.end() ? 0 : it->second.peak;
+}
+
+PT_EXPORT void pt_stat_reset_peak(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_stats.find(name);
+  if (it != g_stats.end()) it->second.peak = it->second.current;
+}
+
+PT_EXPORT void pt_stat_clear() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_stats.clear();
+}
+
+// Writes "name current peak\n" lines into out (malloc'd, caller frees via
+// pt_buf_free); returns byte length.
+PT_EXPORT int64_t pt_stat_report(char** out) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  std::string rep;
+  for (auto& kv : g_stats) {
+    rep += kv.first;
+    rep += ' ';
+    rep += std::to_string(kv.second.current);
+    rep += ' ';
+    rep += std::to_string(kv.second.peak);
+    rep += '\n';
+  }
+  *out = static_cast<char*>(malloc(rep.size()));
+  memcpy(*out, rep.data(), rep.size());
+  return static_cast<int64_t>(rep.size());
+}

@@ -1,0 +1,388 @@
+// Native TCP key-value store for rendezvous/bootstrap.
+//
+// Reference: TCPStore / MasterDaemon (paddle/phi/core/distributed/store/
+// tcp_store.h:121, socket.cpp) — a master process serves a KV map over TCP;
+// clients set/get/add/wait keys to bootstrap process groups before any
+// collective backend exists.  Same role here, next to the PJRT coordination
+// service instead of NCCL.
+//
+// Wire protocol (shared with the pure-Python fallback in
+// paddle_tpu/distributed/store.py; responses reuse the request frame layout
+// with an empty key):
+//   request : u32 frame_len | u8 cmd | u32 key_len | key | u32 val_len | val
+//   response: u32 frame_len | u8 status(0 ok, 1 timeout, 2 error) |
+//             u32 key_len=0 | u32 val_len | val
+//   cmd: 0 set, 1 get(blocking-with-timeout == wait+get), 2 add(val = ascii
+//   int delta -> returns ascii int), 3 delete, 4 keys(prefix -> '\n' joined),
+//   5 wait(val = ascii timeout-ms), 6 get_nowait
+// All integers little-endian (x86/ARM hosts).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+
+namespace {
+
+enum Cmd : uint8_t { kSet = 0, kGet = 1, kAdd = 2, kDelete = 3, kKeys = 4,
+                     kWait = 5, kGetNowait = 6 };
+enum Status : uint8_t { kOk = 0, kTimeout = 1, kError = 2 };
+
+bool send_all(int fd, const char* buf, size_t n) {
+  while (n > 0) {
+    ssize_t w = ::send(fd, buf, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    buf += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, char* buf, size_t n) {
+  while (n > 0) {
+    ssize_t r = ::recv(fd, buf, n, 0);
+    if (r <= 0) return false;
+    buf += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void put_u32(std::string* out, uint32_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 4);
+}
+
+bool send_frame(int fd, uint8_t tag, const std::string& key,
+                const std::string& val) {
+  std::string frame;
+  frame.reserve(9 + key.size() + val.size());
+  frame.push_back(static_cast<char>(tag));
+  put_u32(&frame, static_cast<uint32_t>(key.size()));
+  frame += key;
+  put_u32(&frame, static_cast<uint32_t>(val.size()));
+  frame += val;
+  uint32_t len = static_cast<uint32_t>(frame.size());
+  std::string hdr(reinterpret_cast<const char*>(&len), 4);
+  return send_all(fd, hdr.data(), 4) && send_all(fd, frame.data(), frame.size());
+}
+
+// Parses "tag key val" out of one frame. Returns false on malformed frame.
+bool parse_frame(const std::string& frame, uint8_t* tag, std::string* key,
+                 std::string* val) {
+  if (frame.size() < 9) return false;
+  size_t off = 0;
+  *tag = static_cast<uint8_t>(frame[off++]);
+  uint32_t klen;
+  memcpy(&klen, frame.data() + off, 4);
+  off += 4;
+  if (off + klen + 4 > frame.size()) return false;
+  key->assign(frame.data() + off, klen);
+  off += klen;
+  uint32_t vlen;
+  memcpy(&vlen, frame.data() + off, 4);
+  off += 4;
+  if (off + vlen > frame.size()) return false;
+  val->assign(frame.data() + off, vlen);
+  return true;
+}
+
+bool recv_frame(int fd, uint8_t* tag, std::string* key, std::string* val) {
+  uint32_t len;
+  if (!recv_all(fd, reinterpret_cast<char*>(&len), 4)) return false;
+  if (len > (64u << 20)) return false;  // 64MB sanity cap
+  std::string frame(len, '\0');
+  if (!recv_all(fd, frame.data(), len)) return false;
+  return parse_frame(frame, tag, key, val);
+}
+
+struct StoreServer {
+  std::map<std::string, std::string> data;
+  std::mutex mu;
+  std::condition_variable cv;
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::mutex fd_mu;
+  std::vector<int> client_fds;  // live connections, shut down on stop so
+                                // worker threads blocked in recv() exit
+
+  void handle(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    uint8_t cmd;
+    std::string key, val;
+    while (!stop.load() && recv_frame(fd, &cmd, &key, &val)) {
+      uint8_t status = kOk;
+      std::string out;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        switch (cmd) {
+          case kSet:
+            data[key] = val;
+            cv.notify_all();
+            break;
+          case kGetNowait: {
+            auto it = data.find(key);
+            if (it != data.end()) out = it->second;
+            break;
+          }
+          case kAdd: {
+            long long delta = val.empty() ? 1 : atoll(val.c_str());
+            long long cur = 0;
+            auto it = data.find(key);
+            if (it != data.end()) cur = atoll(it->second.c_str());
+            cur += delta;
+            data[key] = std::to_string(cur);
+            out = data[key];
+            cv.notify_all();
+            break;
+          }
+          case kDelete: {
+            out = data.erase(key) ? "1" : "0";
+            cv.notify_all();
+            break;
+          }
+          case kKeys: {
+            for (auto& kv : data) {
+              if (kv.first.rfind(key, 0) == 0) {
+                if (!out.empty()) out.push_back('\n');
+                out += kv.first;
+              }
+            }
+            break;
+          }
+          case kGet:
+          case kWait: {
+            long long timeout_ms = 300000;
+            if (cmd == kWait && !val.empty()) timeout_ms = atoll(val.c_str());
+            if (cmd == kGet && !val.empty()) timeout_ms = atoll(val.c_str());
+            auto deadline = std::chrono::steady_clock::now() +
+                            std::chrono::milliseconds(timeout_ms);
+            bool found = cv.wait_until(lk, deadline, [&] {
+              return stop.load() || data.count(key) > 0;
+            });
+            if (found && data.count(key)) {
+              out = data[key];
+            } else {
+              status = kTimeout;
+            }
+            break;
+          }
+          default:
+            status = kError;
+            out = "unknown cmd";
+        }
+      }
+      if (!send_frame(fd, status, "", out)) break;
+    }
+    {
+      std::lock_guard<std::mutex> lk(fd_mu);
+      for (auto it = client_fds.begin(); it != client_fds.end(); ++it) {
+        if (*it == fd) {
+          client_fds.erase(it);
+          break;
+        }
+      }
+    }
+    ::close(fd);
+  }
+
+  void serve() {
+    while (!stop.load()) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stop.load()) return;
+        continue;
+      }
+      {
+        std::lock_guard<std::mutex> lk(fd_mu);
+        client_fds.push_back(fd);
+      }
+      workers.emplace_back([this, fd] { handle(fd); });
+    }
+  }
+};
+
+struct StoreClient {
+  int fd = -1;
+  std::mutex mu;
+};
+
+}  // namespace
+
+PT_EXPORT void* pt_store_server_start(int port) {
+  auto* s = new StoreServer();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(s->listen_fd, 512) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] { s->serve(); });
+  return s;
+}
+
+PT_EXPORT int pt_store_server_port(void* handle) {
+  return handle ? static_cast<StoreServer*>(handle)->port : -1;
+}
+
+PT_EXPORT void pt_store_server_stop(void* handle) {
+  if (!handle) return;
+  auto* s = static_cast<StoreServer*>(handle);
+  s->stop.store(true);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->cv.notify_all();
+  }
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  {
+    std::lock_guard<std::mutex> lk(s->fd_mu);
+    for (int fd : s->client_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto& t : s->workers)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+PT_EXPORT void* pt_store_client_connect(const char* host, int port,
+                                        int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    std::string port_s = std::to_string(port);
+    if (getaddrinfo(host && host[0] ? host : "127.0.0.1", port_s.c_str(),
+                    &hints, &res) == 0 && res) {
+      int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+        freeaddrinfo(res);
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        auto* c = new StoreClient();
+        c->fd = fd;
+        return c;
+      }
+      if (fd >= 0) ::close(fd);
+      freeaddrinfo(res);
+    }
+    if (std::chrono::steady_clock::now() > deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  }
+}
+
+// Round-trips one request. Returns status; *out is malloc'd (caller frees via
+// pt_buf_free) when non-null.
+static int client_call(StoreClient* c, uint8_t cmd, const char* key,
+                       const char* val, int val_len, char** out,
+                       int64_t* out_len) {
+  if (out) *out = nullptr;
+  if (out_len) *out_len = 0;
+  std::lock_guard<std::mutex> lk(c->mu);
+  std::string v(val ? val : "", val ? static_cast<size_t>(val_len) : 0);
+  if (!send_frame(c->fd, cmd, key ? key : "", v)) return kError;
+  uint8_t status;
+  std::string rkey, rval;
+  if (!recv_frame(c->fd, &status, &rkey, &rval)) return kError;
+  if (out && !rval.empty()) {
+    *out = static_cast<char*>(malloc(rval.size()));
+    memcpy(*out, rval.data(), rval.size());
+    if (out_len) *out_len = static_cast<int64_t>(rval.size());
+  }
+  return status;
+}
+
+PT_EXPORT int pt_store_set(void* h, const char* key, const char* val,
+                           int val_len) {
+  return client_call(static_cast<StoreClient*>(h), kSet, key, val, val_len,
+                     nullptr, nullptr);
+}
+
+PT_EXPORT int pt_store_get(void* h, const char* key, int64_t timeout_ms,
+                           char** out, int64_t* out_len) {
+  std::string t = std::to_string(timeout_ms);
+  return client_call(static_cast<StoreClient*>(h), kGet, key, t.c_str(),
+                     static_cast<int>(t.size()), out, out_len);
+}
+
+PT_EXPORT int pt_store_get_nowait(void* h, const char* key, char** out,
+                                  int64_t* out_len) {
+  return client_call(static_cast<StoreClient*>(h), kGetNowait, key, nullptr, 0,
+                     out, out_len);
+}
+
+PT_EXPORT int64_t pt_store_add(void* h, const char* key, int64_t delta) {
+  std::string d = std::to_string(delta);
+  char* out = nullptr;
+  int64_t out_len = 0;
+  int st = client_call(static_cast<StoreClient*>(h), kAdd, key, d.c_str(),
+                       static_cast<int>(d.size()), &out, &out_len);
+  int64_t v = (st == kOk && out) ? atoll(std::string(out, out_len).c_str())
+                                 : INT64_MIN;
+  free(out);
+  return v;
+}
+
+PT_EXPORT int pt_store_wait(void* h, const char* key, int64_t timeout_ms) {
+  return client_call(static_cast<StoreClient*>(h), kWait, key,
+                     std::to_string(timeout_ms).c_str(),
+                     static_cast<int>(std::to_string(timeout_ms).size()),
+                     nullptr, nullptr);
+}
+
+PT_EXPORT int pt_store_delete(void* h, const char* key) {
+  char* out = nullptr;
+  int64_t n = 0;
+  int st = client_call(static_cast<StoreClient*>(h), kDelete, key, nullptr, 0,
+                       &out, &n);
+  int existed = (st == kOk && out && n > 0 && out[0] == '1') ? 1 : 0;
+  free(out);
+  return existed;
+}
+
+PT_EXPORT int pt_store_keys(void* h, const char* prefix, char** out,
+                            int64_t* out_len) {
+  return client_call(static_cast<StoreClient*>(h), kKeys, prefix, nullptr, 0,
+                     out, out_len);
+}
+
+PT_EXPORT void pt_store_client_close(void* h) {
+  if (!h) return;
+  auto* c = static_cast<StoreClient*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+PT_EXPORT void pt_buf_free(char* p) { free(p); }

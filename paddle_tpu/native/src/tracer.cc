@@ -1,0 +1,140 @@
+// Host tracer: RecordEvent spans + chrome://tracing JSON export.
+//
+// Reference: the host tracer records RecordEvent spans into thread-local
+// buffers (paddle/fluid/platform/profiler/host_tracer.cc, RecordEvent emitted
+// inside the generated API at api_base.py:1340-1355) and the collected
+// NodeTrees are dumped as chrome://tracing JSON
+// (platform/profiler/chrometracing_logger.h:32).  The TPU device side is
+// covered by jax.profiler/XPlane; this native tracer covers the host side
+// with the same span API and export format, callable from Python (via
+// paddle_tpu.profiler.RecordEvent) without GIL-held timestamping overhead.
+
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace {
+
+struct Span {
+  int64_t t0_us;
+  int64_t t1_us;
+  uint32_t name_id;
+  uint32_t depth;
+};
+
+struct ThreadBuf {
+  std::vector<Span> spans;
+  std::vector<std::pair<uint32_t, int64_t>> stack;  // (name_id, t0)
+  long tid = 0;
+};
+
+std::mutex g_mu;
+std::vector<std::string> g_names;                 // name_id -> name
+std::vector<ThreadBuf*> g_bufs;
+std::atomic<bool> g_enabled{false};
+
+thread_local ThreadBuf* t_buf = nullptr;
+
+ThreadBuf* get_buf() {
+  if (!t_buf) {
+    t_buf = new ThreadBuf();
+    t_buf->tid = syscall(SYS_gettid);
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_bufs.push_back(t_buf);
+  }
+  return t_buf;
+}
+
+void json_escape(FILE* f, const std::string& s) {
+  for (char c : s) {
+    if (c == '"' || c == '\\')
+      fputc('\\', f), fputc(c, f);
+    else if (static_cast<unsigned char>(c) >= 0x20)
+      fputc(c, f);
+    else
+      fprintf(f, "\\u%04x", c);
+  }
+}
+
+}  // namespace
+
+PT_EXPORT void pt_trace_enable() { g_enabled.store(true); }
+PT_EXPORT void pt_trace_disable() { g_enabled.store(false); }
+PT_EXPORT int pt_trace_enabled() { return g_enabled.load() ? 1 : 0; }
+
+// Interns a name; safe to call once per distinct event name and cache.
+PT_EXPORT uint32_t pt_trace_intern(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (uint32_t i = 0; i < g_names.size(); ++i)
+    if (g_names[i] == name) return i;
+  g_names.emplace_back(name);
+  return static_cast<uint32_t>(g_names.size() - 1);
+}
+
+PT_EXPORT void pt_trace_begin(uint32_t name_id) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  ThreadBuf* b = get_buf();
+  b->stack.emplace_back(name_id, pt::now_us());
+}
+
+PT_EXPORT void pt_trace_end() {
+  if (!t_buf || t_buf->stack.empty()) return;
+  auto [name_id, t0] = t_buf->stack.back();
+  t_buf->stack.pop_back();
+  t_buf->spans.push_back({t0, pt::now_us(), name_id,
+                          static_cast<uint32_t>(t_buf->stack.size())});
+}
+
+// One-shot complete span (begin+end timestamps supplied by the caller).
+PT_EXPORT void pt_trace_span(uint32_t name_id, int64_t t0_us, int64_t t1_us) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  ThreadBuf* b = get_buf();
+  b->spans.push_back({t0_us, t1_us, name_id, 0});
+}
+
+PT_EXPORT int64_t pt_trace_now_us() { return pt::now_us(); }
+
+PT_EXPORT int64_t pt_trace_span_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  int64_t n = 0;
+  for (auto* b : g_bufs) n += static_cast<int64_t>(b->spans.size());
+  return n;
+}
+
+PT_EXPORT void pt_trace_clear() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (auto* b : g_bufs) b->spans.clear();
+}
+
+// Dumps all collected spans as chrome://tracing "X" (complete) events.
+// Returns number of spans written, or -1 on I/O error.
+PT_EXPORT int64_t pt_trace_dump(const char* path, int clear) {
+  FILE* f = fopen(path, "w");
+  if (!f) return -1;
+  std::lock_guard<std::mutex> lk(g_mu);
+  fputs("{\"traceEvents\":[", f);
+  int64_t n = 0;
+  long pid = getpid();
+  for (auto* b : g_bufs) {
+    for (const Span& s : b->spans) {
+      if (n) fputc(',', f);
+      fprintf(f, "{\"ph\":\"X\",\"cat\":\"host\",\"name\":\"");
+      json_escape(f, s.name_id < g_names.size() ? g_names[s.name_id] : "?");
+      fprintf(f, "\",\"pid\":%ld,\"tid\":%ld,\"ts\":%lld,\"dur\":%lld}", pid,
+              b->tid, static_cast<long long>(s.t0_us),
+              static_cast<long long>(s.t1_us - s.t0_us));
+      ++n;
+    }
+    if (clear) b->spans.clear();
+  }
+  fputs("]}", f);
+  fclose(f);
+  return n;
+}

@@ -17,6 +17,8 @@ from enum import Enum
 
 import jax
 
+from .. import native as _native
+
 __all__ = [
     "Profiler",
     "RecordEvent",
@@ -46,6 +48,51 @@ _events_lock = threading.Lock()
 _events: list[dict] = []
 _recording = threading.local()
 
+# Native host tracer (paddle_tpu/native/src/tracer.cc — the analog of the
+# reference's C++ host_tracer).  When the library is available, spans are
+# timestamped in C++ (no GIL-held dict append per span); export/summary merge
+# the native buffers back in.
+_nlib = None
+_intern_cache: dict[str, int] = {}
+
+
+def _native_lib():
+    global _nlib
+    if _nlib is None:
+        lib = _native.load()
+        if lib is not None:
+            lib.pt_trace_enable()
+        _nlib = lib if lib is not None else False
+    return _nlib or None
+
+
+def _intern(name: str) -> int:
+    nid = _intern_cache.get(name)
+    if nid is None:
+        nid = _intern_cache[name] = _native_lib().pt_trace_intern(name.encode())
+    return nid
+
+
+def _native_events(clear: bool = False) -> list[dict]:
+    lib = _native_lib()
+    if lib is None:
+        return []
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        path = tf.name
+    try:
+        n = lib.pt_trace_dump(path.encode(), 1 if clear else 0)
+        if n <= 0:
+            return []
+        with open(path) as f:
+            return json.load(f).get("traceEvents", [])
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
 
 def _now_us():
     return time.perf_counter_ns() / 1000.0
@@ -62,7 +109,12 @@ class RecordEvent:
         self._jax_ctx = None
 
     def begin(self):
-        self._t0 = _now_us()
+        lib = _native_lib()
+        if lib is not None:
+            lib.pt_trace_begin(_intern(self.name))
+            self._t0 = True  # marks an open native span
+        else:
+            self._t0 = _now_us()
         try:
             self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
             self._jax_ctx.__enter__()
@@ -72,9 +124,14 @@ class RecordEvent:
     def end(self):
         if self._t0 is None:
             return
-        t1 = _now_us()
         if self._jax_ctx is not None:
             self._jax_ctx.__exit__(None, None, None)
+        lib = _native_lib()
+        if lib is not None:
+            lib.pt_trace_end()
+            self._t0 = None
+            return
+        t1 = _now_us()
         with _events_lock:
             _events.append(
                 {
@@ -199,12 +256,14 @@ class Profiler:
     def export(self, path: str, format: str = "json"):
         with _events_lock:
             events = list(_events)
+        events += _native_events()
         with open(path, "w") as f:
             json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
         with _events_lock:
             events = list(_events)
+        events += _native_events()
         agg: dict[str, list[float]] = {}
         for e in events:
             agg.setdefault(e["name"], []).append(e["dur"])
