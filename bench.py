@@ -58,7 +58,7 @@ def main():
     mesh = llama.make_mesh(dp=1, mp=1, sharding=1, sep=1, devices=jax.devices()[:1])
     step_fn, opt_init, param_shardings, data_sharding = llama.build_train_step(cfg, mesh)
     params = jax.device_put(llama.init_params(cfg, jax.random.key(0)), param_shardings)
-    opt_state = jax.jit(opt_init)(params)
+    opt_state = opt_init(params)
 
     rs = np.random.RandomState(0)
     ids = jax.device_put(jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq))), data_sharding)

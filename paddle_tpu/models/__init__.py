@@ -1,5 +1,9 @@
 """Model zoo: the BASELINE.md config ladder lives here (LeNet/ResNet in
-paddle_tpu.vision.models; Llama + MoE families here)."""
+paddle_tpu.vision.models; Llama, DiT and MoE families here)."""
 
+from . import dit  # noqa: F401
 from . import llama  # noqa: F401
+from . import moe_llama  # noqa: F401
+from .dit import DiTConfig  # noqa: F401
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .moe_llama import MoEConfig  # noqa: F401

@@ -1,0 +1,307 @@
+"""Diffusion Transformer (DiT) — BASELINE config #4 (SD3/DiT class).
+
+Reference surface: the reference covers this class of model via its vision +
+transformer layers (python/paddle/nn/layer/transformer.py, vision/) and the
+fused attention ops; SD3/DiT recipes live downstream (PaddleMIX) on the same
+framework primitives.  This module provides the in-framework flagship for the
+"mixed conv+attention, bf16" rung of the config ladder.
+
+TPU-first design mirrors models/llama.py: a pure functional core (stacked
+layer weights → one lax.scan block), Megatron-style PartitionSpecs over the
+("dp","sharding","mp") mesh axes, Pallas flash attention, bf16 params with
+fp32 master weights in AdamW, and a rectified-flow/eps-prediction training
+step compiled as a single pjit program.
+
+Architecture (DiT-XL/2 style): patchify conv → tokens; timestep sinusoidal
+embedding + label embedding → conditioning vector c; N blocks of
+adaLN-Zero(attention, mlp) modulated by c; final adaLN + linear → unpatchify.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.pallas import flash_attention as fa
+
+
+@dataclasses.dataclass
+class DiTConfig:
+    image_size: int = 32          # latent spatial size (SD3 latents: 32x32)
+    patch_size: int = 2
+    in_channels: int = 4
+    hidden_size: int = 1152
+    depth: int = 28
+    num_heads: int = 16
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000
+    class_dropout_prob: float = 0.1
+    learn_sigma: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_patches(self):
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def out_channels(self):
+        return self.in_channels * (2 if self.learn_sigma else 1)
+
+    @staticmethod
+    def dit_xl_2():
+        return DiTConfig(hidden_size=1152, depth=28, num_heads=16)
+
+    @staticmethod
+    def tiny(image=8, patch=2, channels=4, hidden=64, depth=2, heads=4, classes=10):
+        return DiTConfig(image_size=image, patch_size=patch, in_channels=channels,
+                         hidden_size=hidden, depth=depth, num_heads=heads,
+                         num_classes=classes)
+
+
+def timestep_embedding(t, dim, max_period=10000.0):
+    """Sinusoidal timestep embedding; t: [b] float in [0, 1000)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def init_params(cfg: DiTConfig, key=None) -> dict:
+    key = key if key is not None else jax.random.key(0)
+    k = iter(jax.random.split(key, 24))
+    h, d = cfg.hidden_size, cfg.depth
+    p, c = cfg.patch_size, cfg.in_channels
+    mlp = int(h * cfg.mlp_ratio)
+    std = 0.02
+
+    def init(kk, shape, scale=std):
+        return (jax.random.normal(kk, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    return {
+        "patch_w": init(next(k), (p * p * c, h)),     # patchify projection
+        "patch_b": jnp.zeros((h,), cfg.dtype),
+        "pos_embed": init(next(k), (cfg.num_patches, h)),
+        "t_mlp1": init(next(k), (256, h)),
+        "t_mlp1_b": jnp.zeros((h,), cfg.dtype),
+        "t_mlp2": init(next(k), (h, h)),
+        "t_mlp2_b": jnp.zeros((h,), cfg.dtype),
+        # +1 class for the classifier-free-guidance null token
+        "label_embed": init(next(k), (cfg.num_classes + 1, h)),
+        "blocks": {
+            # adaLN-zero: 6 modulation params per block from c (zero-init out)
+            "mod_w": jnp.zeros((d, h, 6 * h), cfg.dtype),
+            "mod_b": jnp.zeros((d, 6 * h), cfg.dtype),
+            "wqkv": init(next(k), (d, h, 3 * h)),
+            "wo": init(next(k), (d, h, h)),
+            "mlp1": init(next(k), (d, h, mlp)),
+            "mlp1_b": jnp.zeros((d, mlp), cfg.dtype),
+            "mlp2": init(next(k), (d, mlp, h)),
+            "mlp2_b": jnp.zeros((d, h), cfg.dtype),
+        },
+        "final_mod_w": jnp.zeros((h, 2 * h), cfg.dtype),
+        "final_mod_b": jnp.zeros((2 * h,), cfg.dtype),
+        "final_w": jnp.zeros((h, p * p * cfg.out_channels), cfg.dtype),
+        "final_b": jnp.zeros((p * p * cfg.out_channels,), cfg.dtype),
+    }
+
+
+def param_specs(cfg: DiTConfig) -> dict:
+    return {
+        "patch_w": P(None, "mp"),
+        "patch_b": P(None),
+        "pos_embed": P(None, None),
+        "t_mlp1": P(None, "mp"),
+        "t_mlp1_b": P(None),
+        "t_mlp2": P("sharding", "mp"),
+        "t_mlp2_b": P(None),
+        # num_classes+1 rows (CFG null token) is usually odd — don't shard dim 0
+        "label_embed": P(None, "mp"),
+        "blocks": {
+            "mod_w": P(None, "sharding", "mp"),
+            "mod_b": P(None, "mp"),
+            "wqkv": P(None, "sharding", "mp"),   # column parallel
+            "wo": P(None, "mp", "sharding"),     # row parallel
+            "mlp1": P(None, "sharding", "mp"),
+            "mlp1_b": P(None, "mp"),
+            "mlp2": P(None, "mp", "sharding"),
+            "mlp2_b": P(None),
+        },
+        "final_mod_w": P("sharding", "mp"),
+        "final_mod_b": P(None),
+        "final_w": P("mp", None),
+        "final_b": P(None),
+    }
+
+
+def _modulate(x, shift, scale):
+    return x * (1 + scale[:, None, :]) + shift[:, None, :]
+
+
+def _layer_norm(x, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+
+
+def _block_forward(cfg: DiTConfig, x, c, bp):
+    """One DiT block with adaLN-Zero; x: [b, n, h], c: [b, h]."""
+    b, n, h = x.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+    mod = jax.nn.silu(c) @ bp["mod_w"] + bp["mod_b"]
+    (shift_a, scale_a, gate_a, shift_m, scale_m, gate_m) = jnp.split(mod, 6, axis=-1)
+
+    xn = _modulate(_layer_norm(x), shift_a, scale_a)
+    qkv = (xn @ bp["wqkv"]).reshape(b, n, 3, nh, hd)
+    q, kk, vv = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    attn = fa.flash_attention_bshd(q, kk, vv, causal=False)
+    x = x + gate_a[:, None, :] * (attn.reshape(b, n, nh * hd) @ bp["wo"])
+
+    xn = _modulate(_layer_norm(x), shift_m, scale_m)
+    hmid = jax.nn.gelu((xn @ bp["mlp1"]) + bp["mlp1_b"], approximate=True)
+    x = x + gate_m[:, None, :] * ((hmid @ bp["mlp2"]) + bp["mlp2_b"])
+    return x
+
+
+def forward(cfg: DiTConfig, params, x, t, y, remat=True):
+    """Predicted noise for latents x: [b, c, H, W], timesteps t: [b],
+    labels y: [b] int (num_classes == null/uncond token)."""
+    b, c, H, W = x.shape
+    p = cfg.patch_size
+    hgrid, wgrid = H // p, W // p
+
+    # patchify: [b, c, H, W] -> [b, n, p*p*c]
+    xp = x.reshape(b, c, hgrid, p, wgrid, p)
+    xp = xp.transpose(0, 2, 4, 3, 5, 1).reshape(b, hgrid * wgrid, p * p * c)
+    tok = (xp.astype(cfg.dtype) @ params["patch_w"]) + params["patch_b"]
+    tok = tok + params["pos_embed"][None]
+
+    temb = timestep_embedding(t, 256).astype(cfg.dtype)
+    cvec = jax.nn.silu((temb @ params["t_mlp1"]) + params["t_mlp1_b"])
+    cvec = (cvec @ params["t_mlp2"]) + params["t_mlp2_b"]
+    cvec = cvec + jnp.take(params["label_embed"], y, axis=0)
+
+    def body(carry, bp):
+        return _block_forward(cfg, carry, cvec, bp), None
+
+    scan_body = jax.checkpoint(body) if remat else body
+    tok, _ = jax.lax.scan(scan_body, tok, params["blocks"])
+
+    mod = jax.nn.silu(cvec) @ params["final_mod_w"] + params["final_mod_b"]
+    shift, scale = jnp.split(mod, 2, axis=-1)
+    tok = _modulate(_layer_norm(tok), shift, scale)
+    out = (tok @ params["final_w"]) + params["final_b"]
+
+    # unpatchify: [b, n, p*p*oc] -> [b, oc, H, W]
+    oc = cfg.out_channels
+    out = out.reshape(b, hgrid, wgrid, p, p, oc)
+    out = out.transpose(0, 5, 1, 3, 2, 4).reshape(b, oc, H, W)
+    return out
+
+
+def loss_fn(cfg: DiTConfig, params, x0, y, rng):
+    """Rectified-flow matching loss (SD3-style): x_t = (1-t) x0 + t eps,
+    target velocity v = eps - x0."""
+    b = x0.shape[0]
+    k1, k2, k3 = jax.random.split(rng, 3)
+    t = jax.random.uniform(k1, (b,), jnp.float32)
+    eps = jax.random.normal(k2, x0.shape, jnp.float32)
+    # classifier-free guidance dropout: replace label with null token
+    drop = jax.random.bernoulli(k3, cfg.class_dropout_prob, (b,))
+    y = jnp.where(drop, cfg.num_classes, y)
+    xt = (1 - t[:, None, None, None]) * x0 + t[:, None, None, None] * eps
+    v_pred = forward(cfg, params, xt.astype(cfg.dtype), t * 999.0, y)
+    v_tgt = eps - x0
+    return jnp.mean((v_pred.astype(jnp.float32) - v_tgt) ** 2)
+
+
+def make_mesh(dp=1, mp=1, sharding=1, sep=1, pp=1, devices=None):
+    from . import llama
+
+    return llama.make_mesh(dp=dp, mp=mp, sharding=sharding, sep=sep, pp=pp,
+                           devices=devices)
+
+
+def build_train_step(cfg: DiTConfig, mesh: Mesh, lr=1e-4, weight_decay=0.0,
+                     beta1=0.9, beta2=0.999, grad_clip=1.0):
+    specs = param_specs(cfg)
+    data_spec = P(("dp", "sharding"), None, None, None)  # [b, c, H, W]
+
+    def to_named(tree_specs):
+        return jax.tree_util.tree_map(
+            lambda sp: NamedSharding(mesh, sp), tree_specs,
+            is_leaf=lambda sp: isinstance(sp, P))
+
+    param_shardings = to_named(specs)
+
+    def opt_init(params):
+        z = lambda pp_: jnp.zeros(pp_.shape, jnp.float32)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "m": jax.tree_util.tree_map(z, params),
+            "v": jax.tree_util.tree_map(z, params),
+            "master": jax.tree_util.tree_map(lambda pp_: pp_.astype(jnp.float32), params),
+        }
+
+    def train_step(params, opt_state, x0, y, rng):
+        loss, grads = jax.value_and_grad(
+            lambda prm: loss_fn(cfg, prm, x0, y, rng))(params)
+        g32 = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        leaves = jax.tree_util.tree_leaves(g32)
+        gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+        scale_f = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-6))
+        step = opt_state["step"] + 1
+        b1c = 1 - beta1 ** step.astype(jnp.float32)
+        b2c = 1 - beta2 ** step.astype(jnp.float32)
+
+        def upd(g, m, v, master):
+            g = g * scale_f
+            m2 = beta1 * m + (1 - beta1) * g
+            v2 = beta2 * v + (1 - beta2) * g * g
+            update = (m2 / b1c) / (jnp.sqrt(v2 / b2c) + 1e-8)
+            master2 = master * (1 - lr * weight_decay) - lr * update
+            return m2, v2, master2
+
+        updated = jax.tree_util.tree_map(
+            upd, g32, opt_state["m"], opt_state["v"], opt_state["master"])
+        flat, treedef = jax.tree_util.tree_flatten(
+            updated, is_leaf=lambda xx: isinstance(xx, tuple))
+        new_m = jax.tree_util.tree_unflatten(treedef, [tt[0] for tt in flat])
+        new_v = jax.tree_util.tree_unflatten(treedef, [tt[1] for tt in flat])
+        new_w = jax.tree_util.tree_unflatten(treedef, [tt[2] for tt in flat])
+        new_params = jax.tree_util.tree_map(
+            lambda w, pp_: w.astype(pp_.dtype), new_w, params)
+        return loss, new_params, {"step": step, "m": new_m, "v": new_v, "master": new_w}
+
+    opt_shardings = {
+        "step": NamedSharding(mesh, P()),
+        "m": param_shardings,
+        "v": param_shardings,
+        "master": param_shardings,
+    }
+    data_sharding = NamedSharding(mesh, data_spec)
+    label_sharding = NamedSharding(mesh, P(("dp", "sharding")))
+    jitted = jax.jit(
+        train_step,
+        in_shardings=(param_shardings, opt_shardings, data_sharding,
+                      label_sharding, NamedSharding(mesh, P())),
+        out_shardings=(NamedSharding(mesh, P()), param_shardings, opt_shardings),
+        donate_argnums=(0, 1),
+    )
+    # fresh zeros in opt state don't inherit param shardings — pin them
+    opt_init = jax.jit(opt_init, out_shardings=opt_shardings)
+    return jitted, opt_init, param_shardings, data_sharding
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))

@@ -269,6 +269,9 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
         out_shardings=(NamedSharding(mesh, P()), param_shardings, opt_shardings),
         donate_argnums=(0, 1),
     )
+    # fresh zeros in the opt state don't inherit param shardings — pin them so
+    # opt_init output always matches the step's in_shardings
+    opt_init = jax.jit(opt_init, out_shardings=opt_shardings)
     return jitted, opt_init, param_shardings, data_sharding
 
 

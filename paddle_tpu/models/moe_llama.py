@@ -1,0 +1,326 @@
+"""MoE transformer LM (BASELINE config #5: DeepSeekMoE / Qwen2-MoE class).
+
+Reference surface: the reference's MoE stack is `MoELayer` + gates
+(python/paddle/incubate/distributed/models/moe/moe_layer.py, moe/gate/) with
+dispatch/combine over `global_scatter`/`global_gather` NCCL alltoall, plus the
+semi-auto `moe_global_mesh_tensor` APIs (auto_parallel/api.py:495).
+
+TPU-first design: experts are a stacked weight tensor [E, ...] sharded over the
+"mp" mesh axis (expert parallelism); routing uses the dense GShard/Switch
+formulation — one_hot dispatch/combine einsums with a static capacity — which
+XLA lowers to an all-to-all over the expert axis on ICI (SURVEY.md §7 row
+"EP").  DeepSeekMoE structure: `n_shared` always-on shared experts + `E`
+routed experts with top-k token-choice gating, load-balance auxiliary loss
+(Switch-style) and router z-loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.pallas import flash_attention as fa
+from ..ops.pallas import rms_norm as rms
+from ..ops.pallas import rope as rope_mod
+from ..ops.pallas import swiglu as swiglu_mod
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 4096       # shared-expert (dense) ffn width
+    moe_intermediate_size: int = 1024   # per-routed-expert ffn width
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 4
+    num_experts: int = 8
+    num_shared_experts: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    z_loss_weight: float = 1e-3
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, kv_heads=2,
+             experts=4, top_k=2, inter=128, moe_inter=64):
+        return MoEConfig(
+            vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+            moe_intermediate_size=moe_inter, num_hidden_layers=layers,
+            num_attention_heads=heads, num_key_value_heads=kv_heads,
+            num_experts=experts, top_k=top_k, max_position_embeddings=256,
+        )
+
+
+def init_params(cfg: MoEConfig, key=None) -> dict:
+    key = key if key is not None else jax.random.key(0)
+    k = iter(jax.random.split(key, 24))
+    h, i, mi, v = (cfg.hidden_size, cfg.intermediate_size,
+                   cfg.moe_intermediate_size, cfg.vocab_size)
+    nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    L, E = cfg.num_hidden_layers, cfg.num_experts
+    std = 0.02
+
+    def init(kk, shape):
+        return (jax.random.normal(kk, shape, jnp.float32) * std).astype(cfg.dtype)
+
+    return {
+        "embed": init(next(k), (v, h)),
+        "final_norm": jnp.ones((h,), cfg.dtype),
+        "lm_head": init(next(k), (h, v)),
+        "layers": {
+            "input_norm": jnp.ones((L, h), cfg.dtype),
+            "post_norm": jnp.ones((L, h), cfg.dtype),
+            "wq": init(next(k), (L, h, nh * hd)),
+            "wk": init(next(k), (L, h, nkv * hd)),
+            "wv": init(next(k), (L, h, nkv * hd)),
+            "wo": init(next(k), (L, nh * hd, h)),
+            # shared (dense) experts: swiglu ffn, width i * n_shared
+            "s_gate": init(next(k), (L, h, i * cfg.num_shared_experts)),
+            "s_up": init(next(k), (L, h, i * cfg.num_shared_experts)),
+            "s_down": init(next(k), (L, i * cfg.num_shared_experts, h)),
+            # router + routed experts (stacked on E)
+            "router": init(next(k), (L, h, E)).astype(jnp.float32),
+            "e_gate": init(next(k), (L, E, h, mi)),
+            "e_up": init(next(k), (L, E, h, mi)),
+            "e_down": init(next(k), (L, E, mi, h)),
+        },
+    }
+
+
+def param_specs(cfg: MoEConfig) -> dict:
+    """Experts shard over 'mp' (expert parallelism); attention is Megatron-TP
+    over the same axis; ZeRO over 'sharding' like models/llama.py."""
+    return {
+        "embed": P("mp", "sharding"),
+        "final_norm": P(None),
+        "lm_head": P("sharding", "mp"),
+        "layers": {
+            "input_norm": P(None, None),
+            "post_norm": P(None, None),
+            "wq": P(None, "sharding", "mp"),
+            "wk": P(None, "sharding", "mp"),
+            "wv": P(None, "sharding", "mp"),
+            "wo": P(None, "mp", "sharding"),
+            "s_gate": P(None, "sharding", "mp"),
+            "s_up": P(None, "sharding", "mp"),
+            "s_down": P(None, "mp", "sharding"),
+            "router": P(None, None, None),
+            "e_gate": P(None, "mp", "sharding", None),   # expert dim over mp
+            "e_up": P(None, "mp", "sharding", None),
+            "e_down": P(None, "mp", None, "sharding"),
+        },
+    }
+
+
+def moe_ffn(cfg: MoEConfig, x, lp):
+    """Routed-expert FFN for x: [b, s, h] → (out, aux_loss, z_loss).
+
+    Dense GShard dispatch: top-k gating → capacity-bounded one_hot dispatch
+    tensor [g, E, C] → einsum into per-expert batches [E, C*, h] → swiglu →
+    combine.  Under GSPMD with e_* sharded on 'mp' this compiles to
+    all-to-all(dispatch) + expert-local matmuls + all-to-all(combine), the
+    exact dataflow of the reference's global_scatter/global_gather."""
+    b, s, h = x.shape
+    E, K = cfg.num_experts, cfg.top_k
+    g = b * s
+    xf = x.reshape(g, h)
+
+    logits = (xf.astype(jnp.float32) @ lp["router"])           # [g, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    # z-loss: keeps router logits small (numerics at scale)
+    z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+
+    topk_p, topk_i = jax.lax.top_k(probs, K)                   # [g, K]
+    topk_p = topk_p / jnp.maximum(topk_p.sum(-1, keepdims=True), 1e-9)
+
+    cap = int(np.ceil(cfg.capacity_factor * K * g / E))
+    cap = max(cap, 1)
+
+    # position of each (token, k) within its expert queue
+    onehot = jax.nn.one_hot(topk_i, E, dtype=jnp.int32)        # [g, K, E]
+    flat = onehot.reshape(g * K, E)
+    pos = jnp.cumsum(flat, axis=0) - flat                      # slots before me
+    pos = (pos * flat).sum(-1).reshape(g, K)                   # [g, K]
+    keep = pos < cap                                           # drop overflow
+
+    # aux load-balance loss (Switch: E * sum_e f_e * P_e)
+    frac_tokens = jnp.mean(jax.nn.one_hot(topk_i[:, 0], E, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+
+    # dispatch/combine tensors from one-hot einsums
+    oh_e = jax.nn.one_hot(topk_i, E, dtype=xf.dtype)           # [g, K, E]
+    oh_c = jax.nn.one_hot(pos, cap, dtype=xf.dtype) * keep[..., None]  # [g, K, C]
+    combine = jnp.einsum("gke,gkc,gk->gec", oh_e, oh_c, topk_p.astype(xf.dtype))
+    dispatch = jnp.einsum("gke,gkc->gec", oh_e, oh_c)
+
+    expert_in = jnp.einsum("gec,gh->ech", dispatch, xf)        # [E, C, h]
+    gate = jnp.einsum("ech,ehm->ecm", expert_in, lp["e_gate"])
+    up = jnp.einsum("ech,ehm->ecm", expert_in, lp["e_up"])
+    act = swiglu_mod.swiglu(gate, up)
+    expert_out = jnp.einsum("ecm,emh->ech", act, lp["e_down"])
+    out = jnp.einsum("gec,ech->gh", combine, expert_out)
+    return out.reshape(b, s, h), aux, z_loss
+
+
+def _layer_forward(cfg: MoEConfig, x, lp, cos, sin, use_flash=True):
+    b, s, h = x.shape
+    nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+
+    xn = rms.rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    q = (xn @ lp["wq"]).reshape(b, s, nh, hd)
+    kk = (xn @ lp["wk"]).reshape(b, s, nkv, hd)
+    vv = (xn @ lp["wv"]).reshape(b, s, nkv, hd)
+    q, kk = rope_mod.apply_rotary_pos_emb(q, kk, cos, sin)
+    if use_flash:
+        attn = fa.flash_attention_bshd(q, kk, vv, causal=True)
+    else:
+        import math
+
+        attn = fa._composed_attention(q, kk, vv, None, True, 1.0 / math.sqrt(hd))
+    x = x + attn.reshape(b, s, nh * hd) @ lp["wo"]
+
+    xn = rms.rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+    shared = swiglu_mod.swiglu(xn @ lp["s_gate"], xn @ lp["s_up"]) @ lp["s_down"]
+    routed, aux, z = moe_ffn(cfg, xn, lp)
+    return x + shared + routed, aux, z
+
+
+def forward(cfg: MoEConfig, params, input_ids, use_flash=True, remat=True,
+            return_aux=False):
+    x = jnp.take(params["embed"], input_ids, axis=0).astype(cfg.dtype)
+    b, s, _ = x.shape
+    cos, sin = rope_mod.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_theta,
+                                     dtype=cfg.dtype)
+
+    def body(carry, lp):
+        x, aux, z = carry
+        x2, a, zz = _layer_forward(cfg, x, lp, cos, sin, use_flash)
+        return (x2, aux + a, z + zz), None
+
+    scan_body = jax.checkpoint(body) if remat else body
+    (x, aux, z), _ = jax.lax.scan(
+        scan_body, (x, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        params["layers"])
+    x = rms.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = x @ params["lm_head"]
+    if return_aux:
+        return logits, aux / cfg.num_hidden_layers, z / cfg.num_hidden_layers
+    return logits
+
+
+def loss_fn(cfg: MoEConfig, params, input_ids, labels):
+    logits, aux, z = forward(cfg, params, input_ids, return_aux=True)
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    ce = -jnp.mean(picked)
+    return ce + cfg.aux_loss_weight * aux + cfg.z_loss_weight * z
+
+
+def make_mesh(dp=1, mp=1, sharding=1, sep=1, pp=1, devices=None):
+    from . import llama
+
+    return llama.make_mesh(dp=dp, mp=mp, sharding=sharding, sep=sep, pp=pp,
+                           devices=devices)
+
+
+def build_train_step(cfg: MoEConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
+                     beta1=0.9, beta2=0.95, grad_clip=1.0):
+    """Same optimizer/sharding scaffold as models/llama.build_train_step, with
+    the MoE loss (ce + aux + z)."""
+    specs = param_specs(cfg)
+    data_spec = P(("dp", "sharding"), "sep")
+
+    def to_named(tree_specs):
+        return jax.tree_util.tree_map(
+            lambda sp: NamedSharding(mesh, sp), tree_specs,
+            is_leaf=lambda sp: isinstance(sp, P))
+
+    param_shardings = to_named(specs)
+
+    def opt_init(params):
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "m": jax.tree_util.tree_map(z, params),
+            "v": jax.tree_util.tree_map(z, params),
+            "master": jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params),
+        }
+
+    def train_step(params, opt_state, input_ids, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, input_ids, labels))(params)
+        g32 = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        leaves = jax.tree_util.tree_leaves(g32)
+        gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+        scale_f = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-6))
+        step = opt_state["step"] + 1
+        b1c = 1 - beta1 ** step.astype(jnp.float32)
+        b2c = 1 - beta2 ** step.astype(jnp.float32)
+
+        def upd(g, m, v, master):
+            g = g * scale_f
+            m2 = beta1 * m + (1 - beta1) * g
+            v2 = beta2 * v + (1 - beta2) * g * g
+            update = (m2 / b1c) / (jnp.sqrt(v2 / b2c) + 1e-8)
+            master2 = master * (1 - lr * weight_decay) - lr * update
+            return m2, v2, master2
+
+        updated = jax.tree_util.tree_map(
+            upd, g32, opt_state["m"], opt_state["v"], opt_state["master"])
+        # tree_map over 4 trees returns a (m2, v2, w2) tuple per leaf; split
+        flat, treedef = jax.tree_util.tree_flatten(
+            updated, is_leaf=lambda x: isinstance(x, tuple))
+        new_m = jax.tree_util.tree_unflatten(treedef, [t[0] for t in flat])
+        new_v = jax.tree_util.tree_unflatten(treedef, [t[1] for t in flat])
+        new_w = jax.tree_util.tree_unflatten(treedef, [t[2] for t in flat])
+        new_params = jax.tree_util.tree_map(
+            lambda w, p: w.astype(p.dtype), new_w, params)
+        new_opt = {"step": step, "m": new_m, "v": new_v, "master": new_w}
+        return loss, new_params, new_opt
+
+    opt_shardings = {
+        "step": NamedSharding(mesh, P()),
+        "m": param_shardings,
+        "v": param_shardings,
+        "master": param_shardings,
+    }
+    data_sharding = NamedSharding(mesh, data_spec)
+    jitted = jax.jit(
+        train_step,
+        in_shardings=(param_shardings, opt_shardings, data_sharding, data_sharding),
+        out_shardings=(NamedSharding(mesh, P()), param_shardings, opt_shardings),
+        donate_argnums=(0, 1),
+    )
+    # fresh zeros in opt state don't inherit param shardings — pin them
+    opt_init = jax.jit(opt_init, out_shardings=opt_shardings)
+    return jitted, opt_init, param_shardings, data_sharding
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def active_params_per_token(cfg: MoEConfig) -> int:
+    """Active (per-token) parameter count — the MoE MFU denominator."""
+    h, i, mi = cfg.hidden_size, cfg.intermediate_size, cfg.moe_intermediate_size
+    nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    per_layer = (h * nh * hd + 2 * h * nkv * hd + nh * hd * h
+                 + 3 * h * i * cfg.num_shared_experts
+                 + 3 * h * mi * cfg.top_k + h * cfg.num_experts)
+    return cfg.num_hidden_layers * per_layer + 2 * cfg.vocab_size * h

@@ -1,0 +1,104 @@
+"""Model-family tests: MoE LLM (config #5) and DiT (config #4).
+
+Mirrors the reference's model integration tests (test/collective/fleet MoE
+tests, vision model tests): forward shape/dtype checks, loss decreases over a
+few steps, sharded train step runs on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models import dit, moe_llama
+
+
+def test_moe_forward_shapes_and_aux():
+    cfg = moe_llama.MoEConfig.tiny()
+    params = moe_llama.init_params(cfg, jax.random.key(0))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16)))
+    logits, aux, z = jax.jit(
+        lambda p, i: moe_llama.forward(cfg, p, i, use_flash=False, remat=False,
+                                       return_aux=True))(params, ids)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert np.isfinite(float(aux)) and float(aux) > 0
+    assert np.isfinite(float(z))
+
+
+def test_moe_expert_routing_balanced_on_uniform_router():
+    """With a freshly-initialized (near-zero) router, top-1 assignment spreads
+    across experts rather than collapsing (aux loss ≈ 1 for uniform)."""
+    cfg = moe_llama.MoEConfig.tiny(experts=4, top_k=2)
+    params = moe_llama.init_params(cfg, jax.random.key(1))
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, cfg.vocab_size, (4, 32)))
+    _, aux, _ = moe_llama.forward(cfg, params, ids, use_flash=False,
+                                  remat=False, return_aux=True)
+    # Switch aux loss is exactly 1.0 at perfectly uniform routing
+    assert 0.5 < float(aux) < 2.0
+
+
+def test_moe_train_step_loss_decreases():
+    cfg = moe_llama.MoEConfig.tiny()
+    mesh = moe_llama.make_mesh(dp=2, mp=2, sharding=2)
+    step_fn, opt_init, pshard, dshard = moe_llama.build_train_step(cfg, mesh, lr=1e-2)
+    params = jax.device_put(moe_llama.init_params(cfg, jax.random.key(0)), pshard)
+    opt = opt_init(params)
+    rs = np.random.RandomState(0)
+    ids = jax.device_put(jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 128))), dshard)
+    labels = jax.device_put(jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 128))), dshard)
+    losses = []
+    for _ in range(5):
+        loss, params, opt = step_fn(params, opt, ids, labels)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_dit_forward_shape():
+    cfg = dit.DiTConfig.tiny()
+    params = dit.init_params(cfg, jax.random.key(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, cfg.in_channels,
+                                                   cfg.image_size, cfg.image_size),
+                    jnp.float32)
+    t = jnp.asarray([10.0, 500.0])
+    y = jnp.asarray([1, 3])
+    out = jax.jit(lambda p, x, t, y: dit.forward(cfg, p, x, t, y, remat=False))(
+        params, x, t, y)
+    assert out.shape == x.shape
+    assert np.all(np.isfinite(np.asarray(out, np.float32)))
+
+
+def test_dit_zero_init_gives_zero_residual_output():
+    """adaLN-Zero: with zero-init gates and final layer, the initial model
+    output is exactly zero (the DiT paper's init invariant)."""
+    cfg = dit.DiTConfig.tiny()
+    params = dit.init_params(cfg, jax.random.key(0))
+    x = jnp.ones((1, cfg.in_channels, cfg.image_size, cfg.image_size), jnp.float32)
+    out = dit.forward(cfg, params, x, jnp.asarray([3.0]), jnp.asarray([0]),
+                      remat=False)
+    np.testing.assert_allclose(np.asarray(out, np.float32), 0.0, atol=1e-5)
+
+
+def test_dit_train_step_loss_decreases():
+    cfg = dit.DiTConfig.tiny()
+    mesh = dit.make_mesh(dp=2, mp=2, sharding=2)
+    step_fn, opt_init, pshard, dshard = dit.build_train_step(cfg, mesh, lr=3e-3)
+    params = jax.device_put(dit.init_params(cfg, jax.random.key(0)), pshard)
+    opt = opt_init(params)
+    rs = np.random.RandomState(0)
+    x0 = jax.device_put(
+        jnp.asarray(rs.randn(8, cfg.in_channels, cfg.image_size, cfg.image_size),
+                    jnp.float32), dshard)
+    y = jnp.asarray(rs.randint(0, cfg.num_classes, (8,)))
+    losses = []
+    for i in range(5):
+        loss, params, opt = step_fn(params, opt, x0, y, jax.random.key(i))
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_active_params_counter():
+    cfg = moe_llama.MoEConfig.tiny()
+    total = moe_llama.count_params(moe_llama.init_params(cfg))
+    active = moe_llama.active_params_per_token(cfg)
+    assert 0 < active < total
