@@ -23,6 +23,8 @@ __all__ = [
     "fused_bias_act",
     "variable_length_memory_efficient_attention",
     "fused_multi_head_attention",
+    "masked_multihead_attention",
+    "block_multihead_attention",
 ]
 
 
@@ -211,3 +213,35 @@ def fused_moe(
         if extra is not None:
             inputs.append(extra)
     return apply_op("fused_moe", fn, inputs)
+
+
+def masked_multihead_attention(x, cache_kv, seq_lens, scale=None, **kw):
+    """Single-token decode attention over a dense KV cache (reference:
+    python/paddle/incubate/nn/functional/masked_multihead_attention.py, CUDA
+    kernel phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu).
+
+    x: [b, 3, nh, hd] packed qkv for the new token; cache_kv: [2, b, nh, S, hd]
+    (paddle's cache layout).  Returns (out [b, nh, hd], new cache_kv, new
+    seq_lens) — functional instead of the reference's in-place `_` op."""
+    from ....ops import decode_attention as _da
+
+    def fn(xv, cache, lens):
+        out, ck, cv, nl = _da.masked_multihead_attention(
+            xv, cache[0], cache[1], lens, scale=scale)
+        return out, jnp.stack([ck, cv]), nl
+
+    return apply_op("masked_multihead_attention", fn, [x, cache_kv, seq_lens])
+
+
+def block_multihead_attention(q, key_cache, value_cache, block_tables,
+                              seq_lens, scale=None, **kw):
+    """Paged (block) KV-cache decode attention (reference:
+    python/paddle/incubate/nn/functional/block_multihead_attention.py,
+    fused_ops.yaml:45).  See ops/decode_attention.py for layout."""
+    from ....ops import decode_attention as _da
+
+    def fn(qv, kc, vc, bt, lens):
+        return _da.block_multihead_attention(qv, kc, vc, bt, lens, scale=scale)
+
+    return apply_op("block_multihead_attention", fn,
+                    [q, key_cache, value_cache, block_tables, seq_lens])

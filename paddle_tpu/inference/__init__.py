@@ -1,0 +1,369 @@
+"""Inference engine (reference: paddle/fluid/inference/ —
+`AnalysisPredictor` at inference/api/analysis_predictor.h, Config at
+inference/api/paddle_analysis_config.h, file format model+params).
+
+TPU-native mapping (SURVEY.md §2.4): the reference's analysis pipeline
+(~290 IR fusion passes, memory-optimize, TensorRT subgraphs) is XLA's job —
+the program is compiled AOT by PJRT with fusion + layout assignment + buffer
+assignment.  What this module keeps is the *deployment surface*:
+
+* a serialized program artifact (`.pdmodel` = StableHLO bytes via
+  ``jax.export``, versioned and loadable without the Python model code) plus
+  a weights file (`.pdiparams`) — the same two-file contract as the
+  reference;
+* ``Config`` with the reference's knobs mapped to their XLA equivalents
+  (memory-optim → buffer donation, ir-optim → XLA autotuning level,
+  precision → bf16 cast);
+* ``Predictor`` with the reference's handle-style API
+  (get_input_names/get_input_handle/run/get_output_handle) and AOT
+  compile-on-load;
+* an LLM ``GenerationEngine`` (prefill + KV-cache decode loop over the
+  decode-attention ops) — the serving path the reference covers with
+  block_multihead_attention + PaddleNLP's predictor.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Config",
+    "Predictor",
+    "create_predictor",
+    "save_inference_model",
+    "load_inference_model",
+    "GenerationEngine",
+]
+
+
+def save_inference_model(path_prefix: str, fn, example_inputs, params=None,
+                         precision: str | None = None):
+    """Export ``fn(params, *inputs)`` (or ``fn(*inputs)`` when params is None)
+    as a deployable artifact.
+
+    Writes ``<prefix>.pdmodel`` — serialized StableHLO (jax.export), callable
+    without the defining Python code — and ``<prefix>.pdiparams`` — pickled
+    numpy weights.  Mirrors the reference's save_inference_model contract
+    (python/paddle/static/io.py:save_inference_model).
+
+    ``precision`` ("bfloat16"/"float16"): cast floating params to the low
+    precision *before* tracing, so the exported program carries the low-
+    precision signature (the export is an AOT artifact — dtype cannot change
+    after the fact)."""
+    from jax import export as jexport
+
+    os.makedirs(os.path.dirname(os.path.abspath(path_prefix)) or ".", exist_ok=True)
+
+    if precision and params is not None:
+        params = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x).astype(precision)
+            if jnp.issubdtype(jnp.result_type(x), jnp.floating) else x, params)
+
+    def spec(x):
+        return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+    if params is not None:
+        jitted = jax.jit(lambda p, *a: fn(p, *a))
+        args = (jax.tree_util.tree_map(spec, params),
+                *[spec(a) for a in example_inputs])
+    else:
+        jitted = jax.jit(fn)
+        args = tuple(spec(a) for a in example_inputs)
+    exported = jexport.export(jitted)(*args)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    weights = (jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+               if params is not None else None)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(weights, f, protocol=4)
+
+
+def load_inference_model(path_prefix: str):
+    """Returns (exported_callable, params)."""
+    from jax import export as jexport
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        params = pickle.load(f)
+    return exported, params
+
+
+class Config:
+    """Deployment config (reference: AnalysisConfig /
+    paddle_infer.Config — inference/api/paddle_analysis_config.h)."""
+
+    def __init__(self, prog_file: str | None = None, params_file: str | None = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.model_prefix = prog_file
+        self._memory_optim = True
+        self._ir_optim = True
+        self._precision = "float32"
+        self._device = "tpu"
+        self._device_id = 0
+        self._enable_profile = False
+
+    # -- reference-parity knobs ------------------------------------------
+    def enable_use_gpu(self, memory_pool_mb: int = 0, device_id: int = 0):
+        """Accepted for API compat; maps to the default accelerator (TPU)."""
+        self._device, self._device_id = "tpu", device_id
+
+    def enable_xpu(self, *a, **kw):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self, x: bool = True):
+        self._memory_optim = x
+
+    def switch_ir_optim(self, x: bool = True):
+        self._ir_optim = x
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        pass  # XLA threadpool is managed by the runtime
+
+    def enable_low_precision(self, dtype="bfloat16"):
+        """Note: the .pdmodel is an AOT artifact with a fixed dtype signature —
+        this knob only takes effect when the model was exported with
+        ``save_inference_model(..., precision=...)``; otherwise it is ignored
+        with a warning at load."""
+        self._precision = dtype
+
+    def summary(self) -> str:
+        return (f"Config(model={self.model_prefix!r}, device={self._device}, "
+                f"precision={self._precision}, memory_optim={self._memory_optim})")
+
+
+class _Handle:
+    """Input/output tensor handle (reference: ZeroCopyTensor /
+    paddle_infer.Tensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes come from the AOT signature
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return None if self._value is None else tuple(self._value.shape)
+
+
+class Predictor:
+    """AOT predictor (reference: AnalysisPredictor,
+    inference/api/analysis_predictor.h).
+
+    Loads the serialized StableHLO program + weights, places weights on the
+    target device once, and runs the compiled executable per call — the
+    reference's Run() path (feed → execute → fetch) without the per-op
+    interpreter."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        if config.model_prefix is None:
+            raise ValueError("Config has no model path")
+        self._exported, params = load_inference_model(config.model_prefix)
+        dev = (jax.devices("cpu")[0] if config._device == "cpu"
+               else jax.devices()[config._device_id])
+        self._device = dev
+        if config._precision in ("bfloat16", "float16") and params is not None:
+            # only honor if the exported signature already is low-precision
+            # (set via save_inference_model(precision=...)); the AOT program's
+            # avals are fixed at export time.
+            leaf_dtypes = {np.asarray(x).dtype for x in
+                           jax.tree_util.tree_leaves(params)
+                           if np.issubdtype(np.asarray(x).dtype, np.floating)}
+            if leaf_dtypes and all(str(d) == config._precision for d in leaf_dtypes):
+                pass  # already exported at this precision
+            else:
+                import warnings
+
+                warnings.warn(
+                    "enable_low_precision ignored: model was exported at "
+                    f"{[str(d) for d in leaf_dtypes]}; re-export with "
+                    "save_inference_model(precision=...)")
+        self._params = (jax.device_put(params, dev) if params is not None else None)
+        n_model_inputs = len(self._exported.in_avals)
+        self._n_data_inputs = (n_model_inputs
+                               - (len(jax.tree_util.tree_leaves(self._params))
+                                  if self._params is not None else 0))
+        self._input_handles = {f"x{i}": _Handle(f"x{i}")
+                               for i in range(self._n_data_inputs)}
+        self._output_handles: dict[str, _Handle] = {}
+
+    # -- handle-style API (reference predictor surface) -------------------
+    def get_input_names(self):
+        return list(self._input_handles)
+
+    def get_input_handle(self, name):
+        return self._input_handles[name]
+
+    def get_output_names(self):
+        return list(self._output_handles) or ["out0"]
+
+    def get_output_handle(self, name):
+        return self._output_handles[name]
+
+    def run(self, inputs=None):
+        """Either pass arrays directly (returns outputs) or use handles."""
+        if inputs is None:
+            inputs = [self._input_handles[n]._value for n in self._input_handles]
+        inputs = [jax.device_put(np.asarray(a), self._device) for a in inputs]
+        if self._params is not None:
+            out = self._exported.call(self._params, *inputs)
+        else:
+            out = self._exported.call(*inputs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        self._output_handles = {}
+        for i, o in enumerate(outs):
+            h = _Handle(f"out{i}")
+            h._value = np.asarray(o)
+            self._output_handles[h.name] = h
+        return [np.asarray(o) for o in outs]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+# ---------------------------------------------------------------------------
+# LLM serving: prefill + KV-cache decode (block_multihead_attention path)
+# ---------------------------------------------------------------------------
+
+class GenerationEngine:
+    """Greedy/temperature decoding for the Llama family with a dense KV cache.
+
+    Reference analog: PaddleNLP's predictor over the reference's
+    block/masked_multihead_attention fused ops.  Prefill and decode are two
+    AOT-compiled programs with static shapes (max_seq padding), the TPU-serving
+    pattern; the decode step threads the cache functionally (donated buffers)."""
+
+    def __init__(self, cfg, params, max_seq: int = 512):
+        from ..models import llama as _llama
+
+        self.cfg = cfg
+        self.max_seq = max_seq
+        self.params = params
+        self._llama = _llama
+        self._prefill = jax.jit(self._prefill_impl, static_argnums=())
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1, 2))
+
+    # cache: k/v [L, b, nkv, S, hd]
+    def init_cache(self, batch):
+        cfg = self.cfg
+        shape = (cfg.num_hidden_layers, batch, cfg.num_key_value_heads,
+                 self.max_seq, cfg.head_dim)
+        return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+    def _attend(self, q, k_all, v_all, pos_mask):
+        """q: [b, s, nh, hd]; k_all/v_all: [b, nkv, S, hd] full cache."""
+        cfg = self.cfg
+        rep = cfg.num_attention_heads // cfg.num_key_value_heads
+        k = jnp.repeat(k_all, rep, axis=1)
+        v = jnp.repeat(v_all, rep, axis=1)
+        logits = jnp.einsum("bsnd,bnSd->bnsS", q.astype(jnp.float32),
+                            k.astype(jnp.float32))
+        logits = logits / np.sqrt(cfg.head_dim)
+        logits = jnp.where(pos_mask, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bnsS,bnSd->bsnd", p.astype(v.dtype), v)
+
+    def _forward_tokens(self, params, ids, cache_k, cache_v, start_pos):
+        """Run s tokens starting at start_pos; returns logits of last token and
+        the updated caches."""
+        cfg, llama = self.cfg, self._llama
+        from ..ops.pallas import rms_norm as rms
+        from ..ops.pallas import rope as rope_mod
+        from ..ops.pallas import swiglu as swiglu_mod
+
+        b, s = ids.shape
+        S = self.max_seq
+        x = jnp.take(params["embed"], ids, axis=0).astype(cfg.dtype)
+        cos_full, sin_full = rope_mod.rope_cos_sin(S, cfg.head_dim,
+                                                   base=cfg.rope_theta,
+                                                   dtype=cfg.dtype)
+        # rope_cos_sin returns [1, S, d]; slice the sequence axis
+        cos = jax.lax.dynamic_slice_in_dim(cos_full, start_pos, s, axis=1)
+        sin = jax.lax.dynamic_slice_in_dim(sin_full, start_pos, s, axis=1)
+        nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                       cfg.head_dim)
+        # causal-with-offset mask over the cache: key j visible to query i iff
+        # j <= start_pos + i
+        kv_pos = jnp.arange(S)[None, None, None, :]
+        q_pos = start_pos + jnp.arange(s)[None, None, :, None]
+        mask = kv_pos <= q_pos
+
+        def body(carry, layer_in):
+            x = carry
+            lp, ck, cv = layer_in
+            xn = rms.rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+            q = (xn @ lp["wq"]).reshape(b, s, nh, hd)
+            k = (xn @ lp["wk"]).reshape(b, s, nkv, hd)
+            v = (xn @ lp["wv"]).reshape(b, s, nkv, hd)
+            q, k = rope_mod.apply_rotary_pos_emb(q, k, cos, sin)
+            # write k/v into cache at [start_pos:start_pos+s]
+            ck = jax.lax.dynamic_update_slice_in_dim(
+                ck, k.transpose(0, 2, 1, 3), start_pos, axis=2)
+            cv = jax.lax.dynamic_update_slice_in_dim(
+                cv, v.transpose(0, 2, 1, 3), start_pos, axis=2)
+            attn = self._attend(q, ck, cv, mask)
+            x = x + attn.reshape(b, s, nh * hd) @ lp["wo"]
+            xn = rms.rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+            x = x + swiglu_mod.swiglu(xn @ lp["w_gate"], xn @ lp["w_up"]) @ lp["w_down"]
+            return x, (ck, cv)
+
+        x, (all_k, all_v) = jax.lax.scan(
+            body, x, (params["layers"], cache_k, cache_v))
+        x = rms.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        head = params.get("lm_head")
+        if head is None:
+            head = params["embed"].T.astype(cfg.dtype)
+        logits = x[:, -1] @ head
+        return logits, all_k, all_v
+
+    def _prefill_impl(self, params, ids, cache_k, cache_v):
+        return self._forward_tokens(params, ids, cache_k, cache_v, 0)
+
+    def _decode_impl(self, params, cache_k, cache_v, token, pos):
+        return self._forward_tokens(params, token, cache_k, cache_v, pos)
+
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int = 32,
+                 temperature: float = 0.0, seed: int = 0):
+        """prompt_ids: [b, s0] int32. Returns [b, s0 + max_new_tokens]."""
+        cfg = self.cfg
+        b, s0 = prompt_ids.shape
+        assert s0 + max_new_tokens <= self.max_seq
+        cache_k, cache_v = self.init_cache(b)
+        ids = jnp.asarray(prompt_ids, jnp.int32)
+        logits, cache_k, cache_v = self._prefill(self.params, ids, cache_k, cache_v)
+        rng = jax.random.key(seed)
+        out = [ids]
+        pos = s0
+        for _ in range(max_new_tokens):
+            if temperature > 0:
+                rng, k = jax.random.split(rng)
+                nxt = jax.random.categorical(k, logits / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            nxt = nxt[:, None].astype(jnp.int32)
+            out.append(nxt)
+            logits, cache_k, cache_v = self._decode(self.params, cache_k,
+                                                    cache_v, nxt, pos)
+            pos += 1
+        return np.asarray(jnp.concatenate(out, axis=1))

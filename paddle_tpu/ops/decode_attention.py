@@ -1,0 +1,125 @@
+"""Decode-path attention with KV caches (the LLM serving hot ops).
+
+Reference: the reference ships these as hand-written CUDA fused ops —
+`masked_multihead_attention_` (fused_ops.yaml:~, kernels in
+phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu: single-token
+decode, append k/v to a dense cache, attend q over the prefix) and
+`block_multihead_attention_` (fused_ops.yaml:45, blocked/paged KV cache with
+per-sequence block tables, PageAttention-style).
+
+TPU-native design: both are expressed as gather + batched matmul so XLA tiles
+them onto the MXU; the block-table gather compiles to a dynamic-slice-free
+`take` along the block axis (static shapes — the cache and tables are padded
+to maxima, masking handles the ragged tails).  All functions are functional:
+caches are inputs AND outputs (donated under jit), matching JAX's
+no-mutation model rather than the reference's in-place `_` ops.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "masked_multihead_attention",
+    "block_multihead_attention",
+    "append_to_block_cache",
+]
+
+
+def masked_multihead_attention(qkv, cache_k, cache_v, seq_lens, scale=None):
+    """Single-token decode attention over a dense KV cache.
+
+    Args:
+      qkv: [b, 3, nh, hd] current-step packed q/k/v (nh == kv heads here;
+        apply GQA repeat before calling for grouped heads).
+      cache_k, cache_v: [b, nh, S, hd] dense caches, valid prefix per batch
+        given by seq_lens.
+      seq_lens: [b] int32 — number of tokens already in the cache.
+
+    Returns (out [b, nh, hd], new_cache_k, new_cache_v, new_seq_lens).
+    """
+    b, three, nh, hd = qkv.shape
+    assert three == 3, f"qkv must pack q,k,v; got dim1={three}"
+    S = cache_k.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+
+    q = qkv[:, 0]  # [b, nh, hd]
+    k_new = qkv[:, 1]
+    v_new = qkv[:, 2]
+
+    # write k_new/v_new at position seq_lens (scatter via one-hot: static shapes)
+    pos_oh = jax.nn.one_hot(seq_lens, S, dtype=cache_k.dtype)       # [b, S]
+    cache_k = cache_k * (1 - pos_oh[:, None, :, None]) + \
+        k_new[:, :, None, :] * pos_oh[:, None, :, None]
+    cache_v = cache_v * (1 - pos_oh[:, None, :, None]) + \
+        v_new[:, :, None, :] * pos_oh[:, None, :, None]
+
+    new_lens = seq_lens + 1
+    # attend q over cache[0:new_lens]
+    logits = jnp.einsum("bnd,bnsd->bns", q.astype(jnp.float32),
+                        cache_k.astype(jnp.float32)) * scale
+    mask = jnp.arange(S)[None, None, :] < new_lens[:, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bns,bnsd->bnd", p.astype(cache_v.dtype), cache_v)
+    return out, cache_k, cache_v, new_lens
+
+
+def append_to_block_cache(key_cache, value_cache, k, v, block_tables, seq_lens):
+    """Append one token's k/v into a paged cache.
+
+    key_cache/value_cache: [num_blocks, nh, block_size, hd]
+    k, v: [b, nh, hd];  block_tables: [b, max_blocks] int32 (-1 = unassigned);
+    seq_lens: [b] current lengths. Returns updated caches.
+    """
+    num_blocks, nh, bs, hd = key_cache.shape
+    b = k.shape[0]
+    blk_idx = seq_lens // bs                                  # logical block
+    blk_off = seq_lens % bs
+    phys = jnp.take_along_axis(block_tables, blk_idx[:, None], axis=1)[:, 0]
+    phys = jnp.maximum(phys, 0)
+
+    # scatter: for each batch elem, write k at [phys, :, blk_off, :]
+    def write_one(cache, vec):
+        def body(i, c):
+            return c.at[phys[i], :, blk_off[i], :].set(vec[i].astype(c.dtype))
+
+        return jax.lax.fori_loop(0, b, body, cache)
+
+    return write_one(key_cache, k), write_one(value_cache, v)
+
+
+def block_multihead_attention(q, key_cache, value_cache, block_tables,
+                              seq_lens, scale=None):
+    """PageAttention-style decode: q attends over a paged KV cache.
+
+    Args:
+      q: [b, nh, hd] one query token per sequence.
+      key_cache/value_cache: [num_blocks, nh, block_size, hd].
+      block_tables: [b, max_blocks] physical block ids (-1 for unused slots).
+      seq_lens: [b] valid KV length per sequence (incl. the just-appended token).
+
+    Returns out [b, nh, hd].
+    """
+    num_blocks, nh, bs, hd = key_cache.shape
+    b, _, _ = q.shape
+    max_blocks = block_tables.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+
+    safe_tables = jnp.maximum(block_tables, 0)
+    # gather per-sequence KV: [b, max_blocks, nh, bs, hd] -> [b, nh, S, hd]
+    k_seq = jnp.take(key_cache, safe_tables, axis=0)
+    v_seq = jnp.take(value_cache, safe_tables, axis=0)
+    S = max_blocks * bs
+    k_seq = k_seq.transpose(0, 2, 1, 3, 4).reshape(b, nh, S, hd)
+    v_seq = v_seq.transpose(0, 2, 1, 3, 4).reshape(b, nh, S, hd)
+
+    logits = jnp.einsum("bnd,bnsd->bns", q.astype(jnp.float32),
+                        k_seq.astype(jnp.float32)) * scale
+    mask = jnp.arange(S)[None, None, :] < seq_lens[:, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bns,bnsd->bnd", p.astype(v_seq.dtype), v_seq)
