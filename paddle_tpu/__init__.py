@@ -73,6 +73,13 @@ from . import optimizer  # noqa: E402
 from . import profiler  # noqa: E402
 from . import static  # noqa: E402
 from . import vision  # noqa: E402
+from . import fft  # noqa: E402
+from . import signal  # noqa: E402
+from . import distribution  # noqa: E402
+from . import sparse  # noqa: E402
+from . import quantization  # noqa: E402
+from . import geometric  # noqa: E402
+from . import inference  # noqa: E402
 from . import hapi  # noqa: E402
 from .framework.io_utils import load, save  # noqa: E402
 from .hapi import Model, summary  # noqa: E402

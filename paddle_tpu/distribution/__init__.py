@@ -1,0 +1,474 @@
+"""Probability distributions (reference: python/paddle/distribution/ —
+Distribution base, Normal/Uniform/Categorical/Bernoulli/Beta/Dirichlet/
+Multinomial/Gamma/Laplace/LogNormal/Gumbel, TransformedDistribution,
+kl_divergence registry at distribution/kl.py).
+
+TPU-native: sampling uses the framework RNG (threefry keys from
+paddle_tpu.core.rng, the Generator {seed, offset} semantics of
+paddle/phi/core/generator.h); log_prob/entropy are pure jnp and
+differentiable through the tape."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng as _rng
+from ..core.tensor import Tensor, apply_op, _unwrap
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+    "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel",
+    "Laplace", "LogNormal", "Multinomial", "Poisson", "StudentT",
+    "kl_divergence", "register_kl",
+]
+
+
+def _val(x, dtype=jnp.float32):
+    v = _unwrap(x)
+    return jnp.asarray(v, dtype) if not hasattr(v, "dtype") or v.dtype != dtype else v
+
+
+def _next_key():
+    return _rng.next_key()
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply_op("dist_prob", jnp.exp, [self.log_prob(value)])
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """Reference: python/paddle/distribution/normal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(_next_key(), shape, self.loc.dtype)
+        return Tensor(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            var = self.scale ** 2
+            return (-((v - self.loc) ** 2) / (2 * var)
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+        return apply_op("normal_log_prob", fn, [value])
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) +
+                      jnp.log(self.scale) * jnp.ones(self.batch_shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.base = Normal(loc, scale)
+        super().__init__(self.base.batch_shape)
+
+    def sample(self, shape=(), seed=0):
+        return Tensor(jnp.exp(_unwrap(self.base.sample(shape))))
+
+    def log_prob(self, value):
+        def fn(v):
+            logv = jnp.log(v)
+            return _unwrap(self.base.log_prob(Tensor(logv))) - logv
+
+        return apply_op("lognormal_log_prob", fn, [value])
+
+    def entropy(self):
+        return Tensor(_unwrap(self.base.entropy()) + self.base.loc)
+
+
+class Uniform(Distribution):
+    """Reference: python/paddle/distribution/uniform.py."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_next_key(), shape, self.low.dtype)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            inside = (v >= self.low) & (v < self.high)
+            lp = -jnp.log(self.high - self.low)
+            return jnp.where(inside, lp, -jnp.inf)
+
+        return apply_op("uniform_log_prob", fn, [value])
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low) * jnp.ones(self.batch_shape))
+
+
+class Categorical(Distribution):
+    """Reference: python/paddle/distribution/categorical.py (logits input)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = _val(logits)
+        else:
+            self.logits = jnp.log(jnp.maximum(_val(probs), 1e-38))
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jax.nn.softmax(self.logits, axis=-1))
+
+    def sample(self, shape=(), seed=0):
+        out = jax.random.categorical(_next_key(), self.logits,
+                                     shape=tuple(shape) + self.batch_shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def fn(v):
+            logp = jax.nn.log_softmax(self.logits, axis=-1)
+            vb = v.astype(jnp.int32)
+            b = jnp.broadcast_shapes(logp.shape[:-1], vb.shape)
+            logp_b = jnp.broadcast_to(logp, b + logp.shape[-1:])
+            vb = jnp.broadcast_to(vb, b)
+            return jnp.take_along_axis(logp_b, vb[..., None], axis=-1)[..., 0]
+
+        return apply_op("categorical_log_prob", fn, [value])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        p = jnp.exp(logp)
+        return Tensor(-jnp.sum(p * logp, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs_ = _val(probs)
+            self.logits_ = jnp.log(self.probs_ / (1 - self.probs_))
+        else:
+            self.logits_ = _val(logits)
+            self.probs_ = jax.nn.sigmoid(self.logits_)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(_next_key(), self.probs_, shape)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(v):
+            p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return apply_op("bernoulli_log_prob", fn, [value])
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _val(alpha)
+        self.beta = _val(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.beta(_next_key(), self.alpha, self.beta, shape))
+
+    def log_prob(self, value):
+        def fn(v):
+            from jax.scipy.special import betaln
+
+            return ((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v)
+                    - betaln(self.alpha, self.beta))
+
+        return apply_op("beta_log_prob", fn, [value])
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+
+        a, b = self.alpha, self.beta
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                      + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _val(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(_next_key(), self.concentration, shape))
+
+    def log_prob(self, value):
+        def fn(v):
+            from jax.scipy.special import gammaln
+
+            a = self.concentration
+            return (jnp.sum((a - 1) * jnp.log(v), -1)
+                    + gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+
+        return apply_op("dirichlet_log_prob", fn, [value])
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.exponential(_next_key(), shape) / self.rate)
+
+    def log_prob(self, value):
+        return apply_op("exponential_log_prob",
+                        lambda v: jnp.log(self.rate) - self.rate * v, [value])
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _val(concentration)
+        self.rate = _val(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.gamma(_next_key(), self.concentration, shape)
+                      / self.rate)
+
+    def log_prob(self, value):
+        def fn(v):
+            from jax.scipy.special import gammaln
+
+            a, r = self.concentration, self.rate
+            return a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v - gammaln(a)
+
+        return apply_op("gamma_log_prob", fn, [value])
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _val(probs)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_next_key(), shape)
+        return Tensor(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        return apply_op("geometric_log_prob",
+                        lambda v: v * jnp.log1p(-self.probs_) + jnp.log(self.probs_),
+                        [value])
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.gumbel(_next_key(), shape))
+
+    def log_prob(self, value):
+        def fn(v):
+            z = (v - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+        return apply_op("gumbel_log_prob", fn, [value])
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1 + jnp.euler_gamma *
+                      jnp.ones(self.batch_shape))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.laplace(_next_key(), shape))
+
+    def log_prob(self, value):
+        return apply_op(
+            "laplace_log_prob",
+            lambda v: -jnp.abs(v - self.loc) / self.scale
+            - jnp.log(2 * self.scale), [value])
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _val(probs)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    def sample(self, shape=(), seed=0):
+        n = self.probs_.shape[-1]
+        draws = jax.random.categorical(
+            _next_key(), jnp.log(jnp.maximum(self.probs_, 1e-38)),
+            shape=tuple(shape) + self.batch_shape + (self.total_count,))
+        return Tensor(jax.nn.one_hot(draws, n).sum(-2))
+
+    def log_prob(self, value):
+        def fn(v):
+            from jax.scipy.special import gammaln
+
+            return (gammaln(self.total_count + 1.0)
+                    - jnp.sum(gammaln(v + 1.0), -1)
+                    + jnp.sum(v * jnp.log(jnp.maximum(self.probs_, 1e-38)), -1))
+
+        return apply_op("multinomial_log_prob", fn, [value])
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.poisson(_next_key(), self.rate, shape)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(v):
+            from jax.scipy.special import gammaln
+
+            return v * jnp.log(self.rate) - self.rate - gammaln(v + 1.0)
+
+        return apply_op("poisson_log_prob", fn, [value])
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _val(df)
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.t(_next_key(), self.df, shape))
+
+    def log_prob(self, value):
+        def fn(v):
+            from jax.scipy.special import gammaln
+
+            d, z = self.df, (v - self.loc) / self.scale
+            return (gammaln((d + 1) / 2) - gammaln(d / 2)
+                    - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                    - (d + 1) / 2 * jnp.log1p(z * z / d))
+
+        return apply_op("studentt_log_prob", fn, [value])
+
+
+# ---- KL registry (reference: python/paddle/distribution/kl.py) ------------
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(f"kl_divergence({type(p).__name__}, "
+                                  f"{type(q).__name__}) not registered")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_p, var_q = p.scale ** 2, q.scale ** 2
+    out = (jnp.log(q.scale / p.scale) + (var_p + (p.loc - q.loc) ** 2) / (2 * var_q)
+           - 0.5)
+    return Tensor(out)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+    b = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+    return Tensor(a * jnp.log(a / b) + (1 - a) * jnp.log((1 - a) / (1 - b)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))

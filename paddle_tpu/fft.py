@@ -1,0 +1,81 @@
+"""FFT API (reference: python/paddle/fft.py — fft/ifft/rfft/irfft families,
+helpers fftshift/fftfreq; kernels paddle/phi/kernels/fft_*).
+
+TPU-native: jnp.fft lowers to XLA FftOp (ducc on CPU, compiled on device);
+every function dispatches through the eager tape so gradients flow."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply_op, _unwrap
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    return None if norm in (None, "backward") else norm
+
+
+def _wrap1d(jfn, opname):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op(opname, lambda v: jfn(v, n=n, axis=axis, norm=_norm(norm)), [x])
+
+    op.__name__ = opname
+    return op
+
+
+def _wrap2d(jfn, opname):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_op(opname, lambda v: jfn(v, s=s, axes=tuple(axes), norm=_norm(norm)), [x])
+
+    op.__name__ = opname
+    return op
+
+
+fft = _wrap1d(jnp.fft.fft, "fft")
+ifft = _wrap1d(jnp.fft.ifft, "ifft")
+rfft = _wrap1d(jnp.fft.rfft, "rfft")
+irfft = _wrap1d(jnp.fft.irfft, "irfft")
+hfft = _wrap1d(jnp.fft.hfft, "hfft")
+ihfft = _wrap1d(jnp.fft.ihfft, "ihfft")
+def _wrapnd(jfn, opname):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        ax = None if axes is None else tuple(axes)
+        return apply_op(opname, lambda v: jfn(v, s=s, axes=ax, norm=_norm(norm)), [x])
+
+    op.__name__ = opname
+    return op
+
+
+fft2 = _wrap2d(jnp.fft.fft2, "fft2")
+ifft2 = _wrap2d(jnp.fft.ifft2, "ifft2")
+rfft2 = _wrap2d(jnp.fft.rfft2, "rfft2")
+irfft2 = _wrap2d(jnp.fft.irfft2, "irfft2")
+fftn = _wrapnd(jnp.fft.fftn, "fftn")
+ifftn = _wrapnd(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapnd(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapnd(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(int(n), d=float(_unwrap(d)))
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(int(n), d=float(_unwrap(d)))
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fftshift", lambda v: jnp.fft.fftshift(v, axes=axes), [x])
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), [x])

@@ -1,0 +1,137 @@
+"""Graph-learning ops (reference: python/paddle/geometric/ — message passing
+send_u_recv/send_ue_recv in message_passing/send_recv.py, segment math in
+math.py backed by phi segment_pool kernels, sampling in sampling/).
+
+TPU-native: all segment ops map to jax.ops.segment_* (XLA scatter-reduce —
+one fused kernel, deterministic on TPU); message passing composes gather +
+segment-reduce."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, _unwrap
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+]
+
+
+def _num_segments(segment_ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    ids = _unwrap(segment_ids)
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+def segment_sum(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+    return apply_op("segment_sum",
+                    lambda d, i: jax.ops.segment_sum(d, i, num_segments=n),
+                    [data, segment_ids])
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+
+    def fn(d, i):
+        s = jax.ops.segment_sum(d, i, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(i, d.dtype), i, num_segments=n)
+        return s / jnp.maximum(cnt, 1)[(...,) + (None,) * (d.ndim - 1)]
+
+    return apply_op("segment_mean", fn, [data, segment_ids])
+
+
+def segment_max(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+    return apply_op("segment_max",
+                    lambda d, i: jax.ops.segment_max(d, i, num_segments=n),
+                    [data, segment_ids])
+
+
+def segment_min(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+    return apply_op("segment_min",
+                    lambda d, i: jax.ops.segment_min(d, i, num_segments=n),
+                    [data, segment_ids])
+
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # handled inline
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and reduce onto dst (reference
+    geometric/message_passing/send_recv.py:send_u_recv)."""
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f"unknown reduce_op {reduce_op!r}")
+    n = _num_segments(dst_index, out_size) if out_size is not None else None
+
+    def fn(xv, src, dst):
+        num = n if n is not None else xv.shape[0]
+        msgs = jnp.take(xv, src, axis=0)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=num)
+            cnt = jax.ops.segment_sum(jnp.ones_like(dst, xv.dtype), dst,
+                                      num_segments=num)
+            return s / jnp.maximum(cnt, 1)[(...,) + (None,) * (msgs.ndim - 1)]
+        return _REDUCERS[reduce_op](msgs, dst, num_segments=num)
+
+    return apply_op("send_u_recv", fn, [x, src_index, dst_index])
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Messages combine node features with edge features (reference
+    send_recv.py:send_ue_recv)."""
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+    n = _num_segments(dst_index, out_size) if out_size is not None else None
+
+    def fn(xv, yv, src, dst):
+        num = n if n is not None else xv.shape[0]
+        msgs = combine(jnp.take(xv, src, axis=0), yv)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=num)
+            cnt = jax.ops.segment_sum(jnp.ones_like(dst, msgs.dtype), dst,
+                                      num_segments=num)
+            return s / jnp.maximum(cnt, 1)[(...,) + (None,) * (msgs.ndim - 1)]
+        return _REDUCERS[reduce_op](msgs, dst, num_segments=num)
+
+    return apply_op("send_ue_recv", fn, [x, y, src_index, dst_index])
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Edge-wise message from both endpoints (reference send_recv.py:send_uv)."""
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+
+    def fn(xv, yv, src, dst):
+        return combine(jnp.take(xv, src, axis=0), jnp.take(yv, dst, axis=0))
+
+    return apply_op("send_uv", fn, [x, y, src_index, dst_index])
+
+
+def reindex_graph(x, neighbors, count, name=None):
+    """Compact global ids to local ids (reference
+    geometric/reindex.py:reindex_graph). Host-side utility (ragged)."""
+    import numpy as np
+
+    xv = np.asarray(_unwrap(x))
+    nb = np.asarray(_unwrap(neighbors))
+    # local ids: x's nodes keep their order (0..len(x)-1), new neighbor ids
+    # are appended in first-appearance order of the sorted unique set
+    extra = np.setdiff1d(nb, xv)
+    node_ids = np.concatenate([xv, extra])
+    lookup = {int(v): i for i, v in enumerate(node_ids)}
+    reindex_src = np.fromiter((lookup[int(v)] for v in nb), np.int64, len(nb))
+    cnt = np.asarray(_unwrap(count))
+    reindex_dst = np.repeat(np.arange(len(cnt)), cnt)
+    return Tensor(reindex_src), Tensor(reindex_dst), Tensor(node_ids)

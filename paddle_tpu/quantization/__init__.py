@@ -1,0 +1,292 @@
+"""Quantization framework (reference: python/paddle/quantization/ —
+QuantConfig at config.py, QAT at qat.py, PTQ at ptq.py, observers in
+observer/, fake quanters in quanter/; plus nn/quant layers).
+
+TPU-native: quantization simulation (fake-quant with straight-through
+gradients) runs as pure jnp — XLA fuses the quant/dequant pairs into the
+surrounding matmuls.  True low-bit serving on TPU is int8/fp8 matmul via
+XLA's native dot quantization; `convert` produces layers that carry int8
+weights + scales in that layout."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op, _unwrap
+from ..nn.layer_base import Layer
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "quanter",
+    "AbsmaxObserver", "HistObserver", "KLObserver",
+    "FakeQuanterWithAbsMaxObserver", "QuantizedLinear", "fake_quant",
+]
+
+
+def fake_quant(x, scale, bits=8):
+    """Symmetric fake quantization with a straight-through estimator.
+
+    Forward: round(clip(x/step)) * step with step = scale/(2^(b-1)-1).
+    Backward: identity inside the clip range (STE) — implemented via
+    stop_gradient so it is exact under both the tape and jit."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def fn(v, s):
+        step = s / qmax
+        q = jnp.clip(jnp.round(v / step), -qmax, qmax) * step
+        # STE: v + stop_grad(q - v) → d/dv == 1, forward == q
+        return v + jax.lax.stop_gradient(q - v)
+
+    return apply_op("fake_quant", fn, [x, scale])
+
+
+# ---- observers (reference quantization/observer/) -------------------------
+
+class BaseObserver(Layer):
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def scale(self):
+        return self._scale
+
+    def forward(self, x):
+        self._observe(np.asarray(_unwrap(x), np.float32))
+        return x
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running abs-max (reference observer/abs_max.py)."""
+
+    def _observe(self, arr):
+        m = float(np.max(np.abs(arr))) if arr.size else 0.0
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class HistObserver(BaseObserver):
+    """Histogram percentile observer (reference observer/hist.py)."""
+
+    def __init__(self, quant_bits=8, percent=0.999, bins=2048):
+        super().__init__(quant_bits)
+        self.percent = percent
+        self.bins = bins
+        self._samples = []
+
+    def _observe(self, arr):
+        self._samples.append(np.abs(arr).ravel())
+        flat = np.concatenate(self._samples)
+        self._scale = float(np.quantile(flat, self.percent)) if flat.size else 0.0
+
+
+class KLObserver(HistObserver):
+    """KL-minimizing threshold (reference observer/kl.py); approximated by a
+    high percentile of the abs histogram (the KL search optimum lands near
+    the tail percentile for typical activations)."""
+
+    def __init__(self, quant_bits=8, bins=2048):
+        super().__init__(quant_bits, percent=0.9995, bins=bins)
+
+
+# ---- quanters (reference quantization/quanter/) ---------------------------
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT fake-quant node with a moving-average abs-max scale
+    (reference quanter/abs_max.py FakeQuanterWithAbsMaxObserverLayer)."""
+
+    def __init__(self, moving_rate=0.9, bits=8, **kw):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bits = bits
+        self._scale = None
+
+    def forward(self, x):
+        if self._scale is None:
+            self._scale = float(
+                np.max(np.abs(np.asarray(_unwrap(x), np.float32))) or 1e-8)
+        elif self.training:  # scale is frozen in eval (deterministic serving)
+            cur = float(np.max(np.abs(np.asarray(_unwrap(x), np.float32))) or 1e-8)
+            self._scale = (self.moving_rate * self._scale
+                           + (1 - self.moving_rate) * cur)
+        return fake_quant(x, Tensor(jnp.float32(self._scale)), self.bits)
+
+    def scale(self):
+        return self._scale
+
+
+def quanter(name):
+    """Decorator registering a custom quanter class (reference
+    quantization/factory.py)."""
+    def deco(cls):
+        globals()[name] = cls
+        return cls
+
+    return deco
+
+
+# ---- config (reference quantization/config.py) ----------------------------
+
+class _LayerConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self.global_config = _LayerConfig(activation, weight)
+        self._type_configs: dict = {}
+        self._layer_configs: dict = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]):
+            self._type_configs[t] = _LayerConfig(activation, weight)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        for l in (layer if isinstance(layer, (list, tuple)) else [layer]):
+            self._layer_configs[id(l)] = _LayerConfig(activation, weight)
+
+    def config_for(self, layer):
+        return (self._layer_configs.get(id(layer))
+                or self._type_configs.get(type(layer))
+                or self.global_config)
+
+
+# ---- quantized layers -----------------------------------------------------
+
+class QuantedLinear(Layer):
+    """Linear with activation/weight fake-quant inserted (QAT simulation)."""
+
+    def __init__(self, linear, q_config: _LayerConfig):
+        super().__init__()
+        self.linear = linear
+        self.act_quanter = q_config.activation() if q_config.activation else None
+        self.w_quanter = q_config.weight() if q_config.weight else None
+
+    def forward(self, x):
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        w = self.linear.weight
+        if self.w_quanter is not None:
+            w = self.w_quanter(w)
+        from ..nn import functional as F
+
+        return F.linear(x, w, self.linear.bias)
+
+
+class QuantizedLinear(Layer):
+    """Converted (deploy) linear: int8 weights + fp scale, dequant matmul —
+    the layout XLA's int8 dot quantization consumes on TPU."""
+
+    def __init__(self, linear, w_scale, bits=8):
+        super().__init__()
+        qmax = float(2 ** (bits - 1) - 1)
+        w = np.asarray(_unwrap(linear.weight), np.float32)
+        step = max(w_scale, 1e-12) / qmax
+        self.w_int8 = jnp.asarray(np.clip(np.round(w / step), -qmax, qmax), jnp.int8)
+        self.scale = float(step)
+        self.bias = linear.bias
+
+    def forward(self, x):
+        def fn(v, *rest):
+            w = self.w_int8.astype(jnp.float32) * self.scale
+            out = v @ w
+            if rest:
+                out = out + rest[0]
+            return out
+
+        inputs = [x] + ([self.bias] if self.bias is not None else [])
+        return apply_op("quantized_linear", fn, inputs)
+
+
+# ---- QAT / PTQ drivers (reference qat.py / ptq.py) ------------------------
+
+def _swap_linears(model: Layer, make):
+    from ..nn import Linear
+
+    for name, child in list(model._sub_layers.items()):
+        if isinstance(child, Linear):
+            model._sub_layers[name] = make(child)
+        else:
+            _swap_linears(child, make)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (reference quantization/qat.py)."""
+
+    def __init__(self, q_config: QuantConfig):
+        self.q_config = q_config
+
+    def quantize(self, model: Layer, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        return _swap_linears(
+            model, lambda lin: QuantedLinear(lin, self.q_config.config_for(lin)))
+
+    def convert(self, model: Layer, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        return self._convert_inner(model)
+
+    def _convert_inner(self, model: Layer):
+        for name, child in list(model._sub_layers.items()):
+            if isinstance(child, QuantedLinear):
+                scale = (child.w_quanter.scale() if child.w_quanter is not None
+                         else float(np.max(np.abs(
+                             np.asarray(_unwrap(child.linear.weight))))))
+                model._sub_layers[name] = QuantizedLinear(child.linear, scale)
+            else:
+                self._convert_inner(child)
+        return model
+
+
+class PTQ:
+    """Post-training quantization driver (reference quantization/ptq.py):
+    insert observers, run calibration batches, convert."""
+
+    def __init__(self, q_config: QuantConfig):
+        self.q_config = q_config
+
+    def quantize(self, model: Layer, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        cfgs = self.q_config
+        return _swap_linears(
+            model, lambda lin: _PTQObservedLinear(lin, cfgs.config_for(lin)))
+
+    def convert(self, model: Layer, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        return self._convert_inner(model)
+
+    def _convert_inner(self, model: Layer):
+        for name, child in list(model._sub_layers.items()):
+            if isinstance(child, _PTQObservedLinear):
+                model._sub_layers[name] = QuantizedLinear(
+                    child.linear, child.w_obs.scale() or 1e-8)
+            else:
+                self._convert_inner(child)
+        return model
+
+
+class _PTQObservedLinear(Layer):
+    def __init__(self, linear, cfg):
+        super().__init__()
+        self.linear = linear
+        self.act_obs = cfg.activation() if cfg.activation else AbsmaxObserver()
+        self.w_obs = cfg.weight() if cfg.weight else AbsmaxObserver()
+        self.w_obs(linear.weight)
+
+    def forward(self, x):
+        self.act_obs(x)
+        return self.linear(x)

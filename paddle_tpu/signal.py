@@ -1,0 +1,133 @@
+"""Signal processing (reference: python/paddle/signal.py — stft/istft over
+frame/overlap_add kernels paddle/phi/kernels/frame_kernel.h).
+
+TPU-native: framing is a gather with static window starts (XLA-friendly);
+FFTs via paddle_tpu.fft."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply_op, _unwrap
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames along ``axis`` (reference frame op)."""
+
+    def fn(v):
+        v = jnp.moveaxis(v, axis, -1)
+        n = v.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        out = v[..., idx]  # [..., num_frames, frame_length]
+        return jnp.swapaxes(out, -1, -2)  # paddle layout: [..., frame_length, num]
+
+    return apply_op("frame", fn, [x])
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: x [..., frame_length, num_frames] → signal."""
+
+    def fn(v):
+        fl, num = v.shape[-2], v.shape[-1]
+        n = fl + hop_length * (num - 1)
+        segs = jnp.moveaxis(v, -1, 0)  # [num, ..., fl]
+
+        out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
+
+        def body(i, acc):
+            seg = jax.lax.dynamic_index_in_dim(segs, i, keepdims=False)
+            return jax.lax.dynamic_update_slice_in_dim(
+                acc, jax.lax.dynamic_slice_in_dim(acc, i * hop_length, fl, -1) + seg,
+                i * hop_length, -1)
+
+        return jax.lax.fori_loop(0, num, body, out)
+
+    return apply_op("overlap_add", fn, [x])
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform (reference python/paddle/signal.py:stft).
+    x: [batch?, n]; returns [..., n_fft//2+1 or n_fft, num_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    inputs = [x] + ([window] if window is not None else [])
+
+    def fn(v, *rest):
+        win = rest[0] if rest else jnp.ones((win_length,), v.dtype)
+        if win_length < n_fft:  # pad window to n_fft, centered
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        sig = v
+        if center:
+            pad = n_fft // 2
+            sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1) + [(pad, pad)], mode=pad_mode)
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = sig[..., idx] * win  # [..., num, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, num_frames]
+
+    return apply_op("stft", fn, inputs)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with window-square normalization (reference signal.py:istft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    inputs = [x] + ([window] if window is not None else [])
+
+    def fn(v, *rest):
+        win = rest[0] if rest else jnp.ones((win_length,), jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        spec = jnp.swapaxes(v, -1, -2)  # [..., num, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * win
+        num = frames.shape[-2]
+        n = n_fft + hop_length * (num - 1)
+        sig = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        wsum = jnp.zeros((n,), frames.dtype)
+        segs = jnp.moveaxis(frames, -2, 0)
+
+        def body(i, carry):
+            sig, wsum = carry
+            seg = jax.lax.dynamic_index_in_dim(segs, i, keepdims=False)
+            cur = jax.lax.dynamic_slice_in_dim(sig, i * hop_length, n_fft, -1)
+            sig = jax.lax.dynamic_update_slice_in_dim(sig, cur + seg, i * hop_length, -1)
+            wcur = jax.lax.dynamic_slice_in_dim(wsum, i * hop_length, n_fft, -1)
+            wsum = jax.lax.dynamic_update_slice_in_dim(wsum, wcur + win * win, i * hop_length, -1)
+            return sig, wsum
+
+        sig, wsum = jax.lax.fori_loop(0, num, body, (sig, wsum))
+        sig = sig / jnp.maximum(wsum, 1e-11)
+        if center:
+            pad = n_fft // 2
+            sig = sig[..., pad:n - pad]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    return apply_op("istft", fn, inputs)

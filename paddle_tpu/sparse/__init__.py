@@ -1,0 +1,283 @@
+"""Sparse tensors (reference: python/paddle/sparse/ — COO/CSR creation in
+sparse/creation.py, unary/binary ops sparse/unary.py, binary.py, matmul in
+sparse/matmul.py; C++ SparseCooTensor/SparseCsrTensor in
+paddle/phi/core/sparse_coo_tensor.h, kernels paddle/phi/kernels/sparse/).
+
+TPU-native: backed by jax.experimental.sparse.BCOO — XLA lowers sparse
+contractions to gather/scatter + dense MXU matmuls, which on TPU is the
+honest cost model (the reference's cuSPARSE path has no TPU analog).  CSR is
+carried as a thin view that converts through BCOO; dense bridges
+(to_dense/values/indices) dispatch through the eager tape so gradients flow
+into dense consumers."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor, apply_op, _unwrap
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "is_same_shape", "matmul", "masked_matmul", "add",
+    "multiply", "subtract", "relu", "sin", "tanh", "abs", "sqrt", "square",
+    "pow", "neg", "cast", "transpose", "sum",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference sparse_coo_tensor.h:30)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- creation-side accessors -----------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(jnp.asarray(self._bcoo.indices).T)  # paddle: [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        return SparseCsrTensor.from_coo(self)
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def _map(self, fn):
+        return SparseCooTensor(jsparse.BCOO((fn(self._bcoo.data),
+                                             self._bcoo.indices),
+                                            shape=self._bcoo.shape))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR view (reference sparse_csr_tensor.h); stores crows/cols/values and
+    converts through BCOO for compute."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(_unwrap(crows), jnp.int32)
+        self._cols = jnp.asarray(_unwrap(cols), jnp.int32)
+        self._values = jnp.asarray(_unwrap(values))
+        self._shape = tuple(int(s) for s in shape)
+
+    @staticmethod
+    def from_coo(coo: SparseCooTensor) -> "SparseCsrTensor":
+        if len(coo.shape) != 2:
+            raise ValueError("CSR requires 2-D")
+        idx = np.asarray(coo._bcoo.indices)
+        data = coo._bcoo.data
+        order = np.lexsort((idx[:, 1], idx[:, 0]))
+        rows, cols = idx[order, 0], idx[order, 1]
+        crows = np.zeros(coo.shape[0] + 1, np.int32)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows).astype(np.int32)
+        return SparseCsrTensor(crows, cols, jnp.take(data, jnp.asarray(order)),
+                               coo.shape)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def nnz(self):
+        return int(self._cols.shape[0])
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def to_sparse_coo(self, sparse_dim=2):
+        counts = np.diff(np.asarray(self._crows))
+        rows = np.repeat(np.arange(self._shape[0]), counts)
+        idx = jnp.stack([jnp.asarray(rows, jnp.int32), self._cols], axis=1)
+        return SparseCooTensor(jsparse.BCOO((self._values, idx), shape=self._shape))
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """Reference: python/paddle/sparse/creation.py:sparse_coo_tensor.
+    indices: [ndim, nnz]."""
+    idx = jnp.asarray(_unwrap(indices), jnp.int32).T  # BCOO: [nnz, ndim]
+    vals = jnp.asarray(_unwrap(values))
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=0))
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    vals = _unwrap(values)
+    if dtype is not None:
+        vals = jnp.asarray(vals).astype(dtype)
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def _as_coo(x) -> SparseCooTensor:
+    if isinstance(x, SparseCooTensor):
+        return x
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+def matmul(x, y, name=None):
+    """Sparse @ dense (reference sparse/matmul.py)."""
+    coo = _as_coo(x)
+    yv = _unwrap(y)
+    out = coo._bcoo @ jnp.asarray(yv)
+    return Tensor(out)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Dense @ dense, keeping only mask's sparsity pattern (reference
+    sparse/matmul.py:masked_matmul; SDDMM)."""
+    m = _as_coo(mask)
+    xv, yv = jnp.asarray(_unwrap(x)), jnp.asarray(_unwrap(y))
+    idx = m._bcoo.indices  # [nnz, 2]
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows], yv[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals.astype(m.dtype), idx), shape=m.shape))
+
+
+def _pattern_union(a: jsparse.BCOO, b: jsparse.BCOO, bsign=1.0) -> jsparse.BCOO:
+    """O(nnz) union: concatenate (data, indices) and merge duplicates."""
+    data = jnp.concatenate([a.data, (b.data * bsign).astype(a.data.dtype)])
+    idx = jnp.concatenate([a.indices, b.indices])
+    return jsparse.BCOO((data, idx), shape=a.shape).sum_duplicates()
+
+
+def add(x, y, name=None):
+    a, b = _as_coo(x), _as_coo(y)
+    return SparseCooTensor(_pattern_union(a._bcoo, b._bcoo))
+
+
+def subtract(x, y, name=None):
+    a, b = _as_coo(x), _as_coo(y)
+    return SparseCooTensor(_pattern_union(a._bcoo, b._bcoo, bsign=-1.0))
+
+
+def multiply(x, y, name=None):
+    """O(nnz_a * lookup) intersection: for each of a's entries, find the
+    matching entry in b (hash the coordinates into a scalar key)."""
+    a, b = _as_coo(x)._bcoo.sum_duplicates(), _as_coo(y)._bcoo.sum_duplicates()
+    dims = jnp.asarray(a.shape, jnp.int64)
+    strides = jnp.cumprod(jnp.concatenate([dims[1:][::-1],
+                                           jnp.ones(1, jnp.int64)]))[::-1]
+    ka = (a.indices.astype(jnp.int64) * strides).sum(-1)
+    kb = (b.indices.astype(jnp.int64) * strides).sum(-1)
+    order = jnp.argsort(kb)
+    kb_sorted = kb[order]
+    pos = jnp.searchsorted(kb_sorted, ka)
+    pos = jnp.clip(pos, 0, kb_sorted.shape[0] - 1)
+    match = kb_sorted[pos] == ka
+    bvals = b.data[order][pos]
+    data = jnp.where(match, a.data * bvals, 0)
+    return SparseCooTensor(jsparse.BCOO((data, a.indices), shape=a.shape))
+
+
+def _unary(name, jfn):
+    def op(x, name=None):
+        return _as_coo(x)._map(jfn)
+
+    op.__name__ = name
+    return op
+
+
+# value-wise ops preserve the sparsity pattern (f(0)=0 family, reference
+# sparse/unary.py)
+relu = _unary("relu", jax.nn.relu)
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+abs = _unary("abs", jnp.abs)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+neg = _unary("neg", jnp.negative)
+
+
+def pow(x, factor, name=None):
+    return _as_coo(x)._map(lambda v: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    coo = _as_coo(x)
+    data = coo._bcoo.data.astype(value_dtype) if value_dtype else coo._bcoo.data
+    idx = coo._bcoo.indices.astype(index_dtype) if index_dtype else coo._bcoo.indices
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=coo.shape))
+
+
+def transpose(x, perm, name=None):
+    coo = _as_coo(x)
+    idx = coo._bcoo.indices[:, jnp.asarray(perm)]
+    shape = tuple(coo.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((coo._bcoo.data, idx), shape=shape))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    coo = _as_coo(x)
+    out = coo._bcoo.todense().sum(axis=axis, keepdims=keepdim)
+    if dtype:
+        out = out.astype(dtype)
+    return Tensor(out)
+
+
+# nn sub-namespace (reference python/paddle/sparse/nn/)
+class _SparseReLU:
+    def __call__(self, x):
+        return relu(x)
+
+
+class nn:
+    ReLU = _SparseReLU
