@@ -50,6 +50,7 @@ from .ops.creation import *  # noqa: F401,F403
 from .ops.linalg import *  # noqa: F401,F403
 from .ops.manipulation import *  # noqa: F401,F403
 from .ops.math import *  # noqa: F401,F403
+from .ops.extras import *  # noqa: F401,F403
 
 # re-export every registered op by name (covers the _unary/_binary generated ones)
 from .ops.registry import OPS as _OPS

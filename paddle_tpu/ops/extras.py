@@ -1,0 +1,432 @@
+"""Long-tail tensor ops (reference: scattered across python/paddle/tensor/
+math.py, manipulation.py, creation.py — the op families not yet covered by
+ops/math.py, ops/manipulation.py, ops/linalg.py, ops/creation.py).
+
+Same design as the other ops modules: every op is a pure jnp composition
+dispatched through the eager tape (apply_op) so gradients and jit both work."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op, _unwrap
+from .registry import register_op
+
+__all__ = [
+    "take", "renorm", "trapezoid", "cumulative_trapezoid", "nanmedian",
+    "nanquantile", "vander", "unflatten", "tensor_split", "hsplit", "vsplit",
+    "dsplit", "column_stack", "row_stack", "dstack", "atleast_1d",
+    "atleast_2d", "atleast_3d", "polar", "ldexp", "frexp", "sgn", "isposinf",
+    "isneginf", "isreal", "iscomplex", "isin", "bitwise_left_shift",
+    "bitwise_right_shift", "block_diag", "cartesian_prod", "cdist", "cummin",
+    "histogramdd", "index_fill", "masked_scatter", "float_power", "gammaln",
+    "gammainc", "gammaincc", "positive", "negative", "slice_scatter",
+    "select_scatter", "reduce_as", "sinc", "log_normal", "crop",
+]
+
+
+def _reg(name, method=None):
+    def deco(fn):
+        register_op(name, tensor_method=method)(fn)
+        return fn
+
+    return deco
+
+
+@_reg("take", method="take")
+def take(x, index, mode="raise", name=None):
+    """Flattened gather (reference tensor/math.py:take)."""
+    def fn(v, i):
+        flat = v.reshape(-1)
+        n = flat.shape[0]
+        i = i.astype(jnp.int32)
+        if mode == "wrap":
+            i = jnp.mod(i, n)
+        elif mode == "clip":
+            # reference math.py:6938 — clip to [0, n-1], negative indexing off
+            i = jnp.clip(i, 0, n - 1)
+        else:
+            i = jnp.where(i < 0, i + n, i)
+        return jnp.take(flat, i)
+
+    return apply_op("take", fn, [x, index])
+
+
+@_reg("renorm")
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along axis (reference math.py:renorm)."""
+    def fn(v):
+        dims = tuple(d for d in range(v.ndim) if d != axis % v.ndim)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+
+    return apply_op("renorm", fn, [x])
+
+
+@_reg("trapezoid")
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    inputs = [y] + ([x] if x is not None else [])
+
+    def fn(yv, *rest):
+        if rest:
+            return jnp.trapezoid(yv, rest[0], axis=axis)
+        return jnp.trapezoid(yv, dx=dx if dx is not None else 1.0, axis=axis)
+
+    return apply_op("trapezoid", fn, inputs)
+
+
+@_reg("cumulative_trapezoid")
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    inputs = [y] + ([x] if x is not None else [])
+
+    def fn(yv, *rest):
+        yv = jnp.moveaxis(yv, axis, -1)
+        avg = (yv[..., 1:] + yv[..., :-1]) / 2
+        if rest:
+            xv = jnp.moveaxis(rest[0], axis, -1) if rest[0].ndim else rest[0]
+            d = jnp.diff(xv, axis=-1)
+        else:
+            d = dx if dx is not None else 1.0
+        return jnp.moveaxis(jnp.cumsum(avg * d, axis=-1), -1, axis)
+
+    return apply_op("cumulative_trapezoid", fn, inputs)
+
+
+@_reg("nanmedian")
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op("nanmedian",
+                    lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim), [x])
+
+
+@_reg("nanquantile")
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op(
+        "nanquantile",
+        lambda v: jnp.nanquantile(v, q, axis=axis, keepdims=keepdim), [x])
+
+
+@_reg("vander")
+def vander(x, n=None, increasing=False, name=None):
+    return apply_op("vander",
+                    lambda v: jnp.vander(v, N=n, increasing=increasing), [x])
+
+
+@_reg("unflatten", method="unflatten")
+def unflatten(x, axis, shape, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        shp = tuple(_unwrap(s) if isinstance(s, Tensor) else int(s) for s in shape)
+        return v.reshape(v.shape[:ax] + tuple(int(s) for s in shp) + v.shape[ax + 1:])
+
+    return apply_op("unflatten", fn, [x])
+
+
+def _split_family(name, jfn):
+    def op(x, num_or_indices, name=None):
+        out = apply_op(
+            name, lambda v: tuple(jfn(v, num_or_indices)), [x])
+        return list(out) if isinstance(out, tuple) else [out]
+
+    op.__name__ = name
+    return op
+
+
+tensor_split = _split_family("tensor_split", lambda v, s: jnp.array_split(v, s))
+hsplit = _split_family("hsplit", jnp.hsplit)
+vsplit = _split_family("vsplit", jnp.vsplit)
+dsplit = _split_family("dsplit", jnp.dsplit)
+
+
+def column_stack(x, name=None):
+    return apply_op("column_stack", lambda *vs: jnp.column_stack(vs), list(x))
+
+
+def row_stack(x, name=None):
+    return apply_op("row_stack", lambda *vs: jnp.vstack(vs), list(x))
+
+
+def dstack(x, name=None):
+    return apply_op("dstack", lambda *vs: jnp.dstack(vs), list(x))
+
+
+def _atleast(name, jfn):
+    def op(*inputs, name=None):
+        outs = [apply_op(name, jfn, [t]) for t in inputs]
+        return outs[0] if len(outs) == 1 else outs
+
+    op.__name__ = name
+    return op
+
+
+atleast_1d = _atleast("atleast_1d", jnp.atleast_1d)
+atleast_2d = _atleast("atleast_2d", jnp.atleast_2d)
+atleast_3d = _atleast("atleast_3d", jnp.atleast_3d)
+
+
+@_reg("polar")
+def polar(abs, angle, name=None):
+    return apply_op("polar",
+                    lambda a, t: a * jnp.exp(1j * t.astype(jnp.complex64)),
+                    [abs, angle])
+
+
+@_reg("ldexp")
+def ldexp(x, y, name=None):
+    return apply_op("ldexp", lambda a, b: a * (2.0 ** b.astype(jnp.float32)),
+                    [x, y])
+
+
+@_reg("frexp")
+def frexp(x, name=None):
+    return apply_op("frexp", lambda v: jnp.frexp(v), [x], n_outputs=2)
+
+
+@_reg("sgn", method="sgn")
+def sgn(x, name=None):
+    def fn(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.maximum(mag, 1e-38))
+        return jnp.sign(v)
+
+    return apply_op("sgn", fn, [x])
+
+
+@_reg("isposinf")
+def isposinf(x, name=None):
+    return apply_op("isposinf", jnp.isposinf, [x])
+
+
+@_reg("isneginf")
+def isneginf(x, name=None):
+    return apply_op("isneginf", jnp.isneginf, [x])
+
+
+@_reg("isreal")
+def isreal(x, name=None):
+    return apply_op("isreal", jnp.isreal, [x])
+
+
+def iscomplex(x, name=None):
+    return apply_op("iscomplex", jnp.iscomplex, [x])
+
+
+@_reg("isin")
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply_op("isin",
+                    lambda a, b: jnp.isin(a, b, invert=invert), [x, test_x])
+
+
+@_reg("bitwise_left_shift")
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    return apply_op("bitwise_left_shift", jnp.left_shift, [x, y])
+
+
+@_reg("bitwise_right_shift")
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    def fn(a, b):
+        if is_arithmetic or jnp.issubdtype(a.dtype, jnp.signedinteger):
+            return jnp.right_shift(a, b)
+        return jax.lax.shift_right_logical(a, b.astype(a.dtype))
+
+    return apply_op("bitwise_right_shift", fn, [x, y])
+
+
+def block_diag(inputs, name=None):
+    return apply_op("block_diag",
+                    lambda *vs: jax.scipy.linalg.block_diag(*vs), list(inputs))
+
+
+def cartesian_prod(x, name=None):
+    def fn(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return apply_op("cartesian_prod", fn, list(x))
+
+
+@_reg("cdist")
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise distance (reference tensor/linalg.py:cdist).  p=2 uses the
+    matmul expansion (MXU-friendly)."""
+    def fn(a, b):
+        if p == 2.0:
+            a2 = jnp.sum(a * a, -1, keepdims=True)
+            b2 = jnp.sum(b * b, -1, keepdims=True)
+            d2 = a2 + jnp.swapaxes(b2, -1, -2) - 2 * (a @ jnp.swapaxes(b, -1, -2))
+            # grad-safe sqrt: subgradient 0 at d2==0 (self-distances) instead
+            # of the inf that sqrt'(0) produces
+            pos = d2 > 0
+            return jnp.where(pos, jnp.sqrt(jnp.where(pos, d2, 1.0)), 0.0)
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        return jnp.sum(diff ** p, -1) ** (1.0 / p)
+
+    return apply_op("cdist", fn, [x, y])
+
+
+@_reg("cummin", method="cummin")
+def cummin(x, axis=None, dtype="int64", name=None):
+    """Returns (values, indices) like the reference cummin."""
+    def fn(v):
+        ax = 0 if axis is None else axis
+        vv = v.reshape(-1) if axis is None else v
+        n = vv.shape[ax]
+        ar = jnp.broadcast_to(
+            jnp.arange(n).reshape([-1 if i == ax % vv.ndim else 1
+                                   for i in range(vv.ndim)]), vv.shape)
+
+        # pairwise argmin combiner: keep the earlier index on ties
+        def comb(a, b):
+            (va, ia), (vb, ib) = a, b
+            takea = va <= vb
+            return jnp.where(takea, va, vb), jnp.where(takea, ia, ib)
+
+        vals, inds = jax.lax.associative_scan(comb, (vv, ar), axis=ax)
+        return vals, inds.astype(jnp.int64)
+
+    return apply_op("cummin", fn, [x], n_outputs=2)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    xv = np.asarray(_unwrap(x))
+    wv = np.asarray(_unwrap(weights)) if weights is not None else None
+    hist, edges = np.histogramdd(xv, bins=bins, range=ranges, density=density,
+                                 weights=wv)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+@_reg("index_fill", method="index_fill")
+def index_fill(x, index, axis, value, name=None):
+    value_is_tensor = isinstance(value, Tensor)
+    inputs = [x, index] + ([value] if value_is_tensor else [])
+
+    def fn(v, i, *rest):
+        val = rest[0] if rest else jnp.asarray(value, v.dtype)
+        ax = axis % v.ndim
+        mask_shape = [1] * v.ndim
+        mask_shape[ax] = v.shape[ax]
+        mask = jnp.zeros((v.shape[ax],), bool).at[i].set(True)
+        return jnp.where(mask.reshape(mask_shape), val.astype(v.dtype), v)
+
+    return apply_op("index_fill", fn, inputs)
+
+
+@_reg("masked_scatter", method="masked_scatter")
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions with consecutive values (reference
+    manipulation.py:masked_scatter)."""
+    def fn(v, m, val):
+        m = jnp.broadcast_to(m, v.shape)
+        flatm = m.reshape(-1)
+        # k-th True position takes value[k]
+        pos = jnp.cumsum(flatm.astype(jnp.int32)) - 1
+        picked = jnp.take(val.reshape(-1), jnp.clip(pos, 0, val.size - 1))
+        return jnp.where(flatm, picked, v.reshape(-1)).reshape(v.shape)
+
+    return apply_op("masked_scatter", fn, [x, mask, value])
+
+
+@_reg("float_power")
+def float_power(x, y, name=None):
+    return apply_op("float_power",
+                    lambda a, b: jnp.power(a.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32),
+                                           b), [x, y])
+
+
+@_reg("gammaln", method="gammaln")
+def gammaln(x, name=None):
+    from jax.scipy.special import gammaln as _g
+
+    return apply_op("gammaln", _g, [x])
+
+
+@_reg("gammainc")
+def gammainc(x, y, name=None):
+    from jax.scipy.special import gammainc as _g
+
+    return apply_op("gammainc", _g, [x, y])
+
+
+@_reg("gammaincc")
+def gammaincc(x, y, name=None):
+    from jax.scipy.special import gammaincc as _g
+
+    return apply_op("gammaincc", _g, [x, y])
+
+
+def positive(x, name=None):
+    return apply_op("positive", lambda v: +v, [x])
+
+
+def negative(x, name=None):
+    return apply_op("negative", jnp.negative, [x])
+
+
+@_reg("slice_scatter")
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def fn(v, val):
+        idx = [slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = slice(int(st), int(en), int(sd))
+        return v.at[tuple(idx)].set(val)
+
+    return apply_op("slice_scatter", fn, [x, value])
+
+
+@_reg("select_scatter")
+def select_scatter(x, value, axis, index, name=None):
+    def fn(v, val):
+        idx = [slice(None)] * v.ndim
+        idx[axis % v.ndim] = int(index)
+        return v.at[tuple(idx)].set(val)
+
+    return apply_op("select_scatter", fn, [x, value])
+
+
+@_reg("reduce_as")
+def reduce_as(x, target, name=None):
+    """Sum x down to target's shape (reference math.py:reduce_as)."""
+    def fn(v, t):
+        extra = v.ndim - t.ndim
+        axes = tuple(range(extra)) + tuple(
+            i + extra for i, (a, b) in enumerate(zip(v.shape[extra:], t.shape))
+            if b == 1 and a != 1)
+        out = jnp.sum(v, axis=axes, keepdims=False) if axes else v
+        return out.reshape(t.shape)
+
+    return apply_op("reduce_as", fn, [x, target])
+
+
+@_reg("sinc", method="sinc")
+def sinc(x, name=None):
+    return apply_op("sinc", jnp.sinc, [x])
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype="float32", name=None):
+    from ..core import rng as _rng
+
+    out = jnp.exp(mean + std * jax.random.normal(
+        _rng.next_key(), tuple(shape or ()), jnp.float32))
+    return Tensor(out.astype(dtype))
+
+
+@_reg("crop")
+def crop(x, shape=None, offsets=None, name=None):
+    def fn(v):
+        if shape is None:
+            shp = list(v.shape)
+        else:
+            shp = [int(_unwrap(s)) for s in shape]
+            if len(shp) != v.ndim:
+                raise ValueError(f"crop shape rank {len(shp)} != input rank {v.ndim}")
+            shp = [v.shape[i] if s == -1 else s for i, s in enumerate(shp)]
+        offs = ([int(_unwrap(o)) for o in offsets] if offsets is not None
+                else [0] * v.ndim)
+        idx = tuple(slice(o, o + s) for o, s in zip(offs, shp))
+        return v[idx]
+
+    return apply_op("crop", fn, [x])

@@ -66,18 +66,38 @@ class AbsmaxObserver(BaseObserver):
 
 
 class HistObserver(BaseObserver):
-    """Histogram percentile observer (reference observer/hist.py)."""
+    """Histogram percentile observer (reference observer/hist.py).  Keeps a
+    fixed-bin histogram (O(bins) memory) rather than raw samples; the bin
+    range grows by rebinning when a batch exceeds the current maximum."""
 
     def __init__(self, quant_bits=8, percent=0.999, bins=2048):
         super().__init__(quant_bits)
         self.percent = percent
         self.bins = bins
-        self._samples = []
+        self._hist = np.zeros(bins, np.int64)
+        self._max = 0.0
 
     def _observe(self, arr):
-        self._samples.append(np.abs(arr).ravel())
-        flat = np.concatenate(self._samples)
-        self._scale = float(np.quantile(flat, self.percent)) if flat.size else 0.0
+        a = np.abs(arr).ravel()
+        if not a.size:
+            return
+        m = float(a.max())
+        if m > self._max:
+            if self._max > 0:  # rebin old counts into the wider range
+                old_edges = np.linspace(0, self._max, self.bins + 1)[1:]
+                new_idx = np.minimum(
+                    (old_edges / m * self.bins).astype(int), self.bins - 1)
+                rebinned = np.zeros(self.bins, np.int64)
+                np.add.at(rebinned, new_idx, self._hist)
+                self._hist = rebinned
+            self._max = m
+        idx = np.minimum((a / self._max * self.bins).astype(int), self.bins - 1)
+        np.add.at(self._hist, idx, 1)
+        # percentile from the cumulative histogram
+        c = np.cumsum(self._hist)
+        target = self.percent * c[-1]
+        bin_i = int(np.searchsorted(c, target))
+        self._scale = (bin_i + 1) / self.bins * self._max
 
 
 class KLObserver(HistObserver):
@@ -181,6 +201,10 @@ class QuantizedLinear(Layer):
 
     def __init__(self, linear, w_scale, bits=8):
         super().__init__()
+        if w_scale is None:
+            raise ValueError(
+                "quant scale is None — run at least one forward (QAT) or "
+                "calibration batch (PTQ) before convert()")
         qmax = float(2 ** (bits - 1) - 1)
         w = np.asarray(_unwrap(linear.weight), np.float32)
         step = max(w_scale, 1e-12) / qmax

@@ -15,24 +15,37 @@ __all__ = ["frame", "overlap_add", "stft", "istft"]
 
 
 def frame(x, frame_length, hop_length, axis=-1, name=None):
-    """Slice into overlapping frames along ``axis`` (reference frame op)."""
+    """Slice into overlapping frames (reference frame op).  axis=-1 (default):
+    input [..., n] → [..., frame_length, num_frames]; axis=0: input [n, ...]
+    → [num_frames, frame_length, ...] (the reference's two layouts)."""
+    if axis not in (-1, 0):
+        raise ValueError("frame: axis must be 0 or -1")
 
     def fn(v):
-        v = jnp.moveaxis(v, axis, -1)
+        if axis == 0:
+            v = jnp.moveaxis(v, 0, -1)
         n = v.shape[-1]
         num = 1 + (n - frame_length) // hop_length
         starts = jnp.arange(num) * hop_length
         idx = starts[:, None] + jnp.arange(frame_length)[None, :]
         out = v[..., idx]  # [..., num_frames, frame_length]
-        return jnp.swapaxes(out, -1, -2)  # paddle layout: [..., frame_length, num]
+        if axis == 0:
+            # [num_frames, frame_length, ...]
+            return jnp.moveaxis(out, (-2, -1), (0, 1))
+        return jnp.swapaxes(out, -1, -2)  # [..., frame_length, num_frames]
 
     return apply_op("frame", fn, [x])
 
 
 def overlap_add(x, hop_length, axis=-1, name=None):
-    """Inverse of frame: x [..., frame_length, num_frames] → signal."""
+    """Inverse of frame.  axis=-1: x [..., frame_length, num_frames] → [..., n];
+    axis=0: x [num_frames, frame_length, ...] → [n, ...]."""
+    if axis not in (-1, 0):
+        raise ValueError("overlap_add: axis must be 0 or -1")
 
     def fn(v):
+        if axis == 0:
+            v = jnp.moveaxis(v, (0, 1), (-1, -2))  # → [..., frame_length, num]
         fl, num = v.shape[-2], v.shape[-1]
         n = fl + hop_length * (num - 1)
         segs = jnp.moveaxis(v, -1, 0)  # [num, ..., fl]
@@ -45,7 +58,8 @@ def overlap_add(x, hop_length, axis=-1, name=None):
                 acc, jax.lax.dynamic_slice_in_dim(acc, i * hop_length, fl, -1) + seg,
                 i * hop_length, -1)
 
-        return jax.lax.fori_loop(0, num, body, out)
+        sig = jax.lax.fori_loop(0, num, body, out)
+        return jnp.moveaxis(sig, -1, 0) if axis == 0 else sig
 
     return apply_op("overlap_add", fn, [x])
 

@@ -213,9 +213,10 @@ def multiply(x, y, name=None):
     """O(nnz_a * lookup) intersection: for each of a's entries, find the
     matching entry in b (hash the coordinates into a scalar key)."""
     a, b = _as_coo(x)._bcoo.sum_duplicates(), _as_coo(y)._bcoo.sum_duplicates()
-    dims = jnp.asarray(a.shape, jnp.int64)
-    strides = jnp.cumprod(jnp.concatenate([dims[1:][::-1],
-                                           jnp.ones(1, jnp.int64)]))[::-1]
+    # row-major strides: strides[i] = prod(shape[i+1:]), last stride 1
+    strides = jnp.asarray(
+        np.append(np.cumprod(np.asarray(a.shape[1:])[::-1])[::-1], 1)
+        if len(a.shape) > 1 else [1], jnp.int64)
     ka = (a.indices.astype(jnp.int64) * strides).sum(-1)
     kb = (b.indices.astype(jnp.int64) * strides).sum(-1)
     order = jnp.argsort(kb)

@@ -148,3 +148,101 @@ def test_dunders_and_scalars():
     z = (y**2).sum()
     z.backward()
     np.testing.assert_allclose(x.grad.numpy(), 2 * (np.array([1.0, 2.0])), rtol=1e-6)
+
+
+# ---- long-tail ops (ops/extras.py) ----------------------------------------
+
+class TestExtras:
+    def test_take_modes(self):
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        np.testing.assert_array_equal(
+            np.asarray(paddle.take(x, paddle.to_tensor(np.array([0, 5, 11]))).numpy()),
+            [0, 5, 11])
+        np.testing.assert_array_equal(
+            np.asarray(paddle.take(x, paddle.to_tensor(np.array([12, -1])), mode="wrap").numpy()),
+            [0, 11])
+
+    def test_renorm(self):
+        import paddle_tpu as paddle
+        x = np.array([[3.0, 4.0], [6.0, 8.0]], np.float32)
+        out = np.asarray(paddle.renorm(paddle.to_tensor(x), p=2.0, axis=0, max_norm=5.0).numpy())
+        np.testing.assert_allclose(np.linalg.norm(out, axis=1), [5.0, 5.0], rtol=1e-4)
+
+    def test_trapezoid(self):
+        import paddle_tpu as paddle
+        y = np.array([1.0, 2.0, 3.0], np.float32)
+        assert float(paddle.trapezoid(paddle.to_tensor(y)).numpy()) == 4.0
+        ct = np.asarray(paddle.cumulative_trapezoid(paddle.to_tensor(y)).numpy())
+        np.testing.assert_allclose(ct, [1.5, 4.0])
+
+    def test_split_stack_families(self):
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(4, 6))
+        parts = paddle.tensor_split(x, 3)
+        assert [tuple(p.shape) for p in parts] == [(2, 6), (1, 6), (1, 6)]
+        h = paddle.hsplit(x, 2)
+        assert tuple(h[0].shape) == (4, 3)
+        cs = paddle.column_stack([paddle.to_tensor(np.ones(3, np.float32)),
+                                  paddle.to_tensor(np.zeros(3, np.float32))])
+        assert tuple(cs.shape) == (3, 2)
+
+    def test_cummin(self):
+        import paddle_tpu as paddle
+        x = np.array([3.0, 1.0, 2.0, 0.5], np.float32)
+        vals, inds = paddle.cummin(paddle.to_tensor(x), axis=0)
+        np.testing.assert_allclose(np.asarray(vals.numpy()), [3, 1, 1, 0.5])
+        np.testing.assert_array_equal(np.asarray(inds.numpy()), [0, 1, 1, 3])
+
+    def test_cdist_euclid(self):
+        import paddle_tpu as paddle
+        rs = np.random.RandomState(0)
+        a, b = rs.randn(5, 3).astype(np.float32), rs.randn(4, 3).astype(np.float32)
+        out = np.asarray(paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b)).numpy())
+        ref = np.sqrt(((a[:, None] - b[None]) ** 2).sum(-1))
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_masked_scatter_and_index_fill(self):
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        mask = paddle.to_tensor(np.array([[True, False, True], [False, True, False]]))
+        vals = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        out = np.asarray(paddle.masked_scatter(x, mask, vals).numpy())
+        np.testing.assert_array_equal(out, [[1, 0, 2], [0, 3, 0]])
+        f = np.asarray(paddle.index_fill(x, paddle.to_tensor(np.array([1])), 1, 9.0).numpy())
+        np.testing.assert_array_equal(f, [[0, 9, 0], [0, 9, 0]])
+
+    def test_misc_elementwise(self):
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.array([-2.0, 0.0, 3.0], np.float32))
+        np.testing.assert_array_equal(np.asarray(paddle.sgn(x).numpy()), [-1, 0, 1])
+        np.testing.assert_array_equal(
+            np.asarray(paddle.isin(x, paddle.to_tensor(np.array([3.0]))).numpy()),
+            [False, False, True])
+        np.testing.assert_allclose(
+            np.asarray(paddle.ldexp(x, paddle.to_tensor(np.array([1, 1, 1]))).numpy()),
+            [-4, 0, 6])
+        shifted = paddle.bitwise_left_shift(
+            paddle.to_tensor(np.array([1, 2], np.int32)),
+            paddle.to_tensor(np.array([2, 1], np.int32)))
+        np.testing.assert_array_equal(np.asarray(shifted.numpy()), [4, 4])
+
+    def test_reduce_as_and_grad(self):
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+        t = paddle.to_tensor(np.zeros((1, 3), np.float32))
+        out = paddle.reduce_as(x, t)
+        np.testing.assert_array_equal(np.asarray(out.numpy()), [[2, 2, 2]])
+        out.sum().backward()
+        np.testing.assert_array_equal(np.asarray(x.grad.numpy()), np.ones((2, 3)))
+
+    def test_slice_select_scatter(self):
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        v = paddle.to_tensor(np.ones((3, 2), np.float32))
+        out = np.asarray(paddle.slice_scatter(x, v, [1], [0], [4], [2]).numpy())
+        np.testing.assert_array_equal(out[:, 0], 1)
+        np.testing.assert_array_equal(out[:, 1], 0)
+        s = np.asarray(paddle.select_scatter(
+            x, paddle.to_tensor(np.full((4,), 7.0, np.float32)), 0, 1).numpy())
+        np.testing.assert_array_equal(s[1], 7)
