@@ -1,0 +1,192 @@
+"""Audio features (reference: python/paddle/audio/ — functional/window.py
+get_window, functional/functional.py compute_fbank_matrix/create_dct/
+hz_to_mel/mel_to_hz, features/layers.py Spectrogram/MelSpectrogram/
+LogMelSpectrogram/MFCC).
+
+TPU-native: everything composes signal.stft (XLA FftOp) + matmuls; the
+feature layers are nn.Layers so they fuse into model graphs under jit."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op, _unwrap
+from ..nn.layer_base import Layer
+from .. import signal as _signal
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "compute_fbank_matrix", "create_dct",
+    "get_window", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
+]
+
+
+def hz_to_mel(freq, htk=False):
+    """Reference audio/functional/functional.py:hz_to_mel."""
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    f = np.asarray(freq, np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                    mels)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    m = np.asarray(mel, np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Mel filterbank [n_mels, n_fft//2+1] (reference functional.py:
+    compute_fbank_matrix)."""
+    f_max = f_max or sr / 2.0
+    n_freqs = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sr / 2.0, n_freqs)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, n_freqs))
+    for i in range(n_mels):
+        lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+        fb[i] = np.maximum(0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb, dtype))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference functional.py:create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    return Tensor(jnp.asarray(dct.T, dtype))
+
+
+_WINDOWS = {
+    "hann": np.hanning,
+    "hamming": np.hamming,
+    "blackman": np.blackman,
+    "bartlett": np.bartlett,
+}
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Reference audio/functional/window.py:get_window."""
+    name = window if isinstance(window, str) else window[0]
+    if name == "rectangular" or name == "boxcar":
+        w = np.ones(win_length)
+    elif name == "gaussian":
+        std = window[1] if not isinstance(window, str) else 0.4 * win_length / 2
+        n = np.arange(win_length) - (win_length - 1) / 2
+        w = np.exp(-0.5 * (n / std) ** 2)
+    elif name in _WINDOWS:
+        # periodic (fftbins=True) windows: evaluate at win_length+1, drop last
+        w = (_WINDOWS[name](win_length + 1)[:-1] if fftbins
+             else _WINDOWS[name](win_length))
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(jnp.asarray(w, dtype))
+
+
+class Spectrogram(Layer):
+    """Reference audio/features/layers.py:Spectrogram."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, hop_length=self.hop_length,
+                            win_length=self.win_length, window=self.window,
+                            center=self.center, pad_mode=self.pad_mode)
+
+        def mag(s):
+            m = jnp.abs(s)
+            return m ** self.power if self.power != 1.0 else m
+
+        return apply_op("spectrogram_mag", mag, [spec])
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode, dtype)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                          htk, norm, dtype)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)  # [..., n_freqs, frames]
+        return apply_op("mel_project",
+                        lambda s, fb: jnp.einsum("...ft,mf->...mt", s, fb),
+                        [spec, self.fbank])
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, *args, ref_value=1.0, amin=1e-10, top_db=None, **kw):
+        super().__init__()
+        self.mel = MelSpectrogram(*args, **kw)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        m = self.mel(x)
+
+        def to_db(v):
+            log_spec = 10.0 * jnp.log10(jnp.maximum(v, self.amin))
+            log_spec -= 10.0 * math.log10(max(self.ref_value, self.amin))
+            if self.top_db is not None:
+                log_spec = jnp.maximum(log_spec, log_spec.max() - self.top_db)
+            return log_spec
+
+        return apply_op("power_to_db", to_db, [m])
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 n_mels=64, f_min=50.0, f_max=None, dtype="float32", **kw):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr=sr, n_fft=n_fft,
+                                        hop_length=hop_length, n_mels=n_mels,
+                                        f_min=f_min, f_max=f_max, dtype=dtype,
+                                        **kw)
+        self.dct = create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        lm = self.logmel(x)
+        return apply_op("mfcc_dct",
+                        lambda s, d: jnp.einsum("...mt,mk->...kt", s, d),
+                        [lm, self.dct])

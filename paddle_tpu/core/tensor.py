@@ -366,7 +366,10 @@ jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
 class Parameter(Tensor):
     """Trainable tensor (analog of ``paddle.base.framework.EagerParamBase``)."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+    # _asp_mask: optional 2:4 sparsity mask (incubate.asp) — lives on the
+    # parameter so it shares the parameter's lifetime
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "_asp_mask")
 
     def __init__(self, value, trainable: bool = True, name: str | None = None):
         super().__init__(value, stop_gradient=not trainable, name=name)

@@ -1,0 +1,99 @@
+"""ASP — automatic 2:4 structured sparsity (reference:
+python/paddle/incubate/asp/ — prune_model/decorate in asp.py, mask
+generation utils in utils.py supporting_sparse_2_4 patterns).
+
+TPU note: Ampere's sparse tensor cores have no TPU analog; the MXU runs
+dense.  The *workflow* is still valuable (train-dense → prune 2:4 →
+fine-tune with frozen masks → deploy pruned weights), so this module keeps
+the reference API: masks are computed per weight, applied multiplicatively,
+and re-applied after each optimizer step by the decorated optimizer."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, _unwrap
+from ...nn.layer_base import Layer
+
+__all__ = [
+    "calculate_density", "create_mask", "check_mask_2d", "prune_model",
+    "decorate", "reset_excluded_layers", "set_excluded_layers",
+]
+
+# masks live on the parameter object itself (attribute `_asp_mask`) so they
+# follow the parameter's lifetime — no global registry to leak or collide
+_EXCLUDED: set[str] = set()
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(_unwrap(x))
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def create_mask(weight, func_name="mask_2d_best", n=2, m=4):
+    """2:m mask along the last axis: keep the n largest-|w| of every m."""
+    arr = np.asarray(_unwrap(weight), np.float32)
+    orig = arr.shape
+    if arr.size % m:
+        return np.ones(orig, np.float32)  # not divisible: leave dense
+    flat = np.abs(arr).reshape(-1, m)
+    keep = np.argsort(-flat, axis=1)[:, :n]
+    mask = np.zeros_like(flat)
+    np.put_along_axis(mask, keep, 1.0, axis=1)
+    return mask.reshape(orig)
+
+
+def check_mask_2d(mat, n=2, m=4) -> bool:
+    arr = np.asarray(_unwrap(mat))
+    if arr.size % m:
+        return False
+    groups = (np.abs(arr.reshape(-1, m)) > 0).sum(axis=1)
+    return bool(np.all(groups <= n))
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _prunable(name, param):
+    v = _unwrap(param)
+    return (name not in _EXCLUDED and getattr(v, "ndim", 0) >= 2
+            and v.shape[-1] % 4 == 0)
+
+
+def prune_model(model: Layer, n=2, m=4, mask_algo="mask_2d_best",
+                with_mask=True):
+    """Apply 2:4 masks to every prunable weight in place; masks are recorded
+    so a decorated optimizer keeps enforcing them (reference asp.py:
+    prune_model)."""
+    pruned = {}
+    for name, param in model.named_parameters():
+        if not _prunable(name, param):
+            continue
+        mask = create_mask(param, mask_algo, n, m)
+        param._value = (_unwrap(param) * jnp.asarray(mask, _unwrap(param).dtype))
+        param._asp_mask = jnp.asarray(mask)
+        pruned[name] = float(mask.mean())
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply the recorded masks after each update
+    (reference asp.py:decorate → OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+
+    def step(*args, **kwargs):
+        out = orig_step(*args, **kwargs)
+        for p in optimizer._parameter_list or []:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._value = _unwrap(p) * mask.astype(_unwrap(p).dtype)
+        return out
+
+    optimizer.step = step
+    return optimizer

@@ -38,6 +38,16 @@ def _reg(name, method=None):
 @_reg("take", method="take")
 def take(x, index, mode="raise", name=None):
     """Flattened gather (reference tensor/math.py:take)."""
+    if mode == "raise" and not any(
+            isinstance(v, jax.core.Tracer) for v in (_unwrap(x), _unwrap(index))):
+        # eager path: validate like the reference (out-of-range must not
+        # silently produce fill values)
+        n = int(np.prod(np.shape(_unwrap(x))))
+        idx = np.asarray(_unwrap(index))
+        if idx.size and (idx.min() < -n or idx.max() >= n):
+            raise IndexError(
+                f"take index out of range for tensor of {n} elements")
+
     def fn(v, i):
         flat = v.reshape(-1)
         n = flat.shape[0]
@@ -49,6 +59,7 @@ def take(x, index, mode="raise", name=None):
             i = jnp.clip(i, 0, n - 1)
         else:
             i = jnp.where(i < 0, i + n, i)
+            i = jnp.clip(i, 0, n - 1)  # under jit: clamp (checked eagerly above)
         return jnp.take(flat, i)
 
     return apply_op("take", fn, [x, index])
@@ -228,7 +239,7 @@ def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
 @_reg("bitwise_right_shift")
 def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
     def fn(a, b):
-        if is_arithmetic or jnp.issubdtype(a.dtype, jnp.signedinteger):
+        if is_arithmetic:
             return jnp.right_shift(a, b)
         return jax.lax.shift_right_logical(a, b.astype(a.dtype))
 
@@ -286,7 +297,7 @@ def cummin(x, axis=None, dtype="int64", name=None):
             return jnp.where(takea, va, vb), jnp.where(takea, ia, ib)
 
         vals, inds = jax.lax.associative_scan(comb, (vv, ar), axis=ax)
-        return vals, inds.astype(jnp.int64)
+        return vals, inds.astype(dtype)
 
     return apply_op("cummin", fn, [x], n_outputs=2)
 

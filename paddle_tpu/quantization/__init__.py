@@ -82,6 +82,9 @@ class HistObserver(BaseObserver):
         if not a.size:
             return
         m = float(a.max())
+        if m == 0.0 and self._max == 0.0:
+            self._scale = 0.0  # all-zero so far; nothing to bin
+            return
         if m > self._max:
             if self._max > 0:  # rebin old counts into the wider range
                 old_edges = np.linspace(0, self._max, self.bins + 1)[1:]

@@ -1,0 +1,86 @@
+"""Text utilities (reference: python/paddle/text/ — viterbi_decode /
+ViterbiDecoder in viterbi_decode.py; dataset loaders under text/datasets).
+
+TPU-native: Viterbi is a lax.scan over time steps (max-product dynamic
+program) — one compiled kernel, batched; the reference's CUDA kernel
+(phi/kernels/gpu/viterbi_decode_kernel.cu) maps to the same recurrence."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..nn.layer_base import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Batched Viterbi decode (reference text/viterbi_decode.py).
+
+    potentials: [b, t, n] unary emission scores;
+    transition_params: [n, n] (transition[i][j]: score of j -> i, the
+    reference convention; with bos/eos rows when include_bos_eos_tag);
+    lengths: [b] valid sequence lengths.
+    Returns (scores [b], paths [b, t])."""
+
+    def fn(emis, trans, lens):
+        b, t, n = emis.shape
+        mask = jnp.arange(t)[None, :] < lens[:, None]  # [b, t]
+
+        alpha = emis[:, 0]
+        if include_bos_eos_tag:
+            # reference kernel (viterbi_decode_kernel.cc:232-246): the LAST
+            # row of transitions is the start-tag score, the second-to-last
+            # row is the stop-tag score
+            alpha = alpha + trans[n - 1][None, :]
+
+        def step(carry, inp):
+            alpha = carry
+            e_t, m_t = inp  # [b, n], [b]
+            # score[j -> i] = alpha[j] + trans[i, j]
+            cand = alpha[:, None, :] + trans[None, :, :]  # [b, i, j]
+            best_prev = jnp.argmax(cand, axis=-1)          # [b, n]
+            alpha_new = jnp.max(cand, axis=-1) + e_t
+            alpha = jnp.where(m_t[:, None], alpha_new, alpha)
+            return alpha, jnp.where(m_t[:, None], best_prev,
+                                    jnp.arange(n)[None, :])
+
+        emis_t = jnp.moveaxis(emis[:, 1:], 1, 0)          # [t-1, b, n]
+        mask_t = jnp.moveaxis(mask[:, 1:], 1, 0)          # [t-1, b]
+        alpha, backptrs = jax.lax.scan(step, alpha, (emis_t, mask_t))
+
+        if include_bos_eos_tag:
+            alpha = alpha + trans[n - 2][None, :]          # stop-tag row
+        scores = jnp.max(alpha, axis=-1)
+        last = jnp.argmax(alpha, axis=-1)                  # [b]
+
+        # backtrace (reverse scan over backpointers)
+        def back(carry, bp):
+            cur = carry
+            prev = jnp.take_along_axis(bp, cur[:, None], axis=1)[:, 0]
+            return prev, cur
+
+        # reverse scan: ys[k] = tag at time k+1; final carry = tag at time 0
+        first, path_rev = jax.lax.scan(back, last, backptrs, reverse=True)
+        paths = jnp.concatenate([first[:, None], jnp.moveaxis(path_rev, 0, 1)],
+                                axis=1)                    # [b, t]
+        # pad region: repeat the last valid tag (reference zero-pads; mask out)
+        paths = jnp.where(mask, paths, 0)
+        return scores, paths
+
+    return apply_op("viterbi_decode", fn,
+                    [potentials, transition_params, lengths], n_outputs=2)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

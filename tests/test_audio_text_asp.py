@@ -1,0 +1,149 @@
+"""Audio features, text viterbi, ASP 2:4 sparsity tests (mirrors
+test/legacy_test test_audio_functions.py, test_viterbi_decode_op.py,
+test/asp/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, text
+from paddle_tpu.incubate import asp
+
+
+def test_mel_hz_roundtrip():
+    f = np.array([100.0, 440.0, 4000.0])
+    np.testing.assert_allclose(audio.mel_to_hz(audio.hz_to_mel(f)), f, rtol=1e-6)
+    np.testing.assert_allclose(audio.mel_to_hz(audio.hz_to_mel(f, htk=True), htk=True),
+                               f, rtol=1e-6)
+
+
+def test_fbank_matrix_properties():
+    fb = np.asarray(audio.compute_fbank_matrix(16000, 512, n_mels=40).numpy())
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    assert (fb.sum(axis=1) > 0).all()  # every filter covers some bins
+
+
+def test_spectrogram_tone_peak():
+    sr, n_fft = 8000, 256
+    t = np.arange(sr, dtype=np.float32) / sr
+    tone = np.sin(2 * np.pi * 1000 * t)[None]
+    spec = audio.Spectrogram(n_fft=n_fft, hop_length=128)(paddle.to_tensor(tone))
+    mag = np.asarray(spec.numpy())[0].mean(-1)
+    assert abs(mag.argmax() * sr / n_fft - 1000) < sr / n_fft
+
+
+def test_mfcc_shapes():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4000).astype(np.float32))
+    mfcc = audio.MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=40)(x)
+    assert mfcc.shape[0] == 2 and mfcc.shape[1] == 13
+
+
+def test_viterbi_decode_matches_bruteforce():
+    rs = np.random.RandomState(0)
+    b, t, n = 2, 5, 3
+    emis = rs.randn(b, t, n).astype(np.float32)
+    trans = rs.randn(n, n).astype(np.float32)
+    lens = np.array([5, 5], np.int32)
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=False)
+    scores, paths = np.asarray(scores.numpy()), np.asarray(paths.numpy())
+
+    # brute force over all 3^5 paths
+    import itertools
+    for bi in range(b):
+        best, best_p = -1e30, None
+        for path in itertools.product(range(n), repeat=t):
+            s = emis[bi, 0, path[0]]
+            for i in range(1, t):
+                s += trans[path[i], path[i - 1]] + emis[bi, i, path[i]]
+            if s > best:
+                best, best_p = s, path
+        assert abs(scores[bi] - best) < 1e-4
+        np.testing.assert_array_equal(paths[bi], best_p)
+
+
+def test_viterbi_decoder_layer_and_lengths():
+    rs = np.random.RandomState(1)
+    emis = rs.randn(1, 4, 3).astype(np.float32)
+    trans = rs.randn(3, 3).astype(np.float32)
+    dec = text.ViterbiDecoder(paddle.to_tensor(trans), include_bos_eos_tag=False)
+    s4, p4 = dec(paddle.to_tensor(emis), paddle.to_tensor(np.array([4])))
+    # truncating to length 2 must equal decoding the 2-step prefix
+    s2, p2 = dec(paddle.to_tensor(emis), paddle.to_tensor(np.array([2])))
+    s2_ref, p2_ref = dec(paddle.to_tensor(emis[:, :2]),
+                         paddle.to_tensor(np.array([2])))
+    np.testing.assert_allclose(np.asarray(s2.numpy()), np.asarray(s2_ref.numpy()),
+                               rtol=1e-5)
+
+
+def test_asp_prune_and_decorate():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    pruned = asp.prune_model(model)
+    assert pruned  # something was pruned
+    for name, p in model.named_parameters():
+        if name in pruned:
+            assert asp.check_mask_2d(p)
+            assert abs(asp.calculate_density(p) - 0.5) < 0.01
+
+    optim = asp.decorate(opt.SGD(parameters=model.parameters(), learning_rate=0.1))
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    loss = model(x).mean()
+    loss.backward()
+    optim.step()
+    # masks survive the update
+    for name, p in model.named_parameters():
+        if name in pruned:
+            assert asp.check_mask_2d(p)
+
+
+def test_viterbi_bos_eos_default_path():
+    """include_bos_eos_tag=True: last transitions row = start score,
+    second-to-last row = stop score (reference viterbi_decode_kernel.cc)."""
+    rs = np.random.RandomState(2)
+    b, t, n = 2, 4, 5  # tags 3=stop, 4=start
+    emis = rs.randn(b, t, n).astype(np.float32)
+    trans = rs.randn(n, n).astype(np.float32)
+    lens = np.array([4, 4], np.int32)
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=True)
+    scores = np.asarray(scores.numpy())
+
+    import itertools
+    for bi in range(b):
+        best = -1e30
+        for path in itertools.product(range(n), repeat=t):
+            s = emis[bi, 0, path[0]] + trans[n - 1, path[0]]
+            for i in range(1, t):
+                s += trans[path[i], path[i - 1]] + emis[bi, i, path[i]]
+            s += trans[n - 2, path[-1]]
+            best = max(best, s)
+        assert abs(scores[bi] - best) < 1e-4
+
+
+def test_take_raise_mode_validates():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+    with pytest.raises(IndexError):
+        paddle.take(x, paddle.to_tensor(np.array([10])))
+    with pytest.raises(IndexError):
+        paddle.take(x, paddle.to_tensor(np.array([-7])))
+
+
+def test_hist_observer_zero_batch():
+    obs = __import__("paddle_tpu").quantization.HistObserver(bins=16)
+    obs(paddle.to_tensor(np.zeros(10, np.float32)))  # must not crash
+    assert obs.scale() == 0.0
+    obs(paddle.to_tensor(np.ones(10, np.float32)))
+    assert obs.scale() > 0
+
+
+def test_logical_right_shift():
+    out = paddle.bitwise_right_shift(
+        paddle.to_tensor(np.array([-8], np.int32)),
+        paddle.to_tensor(np.array([1], np.int32)), is_arithmetic=False)
+    assert int(np.asarray(out.numpy())[0]) == 2147483644
