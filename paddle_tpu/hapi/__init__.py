@@ -8,8 +8,13 @@ import numpy as np
 from ..core.tensor import Tensor, no_grad, to_tensor
 from ..io import DataLoader, Dataset
 from ..metric import Metric
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    config_callbacks,
+)
 
-__all__ = ["Model", "summary"]
+__all__ = ["Model", "summary", "Callback", "EarlyStopping", "LRScheduler",
+           "ModelCheckpoint", "ProgBarLogger"]
 
 
 class Model:
@@ -73,18 +78,33 @@ class Model:
         callbacks=None,
     ):
         loader = self._as_loader(train_data, batch_size, shuffle)
+        cbs = config_callbacks(callbacks, model=self, log_freq=log_freq,
+                               verbose=verbose, save_dir=save_dir,
+                               save_freq=save_freq, metrics=self._metrics)
+        self.stop_training = False
         history = []
+        cbs.on_train_begin()
         for epoch in range(epochs):
+            cbs.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
             for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step)
                 x, y = batch[0], batch[1]
                 metrics = self.train_batch(x, y)
-                if verbose and step % log_freq == 0:
-                    print(f"epoch {epoch} step {step}: loss {metrics[0]:.4f}")
+                logs = {"loss": metrics[0]}
+                for m, v in zip(self._metrics, metrics[1:]):
+                    logs[m.name()] = v
+                cbs.on_train_batch_end(step, logs)
             history.append(metrics)
+            cbs.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0)
+                cbs.on_eval_end(eval_logs)
+            if self.stop_training:
+                break
+        cbs.on_train_end()
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0, callbacks=None):

@@ -32,10 +32,11 @@ def calculate_density(x) -> float:
 
 
 def create_mask(weight, func_name="mask_2d_best", n=2, m=4):
-    """2:m mask along the last axis: keep the n largest-|w| of every m."""
+    """n:m mask along the last axis: keep the n largest-|w| of every m.
+    Requires shape[-1] % m == 0 so groups never straddle rows."""
     arr = np.asarray(_unwrap(weight), np.float32)
     orig = arr.shape
-    if arr.size % m:
+    if orig[-1] % m:
         return np.ones(orig, np.float32)  # not divisible: leave dense
     flat = np.abs(arr).reshape(-1, m)
     keep = np.argsort(-flat, axis=1)[:, :n]
@@ -46,7 +47,7 @@ def create_mask(weight, func_name="mask_2d_best", n=2, m=4):
 
 def check_mask_2d(mat, n=2, m=4) -> bool:
     arr = np.asarray(_unwrap(mat))
-    if arr.size % m:
+    if arr.shape[-1] % m:
         return False
     groups = (np.abs(arr.reshape(-1, m)) > 0).sum(axis=1)
     return bool(np.all(groups <= n))
@@ -60,10 +61,10 @@ def reset_excluded_layers(main_program=None):
     _EXCLUDED.clear()
 
 
-def _prunable(name, param):
+def _prunable(name, param, m=4):
     v = _unwrap(param)
     return (name not in _EXCLUDED and getattr(v, "ndim", 0) >= 2
-            and v.shape[-1] % 4 == 0)
+            and v.shape[-1] % m == 0)
 
 
 def prune_model(model: Layer, n=2, m=4, mask_algo="mask_2d_best",
@@ -73,7 +74,7 @@ def prune_model(model: Layer, n=2, m=4, mask_algo="mask_2d_best",
     prune_model)."""
     pruned = {}
     for name, param in model.named_parameters():
-        if not _prunable(name, param):
+        if not _prunable(name, param, m):
             continue
         mask = create_mask(param, mask_algo, n, m)
         param._value = (_unwrap(param) * jnp.asarray(mask, _unwrap(param).dtype))

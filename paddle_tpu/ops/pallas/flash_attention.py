@@ -9,10 +9,11 @@ does the two matmuls per tile and the VPU the online rescale.
 Layout: public entry takes BSHD ([batch, seq, heads, head_dim], the paddle
 convention); the kernel runs BHSD grids of (batch*heads, q_blocks, kv_blocks).
 
-Backward: custom_vjp recomputes per-tile probabilities from the saved
-log-sum-exp (standard flash backward recurrence) in plain XLA — numerically
-exact, O(S) memory for residuals.  A full Pallas backward kernel is the next
-optimization step.
+Backward: two Pallas kernels (FlashAttention-2 recurrence) — a dk/dv kernel
+gridded over kv blocks with q innermost, and a dq kernel gridded over q blocks
+with kv innermost.  Per-tile probabilities are recomputed exactly from the
+saved log-sum-exp; delta = rowsum(dO·O) is precomputed in XLA (O(s·d)).
+Logits/probabilities never materialize in HBM in either direction.
 """
 
 from __future__ import annotations
@@ -132,6 +133,146 @@ def _flash_attention_bhsd(q, k, v, scale, causal):
     return out
 
 
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bkv):
+    """Grid: (bh, num_kv_blocks, num_q_blocks); q innermost (sequential)."""
+    q_idx = pl.program_id(2)
+    kv_idx = pl.program_id(1)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    if causal:
+        run = (q_idx + 1) * bq - 1 >= kv_idx * bkv
+    else:
+        run = q_idx >= 0
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)          # [bkv, d]
+        v = v_ref[0].astype(jnp.float32)          # [bkv, d]
+        do = do_ref[0].astype(jnp.float32)        # [bq, d]
+        lse = lse_ref[0]                          # [bq, 1]
+        delta = delta_ref[0]                      # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                  # [bq, bkv]
+        if causal:
+            rows = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            cols = kv_idx * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # exact probs
+        # dv += p^T @ do
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale              # [bq, bkv]
+        # dk += ds^T @ q
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(q_idx == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr, *, scale, causal, bq, bkv):
+    """Grid: (bh, num_q_blocks, num_kv_blocks); kv innermost (sequential)."""
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    if causal:
+        run = (q_idx + 1) * bq - 1 >= kv_idx * bkv
+    else:
+        run = q_idx >= 0
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            cols = kv_idx * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(kv_idx == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, scale, causal):
+    """Pallas FlashAttention-2 backward; q,k,v,out,do: [bh, s, d]."""
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    bq_sz = sq if sq <= 128 else 128
+    bkv_sz = skv if skv <= 128 else 128
+    n_q = pl.cdiv(sq, bq_sz)
+    n_kv = pl.cdiv(skv, bkv_sz)
+
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1, keepdims=True)          # [bh, sq, 1]
+    lse3 = lse[..., None]                             # [bh, sq, 1]
+
+    q_spec_i = pl.BlockSpec((1, bq_sz, d), lambda b, i, j: (b, i, 0))
+    q_spec_j = pl.BlockSpec((1, bq_sz, d), lambda b, i, j: (b, j, 0))
+    kv_spec_i = pl.BlockSpec((1, bkv_sz, d), lambda b, i, j: (b, i, 0))
+    kv_spec_j = pl.BlockSpec((1, bkv_sz, d), lambda b, i, j: (b, j, 0))
+    row_spec_i = pl.BlockSpec((1, bq_sz, 1), lambda b, i, j: (b, i, 0))
+    row_spec_j = pl.BlockSpec((1, bq_sz, 1), lambda b, i, j: (b, j, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq_sz, bkv=bkv_sz),
+        grid=(bh, n_kv, n_q),
+        in_specs=[q_spec_j, kv_spec_i, kv_spec_i, q_spec_j, row_spec_j,
+                  row_spec_j],
+        out_specs=[kv_spec_i, kv_spec_i],
+        out_shape=[jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, skv, d), v.dtype)],
+        scratch_shapes=[_VMEM((bkv_sz, d), jnp.float32),
+                        _VMEM((bkv_sz, d), jnp.float32)]
+        if _VMEM is not None else [],
+        interpret=interpret_mode(),
+    )(q, k, v, do, lse3, delta)
+
+    dq, = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          bq=bq_sz, bkv=bkv_sz),
+        grid=(bh, n_q, n_kv),
+        in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec_i,
+                  row_spec_i],
+        out_specs=[q_spec_i],
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype)],
+        scratch_shapes=[_VMEM((bq_sz, d), jnp.float32)]
+        if _VMEM is not None else [],
+        interpret=interpret_mode(),
+    )(q, k, v, do, lse3, delta)
+    return dq, dk, dv
+
+
 def _flash_vjp_fwd(q, k, v, scale, causal):
     out, lse = _flash_fwd(q, k, v, scale, causal)
     return out, (q, k, v, out, lse)
@@ -139,20 +280,8 @@ def _flash_vjp_fwd(q, k, v, scale, causal):
 
 def _flash_vjp_bwd(scale, causal, res, do):
     q, k, v, out, lse = res
-    qf, kf, vf, of, dof = (t.astype(jnp.float32) for t in (q, k, v, out, do))
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
-    if causal:
-        sq, skv = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, skv), bool))
-        s = jnp.where(mask, s, NEG_INF)
-    p = jnp.exp(s - lse[..., None])  # exact probs from saved lse
-    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
-    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
-    delta = jnp.sum(of * dof, axis=-1, keepdims=True)  # [b, q, 1]
-    ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, scale, causal)
+    return dq, dk, dv
 
 
 _flash_attention_bhsd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

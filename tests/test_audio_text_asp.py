@@ -76,6 +76,9 @@ def test_viterbi_decoder_layer_and_lengths():
                          paddle.to_tensor(np.array([2])))
     np.testing.assert_allclose(np.asarray(s2.numpy()), np.asarray(s2_ref.numpy()),
                                rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(p2.numpy())[:, :2],
+                                  np.asarray(p2_ref.numpy()))
+    assert p4.shape == (1, 4) and np.isfinite(float(np.asarray(s4.numpy())[0]))
 
 
 def test_asp_prune_and_decorate():

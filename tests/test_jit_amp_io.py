@@ -156,3 +156,49 @@ def test_rng_seed_reproducible():
     c = paddle.randn([4]).numpy()
     paddle.set_rng_state(state)
     np.testing.assert_allclose(paddle.randn([4]).numpy(), c)
+
+
+# ---- hapi callbacks (reference hapi/callbacks.py tests) --------------------
+
+def test_hapi_fit_with_callbacks(tmp_path):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.hapi import Model, EarlyStopping, ModelCheckpoint
+    from paddle_tpu.io import TensorDataset
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 4).astype(np.float32)
+    w_true = rs.randn(4, 1).astype(np.float32)
+    y = x @ w_true
+
+    net = nn.Linear(4, 1)
+    model = Model(net)
+    sched = optim.lr.StepDecay(learning_rate=0.1, step_size=1000)
+    model.prepare(optimizer=optim.SGD(parameters=net.parameters(), learning_rate=sched),
+                  loss=nn.MSELoss())
+    ds = TensorDataset([x, y])
+    ckpt_dir = str(tmp_path / "ck")
+    early = EarlyStopping(monitor="loss", patience=2, verbose=0)
+    hist = model.fit(ds, eval_data=ds, batch_size=16, epochs=3, verbose=0,
+                     callbacks=[early, ModelCheckpoint(save_freq=1, save_dir=ckpt_dir)])
+    assert len(hist) >= 1
+    import os
+    assert os.path.exists(os.path.join(ckpt_dir, "final.pdparams"))
+    # LR scheduler stepped by the default LRScheduler callback
+    assert sched.last_epoch > 0
+
+
+def test_hapi_early_stopping_stops():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import EarlyStopping
+
+    early = EarlyStopping(monitor="loss", patience=1, verbose=0)
+
+    class FakeModel:
+        stop_training = False
+
+    early.set_model(FakeModel())
+    early.on_eval_end({"loss": [1.0]})
+    early.on_eval_end({"loss": [1.0]})  # no improvement -> patience hit
+    assert early.stop_training
