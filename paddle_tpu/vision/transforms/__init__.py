@@ -4,7 +4,13 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["Compose", "Normalize", "ToTensor", "Resize", "RandomHorizontalFlip", "RandomCrop", "CenterCrop", "Transpose"]
+__all__ = [
+    "Compose", "Normalize", "ToTensor", "Resize", "RandomHorizontalFlip",
+    "RandomCrop", "CenterCrop", "Transpose", "RandomVerticalFlip", "Pad",
+    "Grayscale", "BrightnessTransform", "ContrastTransform",
+    "SaturationTransform", "HueTransform", "ColorJitter", "RandomRotation",
+    "RandomResizedCrop", "RandomErasing",
+]
 
 
 class Compose:
@@ -113,3 +119,202 @@ class CenterCrop:
         sl[h_ax] = slice(i, i + th)
         sl[w_ax] = slice(j, j + tw)
         return a[tuple(sl)]
+
+
+class RandomVerticalFlip:
+    """Reference: vision/transforms/transforms.py:RandomVerticalFlip."""
+
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.ascontiguousarray(np.asarray(img)[::-1])
+        return img
+
+
+class Pad:
+    """Pad on all sides (reference transforms.py:Pad); img HWC or CHW-agnostic
+    ndarray — pads the two leading spatial dims."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding if isinstance(padding, (list, tuple)) else (padding,) * 4
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = (self.padding if len(self.padding) == 4
+                      else (self.padding[0], self.padding[1]) * 2)
+        pads = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+        mode = {"constant": "constant", "reflect": "reflect",
+                "edge": "edge", "symmetric": "symmetric"}[self.padding_mode]
+        kw = {"constant_values": self.fill} if mode == "constant" else {}
+        return np.pad(arr, pads, mode=mode, **kw)
+
+
+class Grayscale:
+    """Reference transforms.py:Grayscale; HWC input."""
+
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        g = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+        return np.repeat(g[..., None], self.num_output_channels, axis=-1)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        return np.clip(np.asarray(img, np.float32) * f, 0, 255 if np.asarray(img).max() > 1 else 1)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        mean = arr.mean()
+        hi = 255 if arr.max() > 1 else 1
+        return np.clip((arr - mean) * f + mean, 0, hi)
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        g = (arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114)[..., None]
+        hi = 255 if arr.max() > 1 else 1
+        return np.clip(g + (arr - g) * f, 0, hi)
+
+
+class HueTransform:
+    """Approximate hue shift via channel rotation mix (reference uses HSV;
+    the YIQ rotation here matches for small angles)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        theta = np.random.uniform(-self.value, self.value) * np.pi
+        c, s = np.cos(theta), np.sin(theta)
+        yiq_m = np.array([[0.299, 0.587, 0.114],
+                          [0.596, -0.274, -0.322],
+                          [0.211, -0.523, 0.312]], np.float32)
+        rot = np.array([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
+        m = np.linalg.inv(yiq_m) @ rot @ yiq_m
+        hi = 255 if arr.max() > 1 else 1
+        return np.clip(arr @ m.T, 0, hi)
+
+
+class ColorJitter:
+    """Reference transforms.py:ColorJitter — random order of B/C/S/H."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def __call__(self, img):
+        order = np.random.permutation(len(self.ts))
+        for i in order:
+            img = self.ts[i](img)
+        return img
+
+
+class RandomRotation:
+    """Nearest-neighbor rotation (reference transforms.py:RandomRotation)."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        self.degrees = (degrees if isinstance(degrees, (list, tuple))
+                        else (-degrees, degrees))
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        angle = np.radians(np.random.uniform(*self.degrees))
+        h, w = arr.shape[:2]
+        cy, cx = (h - 1) / 2, (w - 1) / 2
+        yy, xx = np.mgrid[0:h, 0:w]
+        ys = cy + (yy - cy) * np.cos(angle) - (xx - cx) * np.sin(angle)
+        xs = cx + (yy - cy) * np.sin(angle) + (xx - cx) * np.cos(angle)
+        yi = np.clip(np.round(ys).astype(int), 0, h - 1)
+        xi = np.clip(np.round(xs).astype(int), 0, w - 1)
+        out = arr[yi, xi]
+        oob = (ys < 0) | (ys > h - 1) | (xs < 0) | (xs > w - 1)
+        out[oob] = self.fill
+        return out
+
+
+class RandomResizedCrop:
+    """Reference transforms.py:RandomResizedCrop (HWC)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                y = np.random.randint(0, h - ch + 1)
+                x = np.random.randint(0, w - cw + 1)
+                crop = arr[y:y + ch, x:x + cw]
+                return Resize(self.size)(crop)
+        return Resize(self.size)(arr)
+
+
+class RandomErasing:
+    """Reference transforms.py:RandomErasing (operates on CHW tensors/arrays)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3), value=0):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.array(img, copy=True)
+        if np.random.rand() >= self.prob:
+            return arr
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh, ew = int(round(np.sqrt(target * ar))), int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                y, x = np.random.randint(0, h - eh), np.random.randint(0, w - ew)
+                if chw:
+                    arr[:, y:y + eh, x:x + ew] = self.value
+                else:
+                    arr[y:y + eh, x:x + ew] = self.value
+                return arr
+        return arr
