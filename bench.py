@@ -3,20 +3,27 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline = measured MFU / 0.40 (the BASELINE.md north-star: Llama-3-8B
 pretrain at >=40% MFU on v5p-64; single-chip runs use a memory-scaled config
-with identical per-layer structure)."""
+with identical per-layer structure).
+
+Hardened after round 1 (BENCH_r01 rc=1): jax backend init over the axon relay
+can HANG (not raise), so the measurement runs in a worker subprocess under a
+hard timeout; on TPU failure the bench re-runs on CPU, and any terminal
+failure still emits a parseable JSON line — the driver always records a
+result.  Orchestration: bench.py → [subprocess: bench.py --worker] →
+[fallback subprocess: bench.py --worker --cpu].
+"""
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
+import traceback
 
-import numpy as np
-
-import jax
-import jax.numpy as jnp
-
+TPU_TIMEOUT = int(os.environ.get("BENCH_TPU_TIMEOUT", "1500"))
+CPU_TIMEOUT = int(os.environ.get("BENCH_CPU_TIMEOUT", "600"))
 
 # bf16 peak FLOPs per chip by generation
 PEAK_FLOPS = {
@@ -29,19 +36,26 @@ PEAK_FLOPS = {
 }
 
 
-def chip_peak() -> float:
-    d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "cpu").lower()
+def chip_peak(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
     for k, v in PEAK_FLOPS.items():
         if k in kind:
             return v
     return PEAK_FLOPS["cpu"]
 
 
-def main():
-    from paddle_tpu.models import llama
+def run_bench():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
 
-    on_tpu = jax.default_backend() == "tpu"
+    from paddle_tpu.models import llama
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    backend = jax.default_backend()
+    devices = jax.devices()
+    print(f"[bench] backend={backend} devices={devices}", file=sys.stderr)
+    on_tpu = backend == "tpu"
     if on_tpu:
         # ~460M-param config: Llama-3 block structure, memory-scaled for 16GB HBM
         cfg = llama.LlamaConfig(
@@ -55,7 +69,7 @@ def main():
         batch, seq = 2, 128
         warmup_steps, bench_steps = 1, 2
 
-    mesh = llama.make_mesh(dp=1, mp=1, sharding=1, sep=1, devices=jax.devices()[:1])
+    mesh = llama.make_mesh(dp=1, mp=1, sharding=1, sep=1, devices=devices[:1])
     step_fn, opt_init, param_shardings, data_sharding = llama.build_train_step(cfg, mesh)
     params = jax.device_put(llama.init_params(cfg, jax.random.key(0)), param_shardings)
     opt_state = opt_init(params)
@@ -64,12 +78,16 @@ def main():
     ids = jax.device_put(jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq))), data_sharding)
     labels = jax.device_put(jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq))), data_sharding)
 
+    kernel_calls_before = fa.KERNEL_CALLS
     # warmup (compile).  NOTE: on the axon relay platform block_until_ready()
     # does not actually synchronize — a host scalar fetch is the only reliable
     # barrier, so timing is bracketed by float() fetches.
+    t_c = time.perf_counter()
     for _ in range(warmup_steps):
         loss, params, opt_state = step_fn(params, opt_state, ids, labels)
     float(loss)
+    print(f"[bench] warmup+compile {time.perf_counter() - t_c:.1f}s", file=sys.stderr)
+    flash_kernel_used = fa.KERNEL_CALLS > kernel_calls_before
 
     t0 = time.perf_counter()
     for _ in range(bench_steps):
@@ -81,9 +99,9 @@ def main():
     tok_per_sec = tokens / dt
     flops_tok = llama.flops_per_token(cfg) + llama.attn_flops_per_token(cfg, seq)
     achieved = tok_per_sec * flops_tok
-    mfu = achieved / chip_peak()
+    mfu = achieved / chip_peak(devices[0])
 
-    result = {
+    return {
         "metric": "llama_train_mfu_single_chip",
         "value": round(mfu * 100, 2),
         "unit": "% MFU",
@@ -94,11 +112,87 @@ def main():
             "params_m": round(llama.count_params(params) / 1e6, 1),
             "batch": batch,
             "seq": seq,
-            "backend": jax.default_backend(),
-            "device": getattr(jax.devices()[0], "device_kind", "?"),
+            "backend": backend,
+            "device": getattr(devices[0], "device_kind", "?"),
+            "flash_kernel_used": flash_kernel_used,
         },
     }
+
+
+def worker_main(force_cpu: bool) -> int:
+    if force_cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        result = run_bench()
+    except Exception as e:
+        print(f"[bench] worker failed: {e}\n{traceback.format_exc()}", file=sys.stderr)
+        return 1
     print(json.dumps(result))
+    sys.stdout.flush()
+    return 0
+
+
+def _try_worker(args: list[str], timeout: int):
+    """Run a worker subprocess; return its parsed JSON result or None.
+
+    Output goes to temp files (not pipes): a hung backend init can fork helper
+    processes that inherit pipe fds and keep them open past the child's death,
+    which would block a communicate()-style read forever.  The worker runs in
+    its own session so the whole process group can be killed on timeout."""
+    import signal
+    import tempfile
+
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker", *args]
+    with tempfile.TemporaryFile(mode="w+") as out_f, \
+            tempfile.TemporaryFile(mode="w+") as err_f:
+        proc = subprocess.Popen(
+            cmd, stdout=out_f, stderr=err_f, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            start_new_session=True,
+        )
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+            print(f"[bench] worker {args} timed out after {timeout}s", file=sys.stderr)
+        out_f.seek(0)
+        err_f.seek(0)
+        stdout, stderr = out_f.read(), err_f.read()
+    sys.stderr.write(stderr[-4000:])  # incl. partial output of a killed worker
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+            if isinstance(out, dict) and "metric" in out:
+                return out
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def main():
+    if "--worker" in sys.argv:
+        sys.exit(worker_main(force_cpu="--cpu" in sys.argv))
+
+    result = _try_worker([], TPU_TIMEOUT)
+    if result is None:
+        print("[bench] TPU run failed; falling back to CPU smoke run", file=sys.stderr)
+        result = _try_worker(["--cpu"], CPU_TIMEOUT)
+    if result is None:
+        result = {
+            "metric": "llama_train_mfu_single_chip",
+            "value": 0.0,
+            "unit": "% MFU",
+            "vs_baseline": 0.0,
+            "detail": {"error": "both TPU and CPU bench workers failed or timed out"},
+        }
+    print(json.dumps(result))
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

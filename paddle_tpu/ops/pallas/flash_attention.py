@@ -37,6 +37,11 @@ from . import interpret_mode
 
 NEG_INF = -1e30
 
+# trace-time counters: how often the public entry took the Pallas kernel path
+# vs the composed-XLA fallback (bench.py asserts the kernel path on TPU)
+KERNEL_CALLS = 0
+FALLBACK_CALLS = 0
+
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, causal, bq, bkv, kv_len):
     """Grid: (bh, num_q_blocks, num_kv_blocks); kv is innermost (sequential)."""
@@ -298,9 +303,12 @@ def flash_attention_bshd(q, k, v, attn_mask=None, causal=False, scale=None):
     skv = k.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    global KERNEL_CALLS, FALLBACK_CALLS
     tileable = (sq <= 128 and skv <= 128) or (sq % 128 == 0 and skv % 128 == 0)
     if attn_mask is not None or not tileable or d % 8 != 0:
+        FALLBACK_CALLS += 1
         return _composed_attention(q, k, v, attn_mask, causal, scale)
+    KERNEL_CALLS += 1
     if hkv != hq:
         rep = hq // hkv
         k = jnp.repeat(k, rep, axis=2)
