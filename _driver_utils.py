@@ -1,0 +1,43 @@
+"""Shared helper for the root driver scripts (bench.py, __graft_entry__.py).
+
+Subprocess execution with a HARD timeout: the axon TPU relay can hang (not
+raise) during backend init, and its forked helper processes inherit stdio fds
+— so output goes to temp files (a pipe would block the read forever after the
+child dies) and the child runs in its own session so the whole process group
+can be killed on timeout.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import tempfile
+
+
+def run_hard_timeout(cmd: list[str], timeout: float, cwd: str | None = None):
+    """Run cmd with a hard timeout; returns (returncode, stdout, stderr).
+
+    returncode is None if the process group had to be killed.  Partial output
+    written before the kill is still returned (it lives in the temp files).
+    """
+    with tempfile.TemporaryFile(mode="w+") as out_f, \
+            tempfile.TemporaryFile(mode="w+") as err_f:
+        proc = subprocess.Popen(
+            cmd, stdout=out_f, stderr=err_f, text=True, cwd=cwd,
+            start_new_session=True,
+        )
+        timed_out = False
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+        out_f.seek(0)
+        err_f.seek(0)
+        stdout, stderr = out_f.read(), err_f.read()
+    return (None if timed_out else proc.returncode), stdout, stderr

@@ -88,6 +88,11 @@ def run_bench():
     float(loss)
     print(f"[bench] warmup+compile {time.perf_counter() - t_c:.1f}s", file=sys.stderr)
     flash_kernel_used = fa.KERNEL_CALLS > kernel_calls_before
+    if on_tpu and not flash_kernel_used:
+        # loud but non-fatal: an MFU number with the composed-attention
+        # fallback is a perf regression worth seeing in the record
+        print("[bench] WARNING: TPU run did NOT take the Pallas flash kernel "
+              f"path (fallback calls: {fa.FALLBACK_CALLS})", file=sys.stderr)
 
     t0 = time.perf_counter()
     for _ in range(bench_steps):
@@ -135,35 +140,15 @@ def worker_main(force_cpu: bool) -> int:
 
 
 def _try_worker(args: list[str], timeout: int):
-    """Run a worker subprocess; return its parsed JSON result or None.
-
-    Output goes to temp files (not pipes): a hung backend init can fork helper
-    processes that inherit pipe fds and keep them open past the child's death,
-    which would block a communicate()-style read forever.  The worker runs in
-    its own session so the whole process group can be killed on timeout."""
-    import signal
-    import tempfile
+    """Run a worker subprocess (hard timeout, see _driver_utils); return its
+    parsed JSON result or None."""
+    from _driver_utils import run_hard_timeout
 
     cmd = [sys.executable, os.path.abspath(__file__), "--worker", *args]
-    with tempfile.TemporaryFile(mode="w+") as out_f, \
-            tempfile.TemporaryFile(mode="w+") as err_f:
-        proc = subprocess.Popen(
-            cmd, stdout=out_f, stderr=err_f, text=True,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            start_new_session=True,
-        )
-        try:
-            proc.wait(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                proc.kill()
-            proc.wait()
-            print(f"[bench] worker {args} timed out after {timeout}s", file=sys.stderr)
-        out_f.seek(0)
-        err_f.seek(0)
-        stdout, stderr = out_f.read(), err_f.read()
+    rc, stdout, stderr = run_hard_timeout(
+        cmd, timeout, cwd=os.path.dirname(os.path.abspath(__file__)))
+    if rc is None:
+        print(f"[bench] worker {args} timed out after {timeout}s", file=sys.stderr)
     sys.stderr.write(stderr[-4000:])  # incl. partial output of a killed worker
     for line in reversed(stdout.strip().splitlines()):
         try:

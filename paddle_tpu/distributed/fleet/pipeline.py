@@ -12,10 +12,11 @@ TPU-native realization in two tiers:
    and an eager runner executes them (single controller, stages sequential;
    correctness + golden schedule-string tests mirror the reference's
    ``static_scheduler`` trick at pipeline_parallel.py:711).
-2. **In-jit execution**: for uniform transformer stacks, stages are *stacked*
-   over the 'pipe' mesh axis and the microbatch loop runs under shard_map with
-   ``lax.ppermute`` activations transfers over ICI (see
-   paddle_tpu.models.llama train_step / GPipeStacked below).
+2. **In-jit execution** (:func:`gpipe_stacked` below): for uniform transformer
+   stacks, stages are *stacked* over the 'pp' mesh axis and the microbatch
+   loop runs under shard_map with ``lax.ppermute`` activation transfers over
+   ICI; AD through the scan gives the reverse pipeline.  Used by
+   paddle_tpu.models.llama.build_train_step when the mesh has pp > 1.
 """
 
 from __future__ import annotations
@@ -26,6 +27,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ...core.tensor import Tensor, no_grad
 from ...nn.layer_base import Layer
@@ -36,12 +38,94 @@ __all__ = [
     "PipelineLayer",
     "PipelineParallel",
     "SegmentLayers",
+    "gpipe_stacked",
     "schedule_fthenb",
     "schedule_1f1b",
     "schedule_interleave",
     "schedule_zero_bubble",
     "format_schedule",
 ]
+
+
+# ---------------- tier 2: in-jit stacked-stage pipeline ----------------------
+
+def gpipe_stacked(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
+                  extra_args=()):
+    """In-jit pipeline execution over the 'pp' mesh axis (the reference's
+    1F1B/interleave runtime — pipeline_parallel.py:684 — re-thought for SPMD).
+
+    The uniform layer stack is sharded over ``axis_name`` on its leading
+    (layer) dim so each device holds one stage's contiguous slice.  Inside a
+    partial-manual ``jax.shard_map`` (only 'pp' manual; dp/mp/sharding/sep stay
+    GSPMD-auto) a ``lax.scan`` runs M + P - 1 ticks: at tick t, stage s runs
+    microbatch t - s and hands its activation to stage s+1 with
+    ``lax.ppermute`` over ICI.  Differentiating through the scan + ppermute
+    yields the reverse pipeline automatically (ppermute transposes to the
+    reversed permutation), so fwd+bwd are both pipelined in one compiled
+    program — the TPU analog of the reference's p2p send/recv schedules.
+    The schedule is GPipe (fill-drain); its bubble matches FThenB, and the
+    XLA latency-hiding scheduler overlaps the ppermute with stage compute.
+
+    Args:
+      stage_fn: ``(local_stage_params, x, *extra_args) -> y`` applying one
+        stage's layers (leaves of ``local_stage_params`` carry leading dim
+        L/P inside the shard_map body).
+      stacked_params: pytree with leading layer dim L (divisible by P),
+        sharded over ``axis_name``.
+      microbatches: ``[M, mb, ...]`` input activations, replicated over pp.
+      extra_args: broadcast arrays every stage needs (e.g. rope cos/sin).
+
+    Returns ``[M, mb, ...]`` last-stage outputs, replicated over pp.
+    """
+    n_stages = mesh.shape[axis_name]
+    num_micro = microbatches.shape[0]
+    fwd_perm = [(p, p + 1) for p in range(n_stages - 1)]
+    compute_dtype = microbatches.dtype
+    # f32 at the shard_map boundary: the transpose of the pp-replicated input
+    # is a psum over 'pp', and XLA CPU's AllReducePromotion pass crashes on
+    # bf16 all-reduces; compute stays in the caller's dtype inside.
+    microbatches = microbatches.astype(jnp.float32)
+
+    def inner(local_params, mb_in, *extras):
+        mb_in = mb_in.astype(compute_dtype)
+        stage = jax.lax.axis_index(axis_name)
+        is_first = stage == 0
+        is_last = stage == n_stages - 1
+
+        def tick(carry, t):
+            recv, outbuf = carry
+            i = t - stage  # microbatch this stage processes at this tick
+            x0 = jax.lax.dynamic_index_in_dim(
+                mb_in, jnp.clip(t, 0, num_micro - 1), axis=0, keepdims=False)
+            x_in = jnp.where(is_first, x0, recv)
+            y = stage_fn(local_params, x_in, *extras)
+            # last stage writes its result at microbatch slot i
+            valid = is_last & (i >= 0) & (i < num_micro)
+            iw = jnp.clip(i, 0, num_micro - 1)
+            cur = jax.lax.dynamic_index_in_dim(outbuf, iw, axis=0, keepdims=False)
+            outbuf = jax.lax.dynamic_update_index_in_dim(
+                outbuf, jnp.where(valid, y, cur), iw, axis=0)
+            recv = jax.lax.ppermute(y, axis_name, fwd_perm)
+            return (recv, outbuf), None
+
+        recv0 = jnp.zeros(mb_in.shape[1:], mb_in.dtype)
+        outbuf0 = jnp.zeros_like(mb_in)
+        (_, outbuf), _ = jax.lax.scan(
+            tick, (recv0, outbuf0), jnp.arange(num_micro + n_stages - 1))
+        # only the last stage ever wrote non-zeros: psum replicates its buffer
+        # (f32 all-reduce: XLA CPU's AllReducePromotion pass crashes on bf16)
+        return jax.lax.psum(outbuf.astype(jnp.float32), axis_name).astype(mb_in.dtype)
+
+    pp_leading = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    rep = P()
+    return jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(pp_leading, rep) + tuple(rep for _ in extra_args),
+        out_specs=rep,
+        axis_names={axis_name},
+        check_vma=False,
+    )(stacked_params, microbatches, *extra_args)
 
 
 class LayerDesc:

@@ -104,24 +104,28 @@ def init_params(cfg: LlamaConfig, key=None) -> dict:
     return params
 
 
-def param_specs(cfg: LlamaConfig) -> dict:
+def param_specs(cfg: LlamaConfig, pp: bool = False) -> dict:
     """PartitionSpecs = the Megatron TP sharding map of the reference's mp_layers
     (ColumnParallelLinear splits output dim over 'mp', RowParallelLinear splits
     input dim; VocabParallelEmbedding splits vocab), plus ZeRO over 'sharding'
-    on the other dim (fleet sharding stage 3 analog)."""
+    on the other dim (fleet sharding stage 3 analog).  With ``pp`` the stacked
+    layer dim is sharded over the 'pp' mesh axis — each device holds one
+    pipeline stage's contiguous layer slice (the PipelineLayer segmentation of
+    pp_layers.py:258, realized as a sharding)."""
+    layer_dim = "pp" if pp else None
     return {
         "embed": P("mp", "sharding"),          # vocab-parallel embedding
         "final_norm": P(None),
         "layers": {
-            "input_norm": P(None, None),
-            "post_norm": P(None, None),
-            "wq": P(None, "sharding", "mp"),   # column parallel
-            "wk": P(None, "sharding", "mp"),
-            "wv": P(None, "sharding", "mp"),
-            "wo": P(None, "mp", "sharding"),   # row parallel
-            "w_gate": P(None, "sharding", "mp"),
-            "w_up": P(None, "sharding", "mp"),
-            "w_down": P(None, "mp", "sharding"),
+            "input_norm": P(layer_dim, None),
+            "post_norm": P(layer_dim, None),
+            "wq": P(layer_dim, "sharding", "mp"),   # column parallel
+            "wk": P(layer_dim, "sharding", "mp"),
+            "wv": P(layer_dim, "sharding", "mp"),
+            "wo": P(layer_dim, "mp", "sharding"),   # row parallel
+            "w_gate": P(layer_dim, "sharding", "mp"),
+            "w_up": P(layer_dim, "sharding", "mp"),
+            "w_down": P(layer_dim, "mp", "sharding"),
         },
         "lm_head": P("sharding", "mp"),
     }
@@ -154,20 +158,16 @@ def _layer_forward(cfg: LlamaConfig, x, layer_params, cos, sin, use_flash=True):
     return x
 
 
-def forward(cfg: LlamaConfig, params, input_ids, use_flash=True, remat=True):
-    """Logits for [b, s] token ids.  The layer stack is a lax.scan over the
-    stacked layer weights with jax.checkpoint (activation recompute ≙ the
-    reference's recompute_sequential over transformer blocks)."""
+def _embed_rope(cfg: LlamaConfig, params, input_ids):
+    """Shared prelude: token embedding + rope tables for the sequence length."""
     x = jnp.take(params["embed"], input_ids, axis=0).astype(cfg.dtype)
-    b, s, h = x.shape
-    cos, sin = rope_mod.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_theta, dtype=cfg.dtype)
+    cos, sin = rope_mod.rope_cos_sin(
+        x.shape[1], cfg.head_dim, base=cfg.rope_theta, dtype=cfg.dtype)
+    return x, cos, sin
 
-    def body(carry, lp):
-        out = _layer_forward(cfg, carry, lp, cos, sin, use_flash)
-        return out, None
 
-    scan_body = jax.checkpoint(body) if remat else body
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+def _final_head(cfg: LlamaConfig, params, x):
+    """Shared tail: final rms_norm + (possibly tied) lm head."""
     x = rms.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
@@ -175,11 +175,61 @@ def forward(cfg: LlamaConfig, params, input_ids, use_flash=True, remat=True):
     return x @ head
 
 
-def loss_fn(cfg: LlamaConfig, params, input_ids, labels):
-    logits = forward(cfg, params, input_ids).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
+def forward(cfg: LlamaConfig, params, input_ids, use_flash=True, remat=True):
+    """Logits for [b, s] token ids.  The layer stack is a lax.scan over the
+    stacked layer weights with jax.checkpoint (activation recompute ≙ the
+    reference's recompute_sequential over transformer blocks)."""
+    x, cos, sin = _embed_rope(cfg, params, input_ids)
+
+    def body(carry, lp):
+        out = _layer_forward(cfg, carry, lp, cos, sin, use_flash)
+        return out, None
+
+    scan_body = jax.checkpoint(body) if remat else body
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    return _final_head(cfg, params, x)
+
+
+def forward_pp(cfg: LlamaConfig, params, input_ids, mesh, num_microbatches,
+               use_flash=True, remat=True):
+    """Pipeline-parallel forward: the stacked layer dim is sharded over 'pp'
+    and executed by the in-jit GPipe engine (fleet/pipeline.py gpipe_stacked ≙
+    the reference's PipelineParallel.forward_backward_pipeline at
+    pipeline_parallel.py:684, as one compiled SPMD program)."""
+    from ..distributed.fleet.pipeline import gpipe_stacked
+
+    x, cos, sin = _embed_rope(cfg, params, input_ids)
+    b, s, h = x.shape
+    M = num_microbatches
+    assert b % M == 0, f"batch {b} not divisible by num_microbatches {M}"
+    xm = x.reshape(M, b // M, s, h)
+
+    def stage_fn(stage_params, xin, cos_, sin_):
+        def body(carry, lp):
+            return _layer_forward(cfg, carry, lp, cos_, sin_, use_flash), None
+
+        scan_body = jax.checkpoint(body) if remat else body
+        y, _ = jax.lax.scan(scan_body, xin, stage_params)
+        return y
+
+    outs = gpipe_stacked(stage_fn, params["layers"], xm, mesh, "pp",
+                         extra_args=(cos, sin))
+    return _final_head(cfg, params, outs.reshape(b, s, h))
+
+
+def _xent(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     return -jnp.mean(picked)
+
+
+def loss_fn(cfg: LlamaConfig, params, input_ids, labels):
+    return _xent(forward(cfg, params, input_ids), labels)
+
+
+def loss_fn_pp(cfg: LlamaConfig, params, input_ids, labels, mesh, num_microbatches):
+    logits = forward_pp(cfg, params, input_ids, mesh, num_microbatches)
+    return _xent(logits, labels)
 
 
 def make_mesh(dp=1, mp=1, sharding=1, sep=1, pp=1, devices=None):
@@ -192,14 +242,22 @@ def make_mesh(dp=1, mp=1, sharding=1, sep=1, pp=1, devices=None):
 
 
 def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
-                     beta1=0.9, beta2=0.95, grad_clip=1.0):
+                     beta1=0.9, beta2=0.95, grad_clip=1.0, num_microbatches=None):
     """The pjit-compiled train step: forward+backward+AdamW, all sharded.
 
     Data: [b, s] sharded ('dp'+'sharding' on batch, 'sep' on sequence).
     GSPMD propagates the Megatron weight specs through the scan; gradient psum
     over 'dp' and optimizer-state sharding over 'sharding' (ZeRO-1/2) come out
-    of the same spec algebra — no per-op SPMD rules needed (SURVEY.md §3.4)."""
-    specs = param_specs(cfg)
+    of the same spec algebra — no per-op SPMD rules needed (SURVEY.md §3.4).
+    When the mesh carries a 'pp' axis > 1, the layer stack is staged over it
+    and the forward runs through the in-jit GPipe engine with
+    ``num_microbatches`` (default: pp size) microbatches."""
+    pp = dict(mesh.shape).get("pp", 1)
+    if pp > 1:
+        assert cfg.num_hidden_layers % pp == 0, (
+            f"{cfg.num_hidden_layers} layers not divisible by pp={pp}")
+        num_microbatches = num_microbatches or pp
+    specs = param_specs(cfg, pp=pp > 1)
     data_spec = P(("dp", "sharding"), "sep")
 
     def to_named(tree_specs):
@@ -220,7 +278,11 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
         }
 
     def train_step(params, opt_state, input_ids, labels):
-        loss, grads = jax.value_and_grad(lambda p: loss_fn(cfg, p, input_ids, labels))(params)
+        if pp > 1:
+            lfn = lambda p: loss_fn_pp(cfg, p, input_ids, labels, mesh, num_microbatches)
+        else:
+            lfn = lambda p: loss_fn(cfg, p, input_ids, labels)
+        loss, grads = jax.value_and_grad(lfn)(params)
         g32 = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
         # global-norm clip (HybridParallelClipGrad semantics; psum over all axes
         # is implicit — the sharded sum-of-squares reduces globally under GSPMD)

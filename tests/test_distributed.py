@@ -450,3 +450,69 @@ def test_data_parallel_grad_sync():
     np.testing.assert_allclose(
         np.asarray(model.weight._grad), np.stack([2 * g0, 2 * g0]), rtol=1e-6
     )
+
+
+# ---------------- in-jit pipeline (gpipe_stacked) ----------------
+
+def test_gpipe_stacked_fwd_grad_parity():
+    """The in-jit pipeline engine matches sequential layer application exactly
+    (fwd) and in gradients (the AD-through-ppermute reverse pipeline)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.pipeline import gpipe_stacked
+
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), axis_names=("pp",))
+    L, h = 4, 8
+    W = jnp.asarray(rng.randn(L, h, h), jnp.float32) * 0.1
+    xm = jnp.asarray(rng.randn(3, 2, h), jnp.float32)  # [M=3, mb=2, h]
+
+    def stage_fn(sp, x):
+        def body(c, w):
+            return jnp.tanh(c @ w), None
+
+        y, _ = jax.lax.scan(body, x, sp)
+        return y
+
+    W_sh = jax.device_put(W, NamedSharding(mesh, P("pp")))
+    out = jax.jit(lambda W_, x_: gpipe_stacked(stage_fn, W_, x_, mesh, "pp"))(W_sh, xm)
+    ref = xm
+    for l in range(L):
+        ref = jnp.tanh(ref @ W[l])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    g = jax.jit(jax.grad(lambda W_, x_: gpipe_stacked(stage_fn, W_, x_, mesh, "pp").sum()))(W_sh, xm)
+
+    def seq_loss(W_):
+        r = xm
+        for l in range(L):
+            r = jnp.tanh(r @ W_[l])
+        return r.sum()
+
+    g_ref = jax.grad(seq_loss)(W)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4)
+
+
+def test_llama_train_step_pp_parity():
+    """pp=2 staged train step matches pp=1 loss over two optimizer steps
+    (VERDICT r1 item 3: in-jit pipeline execution, not the eager simulator)."""
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab=256, hidden=64, layers=4, heads=4, kv_heads=2, inter=128)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 128)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 128)))
+
+    losses = {}
+    states = {}
+    for name, kw in {"pp1": dict(dp=1), "pp2": dict(pp=2, dp=2, mp=2)}.items():
+        mesh = llama.make_mesh(**kw, devices=jax.devices()[: max(1, np.prod(list(kw.values())))])
+        step, oinit, pshard, dshard = llama.build_train_step(cfg, mesh)
+        p = jax.device_put(llama.init_params(cfg, jax.random.key(0)), pshard)
+        o = oinit(p)
+        i = jax.device_put(ids, dshard)
+        y = jax.device_put(labels, dshard)
+        l1, p, o = step(p, o, i, y)
+        l2, p, o = step(p, o, i, y)
+        losses[name] = (float(l1), float(l2))
+
+    np.testing.assert_allclose(losses["pp1"][0], losses["pp2"][0], rtol=2e-2)
+    np.testing.assert_allclose(losses["pp1"][1], losses["pp2"][1], rtol=2e-2)
