@@ -50,7 +50,7 @@ __all__ = [
 # ---------------- tier 2: in-jit stacked-stage pipeline ----------------------
 
 def gpipe_stacked(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
-                  extra_args=()):
+                  extra_args=(), mb_spec=None, extra_specs=None, manual_axes=()):
     """In-jit pipeline execution over the 'pp' mesh axis (the reference's
     1F1B/interleave runtime — pipeline_parallel.py:684 — re-thought for SPMD).
 
@@ -74,20 +74,32 @@ def gpipe_stacked(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
         sharded over ``axis_name``.
       microbatches: ``[M, mb, ...]`` input activations, replicated over pp.
       extra_args: broadcast arrays every stage needs (e.g. rope cos/sin).
+      mb_spec / extra_specs / manual_axes: bind ADDITIONAL mesh axes manually
+        in the same region (sdy cannot nest partial-manual regions over one
+        mesh) — e.g. context parallelism passes manual_axes=("sep",) with the
+        sequence dim of mb_spec/extra_specs sharded over 'sep' and runs ring
+        attention directly inside stage_fn.
 
-    Returns ``[M, mb, ...]`` last-stage outputs, replicated over pp.
+    Returns ``[M, mb, ...]`` last-stage outputs, replicated over pp (sharded
+    per mb_spec over any extra manual axes).
     """
     n_stages = mesh.shape[axis_name]
     num_micro = microbatches.shape[0]
     fwd_perm = [(p, p + 1) for p in range(n_stages - 1)]
-    compute_dtype = microbatches.dtype
-    # f32 at the shard_map boundary: the transpose of the pp-replicated input
+    # f32 at the shard_map boundary: the transpose of any pp-replicated input
     # is a psum over 'pp', and XLA CPU's AllReducePromotion pass crashes on
-    # bf16 all-reduces; compute stays in the caller's dtype inside.
-    microbatches = microbatches.astype(jnp.float32)
+    # bf16 all-reduces; compute stays in the caller's dtypes inside.
+    def _f32(t):
+        return t.astype(jnp.float32) if jnp.issubdtype(t.dtype, jnp.floating) else t
+
+    compute_dtype = microbatches.dtype
+    extra_dtypes = tuple(e.dtype for e in extra_args)
+    microbatches = _f32(microbatches)
+    extra_args = tuple(_f32(e) for e in extra_args)
 
     def inner(local_params, mb_in, *extras):
         mb_in = mb_in.astype(compute_dtype)
+        extras = tuple(e.astype(dt) for e, dt in zip(extras, extra_dtypes))
         stage = jax.lax.axis_index(axis_name)
         is_first = stage == 0
         is_last = stage == n_stages - 1
@@ -117,13 +129,15 @@ def gpipe_stacked(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
         return jax.lax.psum(outbuf.astype(jnp.float32), axis_name).astype(mb_in.dtype)
 
     pp_leading = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
-    rep = P()
+    mb_spec = mb_spec if mb_spec is not None else P()
+    extra_specs = tuple(extra_specs) if extra_specs is not None else tuple(
+        P() for _ in extra_args)
     return jax.shard_map(
         inner,
         mesh=mesh,
-        in_specs=(pp_leading, rep) + tuple(rep for _ in extra_args),
-        out_specs=rep,
-        axis_names={axis_name},
+        in_specs=(pp_leading, mb_spec) + extra_specs,
+        out_specs=mb_spec,
+        axis_names={axis_name, *manual_axes},
         check_vma=False,
     )(stacked_params, microbatches, *extra_args)
 

@@ -131,8 +131,11 @@ def param_specs(cfg: LlamaConfig, pp: bool = False) -> dict:
     }
 
 
-def _layer_forward(cfg: LlamaConfig, x, layer_params, cos, sin, use_flash=True):
-    """One transformer block; x: [b, s, h]."""
+def _layer_forward(cfg: LlamaConfig, x, layer_params, cos, sin, use_flash=True,
+                   attn_fn=None):
+    """One transformer block; x: [b, s, h].  ``attn_fn(q, k, v) -> out`` (all
+    BSHD) overrides the attention implementation — used by the context-parallel
+    path to route through ring attention over the 'sep' axis."""
     lp = layer_params
     b, s, h = x.shape
     nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -143,7 +146,9 @@ def _layer_forward(cfg: LlamaConfig, x, layer_params, cos, sin, use_flash=True):
     kk = (xn @ lp["wk"]).reshape(b, s, nkv, hd)
     vv = (xn @ lp["wv"]).reshape(b, s, nkv, hd)
     q, kk = rope_mod.apply_rotary_pos_emb(q, kk, cos, sin)
-    if use_flash:
+    if attn_fn is not None:
+        attn = attn_fn(q, kk, vv)
+    elif use_flash:
         attn = fa.flash_attention_bshd(q, kk, vv, causal=True)
     else:
         attn = fa._composed_attention(q, kk, vv, None, True, 1.0 / math.sqrt(hd))
@@ -175,14 +180,42 @@ def _final_head(cfg: LlamaConfig, params, x):
     return x @ head
 
 
-def forward(cfg: LlamaConfig, params, input_ids, use_flash=True, remat=True):
+def sep_attention(mesh: Mesh, axis: str = "sep", impl: str = "ring"):
+    """Context-parallel attention over the mesh's sequence axis (the reference's
+    sep axis + SegmentParallel, segment_parallel.py:26; flash-attention SPMD
+    rule with sharded seq, spmd_rules/flash_attention.cc).
+
+    Returns an ``attn_fn(q, k, v)`` (BSHD) that binds the 'sep' axis with a
+    partial-manual shard_map — only 'sep' goes manual, dp/mp/sharding stay
+    GSPMD-auto — and runs ring attention (K/V blocks rotating over ICI with
+    ppermute) or Ulysses (all_to_all heads<->seq) on the local shards."""
+    from ..ops import ring_attention as ra
+
+    seq_spec = P(None, axis, None, None)
+
+    def attn_fn(q, k, v):
+        def local(q_, k_, v_):
+            if impl == "ulysses":
+                return ra.ulysses_attention(q_, k_, v_, axis_name=axis, causal=True)
+            return ra.ring_attention(q_, k_, v_, axis_name=axis, causal=True)
+
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=(seq_spec,) * 3, out_specs=seq_spec,
+            axis_names={axis}, check_vma=False,
+        )(q, k, v)
+
+    return attn_fn
+
+
+def forward(cfg: LlamaConfig, params, input_ids, use_flash=True, remat=True,
+            attn_fn=None):
     """Logits for [b, s] token ids.  The layer stack is a lax.scan over the
     stacked layer weights with jax.checkpoint (activation recompute ≙ the
     reference's recompute_sequential over transformer blocks)."""
     x, cos, sin = _embed_rope(cfg, params, input_ids)
 
     def body(carry, lp):
-        out = _layer_forward(cfg, carry, lp, cos, sin, use_flash)
+        out = _layer_forward(cfg, carry, lp, cos, sin, use_flash, attn_fn)
         return out, None
 
     scan_body = jax.checkpoint(body) if remat else body
@@ -191,29 +224,49 @@ def forward(cfg: LlamaConfig, params, input_ids, use_flash=True, remat=True):
 
 
 def forward_pp(cfg: LlamaConfig, params, input_ids, mesh, num_microbatches,
-               use_flash=True, remat=True):
+               use_flash=True, remat=True, sep_attn_impl="ring"):
     """Pipeline-parallel forward: the stacked layer dim is sharded over 'pp'
     and executed by the in-jit GPipe engine (fleet/pipeline.py gpipe_stacked ≙
     the reference's PipelineParallel.forward_backward_pipeline at
-    pipeline_parallel.py:684, as one compiled SPMD program)."""
-    from ..distributed.fleet.pipeline import gpipe_stacked
+    pipeline_parallel.py:684, as one compiled SPMD program).
 
+    When the mesh also has 'sep' > 1, sep is bound manually in the SAME region
+    (sdy cannot nest partial-manual regions): microbatches and rope tables are
+    seq-sharded over 'sep' and attention runs ring/Ulysses directly."""
+    from ..distributed.fleet.pipeline import gpipe_stacked
+    from ..ops import ring_attention as ra
+
+    sep = dict(mesh.shape).get("sep", 1)
     x, cos, sin = _embed_rope(cfg, params, input_ids)
     b, s, h = x.shape
     M = num_microbatches
     assert b % M == 0, f"batch {b} not divisible by num_microbatches {M}"
     xm = x.reshape(M, b // M, s, h)
 
+    if sep > 1:
+        if sep_attn_impl == "ulysses":
+            attn_fn = lambda q, k, v: ra.ulysses_attention(q, k, v, axis_name="sep", causal=True)
+        else:
+            attn_fn = lambda q, k, v: ra.ring_attention(q, k, v, axis_name="sep", causal=True)
+        gp_kw = dict(
+            mb_spec=P(None, None, "sep", None),
+            extra_specs=(P(None, "sep", None),) * 2,  # rope [1, s, d]: local slices
+            manual_axes=("sep",),
+        )
+    else:
+        attn_fn = None
+        gp_kw = {}
+
     def stage_fn(stage_params, xin, cos_, sin_):
         def body(carry, lp):
-            return _layer_forward(cfg, carry, lp, cos_, sin_, use_flash), None
+            return _layer_forward(cfg, carry, lp, cos_, sin_, use_flash, attn_fn), None
 
         scan_body = jax.checkpoint(body) if remat else body
         y, _ = jax.lax.scan(scan_body, xin, stage_params)
         return y
 
     outs = gpipe_stacked(stage_fn, params["layers"], xm, mesh, "pp",
-                         extra_args=(cos, sin))
+                         extra_args=(cos, sin), **gp_kw)
     return _final_head(cfg, params, outs.reshape(b, s, h))
 
 
@@ -223,12 +276,14 @@ def _xent(logits, labels):
     return -jnp.mean(picked)
 
 
-def loss_fn(cfg: LlamaConfig, params, input_ids, labels):
-    return _xent(forward(cfg, params, input_ids), labels)
+def loss_fn(cfg: LlamaConfig, params, input_ids, labels, attn_fn=None):
+    return _xent(forward(cfg, params, input_ids, attn_fn=attn_fn), labels)
 
 
-def loss_fn_pp(cfg: LlamaConfig, params, input_ids, labels, mesh, num_microbatches):
-    logits = forward_pp(cfg, params, input_ids, mesh, num_microbatches)
+def loss_fn_pp(cfg: LlamaConfig, params, input_ids, labels, mesh, num_microbatches,
+               sep_attn_impl="ring"):
+    logits = forward_pp(cfg, params, input_ids, mesh, num_microbatches,
+                        sep_attn_impl=sep_attn_impl)
     return _xent(logits, labels)
 
 
@@ -242,7 +297,8 @@ def make_mesh(dp=1, mp=1, sharding=1, sep=1, pp=1, devices=None):
 
 
 def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
-                     beta1=0.9, beta2=0.95, grad_clip=1.0, num_microbatches=None):
+                     beta1=0.9, beta2=0.95, grad_clip=1.0, num_microbatches=None,
+                     sep_attn_impl="ring"):
     """The pjit-compiled train step: forward+backward+AdamW, all sharded.
 
     Data: [b, s] sharded ('dp'+'sharding' on batch, 'sep' on sequence).
@@ -251,12 +307,18 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
     of the same spec algebra — no per-op SPMD rules needed (SURVEY.md §3.4).
     When the mesh carries a 'pp' axis > 1, the layer stack is staged over it
     and the forward runs through the in-jit GPipe engine with
-    ``num_microbatches`` (default: pp size) microbatches."""
+    ``num_microbatches`` (default: pp size) microbatches.  When 'sep' > 1,
+    attention routes through ring attention over the sep axis
+    (``sep_attn_impl``: 'ring' or 'ulysses') with the sequence sharded."""
     pp = dict(mesh.shape).get("pp", 1)
+    sep = dict(mesh.shape).get("sep", 1)
     if pp > 1:
         assert cfg.num_hidden_layers % pp == 0, (
             f"{cfg.num_hidden_layers} layers not divisible by pp={pp}")
         num_microbatches = num_microbatches or pp
+    # pp>1 binds sep inside its own manual region (forward_pp); otherwise wrap
+    # attention in its own sep shard_map
+    attn_fn = sep_attention(mesh, "sep", sep_attn_impl) if sep > 1 and pp == 1 else None
     specs = param_specs(cfg, pp=pp > 1)
     data_spec = P(("dp", "sharding"), "sep")
 
@@ -279,9 +341,10 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
 
     def train_step(params, opt_state, input_ids, labels):
         if pp > 1:
-            lfn = lambda p: loss_fn_pp(cfg, p, input_ids, labels, mesh, num_microbatches)
+            lfn = lambda p: loss_fn_pp(cfg, p, input_ids, labels, mesh,
+                                       num_microbatches, sep_attn_impl)
         else:
-            lfn = lambda p: loss_fn(cfg, p, input_ids, labels)
+            lfn = lambda p: loss_fn(cfg, p, input_ids, labels, attn_fn)
         loss, grads = jax.value_and_grad(lfn)(params)
         g32 = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
         # global-norm clip (HybridParallelClipGrad semantics; psum over all axes
