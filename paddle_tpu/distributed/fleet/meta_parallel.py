@@ -44,7 +44,49 @@ class TensorParallel(MetaParallelBase):
 
 class SegmentParallel(MetaParallelBase):
     """sep-axis wrapper (segment_parallel.py:26): sequence dim sharded over the
-    'sep' mesh axis; attention runs ring/alltoall via the sep collectives."""
+    'sep' mesh axis; attention runs ring/alltoall via the sep collectives.
+
+    The reference scatters each input batch along the sequence dim across the
+    sep group before forward and keeps attention sep-aware.  TPU-native: the
+    wrapper places parameters on the hybrid mesh (replicated over 'sep') and
+    shards the inputs' sequence dim over 'sep' with a NamedSharding, so GSPMD
+    runs every position-wise op on local sequence shards; attention itself
+    must go through a sep-aware kernel (ops.ring_attention /
+    models.llama.sep_attention) — exposed here as :meth:`sep_attention`."""
+
+    def __init__(self, layers, hcg=None, strategy=None, seq_axis=1):
+        super().__init__(layers, hcg, strategy)
+        self._seq_axis = seq_axis
+        from .mpu import shard_parameters_to_mesh
+
+        self._mesh = hcg.mesh if hcg is not None else None
+        shard_parameters_to_mesh(layers, self._mesh)
+
+    def sep_attention(self, impl: str = "ring"):
+        """attn_fn(q, k, v) running ring/Ulysses over this mesh's sep axis."""
+        from ...models.llama import sep_attention
+
+        return sep_attention(self._mesh, "sep", impl)
+
+    def _shard_seq(self, x):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        val = getattr(x, "_value", x)
+        if not hasattr(val, "ndim") or val.ndim <= self._seq_axis:
+            return x
+        spec = [None] * val.ndim
+        spec[self._seq_axis] = "sep"
+        out = jax.device_put(val, NamedSharding(self._mesh, PartitionSpec(*spec)))
+        if hasattr(x, "_value"):
+            x._value = out
+            return x
+        return out
+
+    def forward(self, *inputs, **kwargs):
+        if self._mesh is not None and dict(self._mesh.shape).get("sep", 1) > 1:
+            inputs = tuple(self._shard_seq(x) for x in inputs)
+        return self._layers(*inputs, **kwargs)
 
 
 from .pipeline import PipelineParallel  # noqa: E402,F401
