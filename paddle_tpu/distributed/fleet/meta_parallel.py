@@ -59,6 +59,10 @@ class SegmentParallel(MetaParallelBase):
         self._seq_axis = seq_axis
         from .mpu import shard_parameters_to_mesh
 
+        if hcg is None:
+            from .topology import get_hybrid_communicate_group
+
+            hcg = get_hybrid_communicate_group()
         self._mesh = hcg.mesh if hcg is not None else None
         shard_parameters_to_mesh(layers, self._mesh)
 
@@ -75,7 +79,13 @@ class SegmentParallel(MetaParallelBase):
         val = getattr(x, "_value", x)
         if not hasattr(val, "ndim") or val.ndim <= self._seq_axis:
             return x
-        spec = [None] * val.ndim
+        # preserve the input's existing placement on non-sequence axes
+        # (e.g. batch sharded over 'dp') — only the seq axis is constrained
+        cur = getattr(val, "sharding", None)
+        if isinstance(cur, NamedSharding) and cur.mesh == self._mesh:
+            spec = list(cur.spec) + [None] * (val.ndim - len(cur.spec))
+        else:
+            spec = [None] * val.ndim
         spec[self._seq_axis] = "sep"
         out = jax.device_put(val, NamedSharding(self._mesh, PartitionSpec(*spec)))
         if hasattr(x, "_value"):

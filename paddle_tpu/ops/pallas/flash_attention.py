@@ -2,18 +2,31 @@
 
 Replaces the reference's CUDA flash-attn v2/v3 integration
 (`paddle/phi/kernels/gpu/flash_attn_kernel.cu`, dynload
-`paddle/phi/backends/dynload/flashattn.h`) with a TPU-native online-softmax
-kernel: Q/K/V tiles stream HBM→VMEM, logits never materialize in HBM, the MXU
-does the two matmuls per tile and the VPU the online rescale.
+`paddle/phi/backends/dynload/flashattn.h`, varlen entry
+`flash_attn_varlen_kernel`) with a TPU-native online-softmax kernel: Q/K/V
+tiles stream HBM→VMEM, logits never materialize in HBM, the MXU does the two
+matmuls per tile and the VPU the online rescale.
+
+Feature parity with the reference kernel family:
+- causal and full attention;
+- GQA/MQA natively: K/V blocks are indexed per kv head group inside the grid
+  (``bh // rep`` index maps) — grouped heads are never materialized in HBM;
+- arbitrary sequence lengths: inputs are padded to the block grid and the
+  kernel masks out-of-range KV columns (padded Q rows are sliced off);
+- packed/varlen sequences via ``segment_ids`` (the TPU-native analog of the
+  reference's cu_seqlens varlen API): positions attend only within equal ids;
+- dense additive/boolean ``attn_mask`` ([b|1, h|1, sq, skv]) streamed through
+  the kernel block-by-block — the mask is read tile-wise, logits still never
+  hit HBM.
 
 Layout: public entry takes BSHD ([batch, seq, heads, head_dim], the paddle
 convention); the kernel runs BHSD grids of (batch*heads, q_blocks, kv_blocks).
 
 Backward: two Pallas kernels (FlashAttention-2 recurrence) — a dk/dv kernel
-gridded over kv blocks with q innermost, and a dq kernel gridded over q blocks
-with kv innermost.  Per-tile probabilities are recomputed exactly from the
-saved log-sum-exp; delta = rowsum(dO·O) is precomputed in XLA (O(s·d)).
-Logits/probabilities never materialize in HBM in either direction.
+gridded over kv blocks with (group, q) innermost, and a dq kernel gridded over
+q blocks with kv innermost.  Per-tile probabilities are recomputed exactly
+from the saved log-sum-exp; delta = rowsum(dO·O) is precomputed in XLA
+(O(s·d)).  Block sizes are chosen per-call from a VMEM budget.
 """
 
 from __future__ import annotations
@@ -42,9 +55,127 @@ NEG_INF = -1e30
 KERNEL_CALLS = 0
 FALLBACK_CALLS = 0
 
+# VMEM working-set budget for block-size selection (per-core VMEM is ~16 MiB;
+# leave headroom for the pipeline's double buffering and the compiler)
+_VMEM_BUDGET = 8 * 1024 * 1024
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, causal, bq, bkv, kv_len):
-    """Grid: (bh, num_q_blocks, num_kv_blocks); kv is innermost (sequential)."""
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _pick_block(seq: int, cap: int) -> int:
+    """Largest block in {cap, ..., 128} that divides the 128-padded length;
+    sequences shorter than 128 become a single 8-aligned block."""
+    if seq < 128:
+        return _round_up(seq, 8)
+    padded = _round_up(seq, 128)
+    bs = cap
+    while bs > 128 and padded % bs:
+        bs //= 2
+    return bs
+
+
+def _pick_blocks(sq: int, skv: int, d: int, has_mask: bool) -> tuple[int, int]:
+    """(bq, bkv) under the VMEM budget.  Working set per grid step (fp32,
+    double-buffered inputs): q + 2·kv + optional mask tile + s/p intermediates
+    + accumulators."""
+    cap = 512
+
+    def fits(bq, bkv):
+        inputs = 2 * (bq * d + 2 * bkv * d) * 4          # double-buffered
+        mask_b = 2 * bq * bkv * 4 if has_mask else 0
+        scratch = (bq * d + 2 * bq) * 4
+        inter = 3 * bq * bkv * 4                          # s, p, selects
+        return inputs + mask_b + scratch + inter <= _VMEM_BUDGET
+
+    bq, bkv = _pick_block(sq, cap), _pick_block(skv, cap)
+    while not fits(bq, bkv) and bkv > 128:
+        bkv //= 2
+    while not fits(bq, bkv) and bq > 128:
+        bq //= 2
+    return bq, bkv
+
+
+def _pad_seq(x, seq_axis: int, target: int):
+    pad = target - x.shape[seq_axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[seq_axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _mask_index_fn(b: int, hq: int, mb: int, mh: int):
+    """Grid-dim-0 (b·hq) → mask row index for a [mb·mh, sq, skv] mask with
+    broadcastable batch/head dims (mb ∈ {1,b}, mh ∈ {1,hq})."""
+
+    def idx(bh):
+        batch = bh // hq
+        h = bh % hq
+        return (batch if mb > 1 else 0) * mh + (h if mh > 1 else 0)
+
+    return idx
+
+
+def _tile_mask(s, mask_blk):
+    """Apply one streamed mask tile to the logits tile."""
+    if mask_blk.dtype == jnp.bool_:
+        return jnp.where(mask_blk, s, NEG_INF)
+    return s + mask_blk.astype(jnp.float32)
+
+
+def _seg_mask(s, q_seg, kv_seg):
+    """Packed-sequence mask: attend only within equal segment ids.
+    Seg refs are [1, blk, 1] (trailing singleton keeps Mosaic's last-two-dims
+    block constraint satisfiable)."""
+    return jnp.where(q_seg[0, :, 0][:, None] == kv_seg[0, :, 0][None, :],
+                     s, NEG_INF)
+
+
+def _bounds_mask(s, kv_idx, bkv, kv_len):
+    """Mask padded KV columns (seq padded up to the block grid)."""
+    cols = kv_idx * bkv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(cols < kv_len, s, NEG_INF)
+
+
+def _causal_mask(s, q_idx, bq, kv_idx, bkv):
+    rows = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = kv_idx * bkv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
+def _masked_logits(q, k, refs, q_idx, kv_idx, *, scale, causal, bq, bkv,
+                   kv_len, skv_pad, has_mask, has_seg):
+    """Shared fwd/bwd logits tile: QK^T · scale with all masks applied.
+    ``refs`` holds the optional (mask, q_seg, kv_seg) refs in order."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    it = iter(refs)
+    if has_mask:
+        s = _tile_mask(s, next(it)[0])
+    if has_seg:
+        s = _seg_mask(s, next(it), next(it))
+    if causal:
+        s = _causal_mask(s, q_idx, bq, kv_idx, bkv)
+    if kv_len != skv_pad:
+        s = _bounds_mask(s, kv_idx, bkv, kv_len)
+    return s
+
+
+def _safe_exp(s, shift):
+    """exp(s - shift) that is exactly 0 for fully-masked entries even when the
+    running max / lse is itself NEG_INF (avoids exp(-inf + inf) = 1)."""
+    return jnp.where(s > 0.5 * NEG_INF, jnp.exp(s - shift), 0.0)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bkv, kv_len,
+                skv_pad, has_mask, has_seg):
+    """Grid: (bh, num_q_blocks, num_kv_blocks); kv innermost (sequential)."""
+    n_opt = int(has_mask) + 2 * int(has_seg)
+    opt_refs = rest[:n_opt]
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest[n_opt:]
     kv_idx = pl.program_id(2)
     q_idx = pl.program_id(1)
 
@@ -54,29 +185,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
+    # whole-block skips: causal (block fully above the diagonal) and padded
+    # KV blocks (fully out of range)
+    run = kv_idx * bkv < kv_len
     if causal:
-        # whole block is masked out iff last q row < first kv col
-        run = (q_idx + 1) * bq - 1 >= kv_idx * bkv
-    else:
-        run = q_idx >= 0  # always true, as a traced predicate
+        run &= (q_idx + 1) * bq - 1 >= kv_idx * bkv
 
     @pl.when(run)
     def _compute():
         q = q_ref[0].astype(jnp.float32)  # [bq, d]
         k = k_ref[0].astype(jnp.float32)  # [bkv, d]
         v = v_ref[0].astype(jnp.float32)  # [bkv, d]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [bq, bkv]
-        if causal:
-            rows = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
-            cols = kv_idx * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        s = _masked_logits(q, k, opt_refs, q_idx, kv_idx, scale=scale,
+                           causal=causal, bq=bq, bkv=bkv, kv_len=kv_len,
+                           skv_pad=skv_pad, has_mask=has_mask, has_seg=has_seg)
         m_prev = m_scr[:]  # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)  # [bq, bkv]
-        alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+        p = _safe_exp(s, m_new)  # [bq, bkv]
+        alpha = _safe_exp(m_prev, m_new)  # [bq, 1]
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -92,25 +219,54 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         lse_ref[0] = m_scr[:] + jnp.log(l_safe)  # [bq, 1]
 
 
-def _flash_fwd(q, k, v, scale, causal):
-    """q,k,v: [bh, s, d] fp32/bf16 → (out [bh, sq, d], lse [bh, sq])."""
+def _opt_specs(bq, bkv, mask, mask_idx, segs, batch_of, q_blk, kv_blk):
+    """(arrays, in_specs) for the optional streamed inputs, shared by the three
+    kernels.  ``q_blk``/``kv_blk``: grid position → (q block, kv block)."""
+    arrays, specs = [], []
+    if mask is not None:
+        arrays.append(mask)
+        specs.append(pl.BlockSpec(
+            (1, bq, bkv),
+            lambda *g: (mask_idx(g[0]), q_blk(*g), kv_blk(*g))))
+    if segs is not None:
+        q_seg, kv_seg = segs
+        arrays += [q_seg, kv_seg]
+        specs.append(pl.BlockSpec(
+            (1, bq, 1), lambda *g: (batch_of(g[0]), q_blk(*g), 0)))
+        specs.append(pl.BlockSpec(
+            (1, bkv, 1), lambda *g: (batch_of(g[0]), kv_blk(*g), 0)))
+    return arrays, specs
+
+
+def _flash_fwd(q, k, v, scale, causal, *, rep=1, kv_len=None, mask=None,
+               mask_idx=None, segs=None, batch_of=None, blocks=None):
+    """q: [bh, sq, d] (bh = b·hq); k,v: [bh // rep, skv, d].
+    Returns (out [bh, sq, d], lse [bh, sq]).  All seq lengths already padded
+    to the block grid; ``kv_len`` is the real KV length before padding;
+    ``blocks`` is the (bq, bkv) the caller padded for."""
     bh, sq, d = q.shape
     skv = k.shape[1]
-    bq_sz = sq if sq <= 128 else 128
-    bkv_sz = skv if skv <= 128 else 128
+    kv_len = skv if kv_len is None else kv_len
+    bq_sz, bkv_sz = blocks or _pick_blocks(sq, skv, d, mask is not None)
     n_q = pl.cdiv(sq, bq_sz)
     n_kv = pl.cdiv(skv, bkv_sz)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq_sz, bkv=bkv_sz, kv_len=skv
+        _fwd_kernel, scale=scale, causal=causal, bq=bq_sz, bkv=bkv_sz,
+        kv_len=kv_len, skv_pad=skv, has_mask=mask is not None,
+        has_seg=segs is not None,
     )
+    opt_arrays, opt_specs = _opt_specs(
+        bq_sz, bkv_sz, mask, mask_idx, segs, batch_of,
+        q_blk=lambda b, i, j: i, kv_blk=lambda b, i, j: j)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_kv),
         in_specs=[
             pl.BlockSpec((1, bq_sz, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bkv_sz, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bkv_sz, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bkv_sz, d), lambda b, i, j: (b // rep, j, 0)),
+            pl.BlockSpec((1, bkv_sz, d), lambda b, i, j: (b // rep, j, 0)),
+            *opt_specs,
         ],
         out_specs=[
             pl.BlockSpec((1, bq_sz, d), lambda b, i, j: (b, i, 0)),
@@ -128,31 +284,30 @@ def _flash_fwd(q, k, v, scale, causal):
         if _VMEM is not None
         else [],
         interpret=interpret_mode(),
-    )(q, k, v)
+    )(q, k, v, *opt_arrays)
     return out, lse[..., 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention_bhsd(q, k, v, scale, causal):
-    out, _ = _flash_fwd(q, k, v, scale, causal)
-    return out
-
-
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bkv):
-    """Grid: (bh, num_kv_blocks, num_q_blocks); q innermost (sequential)."""
-    q_idx = pl.program_id(2)
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                scale, causal, bq, bkv, kv_len, skv_pad, n_q,
+                has_mask, has_seg):
+    """Grid: (bh_kv, num_kv_blocks, rep·num_q_blocks); the innermost dim walks
+    every q block of every q head in the kv head's group (sequential)."""
+    n_opt = int(has_mask) + 2 * int(has_seg)
+    opt_refs = rest[:n_opt]
+    dk_ref, dv_ref, dk_scr, dv_scr = rest[n_opt:]
+    t = pl.program_id(2)
     kv_idx = pl.program_id(1)
+    q_idx = t % n_q
 
-    @pl.when(q_idx == 0)
+    @pl.when(t == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
+    run = kv_idx * bkv < kv_len
     if causal:
-        run = (q_idx + 1) * bq - 1 >= kv_idx * bkv
-    else:
-        run = q_idx >= 0
+        run &= (q_idx + 1) * bq - 1 >= kv_idx * bkv
 
     @pl.when(run)
     def _compute():
@@ -162,14 +317,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0].astype(jnp.float32)        # [bq, d]
         lse = lse_ref[0]                          # [bq, 1]
         delta = delta_ref[0]                      # [bq, 1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale                                  # [bq, bkv]
-        if causal:
-            rows = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
-            cols = kv_idx * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)                       # exact probs
+        s = _masked_logits(q, k, opt_refs, q_idx, kv_idx, scale=scale,
+                           causal=causal, bq=bq, bkv=bkv, kv_len=kv_len,
+                           skv_pad=skv_pad, has_mask=has_mask, has_seg=has_seg)
+        p = _safe_exp(s, lse)                      # exact probs
         # dv += p^T @ do
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -180,15 +331,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    @pl.when(q_idx == pl.num_programs(2) - 1)
+    @pl.when(t == pl.num_programs(2) - 1)
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_scr, *, scale, causal, bq, bkv):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               scale, causal, bq, bkv, kv_len, skv_pad, has_mask, has_seg):
     """Grid: (bh, num_q_blocks, num_kv_blocks); kv innermost (sequential)."""
+    n_opt = int(has_mask) + 2 * int(has_seg)
+    opt_refs = rest[:n_opt]
+    dq_ref, dq_scr = rest[n_opt:]
     kv_idx = pl.program_id(2)
     q_idx = pl.program_id(1)
 
@@ -196,10 +350,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
+    run = kv_idx * bkv < kv_len
     if causal:
-        run = (q_idx + 1) * bq - 1 >= kv_idx * bkv
-    else:
-        run = q_idx >= 0
+        run &= (q_idx + 1) * bq - 1 >= kv_idx * bkv
 
     @pl.when(run)
     def _compute():
@@ -209,14 +362,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0]
         delta = delta_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            rows = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
-            cols = kv_idx * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        s = _masked_logits(q, k, opt_refs, q_idx, kv_idx, scale=scale,
+                           causal=causal, bq=bq, bkv=bkv, kv_len=kv_len,
+                           skv_pad=skv_pad, has_mask=has_mask, has_seg=has_seg)
+        p = _safe_exp(s, lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
@@ -228,12 +377,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, do, scale, causal):
-    """Pallas FlashAttention-2 backward; q,k,v,out,do: [bh, s, d]."""
+def _flash_bwd(q, k, v, out, lse, do, scale, causal, *, rep=1, kv_len=None,
+               mask=None, mask_idx=None, segs=None, batch_of=None, blocks=None):
+    """Pallas FlashAttention-2 backward; q/out/do: [bh, sq, d], k/v:
+    [bh // rep, skv, d].  Returns (dq [bh,...], dk, dv [bh//rep,...]) — the
+    group sum for GQA happens inside the dkv kernel's accumulator."""
     bh, sq, d = q.shape
-    skv = k.shape[1]
-    bq_sz = sq if sq <= 128 else 128
-    bkv_sz = skv if skv <= 128 else 128
+    bhkv, skv, _ = k.shape
+    kv_len = skv if kv_len is None else kv_len
+    bq_sz, bkv_sz = blocks or _pick_blocks(sq, skv, d, mask is not None)
     n_q = pl.cdiv(sq, bq_sz)
     n_kv = pl.cdiv(skv, bkv_sz)
 
@@ -241,83 +393,175 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal):
                     axis=-1, keepdims=True)          # [bh, sq, 1]
     lse3 = lse[..., None]                             # [bh, sq, 1]
 
-    q_spec_i = pl.BlockSpec((1, bq_sz, d), lambda b, i, j: (b, i, 0))
-    q_spec_j = pl.BlockSpec((1, bq_sz, d), lambda b, i, j: (b, j, 0))
-    kv_spec_i = pl.BlockSpec((1, bkv_sz, d), lambda b, i, j: (b, i, 0))
-    kv_spec_j = pl.BlockSpec((1, bkv_sz, d), lambda b, i, j: (b, j, 0))
-    row_spec_i = pl.BlockSpec((1, bq_sz, 1), lambda b, i, j: (b, i, 0))
-    row_spec_j = pl.BlockSpec((1, bq_sz, 1), lambda b, i, j: (b, j, 0))
+    hq_of = lambda bh_kv, t: bh_kv * rep + t // n_q   # dkv grid → q-row index
+
+    common = dict(scale=scale, causal=causal, bq=bq_sz, bkv=bkv_sz,
+                  kv_len=kv_len, skv_pad=skv,
+                  has_mask=mask is not None, has_seg=segs is not None)
+
+    # ---- dk/dv: grid (bh_kv, n_kv, rep·n_q), q innermost over the group ----
+    # the optional-input index maps resolve the group-dependent q head first
+    q_spec = pl.BlockSpec((1, bq_sz, d), lambda b, kv, t: (hq_of(b, t), t % n_q, 0))
+    row_spec = pl.BlockSpec((1, bq_sz, 1), lambda b, kv, t: (hq_of(b, t), t % n_q, 0))
+    kv_spec = pl.BlockSpec((1, bkv_sz, d), lambda b, kv, t: (b, kv, 0))
+    opt_arrays, opt_specs = [], []
+    if mask is not None:
+        opt_arrays.append(mask)
+        opt_specs.append(pl.BlockSpec(
+            (1, bq_sz, bkv_sz),
+            lambda b, kv, t: (mask_idx(hq_of(b, t)), t % n_q, kv)))
+    if segs is not None:
+        opt_arrays += list(segs)
+        opt_specs.append(pl.BlockSpec(
+            (1, bq_sz, 1), lambda b, kv, t: (batch_of(hq_of(b, t)), t % n_q, 0)))
+        opt_specs.append(pl.BlockSpec(
+            (1, bkv_sz, 1), lambda b, kv, t: (batch_of(hq_of(b, t)), kv, 0)))
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq_sz, bkv=bkv_sz),
-        grid=(bh, n_kv, n_q),
-        in_specs=[q_spec_j, kv_spec_i, kv_spec_i, q_spec_j, row_spec_j,
-                  row_spec_j],
-        out_specs=[kv_spec_i, kv_spec_i],
-        out_shape=[jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, skv, d), v.dtype)],
+        functools.partial(_dkv_kernel, n_q=n_q, **common),
+        grid=(bhkv, n_kv, rep * n_q),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
+                  *opt_specs],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct((bhkv, skv, d), k.dtype),
+                   jax.ShapeDtypeStruct((bhkv, skv, d), v.dtype)],
         scratch_shapes=[_VMEM((bkv_sz, d), jnp.float32),
                         _VMEM((bkv_sz, d), jnp.float32)]
         if _VMEM is not None else [],
         interpret=interpret_mode(),
-    )(q, k, v, do, lse3, delta)
+    )(q, k, v, do, lse3, delta, *opt_arrays)
+
+    # ---- dq: grid (bh, n_q, n_kv), kv innermost ----
+    q_spec_i = pl.BlockSpec((1, bq_sz, d), lambda b, i, j: (b, i, 0))
+    kv_spec_j = pl.BlockSpec((1, bkv_sz, d), lambda b, i, j: (b // rep, j, 0))
+    row_spec_i = pl.BlockSpec((1, bq_sz, 1), lambda b, i, j: (b, i, 0))
+    opt_arrays_q, opt_specs_q = _opt_specs(
+        bq_sz, bkv_sz, mask, mask_idx, segs, batch_of,
+        q_blk=lambda b, i, j: i, kv_blk=lambda b, i, j: j)
 
     dq, = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq_sz, bkv=bkv_sz),
+        functools.partial(_dq_kernel, **common),
         grid=(bh, n_q, n_kv),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec_i,
-                  row_spec_i],
+                  row_spec_i, *opt_specs_q],
         out_specs=[q_spec_i],
         out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype)],
         scratch_shapes=[_VMEM((bq_sz, d), jnp.float32)]
         if _VMEM is not None else [],
         interpret=interpret_mode(),
-    )(q, k, v, do, lse3, delta)
+    )(q, k, v, do, lse3, delta, *opt_arrays_q)
     return dq, dk, dv
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal):
-    out, lse = _flash_fwd(q, k, v, scale, causal)
-    return out, (q, k, v, out, lse)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
+def _flash_attention_core(q, k, v, mask, q_seg, kv_seg,
+                          scale, causal, rep, kv_len, mask_idx, batch_of,
+                          blocks):
+    out, _ = _flash_fwd(
+        q, k, v, scale, causal, rep=rep, kv_len=kv_len, mask=mask,
+        mask_idx=mask_idx, segs=(q_seg, kv_seg) if q_seg is not None else None,
+        batch_of=batch_of, blocks=blocks)
+    return out
 
 
-def _flash_vjp_bwd(scale, causal, res, do):
-    q, k, v, out, lse = res
-    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, scale, causal)
-    return dq, dk, dv
+def _flash_core_fwd(q, k, v, mask, q_seg, kv_seg,
+                    scale, causal, rep, kv_len, mask_idx, batch_of, blocks):
+    out, lse = _flash_fwd(
+        q, k, v, scale, causal, rep=rep, kv_len=kv_len, mask=mask,
+        mask_idx=mask_idx, segs=(q_seg, kv_seg) if q_seg is not None else None,
+        batch_of=batch_of, blocks=blocks)
+    return out, (q, k, v, mask, q_seg, kv_seg, out, lse)
 
 
-_flash_attention_bhsd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+def _flash_core_bwd(scale, causal, rep, kv_len, mask_idx, batch_of, blocks,
+                    res, do):
+    q, k, v, mask, q_seg, kv_seg, out, lse = res
+    dq, dk, dv = _flash_bwd(
+        q, k, v, out, lse, do, scale, causal, rep=rep, kv_len=kv_len,
+        mask=mask, mask_idx=mask_idx,
+        segs=(q_seg, kv_seg) if q_seg is not None else None,
+        batch_of=batch_of, blocks=blocks)
+    zero = lambda x: None if x is None else jnp.zeros_like(x)
+    return dq, dk, dv, zero(mask), zero(q_seg), zero(kv_seg)
 
 
-def flash_attention_bshd(q, k, v, attn_mask=None, causal=False, scale=None):
+_flash_attention_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _normalize_mask(attn_mask, b, hq, sq, skv):
+    """[b|1, h|1, sq, skv] (or 2D/3D broadcast forms) → ([mb·mh, sq, skv],
+    index fn over grid dim 0)."""
+    m = attn_mask
+    if m.ndim == 2:
+        m = m[None, None]
+    elif m.ndim == 3:
+        m = m[:, None]
+    if m.shape[2] != sq or m.shape[3] != skv:
+        raise ValueError(f"attn_mask seq dims {m.shape[2:]} != ({sq}, {skv})")
+    mb, mh = m.shape[0], m.shape[1]
+    if mb not in (1, b) or mh not in (1, hq):
+        raise ValueError(f"attn_mask batch/head dims {m.shape[:2]} not "
+                         f"broadcastable to ({b}, {hq})")
+    return m.reshape(mb * mh, sq, skv), _mask_index_fn(b, hq, mb, mh)
+
+
+def flash_attention_bshd(q, k, v, attn_mask=None, causal=False, scale=None,
+                         segment_ids=None):
     """Public entry: q,k,v [batch, seq, heads, head_dim] (paddle layout).
 
-    GQA/MQA: if kv heads < q heads, kv is broadcast per group.  A non-None
-    additive/bool attn_mask falls back to the XLA-composed path (masked flash
-    is a follow-up kernel)."""
+    GQA/MQA: kv heads are indexed per group inside the kernel grid — grouped
+    K/V never materialize in HBM.  ``attn_mask`` ([b|1, h|1, sq, skv], bool
+    or additive) streams through the kernel tile-by-tile.  ``segment_ids``
+    (a [b, s] int array, or a (q_ids, kv_ids) pair) implements packed/varlen
+    attention (reference: flash_attn_varlen cu_seqlens).  Arbitrary sequence
+    lengths are padded to the block grid and masked in-kernel."""
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
     skv = k.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     global KERNEL_CALLS, FALLBACK_CALLS
-    tileable = (sq <= 128 and skv <= 128) or (sq % 128 == 0 and skv % 128 == 0)
-    if attn_mask is not None or not tileable or d % 8 != 0:
+    if d % 8 != 0 or hq % hkv != 0:
         FALLBACK_CALLS += 1
         return _composed_attention(q, k, v, attn_mask, causal, scale)
     KERNEL_CALLS += 1
-    if hkv != hq:
-        rep = hq // hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    rep = hq // hkv
+
     # BSHD -> (b*h, s, d)
     qh = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
-    kh = k.transpose(0, 2, 1, 3).reshape(b * hq, -1, d)
-    vh = v.transpose(0, 2, 1, 3).reshape(b * hq, -1, d)
-    out = _flash_attention_bhsd(qh, kh, vh, scale, causal)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * hkv, skv, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * hkv, skv, d)
+
+    bq_sz, bkv_sz = _pick_blocks(sq, skv, d, attn_mask is not None)
+    sq_pad = _round_up(sq, bq_sz)
+    skv_pad = _round_up(skv, bkv_sz)
+    qh = _pad_seq(qh, 1, sq_pad)
+    kh = _pad_seq(kh, 1, skv_pad)
+    vh = _pad_seq(vh, 1, skv_pad)
+
+    mask = mask_idx = None
+    if attn_mask is not None:
+        mask, mask_idx = _normalize_mask(attn_mask, b, hq, sq, skv)
+        mask = _pad_seq(_pad_seq(mask, 1, sq_pad), 2, skv_pad)
+
+    q_seg = kv_seg = batch_of = None
+    if segment_ids is not None:
+        if isinstance(segment_ids, (tuple, list)):
+            q_ids, kv_ids = segment_ids
+        else:
+            q_ids = kv_ids = segment_ids
+        # pad with -1/-2 so padded positions never match a real segment;
+        # trailing singleton dim for the Mosaic block-shape constraint
+        q_seg = jnp.pad(jnp.asarray(q_ids, jnp.int32), ((0, 0), (0, sq_pad - sq)),
+                        constant_values=-1)[..., None]
+        kv_seg = jnp.pad(jnp.asarray(kv_ids, jnp.int32), ((0, 0), (0, skv_pad - skv)),
+                         constant_values=-2)[..., None]
+        batch_of = lambda bh: bh // hq
+
+    out = _flash_attention_core(qh, kh, vh, mask, q_seg, kv_seg,
+                                scale, causal, rep, skv, mask_idx, batch_of,
+                                (bq_sz, bkv_sz))
+    out = out[:, :sq]
     return out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
 
 
@@ -327,6 +571,10 @@ def _composed_attention(q, k, v, attn_mask, causal, scale):
         rep = qh.shape[1] // kh.shape[1]
         kh = jnp.repeat(kh, rep, axis=1)
         vh = jnp.repeat(vh, rep, axis=1)
+    if attn_mask is not None and attn_mask.ndim == 3:
+        # [b, sq, skv] means per-batch (same as the kernel path's
+        # _normalize_mask), not right-aligned broadcast over heads
+        attn_mask = attn_mask[:, None]
     logits = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32), kh.astype(jnp.float32)) * scale
     if causal:
         m = jnp.tril(jnp.ones((logits.shape[-2], logits.shape[-1]), bool))
@@ -336,6 +584,10 @@ def _composed_attention(q, k, v, attn_mask, causal, scale):
             logits = jnp.where(attn_mask, logits, NEG_INF)
         else:
             logits = logits + attn_mask.astype(jnp.float32)
+    # fully-masked rows: softmax would give uniform garbage; zero them like
+    # the flash kernel does
+    all_masked = jnp.all(logits <= 0.5 * NEG_INF, axis=-1, keepdims=True)
     p = jax.nn.softmax(logits, axis=-1)
+    p = jnp.where(all_masked, 0.0, p)
     out = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
     return out.astype(q.dtype).transpose(0, 2, 1, 3)

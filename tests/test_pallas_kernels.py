@@ -112,3 +112,152 @@ def test_rope_rotation_preserves_norm():
     np.testing.assert_allclose(np.linalg.norm(np.asarray(q2), axis=-1),
                                np.linalg.norm(np.asarray(q), axis=-1),
                                rtol=1e-4)
+
+
+# ---------------- masked / varlen flash attention (flash_attn_varlen parity) ----
+
+
+def _mask_oracle(q, k, v, mask, causal, d):
+    return fa._composed_attention(q, k, v, mask, causal, 1.0 / np.sqrt(d))
+
+
+@pytest.mark.parametrize("mshape", [(2, 4, 128, 128), (2, 1, 128, 128),
+                                    (1, 1, 128, 128)])
+def test_flash_dense_bool_mask_parity(mshape):
+    rs = np.random.RandomState(7)
+    b, s, h, d = 2, 128, 4, 32
+    q, k, v = (_rand(rs, b, s, h, d) for _ in range(3))
+    mask = jnp.asarray(rs.rand(*mshape) > 0.3)
+    out = fa.flash_attention_bshd(q, k, v, attn_mask=mask, causal=False)
+    ref = _mask_oracle(q, k, v, mask, False, d)
+    assert fa.KERNEL_CALLS > 0
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_additive_mask_parity_and_grad():
+    rs = np.random.RandomState(8)
+    b, s, h, d = 1, 256, 2, 32
+    q, k, v = (_rand(rs, b, s, h, d) for _ in range(3))
+    mask = jnp.asarray((rs.rand(b, 1, s, s) > 0.5) * -1e9, jnp.float32)
+
+    def f_flash(q, k, v):
+        return (fa.flash_attention_bshd(q, k, v, attn_mask=mask, causal=True) ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (_mask_oracle(q, k, v, mask, True, d) ** 2).sum()
+
+    np.testing.assert_allclose(
+        np.asarray(fa.flash_attention_bshd(q, k, v, attn_mask=mask, causal=True)),
+        np.asarray(_mask_oracle(q, k, v, mask, True, d)), rtol=2e-3, atol=2e-3)
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(g1, g2, "qkv"):
+        err = float(jnp.max(jnp.abs(a - b_)) / (jnp.max(jnp.abs(b_)) + 1e-9))
+        assert err < 5e-3, f"d{name} rel err {err}"
+
+
+@pytest.mark.parametrize("s", [129, 200, 2049])
+def test_flash_odd_seq_lengths_no_fallback(s):
+    """Non-128-multiple sequences run through the kernel (padded+masked), not
+    the composed O(s^2) fallback (VERDICT weak #7)."""
+    rs = np.random.RandomState(9)
+    b, h, d = 1, 2, 32
+    q, k, v = (_rand(rs, b, s, h, d) for _ in range(3))
+    before = fa.FALLBACK_CALLS
+    out = fa.flash_attention_bshd(q, k, v, causal=True)
+    assert fa.FALLBACK_CALLS == before, "odd seq fell back to composed path"
+    ref = fa._composed_attention(q, k, v, None, True, 1.0 / np.sqrt(d))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_odd_seq_backward():
+    rs = np.random.RandomState(10)
+    b, s, h, d = 1, 200, 2, 32
+    q, k, v = (_rand(rs, b, s, h, d) for _ in range(3))
+    scale = 1.0 / np.sqrt(d)
+    g1 = jax.grad(lambda q, k, v: (fa.flash_attention_bshd(q, k, v, causal=True) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: (fa._composed_attention(q, k, v, None, True, scale) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(g1, g2, "qkv"):
+        err = float(jnp.max(jnp.abs(a - b_)) / (jnp.max(jnp.abs(b_)) + 1e-9))
+        assert err < 5e-3, f"d{name} rel err {err}"
+
+
+def test_flash_segment_ids_packing():
+    """Packed sequences (varlen analog): two documents in one row must not
+    attend across the boundary; oracle = bool block-diagonal mask."""
+    rs = np.random.RandomState(11)
+    b, s, h, d = 2, 128, 2, 32
+    q, k, v = (_rand(rs, b, s, h, d) for _ in range(3))
+    seg = np.zeros((b, s), np.int32)
+    seg[:, 70:] = 1  # doc boundary at 70 (odd on purpose)
+    out = fa.flash_attention_bshd(q, k, v, causal=True,
+                                  segment_ids=jnp.asarray(seg))
+    same = jnp.asarray(seg[:, None, :, None] == seg[:, None, None, :])
+    ref = _mask_oracle(q, k, v, same, True, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_segment_ids_backward():
+    rs = np.random.RandomState(12)
+    b, s, h, d = 1, 128, 2, 32
+    q, k, v = (_rand(rs, b, s, h, d) for _ in range(3))
+    seg = np.zeros((b, s), np.int32)
+    seg[:, 50:] = 1
+    segj = jnp.asarray(seg)
+    same = jnp.asarray(seg[:, None, :, None] == seg[:, None, None, :])
+    g1 = jax.grad(lambda q, k, v: (fa.flash_attention_bshd(
+        q, k, v, causal=True, segment_ids=segj) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: (_mask_oracle(q, k, v, same, True, d) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(g1, g2, "qkv"):
+        err = float(jnp.max(jnp.abs(a - b_)) / (jnp.max(jnp.abs(b_)) + 1e-9))
+        assert err < 5e-3, f"d{name} rel err {err}"
+
+
+def test_flash_gqa_backward_no_repeat():
+    """GQA backward: dk/dv accumulate over the head group inside the kernel."""
+    rs = np.random.RandomState(13)
+    q = _rand(rs, 2, 128, 8, 32)
+    k = _rand(rs, 2, 128, 2, 32)
+    v = _rand(rs, 2, 128, 2, 32)
+    scale = 1.0 / np.sqrt(32)
+    g1 = jax.grad(lambda q, k, v: (fa.flash_attention_bshd(q, k, v, causal=True) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: (fa._composed_attention(q, k, v, None, True, scale) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(g1, g2, "qkv"):
+        err = float(jnp.max(jnp.abs(a - b_)) / (jnp.max(jnp.abs(b_)) + 1e-9))
+        assert err < 5e-3, f"d{name} rel err {err}"
+
+
+def test_flash_padding_mask_2049():
+    """Padding mask at seq 2048+1 (VERDICT item #5's named acceptance case)."""
+    rs = np.random.RandomState(14)
+    b, s, h, d = 1, 2049, 1, 32
+    q, k, v = (_rand(rs, b, s, h, d) for _ in range(3))
+    valid = np.ones((b, s), bool)
+    valid[:, -100:] = False  # tail padding
+    seg = np.where(valid, 0, np.arange(s)[None] + 1).astype(np.int32)
+    out = fa.flash_attention_bshd(q, k, v, causal=True,
+                                  segment_ids=jnp.asarray(seg))
+    same = jnp.asarray(seg[:, None, :, None] == seg[:, None, None, :])
+    ref = _mask_oracle(q, k, v, same, True, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_composed_fallback_3d_mask_per_batch():
+    """3D [b, sq, skv] masks mean per-batch on BOTH paths (kernel and the
+    d%8!=0 composed fallback) — not numpy right-aligned broadcast."""
+    rs = np.random.RandomState(15)
+    b, s, h, d = 2, 16, 2, 12  # d%8!=0 -> composed fallback
+    q, k, v = (_rand(rs, b, s, h, d) for _ in range(3))
+    mask3 = jnp.asarray(rs.rand(b, s, s) > 0.3)
+    out = fa.flash_attention_bshd(q, k, v, attn_mask=mask3, causal=False)
+    ref = fa._composed_attention(q, k, v, mask3[:, None], False, 1.0 / np.sqrt(d))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
