@@ -86,6 +86,15 @@ class SegmentParallel(MetaParallelBase):
             spec = list(cur.spec) + [None] * (val.ndim - len(cur.spec))
         else:
             spec = [None] * val.ndim
+
+        # 'sep' may appear at most once in a spec — drop any prior use
+        def _strip_sep(entry):
+            if isinstance(entry, tuple):
+                kept = tuple(e for e in entry if e != "sep")
+                return kept or None
+            return None if entry == "sep" else entry
+
+        spec = [_strip_sep(e) for e in spec]
         spec[self._seq_axis] = "sep"
         out = jax.device_put(val, NamedSharding(self._mesh, PartitionSpec(*spec)))
         if hasattr(x, "_value"):

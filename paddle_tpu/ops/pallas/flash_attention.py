@@ -219,22 +219,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bkv, kv_len,
         lse_ref[0] = m_scr[:] + jnp.log(l_safe)  # [bq, 1]
 
 
-def _opt_specs(bq, bkv, mask, mask_idx, segs, batch_of, q_blk, kv_blk):
+def _opt_specs(bq, bkv, mask, mask_idx, segs, batch_of, q_blk, kv_blk,
+               head_of=None):
     """(arrays, in_specs) for the optional streamed inputs, shared by the three
-    kernels.  ``q_blk``/``kv_blk``: grid position → (q block, kv block)."""
+    kernels.  ``q_blk``/``kv_blk``: grid position → (q block, kv block);
+    ``head_of``: grid position → q-head row (defaults to grid dim 0; the dkv
+    kernel resolves it from its (kv-head, group·q) walk)."""
+    head_of = head_of or (lambda *g: g[0])
     arrays, specs = [], []
     if mask is not None:
         arrays.append(mask)
         specs.append(pl.BlockSpec(
             (1, bq, bkv),
-            lambda *g: (mask_idx(g[0]), q_blk(*g), kv_blk(*g))))
+            lambda *g: (mask_idx(head_of(*g)), q_blk(*g), kv_blk(*g))))
     if segs is not None:
         q_seg, kv_seg = segs
         arrays += [q_seg, kv_seg]
         specs.append(pl.BlockSpec(
-            (1, bq, 1), lambda *g: (batch_of(g[0]), q_blk(*g), 0)))
+            (1, bq, 1), lambda *g: (batch_of(head_of(*g)), q_blk(*g), 0)))
         specs.append(pl.BlockSpec(
-            (1, bkv, 1), lambda *g: (batch_of(g[0]), kv_blk(*g), 0)))
+            (1, bkv, 1), lambda *g: (batch_of(head_of(*g)), kv_blk(*g), 0)))
     return arrays, specs
 
 
@@ -404,18 +408,10 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, *, rep=1, kv_len=None,
     q_spec = pl.BlockSpec((1, bq_sz, d), lambda b, kv, t: (hq_of(b, t), t % n_q, 0))
     row_spec = pl.BlockSpec((1, bq_sz, 1), lambda b, kv, t: (hq_of(b, t), t % n_q, 0))
     kv_spec = pl.BlockSpec((1, bkv_sz, d), lambda b, kv, t: (b, kv, 0))
-    opt_arrays, opt_specs = [], []
-    if mask is not None:
-        opt_arrays.append(mask)
-        opt_specs.append(pl.BlockSpec(
-            (1, bq_sz, bkv_sz),
-            lambda b, kv, t: (mask_idx(hq_of(b, t)), t % n_q, kv)))
-    if segs is not None:
-        opt_arrays += list(segs)
-        opt_specs.append(pl.BlockSpec(
-            (1, bq_sz, 1), lambda b, kv, t: (batch_of(hq_of(b, t)), t % n_q, 0)))
-        opt_specs.append(pl.BlockSpec(
-            (1, bkv_sz, 1), lambda b, kv, t: (batch_of(hq_of(b, t)), kv, 0)))
+    opt_arrays, opt_specs = _opt_specs(
+        bq_sz, bkv_sz, mask, mask_idx, segs, batch_of,
+        q_blk=lambda b, kv, t: t % n_q, kv_blk=lambda b, kv, t: kv,
+        head_of=lambda b, kv, t: hq_of(b, t))
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, n_q=n_q, **common),
@@ -473,16 +469,58 @@ def _flash_core_fwd(q, k, v, mask, q_seg, kv_seg,
     return out, (q, k, v, mask, q_seg, kv_seg, out, lse)
 
 
+def _xla_mask_grad(q, k, v, out, lse, do, mask, mask_idx, segs, scale, causal,
+                   kv_len, rep):
+    """Cotangent for an additive (float) attn_mask, recomputed in plain XLA:
+    dmask = Σ_{broadcast group} ds with ds = p·(dp − delta)·scale.  This is
+    O(s²) compute/memory — the same cost class as materializing the mask
+    itself — and is dead-code-eliminated by XLA whenever the caller does not
+    differentiate the mask, so the flash path stays O(s·d) in that case."""
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    rows_idx = jnp.asarray([mask_idx(i) for i in range(bh)])
+    kx = jnp.repeat(k, rep, axis=0) if rep > 1 else k
+    vx = jnp.repeat(v, rep, axis=0) if rep > 1 else v
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   kx.astype(jnp.float32)) * scale
+    s = s + mask[rows_idx].astype(jnp.float32)
+    if segs is not None:
+        q_seg, kv_seg = segs  # [b, s, 1]
+        hq_n = bh // q_seg.shape[0]
+        sq_ids = jnp.repeat(q_seg[:, :, 0], hq_n, axis=0)   # [bh, sq]
+        sk_ids = jnp.repeat(kv_seg[:, :, 0], hq_n, axis=0)  # [bh, skv]
+        s = jnp.where(sq_ids[:, :, None] == sk_ids[:, None, :], s, NEG_INF)
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((sq, skv), bool)), s, NEG_INF)
+    if kv_len != skv:
+        s = jnp.where(jnp.arange(skv)[None, None, :] < kv_len, s, NEG_INF)
+    p = jnp.where(s > 0.5 * NEG_INF, jnp.exp(s - lse[..., None]), 0.0)
+    dp = jnp.einsum("bqd,bkd->bqk", do.astype(jnp.float32),
+                    vx.astype(jnp.float32))
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    # d(loss)/d(mask): the mask adds to the POST-scale logits, so unlike the
+    # dq/dk recurrence there is no ·scale factor here
+    ds = p * (dp - delta)
+    dmask = jax.ops.segment_sum(ds, rows_idx, num_segments=mask.shape[0])
+    return dmask.astype(mask.dtype)
+
+
 def _flash_core_bwd(scale, causal, rep, kv_len, mask_idx, batch_of, blocks,
                     res, do):
     q, k, v, mask, q_seg, kv_seg, out, lse = res
+    segs = (q_seg, kv_seg) if q_seg is not None else None
     dq, dk, dv = _flash_bwd(
         q, k, v, out, lse, do, scale, causal, rep=rep, kv_len=kv_len,
-        mask=mask, mask_idx=mask_idx,
-        segs=(q_seg, kv_seg) if q_seg is not None else None,
+        mask=mask, mask_idx=mask_idx, segs=segs,
         batch_of=batch_of, blocks=blocks)
     zero = lambda x: None if x is None else jnp.zeros_like(x)
-    return dq, dk, dv, zero(mask), zero(q_seg), zero(kv_seg)
+    if mask is not None and jnp.issubdtype(mask.dtype, jnp.inexact):
+        dmask = _xla_mask_grad(q, k, v, out, lse, do, mask, mask_idx, segs,
+                               scale, causal, kv_len, rep)
+    else:
+        dmask = zero(mask)  # bool masks are not differentiable
+    return dq, dk, dv, dmask, zero(q_seg), zero(kv_seg)
 
 
 _flash_attention_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -523,6 +561,20 @@ def flash_attention_bshd(q, k, v, attn_mask=None, causal=False, scale=None,
     global KERNEL_CALLS, FALLBACK_CALLS
     if d % 8 != 0 or hq % hkv != 0:
         FALLBACK_CALLS += 1
+        if segment_ids is not None:
+            # fold segment ids into the mask so packing semantics survive
+            # the composed fallback
+            if isinstance(segment_ids, (tuple, list)):
+                q_ids, kv_ids = (jnp.asarray(s) for s in segment_ids)
+            else:
+                q_ids = kv_ids = jnp.asarray(segment_ids)
+            seg_ok = q_ids[:, None, :, None] == kv_ids[:, None, None, :]
+            if attn_mask is None:
+                attn_mask = seg_ok
+            elif attn_mask.dtype == jnp.bool_:
+                attn_mask = jnp.logical_and(attn_mask, seg_ok)
+            else:
+                attn_mask = attn_mask + jnp.where(seg_ok, 0.0, NEG_INF)
         return _composed_attention(q, k, v, attn_mask, causal, scale)
     KERNEL_CALLS += 1
     rep = hq // hkv

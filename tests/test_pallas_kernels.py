@@ -261,3 +261,35 @@ def test_composed_fallback_3d_mask_per_batch():
     out = fa.flash_attention_bshd(q, k, v, attn_mask=mask3, causal=False)
     ref = fa._composed_attention(q, k, v, mask3[:, None], False, 1.0 / np.sqrt(d))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_flash_additive_mask_gradient_flows():
+    """Learned additive bias (ALiBi-style): grad w.r.t. the mask itself must
+    match the composed oracle, not silently be zero."""
+    rs = np.random.RandomState(16)
+    b, s, h, d = 1, 128, 2, 32
+    q, k, v = (_rand(rs, b, s, h, d) for _ in range(3))
+    bias = jnp.asarray(rs.randn(1, 1, s, s).astype(np.float32) * 0.1)
+
+    g1 = jax.grad(lambda m: (fa.flash_attention_bshd(q, k, v, attn_mask=m,
+                                                     causal=True) ** 2).sum())(bias)
+    g2 = jax.grad(lambda m: (_mask_oracle(q, k, v, m, True, d) ** 2).sum())(bias)
+    assert float(jnp.max(jnp.abs(g2))) > 1e-6  # oracle grad is nonzero
+    err = float(jnp.max(jnp.abs(g1 - g2)) / (jnp.max(jnp.abs(g2)) + 1e-9))
+    assert err < 5e-3, f"dmask rel err {err}"
+
+
+def test_flash_fallback_respects_segment_ids():
+    """d%8!=0 routes to the composed fallback, which must still honor
+    segment_ids (no cross-document attention)."""
+    rs = np.random.RandomState(17)
+    b, s, h, d = 1, 32, 2, 12  # d%8 != 0 -> fallback
+    q, k, v = (_rand(rs, b, s, h, d) for _ in range(3))
+    seg = np.zeros((b, s), np.int32)
+    seg[:, 16:] = 1
+    out = fa.flash_attention_bshd(q, k, v, causal=True,
+                                  segment_ids=jnp.asarray(seg))
+    same = jnp.asarray(seg[:, None, :, None] == seg[:, None, None, :])
+    ref = _mask_oracle(q, k, v, same, True, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
