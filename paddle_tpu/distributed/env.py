@@ -22,6 +22,46 @@ import jax
 _initialized = False
 
 
+def _jax_coordinator_via_store(host: str, store_port: int, pid: int) -> str | None:
+    """Agree on a coordinator address for jax.distributed through the launch
+    CLI's native TCPStore (the reference rendezvous path: TCPStore carries
+    bootstrap KV, python/paddle/distributed/parallel.py:978).  The store and
+    JAX's coordination service speak different wire protocols, so the
+    coordinator needs its OWN port: rank 0 picks a free one ON ITS OWN HOST
+    and publishes it; everyone else waits on the key.  The key is namespaced
+    by the elastic restart generation so a respawned pod never rendezvouses
+    to the previous incarnation's dead coordinator.
+
+    Returns None when no store is live (manual bootstrap without the launch
+    CLI); raises when a live store is reachable but the rendezvous fails —
+    that is a real bootstrap error, silent fallback would just diverge."""
+    from .store import TCPStore
+
+    try:
+        store = TCPStore(host, store_port, timeout=3)
+    except Exception:
+        return None
+    try:
+        gen = os.environ.get("PADDLE_RESTART_COUNT", "0")
+        key = f"jax/coordinator/{gen}"
+        if pid == 0:
+            import socket
+
+            # rank 0 runs the coordination service, so advertise ITS host
+            # (PADDLE_CURRENT_ENDPOINT), not the store's
+            my_host = os.environ.get("PADDLE_CURRENT_ENDPOINT", "").split(":")[0] or host
+            s = socket.socket()
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+            s.close()
+            addr = f"{my_host}:{port}"
+            store.set(key, addr.encode())
+            return addr
+        return store.wait(key, timeout=60.0).decode()
+    finally:
+        store.close()
+
+
 def init_parallel_env():
     """Initialize multi-host coordination if env says we're multi-process."""
     global _initialized
@@ -31,9 +71,18 @@ def init_parallel_env():
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", "1")))
     pid = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
     if coord and nprocs > 1:
+        host = coord.split(":")[0]
         port = os.environ.get("MASTER_PORT", "8476")
+        # explicit operator override wins (firewalled deployments)
+        addr = os.environ.get("PADDLE_JAX_COORD_ADDR")
+        if not addr:
+            addr = _jax_coordinator_via_store(host, int(port), pid)
+        if not addr:
+            # no live store (manual bootstrap): the conventional dedicated
+            # coordinator port next to the store's
+            addr = f"{host}:{int(port) + 1}"
         jax.distributed.initialize(
-            coordinator_address=f"{coord.split(':')[0]}:{port}",
+            coordinator_address=addr,
             num_processes=nprocs,
             process_id=pid,
         )

@@ -90,6 +90,13 @@ class CollectiveController:
         if args.master is None or self.ctx.max_nodes == 1:
             self.node_rank, self.nnodes = 0, 1
             self.endpoints = [f"{args.host}"]
+            if args.nproc_per_node > 1:
+                # local multi-process runs still need a live store: the
+                # workers rendezvous their jax coordinator address through it
+                # (env.py _jax_coordinator_via_store); port 0 = ephemeral
+                port = int(args.master.split(":")[1]) if args.master else 0
+                self.store = TCPStore(args.host, port, is_master=True,
+                                      timeout=120)
             return
         host, port = args.master.split(":")
         is_master = args.rank in (0, -1) and host in (args.host, "127.0.0.1", "localhost")
@@ -125,6 +132,8 @@ class CollectiveController:
         devices = args.devices.split(",") if args.devices else None
         master_addr = (args.master or f"{args.host}:8476").split(":")[0]
         master_port = (args.master or ":8476").split(":")[1]
+        if self.store is not None and getattr(self.store, "port", None):
+            master_port = str(self.store.port)
         self.pod = []
         for local in range(nproc):
             rank = self.node_rank * nproc + local
@@ -134,6 +143,9 @@ class CollectiveController:
                 "PADDLE_TRAINERS_NUM": str(world),
                 "PADDLE_LOCAL_RANK": str(local),
                 "PADDLE_NODE_RANK": str(self.node_rank),
+                # elastic generation: namespaces the jax-coordinator
+                # rendezvous key so restarts never reuse a dead address
+                "PADDLE_RESTART_COUNT": str(getattr(self, "restarts", 0)),
                 "PADDLE_MASTER": f"{master_addr}:{master_port}",
                 "MASTER_ADDR": master_addr,
                 "MASTER_PORT": master_port,
@@ -163,6 +175,7 @@ class CollectiveController:
         self._rendezvous()
         restarts = 0
         while True:
+            self.restarts = restarts
             self.build_pod()
             for c in self.pod:
                 c.start()

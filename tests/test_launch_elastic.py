@@ -106,3 +106,50 @@ def test_eager_collective_is_watched():
     before = mgr.pending()
     out = dist.all_reduce(paddle.to_tensor(np.ones((4,), np.float32)))
     assert mgr.pending() == before  # task opened and closed
+
+
+def test_launch_multiprocess_collective(tmp_path):
+    """Launch CLI spawns 2 real processes that jax.distributed.initialize via
+    the native TCPStore rendezvous and run a cross-process psum on the CPU
+    backend (mirrors test_parallel_dygraph_dataparallel.py:55)."""
+    script = tmp_path / "collective.py"
+    script.write_text(
+        "import os\n"
+        "# one local CPU device per process (override any flag leaked from\n"
+        "# the test harness; the last duplicate XLA flag wins)\n"
+        "os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '')\n"
+        "    + ' --xla_force_host_platform_device_count=1')\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, PartitionSpec as P, NamedSharding\n"
+        "import paddle_tpu.distributed as dist\n"
+        "dist.init_parallel_env()\n"
+        "rank = jax.process_index()\n"
+        "assert jax.process_count() == 2, jax.process_count()\n"
+        "assert jax.device_count() == 2, jax.device_count()\n"
+        "mesh = Mesh(np.array(jax.devices()), ('x',))\n"
+        "local = jnp.full((1, 4), float(rank + 1))\n"
+        "garr = jax.make_array_from_single_device_arrays(\n"
+        "    (2, 4), NamedSharding(mesh, P('x')),\n"
+        "    [jax.device_put(local, jax.local_devices()[0])])\n"
+        "out = jax.jit(jax.shard_map(lambda a: jax.lax.psum(a, 'x'),\n"
+        "    mesh=mesh, in_specs=P('x'), out_specs=P()))(garr)\n"
+        "got = np.asarray(out.addressable_shards[0].data)\n"
+        "np.testing.assert_allclose(got.reshape(-1)[0], 3.0)\n"
+        "print(f'rank {rank} psum OK')\n"
+    )
+    log_dir = tmp_path / "logs"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(log_dir), str(script)],
+        cwd=REPO, env={**os.environ, "PYTHONPATH": REPO},
+        capture_output=True, timeout=240,
+    )
+    body = ""
+    if log_dir.exists():
+        for f in sorted(os.listdir(log_dir)):
+            body += (log_dir / f).read_text()
+    assert r.returncode == 0, (r.stderr.decode()[-2000:], body[-2000:])
+    assert "rank 0 psum OK" in body and "rank 1 psum OK" in body
