@@ -124,13 +124,50 @@ def run_bench():
     }
 
 
+def run_decode_bench():
+    """Decode tokens/sec through GenerationEngine (the serving hot path;
+    reference gate: masked/block_multihead_attention op benchmarks)."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.inference import GenerationEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=4)
+        batch, prompt, new, max_seq = 8, 128, 128, 512
+    else:
+        cfg = llama.LlamaConfig.tiny()
+        batch, prompt, new, max_seq = 2, 16, 16, 64
+    params = llama.init_params(cfg, jax.random.key(0))
+    eng = GenerationEngine(cfg, params, max_seq=max_seq)
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, prompt))
+    eng.generate(ids, max_new_tokens=4)  # compile prefill+decode
+    t0 = time.perf_counter()
+    out = eng.generate(ids, max_new_tokens=new)
+    dt = time.perf_counter() - t0
+    assert out.shape == (batch, prompt + new)
+    tps = batch * new / dt
+    return {
+        "metric": "llama_decode_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tok/s",
+        "vs_baseline": 0.0,  # no reference decode baseline recorded
+        "detail": {"batch": batch, "prompt": prompt, "new_tokens": new,
+                   "backend": jax.default_backend()},
+    }
+
+
 def worker_main(force_cpu: bool) -> int:
     if force_cpu:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
     try:
-        result = run_bench()
+        result = run_decode_bench() if "--decode" in sys.argv else run_bench()
     except Exception as e:
         print(f"[bench] worker failed: {e}\n{traceback.format_exc()}", file=sys.stderr)
         return 1
@@ -164,10 +201,11 @@ def main():
     if "--worker" in sys.argv:
         sys.exit(worker_main(force_cpu="--cpu" in sys.argv))
 
-    result = _try_worker([], TPU_TIMEOUT)
+    extra = ["--decode"] if "--decode" in sys.argv else []
+    result = _try_worker(extra, TPU_TIMEOUT)
     if result is None:
         print("[bench] TPU run failed; falling back to CPU smoke run", file=sys.stderr)
-        result = _try_worker(["--cpu"], CPU_TIMEOUT)
+        result = _try_worker(extra + ["--cpu"], CPU_TIMEOUT)
     if result is None:
         result = {
             "metric": "llama_train_mfu_single_chip",

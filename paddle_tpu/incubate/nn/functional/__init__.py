@@ -147,10 +147,135 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
     return M.transpose(out, [0, 2, 1, 3])
 
 
-def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kwargs):
-    raise NotImplementedError(
-        "fused_multi_head_attention: use nn.MultiHeadAttention (flash-attention backed)"
-    )
+def fused_multi_head_attention(
+    x, qkv_weight, linear_weight, pre_layer_norm=False, pre_ln_scale=None,
+    pre_ln_bias=None, ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+    qkv_bias=None, linear_bias=None, cache_kv=None, attn_mask=None,
+    dropout_rate=0.5, attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+    mode="upscale_in_train", ring_id=-1, add_residual=True,
+    transpose_qkv_wb=False, num_heads=-1, name=None):
+    """Fused transformer attention block (reference:
+    python/paddle/incubate/nn/functional/fused_transformer.py
+    fused_multi_head_attention over fused_attention_op.cu; fused_ops.yaml).
+
+    out = [post_ln](residual + dropout(linear(flash_attn(qkv(pre_ln(x))))))
+
+    TPU-native: one dispatch through the Pallas flash-attention kernel — the
+    additive/bool ``attn_mask`` streams through the kernel tile-by-tile, so
+    the fusion the reference does in CUDA happens in Mosaic/XLA here.
+    ``qkv_weight``: [3, num_heads, head_dim, embed_dim] (paddle layout), or
+    [embed_dim, 3*embed_dim] with ``transpose_qkv_wb=True`` and ``num_heads``.
+    ``cache_kv`` [2, b, nh, s_cache, hd] (decode): current k/v are appended
+    and attention runs over the full prefix; returns (out, new_cache_kv).
+    Dropout uses the framework RNG and honors ``mode`` like
+    nn.functional.dropout."""
+    import jax
+    import jax.numpy as jnp
+
+    from ....core import rng as _rng
+    from ....ops.pallas import flash_attention as _fa
+
+    if transpose_qkv_wb and num_heads <= 0:
+        raise ValueError(
+            "fused_multi_head_attention: transpose_qkv_wb=True requires "
+            f"num_heads > 0 (got {num_heads})")
+    drop_key = _rng.next_key() if (training and dropout_rate > 0) else None
+    attn_drop_key = _rng.next_key() if (training and attn_dropout_rate > 0) else None
+
+    def _drop(v, key, rate):
+        """nn.functional.dropout semantics incl. ``mode``."""
+        if rate == 0.0:
+            return v
+        if key is None:  # eval
+            if mode == "downscale_in_infer":
+                return (v * (1.0 - rate)).astype(v.dtype)
+            return v
+        keep = jax.random.bernoulli(key, 1.0 - rate, v.shape)
+        if mode == "downscale_in_infer":
+            return jnp.where(keep, v, 0.0).astype(v.dtype)
+        return jnp.where(keep, v / (1.0 - rate), 0.0).astype(v.dtype)
+
+    opt = [("pls", pre_ln_scale), ("plb", pre_ln_bias), ("lns", ln_scale),
+           ("lnb", ln_bias), ("qb", qkv_bias), ("lb", linear_bias),
+           ("am", attn_mask), ("ckv", cache_kv)]
+    present = [t for _, t in opt if t is not None]
+    flags = {n: t is not None for n, t in opt}
+
+    def fn(xv, qkvw, lw, *rest):
+        it = iter(rest)
+        g = {n: (next(it) if flags[n] else None) for n, _ in opt}
+
+        def ln(v, scale_, bias_, eps):
+            mu = v.mean(-1, keepdims=True)
+            var = ((v - mu) ** 2).mean(-1, keepdims=True)
+            o = (v - mu) * jax.lax.rsqrt(var + eps)
+            if scale_ is not None:
+                o = o * scale_
+            if bias_ is not None:
+                o = o + bias_
+            return o
+
+        h = ln(xv, g["pls"], g["plb"], pre_ln_epsilon) if pre_layer_norm else xv
+        b, s, e = h.shape
+        if transpose_qkv_wb:
+            nh = num_heads
+            hd = e // nh
+            qkv = (h @ qkvw).reshape(b, s, 3, nh, hd)
+            if g["qb"] is not None:
+                qkv = qkv + g["qb"].reshape(3, nh, hd)
+        else:
+            nh, hd = qkvw.shape[1], qkvw.shape[2]
+            qkv = jnp.einsum("bse,thde->bsthd", h, qkvw)
+            if g["qb"] is not None:
+                qkv = qkv + g["qb"]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b, s, nh, hd]
+
+        new_cache = None
+        if g["ckv"] is not None:
+            # decode: prepend cached k/v ([2, b, nh, S, hd] BHSD layout)
+            k_bhsd = jnp.concatenate(
+                [g["ckv"][0], k.transpose(0, 2, 1, 3)], axis=2)
+            v_bhsd = jnp.concatenate(
+                [g["ckv"][1], v.transpose(0, 2, 1, 3)], axis=2)
+            new_cache = jnp.stack([k_bhsd, v_bhsd])
+            k = k_bhsd.transpose(0, 2, 1, 3)
+            v = v_bhsd.transpose(0, 2, 1, 3)
+
+        if attn_drop_key is None:
+            attn = _fa.flash_attention_bshd(q, k, v, attn_mask=g["am"])
+        else:
+            # attention-probability dropout forces the composed path (the
+            # reference's fused op also materializes probs when dropping)
+            logits = jnp.einsum("bsnd,bSnd->bnsS", q.astype(jnp.float32),
+                                k.astype(jnp.float32)) / jnp.sqrt(
+                                    jnp.asarray(hd, jnp.float32))
+            if g["am"] is not None:
+                m = g["am"]
+                logits = jnp.where(m, logits, -1e30) if m.dtype == jnp.bool_ \
+                    else logits + m.astype(jnp.float32)
+            p = jax.nn.softmax(logits, axis=-1)
+            keep = jax.random.bernoulli(attn_drop_key, 1.0 - attn_dropout_rate,
+                                        p.shape)
+            p = jnp.where(keep, p / (1.0 - attn_dropout_rate), 0.0)
+            attn = jnp.einsum("bnsS,bSnd->bsnd", p.astype(v.dtype), v)
+
+        out = attn.reshape(b, s, nh * hd) @ lw
+        if g["lb"] is not None:
+            out = out + g["lb"]
+        out = _drop(out, drop_key, dropout_rate)
+        if add_residual:
+            out = xv + out
+        if not pre_layer_norm:
+            out = ln(out, g["lns"], g["lnb"], ln_epsilon)
+        out = out.astype(xv.dtype)
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+    inputs = [x, qkv_weight, linear_weight] + present
+    if cache_kv is not None:
+        return apply_op("fused_multi_head_attention", fn, inputs, n_outputs=2)
+    return apply_op("fused_multi_head_attention", fn, inputs)
 
 
 def fused_moe(

@@ -272,17 +272,22 @@ class GenerationEngine:
         return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
 
     def _attend(self, q, k_all, v_all, pos_mask):
-        """q: [b, s, nh, hd]; k_all/v_all: [b, nkv, S, hd] full cache."""
+        """q: [b, s, nh, hd]; k_all/v_all: [b, nkv, S, hd] full cache.
+
+        Fused GQA decode (masked_multihead_attention analog): q heads are
+        grouped per kv head in the einsum itself — the cache is read once and
+        never repeated in HBM, which is what bounds decode throughput."""
         cfg = self.cfg
         rep = cfg.num_attention_heads // cfg.num_key_value_heads
-        k = jnp.repeat(k_all, rep, axis=1)
-        v = jnp.repeat(v_all, rep, axis=1)
-        logits = jnp.einsum("bsnd,bnSd->bnsS", q.astype(jnp.float32),
-                            k.astype(jnp.float32))
+        b, s, nh, hd = q.shape
+        qg = q.reshape(b, s, cfg.num_key_value_heads, rep, hd)
+        logits = jnp.einsum("bsngd,bnSd->bngsS", qg.astype(jnp.float32),
+                            k_all.astype(jnp.float32))
         logits = logits / np.sqrt(cfg.head_dim)
-        logits = jnp.where(pos_mask, logits, -1e30)
+        logits = jnp.where(pos_mask[:, :, None], logits, -1e30)
         p = jax.nn.softmax(logits, axis=-1)
-        return jnp.einsum("bnsS,bnSd->bsnd", p.astype(v.dtype), v)
+        out = jnp.einsum("bngsS,bnSd->bsngd", p.astype(v_all.dtype), v_all)
+        return out.reshape(b, s, nh, hd)
 
     def _forward_tokens(self, params, ids, cache_k, cache_v, start_pos):
         """Run s tokens starting at start_pos; returns logits of last token and
