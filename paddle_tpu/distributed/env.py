@@ -50,7 +50,11 @@ def _jax_coordinator_via_store(host: str, store_port: int, pid: int) -> str | No
             # rank 0 runs the coordination service, so advertise ITS host
             # (PADDLE_CURRENT_ENDPOINT), not the store's
             my_host = os.environ.get("PADDLE_CURRENT_ENDPOINT", "").split(":")[0] or host
+            # bind-then-close to pick a free port; SO_REUSEADDR narrows (does
+            # not eliminate) the TOCTOU window before jax.distributed rebinds.
+            # PADDLE_JAX_COORD_ADDR is the race-free operator override.
             s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             s.bind(("", 0))
             port = s.getsockname()[1]
             s.close()

@@ -94,7 +94,8 @@ class CollectiveController:
                 # local multi-process runs still need a live store: the
                 # workers rendezvous their jax coordinator address through it
                 # (env.py _jax_coordinator_via_store); port 0 = ephemeral
-                port = int(args.master.split(":")[1]) if args.master else 0
+                port = (int(args.master.split(":")[1])
+                        if args.master and ":" in args.master else 0)
                 self.store = TCPStore(args.host, port, is_master=True,
                                       timeout=120)
             return

@@ -12,6 +12,8 @@ through XLA.  Each kernel here:
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 
@@ -25,3 +27,18 @@ def on_tpu() -> bool:
 def interpret_mode() -> bool:
     """Pallas interpret=True off-TPU so kernels stay testable on CPU CI."""
     return not on_tpu()
+
+
+def kernel_disabled(name: str) -> bool:
+    """Operational escape hatch: route around a Pallas kernel at runtime.
+
+    ``PADDLE_TPU_DISABLE_PALLAS="flash_attention,rms_norm"`` (or ``"all"``)
+    switches the named kernels to their XLA-composed fallbacks.  bench.py's
+    kernel probe sets this when a kernel fails to compile standalone, so a
+    Mosaic regression in one kernel degrades throughput instead of hanging
+    the whole measurement."""
+    disabled = os.environ.get("PADDLE_TPU_DISABLE_PALLAS", "")
+    if not disabled:
+        return False
+    names = {s.strip() for s in disabled.split(",")}
+    return "all" in names or name in names

@@ -46,7 +46,7 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-from . import interpret_mode
+from . import interpret_mode, kernel_disabled
 
 NEG_INF = -1e30
 
@@ -475,7 +475,10 @@ def _xla_mask_grad(q, k, v, out, lse, do, mask, mask_idx, segs, scale, causal,
     dmask = Σ_{broadcast group} ds with ds = p·(dp − delta)·scale.  This is
     O(s²) compute/memory — the same cost class as materializing the mask
     itself — and is dead-code-eliminated by XLA whenever the caller does not
-    differentiate the mask, so the flash path stays O(s·d) in that case."""
+    differentiate the mask *under jit*, so the jitted flash path stays
+    O(s·d) in that case.  In eager (non-jit) grad with a float additive mask
+    every backward pass does materialize the full [b·h, sq, skv] logits; run
+    the step under jit if that cost matters."""
     bh, sq, d = q.shape
     skv = k.shape[1]
     rows_idx = jnp.asarray([mask_idx(i) for i in range(bh)])
@@ -534,7 +537,12 @@ def _normalize_mask(attn_mask, b, hq, sq, skv):
         m = m[None, None]
     elif m.ndim == 3:
         m = m[:, None]
-    if m.shape[2] != sq or m.shape[3] != skv:
+    if m.shape[2] in (1, sq) and m.shape[3] in (1, skv):
+        # broadcastable seq dims (e.g. paddle's canonical [b,1,1,skv]
+        # key-padding mask from _convert_attention_mask): materialize
+        if m.shape[2] != sq or m.shape[3] != skv:
+            m = jnp.broadcast_to(m, m.shape[:2] + (sq, skv))
+    else:
         raise ValueError(f"attn_mask seq dims {m.shape[2:]} != ({sq}, {skv})")
     mb, mh = m.shape[0], m.shape[1]
     if mb not in (1, b) or mh not in (1, hq):
@@ -559,7 +567,7 @@ def flash_attention_bshd(q, k, v, attn_mask=None, causal=False, scale=None,
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     global KERNEL_CALLS, FALLBACK_CALLS
-    if d % 8 != 0 or hq % hkv != 0:
+    if d % 8 != 0 or hq % hkv != 0 or kernel_disabled("flash_attention"):
         FALLBACK_CALLS += 1
         if segment_ids is not None:
             # fold segment ids into the mask so packing semantics survive

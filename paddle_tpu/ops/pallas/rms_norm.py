@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from . import interpret_mode
+from . import interpret_mode, kernel_disabled
 
 
 def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
@@ -25,21 +25,30 @@ def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
 
 
 def _rms_fwd_pallas(x2d, w, eps):
+    if kernel_disabled("rms_norm"):
+        xf = x2d.astype(jnp.float32)
+        inv = jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+        return (xf * inv * w.astype(jnp.float32)).astype(x2d.dtype)
     rows, d = x2d.shape
-    br = rows if rows <= 256 else 256
-    if rows % br != 0:
-        br = rows  # single block fallback
-    return pl.pallas_call(
+    br = min(rows, 256)
+    # pad ragged row counts up to the block grid instead of collapsing to a
+    # single [rows, d] block (which blows VMEM at e.g. [8·2048+1, 4096] fp32);
+    # rows are independent, zero rows normalize to zero, pad sliced off below
+    pad = (-rows) % br
+    if pad:
+        x2d = jnp.pad(x2d, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
         functools.partial(_rms_kernel, eps=eps),
-        grid=(pl.cdiv(rows, br),),
+        grid=((rows + pad) // br,),
         in_specs=[
             pl.BlockSpec((br, d), lambda i: (i, 0)),
             pl.BlockSpec((d,), lambda i: (0,)),
         ],
         out_specs=pl.BlockSpec((br, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((rows, d), x2d.dtype),
+        out_shape=jax.ShapeDtypeStruct((rows + pad, d), x2d.dtype),
         interpret=interpret_mode(),
     )(x2d, w)
+    return out[:rows] if pad else out
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
