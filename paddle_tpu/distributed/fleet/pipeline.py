@@ -39,6 +39,7 @@ __all__ = [
     "PipelineParallel",
     "SegmentLayers",
     "gpipe_stacked",
+    "one_f_one_b_stacked",
     "schedule_fthenb",
     "schedule_1f1b",
     "schedule_interleave",
@@ -86,18 +87,32 @@ def gpipe_stacked(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
     n_stages = mesh.shape[axis_name]
     num_micro = microbatches.shape[0]
     fwd_perm = [(p, p + 1) for p in range(n_stages - 1)]
-    # f32 at the shard_map boundary: the transpose of any pp-replicated input
-    # is a psum over 'pp', and XLA CPU's AllReducePromotion pass crashes on
-    # bf16 all-reduces; compute stays in the caller's dtypes inside.
+    # f32 at the shard_map boundary ONLY when the mesh's own devices are CPU:
+    # the transpose of any pp-replicated input is a psum over 'pp', and XLA
+    # CPU's AllReducePromotion pass crashes on bf16 all-reduces.  On TPU the
+    # native (bf16) dtypes cross the boundary — half the ICI bytes per
+    # microbatch (reference sends exactly one stage tensor per hop,
+    # p2p_communication.py:651).
+    _cpu = mesh.devices.flat[0].platform == "cpu"
+
     def _f32(t):
-        return t.astype(jnp.float32) if jnp.issubdtype(t.dtype, jnp.floating) else t
+        return (t.astype(jnp.float32)
+                if _cpu and jnp.issubdtype(t.dtype, jnp.floating) else t)
 
     compute_dtype = microbatches.dtype
     extra_dtypes = tuple(e.dtype for e in extra_args)
     microbatches = _f32(microbatches)
     extra_args = tuple(_f32(e) for e in extra_args)
+    # params are pp-sharded (transpose over 'pp' is identity) but REPLICATED
+    # over any extra manual axis (e.g. 'sep') — their AD transpose is a psum
+    # over that axis, which on CPU hits the same bf16 AllReduce crash; cast
+    # them across the boundary too (bisected r3: bf16 params + manual sep)
+    param_dtypes = jax.tree_util.tree_map(lambda p: p.dtype, stacked_params)
+    stacked_params = jax.tree_util.tree_map(_f32, stacked_params)
 
     def inner(local_params, mb_in, *extras):
+        local_params = jax.tree_util.tree_map(
+            lambda p, dt: p.astype(dt), local_params, param_dtypes)
         mb_in = mb_in.astype(compute_dtype)
         extras = tuple(e.astype(dt) for e, dt in zip(extras, extra_dtypes))
         stage = jax.lax.axis_index(axis_name)
@@ -124,9 +139,12 @@ def gpipe_stacked(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
         outbuf0 = jnp.zeros_like(mb_in)
         (_, outbuf), _ = jax.lax.scan(
             tick, (recv0, outbuf0), jnp.arange(num_micro + n_stages - 1))
-        # only the last stage ever wrote non-zeros: psum replicates its buffer
-        # (f32 all-reduce: XLA CPU's AllReducePromotion pass crashes on bf16)
-        return jax.lax.psum(outbuf.astype(jnp.float32), axis_name).astype(mb_in.dtype)
+        # only the last stage ever wrote non-zeros: psum is the partial →
+        # replicated broadcast (GSPMD's own lowering for single-source
+        # broadcast).  Native dtype on TPU; f32 only on CPU (see _f32 above).
+        if _cpu:
+            return jax.lax.psum(outbuf.astype(jnp.float32), axis_name).astype(mb_in.dtype)
+        return jax.lax.psum(outbuf, axis_name)
 
     pp_leading = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     mb_spec = mb_spec if mb_spec is not None else P()
@@ -140,6 +158,206 @@ def gpipe_stacked(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
         axis_names={axis_name, *manual_axes},
         check_vma=False,
     )(stacked_params, microbatches, *extra_args)
+
+
+def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
+                        embed_params, stacked_params, head_params,
+                        micro_inputs, micro_labels, mesh, axis_name="pp",
+                        extra_args=(), boundary_f32=None):
+    """Executed 1F1B pipeline schedule as ONE compiled SPMD program (the
+    reference's PipelineParallel.forward_backward_pipeline, pipeline_parallel
+    .py:684, re-thought for a TPU mesh — not simulated, not AD-through-scan).
+
+    Synchronous 1F1B on a global clock: tick ``k`` runs, at stage ``s``,
+
+      F sub-tick:  forward of microbatch  f = k - s                (if valid)
+      B sub-tick:  backward of microbatch b = k - 2(P-1) + s       (if valid)
+
+    which is exactly the 1F1B tick order of :func:`schedule_1f1b` (the last
+    stage alternates F/B back-to-back; warmup depth P-1-s).  Total ticks
+    M + 2(P-1); warmup/drain sub-ticks are *skipped* via ``lax.cond`` on
+    ``axis_index`` — unlike :func:`gpipe_stacked`, bubble ticks burn no
+    garbage FLOPs, and the activation working set is an O(P)-slot ring
+    instead of AD-through-scan's O(M+P) saved ticks.  The backward sub-tick
+    recomputes its stage forward from the ring-saved input (``jax.vjp``),
+    i.e. 1F1B composes with per-stage recompute the way the reference's
+    recompute+pp deployment does (fleet/recompute + pipeline_parallel).
+
+    The first stage owns ``embed_fn``, the last owns ``head_loss_fn`` — loss
+    cotangents are produced per-microbatch at the last stage, which is what
+    makes true F/B interleaving possible in a single program (a loss computed
+    outside the pipelined region would serialize into FThenB).  Each tick
+    moves exactly one stage-boundary activation forward and one gradient
+    backward over ICI (``lax.ppermute``), matching the reference's
+    send_forward/send_backward pairing (p2p_communication.py:651); the only
+    cross-stage reductions are the scalar loss and the shared embed/head
+    grads (partial → replicated psum once per step).
+
+    Args:
+      embed_fn: ``(embed_params, ids_mb, *extra_args) -> x [mb, ...]``.
+      stage_fn: ``(local_stage_params, x, *extra_args) -> y`` (y.shape ==
+        x.shape; uniform transformer stack).
+      head_loss_fn: ``(head_params, y, labels_mb, *extra_args) -> scalar``
+        mean loss of one microbatch.
+      stacked_params: pytree with leading layer dim divisible by P, sharded
+        over ``axis_name``.
+      micro_inputs / micro_labels: ``[M, mb, ...]`` (e.g. int token ids),
+        replicated over pp (other mesh axes stay GSPMD-auto).
+      boundary_f32: cast ppermute payloads to f32 (default: only when the
+        mesh's devices are CPU, where XLA's collective handling of bf16 is
+        unreliable; TPU keeps native dtypes — half the ICI bytes).
+
+    Returns ``(mean_loss, (d_embed, d_stacked, d_head))`` — grads in f32;
+    ``d_stacked`` stays sharded over ``axis_name``, embed/head grads are
+    replicated (psum over pp).
+    """
+    P_ = mesh.shape[axis_name]
+    assert P_ > 1, "one_f_one_b_stacked requires pp > 1"
+    M = micro_inputs.shape[0]
+    M_f = float(M)
+    R = 2 * (P_ - 1) + 1  # max in-flight microbatches per stage (stage 0)
+    fwd_perm = [(p, p + 1) for p in range(P_ - 1)]
+    bwd_perm = [(p, p - 1) for p in range(1, P_)]
+    if boundary_f32 is None:
+        boundary_f32 = mesh.devices.flat[0].platform == "cpu"
+
+    act_aval = jax.eval_shape(embed_fn, embed_params, micro_inputs[0], *extra_args)
+    act_shape, act_dtype = act_aval.shape, act_aval.dtype
+
+    def _permute(x, perm):
+        if boundary_f32 and jnp.issubdtype(x.dtype, jnp.floating):
+            return jax.lax.ppermute(x.astype(jnp.float32), axis_name, perm).astype(x.dtype)
+        return jax.lax.ppermute(x, axis_name, perm)
+
+    def inner(embed_p, stacked_p, head_p, mb_in, mb_lbl, *extras):
+        stage = jax.lax.axis_index(axis_name)
+        is_first = stage == 0
+        is_last = stage == P_ - 1
+        # 0 = first, 1 = middle, 2 = last (P_ >= 2 so first != last)
+        branch_idx = jnp.where(is_first, 0, jnp.where(is_last, 2, 1))
+
+        f32_zeros = lambda tree: jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), tree)
+        f32_tree = lambda tree: jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), tree)
+        tree_add = lambda a, b: jax.tree_util.tree_map(jnp.add, a, b)
+
+        def tick(carry, k):
+            recv_f, recv_b, ring, dep, dsp, dhp, loss_acc = carry
+
+            # ---- F sub-tick: forward microbatch k - stage ----
+            fi = k - stage
+            f_valid = (fi >= 0) & (fi < M)
+            fi_c = jnp.clip(fi, 0, M - 1)
+
+            def do_f(ring):
+                ids = jax.lax.dynamic_index_in_dim(mb_in, fi_c, 0, keepdims=False)
+                x_in = jax.lax.cond(
+                    is_first,
+                    lambda: embed_fn(embed_p, ids, *extras).astype(act_dtype),
+                    lambda: recv_f)
+                ring = jax.lax.dynamic_update_index_in_dim(ring, x_in, fi_c % R, 0)
+                # the last stage's forward is fused into its B sub-tick (same
+                # tick), so its F sub-tick sends nothing and computes nothing
+                y = jax.lax.cond(
+                    is_last,
+                    lambda: jnp.zeros(act_shape, act_dtype),
+                    lambda: stage_fn(stacked_p, x_in, *extras))
+                return ring, y
+
+            ring, y = jax.lax.cond(
+                f_valid, do_f,
+                lambda ring: (ring, jnp.zeros(act_shape, act_dtype)), ring)
+
+            # ---- B sub-tick: backward microbatch k - 2(P-1) + stage ----
+            bi = k - 2 * (P_ - 1) + stage
+            b_valid = (bi >= 0) & (bi < M)
+            bi_c = jnp.clip(bi, 0, M - 1)
+
+            def do_b(dep, dsp, dhp, loss_acc):
+                x_saved = jax.lax.dynamic_index_in_dim(ring, bi_c % R, 0, keepdims=False)
+                lbl = jax.lax.dynamic_index_in_dim(mb_lbl, bi_c, 0, keepdims=False)
+                ids = jax.lax.dynamic_index_in_dim(mb_in, bi_c, 0, keepdims=False)
+
+                def stage_vjp():
+                    _, vjp = jax.vjp(
+                        lambda sp, x: stage_fn(sp, x, *extras), stacked_p, x_saved)
+                    return vjp(recv_b)
+
+                def first_b():
+                    g_sp, g_x = stage_vjp()
+                    _, evjp = jax.vjp(
+                        lambda ep: embed_fn(ep, ids, *extras).astype(act_dtype),
+                        embed_p)
+                    (g_ep,) = evjp(g_x)
+                    return (jnp.float32(0), f32_tree(g_ep), f32_tree(g_sp),
+                            f32_zeros(head_p), jnp.zeros(act_shape, act_dtype))
+
+                def mid_b():
+                    g_sp, g_x = stage_vjp()
+                    return (jnp.float32(0), f32_zeros(embed_p), f32_tree(g_sp),
+                            f32_zeros(head_p), g_x)
+
+                def last_b():
+                    def full(sp, hp, x):
+                        return head_loss_fn(hp, stage_fn(sp, x, *extras), lbl, *extras)
+
+                    lval, (g_sp, g_hp, g_x) = jax.value_and_grad(
+                        full, argnums=(0, 1, 2))(stacked_p, head_p, x_saved)
+                    inv_m = 1.0 / M_f  # mean over microbatches
+                    scale = lambda t: jax.tree_util.tree_map(
+                        lambda g: g.astype(jnp.float32) * inv_m, t)
+                    return (lval.astype(jnp.float32) / M_f, f32_zeros(embed_p),
+                            scale(g_sp), scale(g_hp),
+                            jax.tree_util.tree_map(lambda g: g * inv_m, g_x))
+
+                lval, g_ep, g_sp, g_hp, g_x = jax.lax.switch(
+                    branch_idx, [first_b, mid_b, last_b])
+                return (tree_add(dep, g_ep), tree_add(dsp, g_sp),
+                        tree_add(dhp, g_hp), loss_acc + lval, g_x)
+
+            dep, dsp, dhp, loss_acc, dx = jax.lax.cond(
+                b_valid, do_b,
+                lambda dep, dsp, dhp, loss_acc: (
+                    dep, dsp, dhp, loss_acc, jnp.zeros(act_shape, act_dtype)),
+                dep, dsp, dhp, loss_acc)
+
+            recv_f = _permute(y, fwd_perm)
+            recv_b = _permute(dx, bwd_perm)
+            return (recv_f, recv_b, ring, dep, dsp, dhp, loss_acc), None
+
+        carry0 = (
+            jnp.zeros(act_shape, act_dtype),          # recv_f
+            jnp.zeros(act_shape, act_dtype),          # recv_b
+            jnp.zeros((R,) + act_shape, act_dtype),   # input ring
+            f32_zeros(embed_p),
+            f32_zeros(stacked_p),
+            f32_zeros(head_p),
+            jnp.float32(0),
+        )
+        (_, _, _, dep, dsp, dhp, loss_acc), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(M + 2 * (P_ - 1)))
+        # loss lives on the last stage, embed/head grads on their owning
+        # stages: scalar + shared-param psums (cheap; the per-stage grads —
+        # the big ones — never cross stage boundaries)
+        loss = jax.lax.psum(loss_acc, axis_name)
+        dep = jax.lax.psum(dep, axis_name)
+        dhp = jax.lax.psum(dhp, axis_name)
+        return loss, dep, dsp, dhp
+
+    pp_leading = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    rep = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+    loss, dep, dsp, dhp = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(rep(embed_params), pp_leading, rep(head_params), P(), P())
+        + tuple(P() for _ in extra_args),
+        out_specs=(P(), rep(embed_params), pp_leading, rep(head_params)),
+        axis_names={axis_name},
+        check_vma=False,
+    )(embed_params, stacked_params, head_params, micro_inputs, micro_labels,
+      *extra_args)
+    return loss, (dep, dsp, dhp)
 
 
 class LayerDesc:
