@@ -290,6 +290,60 @@ def run_decode_rung(name, cfg, batch, prompt, new, max_seq):
     }
 
 
+def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq):
+    """Continuous-batching throughput: staggered prompt lengths through the
+    slot-pool scheduler (inference/serving.py), the serving pattern behind the
+    reference's block_multihead_attention stack (fused_ops.yaml:45)."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
+
+    log(f"cb rung {name}: building (slots={max_batch} requests={n_requests})")
+    params = llama.init_params(cfg, jax.random.key(0))
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch, max_seq=max_seq)
+    rs = np.random.RandomState(0)
+    # warm the decode step plus one prefill per bucket the timed requests can
+    # land in (lengths span [prompt//2, prompt//2 + prompt - 1]) so no XLA
+    # compile lands inside the timed region
+    from paddle_tpu.inference.serving import _bucket
+    lo_b = min(_bucket(prompt // 2), max_seq)
+    hi_b = min(_bucket(prompt // 2 + prompt - 1), max_seq)
+    buckets = []
+    b = lo_b
+    while b <= hi_b:
+        buckets.append(b)
+        b *= 2
+    t_c = time.perf_counter()
+    for bi, b in enumerate(buckets):
+        warm_len = min(b, max_seq - 1)
+        eng.serve([Request(rid=-1 - bi,
+                           prompt_ids=rs.randint(0, cfg.vocab_size, (warm_len,)).astype(np.int32),
+                           max_new_tokens=2)])
+    log(f"cb rung {name}: compile {time.perf_counter() - t_c:.1f}s (buckets {buckets})")
+    eng.stats.update(decode_steps=0, decode_tokens=0, decode_time_s=0.0)
+    reqs = [Request(rid=i,
+                    prompt_ids=rs.randint(0, cfg.vocab_size,
+                                          (prompt // 2 + rs.randint(prompt),)).astype(np.int32),
+                    max_new_tokens=new)
+            for i in range(n_requests)]
+    t0 = time.perf_counter()
+    eng.serve(reqs)
+    wall = time.perf_counter() - t0
+    total = sum(len(r.output_ids) for r in reqs)
+    return {
+        "metric": "llama_cb_decode_tokens_per_sec",
+        "value": round(eng.decode_tokens_per_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "detail": {"rung": name, "slots": max_batch, "requests": n_requests,
+                   "total_new_tokens": total, "wall_s": round(wall, 2),
+                   "decode_steps": eng.stats["decode_steps"],
+                   "backend": jax.default_backend()},
+    }
+
+
 def decode_ladder_main() -> int:
     import jax
 
@@ -310,6 +364,18 @@ def decode_ladder_main() -> int:
             banked += 1
         except Exception as e:
             log(f"decode rung {rung[0]} failed: {e}\n{traceback.format_exc()}")
+            break
+    # continuous-batching rungs (slot-pool scheduler)
+    cb_rungs = ([("cb_tiny", llama.LlamaConfig.tiny(), 2, 6, 16, 16, 64),
+                 ("cb_full", full_cfg, 8, 24, 128, 64, 512)]
+                if on_tpu else
+                [("cb_cpu_smoke", llama.LlamaConfig.tiny(), 2, 4, 16, 8, 64)])
+    for rung in cb_rungs:
+        try:
+            emit(run_cb_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"cb rung {rung[0]} failed: {e}\n{traceback.format_exc()}")
             break
     return 0 if banked else 1
 

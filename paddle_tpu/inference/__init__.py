@@ -246,6 +246,66 @@ def create_predictor(config: Config) -> Predictor:
 # LLM serving: prefill + KV-cache decode (block_multihead_attention path)
 # ---------------------------------------------------------------------------
 
+def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos, sin):
+    """Cache-threading transformer body shared by GenerationEngine and the
+    continuous-batching engine (serving.py) — one copy of the GQA attend +
+    rms/rope/swiglu scan so masking/grouping fixes can't diverge.
+
+    ``write_fn(cache_layer, kv) -> (committed, attend_view)`` commits new K/V
+    into a per-layer cache [B, nkv, S, hd] and returns the view attention
+    should read (usually the committed cache itself; the slot-prefill path
+    returns its single lane so a batch-1 prompt can prefill into a wider
+    pool).  ``mask`` broadcasts against logits [b, nkv, rep, s, S].
+    Returns (final-normed hidden [b, s, h], all_k, all_v).
+    """
+    from ..ops.pallas import rms_norm as rms
+    from ..ops.pallas import rope as rope_mod
+    from ..ops.pallas import swiglu as swiglu_mod
+
+    b, s = x.shape[:2]
+    nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    rep = nh // nkv
+
+    def attend(q, k_all, v_all):
+        # fused GQA decode (masked_multihead_attention analog): q heads are
+        # grouped per kv head in the einsum itself — the cache is read once
+        # and never repeated in HBM, which is what bounds decode throughput
+        qg = q.reshape(b, s, nkv, rep, hd)
+        logits = jnp.einsum("bsngd,bnSd->bngsS", qg.astype(jnp.float32),
+                            k_all.astype(jnp.float32)) / np.sqrt(hd)
+        logits = jnp.where(mask, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bngsS,bnSd->bsngd", p.astype(v_all.dtype), v_all)
+        return out.reshape(b, s, nh * hd)
+
+    def body(carry, layer_in):
+        x = carry
+        lp, ck, cv = layer_in
+        xn = rms.rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q = (xn @ lp["wq"]).reshape(b, s, nh, hd)
+        k = (xn @ lp["wk"]).reshape(b, s, nkv, hd)
+        v = (xn @ lp["wv"]).reshape(b, s, nkv, hd)
+        q, k = rope_mod.apply_rotary_pos_emb(q, k, cos, sin)
+        ck, k_att = write_fn(ck, k)
+        cv, v_att = write_fn(cv, v)
+        x = x + attend(q, k_att, v_att) @ lp["wo"]
+        xn = rms.rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+        x = x + swiglu_mod.swiglu(xn @ lp["w_gate"], xn @ lp["w_up"]) @ lp["w_down"]
+        return x, (ck, cv)
+
+    x, (all_k, all_v) = jax.lax.scan(body, x, (params["layers"], cache_k, cache_v))
+    return rms.rms_norm(x, params["final_norm"], cfg.rms_norm_eps), all_k, all_v
+
+
+def lm_head_logits(cfg, params, x_last):
+    """Project final hidden state(s) through the (possibly tied) LM head."""
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T.astype(cfg.dtype)
+    return x_last @ head
+
+
 class GenerationEngine:
     """Greedy/temperature decoding for the Llama family with a dense KV cache.
 
@@ -271,31 +331,11 @@ class GenerationEngine:
                  self.max_seq, cfg.head_dim)
         return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
 
-    def _attend(self, q, k_all, v_all, pos_mask):
-        """q: [b, s, nh, hd]; k_all/v_all: [b, nkv, S, hd] full cache.
-
-        Fused GQA decode (masked_multihead_attention analog): q heads are
-        grouped per kv head in the einsum itself — the cache is read once and
-        never repeated in HBM, which is what bounds decode throughput."""
-        cfg = self.cfg
-        rep = cfg.num_attention_heads // cfg.num_key_value_heads
-        b, s, nh, hd = q.shape
-        qg = q.reshape(b, s, cfg.num_key_value_heads, rep, hd)
-        logits = jnp.einsum("bsngd,bnSd->bngsS", qg.astype(jnp.float32),
-                            k_all.astype(jnp.float32))
-        logits = logits / np.sqrt(cfg.head_dim)
-        logits = jnp.where(pos_mask[:, :, None], logits, -1e30)
-        p = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("bngsS,bnSd->bsngd", p.astype(v_all.dtype), v_all)
-        return out.reshape(b, s, nh, hd)
-
     def _forward_tokens(self, params, ids, cache_k, cache_v, start_pos):
         """Run s tokens starting at start_pos; returns logits of last token and
         the updated caches."""
-        cfg, llama = self.cfg, self._llama
-        from ..ops.pallas import rms_norm as rms
+        cfg = self.cfg
         from ..ops.pallas import rope as rope_mod
-        from ..ops.pallas import swiglu as swiglu_mod
 
         b, s = ids.shape
         S = self.max_seq
@@ -306,41 +346,20 @@ class GenerationEngine:
         # rope_cos_sin returns [1, S, d]; slice the sequence axis
         cos = jax.lax.dynamic_slice_in_dim(cos_full, start_pos, s, axis=1)
         sin = jax.lax.dynamic_slice_in_dim(sin_full, start_pos, s, axis=1)
-        nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
-                       cfg.head_dim)
         # causal-with-offset mask over the cache: key j visible to query i iff
-        # j <= start_pos + i
-        kv_pos = jnp.arange(S)[None, None, None, :]
-        q_pos = start_pos + jnp.arange(s)[None, None, :, None]
+        # j <= start_pos + i; broadcast to logits [b, nkv, rep, s, S]
+        kv_pos = jnp.arange(S)[None, None, None, None, :]
+        q_pos = start_pos + jnp.arange(s)[None, None, None, :, None]
         mask = kv_pos <= q_pos
 
-        def body(carry, layer_in):
-            x = carry
-            lp, ck, cv = layer_in
-            xn = rms.rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-            q = (xn @ lp["wq"]).reshape(b, s, nh, hd)
-            k = (xn @ lp["wk"]).reshape(b, s, nkv, hd)
-            v = (xn @ lp["wv"]).reshape(b, s, nkv, hd)
-            q, k = rope_mod.apply_rotary_pos_emb(q, k, cos, sin)
-            # write k/v into cache at [start_pos:start_pos+s]
-            ck = jax.lax.dynamic_update_slice_in_dim(
+        def write(ck, k):
+            out = jax.lax.dynamic_update_slice_in_dim(
                 ck, k.transpose(0, 2, 1, 3), start_pos, axis=2)
-            cv = jax.lax.dynamic_update_slice_in_dim(
-                cv, v.transpose(0, 2, 1, 3), start_pos, axis=2)
-            attn = self._attend(q, ck, cv, mask)
-            x = x + attn.reshape(b, s, nh * hd) @ lp["wo"]
-            xn = rms.rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-            x = x + swiglu_mod.swiglu(xn @ lp["w_gate"], xn @ lp["w_up"]) @ lp["w_down"]
-            return x, (ck, cv)
+            return out, out
 
-        x, (all_k, all_v) = jax.lax.scan(
-            body, x, (params["layers"], cache_k, cache_v))
-        x = rms.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-        head = params.get("lm_head")
-        if head is None:
-            head = params["embed"].T.astype(cfg.dtype)
-        logits = x[:, -1] @ head
-        return logits, all_k, all_v
+        x, all_k, all_v = transformer_apply(cfg, params, x, cache_k, cache_v,
+                                            write, mask, cos, sin)
+        return lm_head_logits(cfg, params, x[:, -1]), all_k, all_v
 
     def _prefill_impl(self, params, ids, cache_k, cache_v):
         return self._forward_tokens(params, ids, cache_k, cache_v, 0)

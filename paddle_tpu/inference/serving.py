@@ -1,0 +1,255 @@
+"""Continuous-batching decode scheduler (VERDICT r2 #6).
+
+Reference analog: the serving stack behind the reference's fused block
+attention family — `paddle/phi/ops/yaml/fused_ops.yaml:45`
+(``block_multihead_attention_``) and `:394` (``fused_multi_transformer_``) —
+which backs PaddleNLP's continuous-batching servers.
+
+TPU-first design
+----------------
+A TPU serving engine wants *static shapes*: one compiled decode step over a
+fixed slot pool, re-run every iteration.  So instead of the reference's
+dynamic batch + paged block tables, we keep:
+
+  * a slot pool of ``max_batch`` lanes in one shared dense KV cache
+    [L, max_batch, nkv, S, hd] — a lane is the TPU analog of a block table
+    entry (HBM is pre-reserved; XLA gets a fixed layout to tile),
+  * one jitted decode step with a *per-slot position vector* — slots at
+    different depths decode together in a single batched program (this is
+    what "continuous batching" means at the kernel level: the batch never
+    drains to admit a newcomer),
+  * prefill into a single lane with bucketed prompt padding (powers of two),
+    bounding the number of compiled prefill variants to log2(max_seq).
+
+Admission/retirement is plain Python around the two compiled programs —
+scheduling is control-plane work and costs microseconds next to a device
+step, the same split the reference makes between its C++ scheduler and CUDA
+kernels.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Request", "ContinuousBatchingEngine"]
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt_ids: np.ndarray  # [s0] int32
+    max_new_tokens: int = 32
+    eos_token_id: int | None = None
+    # filled by the engine
+    output_ids: list = field(default_factory=list)
+    finished: bool = False
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class ContinuousBatchingEngine:
+    """Slot-pool continuous batching over a Llama-family model.
+
+    ``cfg``/``params`` follow paddle_tpu.models.llama conventions (the same
+    pytree the AOT GenerationEngine uses, inference/__init__.py:249).
+    """
+
+    def __init__(self, cfg, params, max_batch: int = 8, max_seq: int = 512):
+        from ..models import llama as _llama  # noqa: F401  (cfg type lives there)
+
+        self.cfg = cfg
+        self.params = params
+        self.max_batch = max_batch
+        self.max_seq = max_seq
+        L = cfg.num_hidden_layers
+        shape = (L, max_batch, cfg.num_key_value_heads, max_seq, cfg.head_dim)
+        self.cache_k = jnp.zeros(shape, cfg.dtype)
+        self.cache_v = jnp.zeros(shape, cfg.dtype)
+        # slot state (host side)
+        self._slot_req: list[Request | None] = [None] * max_batch
+        self._pos = np.zeros(max_batch, np.int32)      # next write position
+        self._last_tok = np.zeros(max_batch, np.int32)
+        self._queue: list[Request] = []
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1, 2))
+        # prefill writes its lane directly into the donated pool arrays —
+        # no slice-out/scatter-back copies of the full pool per admission
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(2, 3),
+                                static_argnums=(6,))
+        self.stats = {"decode_steps": 0, "decode_tokens": 0,
+                      "prefills": 0, "decode_time_s": 0.0}
+
+    # ---------------- compiled programs ----------------
+
+    def _decode_impl(self, params, cache_k, cache_v, tokens, pos, active):
+        """One continuous-batching step.
+
+        tokens [B] int32, pos [B] int32 (per-slot depth), active [B] bool.
+        Inactive slots compute garbage that is masked out — the static batch
+        is the price of a single compiled program, and idle lanes are cheap
+        next to recompiling (the standard TPU serving trade).
+        """
+        from .. import inference as _inf
+        from ..ops.pallas import rope as rope_mod
+
+        cfg = self.cfg
+        B = self.max_batch
+        S = self.max_seq
+        x = jnp.take(params["embed"], tokens[:, None], axis=0).astype(cfg.dtype)
+        cos_full, sin_full = rope_mod.rope_cos_sin(S, cfg.head_dim,
+                                                   base=cfg.rope_theta,
+                                                   dtype=cfg.dtype)
+        cos = jnp.take(cos_full[0], pos, axis=0)[:, None]  # [B, 1, d]
+        sin = jnp.take(sin_full[0], pos, axis=0)[:, None]
+        kv_pos = jnp.arange(S)[None, None, None, None, :]
+        mask = ((kv_pos <= pos[:, None, None, None, None])
+                & active[:, None, None, None, None])
+        lane = jnp.arange(B)
+        safe_pos = jnp.where(active, pos, 0)
+
+        def write(ck, k):
+            # ck [B, nkv, S, hd]; k [B, 1, nkv, hd] — per-slot scatter at
+            # each slot's own depth (drop writes from inactive lanes)
+            upd = jnp.where(active[:, None, None], k[:, 0], ck[lane, :, safe_pos])
+            out = ck.at[lane, :, safe_pos].set(upd)
+            return out, out
+
+        x, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
+                                           write, mask, cos, sin)
+        return _inf.lm_head_logits(cfg, params, x[:, -1]), ak, av
+
+    def _prefill_impl(self, params, ids, cache_k, cache_v, slot, length, bucket):
+        """Prefill one request (batch 1, prompt padded to ``bucket``) directly
+        into lane ``slot`` of the (donated) cache pools.
+
+        Tokens at or beyond ``length`` are padding and masked out of attention
+        (they still write cache positions, which the causal mask makes
+        unreachable until the slot's pos pointer passes them — it never does,
+        decode overwrites).  No logits are computed: the last real prompt
+        token is fed to the first decode step instead (standard split).
+        """
+        from .. import inference as _inf
+        from ..ops.pallas import rope as rope_mod
+
+        cfg = self.cfg
+        S = self.max_seq
+        x = jnp.take(params["embed"], ids, axis=0).astype(cfg.dtype)
+        cos_full, sin_full = rope_mod.rope_cos_sin(S, cfg.head_dim,
+                                                   base=cfg.rope_theta,
+                                                   dtype=cfg.dtype)
+        cos = cos_full[:, :bucket]
+        sin = sin_full[:, :bucket]
+        kv_pos = jnp.arange(S)[None, None, None, None, :]
+        q_pos = jnp.arange(bucket)[None, None, None, :, None]
+        mask = (kv_pos <= q_pos) & (kv_pos < length)
+
+        nkv = cfg.num_key_value_heads
+
+        def write(ck, k):
+            # ck [B, nkv, S, hd] pool layer; commit this request's K/V into
+            # lane `slot` positions [0:bucket], attend over that lane only
+            out = jax.lax.dynamic_update_slice(
+                ck, k.transpose(0, 2, 1, 3), (slot, 0, 0, 0))
+            view = jax.lax.dynamic_slice(
+                out, (slot, 0, 0, 0), (1, nkv, S, cfg.head_dim))
+            return out, view
+
+        _, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
+                                           write, mask, cos, sin)
+        return ak, av
+
+    # ---------------- scheduler ----------------
+
+    def _validate(self, req: Request):
+        ids = np.asarray(req.prompt_ids, np.int32).ravel()
+        if ids.size == 0:
+            raise ValueError(f"request {req.rid}: empty prompt")
+        if ids.size > self.max_seq - 1:
+            raise ValueError(
+                f"request {req.rid}: prompt length {ids.size} exceeds "
+                f"max_seq-1 = {self.max_seq - 1}")
+
+    def add_request(self, req: Request):
+        self._validate(req)
+        self._queue.append(req)
+
+    def _admit(self):
+        """Fill free slots from the queue (prefill path)."""
+        for slot in range(self.max_batch):
+            if self._slot_req[slot] is not None or not self._queue:
+                continue
+            req = self._queue.pop(0)
+            ids = np.asarray(req.prompt_ids, np.int32).ravel()
+            s0 = ids.size
+            bucket = min(_bucket(s0), self.max_seq)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :s0] = ids
+            # the last real token is fed to decode, not prefill, so its
+            # logits come from the decode step (standard split)
+            self.cache_k, self.cache_v = self._prefill(
+                self.params, jnp.asarray(padded), self.cache_k, self.cache_v,
+                jnp.asarray(slot, jnp.int32), jnp.asarray(s0 - 1, jnp.int32),
+                bucket)
+            self._slot_req[slot] = req
+            self._pos[slot] = s0 - 1
+            self._last_tok[slot] = ids[-1]
+            self.stats["prefills"] += 1
+
+    def _retire(self, slot):
+        self._slot_req[slot].finished = True
+        self._slot_req[slot] = None
+
+    def step(self) -> bool:
+        """One admit + decode iteration.  Returns False when fully idle."""
+        self._admit()
+        active_np = np.asarray([r is not None for r in self._slot_req])
+        if not active_np.any():
+            return False
+        t0 = time.perf_counter()
+        logits, self.cache_k, self.cache_v = self._decode(
+            self.params, self.cache_k, self.cache_v,
+            jnp.asarray(self._last_tok), jnp.asarray(self._pos),
+            jnp.asarray(active_np))
+        nxt = np.asarray(jnp.argmax(logits, axis=-1)).astype(np.int32)
+        self.stats["decode_time_s"] += time.perf_counter() - t0
+        self.stats["decode_steps"] += 1
+        self.stats["decode_tokens"] += int(active_np.sum())
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            tok = int(nxt[slot])
+            req.output_ids.append(tok)
+            self._pos[slot] += 1
+            self._last_tok[slot] = tok
+            done = (len(req.output_ids) >= req.max_new_tokens
+                    or (req.eos_token_id is not None and tok == req.eos_token_id)
+                    # next decode would write K/V at pos == max_seq: out of
+                    # bounds, so position max_seq-1 is the last usable one
+                    or self._pos[slot] >= self.max_seq)
+            if done:
+                self._retire(slot)
+        return True
+
+    def serve(self, requests: list[Request]) -> dict[int, list[int]]:
+        """Run all requests to completion; returns {rid: generated tokens}."""
+        for r in requests:
+            self._validate(r)  # all-or-nothing: no request enqueued if any is bad
+        for r in requests:
+            self.add_request(r)
+        while self.step() or self._queue:
+            pass
+        return {r.rid: r.output_ids for r in requests}
+
+    @property
+    def decode_tokens_per_s(self) -> float:
+        t = self.stats["decode_time_s"]
+        return self.stats["decode_tokens"] / t if t > 0 else 0.0
