@@ -81,6 +81,7 @@ from . import sparse  # noqa: E402
 from . import quantization  # noqa: E402
 from . import geometric  # noqa: E402
 from . import inference  # noqa: E402
+from . import onnx  # noqa: E402
 from . import audio  # noqa: E402
 from . import text  # noqa: E402
 from . import hapi  # noqa: E402

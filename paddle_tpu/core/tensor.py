@@ -436,6 +436,12 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
 # set by paddle_tpu.amp at import time: (op_name, vals) -> vals with AMP casts
 _amp_cast_hook = None
 
+# set by paddle_tpu.static while a program_guard is active:
+# (op_name, fn, inputs, static_kwargs, out_tensors) -> None.  Records every
+# dispatched op into the active Program (the eager tape IS the graph; this
+# mirrors the reference's program-building AppendOp path, framework.py).
+_op_record_hook = None
+
 
 def _check_nan_inf(name: str, vals) -> None:
     for v in vals:
@@ -486,6 +492,8 @@ def apply_op(
         if flag("FLAGS_check_nan_inf"):
             _check_nan_inf(name, outs)
         wrapped = [Tensor(o, stop_gradient=True) for o in outs]
+        if _op_record_hook is not None:
+            _op_record_hook(name, fn, inputs, static_kwargs, wrapped)
         return tuple(wrapped) if multi else wrapped[0]
 
     diff_mask = [
@@ -513,6 +521,8 @@ def apply_op(
             t._node = node
             t._out_idx = i
         wrapped.append(t)
+    if _op_record_hook is not None:
+        _op_record_hook(name, fn, inputs, static_kwargs, wrapped)
     return tuple(wrapped) if multi else wrapped[0]
 
 

@@ -5,6 +5,7 @@ fleet (hybrid parallel), auto_parallel (DTensor/GSPMD), sharding (ZeRO),
 checkpoint (sharded save/load with reshard-on-load), launch."""
 
 from . import fleet  # noqa: F401
+from . import rpc  # noqa: F401
 from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401

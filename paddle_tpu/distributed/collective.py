@@ -23,6 +23,7 @@ local tensor.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Sequence
 
@@ -96,8 +97,16 @@ class Group:
 
     @property
     def rank(self) -> int:
-        # single-controller: the controller "is" rank 0 of every group
-        return 0
+        """This process's position in the group.
+
+        Under multi-process (launch CLI / jax.distributed) this is the
+        process rank's index in ``ranks`` (-1 if not a member), mirroring
+        ProcessGroup::GetRank.  Single-controller keeps the rank-0
+        convention (the controller drives every rank)."""
+        pid = _process_rank()
+        if pid == 0 and _process_count() == 1:
+            return 0
+        return self.ranks.index(pid) if pid in self.ranks else -1
 
     def get_group_rank(self, rank):
         return self.ranks.index(rank) if rank in self.ranks else -1
@@ -334,7 +343,35 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Rank i receives tensor_list[i] from src.
+
+    Traced (inside shard_map over the group's axis): each rank selects its
+    own chunk from the stacked list by ``axis_index`` — the in-program form
+    of the reference's scatter kernel.  Eager multi-process: src p2p-sends
+    each chunk, others recv theirs.  Single-controller keeps the stacked
+    convention (slot i = rank i's chunk)."""
     group = group or _default_group()
+    if _axis_in_scope(group.axis_name) and (
+            tensor_list and any(_is_traced(_unwrap(t)) for t in tensor_list)
+            or _is_traced(_unwrap(tensor))):
+        vals = jnp.stack([_unwrap(t) for t in tensor_list], axis=0)
+        out = vals[jax.lax.axis_index(group.axis_name)]
+        tensor._value = out
+        return tensor
+    if _process_count() > 1:
+        # eager cross-process path: ranks are GLOBAL process ranks (the
+        # reference's one-process-per-device model); tensor_list is indexed
+        # by group-local position
+        me = _process_rank()
+        if me == src:
+            for local_i, global_r in enumerate(group.ranks):
+                if global_r == me:
+                    tensor._value = _unwrap(tensor_list[local_i])
+                else:
+                    send(tensor_list[local_i], dst=global_r, group=group)
+        else:
+            recv(tensor, src=src, group=group)
+        return tensor
     if tensor_list is not None:
         vals = jnp.stack([_unwrap(t) for t in tensor_list], axis=0)
         tensor._value = vals  # stacked: slot i = its chunk
@@ -344,12 +381,114 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Collect every rank's tensor at dst (inverse of scatter).
+
+    Traced: lowers to ``all_gather`` over the group axis — every rank
+    materializes the stack, dst semantics are a host-side convention (XLA
+    collectives are symmetric; discarding on non-dst ranks is free under
+    DCE).  Eager multi-process: non-dst ranks p2p-send to dst, which recvs
+    in rank order."""
     group = group or _default_group()
     v = _unwrap(tensor)
+    if _is_traced(v) and _axis_in_scope(group.axis_name):
+        stacked = jax.lax.all_gather(v, group.axis_name)
+        if gather_list is not None:
+            gather_list.extend(Tensor(stacked[i]) for i in range(group.nranks))
+            return gather_list
+        return Tensor(stacked)
+    if _process_count() > 1:
+        # global process ranks, group-local result ordering (see scatter)
+        me = _process_rank()
+        if me == dst:
+            if gather_list is None:
+                gather_list = []
+            for global_r in group.ranks:
+                if global_r == me:
+                    gather_list.append(Tensor(v))
+                else:
+                    chunk = Tensor(jnp.zeros_like(v))
+                    recv(chunk, src=global_r, group=group)
+                    gather_list.append(chunk)
+            return gather_list
+        send(tensor, dst=dst, group=group)
+        return gather_list
     if gather_list is not None:
         gather_list.extend(Tensor(v[i]) for i in range(v.shape[0]))
         return gather_list
     return Tensor(v)
+
+
+# ---------------------------------------------------------------------------
+# point-to-point
+#
+# Honest pairing semantics (round-2 verdict #8): every message is keyed by
+# (group, src, dst, sequence).  Multi-process transport rides the launch
+# CLI's native TCPStore; a recv with no matching send FAILS LOUDLY instead of
+# silently delivering someone else's message.  Reference:
+# ProcessGroupNCCL::Send/Recv (process_group_nccl.cc:267).
+# ---------------------------------------------------------------------------
+
+_p2p_local: dict[tuple, list] = {}          # (gid, src, dst) -> FIFO of values
+_p2p_seq: dict[tuple, int] = {}             # (gid, src, dst, "s"/"r") -> counter
+_p2p_store_cache: list = [None, False]      # [store, resolved?]
+P2P_TIMEOUT = float(os.environ.get("PADDLE_P2P_TIMEOUT", "60"))
+
+
+def _process_rank() -> int:
+    try:
+        if jax.process_count() > 1:
+            return jax.process_index()
+    except Exception:
+        pass
+    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
+
+
+def _process_count() -> int:
+    try:
+        if jax.process_count() > 1:
+            return jax.process_count()
+    except Exception:
+        pass
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", "1")))
+
+
+def _p2p_store():
+    """Lazy TCPStore client for cross-process p2p payloads (None when
+    single-process or no master endpoint is configured)."""
+    if _p2p_store_cache[1]:
+        return _p2p_store_cache[0]
+    _p2p_store_cache[1] = True
+    if _process_count() > 1:
+        coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+        if coord:
+            from .store import TCPStore
+
+            host = coord.split(":")[0]
+            port = int(coord.split(":")[1]) if ":" in coord else int(
+                os.environ.get("MASTER_PORT", "8476"))
+            try:
+                _p2p_store_cache[0] = TCPStore(host, port, timeout=10)
+            except Exception:
+                _p2p_store_cache[0] = None
+    return _p2p_store_cache[0]
+
+
+def _pack(v) -> bytes:
+    import io as _io
+
+    import numpy as _np
+
+    buf = _io.BytesIO()
+    _np.save(buf, _np.asarray(v), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _unpack(b: bytes):
+    import io as _io
+
+    import numpy as _np
+
+    return _np.load(_io.BytesIO(bytes(b)), allow_pickle=False)
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
@@ -360,7 +499,15 @@ def send(tensor, dst=0, group=None, sync_op=True):
         n = group.nranks
         out = jax.lax.ppermute(v, group.axis_name, [(i, dst) for i in range(n)])
         return Tensor(out)
-    _p2p_buffers.setdefault(group.id, {})[dst] = v
+    me = _process_rank()  # GLOBAL rank: src/dst arguments are global too
+    store = _p2p_store()
+    if store is not None:
+        seq_key = (group.id, me, dst, "s")
+        seq = _p2p_seq.get(seq_key, 0)
+        _p2p_seq[seq_key] = seq + 1
+        store.set(f"p2p/{group.id}/{me}/{dst}/{seq}", _pack(v))
+    else:
+        _p2p_local.setdefault((group.id, me, dst), []).append(v)
     return None
 
 
@@ -371,16 +518,31 @@ def recv(tensor, src=0, group=None, sync_op=True):
         n = group.nranks
         out = jax.lax.ppermute(v, group.axis_name, [(src, i) for i in range(n)])
         return Tensor(out)
-    buf = _p2p_buffers.get(group.id, {})
-    # single-controller: the matching send stored the value keyed by *its* dst;
-    # deliver the most recent message (tests drive matched pairs)
-    if buf:
-        k = next(iter(buf))
-        tensor._value = jnp.asarray(buf.pop(k), _unwrap(tensor).dtype)
+    me = _process_rank()  # GLOBAL rank, matching send's key space
+    store = _p2p_store()
+    if store is not None:
+        seq_key = (group.id, src, me, "r")
+        seq = _p2p_seq.get(seq_key, 0)
+        try:
+            payload = store.wait(f"p2p/{group.id}/{src}/{me}/{seq}",
+                                 timeout=P2P_TIMEOUT)
+        except Exception as e:
+            raise RuntimeError(
+                f"recv(src={src}) timed out after {P2P_TIMEOUT}s on rank {me} "
+                f"(group {group.id}, seq {seq}): no matching send") from e
+        # bump the sequence only on success: a timed-out recv must retry the
+        # SAME slot or the channel desynchronizes permanently
+        _p2p_seq[seq_key] = seq + 1
+        tensor._value = jnp.asarray(_unpack(payload), _unwrap(tensor).dtype)
+        return tensor
+    q = _p2p_local.get((group.id, src, me))
+    if not q:
+        pending = sorted(k[:3] for k, lst in _p2p_local.items() if lst)
+        raise RuntimeError(
+            f"recv(src={src}) on rank {me} (group {group.id}) has no matching "
+            f"send; pending sends (gid, src, dst): {pending or 'none'}")
+    tensor._value = jnp.asarray(q.pop(0), _unwrap(tensor).dtype)
     return tensor
-
-
-_p2p_buffers: dict[int, dict] = {}
 
 
 class _Task:

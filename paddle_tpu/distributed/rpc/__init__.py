@@ -1,0 +1,201 @@
+"""RPC API over the native TCPStore (reference:
+python/paddle/distributed/rpc/rpc.py — init_rpc :85, rpc_sync :160,
+rpc_async :206, shutdown :305, worker infos :336-:393).
+
+The reference builds RPC on brpc agents; the TPU-native runtime already has a
+rendezvous KV store with blocking waits (distributed/store.py + the C++
+server in native/src/tcp_store.cc), so RPC here is a thin message layer over
+it: each call is one store round-trip of a pickled (fn, args, kwargs)
+payload to the callee's mailbox, answered on a per-call reply key.  Control
+plane only — tensors in args travel as numpy via pickle; bulk data belongs on
+the collective path.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import threading
+import time
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "get_current_worker_info", "WorkerInfo"]
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _State:
+    store = None
+    daemon = None           # MasterDaemon when this process hosts the store
+    me: WorkerInfo | None = None
+    workers: dict = {}
+    serve_thread = None
+    stop = False
+
+
+_S = _State()
+_POLL_S = 0.005
+
+
+def _require_init():
+    if _S.store is None:
+        raise RuntimeError("rpc is not initialized; call init_rpc() first")
+
+
+def init_rpc(name: str, rank: int | None = None, world_size: int | None = None,
+             master_endpoint: str | None = None):
+    """Register this worker under ``name`` and start serving calls."""
+    if _S.store is not None:
+        raise RuntimeError("rpc is already initialized")
+    from ..store import MasterDaemon, TCPStore
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+                  if world_size is None else world_size)
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", os.environ.get("MASTER_ADDR"))
+    if master_endpoint is None:
+        if world_size > 1:
+            raise ValueError("master_endpoint required for world_size > 1")
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        master_endpoint = f"127.0.0.1:{port}"
+    host, port = master_endpoint.split(":")
+    if rank == 0:
+        # the store may already be hosted by the launch CLI master; fall back
+        # to hosting it ourselves (single-process / manual bootstrap)
+        try:
+            probe = TCPStore(host, int(port), timeout=1)
+            probe.close()
+        except Exception:
+            _S.daemon = MasterDaemon(int(port), world_size=world_size)
+    _S.store = TCPStore(host, int(port), timeout=30)
+    try:  # advertise this worker's own address (informational; transport
+        my_ip = socket.gethostbyname(socket.gethostname())  # rides the store)
+    except OSError:
+        my_ip = "127.0.0.1"
+    _S.me = WorkerInfo(name=name, rank=rank, ip=my_ip, port=int(port))
+    _S.store.set(f"rpc/worker/{rank}",
+                 pickle.dumps((name, rank, _S.me.ip, _S.me.port)))
+    # barrier: all workers registered before anyone issues a call
+    _S.store.add("rpc/init_barrier", 1)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        vals = [_S.store.get_nowait(f"rpc/worker/{r}") for r in range(world_size)]
+        if all(v is not None for v in vals):
+            break
+        time.sleep(_POLL_S)
+    else:
+        raise RuntimeError("init_rpc barrier timed out")
+    _S.workers = {}
+    for r in range(world_size):
+        n, rk, ip, pt = pickle.loads(bytes(_S.store.get_nowait(f"rpc/worker/{r}")))
+        _S.workers[n] = WorkerInfo(name=n, rank=rk, ip=ip, port=pt)
+    _S.stop = False
+    _S.serve_thread = threading.Thread(target=_serve_loop, args=(name,),
+                                       daemon=True)
+    _S.serve_thread.start()
+
+
+def _serve_loop(name: str):
+    """Mailbox consumer: process requests rpc/req/<name>/<seq> in order."""
+    seq = 0
+    while not _S.stop:
+        seq += 1
+        key = f"rpc/req/{name}/{seq}"
+        while not _S.stop:
+            payload = _S.store.get_nowait(key)
+            if payload is not None:
+                break
+            time.sleep(_POLL_S)
+        if _S.stop:
+            return
+        reply_key, fn, args, kwargs = pickle.loads(bytes(payload))
+        try:
+            result = (False, fn(*args, **kwargs))
+        except Exception as e:  # ship the exception back to the caller
+            result = (True, e)
+        _S.store.set(reply_key, pickle.dumps(result))
+
+
+class Future:
+    """Reply handle (reference FutureWrapper, rpc.py:206)."""
+
+    def __init__(self, reply_key: str, timeout: float):
+        self._key = reply_key
+        self._timeout = timeout
+
+    def wait(self):
+        deadline = time.time() + (self._timeout if self._timeout > 0 else 3600)
+        while time.time() < deadline:
+            payload = _S.store.get_nowait(self._key)
+            if payload is not None:
+                is_err, val = pickle.loads(bytes(payload))
+                if is_err:
+                    raise val
+                return val
+            time.sleep(_POLL_S)
+        raise TimeoutError(f"rpc reply {self._key} timed out")
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout: float = -1) -> Future:
+    _require_init()
+    if to not in _S.workers:
+        raise ValueError(f"unknown rpc worker {to!r}; known: {sorted(_S.workers)}")
+    seq = _S.store.add(f"rpc/cnt/{to}", 1)
+    reply_key = f"rpc/reply/{_S.me.name}/{to}/{seq}"
+    _S.store.set(f"rpc/req/{to}/{seq}",
+                 pickle.dumps((reply_key, fn, tuple(args or ()), dict(kwargs or {}))))
+    return Future(reply_key, timeout)
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout: float = -1):
+    return rpc_async(to, fn, args, kwargs, timeout).wait()
+
+
+def shutdown():
+    """Drain-and-stop with a store barrier so no peer's in-flight call is
+    dropped (reference barrier: rpc.py:266)."""
+    if _S.store is None:
+        return
+    world = len(_S.workers)
+    _S.store.add("rpc/shutdown_barrier", 1)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        v = _S.store.get_nowait("rpc/shutdown_barrier")
+        if v is not None and int(v) >= world:
+            break
+        time.sleep(_POLL_S)
+    _S.stop = True
+    if _S.serve_thread is not None:
+        _S.serve_thread.join(timeout=5)
+    _S.store.close()
+    if _S.daemon is not None:
+        _S.daemon.stop()
+    _S.store = _S.daemon = _S.serve_thread = _S.me = None
+    _S.workers = {}
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    _require_init()
+    return _S.workers[name]
+
+
+def get_all_worker_infos() -> list[WorkerInfo]:
+    _require_init()
+    return sorted(_S.workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    _require_init()
+    return _S.me

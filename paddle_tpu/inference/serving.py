@@ -64,13 +64,21 @@ class ContinuousBatchingEngine:
     pytree the AOT GenerationEngine uses, inference/__init__.py:249).
     """
 
-    def __init__(self, cfg, params, max_batch: int = 8, max_seq: int = 512):
+    def __init__(self, cfg, params, max_batch: int = 8, max_seq: int = 512,
+                 chunk: int = 1):
+        """``chunk``: decode steps per compiled call.  Tokens feed back
+        on-device inside a lax.scan and the host fetches ``chunk`` tokens per
+        round-trip — the lever against host-device latency (one RTT per token
+        is what bounds single-step decode on a relay-attached TPU).  Retire
+        and admission happen at chunk granularity; generated tokens past a
+        request's EOS/budget inside a chunk are trimmed host-side."""
         from ..models import llama as _llama  # noqa: F401  (cfg type lives there)
 
         self.cfg = cfg
         self.params = params
         self.max_batch = max_batch
         self.max_seq = max_seq
+        self.chunk = int(chunk)
         L = cfg.num_hidden_layers
         shape = (L, max_batch, cfg.num_key_value_heads, max_seq, cfg.head_dim)
         self.cache_k = jnp.zeros(shape, cfg.dtype)
@@ -90,14 +98,12 @@ class ContinuousBatchingEngine:
 
     # ---------------- compiled programs ----------------
 
-    def _decode_impl(self, params, cache_k, cache_v, tokens, pos, active):
-        """One continuous-batching step.
-
-        tokens [B] int32, pos [B] int32 (per-slot depth), active [B] bool.
-        Inactive slots compute garbage that is masked out — the static batch
-        is the price of a single compiled program, and idle lanes are cheap
-        next to recompiling (the standard TPU serving trade).
-        """
+    def _decode_one(self, params, cache_k, cache_v, tokens, pos, active):
+        """One batched decode step: tokens [B], pos [B], active [B] ->
+        (logits [B, V], caches).  Inactive slots compute garbage that is
+        masked out — the static batch is the price of a single compiled
+        program, and idle lanes are cheap next to recompiling (the standard
+        TPU serving trade)."""
         from .. import inference as _inf
         from ..ops.pallas import rope as rope_mod
 
@@ -108,24 +114,41 @@ class ContinuousBatchingEngine:
         cos_full, sin_full = rope_mod.rope_cos_sin(S, cfg.head_dim,
                                                    base=cfg.rope_theta,
                                                    dtype=cfg.dtype)
-        cos = jnp.take(cos_full[0], pos, axis=0)[:, None]  # [B, 1, d]
-        sin = jnp.take(sin_full[0], pos, axis=0)[:, None]
+        safe_pos = jnp.where(active & (pos < S), pos, 0)
+        cos = jnp.take(cos_full[0], safe_pos, axis=0)[:, None]  # [B, 1, d]
+        sin = jnp.take(sin_full[0], safe_pos, axis=0)[:, None]
         kv_pos = jnp.arange(S)[None, None, None, None, :]
         mask = ((kv_pos <= pos[:, None, None, None, None])
                 & active[:, None, None, None, None])
         lane = jnp.arange(B)
-        safe_pos = jnp.where(active, pos, 0)
+        writeable = active & (pos < S)
 
         def write(ck, k):
             # ck [B, nkv, S, hd]; k [B, 1, nkv, hd] — per-slot scatter at
-            # each slot's own depth (drop writes from inactive lanes)
-            upd = jnp.where(active[:, None, None], k[:, 0], ck[lane, :, safe_pos])
+            # each slot's own depth (drop writes from inactive/oob lanes)
+            upd = jnp.where(writeable[:, None, None], k[:, 0],
+                            ck[lane, :, safe_pos])
             out = ck.at[lane, :, safe_pos].set(upd)
             return out, out
 
         x, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
                                            write, mask, cos, sin)
         return _inf.lm_head_logits(cfg, params, x[:, -1]), ak, av
+
+    def _decode_impl(self, params, cache_k, cache_v, tokens, pos, active):
+        """``chunk`` greedy steps in one compiled program; the sampled token
+        feeds back on-device (no host round-trip inside the chunk).
+        Returns (tokens [chunk, B], caches)."""
+
+        def one(carry, _):
+            ck, cv, tok, p = carry
+            logits, ck, cv = self._decode_one(params, ck, cv, tok, p, active)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (ck, cv, nxt, p + 1), nxt
+
+        (ck, cv, _, _), toks = jax.lax.scan(
+            one, (cache_k, cache_v, tokens, pos), None, length=self.chunk)
+        return toks, ck, cv
 
     def _prefill_impl(self, params, ids, cache_k, cache_v, slot, length, bucket):
         """Prefill one request (batch 1, prompt padded to ``bucket``) directly
@@ -209,33 +232,41 @@ class ContinuousBatchingEngine:
         self._slot_req[slot] = None
 
     def step(self) -> bool:
-        """One admit + decode iteration.  Returns False when fully idle."""
+        """One admit + decode-chunk iteration.  Returns False when idle."""
         self._admit()
         active_np = np.asarray([r is not None for r in self._slot_req])
         if not active_np.any():
             return False
+        k = self.chunk
         t0 = time.perf_counter()
-        logits, self.cache_k, self.cache_v = self._decode(
+        toks, self.cache_k, self.cache_v = self._decode(
             self.params, self.cache_k, self.cache_v,
             jnp.asarray(self._last_tok), jnp.asarray(self._pos),
             jnp.asarray(active_np))
-        nxt = np.asarray(jnp.argmax(logits, axis=-1)).astype(np.int32)
+        toks_np = np.asarray(toks)  # [k, B] — ONE host round-trip per chunk
         self.stats["decode_time_s"] += time.perf_counter() - t0
-        self.stats["decode_steps"] += 1
-        self.stats["decode_tokens"] += int(active_np.sum())
+        self.stats["decode_steps"] += k
+        self.stats["decode_tokens"] += k * int(active_np.sum())
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
-            tok = int(nxt[slot])
-            req.output_ids.append(tok)
-            self._pos[slot] += 1
-            self._last_tok[slot] = tok
-            done = (len(req.output_ids) >= req.max_new_tokens
-                    or (req.eos_token_id is not None and tok == req.eos_token_id)
-                    # next decode would write K/V at pos == max_seq: out of
-                    # bounds, so position max_seq-1 is the last usable one
-                    or self._pos[slot] >= self.max_seq)
-            if done:
+            old_pos = int(self._pos[slot])
+            # tokens produced from positions >= max_seq are garbage (their
+            # K/V writes were dropped): only the first max_seq - old_pos
+            # chunk steps are trustworthy
+            valid = min(k, self.max_seq - old_pos)
+            done = False
+            for j in range(valid):
+                tok = int(toks_np[j, slot])
+                req.output_ids.append(tok)
+                if (len(req.output_ids) >= req.max_new_tokens
+                        or (req.eos_token_id is not None
+                            and tok == req.eos_token_id)):
+                    done = True
+                    break
+            self._pos[slot] = old_pos + k  # device advanced k regardless
+            self._last_tok[slot] = int(toks_np[-1, slot])
+            if done or old_pos + k >= self.max_seq:
                 self._retire(slot)
         return True
 

@@ -1,0 +1,207 @@
+"""Minimal ONNX reader + numpy executor for the subset emitted by
+``paddle_tpu.onnx.export``.
+
+Exists so the export round-trip test is *numerical* — parse the wire bytes
+back (independent generic protobuf decoder, not the encoder run backwards)
+and execute the graph with numpy, comparing against the source jax function.
+Also usable as a tiny reference runtime for exported models on hosts without
+an ONNX runtime.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import _DT_NP
+
+__all__ = ["OnnxModel", "load"]
+
+
+def _decode(buf: bytes) -> dict:
+    """Generic protobuf decode: {field: [raw values]} (varint ints, bytes for
+    length-delimited; fixed32/64 kept as ints)."""
+    out: dict[int, list] = {}
+    i, n = 0, len(buf)
+    while i < n:
+        tag = 0
+        shift = 0
+        while True:
+            b = buf[i]; i += 1
+            tag |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            v = 0
+            shift = 0
+            while True:
+                b = buf[i]; i += 1
+                v |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            out.setdefault(field, []).append(v)
+        elif wire == 2:
+            ln = 0
+            shift = 0
+            while True:
+                b = buf[i]; i += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            out.setdefault(field, []).append(buf[i:i + ln])
+            i += ln
+        elif wire == 5:
+            out.setdefault(field, []).append(int.from_bytes(buf[i:i + 4], "little"))
+            i += 4
+        elif wire == 1:
+            out.setdefault(field, []).append(int.from_bytes(buf[i:i + 8], "little"))
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+    return out
+
+
+def _packed_i64(raw) -> list[int]:
+    if isinstance(raw, int):
+        return [raw]
+    vals = []
+    i = 0
+    while i < len(raw):
+        v = 0
+        shift = 0
+        while True:
+            b = raw[i]; i += 1
+            v |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        vals.append(v)
+    return vals
+
+
+def _tensor(raw: bytes) -> tuple[str, np.ndarray]:
+    f = _decode(raw)
+    dims = []
+    for r in f.get(1, []):
+        dims.extend(_packed_i64(r))
+    dt = _DT_NP[f[2][0]]
+    name = f.get(8, [b""])[0].decode()
+    arr = np.frombuffer(f[9][0], dtype=dt).reshape(dims) if 9 in f else np.zeros(dims, dt)
+    return name, arr
+
+
+class _Node:
+    def __init__(self, raw: bytes):
+        f = _decode(raw)
+        self.inputs = [b.decode() for b in f.get(1, [])]
+        self.outputs = [b.decode() for b in f.get(2, [])]
+        self.op = f[4][0].decode()
+        self.attrs = {}
+        for a in f.get(5, []):
+            af = _decode(a)
+            nm = af[1][0].decode()
+            atype = af.get(20, [0])[0]
+            if atype == 2:      # INT
+                self.attrs[nm] = af[3][0]
+            elif atype == 7:    # INTS
+                vals = []
+                for r in af.get(8, []):
+                    vals.extend(_packed_i64(r))
+                self.attrs[nm] = vals
+            elif atype == 3:    # STRING
+                self.attrs[nm] = af[4][0].decode()
+            elif atype == 1:    # FLOAT
+                self.attrs[nm] = np.frombuffer(
+                    int(af[2][0]).to_bytes(4, "little"), np.float32)[0]
+
+
+_ERF = np.vectorize(math.erf, otypes=[np.float32])
+
+
+class OnnxModel:
+    def __init__(self, data: bytes):
+        model = _decode(data)
+        self.producer = model.get(2, [b""])[0].decode()
+        graph = _decode(model[7][0])
+        self.nodes = [_Node(r) for r in graph.get(1, [])]
+        self.initializers = dict(_tensor(r) for r in graph.get(5, []))
+        self.inputs = [_decode(r)[1][0].decode() for r in graph.get(11, [])]
+        self.outputs = [_decode(r)[1][0].decode() for r in graph.get(12, [])]
+
+    def run(self, *feeds) -> list[np.ndarray]:
+        env = dict(self.initializers)
+        for nm, arr in zip(self.inputs, feeds):
+            env[nm] = np.asarray(arr)
+        for node in self.nodes:
+            ins = [env[i] for i in node.inputs]
+            env[node.outputs[0]] = self._exec(node, ins)
+        return [env[o] for o in self.outputs]
+
+    def _exec(self, node, x):
+        op = node.op
+        a = node.attrs
+        if op == "Add": return x[0] + x[1]
+        if op == "Sub": return x[0] - x[1]
+        if op == "Mul": return x[0] * x[1]
+        if op == "Div": return x[0] / x[1]
+        if op == "Max": return np.maximum(x[0], x[1])
+        if op == "Min": return np.minimum(x[0], x[1])
+        if op == "Pow": return np.power(x[0], x[1])
+        if op == "Mod":
+            return np.fmod(x[0], x[1]) if a.get("fmod", 0) else np.mod(x[0], x[1])
+        if op == "Neg": return -x[0]
+        if op == "Exp": return np.exp(x[0])
+        if op == "Log": return np.log(x[0])
+        if op == "Tanh": return np.tanh(x[0])
+        if op == "Sigmoid": return 1.0 / (1.0 + np.exp(-x[0]))
+        if op == "Sqrt": return np.sqrt(x[0])
+        if op == "Reciprocal": return 1.0 / x[0]
+        if op == "Abs": return np.abs(x[0])
+        if op == "Sign": return np.sign(x[0])
+        if op == "Floor": return np.floor(x[0])
+        if op == "Ceil": return np.ceil(x[0])
+        if op == "Erf": return _ERF(x[0]).astype(x[0].dtype)
+        if op == "And": return np.logical_and(x[0], x[1])
+        if op == "Or": return np.logical_or(x[0], x[1])
+        if op == "Not": return np.logical_not(x[0])
+        if op == "Xor": return np.logical_xor(x[0], x[1])
+        if op == "Equal": return x[0] == x[1]
+        if op == "Greater": return x[0] > x[1]
+        if op == "GreaterOrEqual": return x[0] >= x[1]
+        if op == "Less": return x[0] < x[1]
+        if op == "LessOrEqual": return x[0] <= x[1]
+        if op == "Identity": return x[0]
+        if op == "Einsum": return np.einsum(a["equation"], *x)
+        if op == "MatMul": return x[0] @ x[1]
+        if op == "Transpose": return np.transpose(x[0], a["perm"])
+        if op == "Reshape": return np.reshape(x[0], [int(d) for d in x[1]])
+        if op == "Expand": return np.broadcast_to(x[0], [int(d) for d in x[1]]).copy()
+        if op == "Concat": return np.concatenate(x, axis=a["axis"])
+        if op == "Cast": return x[0].astype(_DT_NP[a["to"]])
+        if op == "Where": return np.where(x[0], x[1], x[2])
+        if op == "ReduceSum":
+            axes = tuple(int(d) for d in x[1]) if len(x) > 1 else None
+            return np.sum(x[0], axis=axes, keepdims=bool(a.get("keepdims", 1)))
+        if op == "ReduceMax":
+            return np.max(x[0], axis=tuple(a["axes"]), keepdims=bool(a.get("keepdims", 1)))
+        if op == "ReduceMin":
+            return np.min(x[0], axis=tuple(a["axes"]), keepdims=bool(a.get("keepdims", 1)))
+        if op == "ReduceMean":
+            return np.mean(x[0], axis=tuple(a["axes"]), keepdims=bool(a.get("keepdims", 1)))
+        if op == "Slice":
+            starts, ends, axes, steps = (list(map(int, v)) for v in x[1:5])
+            sl = [slice(None)] * x[0].ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                sl[ax] = slice(s, e, st)
+            return x[0][tuple(sl)]
+        raise NotImplementedError(f"onnx runtime: op {op!r}")
+
+
+def load(path: str) -> OnnxModel:
+    with open(path, "rb") as f:
+        return OnnxModel(f.read())

@@ -1,18 +1,35 @@
-"""Static-graph API shims (reference: python/paddle/static/).
+"""Static-graph API (reference: python/paddle/static/).
 
 The reference's Program/Executor machinery (PIR + StandaloneExecutor,
-standalone_executor.cc:171) is subsumed by jax.jit tracing + the XLA compile
-cache (SURVEY.md §7 mapping: "PIR + pd_op_to_kernel + PirInterpreter →
-StableHLO module + pjit compile cache").  These shims keep script-level API
-compatibility: InputSpec for to_static signatures, and no-op Program scopes."""
+standalone_executor.cc:171) is subsumed for *performance* by jax.jit tracing
++ the XLA compile cache (SURVEY.md §7 mapping).  But Program is not a shim:
+while a ``program_guard`` is active, every op dispatched through
+``apply_op`` (core/tensor.py) is recorded as an OpDesc into the guarded
+Program — the eager tape IS the graph, mirroring the reference's AppendOp
+program building (python/paddle/base/framework.py).  ``Executor.run`` then
+replays the recorded graph with fed inputs, so reference-style
+
+    with static.program_guard(main):
+        x = static.data("x", [4], "float32")
+        y = some_ops(x)
+    exe = static.Executor()
+    out, = exe.run(main, feed={"x": arr}, fetch_list=[y])
+
+actually executes.  Introspection (``global_block().ops``, ``str(program)``,
+``clone``) reflects the real recorded ops.
+"""
 
 from __future__ import annotations
 
 import numpy as np
 
 from ..core import dtype as dtypes
+from ..core import tensor as _tensor_mod
+from ..core.tensor import Tensor
 
-__all__ = ["InputSpec", "Program", "program_guard", "default_main_program", "default_startup_program", "name_scope"]
+__all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
+           "default_startup_program", "name_scope", "data", "Executor",
+           "OpDesc"]
 
 
 class InputSpec:
@@ -34,19 +51,93 @@ class InputSpec:
         return cls(ndarray.shape, ndarray.dtype, name)
 
 
-class Program:
-    def __init__(self):
-        self._ops = []
+class OpDesc:
+    """One recorded op: analog of the reference's OpDesc (framework.py).
 
+    ``fn`` is the pure jnp callable captured at dispatch; ``inputs`` are
+    (kind, payload) pairs — ("var", tensor_id) for graph edges,
+    ("const", value) for non-Tensor operands."""
+
+    def __init__(self, type_, fn, inputs, attrs, outputs):
+        self.type = type_
+        self.fn = fn
+        self.inputs = inputs
+        self.attrs = dict(attrs)
+        self.outputs = outputs  # tensor ids
+
+    def __repr__(self):
+        ins = ", ".join(f"%{p}" if k == "var" else repr(p)[:24]
+                        for k, p in self.inputs)
+        outs = ", ".join(f"%{o}" for o in self.outputs)
+        a = f" {{{', '.join(f'{k}={v!r}' for k, v in self.attrs.items())}}}" if self.attrs else ""
+        return f"{outs} = {self.type}({ins}){a}"
+
+
+class Program:
+    """A recorded op graph (reference: base/framework.py Program)."""
+
+    def __init__(self):
+        self._ops: list[OpDesc] = []
+        self._feeds: dict[str, int] = {}       # data() name -> tensor id
+        self._shapes: dict[int, tuple] = {}    # tensor id -> (shape, dtype)
+        self._known: set[int] = set()          # ids produced inside the program
+        # strong refs to every produced/feed Tensor: ids key the graph, so a
+        # GC'd-and-reused id would corrupt it
+        self._keepalive: list = []
+
+    # -- introspection (reference Block API surface) --
     def global_block(self):
         return self
 
+    @property
+    def ops(self):
+        return list(self._ops)
+
     def clone(self, for_test=False):
-        return Program()
+        p = Program()
+        p._ops = list(self._ops)
+        p._feeds = dict(self._feeds)
+        p._shapes = dict(self._shapes)
+        p._known = set(self._known)
+        p._keepalive = list(self._keepalive)  # clone must pin ids too
+        return p
+
+    def __str__(self):
+        lines = [f"// Program: {len(self._ops)} ops, feeds {sorted(self._feeds)}"]
+        for name, tid in sorted(self._feeds.items()):
+            shape, dt = self._shapes.get(tid, ((), "?"))
+            lines.append(f"%{tid} = feed[{name!r}] : {dt}{list(shape)}")
+        lines.extend(repr(op) for op in self._ops)
+        return "\n".join(lines)
+
+    # -- recording --
+    def _record(self, name, fn, inputs, static_kwargs, outputs):
+        ins = []
+        for x in inputs:
+            # graph edge only if produced inside this program (feed or an
+            # earlier op's output); anything else — weights, eager temps —
+            # is captured by reference like a parameter
+            if isinstance(x, Tensor) and id(x) in self._known:
+                ins.append(("var", id(x)))
+            else:
+                ins.append(("const", x))
+        out_ids = [id(t) for t in outputs]
+        for t in outputs:
+            self._shapes[id(t)] = (tuple(t.shape), str(t.dtype))
+            self._known.add(id(t))
+            self._keepalive.append(t)
+        self._ops.append(OpDesc(name, fn, ins, static_kwargs, out_ids))
+
+    def _mark_feed(self, name, tensor):
+        self._feeds[name] = id(tensor)
+        self._known.add(id(tensor))
+        self._shapes[id(tensor)] = (tuple(tensor.shape), str(tensor.dtype))
+        self._keepalive.append(tensor)
 
 
 _main = Program()
 _startup = Program()
+_active: list[Program] = []
 
 
 def default_main_program():
@@ -58,14 +149,75 @@ def default_startup_program():
 
 
 class program_guard:
+    """Route op recording into ``main_program`` for the with-block."""
+
     def __init__(self, main_program, startup_program=None):
-        pass
+        self.program = main_program
 
     def __enter__(self):
+        _active.append(self.program)
+        _tensor_mod._op_record_hook = self.program._record
         return self
 
     def __exit__(self, *exc):
+        _active.pop()
+        _tensor_mod._op_record_hook = _active[-1]._record if _active else None
         return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed placeholder (reference: static.data).  Returns a Tensor
+    of zeros usable eagerly; under program_guard it is registered as a feed
+    slot that Executor.run fills."""
+    shape = [1 if (d is None or int(d) < 0) else int(d) for d in shape]
+    t = Tensor(np.zeros(shape, dtypes.convert_dtype(dtype)), stop_gradient=True)
+    if _active:
+        _active[-1]._mark_feed(name, t)
+    return t
+
+
+class Executor:
+    """Replay a recorded Program with fed inputs (reference:
+    python/paddle/base/executor.py Executor.run)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        import jax.numpy as jnp
+
+        program = program or default_main_program()
+        feed = feed or {}
+        env: dict[int, object] = {}
+        for name, val in feed.items():
+            if name not in program._feeds:
+                raise KeyError(f"feed {name!r} is not a data() slot of this "
+                               f"program; slots: {sorted(program._feeds)}")
+            env[program._feeds[name]] = jnp.asarray(
+                val.numpy() if isinstance(val, Tensor) else np.asarray(val))
+        for op in program._ops:
+            vals = []
+            for kind, payload in op.inputs:
+                if kind == "var":
+                    if payload not in env:
+                        raise RuntimeError(
+                            f"op {op.type!r} reads %{payload} which was "
+                            "produced outside this program and not fed")
+                    vals.append(env[payload])
+                else:
+                    v = payload
+                    vals.append(v._value if isinstance(v, Tensor) else v)
+            out = op.fn(*vals, **op.attrs)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for oid, o in zip(op.outputs, outs):
+                env[oid] = o
+        results = []
+        for f in (fetch_list or []):
+            oid = id(f) if isinstance(f, Tensor) else f
+            if oid not in env:
+                raise KeyError(f"fetch target {f!r} not produced by program")
+            results.append(np.asarray(env[oid]) if return_numpy else Tensor(env[oid]))
+        return results
 
 
 class name_scope:

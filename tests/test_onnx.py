@@ -1,0 +1,120 @@
+"""ONNX export round-trip tests (reference: python/paddle/onnx/export.py).
+
+The round trip is numerical: jax/Layer function -> ONNX wire bytes ->
+independent protobuf decode -> numpy execution -> compare with the source.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.onnx import export
+from paddle_tpu.onnx import runtime
+from paddle_tpu.static import InputSpec
+
+rs = np.random.RandomState(11)
+
+
+def _roundtrip(fn, examples, tmp_path, rtol=1e-5, name="m"):
+    path = export(fn, str(tmp_path / name), input_spec=list(examples))
+    model = runtime.load(path)
+    assert model.producer == "paddle_tpu"
+    got = model.run(*[np.asarray(e) for e in examples])
+    want = fn(*[jnp.asarray(e) for e in examples])
+    want = want if isinstance(want, (tuple, list)) else [want]
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, np.asarray(w), rtol=rtol, atol=1e-5)
+    return model
+
+
+def test_elementwise_graph(tmp_path):
+    def fn(x, y):
+        return jnp.tanh(x) * y + jnp.exp(-jnp.abs(x)) / (1.0 + y * y)
+
+    x = rs.randn(3, 4).astype(np.float32)
+    y = rs.randn(3, 4).astype(np.float32)
+    _roundtrip(fn, [x, y], tmp_path)
+
+
+def test_matmul_and_reduction(tmp_path):
+    import jax
+
+    def fn(x, w):
+        h = jnp.dot(x, w)
+        return jax.nn.softmax(h, axis=-1).sum(axis=0)
+
+    x = rs.randn(5, 3).astype(np.float32)
+    w = rs.randn(3, 7).astype(np.float32)
+    _roundtrip(fn, [x, w], tmp_path)
+
+
+def test_batched_dot_general_einsum(tmp_path):
+    def fn(a, b):
+        return jnp.einsum("bij,bjk->bik", a, b)
+
+    a = rs.randn(2, 3, 4).astype(np.float32)
+    b = rs.randn(2, 4, 5).astype(np.float32)
+    _roundtrip(fn, [a, b], tmp_path)
+
+
+def test_layer_export_with_params(tmp_path):
+    """nn.Layer export: parameters become ONNX initializers."""
+    layer = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = rs.randn(2, 6).astype(np.float32)
+    path = export(layer, str(tmp_path / "mlp"), input_spec=[paddle.to_tensor(x)])
+    model = runtime.load(path)
+    assert len(model.initializers) >= 4  # 2 weights + 2 biases
+    got = model.run(x)[0]
+    want = layer(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_input_spec_and_slicing(tmp_path):
+    def fn(x):
+        return jnp.concatenate([x[:, :2] * 2.0, x[:, 2:]], axis=1)
+
+    spec = InputSpec([4, 5], "float32")
+    path = export(fn, str(tmp_path / "sl"), input_spec=[spec])
+    model = runtime.load(path)
+    x = rs.randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(model.run(x)[0], np.asarray(fn(jnp.asarray(x))),
+                               rtol=1e-5)
+
+
+def test_where_cast_broadcast(tmp_path):
+    def fn(x):
+        m = x > 0
+        return jnp.where(m, x, 0.1 * x).astype(jnp.float32) + jnp.float32(1.0)
+
+    x = rs.randn(3, 3).astype(np.float32)
+    _roundtrip(fn, [x], tmp_path)
+
+
+def test_float_rem_negative_dividend(tmp_path):
+    """lax.rem is truncated (fmod) — must round-trip with fmod=1 semantics
+    for negative dividends (review finding: np.mod disagrees on sign)."""
+    import jax.lax as lax
+
+    def fn(x, y):
+        return lax.rem(x, y)
+
+    x = np.array([-7.0, 7.0, -5.5], np.float32)
+    y = np.array([3.0, 3.0, 2.0], np.float32)
+    _roundtrip(fn, [x, y], tmp_path)
+
+
+def test_unsupported_primitive_is_loud(tmp_path):
+    def fn(x):
+        return jnp.fft.fft(x).real
+
+    with pytest.raises(NotImplementedError, match="unsupported primitive"):
+        export(fn, str(tmp_path / "bad"), input_spec=[rs.randn(8).astype(np.float32)])
+
+
+def test_requires_input_spec(tmp_path):
+    with pytest.raises(ValueError, match="input_spec"):
+        export(lambda x: x, str(tmp_path / "x"))

@@ -1,0 +1,147 @@
+"""Honest p2p semantics (round-2 verdict #8): pairing keyed by
+(group, src, dst, seq), loud failure on mismatch, process-aware Group.rank,
+traced scatter/gather, and a real 2-process exchange via the launch CLI
+(reference: ProcessGroupNCCL::Send/Recv, process_group_nccl.cc:267)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import collective as C
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_group_rank_single_controller():
+    g = C.new_group()
+    assert g.rank == 0
+
+
+def test_group_rank_multiprocess_env(monkeypatch):
+    monkeypatch.setenv("WORLD_SIZE", "2")
+    monkeypatch.setenv("RANK", "1")
+    g = C.new_group([0, 1])
+    assert g.rank == 1
+    g2 = C.new_group([0])  # not a member
+    assert g2.rank == -1
+
+
+def test_local_p2p_pairing_and_mismatch():
+    g = C.new_group()
+    t = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    dist.send(t, dst=0, group=g)  # self-send on the controller
+    out = paddle.to_tensor(np.zeros(4, np.float32))
+    dist.recv(out, src=0, group=g)
+    np.testing.assert_array_equal(out.numpy(), t.numpy())
+    # mismatched src fails loudly instead of delivering someone else's message
+    dist.send(t, dst=2, group=g)
+    with pytest.raises(RuntimeError, match="no matching send"):
+        dist.recv(out, src=3, group=g)
+    # FIFO per (src, dst) pair
+    a = paddle.to_tensor(np.full(2, 1.0, np.float32))
+    b = paddle.to_tensor(np.full(2, 2.0, np.float32))
+    dist.send(a, dst=0, group=g)
+    dist.send(b, dst=0, group=g)
+    r = paddle.to_tensor(np.zeros(2, np.float32))
+    dist.recv(r, src=0, group=g)
+    assert r.numpy()[0] == 1.0
+    dist.recv(r, src=0, group=g)
+    assert r.numpy()[0] == 2.0
+
+
+def test_traced_scatter_gather(eight_devices):
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(eight_devices), ("pg0",))
+    g = C.Group(axis_name="pg0")
+    chunks = [np.full((2,), float(i), np.float32) for i in range(8)]
+
+    def body(x):
+        t = paddle.to_tensor(x)
+        dist.scatter(t, [paddle.to_tensor(c) for c in chunks], src=0, group=g)
+        return C._unwrap(t)
+
+    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("pg0"),
+                                out_specs=P("pg0")))(jnp.zeros((8, 2), jnp.float32))
+    got = np.asarray(out).reshape(8, 2)  # per-rank [2] chunks concatenated
+    for i in range(8):
+        np.testing.assert_array_equal(got[i], chunks[i])
+
+    def gbody(x):
+        lst = []
+        dist.gather(paddle.to_tensor(x), lst, dst=0, group=g)
+        return jnp.stack([C._unwrap(t) for t in lst])
+
+    out = jax.jit(jax.shard_map(gbody, mesh=mesh, in_specs=P("pg0"),
+                                out_specs=P(None, "pg0")))(
+        jnp.arange(8, dtype=jnp.float32).reshape(8, 1))
+    # per-rank gathered stack [8, 1, 1]; concatenated on axis 1 -> [8, 8, 1]:
+    # column r is rank r's copy of the full gather
+    got = np.asarray(out).reshape(8, 8)
+    for r in range(8):
+        np.testing.assert_array_equal(got[:, r], np.arange(8, dtype=np.float32))
+
+
+def test_launch_two_process_p2p_exchange(tmp_path):
+    """Two real processes exchange tensors through the TCPStore transport:
+    rank 0 sends [10,11,12] to rank 1 and recvs rank 1's reply; tags must
+    pair by (src, dst, seq)."""
+    script = tmp_path / "p2p.py"
+    script.write_text(
+        "import os\n"
+        "os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '')\n"
+        "    + ' --xla_force_host_platform_device_count=1')\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import paddle_tpu as paddle\n"
+        "import paddle_tpu.distributed as dist\n"
+        "dist.init_parallel_env()\n"
+        "rank = jax.process_index()\n"
+        "g = dist.collective.new_group([0, 1])\n"
+        "assert g.rank == rank, (g.rank, rank)\n"
+        "if rank == 0:\n"
+        "    dist.send(paddle.to_tensor(np.array([10., 11., 12.], np.float32)), dst=1, group=g)\n"
+        "    out = paddle.to_tensor(np.zeros(3, np.float32))\n"
+        "    dist.recv(out, src=1, group=g)\n"
+        "    np.testing.assert_array_equal(out.numpy(), [20., 21., 22.])\n"
+        "else:\n"
+        "    out = paddle.to_tensor(np.zeros(3, np.float32))\n"
+        "    dist.recv(out, src=0, group=g)\n"
+        "    np.testing.assert_array_equal(out.numpy(), [10., 11., 12.])\n"
+        "    dist.send(paddle.to_tensor(np.array([20., 21., 22.], np.float32)), dst=0, group=g)\n"
+        "# eager cross-process scatter: rank 0 distributes per-rank chunks\n"
+        "buf = paddle.to_tensor(np.zeros(2, np.float32))\n"
+        "chunks = [paddle.to_tensor(np.full(2, 100. + i, np.float32)) for i in range(2)]\n"
+        "dist.scatter(buf, chunks if rank == 0 else None, src=0, group=g)\n"
+        "np.testing.assert_array_equal(buf.numpy(), np.full(2, 100. + rank))\n"
+        "# eager cross-process gather back at rank 1\n"
+        "lst = []\n"
+        "got = dist.gather(buf, lst if rank == 1 else None, dst=1, group=g)\n"
+        "if rank == 1:\n"
+        "    np.testing.assert_array_equal(np.stack([t.numpy() for t in lst]),\n"
+        "                                  [[100., 100.], [101., 101.]])\n"
+        "print(f'rank {rank} p2p OK')\n"
+    )
+    log_dir = tmp_path / "logs"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(log_dir), str(script)],
+        cwd=REPO, env={**os.environ, "PYTHONPATH": REPO},
+        capture_output=True, timeout=240,
+    )
+    body = ""
+    if log_dir.exists():
+        for f in sorted(os.listdir(log_dir)):
+            body += (log_dir / f).read_text()
+    assert r.returncode == 0, (r.stderr.decode()[-2000:], body[-2000:])
+    assert "rank 0 p2p OK" in body and "rank 1 p2p OK" in body
