@@ -390,6 +390,80 @@ def decode_ladder_main() -> int:
 
 
 # ---------------------------------------------------------------------------
+# vision ladder (ResNet-50 training — BASELINE.md config ladder row #2)
+# ---------------------------------------------------------------------------
+
+def run_vision_rung(name, arch, batch, img, warmup_steps, bench_steps, flops_per_img):
+    """ResNet train-step throughput via the fully-jitted TrainStep path
+    (jit/__init__.py:212) with bf16 autocast — conv/bn on the MXU."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, jit as pjit, nn, optimizer, vision
+
+    log(f"vision rung {name}: building ({arch} batch={batch} img={img})")
+    model = getattr(vision.models, arch)(num_classes=1000)
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+
+    def loss_fn(x, y):
+        with amp.auto_cast(level="O1"):
+            logits = model(x)
+        return nn.functional.cross_entropy(logits, y)
+
+    step = pjit.TrainStep(model, loss_fn, opt)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(batch, 3, img, img).astype(np.float32))
+    y = jnp.asarray(rs.randint(0, 1000, (batch,)).astype(np.int64))
+
+    t_c = time.perf_counter()
+    for _ in range(warmup_steps):
+        loss = step(x, y)
+    loss_v = float(loss.numpy() if hasattr(loss, "numpy") else loss)
+    log(f"vision rung {name}: warmup+compile {time.perf_counter() - t_c:.1f}s "
+        f"(loss {loss_v:.3f})")
+    t0 = time.perf_counter()
+    for _ in range(bench_steps):
+        loss = step(x, y)
+    loss_v = float(loss.numpy() if hasattr(loss, "numpy") else loss)
+    dt = time.perf_counter() - t0
+    imgs_per_s = batch * bench_steps / dt
+    devices = jax.devices()
+    mfu = imgs_per_s * flops_per_img / chip_peak(devices[0])
+    return {
+        "metric": "resnet_train_images_per_sec",
+        "value": round(imgs_per_s, 1),
+        "unit": "img/s",
+        "vs_baseline": 0.0,
+        "detail": {"rung": name, "arch": arch, "batch": batch, "img": img,
+                   "loss": loss_v, "est_mfu_pct": round(mfu * 100, 2),
+                   "backend": jax.default_backend()},
+    }
+
+
+def vision_ladder_main() -> int:
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    # train FLOPs/img ~= 3x forward; resnet50 fwd @224 ~= 4.1 GF, resnet18
+    # @64 ~= 0.15 GF (scaled from 1.8 GF @224)
+    rungs = ([("tiny", "resnet18", 8, 64, 1, 3, 3 * 0.15e9),
+              ("full", "resnet50", 32, 224, 1, 10, 3 * 4.1e9)]
+             if on_tpu else [("cpu_smoke", "resnet18", 2, 32, 1, 2, 3 * 0.04e9)])
+    banked = 0
+    for rung in rungs:
+        try:
+            emit(run_vision_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"vision rung {rung[0]} failed: {e}\n{traceback.format_exc()}")
+            break
+    return 0 if banked else 1
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
@@ -403,6 +477,8 @@ def worker_main() -> int:
             return probe_main()
         if "--decode" in sys.argv:
             return decode_ladder_main()
+        if "--vision" in sys.argv:
+            return vision_ladder_main()
         return ladder_main()
     except Exception as e:
         log(f"worker failed: {e}\n{traceback.format_exc()}")
@@ -446,7 +522,8 @@ def main():
     if "--worker" in sys.argv:
         sys.exit(worker_main())
 
-    decode = ["--decode"] if "--decode" in sys.argv else []
+    decode = (["--decode"] if "--decode" in sys.argv
+              else ["--vision"] if "--vision" in sys.argv else [])
 
     # phase 0: probe backend + kernels
     probe = _run_worker(["--probe"], PROBE_TIMEOUT)

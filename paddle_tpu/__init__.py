@@ -82,6 +82,16 @@ from . import quantization  # noqa: E402
 from . import geometric  # noqa: E402
 from . import inference  # noqa: E402
 from . import onnx  # noqa: E402
+from . import callbacks  # noqa: E402
+from . import hub  # noqa: E402
+from . import linalg  # noqa: E402
+from . import reader  # noqa: E402
+from . import regularizer  # noqa: E402
+from . import sysconfig  # noqa: E402
+from . import tensor  # noqa: E402
+from . import utils  # noqa: E402
+from . import version  # noqa: E402
+from .batch import batch  # noqa: E402
 from . import audio  # noqa: E402
 from . import text  # noqa: E402
 from . import hapi  # noqa: E402

@@ -1,0 +1,5 @@
+"""paddle.callbacks namespace (reference: python/paddle/callbacks.py — a
+re-export of hapi.callbacks)."""
+
+from .hapi.callbacks import *  # noqa: F401,F403
+from .hapi.callbacks import __all__  # noqa: F401

@@ -440,7 +440,9 @@ def _process_rank() -> int:
             return jax.process_index()
     except Exception:
         pass
-    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
+    from . import env as _env
+
+    return _env.env_rank()
 
 
 def _process_count() -> int:
@@ -449,7 +451,9 @@ def _process_count() -> int:
             return jax.process_count()
     except Exception:
         pass
-    return int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", "1")))
+    from . import env as _env
+
+    return _env.env_world_size()
 
 
 def _p2p_store():
@@ -459,18 +463,20 @@ def _p2p_store():
         return _p2p_store_cache[0]
     _p2p_store_cache[1] = True
     if _process_count() > 1:
-        coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
-        if coord:
+        from . import env as _env
+
+        ep = _env.env_master_endpoint()
+        if ep:
             from .store import TCPStore
 
-            host = coord.split(":")[0]
-            port = int(coord.split(":")[1]) if ":" in coord else int(
-                os.environ.get("MASTER_PORT", "8476"))
             try:
-                _p2p_store_cache[0] = TCPStore(host, port, timeout=10)
+                _p2p_store_cache[0] = TCPStore(ep[0], ep[1], timeout=10)
             except Exception:
                 _p2p_store_cache[0] = None
     return _p2p_store_cache[0]
+
+
+_BF16_TAG = b"BF16"
 
 
 def _pack(v) -> bytes:
@@ -478,9 +484,15 @@ def _pack(v) -> bytes:
 
     import numpy as _np
 
+    arr = _np.asarray(v)
+    tag = b""
+    if str(arr.dtype) == "bfloat16":
+        # np.save writes bf16 as opaque void; ship as uint16 + tag instead
+        arr = arr.view(_np.uint16)
+        tag = _BF16_TAG
     buf = _io.BytesIO()
-    _np.save(buf, _np.asarray(v), allow_pickle=False)
-    return buf.getvalue()
+    _np.save(buf, arr, allow_pickle=False)
+    return tag + buf.getvalue()
 
 
 def _unpack(b: bytes):
@@ -488,7 +500,11 @@ def _unpack(b: bytes):
 
     import numpy as _np
 
-    return _np.load(_io.BytesIO(bytes(b)), allow_pickle=False)
+    b = bytes(b)
+    if b[: len(_BF16_TAG)] == _BF16_TAG:
+        return _np.load(_io.BytesIO(b[len(_BF16_TAG):]),
+                        allow_pickle=False).view(jnp.bfloat16)
+    return _np.load(_io.BytesIO(b), allow_pickle=False)
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
@@ -533,6 +549,10 @@ def recv(tensor, src=0, group=None, sync_op=True):
         # bump the sequence only on success: a timed-out recv must retry the
         # SAME slot or the channel desynchronizes permanently
         _p2p_seq[seq_key] = seq + 1
+        try:  # consumed: reclaim the store's memory
+            store.delete_key(f"p2p/{group.id}/{src}/{me}/{seq}")
+        except Exception:
+            pass
         tensor._value = jnp.asarray(_unpack(payload), _unwrap(tensor).dtype)
         return tensor
     q = _p2p_local.get((group.id, src, me))

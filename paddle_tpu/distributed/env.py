@@ -98,6 +98,28 @@ def is_initialized() -> bool:
     return _initialized
 
 
+# single source of truth for the bootstrap env contract (consumed by
+# collective.py p2p and distributed.rpc as well as init_parallel_env)
+
+def env_rank() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
+
+
+def env_world_size() -> int:
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", "1")))
+
+
+def env_master_endpoint() -> tuple[str, int] | None:
+    """(host, port) of the launch master / TCPStore, or None."""
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    if not coord:
+        return None
+    host = coord.split(":")[0]
+    port = (int(coord.split(":")[1]) if ":" in coord
+            else int(os.environ.get("MASTER_PORT", "8476")))
+    return host, port
+
+
 def get_rank(group=None) -> int:
     if group is not None:
         return group.rank

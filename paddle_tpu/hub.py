@@ -1,0 +1,62 @@
+"""paddle.hub (reference: python/paddle/hub.py) — hubconf.py protocol.
+
+Supports ``source='local'`` fully (load entrypoints from a directory's
+hubconf.py).  ``source='github'/'gitee'`` requires network egress, which this
+build intentionally does not have: a clear error tells the user to clone the
+repo and use the local path instead.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_builtin_list = list
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _check_source(source: str):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network access, which this build "
+            "does not have; clone the repo and call with "
+            "repo_dir=<path>, source='local'")
+
+
+def list(repo_dir: str, source: str = "github"):  # noqa: A001  (reference name)
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "github"):  # noqa: A001
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"model {model!r} not in hubconf ({repo_dir})")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github", **kwargs):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"model {model!r} not in hubconf ({repo_dir})")
+    return getattr(mod, model)(**kwargs)

@@ -1,0 +1,5 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py — a re-export
+of tensor.linalg).  The implementations live in ops/linalg.py (XLA lax.linalg
+backends)."""
+
+from .ops.linalg import *  # noqa: F401,F403

@@ -1,0 +1,95 @@
+"""Legacy reader decorators (reference: python/paddle/reader/decorator.py).
+
+Kept for script parity; io.DataLoader is the performant path (device-feeding
+with multiprocess shm workers)."""
+
+from __future__ import annotations
+
+import random as _random
+from itertools import chain as _chain
+
+__all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
+           "firstn", "cache", "ComposeNotAligned"]
+
+
+class ComposeNotAligned(ValueError):
+    """Raised when composed readers yield different numbers of samples
+    (reference: reader/decorator.py ComposeNotAligned)."""
+
+
+def map_readers(func, *readers):
+    def reader():
+        for vals in zip(*[r() for r in readers]):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        return _chain(*[r() for r in readers])
+    return chained
+
+
+def compose(*readers, check_alignment=True):
+    from itertools import zip_longest
+
+    _END = object()
+
+    def composed():
+        its = [r() for r in readers]
+        for items in zip_longest(*its, fillvalue=_END):
+            # identity checks: `in`/`==` would broadcast over array samples
+            if any(i is _END for i in items):
+                if check_alignment and any(i is not _END for i in items):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned (different lengths)")
+                return  # aligned end, or misalignment tolerated
+            out = []
+            for it in items:
+                out.extend(it if isinstance(it, tuple) else (it,))
+            yield tuple(out)
+    return composed
+
+
+def buffered(reader, size):
+    # single-controller analog: queue-based readahead is io.DataLoader's job;
+    # semantics here are just pass-through ordering
+    def buffered_reader():
+        yield from reader()
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                return
+            yield item
+    return firstn_reader
+
+
+def cache(reader):
+    all_data = []
+    filled = []
+
+    def cached():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        yield from all_data
+    return cached

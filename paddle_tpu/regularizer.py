@@ -1,0 +1,31 @@
+"""Weight-decay regularizers (reference: python/paddle/regularizer.py).
+
+The reference applies these inside the C++ optimizer ops via append_regularization_ops;
+here they are declarative records that the jitted optimizer step reads
+(`optimizer/__init__.py:_parse_wd` consumes ``_coeff``), so the decay fuses
+into the same XLA program as the update.
+"""
+
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self) -> float:
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """loss += coeff * sum(|w|) — applied as a gradient term sign(w)*coeff."""
+
+
+class L2Decay(WeightDecayRegularizer):
+    """loss += 0.5 * coeff * sum(w^2) — the decoupled/fused wd path."""

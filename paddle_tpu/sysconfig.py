@@ -1,0 +1,17 @@
+"""Build/config paths (reference: python/paddle/sysconfig.py)."""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of native headers shipped with the package."""
+    return os.path.join(_PKG, "native", "src")
+
+
+def get_lib() -> str:
+    """Directory containing libpaddle_tpu_native.so."""
+    return os.path.join(_PKG, "native")

@@ -1,0 +1,15 @@
+"""paddle.tensor namespace (reference: python/paddle/tensor/ — the functional
+tensor library re-exported at the root).  paddle_tpu keeps one implementation
+in ops/ and mirrors it here for scripts that import via paddle.tensor.xxx."""
+
+from ..ops.creation import *  # noqa: F401,F403
+from ..ops.linalg import *  # noqa: F401,F403
+from ..ops.manipulation import *  # noqa: F401,F403
+from ..ops.math import *  # noqa: F401,F403
+from ..ops.extras import *  # noqa: F401,F403
+from ..ops.registry import OPS as _OPS
+
+for _name, _od in list(_OPS.items()):
+    if _name not in globals():
+        globals()[_name] = _od.fn
+del _name, _od, _OPS

@@ -1,0 +1,69 @@
+"""paddle.utils (reference: python/paddle/utils/) — the pieces scripts
+actually touch: deprecated decorator, try_import, unique_name, run_check,
+dlpack bridge, download (local-cache only: zero-egress build)."""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import os
+import warnings
+
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+from . import unique_name  # noqa: F401
+
+__all__ = ["deprecated", "try_import", "run_check", "dlpack", "download",
+           "unique_name"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0):
+    """Mark an API deprecated (reference: utils/deprecated.py)."""
+
+    def decorator(func):
+        msg = f"API {func.__module__}.{func.__name__} is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use {update_to} instead"
+        if reason:
+            msg += f". reason: {reason}"
+        if level == 2:
+            raise RuntimeError(msg)
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level == 1:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        wrapper.__doc__ = (f"\n.. warning:: {msg}\n\n" + (func.__doc__ or ""))
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name: str, err_msg: str | None = None):
+    """Import an optional dependency with a helpful error (reference:
+    utils/lazy_import.py)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"optional dependency {module_name!r} is not installed "
+                       "(this build cannot pip install; vendor it or gate the "
+                       "feature)") from e
+
+
+def run_check():
+    """Smoke-check the install (reference: utils/install_check.py): run one
+    jitted matmul on the default backend and report."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    y = jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128), jnp.float32))
+    float(y)
+    print(f"paddle_tpu is installed successfully! backend={jax.default_backend()} "
+          f"device={getattr(dev, 'device_kind', dev)}")

@@ -1,0 +1,28 @@
+"""DLPack interop (reference: python/paddle/utils/dlpack.py) — zero-copy
+exchange with torch/numpy/cupy via jax's dlpack support."""
+
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    import jax
+
+    from ..core.tensor import _unwrap
+
+    return jax.dlpack.to_dlpack(_unwrap(x))
+
+
+def from_dlpack(capsule):
+    import jax
+
+    from ..core.tensor import Tensor
+
+    try:
+        arr = jax.dlpack.from_dlpack(capsule)
+    except TypeError:
+        import jax.numpy as jnp
+
+        arr = jnp.from_dlpack(capsule)
+    return Tensor(arr)

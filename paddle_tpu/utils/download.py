@@ -1,0 +1,26 @@
+"""Weight-file resolution (reference: python/paddle/utils/download.py).
+
+This build has no network egress, so resolution is cache-only: a URL maps to
+``$DATA_HOME/<basename>`` and must already exist there (place files manually
+or mount a cache).  The error says exactly where to put the file.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url", "DATA_HOME"]
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))
+
+
+def get_weights_path_from_url(url: str, md5sum: str | None = None) -> str:
+    fname = os.path.basename(url.split("?")[0])
+    path = os.path.join(DATA_HOME, "weights", fname)
+    if os.path.isfile(path):
+        return path
+    raise FileNotFoundError(
+        f"cannot download {url!r}: this build has no network access. "
+        f"Place the file at {path!r} and retry.")

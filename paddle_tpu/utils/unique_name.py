@@ -1,0 +1,43 @@
+"""Unique name generator (reference: python/paddle/utils/unique_name.py —
+base/unique_name.py generator with guards)."""
+
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class _Generator:
+    def __init__(self):
+        self.ids = defaultdict(int)
+
+    def generate(self, key: str) -> str:
+        n = self.ids[key]
+        self.ids[key] += 1
+        return f"{key}_{n}"
+
+
+_generator = _Generator()
+
+
+def generate(key: str) -> str:
+    return _generator.generate(key)
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        global _generator
+        _generator = old

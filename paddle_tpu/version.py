@@ -1,0 +1,29 @@
+"""Version info (reference: python/paddle/version/__init__.py, generated at
+build time).  paddle_tpu tracks API parity with the reference's 3.x line."""
+
+full_version = "3.0.0+tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+cuda_version = "False"   # CUDA-free by design
+cudnn_version = "False"
+tensorrt_version = "False"
+xpu_version = "False"
+commit = "unknown"
+with_pip_cuda_libraries = "OFF"
+
+
+def show() -> None:
+    print(f"full_version: {full_version}")
+    print(f"major: {major}\nminor: {minor}\npatch: {patch}\nrc: {rc}")
+    print(f"commit: {commit}")
+    print("cuda: False (TPU/XLA build)")
+
+
+def cuda() -> str:
+    return cuda_version
+
+
+def cudnn() -> str:
+    return cudnn_version

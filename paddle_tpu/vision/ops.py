@@ -44,6 +44,7 @@ __all__ = [
     "box_coder",
     "deform_conv2d",
     "DeformConv2D",
+    "generate_proposals",
     "distribute_fpn_proposals",
     "psroi_pool",
     "PSRoIPool",
@@ -813,6 +814,82 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
     if pv_is_tensor:
         inputs.append(prior_box_var)
     return apply_op("box_coder", fn, inputs)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, pixel_offset=False,
+                       return_rois_num=False, name=None):
+    """RPN proposal generation — reference python/paddle/vision/ops.py:2159
+    (phi generate_proposals kernel): top-k by score, anchor decode with
+    variances, clip to image, min-size filter, NMS, top post_nms_top_n.
+
+    Eager-mode (data-dependent output length, like the reference's LoD
+    outputs).  scores [N,A,H,W], bbox_deltas [N,4A,H,W], anchors/variances
+    [H,W,A,4].
+    """
+    sv = np.asarray(_unwrap(scores), np.float32)
+    dv = np.asarray(_unwrap(bbox_deltas), np.float32)
+    imv = np.asarray(_unwrap(img_size), np.float32)
+    av = np.asarray(_unwrap(anchors), np.float32).reshape(-1, 4)
+    vv = np.asarray(_unwrap(variances), np.float32).reshape(-1, 4)
+    N, A, H, W = sv.shape
+    off = 1.0 if pixel_offset else 0.0
+    bbox_clip = math.log(1000.0 / 16.0)  # phi kBBoxClipDefault
+
+    all_rois, all_probs, nums = [], [], []
+    for n in range(N):
+        s = sv[n].transpose(1, 2, 0).ravel()                       # [H*W*A]
+        d = dv[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        k = min(pre_nms_top_n, s.size)
+        order = np.argsort(-s, kind="stable")[:k]
+        s, d, anc, var = s[order], d[order], av[order], vv[order]
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + aw * 0.5
+        acy = anc[:, 1] + ah * 0.5
+        cx = d[:, 0] * var[:, 0] * aw + acx
+        cy = d[:, 1] * var[:, 1] * ah + acy
+        bw = np.exp(np.minimum(d[:, 2] * var[:, 2], bbox_clip)) * aw
+        bh = np.exp(np.minimum(d[:, 3] * var[:, 3], bbox_clip)) * ah
+        boxes = np.stack([cx - bw / 2 + off * 0.5, cy - bh / 2 + off * 0.5,
+                          cx + bw / 2 - off * 0.5, cy + bh / 2 - off * 0.5], 1)
+        im_h, im_w = imv[n, 0], imv[n, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, im_w - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, im_h - off)
+        ms = max(float(min_size), 1.0)
+        ww = boxes[:, 2] - boxes[:, 0] + off
+        hh = boxes[:, 3] - boxes[:, 1] + off
+        keep = (ww >= ms) & (hh >= ms)
+        boxes, s = boxes[keep], s[keep]
+        if boxes.shape[0]:
+            # adaptive-eta greedy NMS (already score-sorted)
+            kept = []
+            thresh = nms_thresh
+            sup = np.zeros(boxes.shape[0], bool)
+            iou = np.asarray(_iou_matrix(jnp.asarray(boxes)))
+            for i in range(boxes.shape[0]):
+                if sup[i]:
+                    continue
+                kept.append(i)
+                if len(kept) >= post_nms_top_n:
+                    break
+                sup |= iou[i] > thresh
+                sup[i] = True
+                if eta < 1.0 and thresh > 0.5:
+                    thresh *= eta
+            kept = np.asarray(kept, np.int64)
+            boxes, s = boxes[kept], s[kept]
+        all_rois.append(boxes)
+        all_probs.append(s[:, None])
+        nums.append(boxes.shape[0])
+    rois = Tensor(np.concatenate(all_rois, 0) if all_rois else np.zeros((0, 4), np.float32),
+                  stop_gradient=True)
+    probs = Tensor(np.concatenate(all_probs, 0) if all_probs else np.zeros((0, 1), np.float32),
+                   stop_gradient=True)
+    if return_rois_num:
+        return rois, probs, Tensor(np.asarray(nums, np.int32), stop_gradient=True)
+    return rois, probs
 
 
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,

@@ -1,0 +1,109 @@
+"""Top-level namespace parity (reference: python/paddle/ top-level modules —
+linalg.py, tensor/, regularizer.py, batch.py, reader/, hub.py, utils/,
+version/, sysconfig.py, callbacks.py)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_linalg_and_tensor_namespaces():
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32) * 2.0)
+    assert float(paddle.linalg.det(x).numpy()) == pytest.approx(8.0)
+    assert float(paddle.tensor.det(x).numpy()) == pytest.approx(8.0)
+    np.testing.assert_allclose(paddle.tensor.ones([2]).numpy(), [1.0, 1.0])
+
+
+def test_regularizer_feeds_weight_decay():
+    from paddle_tpu import nn, optimizer
+
+    lin = nn.Linear(2, 2)
+    opt = optimizer.Momentum(learning_rate=0.1,
+                             weight_decay=paddle.regularizer.L2Decay(0.01),
+                             parameters=lin.parameters())
+    assert opt._parse_wd(paddle.regularizer.L2Decay(0.25)) == 0.25
+    assert repr(paddle.regularizer.L1Decay(0.1)).startswith("L1Decay")
+
+
+def test_batch_and_reader():
+    def r():
+        yield from range(7)
+
+    batches = list(paddle.batch(r, batch_size=3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    batches = list(paddle.batch(r, batch_size=3, drop_last=True)())
+    assert batches == [[0, 1, 2], [3, 4, 5]]
+
+    doubled = paddle.reader.map_readers(lambda a: a * 2, r)
+    assert list(doubled())[:3] == [0, 2, 4]
+    first = paddle.reader.firstn(r, 2)
+    assert list(first()) == [0, 1]
+    sh = paddle.reader.shuffle(r, 100)
+    assert sorted(sh()) == list(range(7))
+    cached = paddle.reader.cache(r)
+    assert list(cached()) == list(cached())
+
+    def r2():
+        yield from range(5)
+
+    comp = paddle.reader.compose(r, r2)
+    with pytest.raises(paddle.reader.ComposeNotAligned):
+        list(comp())
+    ok = list(paddle.reader.compose(r, r, check_alignment=True)())
+    assert ok[0] == (0, 0) and len(ok) == 7
+    trunc = list(paddle.reader.compose(r, r2, check_alignment=False)())
+    assert len(trunc) == 5
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def toy_model(scale=1):\n"
+        "    'a toy entrypoint'\n"
+        "    return {'scale': scale}\n")
+    assert paddle.hub.list(str(tmp_path), source="local") == ["toy_model"]
+    assert "toy entrypoint" in paddle.hub.help(str(tmp_path), "toy_model", source="local")
+    assert paddle.hub.load(str(tmp_path), "toy_model", source="local", scale=3) == {"scale": 3}
+    with pytest.raises(RuntimeError, match="network access"):
+        paddle.hub.load("org/repo", "m", source="github")
+
+
+def test_utils_surface(capsys):
+    @paddle.utils.deprecated(update_to="new_api", since="3.0", level=1)
+    def old_api():
+        return 42
+
+    with pytest.warns(DeprecationWarning, match="new_api"):
+        assert old_api() == 42
+
+    with pytest.raises(ImportError, match="not installed"):
+        paddle.utils.try_import("definitely_not_a_module_xyz")
+
+    n1 = paddle.utils.unique_name.generate("fc")
+    n2 = paddle.utils.unique_name.generate("fc")
+    assert n1 != n2
+    with paddle.utils.unique_name.guard():
+        assert paddle.utils.unique_name.generate("fc") == "fc_0"
+
+    paddle.utils.run_check()
+    assert "installed successfully" in capsys.readouterr().out
+
+    with pytest.raises(FileNotFoundError, match="no network access"):
+        paddle.utils.download.get_weights_path_from_url("https://x/y/w.pdparams")
+
+
+def test_dlpack_roundtrip():
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    t = paddle.utils.dlpack.from_dlpack(arr)  # numpy supports __dlpack__
+    np.testing.assert_allclose(t.numpy(), arr)
+
+
+def test_version_and_sysconfig():
+    assert paddle.version.full_version.startswith("3.")
+    assert paddle.version.cuda() == "False"
+    assert os.path.basename(paddle.sysconfig.get_lib()) == "native"
+    assert paddle.callbacks.EarlyStopping is not None

@@ -58,6 +58,19 @@ def test_local_p2p_pairing_and_mismatch():
     assert r.numpy()[0] == 2.0
 
 
+def test_p2p_pack_roundtrip_dtypes():
+    """_pack/_unpack must survive bf16 (np.save alone stores it as opaque
+    void — review finding) plus the regular dtypes."""
+    for arr in [np.arange(6, dtype=np.float32).reshape(2, 3),
+                np.asarray(jnp.arange(6, dtype=jnp.bfloat16)),
+                np.array([1, -2, 3], np.int64),
+                np.array([True, False])]:
+        out = C._unpack(C._pack(arr))
+        assert str(out.dtype) == str(arr.dtype)
+        np.testing.assert_array_equal(np.asarray(out, np.float64),
+                                      np.asarray(arr, np.float64))
+
+
 def test_traced_scatter_gather(eight_devices):
     from jax.sharding import Mesh, PartitionSpec as P
 

@@ -532,6 +532,45 @@ def test_distribute_fpn_proposals():
     np.testing.assert_allclose(cat[rest], rois)
 
 
+def test_generate_proposals():
+    N, A, H, W = 1, 2, 3, 3
+    scores = rs.rand(N, A, H, W).astype(np.float32)
+    deltas = (rs.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+    img = np.array([[40.0, 40.0]], np.float32)
+    # grid anchors 8x8 at stride 8
+    anc = np.zeros((H, W, A, 4), np.float32)
+    for i in range(H):
+        for j in range(W):
+            for a in range(A):
+                sz = 8.0 * (a + 1)
+                anc[i, j, a] = [j * 8, i * 8, j * 8 + sz, i * 8 + sz]
+    var = np.full((H, W, A, 4), 0.5, np.float32)
+    rois, probs, num = V.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas), paddle.to_tensor(img),
+        paddle.to_tensor(anc), paddle.to_tensor(var),
+        pre_nms_top_n=10, post_nms_top_n=5, nms_thresh=0.6, min_size=2.0,
+        return_rois_num=True)
+    r, p, nn_ = rois.numpy(), probs.numpy(), num.numpy()
+    assert r.shape[0] == p.shape[0] == int(nn_[0]) <= 5
+    # clipped to image, min-size respected, scores descending
+    assert (r[:, 0::2] >= 0).all() and (r[:, 0::2] <= 40).all()
+    assert ((r[:, 2] - r[:, 0]) >= 2.0 - 1e-5).all()
+    assert (np.diff(p.ravel()) <= 1e-6).all()
+    # oracle for the top-scoring box's decode (it always survives NMS)
+    flat = scores[0].transpose(1, 2, 0).ravel()
+    top = int(np.argmax(flat))
+    i, j, a = top // (W * A), (top // A) % W, top % A
+    an = anc[i, j, a]
+    dx, dy, dw, dh = deltas[0].reshape(A, 4, H, W)[a, :, i, j]
+    aw, ah = an[2] - an[0], an[3] - an[1]
+    cx = dx * 0.5 * aw + an[0] + aw / 2
+    cy = dy * 0.5 * ah + an[1] + ah / 2
+    bw, bh = np.exp(dw * 0.5) * aw, np.exp(dh * 0.5) * ah
+    want = [np.clip(cx - bw / 2, 0, 40), np.clip(cy - bh / 2, 0, 40),
+            np.clip(cx + bw / 2, 0, 40), np.clip(cy + bh / 2, 0, 40)]
+    np.testing.assert_allclose(r[0], want, rtol=1e-4, atol=1e-4)
+
+
 def test_matrix_nms_shapes():
     N, C, M = 1, 3, 12
     boxes = np.stack([_rand_rois(M, 20, 20, 1.0)] * N)
