@@ -122,7 +122,10 @@ def _serve_loop(name: str):
         while not _S.stop:
             try:
                 payload = _S.store.wait(key, timeout=0.5)
+            except TimeoutError:
+                continue
             except Exception:
+                time.sleep(0.2)  # dead/flaky master: back off, don't hot-spin
                 continue
             if payload:
                 break
@@ -150,7 +153,10 @@ class Future:
             try:  # blocking store wait in slices (see _serve_loop)
                 payload = _S.store.wait(self._key, timeout=min(
                     1.0, max(0.05, deadline - time.time())))
+            except TimeoutError:
+                continue
             except Exception:
+                time.sleep(0.2)  # back off on transport errors
                 continue
             if not payload:
                 continue

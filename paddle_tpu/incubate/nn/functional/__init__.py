@@ -25,6 +25,7 @@ __all__ = [
     "fused_multi_head_attention",
     "masked_multihead_attention",
     "block_multihead_attention",
+    "fused_multi_transformer",
 ]
 
 
@@ -370,3 +371,141 @@ def block_multihead_attention(q, key_cache, value_cache, block_tables,
 
     return apply_op("block_multihead_attention", fn,
                     [q, key_cache, value_cache, block_tables, seq_lens])
+
+
+def fused_multi_transformer(
+    x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+    linear_weights, linear_biases, ffn_ln_scales, ffn_ln_biases,
+    ffn1_weights, ffn1_biases, ffn2_weights, ffn2_biases,
+    pre_layer_norm=True, epsilon=1e-5, cache_kvs=None, time_step=None,
+    attn_mask=None, dropout_rate=0.0, activation="gelu",
+    training=False, mode="upscale_in_train", name=None,
+):
+    """The reference's whole-decoder fused op (fused_ops.yaml:394,
+    python/paddle/incubate/nn/functional/fused_transformer.py
+    fused_multi_transformer): L pre/post-LN transformer layers with one call,
+    threading a dense KV cache for generation.
+
+    TPU mapping: one jnp composition that XLA fuses per layer — the CUDA
+    kernel's fusion work is the compiler's job here; the op's value on TPU is
+    the *cache-threading decode semantics* (prefill writes positions [0, s);
+    decode with ``time_step=t`` appends the single new token at position t
+    and attends over the first t+1 cache slots).
+
+    Shapes (reference layout): x [b, s, e]; qkv_weights[i] [3, nh, hd, e];
+    linear_weights[i] [nh*hd, e]; ffn1 [e, di]; ffn2 [di, e];
+    cache_kvs[i] [2, b, nh, S, hd].  Returns (out, cache_kvs) when caches are
+    given, else out — functional in place of the reference's in-place ``_``.
+    """
+    import jax
+    import numpy as np
+
+    if dropout_rate and training:
+        raise NotImplementedError(
+            "fused_multi_transformer: dropout in training mode is not "
+            "implemented (inference/serving op here); use the nn.Layer stack "
+            "for dropout training")
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[activation]
+    L = len(qkv_weights)
+    use_cache = cache_kvs is not None
+    decode = time_step is not None
+
+    def ln(v, scale_, bias_, eps):
+        mu = jnp.mean(v, axis=-1, keepdims=True)
+        var = jnp.var(v, axis=-1, keepdims=True)
+        out = (v - mu) / jnp.sqrt(var + eps)
+        return out * scale_ + (bias_ if bias_ is not None else 0.0)
+
+    def one_layer(xv, lns, lnb, qkvw, qkvb, lw, lb, flns, flnb, f1w, f1b,
+                  f2w, f2b, cache, t):
+        b, s, e = xv.shape
+        _, nh, hd, _ = qkvw.shape
+        h = ln(xv, lns, lnb, epsilon) if pre_layer_norm else xv
+        qkv = jnp.einsum("bse,cnde->bscnd", h, qkvw)  # [b, s, 3, nh, hd]
+        if qkvb is not None:
+            qkv = qkv + qkvb[None, None]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b, s, nh, hd]
+        if use_cache:
+            S = cache.shape[3]
+            if decode:
+                # append the single new token at position t
+                cache = jax.lax.dynamic_update_slice(
+                    cache, jnp.stack([k, v]).transpose(0, 1, 3, 2, 4),
+                    (0, 0, 0, t, 0))
+                kk = cache[0]
+                vv = cache[1]
+                kv_mask = jnp.arange(S)[None, None, None, :] <= t
+            else:
+                cache = jax.lax.dynamic_update_slice(
+                    cache, jnp.stack([k, v]).transpose(0, 1, 3, 2, 4),
+                    (0, 0, 0, 0, 0))
+                kk = cache[0]
+                vv = cache[1]
+                q_pos = jnp.arange(s)[None, None, :, None]
+                kv_mask = jnp.arange(S)[None, None, None, :] <= q_pos
+        else:
+            kk = k.transpose(0, 2, 1, 3)
+            vv = v.transpose(0, 2, 1, 3)
+            q_pos = jnp.arange(s)[None, None, :, None]
+            kv_mask = jnp.arange(s)[None, None, None, :] <= q_pos
+        logits = jnp.einsum("bsnd,bnSd->bnsS", q.astype(jnp.float32),
+                            kk.astype(jnp.float32)) / np.sqrt(hd)
+        logits = jnp.where(kv_mask, logits, -1e30)
+        if attn_mask is not None:
+            logits = logits + jnp.asarray(_unwrap(attn_mask), logits.dtype)
+        p = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bnsS,bnSd->bsnd", p.astype(vv.dtype), vv)
+        attn = attn.reshape(b, s, nh * hd) @ lw
+        if lb is not None:
+            attn = attn + lb
+        xv = xv + attn
+        if not pre_layer_norm:
+            xv = ln(xv, lns, lnb, epsilon)
+        h = ln(xv, flns, flnb, epsilon) if pre_layer_norm else xv
+        ff = act(h @ f1w + (f1b if f1b is not None else 0.0)) @ f2w
+        if f2b is not None:
+            ff = ff + f2b
+        xv = xv + ff
+        if not pre_layer_norm:
+            xv = ln(xv, flns, flnb, epsilon)
+        return xv, cache
+
+    def fn(xv, *flat):
+        t = None
+        if decode:
+            t = jnp.asarray(_unwrap(time_step), jnp.int32).reshape(())
+        per = 12  # tensors per layer in `flat` (before caches)
+        caches = list(flat[per * L:]) if use_cache else [None] * L
+        new_caches = []
+        out = xv
+        for i in range(L):
+            lns, lnb, qkvw, qkvb, lw, lb, flns, flnb, f1w, f1b, f2w, f2b = (
+                flat[per * i: per * (i + 1)])
+            out, c = one_layer(out, lns, lnb, qkvw, qkvb, lw, lb, flns, flnb,
+                               f1w, f1b, f2w, f2b, caches[i], t)
+            new_caches.append(c)
+        if use_cache:
+            return tuple([out] + new_caches)
+        return out
+
+    def opt(seq, i):
+        return seq[i] if seq is not None else None
+
+    flat = []
+    for i in range(L):
+        flat.extend([
+            ln_scales[i], opt(ln_biases, i), qkv_weights[i], opt(qkv_biases, i),
+            linear_weights[i], opt(linear_biases, i),
+            ffn_ln_scales[i], opt(ffn_ln_biases, i),
+            ffn1_weights[i], opt(ffn1_biases, i),
+            ffn2_weights[i], opt(ffn2_biases, i),
+        ])
+    # None biases become inline 0-d zeros in x's dtype (a float32 zero would
+    # silently promote a bf16 residual stream through every bias add)
+    xdt = _unwrap(x).dtype
+    flat = [f if f is not None else jnp.zeros((), xdt) for f in flat]
+    inputs = [x] + flat + (list(cache_kvs) if use_cache else [])
+    res = apply_op("fused_multi_transformer", fn, inputs)
+    if use_cache:
+        return res[0], list(res[1:])
+    return res

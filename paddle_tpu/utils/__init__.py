@@ -29,11 +29,10 @@ def deprecated(update_to: str = "", since: str = "", reason: str = "",
             msg += f", use {update_to} instead"
         if reason:
             msg += f". reason: {reason}"
-        if level == 2:
-            raise RuntimeError(msg)
-
         @functools.wraps(func)
         def wrapper(*args, **kwargs):
+            if level == 2:  # raise at CALL time, like the reference
+                raise RuntimeError(msg)
             if level == 1:
                 warnings.warn(msg, DeprecationWarning, stacklevel=2)
             return func(*args, **kwargs)

@@ -7,11 +7,11 @@ __all__ = ["to_dlpack", "from_dlpack"]
 
 
 def to_dlpack(x):
-    import jax
-
+    # jax arrays implement the capsule protocol (__dlpack__) directly; the
+    # old jax.dlpack.to_dlpack helper no longer exists
     from ..core.tensor import _unwrap
 
-    return jax.dlpack.to_dlpack(_unwrap(x))
+    return _unwrap(x)
 
 
 def from_dlpack(capsule):

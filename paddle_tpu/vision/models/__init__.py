@@ -1,6 +1,28 @@
-"""Vision models (reference: python/paddle/vision/models/ — lenet.py, resnet.py,
-vgg.py, mobilenetv2.py)."""
+"""Vision models (reference: python/paddle/vision/models/ — the full zoo:
+lenet, resnet, vgg, mobilenet v1/v2/v3, alexnet, squeezenet, densenet,
+shufflenetv2, googlenet, inceptionv3)."""
 
+from .extra import (  # noqa: F401
+    AlexNet,
+    DenseNet,
+    ShuffleNetV2,
+    SqueezeNet,
+    alexnet,
+    densenet121,
+    shufflenet_v2_x1_0,
+    squeezenet1_1,
+)
+from .googlenet_inception import (  # noqa: F401
+    GoogLeNet,
+    InceptionV3,
+    MobileNetV1,
+    MobileNetV3,
+    googlenet,
+    inception_v3,
+    mobilenet_v1,
+    mobilenet_v3_large,
+    mobilenet_v3_small,
+)
 from .lenet import LeNet  # noqa: F401
 from .mobilenet import MobileNetV2, mobilenet_v2  # noqa: F401
 from .resnet import (  # noqa: F401

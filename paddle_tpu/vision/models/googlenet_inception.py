@@ -1,0 +1,356 @@
+"""GoogLeNet + InceptionV3 + MobileNetV1/V3 (reference:
+python/paddle/vision/models/{googlenet,inceptionv3,mobilenetv1,mobilenetv3}.py).
+
+Independent compact implementations of the reference architectures (paper
+topologies); API surface matches the reference constructors.
+"""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+
+class _ConvBN(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+            nn.ReLU(),
+        )
+
+
+# ---------------------------------------------------------------- GoogLeNet
+
+class _Inception(nn.Layer):
+    """The v1 inception block: 1x1 | 1x1->3x3 | 1x1->5x5 | pool->1x1."""
+
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, c1, 1)
+        self.b2 = nn.Sequential(_ConvBN(in_c, c3r, 1), _ConvBN(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_ConvBN(in_c, c5r, 1), _ConvBN(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1), _ConvBN(in_c, pp, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """Inception v1 (reference: googlenet.py).  forward returns
+    (main, aux1, aux2) logits like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 64, 7, stride=2, padding=3), nn.MaxPool2D(3, 2, padding=1),
+            _ConvBN(64, 64, 1), _ConvBN(64, 192, 3, padding=1), nn.MaxPool2D(3, 2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            # aux heads (training-time deep supervision)
+            self.aux1 = nn.Sequential(nn.AdaptiveAvgPool2D((4, 4)))
+            self.aux1_conv = _ConvBN(512, 128, 1)
+            self.aux1_fc1 = nn.Linear(128 * 16, 1024)
+            self.aux1_fc2 = nn.Linear(1024, num_classes)
+            self.aux2_conv = _ConvBN(528, 128, 1)
+            self.aux2_fc1 = nn.Linear(128 * 16, 1024)
+            self.aux2_fc2 = nn.Linear(1024, num_classes)
+
+    def _aux(self, x, conv, fc1, fc2):
+        x = nn.AdaptiveAvgPool2D((4, 4))(x)
+        x = conv(x)
+        x = flatten(x, 1)
+        x = nn.functional.relu(fc1(x))
+        return fc2(x)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        aux1 = self._aux(x, self.aux1_conv, self.aux1_fc1, self.aux1_fc2) if self.num_classes > 0 else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        aux2 = self._aux(x, self.aux2_conv, self.aux2_fc1, self.aux2_fc2) if self.num_classes > 0 else None
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(flatten(x, 1)))
+        return x, aux1, aux2
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)
+
+
+# ---------------------------------------------------------------- InceptionV3
+
+class _IncA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 64, 1)
+        self.b5 = nn.Sequential(_ConvBN(in_c, 48, 1), _ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_ConvBN(in_c, 64, 1), _ConvBN(64, 96, 3, padding=1),
+                                _ConvBN(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1), _ConvBN(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1)
+
+
+class _IncB(nn.Layer):  # grid reduction 35->17
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _ConvBN(in_c, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_ConvBN(in_c, 64, 1), _ConvBN(64, 96, 3, padding=1),
+                                 _ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _IncC(nn.Layer):  # factorized 7x7
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _ConvBN(in_c, c7, 1), _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _ConvBN(in_c, c7, 1), _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1), _ConvBN(in_c, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], axis=1)
+
+
+class _IncD(nn.Layer):  # grid reduction 17->8
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_ConvBN(in_c, 192, 1), _ConvBN(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _ConvBN(in_c, 192, 1), _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBN(192, 192, (7, 1), padding=(3, 0)), _ConvBN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _IncE(nn.Layer):  # expanded filter bank
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 320, 1)
+        self.b3_stem = _ConvBN(in_c, 384, 1)
+        self.b3_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_ConvBN(in_c, 448, 1), _ConvBN(448, 384, 3, padding=1))
+        self.b3d_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1), _ConvBN(in_c, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return concat([self.b1(x),
+                       concat([self.b3_a(s), self.b3_b(s)], axis=1),
+                       concat([self.b3d_a(d), self.b3d_b(d)], axis=1),
+                       self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Inception v3 (reference: inceptionv3.py), 299x299 inputs."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3), _ConvBN(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, 2), _ConvBN(64, 80, 1), _ConvBN(80, 192, 3), nn.MaxPool2D(3, 2))
+        self.blocks = nn.Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncB(288),
+            _IncC(768, 128), _IncC(768, 160), _IncC(768, 160), _IncC(768, 192),
+            _IncD(768),
+            _IncE(1280), _IncE(2048))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)
+
+
+# ---------------------------------------------------------------- MobileNetV1
+
+class _DWSep(nn.Sequential):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__(
+            nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1, groups=in_c, bias_attr=False),
+            nn.BatchNorm2D(in_c), nn.ReLU(),
+            nn.Conv2D(in_c, out_c, 1, bias_attr=False),
+            nn.BatchNorm2D(out_c), nn.ReLU())
+
+
+class MobileNetV1(nn.Layer):
+    """reference: mobilenetv1.py."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        c = lambda ch: max(8, int(ch * scale))
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [nn.Conv2D(3, c(32), 3, stride=2, padding=1, bias_attr=False),
+                  nn.BatchNorm2D(c(32)), nn.ReLU()]
+        for in_c, out_c, s in cfg:
+            layers.append(_DWSep(c(in_c), c(out_c), s))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+# ---------------------------------------------------------------- MobileNetV3
+
+class _SE(nn.Layer):
+    def __init__(self, ch, r=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc1 = nn.Conv2D(ch, ch // r, 1)
+        self.fc2 = nn.Conv2D(ch // r, ch, 1)
+        self.hs = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hs(self.fc2(nn.functional.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _V3Block(nn.Layer):
+    def __init__(self, in_c, exp, out_c, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        Act = nn.Hardswish if act == "hardswish" else nn.ReLU
+        layers = []
+        if exp != in_c:
+            layers += [nn.Conv2D(in_c, exp, 1, bias_attr=False), nn.BatchNorm2D(exp), Act()]
+        layers += [nn.Conv2D(exp, exp, k, stride=stride, padding=k // 2, groups=exp, bias_attr=False),
+                   nn.BatchNorm2D(exp), Act()]
+        if se:
+            layers.append(_SE(exp))
+        layers += [nn.Conv2D(exp, out_c, 1, bias_attr=False), nn.BatchNorm2D(out_c)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_V3_SMALL = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+_V3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    """reference: mobilenetv3.py (small/large)."""
+
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        c = lambda ch: max(8, int(ch * scale))
+        layers = [nn.Conv2D(3, c(16), 3, stride=2, padding=1, bias_attr=False),
+                  nn.BatchNorm2D(c(16)), nn.Hardswish()]
+        in_c = c(16)
+        for k, exp, out_c, se, act, s in config:
+            layers.append(_V3Block(in_c, c(exp), c(out_c), k, s, se, act))
+            in_c = c(out_c)
+        last_conv = c(config[-1][1])
+        layers += [nn.Conv2D(in_c, last_conv, 1, bias_attr=False),
+                   nn.BatchNorm2D(last_conv), nn.Hardswish()]
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3(_V3_SMALL, 1024, scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3(_V3_LARGE, 1280, scale=scale, **kwargs)

@@ -863,18 +863,26 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         keep = (ww >= ms) & (hh >= ms)
         boxes, s = boxes[keep], s[keep]
         if boxes.shape[0]:
-            # adaptive-eta greedy NMS (already score-sorted)
+            # adaptive-eta greedy NMS (already score-sorted), row-lazy: one
+            # IoU row per KEPT box (<= post_nms_top_n rows) instead of the
+            # full pre_nms_top_n^2 matrix
+            areas = np.maximum(boxes[:, 2] - boxes[:, 0], 0) * np.maximum(
+                boxes[:, 3] - boxes[:, 1], 0)
             kept = []
             thresh = nms_thresh
             sup = np.zeros(boxes.shape[0], bool)
-            iou = np.asarray(_iou_matrix(jnp.asarray(boxes)))
             for i in range(boxes.shape[0]):
                 if sup[i]:
                     continue
                 kept.append(i)
                 if len(kept) >= post_nms_top_n:
                     break
-                sup |= iou[i] > thresh
+                lt = np.maximum(boxes[i, :2], boxes[:, :2])
+                rb = np.minimum(boxes[i, 2:], boxes[:, 2:])
+                wh = np.maximum(rb - lt, 0.0)
+                inter = wh[:, 0] * wh[:, 1]
+                iou_row = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+                sup |= iou_row > thresh
                 sup[i] = True
                 if eta < 1.0 and thresh > 0.5:
                     thresh *= eta

@@ -242,3 +242,87 @@ def test_fused_mha_mask_and_postln():
     # post-LN output is normalized
     np.testing.assert_allclose(o.mean(-1), 0.0, atol=1e-4)
     np.testing.assert_allclose(o.var(-1), 1.0, atol=1e-2)
+
+
+def test_fused_multi_transformer_prefill_decode_parity():
+    """fused_multi_transformer (fused_ops.yaml:394): running s tokens as one
+    prefill must equal feeding them one-by-one with time_step (KV-cache
+    decode path), layer count L=2, pre-LN."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rs = np.random.RandomState(4)
+    L, b, s, e, nh, hd, di, S = 2, 2, 5, 16, 4, 4, 32, 8
+    mk = lambda *sh: paddle.to_tensor((rs.randn(*sh) * 0.2).astype(np.float32))
+    lns = [mk(e) for _ in range(L)]; lnb = [mk(e) for _ in range(L)]
+    qkvw = [mk(3, nh, hd, e) for _ in range(L)]
+    qkvb = [mk(3, nh, hd) for _ in range(L)]
+    lw = [mk(nh * hd, e) for _ in range(L)]; lb = [mk(e) for _ in range(L)]
+    flns = [mk(e) for _ in range(L)]; flnb = [mk(e) for _ in range(L)]
+    f1w = [mk(e, di) for _ in range(L)]; f1b = [mk(di) for _ in range(L)]
+    f2w = [mk(di, e) for _ in range(L)]; f2b = [mk(e) for _ in range(L)]
+    x = mk(b, s, e)
+
+    caches = [paddle.to_tensor(np.zeros((2, b, nh, S, hd), np.float32))
+              for _ in range(L)]
+    out_prefill, caches_p = IF.fused_multi_transformer(
+        x, lns, lnb, qkvw, qkvb, lw, lb, flns, flnb, f1w, f1b, f2w, f2b,
+        cache_kvs=caches, epsilon=1e-5)
+
+    caches_d = [paddle.to_tensor(np.zeros((2, b, nh, S, hd), np.float32))
+                for _ in range(L)]
+    outs = []
+    xs = x.numpy()
+    for t in range(s):
+        tok = paddle.to_tensor(xs[:, t:t + 1])
+        o, caches_d = IF.fused_multi_transformer(
+            tok, lns, lnb, qkvw, qkvb, lw, lb, flns, flnb, f1w, f1b, f2w, f2b,
+            cache_kvs=caches_d, time_step=paddle.to_tensor(np.int32(t)),
+            epsilon=1e-5)
+        outs.append(o.numpy())
+    decode_out = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(out_prefill.numpy(), decode_out,
+                               rtol=1e-4, atol=1e-4)
+    # caches agree on the written prefix
+    for cp, cd in zip(caches_p, caches_d):
+        np.testing.assert_allclose(cp.numpy()[:, :, :, :s],
+                                   cd.numpy()[:, :, :, :s], rtol=1e-4, atol=1e-5)
+
+
+def test_fused_multi_transformer_no_cache_postln():
+    """No-cache path with post-LN: matches an eager per-layer composition."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rs = np.random.RandomState(5)
+    b, s, e, nh, hd, di = 1, 4, 8, 2, 4, 16
+    mk = lambda *sh: (rs.randn(*sh) * 0.3).astype(np.float32)
+    lns, lnb = mk(e), mk(e)
+    qkvw, qkvb = mk(3, nh, hd, e), mk(3, nh, hd)
+    lw, lb = mk(nh * hd, e), mk(e)
+    flns, flnb = mk(e), mk(e)
+    f1w, f1b, f2w, f2b = mk(e, di), mk(di), mk(di, e), mk(e)
+    x = mk(b, s, e)
+    T = paddle.to_tensor
+    out = IF.fused_multi_transformer(
+        T(x), [T(lns)], [T(lnb)], [T(qkvw)], [T(qkvb)], [T(lw)], [T(lb)],
+        [T(flns)], [T(flnb)], [T(f1w)], [T(f1b)], [T(f2w)], [T(f2b)],
+        pre_layer_norm=False, activation="relu").numpy()
+
+    # numpy oracle
+    def lnorm(v, sc, bi):
+        mu = v.mean(-1, keepdims=True); vr = v.var(-1, keepdims=True)
+        return (v - mu) / np.sqrt(vr + 1e-5) * sc + bi
+
+    qkv = np.einsum("bse,cnde->bscnd", x, qkvw) + qkvb[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    logits = np.einsum("bsnd,bSnd->bnsS", q, k) / np.sqrt(hd)
+    causal = np.tril(np.ones((s, s), bool))
+    logits = np.where(causal[None, None], logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    attn = np.einsum("bnsS,bSnd->bsnd", p, v).reshape(b, s, nh * hd) @ lw + lb
+    h = lnorm(x + attn, lns, lnb)
+    ff = np.maximum(h @ f1w + f1b, 0) @ f2w + f2b
+    want = lnorm(h + ff, flns, flnb)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)

@@ -100,6 +100,19 @@ def test_dlpack_roundtrip():
     arr = np.arange(6, dtype=np.float32).reshape(2, 3)
     t = paddle.utils.dlpack.from_dlpack(arr)  # numpy supports __dlpack__
     np.testing.assert_allclose(t.numpy(), arr)
+    # to_dlpack returns a capsule-protocol object numpy can consume
+    cap = paddle.utils.dlpack.to_dlpack(t)
+    back = np.from_dlpack(cap)
+    np.testing.assert_allclose(np.asarray(back), arr)
+
+
+def test_deprecated_level2_raises_at_call_not_decoration():
+    @paddle.utils.deprecated(level=2, update_to="x")  # must not raise here
+    def gone():
+        return 1
+
+    with pytest.raises(RuntimeError, match="deprecated"):
+        gone()
 
 
 def test_version_and_sysconfig():

@@ -1,0 +1,57 @@
+"""Vision model zoo forward/backward smoke (reference:
+python/paddle/vision/models/ — googlenet, inceptionv3, mobilenet v1/v3 plus
+the previously-unexported extra zoo)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+rs = np.random.RandomState(0)
+
+
+def _img(n=1, size=64):
+    return paddle.to_tensor(rs.rand(n, 3, size, size).astype(np.float32))
+
+
+@pytest.mark.parametrize("ctor,size", [
+    (lambda: M.mobilenet_v1(scale=0.25, num_classes=10), 64),
+    (lambda: M.mobilenet_v3_small(scale=0.5, num_classes=10), 64),
+    (lambda: M.mobilenet_v3_large(scale=0.35, num_classes=10), 64),
+    (lambda: M.alexnet(num_classes=10), 96),
+    (lambda: M.squeezenet1_1(num_classes=10), 64),
+    (lambda: M.shufflenet_v2_x1_0(num_classes=10), 64),
+])
+def test_zoo_forward_shapes(ctor, size):
+    model = ctor()
+    model.eval()
+    out = model(_img(2, size))
+    assert tuple(out.shape) == (2, 10)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_googlenet_aux_heads():
+    model = M.googlenet(num_classes=10)
+    model.eval()
+    main, aux1, aux2 = model(_img(1, 96))
+    assert tuple(main.shape) == (1, 10)
+    assert tuple(aux1.shape) == (1, 10) and tuple(aux2.shape) == (1, 10)
+
+
+def test_inception_v3_forward():
+    model = M.inception_v3(num_classes=10)
+    model.eval()
+    out = model(_img(1, 299))
+    assert tuple(out.shape) == (1, 10)
+
+
+def test_mobilenet_v3_backward():
+    model = M.mobilenet_v3_small(scale=0.35, num_classes=4)
+    x = _img(1, 32)
+    out = model(x)
+    out.sum().backward()
+    grads = [p.grad for p in model.parameters() if p.grad is not None]
+    assert grads and all(np.isfinite(g.numpy()).all() for g in grads)
