@@ -464,6 +464,149 @@ def vision_ladder_main() -> int:
 
 
 # ---------------------------------------------------------------------------
+# MoE ladder (DeepSeekMoE-style expert-parallel — BASELINE.md ladder row #5,
+# single-chip: dense GShard dispatch; EP over ICI needs multi-chip HW)
+# ---------------------------------------------------------------------------
+
+def run_moe_rung(name, cfg, batch, seq, warmup_steps, bench_steps):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import llama, moe_llama
+
+    devices = jax.devices()
+    log(f"moe rung {name}: building (batch={batch} seq={seq} "
+        f"experts={cfg.num_experts} top_k={cfg.top_k})")
+    mesh = moe_llama.make_mesh(devices=devices[:1])
+    step_fn, opt_init, psh, dsh = moe_llama.build_train_step(cfg, mesh)
+    params = jax.device_put(moe_llama.init_params(cfg, jax.random.key(0)), psh)
+    opt_state = opt_init(params)
+    rs = np.random.RandomState(0)
+    ids = jax.device_put(jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq))), dsh)
+    labels = jax.device_put(jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq))), dsh)
+    t_c = time.perf_counter()
+    for _ in range(warmup_steps):
+        loss, params, opt_state = step_fn(params, opt_state, ids, labels)
+    float(loss)
+    log(f"moe rung {name}: warmup+compile {time.perf_counter() - t_c:.1f}s")
+    t0 = time.perf_counter()
+    for _ in range(bench_steps):
+        loss, params, opt_state = step_fn(params, opt_state, ids, labels)
+    loss_v = float(loss)
+    dt = time.perf_counter() - t0
+    tok_s = batch * seq * bench_steps / dt
+    # MFU over ACTIVE params (the MoE convention) + causal attention term
+    flops_tok = (6.0 * moe_llama.active_params_per_token(cfg)
+                 + llama.attn_flops_per_token(cfg, seq, causal=True))
+    mfu = tok_s * flops_tok / chip_peak(devices[0])
+    return {
+        "metric": "moe_train_mfu_single_chip",
+        "value": round(mfu * 100, 2),
+        "unit": "% MFU (active)",
+        "vs_baseline": 0.0,
+        "detail": {"rung": name, "tokens_per_sec_per_chip": round(tok_s, 1),
+                   "loss": loss_v, "experts": cfg.num_experts,
+                   "total_params_m": round(moe_llama.count_params(params) / 1e6, 1),
+                   "batch": batch, "seq": seq,
+                   "backend": jax.default_backend()},
+    }
+
+
+def run_dit_rung(name, cfg, batch, warmup_steps, bench_steps):
+    """DiT diffusion train step (BASELINE.md ladder row #4 — mixed
+    patchify-conv + attention, bf16)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import dit
+
+    devices = jax.devices()
+    log(f"dit rung {name}: building (batch={batch} image={cfg.image_size})")
+    mesh = dit.make_mesh(devices=devices[:1])
+    step_fn, opt_init, psh, dsh = dit.build_train_step(cfg, mesh)
+    params = jax.device_put(dit.init_params(cfg, jax.random.key(0)), psh)
+    opt_state = opt_init(params)
+    rs = np.random.RandomState(0)
+    x0 = jax.device_put(
+        jnp.asarray(rs.randn(batch, cfg.in_channels, cfg.image_size,
+                             cfg.image_size).astype(np.float32)), dsh)
+    y = jnp.asarray(rs.randint(0, cfg.num_classes, (batch,)))
+    rng = jax.random.key(1)
+    t_c = time.perf_counter()
+    for _ in range(warmup_steps):
+        loss, params, opt_state = step_fn(params, opt_state, x0, y, rng)
+    float(loss)
+    log(f"dit rung {name}: warmup+compile {time.perf_counter() - t_c:.1f}s")
+    t0 = time.perf_counter()
+    for _ in range(bench_steps):
+        loss, params, opt_state = step_fn(params, opt_state, x0, y, rng)
+    loss_v = float(loss)
+    dt = time.perf_counter() - t0
+    imgs_s = batch * bench_steps / dt
+    # train FLOPs/img ~= 6 * params * tokens (tokens = (img/patch)^2)
+    tokens = (cfg.image_size // cfg.patch_size) ** 2
+    flops_img = 6.0 * dit.count_params(params) * tokens
+    mfu = imgs_s * flops_img / chip_peak(devices[0])
+    return {
+        "metric": "dit_train_images_per_sec",
+        "value": round(imgs_s, 1),
+        "unit": "img/s",
+        "vs_baseline": 0.0,
+        "detail": {"rung": name, "loss": loss_v, "batch": batch,
+                   "est_mfu_pct": round(mfu * 100, 2),
+                   "params_m": round(dit.count_params(params) / 1e6, 1),
+                   "backend": jax.default_backend()},
+    }
+
+
+def moe_ladder_main() -> int:
+    import jax
+
+    from paddle_tpu.models import moe_llama
+
+    on_tpu = jax.default_backend() == "tpu"
+    full = moe_llama.MoEConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        moe_intermediate_size=704, num_hidden_layers=10,
+        num_attention_heads=8, num_key_value_heads=4, num_experts=8, top_k=2)
+    rungs = ([("tiny", moe_llama.MoEConfig.tiny(), 2, 128, 1, 3),
+              ("full", full, 4, 1024, 1, 8)]
+             if on_tpu else [("cpu_smoke", moe_llama.MoEConfig.tiny(), 2, 64, 1, 2)])
+    banked = 0
+    for rung in rungs:
+        try:
+            emit(run_moe_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"moe rung {rung[0]} failed: {e}\n{traceback.format_exc()}")
+            break
+    # DiT rungs (ladder row #4) share the --moe mode: both are "other model
+    # family" evidence rows.  Isolated like every rung — a DiT failure must
+    # not discard banked MoE results.
+    try:
+        from paddle_tpu.models import dit as _dit
+
+        dit_full = _dit.DiTConfig(image_size=32, patch_size=2, hidden_size=768,
+                                  depth=12, num_heads=12)
+        dit_rungs = ([("tiny", _dit.DiTConfig.tiny(), 4, 1, 3),
+                      ("full", dit_full, 16, 1, 8)]
+                     if on_tpu else [("cpu_smoke", _dit.DiTConfig.tiny(), 2, 1, 2)])
+    except Exception as e:
+        log(f"dit setup failed: {e}\n{traceback.format_exc()}")
+        dit_rungs = []
+    for rung in dit_rungs:
+        try:
+            emit(run_dit_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"dit rung {rung[0]} failed: {e}\n{traceback.format_exc()}")
+            break
+    return 0 if banked else 1
+
+
+# ---------------------------------------------------------------------------
 # orchestration
 # ---------------------------------------------------------------------------
 
@@ -479,6 +622,8 @@ def worker_main() -> int:
             return decode_ladder_main()
         if "--vision" in sys.argv:
             return vision_ladder_main()
+        if "--moe" in sys.argv:
+            return moe_ladder_main()
         return ladder_main()
     except Exception as e:
         log(f"worker failed: {e}\n{traceback.format_exc()}")
@@ -523,7 +668,8 @@ def main():
         sys.exit(worker_main())
 
     decode = (["--decode"] if "--decode" in sys.argv
-              else ["--vision"] if "--vision" in sys.argv else [])
+              else ["--vision"] if "--vision" in sys.argv
+              else ["--moe"] if "--moe" in sys.argv else [])
 
     # phase 0: probe backend + kernels
     probe = _run_worker(["--probe"], PROBE_TIMEOUT)

@@ -17,6 +17,20 @@ TPU-native realization in two tiers:
    loop runs under shard_map with ``lax.ppermute`` activation transfers over
    ICI; AD through the scan gives the reverse pipeline.  Used by
    paddle_tpu.models.llama.build_train_step when the mesh has pp > 1.
+   :func:`one_f_one_b_stacked` executes the 1F1B order in-jit on a global
+   clock (no garbage FLOPs, O(P) activation ring).
+
+Why interleaved-VPP and ZB-H1 stay schedule generators (design note):
+both derive their benefit from *irregular, per-stage* tick orders (Megatron's
+staggered per-chunk warmups; ZB's W-pass splitting), which fight the
+single-SPMD-program model this engine targets — a uniform global-clock
+rendering of VPP (every stage running each of its V chunks per tick behind
+one collective permute) has bubble V*P*t_chunk, i.e. *worse* than executed
+1F1B's (P-1)*t_stage, so executing it that way would be a regression, and a
+faithful irregular rendering needs per-stage programs (multi-executable
+runner) rather than one shard_map.  The generators + golden-string tests
+keep the reference's schedule semantics testable; 1F1B is the executed
+optimum within the one-program design.
 """
 
 from __future__ import annotations

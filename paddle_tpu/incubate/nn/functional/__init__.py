@@ -425,10 +425,16 @@ def fused_multi_transformer(
         if qkvb is not None:
             qkv = qkv + qkvb[None, None]
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b, s, nh, hd]
+        # causal is the DEFAULT only when no attn_mask is given (the
+        # reference op applies solely the caller's mask — an encoder-style
+        # bidirectional mask must be expressible); cache-validity bounds are
+        # structural and always apply
+        causal_default = attn_mask is None
         if use_cache:
             S = cache.shape[3]
             if decode:
-                # append the single new token at position t
+                # append the single new token at position t; slots > t are
+                # unwritten garbage and always masked
                 cache = jax.lax.dynamic_update_slice(
                     cache, jnp.stack([k, v]).transpose(0, 1, 3, 2, 4),
                     (0, 0, 0, t, 0))
@@ -442,12 +448,17 @@ def fused_multi_transformer(
                 kk = cache[0]
                 vv = cache[1]
                 q_pos = jnp.arange(s)[None, None, :, None]
-                kv_mask = jnp.arange(S)[None, None, None, :] <= q_pos
+                valid = jnp.arange(S)[None, None, None, :] < s
+                kv_mask = (valid & (jnp.arange(S)[None, None, None, :] <= q_pos)
+                           if causal_default else valid)
         else:
             kk = k.transpose(0, 2, 1, 3)
             vv = v.transpose(0, 2, 1, 3)
-            q_pos = jnp.arange(s)[None, None, :, None]
-            kv_mask = jnp.arange(s)[None, None, None, :] <= q_pos
+            if causal_default:
+                q_pos = jnp.arange(s)[None, None, :, None]
+                kv_mask = jnp.arange(s)[None, None, None, :] <= q_pos
+            else:
+                kv_mask = jnp.ones((1, 1, 1, s), bool)
         logits = jnp.einsum("bsnd,bnSd->bnsS", q.astype(jnp.float32),
                             kk.astype(jnp.float32)) / np.sqrt(hd)
         logits = jnp.where(kv_mask, logits, -1e30)

@@ -7,8 +7,11 @@ __all__ = ["to_dlpack", "from_dlpack"]
 
 
 def to_dlpack(x):
-    # jax arrays implement the capsule protocol (__dlpack__) directly; the
-    # old jax.dlpack.to_dlpack helper no longer exists
+    """Return the array as a DLPack-protocol object (has ``__dlpack__`` /
+    ``__dlpack_device__``), consumable by np.from_dlpack / torch.from_dlpack
+    and :func:`from_dlpack` below.  The legacy raw-PyCapsule contract is
+    gone from the ecosystem (modern jax/numpy refuse bare capsules); a
+    capsule-only consumer can call ``to_dlpack(x).__dlpack__()`` itself."""
     from ..core.tensor import _unwrap
 
     return _unwrap(x)

@@ -64,7 +64,7 @@ class GoogLeNet(nn.Layer):
             self.drop = nn.Dropout(0.4)
             self.fc = nn.Linear(1024, num_classes)
             # aux heads (training-time deep supervision)
-            self.aux1 = nn.Sequential(nn.AdaptiveAvgPool2D((4, 4)))
+            self.aux_pool = nn.AdaptiveAvgPool2D((4, 4))
             self.aux1_conv = _ConvBN(512, 128, 1)
             self.aux1_fc1 = nn.Linear(128 * 16, 1024)
             self.aux1_fc2 = nn.Linear(1024, num_classes)
@@ -73,7 +73,7 @@ class GoogLeNet(nn.Layer):
             self.aux2_fc2 = nn.Linear(1024, num_classes)
 
     def _aux(self, x, conv, fc1, fc2):
-        x = nn.AdaptiveAvgPool2D((4, 4))(x)
+        x = self.aux_pool(x)
         x = conv(x)
         x = flatten(x, 1)
         x = nn.functional.relu(fc1(x))

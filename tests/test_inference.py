@@ -326,3 +326,33 @@ def test_fused_multi_transformer_no_cache_postln():
     ff = np.maximum(h @ f1w + f1b, 0) @ f2w + f2b
     want = lnorm(h + ff, flns, flnb)
     np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_multi_transformer_bidirectional_mask():
+    """With an explicit attn_mask the op must NOT bake in causality
+    (encoder-style usage): a zero additive mask means full bidirectional
+    attention, so output at position 0 must depend on position 2's input."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rs = np.random.RandomState(6)
+    b, s, e, nh, hd, di = 1, 3, 8, 2, 4, 16
+    mk = lambda *sh: paddle.to_tensor((rs.randn(*sh) * 0.3).astype(np.float32))
+    args = ([mk(e)], [mk(e)], [mk(3, nh, hd, e)], [mk(3, nh, hd)],
+            [mk(nh * hd, e)], [mk(e)], [mk(e)], [mk(e)],
+            [mk(e, di)], [mk(di)], [mk(di, e)], [mk(e)])
+    x = rs.randn(b, s, e).astype(np.float32)
+    zero_mask = paddle.to_tensor(np.zeros((1, 1, s, s), np.float32))
+    out1 = IF.fused_multi_transformer(paddle.to_tensor(x), *args,
+                                      attn_mask=zero_mask).numpy()
+    x2 = x.copy()
+    x2[0, 2, 0] += 1.0  # perturb one channel of the LAST position
+    # (a whole-vector shift would be LayerNorm-invariant)
+    out2 = IF.fused_multi_transformer(paddle.to_tensor(x2), *args,
+                                      attn_mask=zero_mask).numpy()
+    # bidirectional: position 0's output must change
+    assert np.abs(out1[0, 0] - out2[0, 0]).max() > 1e-6
+    # and without a mask, causality holds: position 0 unchanged
+    out3 = IF.fused_multi_transformer(paddle.to_tensor(x), *args).numpy()
+    out4 = IF.fused_multi_transformer(paddle.to_tensor(x2), *args).numpy()
+    np.testing.assert_allclose(out3[0, 0], out4[0, 0], rtol=1e-6)

@@ -100,10 +100,12 @@ def test_dlpack_roundtrip():
     arr = np.arange(6, dtype=np.float32).reshape(2, 3)
     t = paddle.utils.dlpack.from_dlpack(arr)  # numpy supports __dlpack__
     np.testing.assert_allclose(t.numpy(), arr)
-    # to_dlpack returns a capsule-protocol object numpy can consume
+    # to_dlpack returns a protocol object every modern consumer accepts
     cap = paddle.utils.dlpack.to_dlpack(t)
-    back = np.from_dlpack(cap)
-    np.testing.assert_allclose(np.asarray(back), arr)
+    assert hasattr(cap, "__dlpack__") and hasattr(cap, "__dlpack_device__")
+    np.testing.assert_allclose(np.from_dlpack(cap), arr)
+    back = paddle.utils.dlpack.from_dlpack(cap)
+    np.testing.assert_allclose(back.numpy(), arr)
 
 
 def test_deprecated_level2_raises_at_call_not_decoration():
