@@ -150,28 +150,32 @@ def _train_rungs(on_tpu: bool):
 
     if not on_tpu:
         return [("cpu_smoke", llama.LlamaConfig.tiny(), 2, 128, 1, 2)]
+    # ~460M-param config: Llama-3 block structure, memory-scaled for 16GB HBM
+    cfg_460m = llama.LlamaConfig(
+        vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+        num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=4)
     return [
-        # (name, cfg, batch, seq, warmup, steps)
+        # (name, cfg, batch, seq, warmup, steps[, remat])
         ("tiny", llama.LlamaConfig.tiny(), 2, 128, 1, 3),
         ("small", llama.LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
             num_hidden_layers=8, num_attention_heads=8, num_key_value_heads=8,
         ), 4, 1024, 1, 5),
-        # ~460M-param config: Llama-3 block structure, memory-scaled for 16GB HBM
-        ("full", llama.LlamaConfig(
-            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
-            num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=4,
-        ), 8, 2048, 2, 10),
+        ("full", cfg_460m, 8, 2048, 2, 10),
         # ~1.1B: deeper/wider — bigger matmuls usually mean better MXU
         # utilization; ladder structure makes this rung free to attempt
         ("xl", llama.LlamaConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8,
         ), 8, 2048, 2, 10),
+        # SAME 460M config, selective recompute (save matmul outputs): fewer
+        # recomputed MXU FLOPs if HBM allows.  Last so an OOM here cannot
+        # abort earlier rungs (ladder breaks on first failure).
+        ("full_dots", cfg_460m, 8, 2048, 2, 10, "dots"),
     ]
 
 
-def run_rung(name, cfg, batch, seq, warmup_steps, bench_steps):
+def run_rung(name, cfg, batch, seq, warmup_steps, bench_steps, remat_policy="full"):
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -181,7 +185,8 @@ def run_rung(name, cfg, batch, seq, warmup_steps, bench_steps):
 
     backend = jax.default_backend()
     devices = jax.devices()
-    log(f"rung {name}: building (batch={batch} seq={seq})")
+    os.environ["PADDLE_TPU_REMAT"] = remat_policy  # read at trace time
+    log(f"rung {name}: building (batch={batch} seq={seq} remat={remat_policy})")
 
     mesh = llama.make_mesh(dp=1, mp=1, sharding=1, sep=1, devices=devices[:1])
     step_fn, opt_init, param_shardings, data_sharding = llama.build_train_step(cfg, mesh)
@@ -236,6 +241,7 @@ def run_rung(name, cfg, batch, seq, warmup_steps, bench_steps):
             "backend": backend,
             "device": getattr(devices[0], "device_kind", "?"),
             "flash_kernel_used": flash_kernel_used,
+            "remat": remat_policy,
             "disabled_pallas": os.environ.get("PADDLE_TPU_DISABLE_PALLAS", ""),
         },
     }
@@ -699,9 +705,16 @@ def main():
         rungs = _run_worker(decode, TPU_TIMEOUT, env_extra)
         rungs = [r for r in rungs if not r["metric"].startswith("probe_")]
         if rungs:
-            # headline = best MFU among banked rungs (decode mode: deepest)
-            result = (rungs[-1] if decode
-                      else max(rungs, key=lambda r: r.get("vs_baseline", 0)))
+            # headline: train ladder = best MFU; --moe = deepest MoE rung
+            # (the mode's reason to exist — a banked DiT rung must not
+            # shadow it); other modes = deepest banked rung
+            if not decode:
+                result = max(rungs, key=lambda r: r.get("vs_baseline", 0))
+            elif decode == ["--moe"]:
+                result = next((r for r in reversed(rungs)
+                               if r["metric"].startswith("moe")), rungs[-1])
+            else:
+                result = rungs[-1]
             result.setdefault("detail", {})["rungs_banked"] = len(rungs)
             result.setdefault("detail", {})["all_rungs"] = [
                 {"rung": r.get("detail", {}).get("rung"), "value": r["value"],
@@ -713,7 +726,11 @@ def main():
         rungs = _run_worker(decode + ["--cpu"], CPU_TIMEOUT)
         rungs = [r for r in rungs if not r["metric"].startswith("probe_")]
         if rungs:
-            result = rungs[-1]
+            if decode == ["--moe"]:  # same headline rule as the TPU phase
+                result = next((r for r in reversed(rungs)
+                               if r["metric"].startswith("moe")), rungs[-1])
+            else:
+                result = rungs[-1]
 
     if result is None:
         result = {

@@ -207,6 +207,25 @@ def sep_attention(mesh: Mesh, axis: str = "sep", impl: str = "ring"):
     return attn_fn
 
 
+def _remat_wrap(body, remat):
+    """Apply the recompute policy (reference: fleet/recompute full-block
+    recompute vs selective recompute).  PADDLE_TPU_REMAT selects at trace
+    time: 'full' (default — recompute everything, minimum HBM), 'dots'
+    (save matmul outputs, recompute only cheap elementwise — trades HBM for
+    fewer recomputed MXU FLOPs), 'none' (no recompute)."""
+    import os
+
+    if not remat:
+        return body
+    policy = os.environ.get("PADDLE_TPU_REMAT", "full")
+    if policy == "none":
+        return body
+    if policy == "dots":
+        return jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    return jax.checkpoint(body)
+
+
 def forward(cfg: LlamaConfig, params, input_ids, use_flash=True, remat=True,
             attn_fn=None):
     """Logits for [b, s] token ids.  The layer stack is a lax.scan over the
@@ -218,7 +237,7 @@ def forward(cfg: LlamaConfig, params, input_ids, use_flash=True, remat=True,
         out = _layer_forward(cfg, carry, lp, cos, sin, use_flash, attn_fn)
         return out, None
 
-    scan_body = jax.checkpoint(body) if remat else body
+    scan_body = _remat_wrap(body, remat)
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
     return _final_head(cfg, params, x)
 
@@ -261,7 +280,7 @@ def forward_pp(cfg: LlamaConfig, params, input_ids, mesh, num_microbatches,
         def body(carry, lp):
             return _layer_forward(cfg, carry, lp, cos_, sin_, use_flash, attn_fn), None
 
-        scan_body = jax.checkpoint(body) if remat else body
+        scan_body = _remat_wrap(body, remat)
         y, _ = jax.lax.scan(scan_body, xin, stage_params)
         return y
 
@@ -294,7 +313,7 @@ def loss_and_grads_1f1b(cfg: LlamaConfig, params, input_ids, labels, mesh,
         def body(carry, lp):
             return _layer_forward(cfg, carry, lp, cos_, sin_, use_flash, None), None
 
-        scan_body = jax.checkpoint(body) if remat else body
+        scan_body = _remat_wrap(body, remat)
         y, _ = jax.lax.scan(scan_body, x, sp)
         return y
 
