@@ -263,7 +263,10 @@ def ladder_main() -> int:
             banked += 1
         except Exception as e:
             log(f"rung {name} failed: {e}\n{traceback.format_exc()}")
-            break
+            if banked == 0:
+                break  # fundamentally broken: don't burn budget on bigger rungs
+            # else keep going: an xl OOM must not skip full_dots (both are
+            # independent "free attempts" above the banked baseline)
     return 0 if banked else 1
 
 

@@ -253,6 +253,10 @@ class _Converter:
             out = self.emit("Slice", [ins[0], starts, ends, axes, steps])
         elif p == "stop_gradient" or p == "copy":
             out = self.emit("Identity", ins)
+        elif p == "conv_general_dilated":
+            out = self.conv(eqn, ins)
+        elif p in ("reduce_window_max", "reduce_window_sum"):
+            out = self.pool(eqn, ins, p)
         elif p == "exp2":
             two = self.add_const(np.asarray(2.0, np.float32))
             out = self.emit("Pow", [two, ins[0]])
@@ -310,6 +314,67 @@ class _Converter:
                 out.append(rhs[j])
         eqn_str = f"{''.join(lhs)},{''.join(rhs)}->{''.join(out)}"
         return self.emit("Einsum", ins, attrs=[_attr_str("equation", eqn_str)])
+
+    def conv(self, eqn, ins):
+        """NCHW/OIHW conv_general_dilated -> ONNX Conv (the layouts match
+        ONNX's native convention; grouped conv via the group attribute)."""
+        pr = eqn.params
+        dn = pr["dimension_numbers"]
+        lhs_spec = tuple(dn.lhs_spec) if hasattr(dn, "lhs_spec") else dn[0]
+        rhs_spec = tuple(dn.rhs_spec) if hasattr(dn, "rhs_spec") else dn[1]
+        out_spec = tuple(dn.out_spec) if hasattr(dn, "out_spec") else dn[2]
+        nd = len(lhs_spec) - 2
+        if nd != 2:
+            raise NotImplementedError(
+                f"ONNX export: only 2D conv is supported (got {nd}D; the "
+                "bundled runtime is 2D-only)")
+        # NCHW: (0,1,2,3); OIHW: (0,1,2,3)
+        iota = tuple(range(nd + 2))
+        if lhs_spec != iota or rhs_spec != iota or out_spec != iota:
+            raise NotImplementedError(
+                f"ONNX export: conv layout {dn} is not NCHW/OIHW")
+        if any(d != 1 for d in pr["lhs_dilation"]):
+            raise NotImplementedError(
+                "ONNX export: transposed conv (lhs_dilation != 1)")
+        if pr.get("batch_group_count", 1) != 1:
+            raise NotImplementedError(
+                "ONNX export: batch_group_count != 1 has no ONNX Conv mapping")
+        pads = [p[0] for p in pr["padding"]] + [p[1] for p in pr["padding"]]
+        attrs = [_attr_ints("strides", pr["window_strides"]),
+                 _attr_ints("pads", pads),
+                 _attr_ints("dilations", pr["rhs_dilation"]),
+                 _attr_int("group", pr["feature_group_count"])]
+        return self.emit("Conv", ins, attrs=attrs)
+
+    def pool(self, eqn, ins, p):
+        """reduce_window_{max,sum} over (1,1,kh,kw) windows -> ONNX
+        MaxPool / AveragePool (sum pool = AveragePool(count_include_pad=1)
+        scaled by the window area)."""
+        pr = eqn.params
+        wd = list(pr["window_dimensions"])
+        ws = list(pr["window_strides"])
+        pad = list(pr["padding"])
+        if (len(wd) != 4 or any(d != 1 for d in wd[:2])
+                or any(s != 1 for s in ws[:2])
+                or any(tuple(q) != (0, 0) for q in pad[:2])):
+            raise NotImplementedError(
+                f"ONNX export: only NCHW spatial pooling is supported "
+                f"(window {wd}; the bundled runtime is 2D-only)")
+        if any(d != 1 for d in pr.get("base_dilation", [1])) or \
+                any(d != 1 for d in pr.get("window_dilation", [1])):
+            raise NotImplementedError("ONNX export: dilated pooling")
+        kernel = wd[2:]
+        pads = [q[0] for q in pad[2:]] + [q[1] for q in pad[2:]]
+        attrs = [_attr_ints("kernel_shape", kernel),
+                 _attr_ints("strides", ws[2:]),
+                 _attr_ints("pads", pads)]
+        if p == "reduce_window_max":
+            return self.emit("MaxPool", ins, attrs=attrs)
+        # sum pool: average with padding counted, times window area
+        attrs.append(_attr_int("count_include_pad", 1))
+        avg = self.emit("AveragePool", ins, attrs=attrs)
+        area = self.add_const(np.asarray(float(np.prod(kernel)), np.float32))
+        return self.emit("Mul", [avg, area])
 
     def broadcast_in_dim(self, eqn, ins):
         tgt = eqn.outvars[0].aval.shape

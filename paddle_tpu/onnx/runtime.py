@@ -123,6 +123,53 @@ class _Node:
 _ERF = np.vectorize(math.erf, otypes=[np.float32])
 
 
+def _windows(x, kh, kw, sh, sw, ph0, ph1, pw0, pw1, fill):
+    """Sliding [N, C, Ho, Wo, kh, kw] view after padding with ``fill``."""
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)),
+                constant_values=fill)
+    win = np.lib.stride_tricks.sliding_window_view(xp, (kh, kw), axis=(2, 3))
+    return win[:, :, ::sh, ::sw]
+
+
+def _conv2d(x, w, b, strides, pads, dilations, group):
+    N, C, H, W = x.shape
+    M, Cg, kh, kw = w.shape
+    dh, dw = dilations
+    if dh != 1 or dw != 1:  # dilate the kernel explicitly
+        wd = np.zeros((M, Cg, dh * (kh - 1) + 1, dw * (kw - 1) + 1), w.dtype)
+        wd[:, :, ::dh, ::dw] = w
+        w, (kh, kw) = wd, wd.shape[2:]
+    win = _windows(x, kh, kw, strides[0], strides[1],
+                   pads[0], pads[2], pads[1], pads[3], 0.0)
+    # win [N, C, Ho, Wo, kh, kw]; grouped contraction
+    N_, C_, Ho, Wo = win.shape[:4]
+    out = np.empty((N_, M, Ho, Wo), np.float32)
+    mpg = M // group
+    for g in range(group):
+        wg = w[g * mpg:(g + 1) * mpg]
+        xg = win[:, g * Cg:(g + 1) * Cg]
+        out[:, g * mpg:(g + 1) * mpg] = np.einsum(
+            "nchwij,mcij->nmhw", xg, wg, optimize=True)
+    if b is not None:
+        out += b.reshape(1, M, 1, 1)
+    return out.astype(x.dtype)
+
+
+def _pool2d(x, kernel, strides, pads, mode, count_include_pad=False):
+    kh, kw = kernel
+    sh, sw = strides or (1, 1)  # ONNX default: stride 1 per spatial axis
+    fill = -np.inf if mode == "max" else 0.0
+    win = _windows(x, kh, kw, sh, sw, pads[0], pads[2], pads[1], pads[3], fill)
+    if mode == "max":
+        return win.max(axis=(4, 5)).astype(x.dtype)
+    s = win.sum(axis=(4, 5))
+    if count_include_pad:
+        return (s / (kh * kw)).astype(x.dtype)
+    ones = _windows(np.ones_like(x), kh, kw, sh, sw,
+                    pads[0], pads[2], pads[1], pads[3], 0.0)
+    return (s / ones.sum(axis=(4, 5))).astype(x.dtype)
+
+
 class OnnxModel:
     def __init__(self, data: bytes):
         model = _decode(data)
@@ -193,6 +240,17 @@ class OnnxModel:
             return np.min(x[0], axis=tuple(a["axes"]), keepdims=bool(a.get("keepdims", 1)))
         if op == "ReduceMean":
             return np.mean(x[0], axis=tuple(a["axes"]), keepdims=bool(a.get("keepdims", 1)))
+        if op == "Conv":
+            return _conv2d(x[0], x[1], x[2] if len(x) > 2 else None,
+                           a.get("strides", [1, 1]), a.get("pads", [0, 0, 0, 0]),
+                           a.get("dilations", [1, 1]), a.get("group", 1))
+        if op == "MaxPool":
+            return _pool2d(x[0], a["kernel_shape"], a.get("strides"),
+                           a.get("pads", [0, 0, 0, 0]), "max")
+        if op == "AveragePool":
+            return _pool2d(x[0], a["kernel_shape"], a.get("strides"),
+                           a.get("pads", [0, 0, 0, 0]), "avg",
+                           count_include_pad=bool(a.get("count_include_pad", 0)))
         if op == "Slice":
             starts, ends, axes, steps = (list(map(int, v)) for v in x[1:5])
             sl = [slice(None)] * x[0].ndim

@@ -118,3 +118,69 @@ def test_unsupported_primitive_is_loud(tmp_path):
 def test_requires_input_spec(tmp_path):
     with pytest.raises(ValueError, match="input_spec"):
         export(lambda x: x, str(tmp_path / "x"))
+
+
+def test_lenet_export_roundtrip(tmp_path):
+    """A real conv model exports and matches numerically (Conv + MaxPool)."""
+    from paddle_tpu.vision import models as M
+
+    model = M.LeNet(num_classes=10)
+    model.eval()
+    x = rs.rand(2, 1, 28, 28).astype(np.float32)
+    path = export(model, str(tmp_path / "lenet"), input_spec=[paddle.to_tensor(x)])
+    m = runtime.load(path)
+    got = m.run(x)[0]
+    want = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_resnet18_export_roundtrip(tmp_path):
+    """ResNet-18 (strided + grouped-free convs, BN folded into elementwise,
+    padded MaxPool) exports and matches."""
+    from paddle_tpu.vision import models as M
+
+    model = M.resnet18(num_classes=7)
+    model.eval()
+    x = rs.rand(1, 3, 32, 32).astype(np.float32)
+    path = export(model, str(tmp_path / "r18"), input_spec=[paddle.to_tensor(x)])
+    m = runtime.load(path)
+    got = m.run(x)[0]
+    want = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_grouped_and_dilated_conv_roundtrip(tmp_path):
+    import jax.lax as lax
+
+    def fn(x, w):
+        return lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding=((2, 2), (2, 2)),
+            rhs_dilation=(2, 2), feature_group_count=2,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    x = rs.rand(1, 4, 8, 8).astype(np.float32)
+    w = (rs.randn(6, 2, 3, 3) * 0.3).astype(np.float32)
+    _roundtrip(fn, [x, w], tmp_path)
+
+
+def test_conv1d_and_batch_groups_are_loud(tmp_path):
+    import jax.lax as lax
+
+    def fn1d(x, w):
+        return lax.conv_general_dilated(x, w, (1,), ((1, 1),),
+                                        dimension_numbers=("NCW", "OIW", "NCW"))
+
+    with pytest.raises(NotImplementedError, match="2D"):
+        export(fn1d, str(tmp_path / "c1"),
+               input_spec=[rs.rand(1, 2, 8).astype(np.float32),
+                           rs.rand(3, 2, 3).astype(np.float32)])
+
+    def fnbg(x, w):
+        return lax.conv_general_dilated(x, w, (1, 1), ((0, 0), (0, 0)),
+                                        batch_group_count=2,
+                                        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    with pytest.raises(NotImplementedError, match="batch_group_count"):
+        export(fnbg, str(tmp_path / "c2"),
+               input_spec=[rs.rand(2, 2, 4, 4).astype(np.float32),
+                           rs.rand(2, 2, 1, 1).astype(np.float32)])
