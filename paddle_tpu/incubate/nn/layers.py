@@ -1,0 +1,318 @@
+"""Fused Layer classes (reference: python/paddle/incubate/nn/layer/
+fused_transformer.py — FusedMultiHeadAttention :213, FusedFeedForward :534,
+FusedTransformerEncoderLayer :750, FusedMultiTransformer :1071;
+fused_linear.py FusedLinear :26; fused_dropout_add.py FusedDropoutAdd :26).
+
+Each Layer owns the parameters and forwards through the functional fused op
+in ``incubate.nn.functional`` — same split as the reference (Layer = param
+container, functional = the fused kernel call).
+"""
+
+from __future__ import annotations
+
+from ... import nn
+from . import functional as F
+
+__all__ = [
+    "FusedLinear",
+    "FusedDropoutAdd",
+    "FusedBiasDropoutResidualLayerNorm",
+    "FusedMultiHeadAttention",
+    "FusedFeedForward",
+    "FusedTransformerEncoderLayer",
+    "FusedMultiTransformer",
+]
+
+
+class FusedLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = self.create_parameter((out_features,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return F.fused_linear(x, self.weight, self.bias,
+                              transpose_weight=self.transpose_weight)
+
+
+class FusedDropoutAdd(nn.Layer):
+    """out = dropout(x) + y (reference fused_dropout_add.py:26)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return nn.functional.dropout(x, p=self.p, training=self.training,
+                                     mode=self.mode) + y
+
+
+class FusedBiasDropoutResidualLayerNorm(nn.Layer):
+    """out = layer_norm(residual + dropout(x + bias)) (reference :94)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn import initializer as I
+
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter((embed_dim,), is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr, default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter((embed_dim,), attr=bias_attr,
+                                             is_bias=True)
+
+    def forward(self, x, residual):
+        h = nn.functional.dropout(x + self.linear_bias, p=self.dropout_rate,
+                                  training=self.training)
+        return nn.functional.layer_norm(
+            residual + h, x.shape[-1:], self.ln_scale, self.ln_bias,
+            self.epsilon)
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """Param container over functional.fused_multi_head_attention
+    (reference :213)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, transpose_qkv_wb=False, name=None):
+        super().__init__()
+        from ...nn import initializer as I
+
+        assert embed_dim > 0 and num_heads > 0
+        assert embed_dim % num_heads == 0
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        self.transpose_qkv_wb = transpose_qkv_wb
+        if transpose_qkv_wb:
+            qkv_shape = (embed_dim, 3 * embed_dim)
+        else:
+            qkv_shape = (3, num_heads, self.head_dim, embed_dim)
+        self.qkv_weight = self.create_parameter(qkv_shape, attr=qkv_weight_attr)
+        self.qkv_bias = self.create_parameter(
+            (3 * embed_dim,) if transpose_qkv_wb else (3, num_heads, self.head_dim),
+            attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter((embed_dim, embed_dim),
+                                                   attr=linear_weight_attr)
+        self.linear_bias = self.create_parameter((embed_dim,),
+                                                 attr=linear_bias_attr, is_bias=True)
+        one = I.Constant(1.0)
+        self.pre_ln_scale = self.create_parameter(
+            (embed_dim,), attr=pre_ln_scale_attr, default_initializer=one)
+        self.pre_ln_bias = self.create_parameter((embed_dim,),
+                                                 attr=pre_ln_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=ln_scale_attr, default_initializer=one)
+        self.ln_bias = self.create_parameter((embed_dim,), attr=ln_bias_attr,
+                                             is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        # the fused op is self-attention (same contract as the reference's
+        # fused kernel, which asserts key is query); fail loudly rather than
+        # silently ignoring a distinct key/value
+        if (key is not None and key is not query) or \
+                (value is not None and value is not query):
+            raise NotImplementedError(
+                "FusedMultiHeadAttention is self-attention only "
+                "(key/value must be None or the query tensor)")
+        return F.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self.epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate, ln_epsilon=self.epsilon,
+            training=self.training, transpose_qkv_wb=self.transpose_qkv_wb,
+            num_heads=self.num_heads)
+
+
+class FusedFeedForward(nn.Layer):
+    """[pre/post LN] linear -> act -> dropout -> linear -> dropout + residual
+    (reference :534)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, name=None):
+        super().__init__()
+        from ...nn import initializer as I
+
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.activation = activation
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward), attr=linear1_weight_attr)
+        self.linear1_bias = self.create_parameter(
+            (dim_feedforward,), attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model), attr=linear2_weight_attr)
+        self.linear2_bias = self.create_parameter(
+            (d_model,), attr=linear2_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (d_model,), attr=ln1_scale_attr, default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter((d_model,), attr=ln1_bias_attr,
+                                             is_bias=True)
+
+    def forward(self, src):
+        residual = src
+        h = src
+        if self.normalize_before:
+            h = nn.functional.layer_norm(h, h.shape[-1:], self.ln_scale,
+                                         self.ln_bias, self.epsilon)
+        h = F.fused_linear(h, self.linear1_weight, self.linear1_bias)
+        h = getattr(nn.functional, self.activation)(h)
+        h = nn.functional.dropout(h, p=self.act_dropout_rate,
+                                  training=self.training)
+        h = F.fused_linear(h, self.linear2_weight, self.linear2_bias)
+        h = nn.functional.dropout(h, p=self.dropout_rate,
+                                  training=self.training)
+        out = residual + h
+        if not self.normalize_before:
+            out = nn.functional.layer_norm(out, out.shape[-1:], self.ln_scale,
+                                           self.ln_bias, self.epsilon)
+        return out
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    """FusedMultiHeadAttention + FusedFeedForward (reference :750)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout_rate = (dropout_rate if attn_dropout_rate is None
+                             else attn_dropout_rate)
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before,
+            qkv_weight_attr=weight_attr, qkv_bias_attr=bias_attr,
+            linear_weight_attr=weight_attr, linear_bias_attr=bias_attr)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before,
+            linear1_weight_attr=weight_attr, linear1_bias_attr=bias_attr,
+            linear2_weight_attr=weight_attr, linear2_bias_attr=bias_attr)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+        if isinstance(out, tuple):  # decode path returns (out, new_cache)
+            attn_out, new_cache = out
+            return self.ffn(attn_out), new_cache
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(nn.Layer):
+    """num_layers decoder layers over functional.fused_multi_transformer
+    (reference :1071, the serving stack's Layer)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None, epsilon=1e-5,
+                 residual_alpha=1.0, num_layers=-1, nranks=1, trans_qkvw=True,
+                 ring_id=-1, norm_type="layernorm",
+                 use_neox_rotary_style=False, gqa_group_size=-1, name=None):
+        super().__init__()
+        from ...nn import initializer as I
+
+        # unsupported reference variants fail loudly instead of silently
+        # building the wrong computation
+        if not trans_qkvw:
+            raise NotImplementedError(
+                "FusedMultiTransformer: trans_qkvw=False ([e, 3*nh*hd] qkv "
+                "layout) is not supported; use the default layout")
+        if norm_type != "layernorm":
+            raise NotImplementedError(f"norm_type {norm_type!r} not supported")
+        if use_neox_rotary_style or gqa_group_size > 0:
+            raise NotImplementedError(
+                "rotary embedding / GQA variants are not wired into "
+                "fused_multi_transformer; use models.llama for GQA+RoPE")
+        if residual_alpha != 1.0:
+            raise NotImplementedError("residual_alpha != 1.0 not supported")
+        assert embed_dim > 0 and num_heads > 0
+        if num_layers < 0:
+            num_layers = (len(qkv_weight_attrs)
+                          if isinstance(qkv_weight_attrs, (list, tuple)) else 1)
+        self.num_layers = num_layers
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        nh, hd = num_heads, embed_dim // num_heads
+        one = I.Constant(1.0)
+
+        def attr_i(attrs, i):
+            return attrs[i] if isinstance(attrs, (list, tuple)) else attrs
+
+        def plist(name, shape, attrs, bias=False, init=None):
+            ps = []
+            for i in range(num_layers):
+                p = self.create_parameter(shape, attr=attr_i(attrs, i),
+                                          is_bias=bias,
+                                          default_initializer=init)
+                self.add_parameter(f"{name}_{i}", p)
+                ps.append(p)
+            return ps
+
+        self.ln_scales = plist("ln_scale", (embed_dim,), ln_scale_attrs, init=one)
+        self.ln_biases = plist("ln_bias", (embed_dim,), ln_bias_attrs, bias=True)
+        self.qkv_weights = plist("qkv_weight", (3, nh, hd, embed_dim),
+                                 qkv_weight_attrs)
+        self.qkv_biases = plist("qkv_bias", (3, nh, hd), qkv_bias_attrs, bias=True)
+        self.linear_weights = plist("linear_weight", (nh * hd, embed_dim),
+                                    linear_weight_attrs)
+        self.linear_biases = plist("linear_bias", (embed_dim,),
+                                   linear_bias_attrs, bias=True)
+        self.ffn_ln_scales = plist("ffn_ln_scale", (embed_dim,),
+                                   ffn_ln_scale_attrs, init=one)
+        self.ffn_ln_biases = plist("ffn_ln_bias", (embed_dim,),
+                                   ffn_ln_bias_attrs, bias=True)
+        self.ffn1_weights = plist("ffn1_weight", (embed_dim, dim_feedforward),
+                                  ffn1_weight_attrs)
+        self.ffn1_biases = plist("ffn1_bias", (dim_feedforward,),
+                                 ffn1_bias_attrs, bias=True)
+        self.ffn2_weights = plist("ffn2_weight", (dim_feedforward, embed_dim),
+                                  ffn2_weight_attrs)
+        self.ffn2_biases = plist("ffn2_bias", (embed_dim,), ffn2_bias_attrs,
+                                 bias=True)
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        return F.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
+            cache_kvs=caches, time_step=time_step, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate, activation=self.activation,
+            training=self.training)

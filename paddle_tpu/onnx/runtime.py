@@ -143,7 +143,7 @@ def _conv2d(x, w, b, strides, pads, dilations, group):
                    pads[0], pads[2], pads[1], pads[3], 0.0)
     # win [N, C, Ho, Wo, kh, kw]; grouped contraction
     N_, C_, Ho, Wo = win.shape[:4]
-    out = np.empty((N_, M, Ho, Wo), np.float32)
+    out = np.empty((N_, M, Ho, Wo), np.result_type(x, w))
     mpg = M // group
     for g in range(group):
         wg = w[g * mpg:(g + 1) * mpg]
