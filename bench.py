@@ -21,7 +21,21 @@ BENCH_r01 rc=1 and BENCH_r02's 1500s hang both produced zero TPU evidence):
                               result line is emitted (and flushed) after EACH
                               rung, so the first TPU number banks within
                               minutes and a later-rung hang costs nothing.
-  phase 2  CPU fallback       only if no TPU rung banked.
+  phase 1b (bare invocation)  compact cross-mode rungs — decode (chunked
+                              continuous batching), MoE, vision — so a single
+                              driver run certifies more than train MFU.  Each
+                              lands in the final line's detail.cross_mode.
+  phase 2  CPU fallback       only if no TPU rung banked.  If the committed
+                              BENCH_TPU_CACHE.json holds a rung measured on
+                              real TPU earlier (relay outages last hours —
+                              see round 1-3 artifacts), that rung is the
+                              headline, explicitly marked source=
+                              last_healthy_tpu_cache with its timestamp, and
+                              the live CPU smoke is attached as proof of life.
+
+The aggregate result line is re-emitted after every completed phase; the
+driver parses the LAST complete JSON line, so a kill mid-phase cannot erase
+finished phases.
 
 Every phase prints per-step wall-clock to stderr, so a killed worker's stderr
 shows exactly where time went.  All subprocesses run under hard process-group
@@ -37,9 +51,21 @@ import sys
 import time
 import traceback
 
-PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "600"))
-TPU_TIMEOUT = int(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
+TPU_TIMEOUT = int(os.environ.get("BENCH_TPU_TIMEOUT", "1200"))
 CPU_TIMEOUT = int(os.environ.get("BENCH_CPU_TIMEOUT", "600"))
+MODE_TIMEOUT = int(os.environ.get("BENCH_MODE_TIMEOUT", "480"))
+# overall wall-clock budget for a bare `python bench.py` invocation; phases
+# that would start past the deadline are skipped (their absence is visible in
+# detail.cross_mode) rather than risking a driver-side kill mid-phase
+TOTAL_BUDGET = int(os.environ.get("BENCH_TOTAL_BUDGET", "3300"))
+# best-TPU-rung persistence: a round-end relay outage (r1 rc=1, r2 hang, r3
+# multi-hour outage) must not erase hardware evidence gathered earlier in the
+# round, so every banked TPU rung is merged into this committed cache file
+CACHE_PATH = os.environ.get(
+    "BENCH_CACHE_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_TPU_CACHE.json"))
 
 # bf16 peak FLOPs per chip by generation
 PEAK_FLOPS = {
@@ -360,7 +386,7 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1)
     }
 
 
-def decode_ladder_main() -> int:
+def decode_ladder_main(compact: bool = False) -> int:
     import jax
 
     from paddle_tpu.models import llama
@@ -373,6 +399,8 @@ def decode_ladder_main() -> int:
     rungs = ([("tiny", llama.LlamaConfig.tiny(), 2, 16, 16, 64),
               ("full", full_cfg, 8, 128, 128, 512)]
              if on_tpu else [("cpu_smoke", llama.LlamaConfig.tiny(), 2, 16, 16, 64)])
+    if compact and on_tpu:
+        rungs = []  # compact mode: the chunked CB rung is the headline
     banked = 0
     for rung in rungs:
         try:
@@ -388,6 +416,10 @@ def decode_ladder_main() -> int:
                  ("cb_full_chunk8", full_cfg, 8, 24, 128, 64, 512, 8)]
                 if on_tpu else
                 [("cb_cpu_smoke", llama.LlamaConfig.tiny(), 2, 4, 16, 8, 64, 2)])
+    if compact and on_tpu:
+        # single best-known config (round-3 headline: chunk=8 hides the
+        # per-token relay RTT) so the cross-mode phase fits the budget
+        cb_rungs = [("cb_full_chunk8", full_cfg, 8, 24, 128, 64, 512, 8)]
     for rung in cb_rungs:
         try:
             emit(run_cb_rung(*rung))
@@ -452,7 +484,7 @@ def run_vision_rung(name, arch, batch, img, warmup_steps, bench_steps, flops_per
     }
 
 
-def vision_ladder_main() -> int:
+def vision_ladder_main(compact: bool = False) -> int:
     import jax
 
     on_tpu = jax.default_backend() == "tpu"
@@ -461,6 +493,8 @@ def vision_ladder_main() -> int:
     rungs = ([("tiny", "resnet18", 8, 64, 1, 3, 3 * 0.15e9),
               ("full", "resnet50", 32, 224, 1, 10, 3 * 4.1e9)]
              if on_tpu else [("cpu_smoke", "resnet18", 2, 32, 1, 2, 3 * 0.04e9)])
+    if compact and on_tpu:
+        rungs = [("full", "resnet50", 32, 224, 1, 6, 3 * 4.1e9)]
     banked = 0
     for rung in rungs:
         try:
@@ -570,7 +604,7 @@ def run_dit_rung(name, cfg, batch, warmup_steps, bench_steps):
     }
 
 
-def moe_ladder_main() -> int:
+def moe_ladder_main(compact: bool = False) -> int:
     import jax
 
     from paddle_tpu.models import moe_llama
@@ -583,6 +617,8 @@ def moe_ladder_main() -> int:
     rungs = ([("tiny", moe_llama.MoEConfig.tiny(), 2, 128, 1, 3),
               ("full", full, 4, 1024, 1, 8)]
              if on_tpu else [("cpu_smoke", moe_llama.MoEConfig.tiny(), 2, 64, 1, 2)])
+    if compact and on_tpu:
+        rungs = [("full", full, 4, 1024, 1, 6)]
     banked = 0
     for rung in rungs:
         try:
@@ -593,7 +629,9 @@ def moe_ladder_main() -> int:
             break
     # DiT rungs (ladder row #4) share the --moe mode: both are "other model
     # family" evidence rows.  Isolated like every rung — a DiT failure must
-    # not discard banked MoE results.
+    # not discard banked MoE results.  Compact mode keeps MoE only.
+    if compact:
+        return 0 if banked else 1
     try:
         from paddle_tpu.models import dit as _dit
 
@@ -624,15 +662,16 @@ def worker_main() -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    compact = "--compact" in sys.argv
     try:
         if "--probe" in sys.argv:
             return probe_main()
         if "--decode" in sys.argv:
-            return decode_ladder_main()
+            return decode_ladder_main(compact)
         if "--vision" in sys.argv:
-            return vision_ladder_main()
+            return vision_ladder_main(compact)
         if "--moe" in sys.argv:
-            return moe_ladder_main()
+            return moe_ladder_main(compact)
         return ladder_main()
     except Exception as e:
         log(f"worker failed: {e}\n{traceback.format_exc()}")
@@ -672,13 +711,64 @@ def _run_worker(args: list[str], timeout: int, env_extra: dict | None = None):
     return results
 
 
+def _load_cache() -> dict:
+    try:
+        with open(CACHE_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _bank_to_cache(rungs: list[dict]) -> None:
+    """Merge freshly-measured TPU rungs into the committed cache, keyed by
+    (metric, rung).  Only rungs whose own detail says backend=tpu are cached —
+    the cache must never launder a CPU number into TPU evidence."""
+    cache = _load_cache()
+    entries = cache.setdefault("rungs", {})
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    fresh = 0
+    for r in rungs:
+        det = r.get("detail", {})
+        if det.get("backend") != "tpu":
+            continue
+        key = f'{r["metric"]}/{det.get("rung", "?")}'
+        entries[key] = {**r, "measured_at": now}
+        fresh += 1
+    if fresh:
+        cache["updated_at"] = now
+        try:
+            # atomic replace: a kill mid-write must not truncate the cache
+            # (losing banked evidence is the exact failure this file prevents)
+            tmp = CACHE_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(cache, f, indent=1, sort_keys=True)
+            os.replace(tmp, CACHE_PATH)
+            log(f"cache: banked {fresh} fresh TPU rungs "
+                f"({len(entries)} total) to {CACHE_PATH}")
+        except OSError as e:
+            log(f"cache: write failed: {e}")
+
+
+def _best_cached_train(cache: dict) -> dict | None:
+    rungs = [r for r in cache.get("rungs", {}).values()
+             if r.get("metric") == "llama_train_mfu_single_chip"]
+    return max(rungs, key=lambda r: r.get("vs_baseline", 0)) if rungs else None
+
+
 def main():
     if "--worker" in sys.argv:
         sys.exit(worker_main())
 
+    t_start = time.perf_counter()
+
+    def budget_left() -> float:
+        return TOTAL_BUDGET - (time.perf_counter() - t_start)
+
     decode = (["--decode"] if "--decode" in sys.argv
               else ["--vision"] if "--vision" in sys.argv
               else ["--moe"] if "--moe" in sys.argv else [])
+    cross_mode = not decode  # bare invocation (the driver's command) sweeps
+                             # train + compact decode/moe/vision phases
 
     # phase 0: probe backend + kernels
     probe = _run_worker(["--probe"], PROBE_TIMEOUT)
@@ -699,41 +789,90 @@ def main():
             log(f"probe: disabling Pallas kernels for the ladder: {disabled}")
     else:
         log("probe: TPU backend did not come up — skipping TPU ladder")
+    env_extra = ({"PADDLE_TPU_DISABLE_PALLAS": ",".join(disabled)}
+                 if disabled else None)
 
-    # phase 1: TPU ladder (best banked rung wins)
+    def headline_of(rungs: list[dict], mode: list[str]):
+        """Pick a mode's headline: train ladder = best MFU; --moe = deepest
+        MoE rung (a banked DiT rung must not shadow it); else deepest rung."""
+        if not rungs:
+            return None
+        if not mode:
+            return max(rungs, key=lambda r: r.get("vs_baseline", 0))
+        if mode == ["--moe"]:
+            return next((r for r in reversed(rungs)
+                         if r["metric"].startswith("moe")), rungs[-1])
+        return rungs[-1]
+
+    def emit_aggregate(result: dict, cross: dict) -> None:
+        # re-emit the full aggregate after every phase: the driver parses the
+        # LAST complete JSON line, so a kill mid-phase still leaves a whole
+        # result from the phases that finished
+        result.setdefault("detail", {})["probe"] = probe_summary
+        if cross:
+            result["detail"]["cross_mode"] = cross
+        print(json.dumps(result))
+        sys.stdout.flush()
+
     result = None
-    if tpu_up:
-        env_extra = ({"PADDLE_TPU_DISABLE_PALLAS": ",".join(disabled)}
-                     if disabled else None)
-        rungs = _run_worker(decode, TPU_TIMEOUT, env_extra)
+    cross: dict = {}
+
+    # phase 1: TPU ladder for the requested (or default train) mode
+    if tpu_up and budget_left() > 60:
+        rungs = _run_worker(decode, min(TPU_TIMEOUT, int(budget_left())), env_extra)
         rungs = [r for r in rungs if not r["metric"].startswith("probe_")]
-        if rungs:
-            # headline: train ladder = best MFU; --moe = deepest MoE rung
-            # (the mode's reason to exist — a banked DiT rung must not
-            # shadow it); other modes = deepest banked rung
-            if not decode:
-                result = max(rungs, key=lambda r: r.get("vs_baseline", 0))
-            elif decode == ["--moe"]:
-                result = next((r for r in reversed(rungs)
-                               if r["metric"].startswith("moe")), rungs[-1])
-            else:
-                result = rungs[-1]
+        _bank_to_cache(rungs)
+        result = headline_of(rungs, decode)
+        if result is not None:
             result.setdefault("detail", {})["rungs_banked"] = len(rungs)
-            result.setdefault("detail", {})["all_rungs"] = [
+            result["detail"]["all_rungs"] = [
                 {"rung": r.get("detail", {}).get("rung"), "value": r["value"],
                  "unit": r["unit"]} for r in rungs]
+            emit_aggregate(result, cross)
 
-    # phase 2: CPU fallback
+    # phase 1b (bare invocation only): compact cross-mode rungs, so one
+    # driver artifact certifies decode + MoE + vision alongside train MFU.
+    # Runs even when the train ladder banked nothing — a broken train step
+    # must not cost the round its decode/MoE/vision hardware evidence.
+    if tpu_up and cross_mode:
+        for mode_flag, label in (("--decode", "decode"), ("--moe", "moe"),
+                                 ("--vision", "vision")):
+            if budget_left() < 120:
+                log(f"cross-mode {label}: skipped (budget exhausted)")
+                cross[label] = {"skipped": "budget"}
+                continue
+            mrungs = _run_worker([mode_flag, "--compact"],
+                                 min(MODE_TIMEOUT, int(budget_left())), env_extra)
+            mrungs = [r for r in mrungs if not r["metric"].startswith("probe_")]
+            _bank_to_cache(mrungs)
+            head = headline_of(mrungs, [mode_flag])
+            cross[label] = ({"metric": head["metric"], "value": head["value"],
+                             "unit": head["unit"], "detail": head.get("detail", {})}
+                            if head else {"error": "no rung banked"})
+            if result is not None:
+                emit_aggregate(result, cross)
+
+    # phase 2: CPU fallback — with the last-healthy TPU measurement from the
+    # committed cache as the headline when one exists (explicitly marked as
+    # cached + timestamped; the live CPU smoke is attached as proof-of-life)
     if result is None:
         log("no TPU result; falling back to CPU smoke run")
-        rungs = _run_worker(decode + ["--cpu"], CPU_TIMEOUT)
+        rungs = _run_worker(decode + ["--cpu"], min(CPU_TIMEOUT, max(60, int(budget_left()))))
         rungs = [r for r in rungs if not r["metric"].startswith("probe_")]
-        if rungs:
-            if decode == ["--moe"]:  # same headline rule as the TPU phase
-                result = next((r for r in reversed(rungs)
-                               if r["metric"].startswith("moe")), rungs[-1])
-            else:
-                result = rungs[-1]
+        cpu_head = headline_of(rungs, decode)
+        cached = None if decode else _best_cached_train(_load_cache())
+        if cached is not None:
+            result = dict(cached)
+            result.pop("measured_at", None)
+            result["detail"] = dict(cached.get("detail", {}))
+            result["detail"]["source"] = "last_healthy_tpu_cache"
+            result["detail"]["measured_at"] = cached.get("measured_at")
+            result["detail"]["live_cpu_smoke"] = (
+                {"value": cpu_head["value"], "unit": cpu_head["unit"]}
+                if cpu_head else {"error": "cpu smoke failed too"})
+            log(f"using cached TPU rung from {cached.get('measured_at')} as headline")
+        else:
+            result = cpu_head
 
     if result is None:
         result = {
@@ -743,9 +882,7 @@ def main():
             "vs_baseline": 0.0,
             "detail": {"error": "all bench workers failed or timed out"},
         }
-    result.setdefault("detail", {})["probe"] = probe_summary
-    print(json.dumps(result))
-    sys.stdout.flush()
+    emit_aggregate(result, cross)
 
 
 if __name__ == "__main__":
