@@ -246,7 +246,6 @@ class ContinuousBatchingEngine:
         toks_np = np.asarray(toks)  # [k, B] — ONE host round-trip per chunk
         self.stats["decode_time_s"] += time.perf_counter() - t0
         self.stats["decode_steps"] += k
-        self.stats["decode_tokens"] += k * int(active_np.sum())
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
@@ -259,6 +258,10 @@ class ContinuousBatchingEngine:
             for j in range(valid):
                 tok = int(toks_np[j, slot])
                 req.output_ids.append(tok)
+                # count only tokens a caller actually receives: chunk steps
+                # past EOS / the token budget / max_seq are trimmed here, so
+                # they must not inflate decode_tokens_per_s (the headline)
+                self.stats["decode_tokens"] += 1
                 if (len(req.output_ids) >= req.max_new_tokens
                         or (req.eos_token_id is not None
                             and tok == req.eos_token_id)):

@@ -19,6 +19,8 @@ compare), not merely structural.
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -411,6 +413,16 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
     examples = []
     for spec in input_spec:
         if isinstance(spec, InputSpec):
+            if any(d is None or int(d) < 0 for d in spec.shape):
+                # the exporter traces at concrete shapes and bakes every
+                # Reshape target as a constant, so the emitted model only
+                # works at the example shape — a silent pin-to-1 would break
+                # at other batch sizes with no hint why
+                warnings.warn(
+                    f"ONNX export is fixed-shape: dynamic dims in "
+                    f"InputSpec {spec.shape} are pinned to 1 and the "
+                    f"exported model only accepts that exact shape",
+                    stacklevel=2)
             shape = [1 if (d is None or int(d) < 0) else int(d) for d in spec.shape]
             examples.append(jnp.zeros(shape, spec.dtype))
         else:

@@ -195,7 +195,17 @@ class OnnxModel:
         if op == "Add": return x[0] + x[1]
         if op == "Sub": return x[0] - x[1]
         if op == "Mul": return x[0] * x[1]
-        if op == "Div": return x[0] / x[1]
+        if op == "Div":
+            a0, a1 = np.asarray(x[0]), np.asarray(x[1])
+            if np.issubdtype(a0.dtype, np.integer) and \
+                    np.issubdtype(a1.dtype, np.integer):
+                # ONNX Div on ints truncates toward zero (C semantics, like
+                # lax.div).  Pure-integer formulation: float round-tripping
+                # would lose exactness past 2**53 for int64
+                q = np.abs(a0) // np.abs(a1)
+                return (np.where(np.sign(a0) * np.sign(a1) < 0, -q, q)
+                        .astype(np.result_type(a0, a1)))
+            return x[0] / x[1]
         if op == "Max": return np.maximum(x[0], x[1])
         if op == "Min": return np.minimum(x[0], x[1])
         if op == "Pow": return np.power(x[0], x[1])

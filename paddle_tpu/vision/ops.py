@@ -131,6 +131,13 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             gh = jnp.full((R,), float(GH))
             gw = jnp.full((R,), float(GW))
         else:
+            # adaptive sampling (sampling_ratio<=0): the reference uses
+            # ceil(roi_h/ph) points per bin, which is data-dependent; a
+            # jittable static bound is needed, and ceil(H/ph) covers every
+            # ROI that fits the feature map.  An ROI LARGER than the map
+            # (bin_h > H/ph) gets its grid clamped to this bound and uses
+            # fewer samples than the reference — numerics diverge only for
+            # such oversized boxes.
             GH = max(1, math.ceil(H / ph))
             GW = max(1, math.ceil(W / pw))
             gh = jnp.clip(jnp.ceil(bin_h), 1.0, GH)
@@ -545,8 +552,9 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
               ignore_thresh, downsample_ratio, gt_score=None,
               use_label_smooth=True, name=None, scale_x_y=1.0):
     """YOLOv3 loss — reference python/paddle/vision/ops.py:69; semantics from
-    phi/kernels/cpu/yolo_loss_kernel.cc (vectorized: the per-gt scatter uses
-    ``.at[].set(mode="drop")`` instead of the reference's serial writes).
+    phi/kernels/cpu/yolo_loss_kernel.cc (vectorized except the per-gt
+    objectness scatter, which runs one gt per step so duplicate (anchor,
+    cell) matches resolve last-gt-wins like the reference's serial writes).
 
     Returns per-sample loss [N].
     """
@@ -629,10 +637,21 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         # scatter gt scores into the objectness map; invalid/masked-out gts
         # are routed to row `mask_num`, which is out of bounds so mode="drop"
         # discards them (-1 would WRAP, not drop — negative indices are
-        # normalized before the oob mode applies)
+        # normalized before the oob mode applies).  Scattering one gt per
+        # step keeps within-step indices unique (distinct n), so when two
+        # gts of one image land on the same (anchor, cell) the LAST gt wins
+        # deterministically — XLA leaves duplicate-index set order
+        # unspecified, while the reference's serial kernel overwrites in gt
+        # order (yolo_loss_kernel.cc gt loop).
         drop_m = jnp.where(pos, mask_idx, mask_num)
-        obj = obj.at[nn_idx, drop_m, gj, gi].set(
-            jnp.where(pos, score, 0.0), mode="drop")
+        n_arr = jnp.arange(N)
+        gt_val = jnp.where(pos, score, 0.0)
+
+        def scatter_gt(b, o):
+            return o.at[n_arr, drop_m[:, b], gj[:, b], gi[:, b]].set(
+                gt_val[:, b], mode="drop")
+
+        obj = jax.lax.fori_loop(0, B, scatter_gt, obj) if B else obj
 
         ologit = xr[:, :, 4]
         pos_l = _sigmoid_ce(ologit, 1.0) * obj

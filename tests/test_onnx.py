@@ -184,3 +184,28 @@ def test_conv1d_and_batch_groups_are_loud(tmp_path):
         export(fnbg, str(tmp_path / "c2"),
                input_spec=[rs.rand(2, 2, 4, 4).astype(np.float32),
                            rs.rand(2, 2, 1, 1).astype(np.float32)])
+
+
+def test_integer_div_truncates_toward_zero(tmp_path):
+    """ONNX Div on ints is C-style truncation (matching lax.div) — numpy's
+    true division would emit floats and floor-like results for negatives."""
+    import jax
+
+    def fn(x, y):
+        return jax.lax.div(x, y)
+
+    x = np.array([7, -7, 9, -9], np.int32)
+    y = np.array([2, 2, -4, -4], np.int32)
+    model = _roundtrip(fn, [x, y], tmp_path)
+    got = model.run(x, y)[0]
+    assert got.dtype == np.int32
+    np.testing.assert_array_equal(got, [3, -3, -2, 2])
+
+
+def test_dynamic_input_spec_warns(tmp_path):
+    def fn(x):
+        return x * 2.0
+
+    with pytest.warns(UserWarning, match="fixed-shape"):
+        export(fn, str(tmp_path / "dyn"),
+               input_spec=[InputSpec([None, 3], "float32")])

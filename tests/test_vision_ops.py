@@ -606,3 +606,41 @@ def test_roi_ops_jittable():
         np.asarray(a),
         V.roi_align(np.asarray(x), np.asarray(boxes), np.asarray(bn), 2,
                     sampling_ratio=2).numpy(), rtol=1e-5)
+
+
+def test_yolo_loss_duplicate_gt_last_write_wins():
+    """Two gts matching the same (anchor, cell) must resolve like the
+    reference's serial kernel: the LAST gt's score owns the objectness
+    target.  Identical boxes make every other loss term order-symmetric, so
+    loss[AB] - loss[BA] == sce(obj_logit, 1) * (sB - sA) exactly."""
+    anchors = [10, 13, 16, 30]
+    mask = [0, 1]
+    cls = 3
+    H = 4
+    x = (rs.randn(1, 2 * (5 + cls), H, H) * 0.3).astype(np.float32)
+    box = np.array([0.4, 0.6, 0.3, 0.2], np.float32)  # one cell, one anchor
+    gt_ab = np.stack([box, box])[None]  # [1, 2, 4], identical boxes
+    lbl = np.array([[1, 1]], np.int64)
+    s_a, s_b = 0.3, 0.9
+    loss_ab = V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt_ab),
+                          paddle.to_tensor(lbl), anchors, mask, cls, 0.7, 32,
+                          gt_score=paddle.to_tensor(np.array([[s_a, s_b]], np.float32)),
+                          use_label_smooth=False).numpy()
+    loss_ba = V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt_ab),
+                          paddle.to_tensor(lbl), anchors, mask, cls, 0.7, 32,
+                          gt_score=paddle.to_tensor(np.array([[s_b, s_a]], np.float32)),
+                          use_label_smooth=False).numpy()
+    # locate the matched cell/anchor like the kernel does
+    gi, gj = int(box[0] * H), int(box[1] * H)
+    input_size = 32 * H
+    ious = []
+    for a in range(2):
+        an_w, an_h = anchors[2 * a] / input_size, anchors[2 * a + 1] / input_size
+        inter = min(an_w, box[2]) * min(an_h, box[3])
+        ious.append(inter / (an_w * an_h + box[2] * box[3] - inter))
+    mi = int(np.argmax(ious))
+    xr = x.reshape(1, 2, 5 + cls, H, H)
+    o = xr[0, mi, 4, gj, gi]
+    sce = max(o, 0.0) - o * 1.0 + math.log1p(math.exp(-abs(o)))
+    np.testing.assert_allclose(loss_ab - loss_ba, sce * (s_b - s_a),
+                               rtol=1e-4, atol=1e-5)
