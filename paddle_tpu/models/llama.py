@@ -104,15 +104,25 @@ def init_params(cfg: LlamaConfig, key=None) -> dict:
     return params
 
 
-def param_specs(cfg: LlamaConfig, pp: bool = False) -> dict:
+def param_specs(cfg: LlamaConfig, pp: bool = False, mp: int = 1) -> dict:
     """PartitionSpecs = the Megatron TP sharding map of the reference's mp_layers
     (ColumnParallelLinear splits output dim over 'mp', RowParallelLinear splits
     input dim; VocabParallelEmbedding splits vocab), plus ZeRO over 'sharding'
     on the other dim (fleet sharding stage 3 analog).  With ``pp`` the stacked
     layer dim is sharded over the 'pp' mesh axis — each device holds one
     pipeline stage's contiguous layer slice (the PipelineLayer segmentation of
-    pp_layers.py:258, realized as a sharding)."""
+    pp_layers.py:258, realized as a sharding).
+
+    GQA under TP: when ``mp`` exceeds ``num_key_value_heads``, K/V projections
+    are REPLICATED over 'mp' instead of column-sharded — a sub-head split
+    makes the SPMD partitioner replicate-then-repartition every layer
+    ("involuntary full rematerialization", wasted ICI bandwidth).  The
+    reference's mp_layers duplicate KV heads in exactly this regime
+    (fleet/layers/mpu/mp_layers.py:49,336)."""
     layer_dim = "pp" if pp else None
+    # replicate unless mp divides the kv heads evenly (mp > kv_heads is the
+    # common case, but any non-dividing mp sub-head-splits too)
+    kv_col = None if cfg.num_key_value_heads % mp != 0 else "mp"
     return {
         "embed": P("mp", "sharding"),          # vocab-parallel embedding
         "final_norm": P(None),
@@ -120,8 +130,8 @@ def param_specs(cfg: LlamaConfig, pp: bool = False) -> dict:
             "input_norm": P(layer_dim, None),
             "post_norm": P(layer_dim, None),
             "wq": P(layer_dim, "sharding", "mp"),   # column parallel
-            "wk": P(layer_dim, "sharding", "mp"),
-            "wv": P(layer_dim, "sharding", "mp"),
+            "wk": P(layer_dim, "sharding", kv_col),
+            "wv": P(layer_dim, "sharding", kv_col),
             "wo": P(layer_dim, "mp", "sharding"),   # row parallel
             "w_gate": P(layer_dim, "sharding", "mp"),
             "w_up": P(layer_dim, "sharding", "mp"),
@@ -389,7 +399,7 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
     # pp>1 binds sep inside its own manual region (forward_pp); otherwise wrap
     # attention in its own sep shard_map
     attn_fn = sep_attention(mesh, "sep", sep_attn_impl) if sep > 1 and pp == 1 else None
-    specs = param_specs(cfg, pp=pp > 1)
+    specs = param_specs(cfg, pp=pp > 1, mp=dict(mesh.shape).get("mp", 1))
     data_spec = P(("dp", "sharding"), "sep")
 
     def to_named(tree_specs):

@@ -101,9 +101,12 @@ def init_params(cfg: MoEConfig, key=None) -> dict:
     }
 
 
-def param_specs(cfg: MoEConfig) -> dict:
+def param_specs(cfg: MoEConfig, mp: int = 1) -> dict:
     """Experts shard over 'mp' (expert parallelism); attention is Megatron-TP
-    over the same axis; ZeRO over 'sharding' like models/llama.py."""
+    over the same axis; ZeRO over 'sharding' like models/llama.py.  K/V
+    projections replicate over 'mp' when it exceeds num_key_value_heads
+    (sub-head splits trigger involuntary remat — see llama.param_specs)."""
+    kv_col = None if cfg.num_key_value_heads % mp != 0 else "mp"
     return {
         "embed": P("mp", "sharding"),
         "final_norm": P(None),
@@ -112,8 +115,8 @@ def param_specs(cfg: MoEConfig) -> dict:
             "input_norm": P(None, None),
             "post_norm": P(None, None),
             "wq": P(None, "sharding", "mp"),
-            "wk": P(None, "sharding", "mp"),
-            "wv": P(None, "sharding", "mp"),
+            "wk": P(None, "sharding", kv_col),
+            "wv": P(None, "sharding", kv_col),
             "wo": P(None, "mp", "sharding"),
             "s_gate": P(None, "sharding", "mp"),
             "s_up": P(None, "sharding", "mp"),
@@ -243,7 +246,7 @@ def build_train_step(cfg: MoEConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
                      beta1=0.9, beta2=0.95, grad_clip=1.0):
     """Same optimizer/sharding scaffold as models/llama.build_train_step, with
     the MoE loss (ce + aux + z)."""
-    specs = param_specs(cfg)
+    specs = param_specs(cfg, mp=dict(mesh.shape).get("mp", 1))
     data_spec = P(("dp", "sharding"), "sep")
 
     def to_named(tree_specs):
