@@ -177,7 +177,9 @@ def gpipe_stacked(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
 def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
                         embed_params, stacked_params, head_params,
                         micro_inputs, micro_labels, mesh, axis_name="pp",
-                        extra_args=(), boundary_f32=None):
+                        extra_args=(), boundary_f32=None,
+                        batch_axes=(), zero_axis=None,
+                        embed_specs=None, stacked_specs=None, head_specs=None):
     """Executed 1F1B pipeline schedule as ONE compiled SPMD program (the
     reference's PipelineParallel.forward_backward_pipeline, pipeline_parallel
     .py:684, re-thought for a TPU mesh — not simulated, not AD-through-scan).
@@ -220,10 +222,29 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
       boundary_f32: cast ppermute payloads to f32 (default: only when the
         mesh's devices are CPU, where XLA's collective handling of bf16 is
         unreliable; TPU keeps native dtypes — half the ICI bytes).
+      batch_axes: extra mesh axes to bind MANUALLY in the same shard_map,
+        over which the microbatch batch dim is sharded (e.g.
+        ``("dp", "sharding")``).  Binding them manually is what makes the
+        pp×dp×sharding factorization compile: a batch dim tuple-sharded over
+        two GSPMD-auto axes entering a partial-manual region CHECK-fails the
+        XLA partitioner's device grouping (spmd_partitioner_util.cc:495 —
+        the round-3 north-star blocker).  'mp' (and any other axis) stays
+        auto.
+      zero_axis: the ZeRO param-sharding axis among ``batch_axes``.  Param
+        leaves whose spec mentions it are stored sharded and all-gathered
+        (tiled) just before use — the vjp's transpose (psum_scatter) then
+        reduce-scatters their grads over the axis, i.e. exactly the ZeRO
+        grad flow, matching the reference's sharding-stage semantics
+        (dygraph_sharding_optimizer + pipeline hybrid).
+      embed_specs / stacked_specs / head_specs: full PartitionSpec trees for
+        the three param groups (only consulted when batch_axes is set; their
+        non-manual axis entries are dropped for the shard_map specs).
 
     Returns ``(mean_loss, (d_embed, d_stacked, d_head))`` — grads in f32;
     ``d_stacked`` stays sharded over ``axis_name``, embed/head grads are
-    replicated (psum over pp).
+    replicated over pp (psum); with ``batch_axes``, grads are additionally
+    summed over the batch axes (psum, or reduce-scatter via the zero-axis
+    gather transpose) and scaled so the loss is the global batch mean.
     """
     P_ = mesh.shape[axis_name]
     assert P_ > 1, "one_f_one_b_stacked requires pp > 1"
@@ -235,8 +256,109 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
     if boundary_f32 is None:
         boundary_f32 = mesh.devices.flat[0].platform == "cpu"
 
+    manual = {axis_name, *batch_axes}
+    K_batch = 1
+    for a in batch_axes:
+        K_batch *= mesh.shape[a]
+    assert zero_axis is None or zero_axis in batch_axes, zero_axis
+
+    def _entries(e):
+        return tuple(e) if isinstance(e, (tuple, list)) else (e,)
+
+    # params may be sharded over the ZeRO axis (gathered before use) but not
+    # over any other batch axis — such a leaf would enter the region as an
+    # ungathered shard and mis-reduce; fail fast instead
+    for tree in (embed_specs, stacked_specs, head_specs):
+        if tree is None or not batch_axes:
+            continue
+        for sp in jax.tree_util.tree_leaves(
+                tree, is_leaf=lambda s: s is None or isinstance(s, P)):
+            for e in (sp or ()):
+                bad = [a for a in _entries(e)
+                       if a in batch_axes and a != zero_axis]
+                assert not bad, (
+                    f"param spec {sp} shards over batch axis {bad}; only the "
+                    f"zero_axis ({zero_axis}) may shard params")
+
+    def _proj(spec):
+        """Project a full PartitionSpec onto the manual axes (auto axes are
+        GSPMD's business and must not appear in shard_map specs)."""
+        if spec is None:
+            return P()
+        out = []
+        for e in spec:
+            kept = tuple(a for a in _entries(e) if a in manual)
+            out.append(kept[0] if len(kept) == 1 else (kept or None))
+        return P(*out)
+
+    def _proj_tree(params, specs, default):
+        if not batch_axes or specs is None:
+            return jax.tree_util.tree_map(default, params)
+        return jax.tree_util.tree_map(_proj, specs,
+                                      is_leaf=lambda s: s is None or isinstance(s, P))
+
+    def _gather_tree(tree, specs):
+        """All-gather zero-axis-sharded leaves to full size before use; the
+        vjp transpose (psum_scatter) reduce-scatters their grads back.  On
+        CPU meshes the collective runs in f32 (same bf16-collective XLA
+        weakness the ppermute boundary works around)."""
+        if zero_axis is None or specs is None:
+            return tree
+
+        def g(w, sp):
+            if sp is None:
+                return w
+            dims = [dim for dim, e in enumerate(sp) if zero_axis in _entries(e)]
+            if not dims:
+                return w
+            dt = w.dtype
+            if boundary_f32 and jnp.issubdtype(dt, jnp.floating):
+                w = w.astype(jnp.float32)
+            for dim in dims:
+                w = jax.lax.all_gather(w, zero_axis, axis=dim, tiled=True)
+            return w.astype(dt)
+
+        return jax.tree_util.tree_map(
+            g, tree, specs, is_leaf=lambda s: s is None or isinstance(s, P))
+
+    def _reduce_tree(tree, specs, with_pp):
+        """psum each grad leaf over the batch axes its spec does NOT shard
+        (zero-axis-sharded dims were already reduce-scattered by the gather
+        transpose), plus pp for the stage-owned embed/head params."""
+        def axes_of(sp):
+            named = set()
+            if sp is not None:
+                for e in sp:
+                    named |= {a for a in _entries(e) if a is not None}
+            extra = tuple(a for a in batch_axes if a not in named)
+            return (axis_name, *extra) if with_pp else extra
+
+        def r(g, sp):
+            ax = axes_of(sp)
+            return jax.lax.psum(g, ax) if ax else g
+
+        if specs is None:
+            specs = jax.tree_util.tree_map(lambda _: None, tree)
+        return jax.tree_util.tree_map(
+            r, tree, specs, is_leaf=lambda s: s is None or isinstance(s, P))
+
+    # local activation shape: the batch dim (dim 0 of the embed output) is
+    # split over the manual batch axes inside the region
     act_aval = jax.eval_shape(embed_fn, embed_params, micro_inputs[0], *extra_args)
-    act_shape, act_dtype = act_aval.shape, act_aval.dtype
+    assert act_aval.shape[0] % K_batch == 0, (
+        f"microbatch {act_aval.shape[0]} not divisible by batch axes {batch_axes}"
+        f" product {K_batch}")
+    act_shape = (act_aval.shape[0] // K_batch,) + act_aval.shape[1:]
+    act_dtype = act_aval.dtype
+
+    if batch_axes:
+        _embed_fn, _stage_fn, _head_loss_fn = embed_fn, stage_fn, head_loss_fn
+        embed_fn = lambda ep, ids, *ex: _embed_fn(
+            _gather_tree(ep, embed_specs), ids, *ex)
+        stage_fn = lambda sp, x, *ex: _stage_fn(
+            _gather_tree(sp, stacked_specs), x, *ex)
+        head_loss_fn = lambda hp, y, lbl, *ex: _head_loss_fn(
+            _gather_tree(hp, head_specs), y, lbl, *ex)
 
     def _permute(x, perm):
         if boundary_f32 and jnp.issubdtype(x.dtype, jnp.floating):
@@ -353,21 +475,39 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
             tick, carry0, jnp.arange(M + 2 * (P_ - 1)))
         # loss lives on the last stage, embed/head grads on their owning
         # stages: scalar + shared-param psums (cheap; the per-stage grads —
-        # the big ones — never cross stage boundaries)
-        loss = jax.lax.psum(loss_acc, axis_name)
-        dep = jax.lax.psum(dep, axis_name)
-        dhp = jax.lax.psum(dhp, axis_name)
+        # the big ones — never cross stage boundaries).  With batch axes
+        # bound manually, each device saw 1/K_batch of every microbatch:
+        # grads sum over the axes their leaf is not sharded on, and
+        # everything scales by 1/K_batch to make the loss the global mean.
+        loss = jax.lax.psum(loss_acc, (axis_name, *batch_axes))
+        dep = _reduce_tree(dep, embed_specs if batch_axes else None, with_pp=True)
+        dhp = _reduce_tree(dhp, head_specs if batch_axes else None, with_pp=True)
+        if batch_axes:
+            dsp = _reduce_tree(dsp, stacked_specs, with_pp=False)
+        if K_batch > 1:
+            inv_k = 1.0 / K_batch
+            sc = lambda t: jax.tree_util.tree_map(lambda g: g * inv_k, t)
+            loss, dep, dsp, dhp = loss * inv_k, sc(dep), sc(dsp), sc(dhp)
         return loss, dep, dsp, dhp
 
     pp_leading = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     rep = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+    if batch_axes:
+        embed_in = _proj_tree(embed_params, embed_specs, lambda _: P())
+        stacked_in = _proj_tree(stacked_params, stacked_specs,
+                                lambda _: P(axis_name))
+        head_in = _proj_tree(head_params, head_specs, lambda _: P())
+        data_in = P(None, tuple(batch_axes))
+    else:
+        embed_in, stacked_in, head_in = rep(embed_params), pp_leading, rep(head_params)
+        data_in = P()
     loss, dep, dsp, dhp = jax.shard_map(
         inner,
         mesh=mesh,
-        in_specs=(rep(embed_params), pp_leading, rep(head_params), P(), P())
+        in_specs=(embed_in, stacked_in, head_in, data_in, data_in)
         + tuple(P() for _ in extra_args),
-        out_specs=(P(), rep(embed_params), pp_leading, rep(head_params)),
-        axis_names={axis_name},
+        out_specs=(P(), embed_in, stacked_in, head_in),
+        axis_names={axis_name, *batch_axes},
         check_vma=False,
     )(embed_params, stacked_params, head_params, micro_inputs, micro_labels,
       *extra_args)

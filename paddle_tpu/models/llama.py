@@ -338,10 +338,27 @@ def loss_and_grads_1f1b(cfg: LlamaConfig, params, input_ids, labels, mesh,
     head_params["embed" if tied else "lm_head"] = (
         params["embed"] if tied else params["lm_head"])
 
+    # bind dp+sharding manually alongside pp when either is nontrivial: the
+    # batch dim tuple-sharded over two auto axes CHECK-fails the partitioner
+    # (the round-3 north-star blocker) — and manual ZeRO gathers make the
+    # sharding-axis flow explicit (see one_f_one_b_stacked docstring)
+    mesh_axes = dict(mesh.shape)
+    batch_axes = tuple(a for a in ("dp", "sharding") if mesh_axes.get(a, 1) > 1)
+    pipe_kw = {}
+    if batch_axes:
+        specs = param_specs(cfg, pp=True, mp=mesh_axes.get("mp", 1))
+        head_specs = {"final_norm": specs["final_norm"]}
+        head_specs["embed" if tied else "lm_head"] = (
+            specs["embed"] if tied else specs["lm_head"])
+        pipe_kw = dict(batch_axes=batch_axes,
+                       zero_axis="sharding" if "sharding" in batch_axes else None,
+                       embed_specs=specs["embed"],
+                       stacked_specs=specs["layers"], head_specs=head_specs)
+
     loss, (dep, dsp, dhp) = one_f_one_b_stacked(
         embed_fn, stage_fn, head_loss_fn,
         params["embed"], params["layers"], head_params,
-        ids_m, lbl_m, mesh, axis_name="pp", extra_args=(cos, sin))
+        ids_m, lbl_m, mesh, axis_name="pp", extra_args=(cos, sin), **pipe_kw)
 
     grads = {"final_norm": dhp["final_norm"], "layers": dsp}
     grads["embed"] = dep + dhp["embed"] if tied else dep
@@ -419,17 +436,13 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
             "master": jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params),
         }
 
-    # the executed-1F1B runner binds only 'pp' manually; a sep axis needs the
-    # gpipe region (which binds sep in the same shard_map) — see forward_pp.
-    # KNOWN LIMIT (bisected r3): when the batch dim is tuple-sharded over TWO
-    # nontrivial auto axes (dp>1 AND sharding>1) the XLA SPMD partitioner
-    # CHECK-fails grouping devices inside the partial-manual region
-    # (spmd_partitioner_util.cc:495); dp×pp, sharding×pp, dp×pp×mp and
-    # pp×sharding×mp all work.  Fall back to gpipe for that combination.
-    dp_deg = dict(mesh.shape).get("dp", 1)
-    shard_deg = dict(mesh.shape).get("sharding", 1)
-    use_1f1b = (pp > 1 and sep == 1 and pipeline_schedule == "1f1b"
-                and not (dp_deg > 1 and shard_deg > 1))
+    # the executed-1F1B runner binds 'pp' plus any nontrivial dp/sharding
+    # axes manually (loss_and_grads_1f1b) — the round-3 dp×sharding×pp
+    # partitioner CHECK-fail is gone because the batch dim is never
+    # tuple-sharded over auto axes inside the region.  A sep axis still
+    # needs the gpipe region (which binds sep in the same shard_map) — see
+    # forward_pp.
+    use_1f1b = pp > 1 and sep == 1 and pipeline_schedule == "1f1b"
 
     def train_step(params, opt_state, input_ids, labels):
         if use_1f1b:

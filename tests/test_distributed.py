@@ -675,3 +675,60 @@ def test_segment_parallel_wrapper(eight_devices):
     q = jnp.asarray(rng.rand(2, 8, 4, 8).astype(np.float32))
     got = attn(q, q, q)
     assert got.shape == q.shape
+
+
+def test_llama_1f1b_dp_sharding_pp_parity(eight_devices):
+    """dp2×sharding2×pp2 — the north-star 8B-recipe factorization — runs the
+    EXECUTED 1F1B schedule (round-3 verdict #2: this combination used to
+    CHECK-fail the XLA partitioner and silently fall back to GPipe).  Loss
+    must match the single-device full-batch reference; sharded param grads
+    must match the reference's corresponding shards."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=4, heads=4,
+                                 kv_heads=2, inter=64)
+    mesh = llama.make_mesh(dp=2, sharding=2, pp=2)
+    specs = llama.param_specs(cfg, pp=True)
+    psh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
+                                 is_leaf=lambda s: isinstance(s, P))
+    params = jax.device_put(llama.init_params(cfg, jax.random.key(0)), psh)
+    dsh = NamedSharding(mesh, P(("dp", "sharding"), None))
+    ids = jax.device_put(jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16))), dsh)
+    labels = jax.device_put(jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16))), dsh)
+
+    loss, grads = jax.jit(lambda p, i, y: llama.loss_and_grads_1f1b(
+        cfg, p, i, y, mesh, num_microbatches=2))(params, ids, labels)
+
+    host_p = jax.device_get(params)
+    rl, rg = jax.value_and_grad(lambda p: llama.loss_fn(
+        cfg, p, jax.device_get(ids), jax.device_get(labels)))(host_p)
+    np.testing.assert_allclose(float(loss), float(rl), rtol=1e-4)
+    flat, _ = jax.tree_util.tree_flatten_with_path(grads)
+    rflat = dict(jax.tree_util.tree_flatten_with_path(rg)[0])
+    for path, g in flat:
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(g), np.float32),
+            np.asarray(rflat[path], np.float32),
+            rtol=5e-2, atol=2e-3, err_msg=str(path))
+
+
+def test_build_train_step_uses_1f1b_under_dp_sharding(eight_devices):
+    """build_train_step no longer falls back to GPipe for dp×sharding×pp:
+    one optimizer step on that mesh runs end-to-end and moves the loss."""
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=4, heads=4,
+                                 kv_heads=2, inter=64)
+    mesh = llama.make_mesh(dp=2, sharding=2, pp=2)
+    step, oinit, pshard, dshard = llama.build_train_step(
+        cfg, mesh, num_microbatches=2, pipeline_schedule="1f1b")
+    p = jax.device_put(llama.init_params(cfg, jax.random.key(0)), pshard)
+    o = oinit(p)
+    ids = jax.device_put(jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16))), dshard)
+    labels = jax.device_put(jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16))), dshard)
+    l0, p, o = step(p, o, ids, labels)
+    for _ in range(4):
+        l, p, o = step(p, o, ids, labels)
+    assert np.isfinite(float(l0)) and float(l) < float(l0)
