@@ -179,7 +179,8 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
                         micro_inputs, micro_labels, mesh, axis_name="pp",
                         extra_args=(), boundary_f32=None,
                         batch_axes=(), zero_axis=None,
-                        embed_specs=None, stacked_specs=None, head_specs=None):
+                        embed_specs=None, stacked_specs=None, head_specs=None,
+                        num_chunks=1):
     """Executed 1F1B pipeline schedule as ONE compiled SPMD program (the
     reference's PipelineParallel.forward_backward_pipeline, pipeline_parallel
     .py:684, re-thought for a TPU mesh — not simulated, not AD-through-scan).
@@ -239,6 +240,18 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
       embed_specs / stacked_specs / head_specs: full PartitionSpec trees for
         the three param groups (only consulted when batch_axes is set; their
         non-manual axis entries are dropped for the shard_map specs).
+      num_chunks: C > 1 executes the INTERLEAVED/virtual-pipeline 1F1B
+        schedule (the reference's PipelineParallelWithInterleave,
+        pipeline_parallel.py:1308; tick order = :func:`schedule_interleave`):
+        each stage owns C model chunks, ``stage_fn`` gains a chunk-index
+        argument, and ``stacked_params``' leading dim must be ordered
+        stage-major (row = s·(C·L/V) + c·L/V + offset for virtual stage
+        v = c·P + s) so the pp shard of stage s holds exactly its C chunks.
+        The grouped round-robin microbatch order makes every cross-chunk
+        wraparound activation (stage P-1 → 0 forward, 0 → P-1 backward)
+        arrive exactly one ppermute hop before its consumer tick, so the
+        same per-tick ring design executes VPP with zero extra latency.
+        Requires ``M % P == 0`` (the reference's constraint) and C | L/P.
 
     Returns ``(mean_loss, (d_embed, d_stacked, d_head))`` — grads in f32;
     ``d_stacked`` stays sharded over ``axis_name``, embed/head grads are
@@ -250,11 +263,40 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
     assert P_ > 1, "one_f_one_b_stacked requires pp > 1"
     M = micro_inputs.shape[0]
     M_f = float(M)
-    R = 2 * (P_ - 1) + 1  # max in-flight microbatches per stage (stage 0)
-    fwd_perm = [(p, p + 1) for p in range(P_ - 1)]
-    bwd_perm = [(p, p - 1) for p in range(1, P_)]
+    C = num_chunks
+    assert C >= 1
+    assert C == 1 or M % P_ == 0, (
+        f"interleaved schedule requires microbatches ({M}) % pp ({P_}) == 0")
+    total_f = M * C                      # F (and B) sub-ticks per stage
+    D = 2 * (P_ - 1) + (C - 1) * P_     # B-stream clock offset
+    # ring: one save per tick, entry (m,c) at stage s lives from tick
+    # s+idx_f(m,c) to D-2s+idx_f(m,C-1-c); max span (s=0,c=0) is
+    # D+(C-1)P, so span+1 slots never clobber a live entry
+    R = D + (C - 1) * P_ + 1
+    if C > 1:
+        # full rings: the wraparound edges carry the cross-chunk handoffs
+        fwd_perm = [(p, (p + 1) % P_) for p in range(P_)]
+        bwd_perm = [(p, (p - 1) % P_) for p in range(P_)]
+    else:
+        # open chains: with one chunk the wraparound value is never read
+        # (stage 0 embeds, stage P-1 fuses F+B) — don't pay the transfer
+        fwd_perm = [(p, p + 1) for p in range(P_ - 1)]
+        bwd_perm = [(p, p - 1) for p in range(1, P_)]
     if boundary_f32 is None:
         boundary_f32 = mesh.devices.flat[0].platform == "cpu"
+
+    def _f_to_mc(i):
+        """order_f[i] -> (microbatch, chunk): microbatches round-robin in
+        groups of P over chunks (schedule_interleave's order)."""
+        if C == 1:
+            return i, jnp.int32(0)
+        g, r = i // (P_ * C), i % (P_ * C)
+        return g * P_ + r % P_, r // P_
+
+    def _mc_to_f(m, c):
+        if C == 1:
+            return m
+        return (m // P_) * P_ * C + c * P_ + m % P_
 
     manual = {axis_name, *batch_axes}
     K_batch = 1
@@ -369,8 +411,9 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
         stage = jax.lax.axis_index(axis_name)
         is_first = stage == 0
         is_last = stage == P_ - 1
-        # 0 = first, 1 = middle, 2 = last (P_ >= 2 so first != last)
-        branch_idx = jnp.where(is_first, 0, jnp.where(is_last, 2, 1))
+
+        call_stage = ((lambda sp, x, c: stage_fn(sp, x, c, *extras)) if C > 1
+                      else (lambda sp, x, c: stage_fn(sp, x, *extras)))
 
         f32_zeros = lambda tree: jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), tree)
@@ -381,43 +424,54 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
         def tick(carry, k):
             recv_f, recv_b, ring, dep, dsp, dhp, loss_acc = carry
 
-            # ---- F sub-tick: forward microbatch k - stage ----
+            # ---- F sub-tick: order_f[k - stage] = (microbatch, chunk) ----
             fi = k - stage
-            f_valid = (fi >= 0) & (fi < M)
-            fi_c = jnp.clip(fi, 0, M - 1)
+            f_valid = (fi >= 0) & (fi < total_f)
+            fi_c = jnp.clip(fi, 0, total_f - 1)
+            fm, fc = _f_to_mc(fi_c)
 
             def do_f(ring):
-                ids = jax.lax.dynamic_index_in_dim(mb_in, fi_c, 0, keepdims=False)
+                ids = jax.lax.dynamic_index_in_dim(mb_in, fm, 0, keepdims=False)
+                # pipeline entry = (stage 0, chunk 0): embed; every other
+                # (stage, chunk) consumes the ring hop (stage-1 same chunk,
+                # or the P-1 -> 0 wraparound carrying chunk c-1's output)
                 x_in = jax.lax.cond(
-                    is_first,
+                    is_first & (fc == 0),
                     lambda: embed_fn(embed_p, ids, *extras).astype(act_dtype),
                     lambda: recv_f)
                 ring = jax.lax.dynamic_update_index_in_dim(ring, x_in, fi_c % R, 0)
-                # the last stage's forward is fused into its B sub-tick (same
-                # tick), so its F sub-tick sends nothing and computes nothing
+                # the last VIRTUAL stage's forward (last stage, last chunk)
+                # is fused into its B sub-tick, so it computes/sends nothing
                 y = jax.lax.cond(
-                    is_last,
+                    is_last & (fc == C - 1),
                     lambda: jnp.zeros(act_shape, act_dtype),
-                    lambda: stage_fn(stacked_p, x_in, *extras))
+                    lambda: call_stage(stacked_p, x_in, fc))
                 return ring, y
 
             ring, y = jax.lax.cond(
                 f_valid, do_f,
                 lambda ring: (ring, jnp.zeros(act_shape, act_dtype)), ring)
 
-            # ---- B sub-tick: backward microbatch k - 2(P-1) + stage ----
-            bi = k - 2 * (P_ - 1) + stage
-            b_valid = (bi >= 0) & (bi < M)
-            bi_c = jnp.clip(bi, 0, M - 1)
+            # ---- B sub-tick: order_b[k - D + stage], mirrored chunks ----
+            bi = k - D + stage
+            b_valid = (bi >= 0) & (bi < total_f)
+            bi_c = jnp.clip(bi, 0, total_f - 1)
+            bm, bfc = _f_to_mc(bi_c)
+            bc = C - 1 - bfc
+            slot_b = _mc_to_f(bm, bc) % R
 
             def do_b(dep, dsp, dhp, loss_acc):
-                x_saved = jax.lax.dynamic_index_in_dim(ring, bi_c % R, 0, keepdims=False)
-                lbl = jax.lax.dynamic_index_in_dim(mb_lbl, bi_c, 0, keepdims=False)
-                ids = jax.lax.dynamic_index_in_dim(mb_in, bi_c, 0, keepdims=False)
+                x_saved = jax.lax.dynamic_index_in_dim(ring, slot_b, 0, keepdims=False)
+                lbl = jax.lax.dynamic_index_in_dim(mb_lbl, bm, 0, keepdims=False)
+                ids = jax.lax.dynamic_index_in_dim(mb_in, bm, 0, keepdims=False)
+                # pipeline-terminal roles are per (stage, chunk): embed vjp
+                # at (0, 0), loss head at (P-1, C-1), plain mid elsewhere
+                branch_idx = jnp.where(is_first & (bc == 0), 0,
+                                       jnp.where(is_last & (bc == C - 1), 2, 1))
 
                 def stage_vjp():
                     _, vjp = jax.vjp(
-                        lambda sp, x: stage_fn(sp, x, *extras), stacked_p, x_saved)
+                        lambda sp, x: call_stage(sp, x, bc), stacked_p, x_saved)
                     return vjp(recv_b)
 
                 def first_b():
@@ -436,7 +490,7 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
 
                 def last_b():
                     def full(sp, hp, x):
-                        return head_loss_fn(hp, stage_fn(sp, x, *extras), lbl, *extras)
+                        return head_loss_fn(hp, call_stage(sp, x, bc), lbl, *extras)
 
                     lval, (g_sp, g_hp, g_x) = jax.value_and_grad(
                         full, argnums=(0, 1, 2))(stacked_p, head_p, x_saved)
@@ -472,7 +526,7 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
             jnp.float32(0),
         )
         (_, _, _, dep, dsp, dhp, loss_acc), _ = jax.lax.scan(
-            tick, carry0, jnp.arange(M + 2 * (P_ - 1)))
+            tick, carry0, jnp.arange(total_f + D))
         # loss lives on the last stage, embed/head grads on their owning
         # stages: scalar + shared-param psums (cheap; the per-stage grads —
         # the big ones — never cross stage boundaries).  With batch axes

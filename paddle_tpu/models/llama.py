@@ -300,12 +300,21 @@ def forward_pp(cfg: LlamaConfig, params, input_ids, mesh, num_microbatches,
 
 
 def loss_and_grads_1f1b(cfg: LlamaConfig, params, input_ids, labels, mesh,
-                        num_microbatches, use_flash=True, remat=True):
+                        num_microbatches, use_flash=True, remat=True,
+                        num_chunks=1, layers_stage_major=False):
     """Pipeline train-step core on the executed 1F1B schedule
     (fleet/pipeline.py one_f_one_b_stacked ≙ pipeline_parallel.py:684 run,
     not simulated).  Stage 0 owns the embedding, the last stage owns final
     norm + lm head + loss, so loss cotangents stream backward per microbatch.
-    Returns (mean_loss, grads) with grads matching the params tree (f32)."""
+    With ``num_chunks`` C > 1 this is the interleaved/VPP schedule
+    (PipelineParallelWithInterleave, pipeline_parallel.py:1308): the stacked
+    layers are reordered stage-major (stage s owns virtual stages c·P+s) so
+    the pp shard of each stage holds its C chunks; grads are reordered back.
+    That in-step reorder reshards ~half the layer params across pp shards
+    each step — callers that keep their train state stage-major permanently
+    (reorder once at init) should pass ``layers_stage_major=True`` to skip
+    both permutes.  Returns (mean_loss, grads) with grads matching the
+    params tree (f32)."""
     from ..distributed.fleet.pipeline import one_f_one_b_stacked
 
     b, s = input_ids.shape
@@ -315,17 +324,43 @@ def loss_and_grads_1f1b(cfg: LlamaConfig, params, input_ids, labels, mesh,
     lbl_m = labels.reshape(M, b // M, s)
     cos, sin = rope_mod.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_theta,
                                      dtype=cfg.dtype)
+    C = num_chunks
+    pp_deg = dict(mesh.shape).get("pp", 1)
+    L = cfg.num_hidden_layers
+    assert L % (pp_deg * C) == 0, (L, pp_deg, C)
+    Lv = L // (pp_deg * C)  # layers per virtual stage
 
     def embed_fn(ep, ids, cos_, sin_):
         return jnp.take(ep, ids, axis=0).astype(cfg.dtype)
 
-    def stage_fn(sp, x, cos_, sin_):
+    def _scan_layers(sp, x, cos_, sin_):
         def body(carry, lp):
             return _layer_forward(cfg, carry, lp, cos_, sin_, use_flash, None), None
 
         scan_body = _remat_wrap(body, remat)
         y, _ = jax.lax.scan(scan_body, x, sp)
         return y
+
+    if C == 1:
+        stage_fn = _scan_layers
+    else:
+        def stage_fn(sp, x, chunk, cos_, sin_):
+            # local stacked leaves hold C chunks of Lv layers (stage-major
+            # layout): slice this chunk, then scan it
+            pick = lambda w: jax.lax.dynamic_index_in_dim(
+                w.reshape((C, Lv) + w.shape[1:]), chunk, 0, keepdims=False)
+            return _scan_layers(jax.tree_util.tree_map(pick, sp), x, cos_, sin_)
+
+    def _to_vpp(tree):
+        # natural layer order [V·Lv, ...] -> stage-major [P·(C·Lv), ...]
+        return jax.tree_util.tree_map(
+            lambda w: w.reshape((C, pp_deg, Lv) + w.shape[1:])
+                       .swapaxes(0, 1).reshape(w.shape), tree)
+
+    def _from_vpp(tree):
+        return jax.tree_util.tree_map(
+            lambda w: w.reshape((pp_deg, C, Lv) + w.shape[1:])
+                       .swapaxes(0, 1).reshape(w.shape), tree)
 
     tied = "lm_head" not in params
 
@@ -355,10 +390,15 @@ def loss_and_grads_1f1b(cfg: LlamaConfig, params, input_ids, labels, mesh,
                        embed_specs=specs["embed"],
                        stacked_specs=specs["layers"], head_specs=head_specs)
 
+    reorder = C > 1 and not layers_stage_major
+    stacked = _to_vpp(params["layers"]) if reorder else params["layers"]
     loss, (dep, dsp, dhp) = one_f_one_b_stacked(
         embed_fn, stage_fn, head_loss_fn,
-        params["embed"], params["layers"], head_params,
-        ids_m, lbl_m, mesh, axis_name="pp", extra_args=(cos, sin), **pipe_kw)
+        params["embed"], stacked, head_params,
+        ids_m, lbl_m, mesh, axis_name="pp", extra_args=(cos, sin),
+        num_chunks=C, **pipe_kw)
+    if reorder:
+        dsp = _from_vpp(dsp)
 
     grads = {"final_norm": dhp["final_norm"], "layers": dsp}
     grads["embed"] = dep + dhp["embed"] if tied else dep
@@ -395,7 +435,8 @@ def make_mesh(dp=1, mp=1, sharding=1, sep=1, pp=1, devices=None):
 
 def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
                      beta1=0.9, beta2=0.95, grad_clip=1.0, num_microbatches=None,
-                     sep_attn_impl="ring", pipeline_schedule="1f1b"):
+                     sep_attn_impl="ring", pipeline_schedule="1f1b",
+                     num_chunks=2):
     """The pjit-compiled train step: forward+backward+AdamW, all sharded.
 
     Data: [b, s] sharded ('dp'+'sharding' on batch, 'sep' on sequence).
@@ -442,12 +483,17 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
     # tuple-sharded over auto axes inside the region.  A sep axis still
     # needs the gpipe region (which binds sep in the same shard_map) — see
     # forward_pp.
-    use_1f1b = pp > 1 and sep == 1 and pipeline_schedule == "1f1b"
+    # 'vpp'/'interleave' runs the same executed runner with C>1 virtual
+    # chunks per stage (num_chunks); '1f1b' is C=1
+    use_1f1b = pp > 1 and sep == 1 and pipeline_schedule in ("1f1b", "vpp",
+                                                             "interleave")
+    vpp_chunks = num_chunks if pipeline_schedule in ("vpp", "interleave") else 1
 
     def train_step(params, opt_state, input_ids, labels):
         if use_1f1b:
             loss, grads = loss_and_grads_1f1b(cfg, params, input_ids, labels,
-                                              mesh, num_microbatches)
+                                              mesh, num_microbatches,
+                                              num_chunks=vpp_chunks)
         else:
             if pp > 1:
                 lfn = lambda p: loss_fn_pp(cfg, p, input_ids, labels, mesh,

@@ -732,3 +732,119 @@ def test_build_train_step_uses_1f1b_under_dp_sharding(eight_devices):
     for _ in range(4):
         l, p, o = step(p, o, ids, labels)
     assert np.isfinite(float(l0)) and float(l) < float(l0)
+
+
+# ---------------- executed interleaved/VPP (num_chunks > 1) ----------------
+
+def _vpp_toy(pp, C, M=4, L=8, h=8, v=16, mb=2):
+    """VPP parity harness: stage-major chunked stack through the executed
+    interleaved schedule vs a sequential reference (reference semantics:
+    PipelineParallelWithInterleave, pipeline_parallel.py:1308)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.pipeline import one_f_one_b_stacked
+
+    mesh = Mesh(np.array(jax.devices()[:pp]).reshape(pp), axis_names=("pp",))
+    Lv = L // (pp * C)
+    E = jnp.asarray(rng.randn(v, h), jnp.float32) * 0.1
+    W = jnp.asarray(rng.randn(L, h, h), jnp.float32) * 0.1
+    H = jnp.asarray(rng.randn(h, v), jnp.float32) * 0.1
+    ids = jnp.asarray(rng.randint(0, v, (M, mb, 3)))
+    lbl = jnp.asarray(rng.randint(0, v, (M, mb, 3)))
+
+    def embed_fn(ep, i):
+        return jnp.take(ep, i, axis=0)
+
+    def scan_block(w, x):
+        def body(c, wk):
+            return jnp.tanh(c @ wk), None
+
+        y, _ = jax.lax.scan(body, x, w)
+        return y
+
+    def stage_fn(sp, x, c):
+        spc = jax.lax.dynamic_index_in_dim(
+            sp.reshape((C, Lv) + sp.shape[1:]), c, 0, keepdims=False)
+        return scan_block(spc, x)
+
+    def head_loss_fn(hp, y, lb):
+        logp = jax.nn.log_softmax(y @ hp["H"], axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, lb[..., None], axis=-1))
+
+    W_vpp = W.reshape(C, pp, Lv, h, h).swapaxes(0, 1).reshape(L, h, h)
+    W_sh = jax.device_put(W_vpp, NamedSharding(mesh, P("pp")))
+    loss, (dE, dW, dH) = jax.jit(
+        lambda E_, W_, H_: one_f_one_b_stacked(
+            embed_fn, stage_fn, head_loss_fn, E_, W_, {"H": H_},
+            ids, lbl, mesh, num_chunks=C))(E, W_sh, H)
+    dW = np.asarray(dW).reshape(pp, C, Lv, h, h).swapaxes(0, 1).reshape(L, h, h)
+
+    def ref_loss(E_, W_, H_):
+        tot = 0.0
+        for m in range(M):
+            tot += head_loss_fn({"H": H_}, scan_block(W_, embed_fn(E_, ids[m])), lbl[m])
+        return tot / M
+
+    rl, (rE, rW, rH) = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(E, W, H)
+    return (float(loss), np.asarray(dE), dW, np.asarray(dH["H"])), \
+        (float(rl), np.asarray(rE), np.asarray(rW), np.asarray(rH))
+
+
+@pytest.mark.parametrize("pp,chunks", [(2, 2), (4, 2), (2, 4)])
+def test_vpp_interleave_loss_and_grads_parity(pp, chunks, eight_devices):
+    """Executed interleaved/VPP matches the sequential reference in loss AND
+    every grad at pp=2/C=2, pp=4/C=2, pp=2/C=4 (round-3 verdict item #3)."""
+    (loss, dE, dW, dH), (rl, rE, rW, rH) = _vpp_toy(pp, chunks)
+    np.testing.assert_allclose(loss, rl, rtol=1e-5)
+    np.testing.assert_allclose(dE, rE, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(dW, rW, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(dH, rH, rtol=1e-4, atol=1e-6)
+
+
+def test_llama_vpp_full_grad_parity(eight_devices):
+    """llama loss_and_grads_1f1b with num_chunks=2 (pp=2, M=4): loss and
+    every param grad leaf agree with single-device value_and_grad, through
+    the stage-major reorder round-trip."""
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=4, heads=4,
+                                 kv_heads=2, inter=64)
+    mesh = llama.make_mesh(pp=2, devices=jax.devices()[:2])
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 16)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 16)))
+
+    loss, grads = jax.jit(lambda p: llama.loss_and_grads_1f1b(
+        cfg, p, ids, labels, mesh, num_microbatches=4, num_chunks=2))(params)
+
+    rl, rg = jax.value_and_grad(
+        lambda p: llama.loss_fn(cfg, p, ids, labels))(params)
+    np.testing.assert_allclose(float(loss), float(rl), rtol=1e-4)
+    flat, _ = jax.tree_util.tree_flatten_with_path(grads)
+    rflat = dict(jax.tree_util.tree_flatten_with_path(rg)[0])
+    for path, g in flat:
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(rflat[path], np.float32),
+            rtol=5e-2, atol=2e-3, err_msg=str(path))
+
+
+@pytest.mark.parametrize("mesh_kw", [dict(dp=2, pp=2), dict(sharding=2, pp=2)])
+def test_build_train_step_vpp_schedule(mesh_kw, eight_devices):
+    """pipeline_schedule='vpp' end-to-end on dp2×pp2 AND sharding2×pp2:
+    steps run and loss moves (VPP composes with the manual dp batch axis and
+    with the ZeRO gather/reduce-scatter wrapper around chunk slicing)."""
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=4, heads=4,
+                                 kv_heads=2, inter=64)
+    mesh = llama.make_mesh(**mesh_kw, devices=jax.devices()[:4])
+    step, oinit, pshard, dshard = llama.build_train_step(
+        cfg, mesh, num_microbatches=2, pipeline_schedule="vpp", num_chunks=2)
+    p = jax.device_put(llama.init_params(cfg, jax.random.key(0)), pshard)
+    o = oinit(p)
+    ids = jax.device_put(jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16))), dshard)
+    labels = jax.device_put(jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16))), dshard)
+    l0, p, o = step(p, o, ids, labels)
+    for _ in range(4):
+        l, p, o = step(p, o, ids, labels)
+    assert np.isfinite(float(l0)) and float(l) < float(l0)
