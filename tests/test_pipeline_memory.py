@@ -1,0 +1,93 @@
+"""Pipeline engine memory audit (round-3 verdict weak #4/#10).
+
+Compares XLA's compiled memory analysis for the executed 1F1B engine vs the
+GPipe (AD-through-scan) engine on the 8-virtual-device mesh: 1F1B's O(P)
+activation ring + f32 embed/head accumulators must not blow past GPipe's
+AD-saved O(M+P) ticks.  Static compiler numbers from the CPU backend, not
+TPU HBM: the CPU program carries f32 boundary casts (pipeline.py's
+boundary_f32/_cpu paths) that the TPU bf16 program does not, so these sizes
+OVERSTATE the TPU working set — the "fits" conclusions are conservative,
+while engine-to-engine ratios are like-for-like.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+rng = np.random.RandomState(7)
+
+
+def _mem(step_fn, args):
+    comp = step_fn.lower(*args).compile()
+    m = comp.memory_analysis()
+    if m is None:
+        pytest.skip("backend provides no memory analysis")
+    return m
+
+
+def test_1f1b_memory_vs_gpipe(eight_devices):
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=1024, hidden_size=256, intermediate_size=512,
+        num_hidden_layers=8, num_attention_heads=8, num_key_value_heads=4,
+        max_position_embeddings=256)
+    mesh = llama.make_mesh(pp=4, devices=jax.devices()[:4])
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 128)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 128)))
+
+    sizes = {}
+    for sched in ("1f1b", "gpipe"):
+        step, oinit, pshard, dshard = llama.build_train_step(
+            cfg, mesh, num_microbatches=8, pipeline_schedule=sched)
+        p = jax.device_put(llama.init_params(cfg, jax.random.key(0)), pshard)
+        o = oinit(p)
+        i = jax.device_put(ids, dshard)
+        y = jax.device_put(labels, dshard)
+        m = _mem(step, (p, o, i, y))
+        sizes[sched] = dict(
+            temp=m.temp_size_in_bytes, args=m.argument_size_in_bytes,
+            out=m.output_size_in_bytes)
+    print(f"\n[pp memory audit] 1f1b temp={sizes['1f1b']['temp']/1e6:.1f}MB "
+          f"gpipe temp={sizes['gpipe']['temp']/1e6:.1f}MB "
+          f"(args {sizes['1f1b']['args']/1e6:.1f}MB)")
+    # the acceptance bound: 1F1B's working set must be in the same class as
+    # GPipe's, not a multiple of it — the O(P) ring replaces AD's O(M+P)
+    # saved ticks, and the f32 embed/head accumulators are per-stage O(1)
+    assert sizes["1f1b"]["temp"] <= 1.5 * sizes["gpipe"]["temp"], sizes
+
+
+def test_1f1b_xl_single_stage_memory_fits_v5e(eight_devices):
+    """Scale sanity for the xl (1.1B) bench rung at pp=4: per-device compiled
+    working set (args + temp) must be far below the 16GB v5e HBM."""
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8,
+        max_position_embeddings=512)
+    mesh = llama.make_mesh(pp=4, devices=jax.devices()[:4])
+    step, oinit, pshard, dshard = llama.build_train_step(
+        cfg, mesh, num_microbatches=4, pipeline_schedule="1f1b")
+
+    # abstract avals only — 1.1B of real weights plus f32 AdamW state would
+    # cost ~15GB host RSS for a compile-only test
+    def sds(avals, shardings):
+        return jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            avals, shardings)
+
+    p_avals = jax.eval_shape(lambda: llama.init_params(cfg, jax.random.key(0)))
+    o_avals = jax.eval_shape(oinit, sds(p_avals, pshard))
+    o_shardings = jax.tree_util.tree_map(lambda a: a.sharding, o_avals)
+    ids = jax.ShapeDtypeStruct((4, 512), jnp.int32, sharding=dshard)
+    m = _mem(step, (sds(p_avals, pshard), sds(o_avals, o_shardings), ids, ids))
+    # memory_analysis reports PER-SHARD sizes already (verified: a globally
+    # sharded argument reports its shard bytes, not global bytes)
+    per_device = (m.argument_size_in_bytes + m.temp_size_in_bytes
+                  + m.output_size_in_bytes)
+    print(f"\n[xl pp4 1f1b] per-device bytes={per_device/1e9:.2f}GB")
+    assert per_device < 14e9, f"{per_device/1e9:.2f}GB exceeds v5e budget"
