@@ -331,20 +331,24 @@ def run_decode_rung(name, cfg, batch, prompt, new, max_seq):
     }
 
 
-def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1):
+def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
+                quant=None):
     """Continuous-batching throughput: staggered prompt lengths through the
     slot-pool scheduler (inference/serving.py), the serving pattern behind the
-    reference's block_multihead_attention stack (fused_ops.yaml:45)."""
+    reference's block_multihead_attention stack (fused_ops.yaml:45).
+    ``quant``: weight-only int8/int4 matmuls (nn/quant) — the HBM-bandwidth
+    lever for decode."""
     import numpy as np
     import jax
 
     from paddle_tpu.models import llama
     from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
 
-    log(f"cb rung {name}: building (slots={max_batch} requests={n_requests})")
+    log(f"cb rung {name}: building (slots={max_batch} requests={n_requests} "
+        f"quant={quant})")
     params = llama.init_params(cfg, jax.random.key(0))
     eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
-                                   max_seq=max_seq, chunk=chunk)
+                                   max_seq=max_seq, chunk=chunk, quant=quant)
     rs = np.random.RandomState(0)
     # warm the decode step plus one prefill per bucket the timed requests can
     # land in (lengths span [prompt//2, prompt//2 + prompt - 1]) so no XLA
@@ -382,7 +386,7 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1)
         "detail": {"rung": name, "slots": max_batch, "requests": n_requests,
                    "total_new_tokens": total, "wall_s": round(wall, 2),
                    "decode_steps": eng.stats["decode_steps"], "chunk": chunk,
-                   "backend": jax.default_backend()},
+                   "quant": quant, "backend": jax.default_backend()},
     }
 
 
@@ -413,13 +417,15 @@ def decode_ladder_main(compact: bool = False) -> int:
     # the per-token host round-trip (dominant on a relay-attached TPU)
     cb_rungs = ([("cb_tiny", llama.LlamaConfig.tiny(), 2, 6, 16, 16, 64, 1),
                  ("cb_full", full_cfg, 8, 24, 128, 64, 512, 1),
-                 ("cb_full_chunk8", full_cfg, 8, 24, 128, 64, 512, 8)]
+                 ("cb_full_chunk8", full_cfg, 8, 24, 128, 64, 512, 8),
+                 ("cb_full_chunk8_int8", full_cfg, 8, 24, 128, 64, 512, 8, "int8")]
                 if on_tpu else
                 [("cb_cpu_smoke", llama.LlamaConfig.tiny(), 2, 4, 16, 8, 64, 2)])
     if compact and on_tpu:
-        # single best-known config (round-3 headline: chunk=8 hides the
-        # per-token relay RTT) so the cross-mode phase fits the budget
-        cb_rungs = [("cb_full_chunk8", full_cfg, 8, 24, 128, 64, 512, 8)]
+        # best-known config (round-3 headline: chunk=8 hides the per-token
+        # relay RTT) fp + weight-only int8, so the cross-mode phase fits
+        cb_rungs = [("cb_full_chunk8", full_cfg, 8, 24, 128, 64, 512, 8),
+                    ("cb_full_chunk8_int8", full_cfg, 8, 24, 128, 64, 512, 8, "int8")]
     for rung in cb_rungs:
         try:
             emit(run_cb_rung(*rung))

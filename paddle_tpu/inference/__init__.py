@@ -279,23 +279,59 @@ def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos, sin
         out = jnp.einsum("bngsS,bnSd->bsngd", p.astype(v_all.dtype), v_all)
         return out.reshape(b, s, nh * hd)
 
+    def wmat(entry, dt):
+        """Dense [in, out] matrix from a param leaf — either fp as stored,
+        or a weight-only quantized {'qweight': int8/int4 [out, in],
+        'scale': [out]} dict whose dequant multiply XLA fuses into the
+        matmul's HBM read (the weight streams at 1/2 or 1/4 the bytes:
+        the lever in bandwidth-bound decode)."""
+        if isinstance(entry, dict):
+            from ..nn.quant import _dequant_2d
+
+            return _dequant_2d(entry["qweight"], entry["scale"], dt)
+        return entry
+
     def body(carry, layer_in):
         x = carry
         lp, ck, cv = layer_in
+        dt = x.dtype
         xn = rms.rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q = (xn @ lp["wq"]).reshape(b, s, nh, hd)
-        k = (xn @ lp["wk"]).reshape(b, s, nkv, hd)
-        v = (xn @ lp["wv"]).reshape(b, s, nkv, hd)
+        q = (xn @ wmat(lp["wq"], dt)).reshape(b, s, nh, hd)
+        k = (xn @ wmat(lp["wk"], dt)).reshape(b, s, nkv, hd)
+        v = (xn @ wmat(lp["wv"], dt)).reshape(b, s, nkv, hd)
         q, k = rope_mod.apply_rotary_pos_emb(q, k, cos, sin)
         ck, k_att = write_fn(ck, k)
         cv, v_att = write_fn(cv, v)
-        x = x + attend(q, k_att, v_att) @ lp["wo"]
+        x = x + attend(q, k_att, v_att) @ wmat(lp["wo"], dt)
         xn = rms.rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-        x = x + swiglu_mod.swiglu(xn @ lp["w_gate"], xn @ lp["w_up"]) @ lp["w_down"]
+        x = x + swiglu_mod.swiglu(xn @ wmat(lp["w_gate"], dt),
+                                  xn @ wmat(lp["w_up"], dt)) @ wmat(lp["w_down"], dt)
         return x, (ck, cv)
 
     x, (all_k, all_v) = jax.lax.scan(body, x, (params["layers"], cache_k, cache_v))
     return rms.rms_norm(x, params["final_norm"], cfg.rms_norm_eps), all_k, all_v
+
+
+_QUANT_ALGOS = {"int8": "weight_only_int8", "int4": "weight_only_int4"}
+_MATMUL_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_layer_params(params, quant: str):
+    """Weight-only-quantize the stacked per-layer matmul weights of a llama
+    param tree (embed / lm_head / norms stay fp).  Each [L, K, N] leaf
+    becomes {'qweight': [L, N, K] int8|int4, 'scale': [L, N] f32} — the
+    serving analog of the reference's weight_quantize + weight_only_linear
+    deployment flow (nn/quant/quantized_linear.py)."""
+    from ..nn.quant import _quantize_2d
+
+    algo = _QUANT_ALGOS[quant]
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in _MATMUL_LEAVES:
+        q, s = jax.vmap(lambda w: _quantize_2d(w, algo))(layers[name])
+        layers[name] = {"qweight": q, "scale": s}
+    out["layers"] = layers
+    return out
 
 
 def lm_head_logits(cfg, params, x_last):
@@ -314,12 +350,19 @@ class GenerationEngine:
     AOT-compiled programs with static shapes (max_seq padding), the TPU-serving
     pattern; the decode step threads the cache functionally (donated buffers)."""
 
-    def __init__(self, cfg, params, max_seq: int = 512):
+    def __init__(self, cfg, params, max_seq: int = 512, quant: str | None = None):
+        """``quant``: None (fp), 'int8' or 'int4' — weight-only quantize the
+        per-layer matmul weights at load (reference deployment flow:
+        weight_quantize + weight_only_linear; on a 16GB v5e this is what
+        makes >7B models servable at all)."""
         from ..models import llama as _llama
 
         self.cfg = cfg
         self.max_seq = max_seq
+        if quant is not None:
+            params = quantize_layer_params(params, quant)
         self.params = params
+        self.quant = quant
         self._llama = _llama
         self._prefill = jax.jit(self._prefill_impl, static_argnums=())
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1, 2))

@@ -65,16 +65,23 @@ class ContinuousBatchingEngine:
     """
 
     def __init__(self, cfg, params, max_batch: int = 8, max_seq: int = 512,
-                 chunk: int = 1):
+                 chunk: int = 1, quant: str | None = None):
         """``chunk``: decode steps per compiled call.  Tokens feed back
         on-device inside a lax.scan and the host fetches ``chunk`` tokens per
         round-trip — the lever against host-device latency (one RTT per token
         is what bounds single-step decode on a relay-attached TPU).  Retire
         and admission happen at chunk granularity; generated tokens past a
-        request's EOS/budget inside a chunk are trimmed host-side."""
+        request's EOS/budget inside a chunk are trimmed host-side.
+        ``quant``: None | 'int8' | 'int4' — weight-only quantized matmuls
+        (weights stream from HBM at 1/2 or 1/4 the bytes)."""
         from ..models import llama as _llama  # noqa: F401  (cfg type lives there)
 
         self.cfg = cfg
+        if quant is not None:
+            from . import quantize_layer_params
+
+            params = quantize_layer_params(params, quant)
+        self.quant = quant
         self.params = params
         self.max_batch = max_batch
         self.max_seq = max_seq

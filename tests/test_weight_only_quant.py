@@ -1,0 +1,152 @@
+"""Weight-only quantization ops + quantized decode engines.
+
+Reference surface: python/paddle/nn/quant/quantized_linear.py
+(weight_quantize :64, weight_dequantize :131, weight_only_linear :191,
+llm_int8_linear :285) and the weight_only_linear op (phi ops.yaml:5320).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import quant as Q
+
+rs = np.random.RandomState(3)
+
+
+def _w(k=64, n=32):
+    return (rs.randn(k, n) * 0.5).astype(np.float32)
+
+
+def test_weight_quantize_shapes_and_roundtrip():
+    w = _w()
+    q, s = Q.weight_quantize(paddle.to_tensor(w), algo="weight_only_int8")
+    assert q.shape == (32, 64) and str(q.numpy().dtype) == "int8"
+    assert s.shape == (32,)
+    back = Q.weight_dequantize(q, s, out_dtype="float32").numpy()
+    rel = np.abs(back - w).max() / np.abs(w).max()
+    assert rel < 0.01, rel
+
+
+def test_weight_quantize_int4_roundtrip():
+    w = _w()
+    q, s = Q.weight_quantize(paddle.to_tensor(w), algo="weight_only_int4")
+    back = Q.weight_dequantize(q, s, out_dtype="float32").numpy()
+    rel = np.abs(back - w).max() / np.abs(w).max()
+    assert rel < 0.12, rel  # 4-bit: ~1/15 of absmax per channel
+
+
+def test_weight_only_linear_parity_int8():
+    w = _w(64, 48)
+    x = (rs.randn(4, 64) * 0.3).astype(np.float32)
+    b = rs.randn(48).astype(np.float32)
+    q, s = Q.weight_quantize(paddle.to_tensor(w))
+    out = Q.weight_only_linear(paddle.to_tensor(x), q, bias=paddle.to_tensor(b),
+                               weight_scale=s).numpy()
+    ref = x @ w + b
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 0.02
+
+
+def test_weight_only_linear_grouped_tighter_than_per_channel():
+    """group_size=64 scales adapt within channel slices: error must not
+    exceed per-channel (and typically improves on heterogeneous weights)."""
+    w = _w(128, 16)
+    w[:64] *= 8.0  # heterogeneous magnitude across the K dim
+    x = (rs.randn(4, 128) * 0.3).astype(np.float32)
+    ref = x @ w
+
+    def err(group_size):
+        q, s = Q.weight_quantize(paddle.to_tensor(w), group_size=group_size)
+        out = Q.weight_only_linear(paddle.to_tensor(x), q, weight_scale=s,
+                                   group_size=group_size).numpy()
+        return np.abs(out - ref).max()
+
+    assert err(64) <= err(-1) * 1.01
+
+
+def test_llm_int8_linear_outlier_decomposition():
+    w = _w(64, 32)
+    x = (rs.randn(4, 64) * 0.3).astype(np.float32)
+    x[:, 7] = 40.0   # outlier channels (abs > threshold)
+    x[:, 21] = -35.0
+    q, s = Q.weight_quantize(paddle.to_tensor(w), algo="llm.int8")
+    out = Q.llm_int8_linear(paddle.to_tensor(x), q, weight_scale=s,
+                            threshold=6.0).numpy()
+    ref = x @ w
+    rel = np.abs(out - ref).max() / np.abs(ref).max()
+    assert rel < 0.02, rel
+    # sanity: without the decomposition the outliers would dominate the
+    # per-row scale and blow up the inlier error
+    row_scale = np.abs(x).max(-1, keepdims=True) / 127.0
+    naive = (np.round(x / row_scale) * row_scale) @ w
+    assert rel < np.abs(naive - ref).max() / np.abs(ref).max()
+
+
+def test_int4_storage_is_packed():
+    """jnp.int4 weights occupy half a byte per element on device — the
+    claim behind serving >7B on a 16GB chip."""
+    w = _w(64, 32)
+    q, _ = Q.weight_quantize(paddle.to_tensor(w), algo="weight_only_int4")
+    import paddle_tpu.core.tensor as ct
+    jarr = ct._unwrap(q)
+    assert jarr.dtype == jnp.int4
+    # XLA packs int4 2-per-byte; on_device_size covers layout truth
+    nbytes = jarr.nbytes if hasattr(jarr, "nbytes") else None
+    if nbytes is not None:
+        assert nbytes <= 64 * 32  # half of the int8 footprint
+
+
+# ---------------- quantized decode engines ----------------
+
+def _tiny():
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                                 kv_heads=2, inter=64)
+    return cfg, llama.init_params(cfg, jax.random.key(0))
+
+
+def test_generation_engine_int8_logits_close():
+    from paddle_tpu.inference import GenerationEngine
+
+    cfg, params = _tiny()
+    ids = rs.randint(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+    fp = GenerationEngine(cfg, params, max_seq=32)
+    q8 = GenerationEngine(cfg, params, max_seq=32, quant="int8")
+    lf, *_ = fp._prefill(fp.params, jnp.asarray(ids), *fp.init_cache(2))
+    lq, *_ = q8._prefill(q8.params, jnp.asarray(ids), *q8.init_cache(2))
+    lf, lq = np.asarray(lf, np.float32), np.asarray(lq, np.float32)
+    assert np.abs(lf - lq).max() < 0.05 * (np.abs(lf).max() + 1e-6)
+
+
+@pytest.mark.parametrize("quant", ["int8", "int4"])
+def test_generation_engine_quant_generates(quant):
+    from paddle_tpu.inference import GenerationEngine
+
+    cfg, params = _tiny()
+    eng = GenerationEngine(cfg, params, max_seq=32, quant=quant)
+    ids = rs.randint(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    out = eng.generate(ids, max_new_tokens=6)
+    assert out.shape == (2, 14)
+    assert (out[:, :8] == ids).all()
+
+
+def test_cb_engine_int8_serves():
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
+
+    cfg, params = _tiny()
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                   chunk=2, quant="int8")
+    reqs = [Request(rid=i, prompt_ids=np.arange(1, 6, dtype=np.int32),
+                    max_new_tokens=4) for i in range(3)]
+    got = eng.serve(reqs)
+    assert all(len(v) == 4 for v in got.values())
+    # int8 logits track fp closely on a tiny model: greedy tokens match
+    fp = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64, chunk=2)
+    ref = fp.serve([Request(rid=9, prompt_ids=np.arange(1, 6, dtype=np.int32),
+                            max_new_tokens=4)])
+    assert got[0] == ref[9]
