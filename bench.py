@@ -332,7 +332,7 @@ def run_decode_rung(name, cfg, batch, prompt, new, max_seq):
 
 
 def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
-                quant=None):
+                quant=None, paged=False):
     """Continuous-batching throughput: staggered prompt lengths through the
     slot-pool scheduler (inference/serving.py), the serving pattern behind the
     reference's block_multihead_attention stack (fused_ops.yaml:45).
@@ -348,7 +348,8 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
         f"quant={quant})")
     params = llama.init_params(cfg, jax.random.key(0))
     eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
-                                   max_seq=max_seq, chunk=chunk, quant=quant)
+                                   max_seq=max_seq, chunk=chunk, quant=quant,
+                                   paged=paged)
     rs = np.random.RandomState(0)
     # warm the decode step plus one prefill per bucket the timed requests can
     # land in (lengths span [prompt//2, prompt//2 + prompt - 1]) so no XLA
@@ -386,7 +387,8 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
         "detail": {"rung": name, "slots": max_batch, "requests": n_requests,
                    "total_new_tokens": total, "wall_s": round(wall, 2),
                    "decode_steps": eng.stats["decode_steps"], "chunk": chunk,
-                   "quant": quant, "backend": jax.default_backend()},
+                   "quant": quant, "paged": paged,
+                   "backend": jax.default_backend()},
     }
 
 
@@ -418,7 +420,8 @@ def decode_ladder_main(compact: bool = False) -> int:
     cb_rungs = ([("cb_tiny", llama.LlamaConfig.tiny(), 2, 6, 16, 16, 64, 1),
                  ("cb_full", full_cfg, 8, 24, 128, 64, 512, 1),
                  ("cb_full_chunk8", full_cfg, 8, 24, 128, 64, 512, 8),
-                 ("cb_full_chunk8_int8", full_cfg, 8, 24, 128, 64, 512, 8, "int8")]
+                 ("cb_full_chunk8_int8", full_cfg, 8, 24, 128, 64, 512, 8, "int8"),
+                 ("cb_full_chunk8_paged", full_cfg, 8, 24, 128, 64, 512, 8, None, True)]
                 if on_tpu else
                 [("cb_cpu_smoke", llama.LlamaConfig.tiny(), 2, 4, 16, 8, 64, 2)])
     if compact and on_tpu:

@@ -9,7 +9,7 @@ TPU-first design
 ----------------
 A TPU serving engine wants *static shapes*: one compiled decode step over a
 fixed slot pool, re-run every iteration.  So instead of the reference's
-dynamic batch + paged block tables, we keep:
+dynamic batch, we keep:
 
   * a slot pool of ``max_batch`` lanes in one shared dense KV cache
     [L, max_batch, nkv, S, hd] — a lane is the TPU analog of a block table
@@ -21,10 +21,23 @@ dynamic batch + paged block tables, we keep:
   * prefill into a single lane with bucketed prompt padding (powers of two),
     bounding the number of compiled prefill variants to log2(max_seq).
 
-Admission/retirement is plain Python around the two compiled programs —
-scheduling is control-plane work and costs microseconds next to a device
-step, the same split the reference makes between its C++ scheduler and CUDA
-kernels.
+``paged=True`` swaps the per-slot dense lanes for a BLOCK-TABLE cache (the
+reference's ``block_multihead_attention_`` memory model, fused_ops.yaml:45):
+K/V live in a fixed pool of [num_blocks, nkv, block_size, hd] pages per
+layer, each slot owns a host-managed list of block ids, and the compiled
+programs receive the [max_batch, max_blocks] table AS DATA — shapes stay
+static (the TPU requirement) while HBM is shared by actual usage, so
+admission is bounded by free blocks rather than worst-case max_seq lanes.
+Attention reads a gathered view of the slot's blocks (XLA fuses the block
+gather into the attention contraction's operand read); when the pool runs
+dry the youngest slot is preempted vLLM-style (blocks freed, request
+requeued with prompt+generated so far — greedy decode makes the recompute
+exact).
+
+Admission/retirement/allocation is plain Python around the compiled
+programs — scheduling is control-plane work and costs microseconds next to
+a device step, the same split the reference makes between its C++ scheduler
+and CUDA kernels.
 """
 
 from __future__ import annotations
@@ -65,7 +78,8 @@ class ContinuousBatchingEngine:
     """
 
     def __init__(self, cfg, params, max_batch: int = 8, max_seq: int = 512,
-                 chunk: int = 1, quant: str | None = None):
+                 chunk: int = 1, quant: str | None = None, paged: bool = False,
+                 block_size: int = 64, num_blocks: int | None = None):
         """``chunk``: decode steps per compiled call.  Tokens feed back
         on-device inside a lax.scan and the host fetches ``chunk`` tokens per
         round-trip — the lever against host-device latency (one RTT per token
@@ -73,7 +87,11 @@ class ContinuousBatchingEngine:
         and admission happen at chunk granularity; generated tokens past a
         request's EOS/budget inside a chunk are trimmed host-side.
         ``quant``: None | 'int8' | 'int4' — weight-only quantized matmuls
-        (weights stream from HBM at 1/2 or 1/4 the bytes)."""
+        (weights stream from HBM at 1/2 or 1/4 the bytes).
+        ``paged``: block-table KV cache (``block_size`` tokens per page,
+        ``num_blocks`` pages shared by all slots; default num_blocks gives
+        half the dense pool's capacity — the paged mode's point is serving
+        more logical context than physically reserved HBM)."""
         from ..models import llama as _llama  # noqa: F401  (cfg type lives there)
 
         self.cfg = cfg
@@ -86,8 +104,30 @@ class ContinuousBatchingEngine:
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.chunk = int(chunk)
+        self.paged = bool(paged)
         L = cfg.num_hidden_layers
-        shape = (L, max_batch, cfg.num_key_value_heads, max_seq, cfg.head_dim)
+        nkv, hd = cfg.num_key_value_heads, cfg.head_dim
+        if paged:
+            assert max_seq % block_size == 0, (max_seq, block_size)
+            self.block_size = block_size
+            self.max_blocks = max_seq // block_size     # per-slot logical cap
+            self.num_blocks = (num_blocks if num_blocks is not None
+                               else (max_batch * self.max_blocks) // 2)
+            assert self.num_blocks >= self.max_blocks, (
+                f"pool of {self.num_blocks} blocks cannot hold one full "
+                f"request ({self.max_blocks} blocks)")
+            shape = (L, self.num_blocks, nkv, block_size, hd)
+            # host allocator state
+            self._free: list[int] = list(range(self.num_blocks))
+            self._slot_blocks: list[list[int]] = [[] for _ in range(max_batch)]
+            # sentinel num_blocks = unallocated (oob: writes drop, reads are
+            # masked by the causal/active mask before they matter)
+            self._table = np.full((max_batch, self.max_blocks),
+                                  self.num_blocks, np.int32)
+            self._admit_seq = 0
+            self._slot_age = np.zeros(max_batch, np.int64)
+        else:
+            shape = (L, max_batch, nkv, max_seq, hd)
         self.cache_k = jnp.zeros(shape, cfg.dtype)
         self.cache_v = jnp.zeros(shape, cfg.dtype)
         # slot state (host side)
@@ -95,28 +135,38 @@ class ContinuousBatchingEngine:
         self._pos = np.zeros(max_batch, np.int32)      # next write position
         self._last_tok = np.zeros(max_batch, np.int32)
         self._queue: list[Request] = []
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(1, 2))
+        impl = self._decode_impl_paged if paged else self._decode_impl
+        self._decode = jax.jit(impl, donate_argnums=(1, 2))
         # prefill writes its lane directly into the donated pool arrays —
         # no slice-out/scatter-back copies of the full pool per admission
-        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(2, 3),
+        pimpl = self._prefill_impl_paged if paged else self._prefill_impl
+        self._prefill = jax.jit(pimpl, donate_argnums=(2, 3),
                                 static_argnums=(6,))
         self.stats = {"decode_steps": 0, "decode_tokens": 0,
-                      "prefills": 0, "decode_time_s": 0.0}
+                      "prefills": 0, "decode_time_s": 0.0, "preemptions": 0}
 
     # ---------------- compiled programs ----------------
 
-    def _decode_one(self, params, cache_k, cache_v, tokens, pos, active):
+    def _decode_one(self, params, cache_k, cache_v, tokens, pos, active,
+                    table=None):
         """One batched decode step: tokens [B], pos [B], active [B] ->
         (logits [B, V], caches).  Inactive slots compute garbage that is
         masked out — the static batch is the price of a single compiled
         program, and idle lanes are cheap next to recompiling (the standard
-        TPU serving trade)."""
+        TPU serving trade).
+
+        With ``table`` (paged mode) the K/V write lands in pool page
+        table[b, pos//bs] at offset pos%bs and attention reads a gathered
+        [B, nkv, max_seq, hd] view of each slot's pages (the reference's
+        block_multihead_attention memory model; the gather fuses into the
+        attention contraction)."""
         from .. import inference as _inf
         from ..ops.pallas import rope as rope_mod
 
         cfg = self.cfg
         B = self.max_batch
         S = self.max_seq
+        nkv, hd = cfg.num_key_value_heads, cfg.head_dim
         x = jnp.take(params["embed"], tokens[:, None], axis=0).astype(cfg.dtype)
         cos_full, sin_full = rope_mod.rope_cos_sin(S, cfg.head_dim,
                                                    base=cfg.rope_theta,
@@ -130,32 +180,54 @@ class ContinuousBatchingEngine:
         lane = jnp.arange(B)
         writeable = active & (pos < S)
 
-        def write(ck, k):
-            # ck [B, nkv, S, hd]; k [B, 1, nkv, hd] — per-slot scatter at
-            # each slot's own depth (drop writes from inactive/oob lanes)
-            upd = jnp.where(writeable[:, None, None], k[:, 0],
-                            ck[lane, :, safe_pos])
-            out = ck.at[lane, :, safe_pos].set(upd)
-            return out, out
+        if table is None:
+            def write(ck, k):
+                # ck [B, nkv, S, hd]; k [B, 1, nkv, hd] — per-slot scatter at
+                # each slot's own depth (drop writes from inactive/oob lanes)
+                upd = jnp.where(writeable[:, None, None], k[:, 0],
+                                ck[lane, :, safe_pos])
+                out = ck.at[lane, :, safe_pos].set(upd)
+                return out, out
+        else:
+            bs_ = self.block_size
+            blk = table[lane, safe_pos // bs_]                   # [B]
+            off = safe_pos % bs_
+            drop_blk = jnp.where(writeable, blk, self.num_blocks)  # oob -> drop
+
+            def write(ck, k):
+                # ck [num_blocks, nkv, bs, hd].  Allocator invariant:
+                # distinct slots own disjoint pages — no scatter collisions.
+                out = ck.at[drop_blk, :, off].set(k[:, 0], mode="drop")
+                # unallocated (sentinel) pages read as ZEROS — jnp.take's
+                # default oob mode fills NaN, and 0*NaN through the masked
+                # softmax would poison the whole row
+                view = jnp.take(out, table, axis=0, mode="fill", fill_value=0)
+                view = view.transpose(0, 2, 1, 3, 4).reshape(B, nkv, S, hd)
+                return out, view
 
         x, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
                                            write, mask, cos, sin)
         return _inf.lm_head_logits(cfg, params, x[:, -1]), ak, av
 
-    def _decode_impl(self, params, cache_k, cache_v, tokens, pos, active):
+    def _chunk_scan(self, params, cache_k, cache_v, tokens, pos, active,
+                    table=None):
         """``chunk`` greedy steps in one compiled program; the sampled token
         feeds back on-device (no host round-trip inside the chunk).
         Returns (tokens [chunk, B], caches)."""
 
         def one(carry, _):
             ck, cv, tok, p = carry
-            logits, ck, cv = self._decode_one(params, ck, cv, tok, p, active)
+            logits, ck, cv = self._decode_one(params, ck, cv, tok, p, active,
+                                              table)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return (ck, cv, nxt, p + 1), nxt
 
         (ck, cv, _, _), toks = jax.lax.scan(
             one, (cache_k, cache_v, tokens, pos), None, length=self.chunk)
         return toks, ck, cv
+
+    def _decode_impl(self, params, cache_k, cache_v, tokens, pos, active):
+        return self._chunk_scan(params, cache_k, cache_v, tokens, pos, active)
 
     def _prefill_impl(self, params, ids, cache_k, cache_v, slot, length, bucket):
         """Prefill one request (batch 1, prompt padded to ``bucket``) directly
@@ -197,6 +269,105 @@ class ContinuousBatchingEngine:
                                            write, mask, cos, sin)
         return ak, av
 
+    # ---------------- paged (block-table) compiled programs ----------------
+
+    def _decode_impl_paged(self, params, cache_k, cache_v, tokens, pos, active,
+                           table):
+        return self._chunk_scan(params, cache_k, cache_v, tokens, pos, active,
+                                table)
+
+    def _prefill_impl_paged(self, params, ids, cache_k, cache_v, table_row,
+                            length, bucket):
+        """Prefill into the slot's pages: prompt position j writes page
+        table_row[j // bs] offset j % bs; padding positions whose page is
+        the unallocated sentinel drop (and are masked from attention)."""
+        from .. import inference as _inf
+        from ..ops.pallas import rope as rope_mod
+
+        cfg = self.cfg
+        S = self.max_seq
+        bs_ = self.block_size
+        nkv, hd = cfg.num_key_value_heads, cfg.head_dim
+        x = jnp.take(params["embed"], ids, axis=0).astype(cfg.dtype)
+        cos_full, sin_full = rope_mod.rope_cos_sin(S, cfg.head_dim,
+                                                   base=cfg.rope_theta,
+                                                   dtype=cfg.dtype)
+        cos = cos_full[:, :bucket]
+        sin = sin_full[:, :bucket]
+        kv_pos = jnp.arange(S)[None, None, None, None, :]
+        q_pos = jnp.arange(bucket)[None, None, None, :, None]
+        mask = (kv_pos <= q_pos) & (kv_pos < length)
+        j = jnp.arange(bucket)
+        blk_j = table_row[j // bs_]                          # [bucket]
+        off_j = j % bs_
+
+        def write(ck, k):
+            # k [1, bucket, nkv, hd] -> scatter each prompt position into
+            # its page; view = this slot's gathered pages, batch-1
+            out = ck.at[blk_j, :, off_j].set(k[0], mode="drop")
+            view = jnp.take(out, table_row, axis=0,          # [maxblk, nkv, bs, hd]
+                            mode="fill", fill_value=0)       # sentinel -> zeros
+            view = view.transpose(1, 0, 2, 3).reshape(1, nkv, S, hd)
+            return out, view
+
+        _, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
+                                           write, mask, cos, sin)
+        return ak, av
+
+    # ---------------- block allocator (host control plane) ----------------
+
+    def _blocks_needed(self, last_pos: int) -> int:
+        return min(last_pos, self.max_seq - 1) // self.block_size + 1
+
+    def _alloc_to(self, slot: int, n_blocks: int) -> bool:
+        """Grow slot to n_blocks pages; False if the pool runs dry."""
+        owned = self._slot_blocks[slot]
+        while len(owned) < n_blocks:
+            if not self._free:
+                return False
+            b = self._free.pop()
+            self._table[slot, len(owned)] = b
+            owned.append(b)
+        return True
+
+    def _release(self, slot: int):
+        self._free.extend(self._slot_blocks[slot])
+        self._slot_blocks[slot] = []
+        self._table[slot, :] = self.num_blocks
+
+    def _preempt(self, slot: int):
+        """vLLM-style recompute preemption: free the slot, requeue the
+        request with prompt + generated-so-far (greedy decode makes the
+        recomputed continuation exact)."""
+        req = self._slot_req[slot]
+        ids = np.concatenate([np.asarray(req.prompt_ids, np.int32).ravel(),
+                              np.asarray(req.output_ids, np.int32)])
+        req._resume_ids = ids
+        self._release(slot)
+        self._slot_req[slot] = None
+        self._queue.insert(0, req)
+        self.stats["preemptions"] += 1
+
+    def _ensure_growth(self, k: int):
+        """Before a decode chunk: every active slot needs pages covering
+        positions up to pos+k-1.  Oldest slots win; when the pool is dry the
+        youngest active slot is preempted and its pages recycled."""
+        order = sorted((s for s in range(self.max_batch)
+                        if self._slot_req[s] is not None),
+                       key=lambda s: self._slot_age[s])
+        for slot in order:
+            if self._slot_req[slot] is None:
+                continue  # preempted by an older slot this pass
+            need = self._blocks_needed(int(self._pos[slot]) + k - 1)
+            while not self._alloc_to(slot, need):
+                victims = [s for s in range(self.max_batch)
+                           if s != slot and self._slot_req[s] is not None]
+                if not victims:
+                    raise RuntimeError(
+                        "KV block pool exhausted by a single request; "
+                        "increase num_blocks")
+                self._preempt(max(victims, key=lambda s: self._slot_age[s]))
+
     # ---------------- scheduler ----------------
 
     def _validate(self, req: Request):
@@ -213,22 +384,50 @@ class ContinuousBatchingEngine:
         self._queue.append(req)
 
     def _admit(self):
-        """Fill free slots from the queue (prefill path)."""
+        """Fill free slots from the queue (prefill path).  Paged mode admits
+        by free-page count: a request enters only when its prompt's pages
+        are allocatable — the block-table analog of "is a lane free"."""
         for slot in range(self.max_batch):
             if self._slot_req[slot] is not None or not self._queue:
                 continue
-            req = self._queue.pop(0)
-            ids = np.asarray(req.prompt_ids, np.int32).ravel()
+            req = self._queue[0]
+            # a preempted request resumes with prompt + generated-so-far
+            ids = getattr(req, "_resume_ids", None)
+            if ids is None:
+                ids = np.asarray(req.prompt_ids, np.int32).ravel()
             s0 = ids.size
+            if self.paged:
+                # admit only if the prompt's pages fit AND the active slots'
+                # imminent growth (next chunk) keeps its headroom — otherwise
+                # a fresh admit would be preempted by _ensure_growth in the
+                # same step, wasting its full-prompt prefill
+                headroom = sum(
+                    self._blocks_needed(int(self._pos[s]) + self.chunk - 1)
+                    - len(self._slot_blocks[s])
+                    for s in range(self.max_batch)
+                    if self._slot_req[s] is not None)
+                need = self._blocks_needed(s0 - 1)
+                if (len(self._free) < need + headroom
+                        or not self._alloc_to(slot, need)):
+                    # roll back any partial allocation on this EMPTY slot —
+                    # stranded pages are invisible to every release path
+                    self._release(slot)
+                    break  # pool dry: keep queue order, retry next step
+                self._slot_age[slot] = self._admit_seq
+                self._admit_seq += 1
+            self._queue.pop(0)
+            if hasattr(req, "_resume_ids"):
+                del req._resume_ids
             bucket = min(_bucket(s0), self.max_seq)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :s0] = ids
             # the last real token is fed to decode, not prefill, so its
             # logits come from the decode step (standard split)
+            slot_arg = (jnp.asarray(self._table[slot]) if self.paged
+                        else jnp.asarray(slot, jnp.int32))
             self.cache_k, self.cache_v = self._prefill(
                 self.params, jnp.asarray(padded), self.cache_k, self.cache_v,
-                jnp.asarray(slot, jnp.int32), jnp.asarray(s0 - 1, jnp.int32),
-                bucket)
+                slot_arg, jnp.asarray(s0 - 1, jnp.int32), bucket)
             self._slot_req[slot] = req
             self._pos[slot] = s0 - 1
             self._last_tok[slot] = ids[-1]
@@ -237,19 +436,24 @@ class ContinuousBatchingEngine:
     def _retire(self, slot):
         self._slot_req[slot].finished = True
         self._slot_req[slot] = None
+        if self.paged:
+            self._release(slot)
 
     def step(self) -> bool:
         """One admit + decode-chunk iteration.  Returns False when idle."""
         self._admit()
+        k = self.chunk
+        if self.paged:
+            self._ensure_growth(k)  # may preempt the youngest slot
         active_np = np.asarray([r is not None for r in self._slot_req])
         if not active_np.any():
             return False
-        k = self.chunk
         t0 = time.perf_counter()
+        extra = (jnp.asarray(self._table),) if self.paged else ()
         toks, self.cache_k, self.cache_v = self._decode(
             self.params, self.cache_k, self.cache_v,
             jnp.asarray(self._last_tok), jnp.asarray(self._pos),
-            jnp.asarray(active_np))
+            jnp.asarray(active_np), *extra)
         toks_np = np.asarray(toks)  # [k, B] — ONE host round-trip per chunk
         self.stats["decode_time_s"] += time.perf_counter() - t0
         self.stats["decode_steps"] += k
