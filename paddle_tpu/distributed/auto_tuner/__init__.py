@@ -16,3 +16,4 @@ from .tuner import AutoTuner, TunerConfig  # noqa: F401
 from .prune import prune_candidates, default_prune_rules  # noqa: F401
 from .cost_model import estimate_cost  # noqa: F401
 from .recorder import HistoryRecorder  # noqa: F401
+from .trial_runner import make_llama_trial_runner  # noqa: F401

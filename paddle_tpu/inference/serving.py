@@ -229,16 +229,16 @@ class ContinuousBatchingEngine:
     def _decode_impl(self, params, cache_k, cache_v, tokens, pos, active):
         return self._chunk_scan(params, cache_k, cache_v, tokens, pos, active)
 
-    def _prefill_impl(self, params, ids, cache_k, cache_v, slot, length, bucket):
-        """Prefill one request (batch 1, prompt padded to ``bucket``) directly
-        into lane ``slot`` of the (donated) cache pools.
+    def _prefill_body(self, params, ids, cache_k, cache_v, length, bucket,
+                      make_write):
+        """Shared prefill: embed/rope/mask once, write-path injected (dense
+        lane vs paged block table) so mask/rope fixes cannot diverge.
 
         Tokens at or beyond ``length`` are padding and masked out of attention
         (they still write cache positions, which the causal mask makes
         unreachable until the slot's pos pointer passes them — it never does,
         decode overwrites).  No logits are computed: the last real prompt
-        token is fed to the first decode step instead (standard split).
-        """
+        token is fed to the first decode step instead (standard split)."""
         from .. import inference as _inf
         from ..ops.pallas import rope as rope_mod
 
@@ -253,21 +253,31 @@ class ContinuousBatchingEngine:
         kv_pos = jnp.arange(S)[None, None, None, None, :]
         q_pos = jnp.arange(bucket)[None, None, None, :, None]
         mask = (kv_pos <= q_pos) & (kv_pos < length)
+        _, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
+                                           make_write(), mask, cos, sin)
+        return ak, av
 
+    def _prefill_impl(self, params, ids, cache_k, cache_v, slot, length, bucket):
+        """Prefill one request (batch 1, prompt padded to ``bucket``) directly
+        into lane ``slot`` of the (donated) cache pools."""
+        cfg = self.cfg
+        S = self.max_seq
         nkv = cfg.num_key_value_heads
 
-        def write(ck, k):
-            # ck [B, nkv, S, hd] pool layer; commit this request's K/V into
-            # lane `slot` positions [0:bucket], attend over that lane only
-            out = jax.lax.dynamic_update_slice(
-                ck, k.transpose(0, 2, 1, 3), (slot, 0, 0, 0))
-            view = jax.lax.dynamic_slice(
-                out, (slot, 0, 0, 0), (1, nkv, S, cfg.head_dim))
-            return out, view
+        def make_write():
+            def write(ck, k):
+                # ck [B, nkv, S, hd] pool layer; commit this request's K/V
+                # into lane `slot` positions [0:bucket], attend on that lane
+                out = jax.lax.dynamic_update_slice(
+                    ck, k.transpose(0, 2, 1, 3), (slot, 0, 0, 0))
+                view = jax.lax.dynamic_slice(
+                    out, (slot, 0, 0, 0), (1, nkv, S, cfg.head_dim))
+                return out, view
 
-        _, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
-                                           write, mask, cos, sin)
-        return ak, av
+            return write
+
+        return self._prefill_body(params, ids, cache_k, cache_v, length,
+                                  bucket, make_write)
 
     # ---------------- paged (block-table) compiled programs ----------------
 
@@ -281,38 +291,28 @@ class ContinuousBatchingEngine:
         """Prefill into the slot's pages: prompt position j writes page
         table_row[j // bs] offset j % bs; padding positions whose page is
         the unallocated sentinel drop (and are masked from attention)."""
-        from .. import inference as _inf
-        from ..ops.pallas import rope as rope_mod
-
         cfg = self.cfg
         S = self.max_seq
         bs_ = self.block_size
         nkv, hd = cfg.num_key_value_heads, cfg.head_dim
-        x = jnp.take(params["embed"], ids, axis=0).astype(cfg.dtype)
-        cos_full, sin_full = rope_mod.rope_cos_sin(S, cfg.head_dim,
-                                                   base=cfg.rope_theta,
-                                                   dtype=cfg.dtype)
-        cos = cos_full[:, :bucket]
-        sin = sin_full[:, :bucket]
-        kv_pos = jnp.arange(S)[None, None, None, None, :]
-        q_pos = jnp.arange(bucket)[None, None, None, :, None]
-        mask = (kv_pos <= q_pos) & (kv_pos < length)
         j = jnp.arange(bucket)
         blk_j = table_row[j // bs_]                          # [bucket]
         off_j = j % bs_
 
-        def write(ck, k):
-            # k [1, bucket, nkv, hd] -> scatter each prompt position into
-            # its page; view = this slot's gathered pages, batch-1
-            out = ck.at[blk_j, :, off_j].set(k[0], mode="drop")
-            view = jnp.take(out, table_row, axis=0,          # [maxblk, nkv, bs, hd]
-                            mode="fill", fill_value=0)       # sentinel -> zeros
-            view = view.transpose(1, 0, 2, 3).reshape(1, nkv, S, hd)
-            return out, view
+        def make_write():
+            def write(ck, k):
+                # k [1, bucket, nkv, hd] -> scatter each prompt position into
+                # its page; view = this slot's gathered pages, batch-1
+                out = ck.at[blk_j, :, off_j].set(k[0], mode="drop")
+                view = jnp.take(out, table_row, axis=0,      # [maxblk, nkv, bs, hd]
+                                mode="fill", fill_value=0)   # sentinel -> zeros
+                view = view.transpose(1, 0, 2, 3).reshape(1, nkv, S, hd)
+                return out, view
 
-        _, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
-                                           write, mask, cos, sin)
-        return ak, av
+            return write
+
+        return self._prefill_body(params, ids, cache_k, cache_v, length,
+                                  bucket, make_write)
 
     # ---------------- block allocator (host control plane) ----------------
 
@@ -343,6 +343,9 @@ class ContinuousBatchingEngine:
         ids = np.concatenate([np.asarray(req.prompt_ids, np.int32).ravel(),
                               np.asarray(req.output_ids, np.int32)])
         req._resume_ids = ids
+        # keep seniority across the round trip: a resumed request must not
+        # become the youngest slot and the repeat victim (preemption thrash)
+        req._resume_age = int(self._slot_age[slot])
         self._release(slot)
         self._slot_req[slot] = None
         self._queue.insert(0, req)
@@ -407,17 +410,23 @@ class ContinuousBatchingEngine:
                     for s in range(self.max_batch)
                     if self._slot_req[s] is not None)
                 need = self._blocks_needed(s0 - 1)
-                if (len(self._free) < need + headroom
+                # gate on the new slot's own first-chunk growth too, or
+                # _ensure_growth would preempt someone in this same step
+                gate = self._blocks_needed(s0 - 2 + self.chunk)
+                if (len(self._free) < gate + headroom
                         or not self._alloc_to(slot, need)):
                     # roll back any partial allocation on this EMPTY slot —
                     # stranded pages are invisible to every release path
                     self._release(slot)
                     break  # pool dry: keep queue order, retry next step
-                self._slot_age[slot] = self._admit_seq
+                age = getattr(req, "_resume_age", None)
+                self._slot_age[slot] = self._admit_seq if age is None else age
                 self._admit_seq += 1
             self._queue.pop(0)
             if hasattr(req, "_resume_ids"):
                 del req._resume_ids
+            if hasattr(req, "_resume_age"):
+                del req._resume_age
             bucket = min(_bucket(s0), self.max_seq)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :s0] = ids
