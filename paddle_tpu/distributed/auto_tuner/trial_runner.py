@@ -2,11 +2,13 @@
 launches each surviving candidate as a REAL distributed trial job and records
 its metric; this is the TPU/mesh analog).
 
-``make_llama_trial_runner`` returns a ``run_trial(candidate) -> step_time``
+``make_llama_trial_runner`` returns a ``run_trial(candidate) -> metric``
 callable for :class:`..auto_tuner.tuner.AutoTuner`: it builds the Llama train
 step on the candidate's mesh factorization (real devices when present, the
 8-virtual-CPU mesh in tests), jits one step for compile, times the next N
-with a host-fetch barrier, and returns mean seconds/step.  A candidate that
+with a host-fetch barrier, and returns mean SECONDS PER SAMPLE (the batch
+weak-scales with the factorization, so per-sample time — throughput rank —
+is the comparable unit; see make_llama_trial_runner).  A candidate that
 fails to build or OOMs raises — the tuner records the error and moves on,
 exactly the reference's failed-trial semantics.
 """
@@ -30,6 +32,12 @@ def make_llama_trial_runner(model_cfg=None, seq: int = 64,
     ``micro_batch_size`` scales rows per (dp x sharding) shard per
     microbatch; ``use_recompute`` selects the remat policy the model reads
     at trace time (PADDLE_TPU_REMAT).
+
+    Metric: the batch weak-scales with the factorization (dp x sharding x
+    microbatches), so the returned metric is SECONDS PER SAMPLE, not raw
+    step time — candidates are ranked by throughput, and an mp=2 candidate
+    (half the tokens/step of dp=2) can't win merely by doing less work per
+    step.
     """
     import jax
     import jax.numpy as jnp
@@ -56,6 +64,9 @@ def make_llama_trial_runner(model_cfg=None, seq: int = 64,
 
         mbs = int(cand.get("micro_batch_size", 1))
         M = pp if pp > 1 else 1                    # microbatches
+        # weak-scaled batch, normalized to seconds/sample below so an mp=2
+        # candidate (half the tokens/step of dp=2) can't win on raw step
+        # time while losing on throughput
         batch = max(1, mbs * micro_rows) * dp * shard * M
         prev = os.environ.get("PADDLE_TPU_REMAT")
         os.environ["PADDLE_TPU_REMAT"] = (
@@ -74,11 +85,12 @@ def make_llama_trial_runner(model_cfg=None, seq: int = 64,
             for _ in range(max(1, warmup)):  # >=1: compile must stay untimed
                 loss, params, opt_state = step_fn(params, opt_state, ids, labels)
             float(loss)  # host fetch = the only reliable barrier on the relay
+            n_steps = max(1, steps)
             t0 = time.perf_counter()
-            for _ in range(steps):
+            for _ in range(n_steps):
                 loss, params, opt_state = step_fn(params, opt_state, ids, labels)
             float(loss)
-            return (time.perf_counter() - t0) / steps
+            return (time.perf_counter() - t0) / n_steps / batch
         finally:
             if prev is None:
                 os.environ.pop("PADDLE_TPU_REMAT", None)

@@ -230,7 +230,7 @@ class ContinuousBatchingEngine:
         return self._chunk_scan(params, cache_k, cache_v, tokens, pos, active)
 
     def _prefill_body(self, params, ids, cache_k, cache_v, length, bucket,
-                      make_write):
+                      write):
         """Shared prefill: embed/rope/mask once, write-path injected (dense
         lane vs paged block table) so mask/rope fixes cannot diverge.
 
@@ -254,7 +254,7 @@ class ContinuousBatchingEngine:
         q_pos = jnp.arange(bucket)[None, None, None, :, None]
         mask = (kv_pos <= q_pos) & (kv_pos < length)
         _, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
-                                           make_write(), mask, cos, sin)
+                                           write, mask, cos, sin)
         return ak, av
 
     def _prefill_impl(self, params, ids, cache_k, cache_v, slot, length, bucket):
@@ -264,20 +264,17 @@ class ContinuousBatchingEngine:
         S = self.max_seq
         nkv = cfg.num_key_value_heads
 
-        def make_write():
-            def write(ck, k):
-                # ck [B, nkv, S, hd] pool layer; commit this request's K/V
-                # into lane `slot` positions [0:bucket], attend on that lane
-                out = jax.lax.dynamic_update_slice(
-                    ck, k.transpose(0, 2, 1, 3), (slot, 0, 0, 0))
-                view = jax.lax.dynamic_slice(
-                    out, (slot, 0, 0, 0), (1, nkv, S, cfg.head_dim))
-                return out, view
-
-            return write
+        def write(ck, k):
+            # ck [B, nkv, S, hd] pool layer; commit this request's K/V
+            # into lane `slot` positions [0:bucket], attend on that lane
+            out = jax.lax.dynamic_update_slice(
+                ck, k.transpose(0, 2, 1, 3), (slot, 0, 0, 0))
+            view = jax.lax.dynamic_slice(
+                out, (slot, 0, 0, 0), (1, nkv, S, cfg.head_dim))
+            return out, view
 
         return self._prefill_body(params, ids, cache_k, cache_v, length,
-                                  bucket, make_write)
+                                  bucket, write)
 
     # ---------------- paged (block-table) compiled programs ----------------
 
@@ -299,20 +296,17 @@ class ContinuousBatchingEngine:
         blk_j = table_row[j // bs_]                          # [bucket]
         off_j = j % bs_
 
-        def make_write():
-            def write(ck, k):
-                # k [1, bucket, nkv, hd] -> scatter each prompt position into
-                # its page; view = this slot's gathered pages, batch-1
-                out = ck.at[blk_j, :, off_j].set(k[0], mode="drop")
-                view = jnp.take(out, table_row, axis=0,      # [maxblk, nkv, bs, hd]
-                                mode="fill", fill_value=0)   # sentinel -> zeros
-                view = view.transpose(1, 0, 2, 3).reshape(1, nkv, S, hd)
-                return out, view
-
-            return write
+        def write(ck, k):
+            # k [1, bucket, nkv, hd] -> scatter each prompt position into
+            # its page; view = this slot's gathered pages, batch-1
+            out = ck.at[blk_j, :, off_j].set(k[0], mode="drop")
+            view = jnp.take(out, table_row, axis=0,          # [maxblk, nkv, bs, hd]
+                            mode="fill", fill_value=0)       # sentinel -> zeros
+            view = view.transpose(1, 0, 2, 3).reshape(1, nkv, S, hd)
+            return out, view
 
         return self._prefill_body(params, ids, cache_k, cache_v, length,
-                                  bucket, make_write)
+                                  bucket, write)
 
     # ---------------- block allocator (host control plane) ----------------
 

@@ -1,6 +1,7 @@
 """Auto-tuner tests (mirrors test/auto_tuner/: pruning rules + search)."""
 
 import numpy as np
+import pytest
 
 from paddle_tpu.distributed.auto_tuner import AutoTuner, TunerConfig, estimate_cost, prune_candidates
 
