@@ -377,9 +377,10 @@ def fused_multi_transformer(
     x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     linear_weights, linear_biases, ffn_ln_scales, ffn_ln_biases,
     ffn1_weights, ffn1_biases, ffn2_weights, ffn2_biases,
-    pre_layer_norm=True, epsilon=1e-5, cache_kvs=None, time_step=None,
-    attn_mask=None, dropout_rate=0.0, activation="gelu",
-    training=False, mode="upscale_in_train", name=None,
+    pre_layer_norm=True, epsilon=1e-5, cache_kvs=None, rotary_embs=None,
+    time_step=None, attn_mask=None, dropout_rate=0.0, rotary_emb_dims=0,
+    activation="gelu", training=False, mode="upscale_in_train",
+    use_neox_rotary_style=False, gqa_group_size=-1, name=None,
 ):
     """The reference's whole-decoder fused op (fused_ops.yaml:394,
     python/paddle/incubate/nn/functional/fused_transformer.py
@@ -392,10 +393,15 @@ def fused_multi_transformer(
     decode with ``time_step=t`` appends the single new token at position t
     and attends over the first t+1 cache slots).
 
-    Shapes (reference layout): x [b, s, e]; qkv_weights[i] [3, nh, hd, e];
+    Shapes (reference layout): x [b, s, e]; qkv_weights[i] [3, nh, hd, e]
+    (MHA) or, with ``gqa_group_size=kvh`` kv heads, [nh + 2*kvh, hd, e]
+    (the reference's GQA packing, infermeta/fusion.cc:195);
     linear_weights[i] [nh*hd, e]; ffn1 [e, di]; ffn2 [di, e];
-    cache_kvs[i] [2, b, nh, S, hd].  Returns (out, cache_kvs) when caches are
-    given, else out — functional in place of the reference's in-place ``_``.
+    cache_kvs[i] [2, b, nh_or_kvh, S, hd].  ``rotary_embs`` [2, b, 1, S, hd]
+    holds (cos, sin) per position; ``use_neox_rotary_style`` selects
+    half-rotation (NeoX) vs interleaved-pair (GPT-J) application.  Returns
+    (out, cache_kvs) when caches are given, else out — functional in place
+    of the reference's in-place ``_``.
     """
     import jax
     import numpy as np
@@ -405,10 +411,37 @@ def fused_multi_transformer(
             "fused_multi_transformer: dropout in training mode is not "
             "implemented (inference/serving op here); use the nn.Layer stack "
             "for dropout training")
+    if rotary_emb_dims not in (0, 1):
+        raise NotImplementedError(
+            "fused_multi_transformer: rotary_emb_dims=2 (2D/GLM rotary with "
+            "pos_extra_ids) is not supported")
+    if rotary_emb_dims == 1 and rotary_embs is None:
+        raise ValueError("rotary_emb_dims=1 requires rotary_embs")
+    if rotary_embs is not None and rotary_emb_dims == 0:
+        # the reference kernel's rotary loop runs rotary_emb_dims times, so
+        # dims=0 would silently IGNORE the supplied table — reject instead
+        raise ValueError(
+            "rotary_embs given but rotary_emb_dims=0 (the reference ignores "
+            "the table in this case); pass rotary_emb_dims=1 to apply rotary")
     act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[activation]
     L = len(qkv_weights)
     use_cache = cache_kvs is not None
     decode = time_step is not None
+    use_rotary = rotary_embs is not None and rotary_emb_dims > 0
+    gqa = gqa_group_size > 0
+
+    def apply_rotary(u, cos, sin):
+        # u [b, s, n, hd]; cos/sin [b, s, hd] (broadcast over heads)
+        cos = cos[:, :, None]
+        sin = sin[:, :, None]
+        if use_neox_rotary_style:
+            u1, u2 = jnp.split(u, 2, axis=-1)
+            rot = jnp.concatenate([-u2, u1], axis=-1)
+        else:
+            # GPT-J interleaved pairs: (x0, x1) -> (-x1, x0)
+            rot = jnp.stack([-u[..., 1::2], u[..., 0::2]],
+                            axis=-1).reshape(u.shape)
+        return u * cos + rot * sin
 
     def ln(v, scale_, bias_, eps):
         mu = jnp.mean(v, axis=-1, keepdims=True)
@@ -417,14 +450,36 @@ def fused_multi_transformer(
         return out * scale_ + (bias_ if bias_ is not None else 0.0)
 
     def one_layer(xv, lns, lnb, qkvw, qkvb, lw, lb, flns, flnb, f1w, f1b,
-                  f2w, f2b, cache, t):
+                  f2w, f2b, cache, t, rot):
         b, s, e = xv.shape
-        _, nh, hd, _ = qkvw.shape
         h = ln(xv, lns, lnb, epsilon) if pre_layer_norm else xv
-        qkv = jnp.einsum("bse,cnde->bscnd", h, qkvw)  # [b, s, 3, nh, hd]
-        if qkvb is not None:
-            qkv = qkv + qkvb[None, None]
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b, s, nh, hd]
+        if gqa:
+            # GQA packing [nh + 2*kvh, hd, e] (infermeta/fusion.cc:195)
+            total, hd, _ = qkvw.shape
+            kvh = gqa_group_size
+            nh = total - 2 * kvh
+            qkv = jnp.einsum("bse,nde->bsnd", h, qkvw)  # [b, s, nh+2kvh, hd]
+            if qkvb is not None:
+                qkv = qkv + qkvb[None, None]
+            q = qkv[:, :, :nh]
+            k = qkv[:, :, nh:nh + kvh]
+            v = qkv[:, :, nh + kvh:]
+        else:
+            _, nh, hd, _ = qkvw.shape
+            qkv = jnp.einsum("bse,cnde->bscnd", h, qkvw)  # [b, s, 3, nh, hd]
+            if qkvb is not None:
+                qkv = qkv + qkvb[None, None]
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b, s, nh, hd]
+        if rot is not None:
+            # rot [2, b, 1, S, hd]: slice this call's positions — [0, s) for
+            # prefill, position t for the single decode token
+            if decode:
+                cs = jax.lax.dynamic_slice_in_dim(rot[:, :, 0], t, 1, axis=2)
+            else:
+                cs = rot[:, :, 0, :s]
+            cos_p, sin_p = cs[0], cs[1]                # [b, s, hd]
+            q = apply_rotary(q, cos_p, sin_p)
+            k = apply_rotary(k, cos_p, sin_p)
         # causal is the DEFAULT only when no attn_mask is given (the
         # reference op applies solely the caller's mask — an encoder-style
         # bidirectional mask must be expressible); cache-validity bounds are
@@ -459,6 +514,10 @@ def fused_multi_transformer(
                 kv_mask = jnp.arange(s)[None, None, None, :] <= q_pos
             else:
                 kv_mask = jnp.ones((1, 1, 1, s), bool)
+        if gqa:
+            # each group of nh//kvh query heads shares one kv head
+            kk = jnp.repeat(kk, nh // gqa_group_size, axis=1)
+            vv = jnp.repeat(vv, nh // gqa_group_size, axis=1)
         logits = jnp.einsum("bsnd,bnSd->bnsS", q.astype(jnp.float32),
                             kk.astype(jnp.float32)) / np.sqrt(hd)
         logits = jnp.where(kv_mask, logits, -1e30)
@@ -485,7 +544,10 @@ def fused_multi_transformer(
         t = None
         if decode:
             t = jnp.asarray(_unwrap(time_step), jnp.int32).reshape(())
-        per = 12  # tensors per layer in `flat` (before caches)
+        per = 12  # tensors per layer in `flat` (before caches/rotary)
+        rot = flat[-1] if use_rotary else None
+        if use_rotary:
+            flat = flat[:-1]
         caches = list(flat[per * L:]) if use_cache else [None] * L
         new_caches = []
         out = xv
@@ -493,7 +555,7 @@ def fused_multi_transformer(
             lns, lnb, qkvw, qkvb, lw, lb, flns, flnb, f1w, f1b, f2w, f2b = (
                 flat[per * i: per * (i + 1)])
             out, c = one_layer(out, lns, lnb, qkvw, qkvb, lw, lb, flns, flnb,
-                               f1w, f1b, f2w, f2b, caches[i], t)
+                               f1w, f1b, f2w, f2b, caches[i], t, rot)
             new_caches.append(c)
         if use_cache:
             return tuple([out] + new_caches)
@@ -515,7 +577,8 @@ def fused_multi_transformer(
     # silently promote a bf16 residual stream through every bias add)
     xdt = _unwrap(x).dtype
     flat = [f if f is not None else jnp.zeros((), xdt) for f in flat]
-    inputs = [x] + flat + (list(cache_kvs) if use_cache else [])
+    inputs = ([x] + flat + (list(cache_kvs) if use_cache else [])
+              + ([rotary_embs] if use_rotary else []))
     res = apply_op("fused_multi_transformer", fn, inputs)
     if use_cache:
         return res[0], list(res[1:])

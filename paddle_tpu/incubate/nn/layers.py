@@ -253,13 +253,15 @@ class FusedMultiTransformer(nn.Layer):
                 "layout) is not supported; use the default layout")
         if norm_type != "layernorm":
             raise NotImplementedError(f"norm_type {norm_type!r} not supported")
-        if use_neox_rotary_style or gqa_group_size > 0:
-            raise NotImplementedError(
-                "rotary embedding / GQA variants are not wired into "
-                "fused_multi_transformer; use models.llama for GQA+RoPE")
         if residual_alpha != 1.0:
             raise NotImplementedError("residual_alpha != 1.0 not supported")
         assert embed_dim > 0 and num_heads > 0
+        if gqa_group_size > 0 and num_heads % gqa_group_size:
+            raise ValueError(
+                f"num_heads={num_heads} must divide by "
+                f"gqa_group_size={gqa_group_size} (kv heads)")
+        self.use_neox_rotary_style = use_neox_rotary_style
+        self.gqa_group_size = gqa_group_size
         if num_layers < 0:
             num_layers = (len(qkv_weight_attrs)
                           if isinstance(qkv_weight_attrs, (list, tuple)) else 1)
@@ -286,9 +288,15 @@ class FusedMultiTransformer(nn.Layer):
 
         self.ln_scales = plist("ln_scale", (embed_dim,), ln_scale_attrs, init=one)
         self.ln_biases = plist("ln_bias", (embed_dim,), ln_bias_attrs, bias=True)
-        self.qkv_weights = plist("qkv_weight", (3, nh, hd, embed_dim),
-                                 qkv_weight_attrs)
-        self.qkv_biases = plist("qkv_bias", (3, nh, hd), qkv_bias_attrs, bias=True)
+        if gqa_group_size > 0:
+            # GQA packing: q heads then kv heads (infermeta/fusion.cc:195)
+            qkv_shape = (nh + 2 * gqa_group_size, hd, embed_dim)
+            qkv_b_shape = (nh + 2 * gqa_group_size, hd)
+        else:
+            qkv_shape = (3, nh, hd, embed_dim)
+            qkv_b_shape = (3, nh, hd)
+        self.qkv_weights = plist("qkv_weight", qkv_shape, qkv_weight_attrs)
+        self.qkv_biases = plist("qkv_bias", qkv_b_shape, qkv_bias_attrs, bias=True)
         self.linear_weights = plist("linear_weight", (nh * hd, embed_dim),
                                     linear_weight_attrs)
         self.linear_biases = plist("linear_bias", (embed_dim,),
@@ -306,13 +314,17 @@ class FusedMultiTransformer(nn.Layer):
         self.ffn2_biases = plist("ffn2_bias", (embed_dim,), ffn2_bias_attrs,
                                  bias=True)
 
-    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+    def forward(self, src, attn_mask=None, caches=None, rotary_embs=None,
+                rotary_emb_dims=0, time_step=None):
         return F.fused_multi_transformer(
             src, self.ln_scales, self.ln_biases, self.qkv_weights,
             self.qkv_biases, self.linear_weights, self.linear_biases,
             self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
             self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
             pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
-            cache_kvs=caches, time_step=time_step, attn_mask=attn_mask,
-            dropout_rate=self.dropout_rate, activation=self.activation,
-            training=self.training)
+            cache_kvs=caches, rotary_embs=rotary_embs, time_step=time_step,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            rotary_emb_dims=rotary_emb_dims, activation=self.activation,
+            training=self.training,
+            use_neox_rotary_style=self.use_neox_rotary_style,
+            gqa_group_size=self.gqa_group_size)

@@ -19,6 +19,7 @@ compare), not merely structural.
 
 from __future__ import annotations
 
+import os
 import warnings
 
 import jax
@@ -125,6 +126,7 @@ _ELEMENTWISE = {
     "neg": "Neg", "exp": "Exp", "log": "Log", "tanh": "Tanh",
     "logistic": "Sigmoid", "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign",
     "floor": "Floor", "ceil": "Ceil", "erf": "Erf", "rsqrt": None,  # composite
+    "cos": "Cos", "sin": "Sin",
     "and": "And", "or": "Or", "not": "Not", "xor": "Xor",
 }
 _COMPARE = {"eq": "Equal", "gt": "Greater", "ge": "GreaterOrEqual",
@@ -279,6 +281,28 @@ class _Converter:
                          for i in range(len(aval.shape))]), aval.shape),
                 aval.shape).astype(np.dtype(params["dtype"]) if str(params["dtype"]) != "bfloat16" else np.float32)
             out = self.emit("Identity", [self.add_const(arr, "iota")])
+        elif p == "split":
+            sizes = self.add_const(np.asarray(params["sizes"], np.int64))
+            out = self.emit("Split", [ins[0], sizes],
+                            n_out=len(params["sizes"]),
+                            attrs=[_attr_int("axis", params["axis"])])
+        elif p == "reduce_and":
+            # ONNX has no ReduceAnd: all(x) == min over int casts
+            i32 = self.emit("Cast", ins, attrs=[_attr_int("to", 6)])
+            red = self.emit("ReduceMin", [i32],
+                            attrs=[_attr_ints("axes", params["axes"]),
+                                   _attr_int("keepdims", 0)])
+            out = self.emit("Cast", [red], attrs=[_attr_int("to", 9)])
+        elif p == "reduce_or":
+            i32 = self.emit("Cast", ins, attrs=[_attr_int("to", 6)])
+            red = self.emit("ReduceMax", [i32],
+                            attrs=[_attr_ints("axes", params["axes"]),
+                                   _attr_int("keepdims", 0)])
+            out = self.emit("Cast", [red], attrs=[_attr_int("to", 9)])
+        elif p == "gather":
+            out = self.gather(eqn, ins)
+        elif p == "scan":
+            out = self.scan(eqn, ins)
         else:
             raise NotImplementedError(
                 f"ONNX export: unsupported primitive {p!r} "
@@ -378,6 +402,131 @@ class _Converter:
         area = self.add_const(np.asarray(float(np.prod(kernel)), np.float32))
         return self.emit("Mul", [avg, area])
 
+    def gather(self, eqn, ins):
+        """lax.gather restricted to the take-along-one-axis pattern (the
+        embedding-lookup / table-index shape jnp.take emits): one indexed
+        axis, full slices elsewhere — maps to ONNX Gather(axis).  Anything
+        fancier (multi-axis starts, batching dims) is a loud
+        NotImplementedError."""
+        pr = eqn.params
+        dn = pr["dimension_numbers"]
+        op_shape = tuple(eqn.invars[0].aval.shape)
+        idx_shape = tuple(eqn.invars[1].aval.shape)
+        slice_sizes = tuple(pr["slice_sizes"])
+        if (len(dn.start_index_map) != 1
+                or tuple(dn.collapsed_slice_dims) != tuple(dn.start_index_map)
+                or getattr(dn, "operand_batching_dims", ()) != ()
+                or idx_shape[-1:] != (1,)):
+            raise NotImplementedError(
+                f"ONNX export: gather pattern {dn} is not a single-axis take")
+        axis = dn.start_index_map[0]
+        if (slice_sizes[axis] != 1
+                or any(slice_sizes[d] != op_shape[d]
+                       for d in range(len(op_shape)) if d != axis)):
+            raise NotImplementedError(
+                f"ONNX export: gather slice_sizes {slice_sizes} is not a "
+                "single-axis take")
+        # drop the trailing index-vector dim of 1
+        ishape = self.add_const(np.asarray(idx_shape[:-1], np.int64))
+        idx = self.emit("Reshape", [ins[1], ishape])
+        # OOB semantics: CLIP / PROMISE_IN_BOUNDS export as a clamped Gather;
+        # FILL_OR_DROP (jnp.take's default) additionally masks OOB rows to
+        # the fill value so the round trip is faithful even out of range
+        mode = str(pr.get("mode"))
+        dim = op_shape[axis]
+        lo = self.add_const(np.asarray(0, np.int64))
+        hi = self.add_const(np.asarray(dim - 1, np.int64))
+        idx64 = self.emit("Cast", [idx], attrs=[_attr_int("to", 7)])
+        clamped = self.emit("Min", [self.emit("Max", [idx64, lo]), hi])
+        got = self.emit("Gather", [ins[0], clamped],
+                        attrs=[_attr_int("axis", axis)])
+        # Gather output = op[:axis] + idx_shape + op[axis+1:]; jax's
+        # offset_dims choose where slice dims land — verify they agree,
+        # else fix up with a Reshape/Transpose only for the pure-take case
+        onnx_shape = (op_shape[:axis] + idx_shape[:-1] + op_shape[axis + 1:])
+        want = tuple(eqn.outvars[0].aval.shape)
+        if onnx_shape != want:
+            raise NotImplementedError(
+                f"ONNX export: gather output layout {want} != Gather's "
+                f"{onnx_shape} (non-trailing offset_dims)")
+        if "FILL_OR_DROP" not in mode:
+            return got
+        out_dtype = np.dtype(eqn.outvars[0].aval.dtype)
+        if not np.issubdtype(out_dtype, np.floating):
+            # integer fill default is dtype-min; nobody round-trips OOB int
+            # gathers on purpose — stay loud rather than guess
+            raise NotImplementedError(
+                "ONNX export: gather mode=fill on non-float dtypes")
+        fv = pr.get("fill_value")
+        fill = self.add_const(np.asarray(np.nan if fv is None else fv,
+                                         np.float32))
+        valid = self.emit("And", [
+            self.emit("GreaterOrEqual", [idx64, lo]),
+            self.emit("LessOrEqual", [idx64, hi])])
+        # broadcast the [idx...] mask over the gathered slice dims
+        vshape = self.add_const(np.asarray(
+            (1,) * axis + idx_shape[:-1]
+            + (1,) * (len(op_shape) - axis - 1), np.int64))
+        vmask = self.emit("Reshape", [valid, vshape])
+        return self.emit("Where", [vmask, got, fill])
+
+    def scan(self, eqn, ins):
+        """lax.scan unrolled: ``length`` is static under jit, so the loop
+        becomes ``length`` copies of the body with per-iteration Slice of
+        each stacked xs input, and ys outputs re-stacked with Concat.  This
+        trades file size for zero control-flow ops — the exported graph
+        stays in the basic ONNX profile the bundled runtime executes."""
+        pr = eqn.params
+        closed = pr["jaxpr"]
+        inner = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+        consts_vals = closed.consts if hasattr(closed, "consts") else []
+        L = pr["length"]
+        nc = pr["num_consts"]
+        ncar = pr["num_carry"]
+        consts = ins[:nc]
+        carry = list(ins[nc:nc + ncar])
+        xs = ins[nc + ncar:]
+        xs_vars = eqn.invars[nc + ncar:]
+        n_ys = len(eqn.outvars) - ncar
+        ys_names: list[list] = [[] for _ in range(n_ys)]
+        reverse = bool(pr.get("reverse", False))
+        order = reversed(range(L)) if reverse else range(L)
+        # loop-invariant constants hoisted: body consts would otherwise be
+        # re-serialized as fresh initializers on every unrolled iteration
+        const_names = [self.add_const(np.asarray(c), "w") for c in consts_vals]
+        ax0 = self.add_const(np.asarray([0], np.int64))
+        step1 = self.add_const(np.asarray([1], np.int64))
+        x_tgts = [self.add_const(np.asarray(tuple(v.aval.shape)[1:], np.int64))
+                  for v in xs_vars]
+        for it in order:
+            starts = self.add_const(np.asarray([it], np.int64))
+            ends = self.add_const(np.asarray([it + 1], np.int64))
+            xi = []
+            for nm, tgt in zip(xs, x_tgts):
+                sl = self.emit("Slice", [nm, starts, ends, ax0, step1])
+                xi.append(self.emit("Reshape", [sl, tgt]))
+            for var, nm in zip(inner.constvars, const_names):
+                self.names[var] = nm
+            for var, nm in zip(inner.invars, consts + carry + xi):
+                self.names[var] = nm
+            for sub_eqn in inner.eqns:
+                self.eqn(sub_eqn)
+            outs = [self.name_of(v) for v in inner.outvars]
+            carry = outs[:ncar]
+            for k, nm in enumerate(outs[ncar:]):
+                ys_names[k].append(nm)
+        result = list(carry)
+        for k in range(n_ys):
+            shp = tuple(eqn.outvars[ncar + k].aval.shape)  # [L, ...]
+            per = self.add_const(np.asarray((1,) + shp[1:], np.int64))
+            rows = ys_names[k]
+            if reverse:
+                rows = list(reversed(rows))
+            us = [self.emit("Reshape", [nm, per]) for nm in rows]
+            result.append(self.emit("Concat", us,
+                                    attrs=[_attr_int("axis", 0)]))
+        return result
+
     def broadcast_in_dim(self, eqn, ins):
         tgt = eqn.outvars[0].aval.shape
         bdims = eqn.params["broadcast_dimensions"]
@@ -437,7 +586,21 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
             out = layer(*[Tensor(x) for x in xs])
             return _unwrap(out)
 
-    closed = jax.make_jaxpr(fn)(*examples)
+    # pallas_call has no ONNX mapping: trace with every Pallas kernel routed
+    # to its XLA-composed fallback (kernel_disabled() reads this per call)
+    prev_disable = os.environ.get("PADDLE_TPU_DISABLE_PALLAS")
+    os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "all"
+    try:
+        # jit trace caches are keyed on avals, not this env var: a callable
+        # already traced with Pallas enabled would replay its cached
+        # pallas_call jaxpr straight through make_jaxpr
+        jax.clear_caches()
+        closed = jax.make_jaxpr(fn)(*examples)
+    finally:
+        if prev_disable is None:
+            os.environ.pop("PADDLE_TPU_DISABLE_PALLAS", None)
+        else:
+            os.environ["PADDLE_TPU_DISABLE_PALLAS"] = prev_disable
     conv = _Converter()
     in_names = []
     for i, (var, ex) in enumerate(zip(closed.jaxpr.invars, examples)):

@@ -186,7 +186,12 @@ class OnnxModel:
             env[nm] = np.asarray(arr)
         for node in self.nodes:
             ins = [env[i] for i in node.inputs]
-            env[node.outputs[0]] = self._exec(node, ins)
+            res = self._exec(node, ins)
+            if isinstance(res, (list, tuple)):  # multi-output (e.g. Split)
+                for nm, v in zip(node.outputs, res):
+                    env[nm] = v
+            else:
+                env[node.outputs[0]] = res
         return [env[o] for o in self.outputs]
 
     def _exec(self, node, x):
@@ -223,6 +228,17 @@ class OnnxModel:
         if op == "Floor": return np.floor(x[0])
         if op == "Ceil": return np.ceil(x[0])
         if op == "Erf": return _ERF(x[0]).astype(x[0].dtype)
+        if op == "Cos": return np.cos(x[0])
+        if op == "Sin": return np.sin(x[0])
+        if op == "Gather":
+            # the exporter pre-clamps indices (and masks fill-mode OOB rows
+            # itself); clip here is belt-and-braces, never semantics
+            return np.take(x[0], x[1].astype(np.int64), axis=a.get("axis", 0),
+                           mode="clip")
+        if op == "Split":
+            sizes = [int(d) for d in x[1]] if len(x) > 1 else a["split"]
+            idx = np.cumsum(sizes)[:-1]
+            return np.split(x[0], idx, axis=a.get("axis", 0))
         if op == "And": return np.logical_and(x[0], x[1])
         if op == "Or": return np.logical_or(x[0], x[1])
         if op == "Not": return np.logical_not(x[0])

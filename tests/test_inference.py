@@ -356,3 +356,126 @@ def test_fused_multi_transformer_bidirectional_mask():
     out3 = IF.fused_multi_transformer(paddle.to_tensor(x), *args).numpy()
     out4 = IF.fused_multi_transformer(paddle.to_tensor(x2), *args).numpy()
     np.testing.assert_allclose(out3[0, 0], out4[0, 0], rtol=1e-6)
+
+
+def test_fused_multi_transformer_gqa_matches_duplicated_kv_mha():
+    """GQA (qkv packed [nh + 2*kvh, hd, e], infermeta/fusion.cc:195) must
+    equal plain MHA whose K/V head weights are the GQA kv heads repeated
+    per group — the defining GQA identity — on both the no-cache path and
+    prefill→decode with a [2, b, kvh, S, hd] cache."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rs = np.random.RandomState(7)
+    b, s, e, nh, kvh, hd, di, S = 2, 4, 16, 4, 2, 4, 32, 8
+    rep = nh // kvh
+    mk = lambda *sh: (rs.randn(*sh) * 0.2).astype(np.float32)
+
+    qkv_g = mk(nh + 2 * kvh, hd, e)
+    qkv_gb = mk(nh + 2 * kvh, hd)
+    # MHA weights with each kv head duplicated across its group
+    q_w, k_w, v_w = qkv_g[:nh], qkv_g[nh:nh + kvh], qkv_g[nh + kvh:]
+    q_b, k_b, v_b = qkv_gb[:nh], qkv_gb[nh:nh + kvh], qkv_gb[nh + kvh:]
+    qkv_m = np.stack([q_w, np.repeat(k_w, rep, 0), np.repeat(v_w, rep, 0)])
+    qkv_mb = np.stack([q_b, np.repeat(k_b, rep, 0), np.repeat(v_b, rep, 0)])
+
+    common = dict(lns=mk(e), lnb=mk(e), lw=mk(nh * hd, e), lb=mk(e),
+                  flns=mk(e), flnb=mk(e), f1w=mk(e, di), f1b=mk(di),
+                  f2w=mk(di, e), f2b=mk(e))
+    t_ = paddle.to_tensor
+
+    def run(qkvw, qkvb, x, gqa, caches=None, time_step=None):
+        return IF.fused_multi_transformer(
+            t_(x), [t_(common["lns"])], [t_(common["lnb"])], [t_(qkvw)],
+            [t_(qkvb)], [t_(common["lw"])], [t_(common["lb"])],
+            [t_(common["flns"])], [t_(common["flnb"])], [t_(common["f1w"])],
+            [t_(common["f1b"])], [t_(common["f2w"])], [t_(common["f2b"])],
+            cache_kvs=caches, time_step=time_step,
+            gqa_group_size=kvh if gqa else -1)
+
+    x = mk(b, s, e)
+    out_g = run(qkv_g, qkv_gb, x, gqa=True).numpy()
+    out_m = run(qkv_m, qkv_mb, x, gqa=False).numpy()
+    np.testing.assert_allclose(out_g, out_m, rtol=1e-4, atol=1e-5)
+
+    # prefill + one decode step with the narrower GQA cache
+    cache_g = [t_(np.zeros((2, b, kvh, S, hd), np.float32))]
+    out_gp, cache_g = run(qkv_g, qkv_gb, x, gqa=True, caches=cache_g)
+    cache_m = [t_(np.zeros((2, b, nh, S, hd), np.float32))]
+    out_mp, cache_m = run(qkv_m, qkv_mb, x, gqa=False, caches=cache_m)
+    np.testing.assert_allclose(out_gp.numpy(), out_mp.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    tok = mk(b, 1, e)
+    ts = t_(np.int32(s))
+    out_gd, _ = run(qkv_g, qkv_gb, tok, gqa=True, caches=cache_g, time_step=ts)
+    out_md, _ = run(qkv_m, qkv_mb, tok, gqa=False, caches=cache_m, time_step=ts)
+    np.testing.assert_allclose(out_gd.numpy(), out_md.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_multi_transformer_rotary_styles():
+    """rotary_embs [2, b, 1, S, hd] application — NeoX half-rotation vs
+    GPT-J interleaved pairs — against a direct numpy oracle of the qkv
+    projection + rotation (single layer, no cache, causal)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rs = np.random.RandomState(8)
+    b, s, e, nh, hd, di = 1, 4, 8, 2, 4, 16
+    mk = lambda *sh: (rs.randn(*sh) * 0.3).astype(np.float32)
+    lns, lnb = mk(e), mk(e)
+    qkvw, qkvb = mk(3, nh, hd, e), np.zeros((3, nh, hd), np.float32)
+    lw, lb = mk(nh * hd, e), mk(e)
+    flns, flnb = mk(e), mk(e)
+    f1w, f1b, f2w, f2b = mk(e, di), mk(di), mk(di, e), mk(e)
+    x = mk(b, s, e)
+    inv = 1.0 / 10000 ** (np.arange(0, hd, 2) / hd)
+    ang = np.arange(s)[:, None] * inv[None]               # [s, hd/2]
+
+    for neox in (True, False):
+        if neox:
+            cos = np.concatenate([np.cos(ang), np.cos(ang)], -1)  # [s, hd]
+            sin = np.concatenate([np.sin(ang), np.sin(ang)], -1)
+        else:
+            cos = np.repeat(np.cos(ang), 2, axis=-1)
+            sin = np.repeat(np.sin(ang), 2, axis=-1)
+        rot = np.zeros((2, b, 1, s, hd), np.float32)
+        rot[0, :, 0] = cos
+        rot[1, :, 0] = sin
+
+        t_ = paddle.to_tensor
+        out = IF.fused_multi_transformer(
+            t_(x), [t_(lns)], [t_(lnb)], [t_(qkvw)], [t_(qkvb)], [t_(lw)],
+            [t_(lb)], [t_(flns)], [t_(flnb)], [t_(f1w)], [t_(f1b)],
+            [t_(f2w)], [t_(f2b)], rotary_embs=t_(rot), rotary_emb_dims=1,
+            use_neox_rotary_style=neox).numpy()
+
+        # numpy oracle
+        mu = x.mean(-1, keepdims=True)
+        h = (x - mu) / np.sqrt(x.var(-1, keepdims=True) + 1e-5) * lns + lnb
+        qkv = np.einsum("bse,cnde->bscnd", h, qkvw)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        def rot_np(u):
+            if neox:
+                u1, u2 = np.split(u, 2, axis=-1)
+                r = np.concatenate([-u2, u1], -1)
+            else:
+                r = np.stack([-u[..., 1::2], u[..., 0::2]], -1).reshape(u.shape)
+            return u * cos[None, :, None] + r * sin[None, :, None]
+
+        q, k = rot_np(q), rot_np(k)
+        logits = np.einsum("bsnd,bSnd->bnsS", q, k) / np.sqrt(hd)
+        causal = np.tril(np.ones((s, s), bool))
+        logits = np.where(causal[None, None], logits, -1e30)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        attn = np.einsum("bnsS,bSnd->bsnd", p, v).reshape(b, s, nh * hd)
+        xa = x + attn @ lw + lb
+        mu = xa.mean(-1, keepdims=True)
+        h2 = (xa - mu) / np.sqrt(xa.var(-1, keepdims=True) + 1e-5) * flns + flnb
+        gelu = 0.5 * (h2 @ f1w + f1b) * (
+            1 + np.tanh(np.sqrt(2 / np.pi) * ((h2 @ f1w + f1b)
+                                              + 0.044715 * (h2 @ f1w + f1b) ** 3)))
+        ref = xa + gelu @ f2w + f2b
+        np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
