@@ -20,17 +20,17 @@ TPU-native realization in two tiers:
    :func:`one_f_one_b_stacked` executes the 1F1B order in-jit on a global
    clock (no garbage FLOPs, O(P) activation ring).
 
-Why interleaved-VPP and ZB-H1 stay schedule generators (design note):
-both derive their benefit from *irregular, per-stage* tick orders (Megatron's
-staggered per-chunk warmups; ZB's W-pass splitting), which fight the
-single-SPMD-program model this engine targets — a uniform global-clock
-rendering of VPP (every stage running each of its V chunks per tick behind
-one collective permute) has bubble V*P*t_chunk, i.e. *worse* than executed
-1F1B's (P-1)*t_stage, so executing it that way would be a regression, and a
-faithful irregular rendering needs per-stage programs (multi-executable
-runner) rather than one shard_map.  The generators + golden-string tests
-keep the reference's schedule semantics testable; 1F1B is the executed
-optimum within the one-program design.
+All four reference schedules now EXECUTE in the one-program design:
+FThenB (:func:`gpipe_stacked`), 1F1B, interleaved/VPP
+(``num_chunks > 1`` — grouped round-robin microbatches make every
+cross-chunk wraparound land exactly one ppermute hop early, so VPP runs on
+the same per-tick ring with zero extra latency), and ZB-H1
+(``zero_bubble=True`` — the backward sub-tick computes only the
+critical-path activation gradient and each stage's weight grads ride its
+idle F sub-slots during the drain bubble; see the parameter doc).  The
+schedule *generators* below remain the spec oracle: golden-string tests pin
+the executed tick orders to the reference's ``static_scheduler`` output
+(pipeline_parallel.py:711, pipeline_zero_bubble.py:62).
 """
 
 from __future__ import annotations
@@ -190,7 +190,7 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
                         extra_args=(), boundary_f32=None,
                         batch_axes=(), zero_axis=None,
                         embed_specs=None, stacked_specs=None, head_specs=None,
-                        num_chunks=1):
+                        num_chunks=1, zero_bubble=False):
     """Executed 1F1B pipeline schedule as ONE compiled SPMD program (the
     reference's PipelineParallel.forward_backward_pipeline, pipeline_parallel
     .py:684, re-thought for a TPU mesh — not simulated, not AD-through-scan).
@@ -250,6 +250,23 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
       embed_specs / stacked_specs / head_specs: full PartitionSpec trees for
         the three param groups (only consulted when batch_axes is set; their
         non-manual axis entries are dropped for the shard_map specs).
+      zero_bubble: execute the ZB-H1 schedule (the reference's
+        pipeline_zero_bubble.py:62 pass) instead of plain 1F1B: the backward
+        sub-tick computes only the ACTIVATION gradient (the critical-path
+        cotangent the upstream stage waits for), and the weight gradient (W)
+        of microbatch m is deferred to the stage's idle F sub-slots after
+        its forward stream drains — tick ``k = s + M + m`` — which exist
+        precisely during the drain bubble, so W work rides the slots 1F1B
+        wastes.  Stage s hides ``Z(s) = min(M, 2(P-1) - s)`` weight grads
+        (its bubble capacity); the remainder run fused in their B sub-tick
+        exactly as 1F1B.  Total tick count is unchanged; the steady-state
+        critical path drops from (F + full-B) to (F + dx-B) for the hidden
+        fraction.  Costs: the input ring grows from O(P) to M+1 slots and a
+        second M+1-slot cotangent ring appears (ZB's known memory trade —
+        activations live until their W tick), and deferred W re-runs the
+        stage forward (the same recompute fused-B already pays once).
+        Requires ``num_chunks == 1`` and ``M >= 2(P-1) + 1`` (so every
+        stage's first idle F-slot falls after its corresponding backward).
       num_chunks: C > 1 executes the INTERLEAVED/virtual-pipeline 1F1B
         schedule (the reference's PipelineParallelWithInterleave,
         pipeline_parallel.py:1308; tick order = :func:`schedule_interleave`):
@@ -279,10 +296,16 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
         f"interleaved schedule requires microbatches ({M}) % pp ({P_}) == 0")
     total_f = M * C                      # F (and B) sub-ticks per stage
     D = 2 * (P_ - 1) + (C - 1) * P_     # B-stream clock offset
+    if zero_bubble:
+        assert C == 1, "zero_bubble composes with num_chunks=1 only"
+        assert M >= D + 1, (
+            f"ZB-H1 needs microbatches ({M}) >= 2*(pp-1)+1 ({D + 1}): the "
+            "first idle F-slot must fall after the matching backward")
     # ring: one save per tick, entry (m,c) at stage s lives from tick
     # s+idx_f(m,c) to D-2s+idx_f(m,C-1-c); max span (s=0,c=0) is
-    # D+(C-1)P, so span+1 slots never clobber a live entry
-    R = D + (C - 1) * P_ + 1
+    # D+(C-1)P, so span+1 slots never clobber a live entry.  ZB extends the
+    # lifetime to the W tick s+M+m — span exactly M.
+    R = (M + 1) if zero_bubble else (D + (C - 1) * P_ + 1)
     if C > 1:
         # full rings: the wraparound edges carry the cross-chunk handoffs
         fwd_perm = [(p, (p + 1) % P_) for p in range(P_)]
@@ -431,8 +454,10 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
             lambda g: g.astype(jnp.float32), tree)
         tree_add = lambda a, b: jax.tree_util.tree_map(jnp.add, a, b)
 
+        Z_defer = jnp.minimum(M, D - stage) if zero_bubble else None
+
         def tick(carry, k):
-            recv_f, recv_b, ring, dep, dsp, dhp, loss_acc = carry
+            recv_f, recv_b, ring, dyring, dep, dsp, dhp, loss_acc = carry
 
             # ---- F sub-tick: order_f[k - stage] = (microbatch, chunk) ----
             fi = k - stage
@@ -511,8 +536,45 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
                             scale(g_sp), scale(g_hp),
                             jax.tree_util.tree_map(lambda g: g * inv_m, g_x))
 
-                lval, g_ep, g_sp, g_hp, g_x = jax.lax.switch(
-                    branch_idx, [first_b, mid_b, last_b])
+                branches = [first_b, mid_b, last_b]
+                if zero_bubble:
+                    # ZB-H1 deferred variants: B computes only what the
+                    # upstream stage is waiting for (dx / loss); the weight
+                    # grad moves to this stage's W sub-tick
+                    def first_b_zb():
+                        # stage 0 sends no dx and all its grads are weight
+                        # grads — the whole backward defers
+                        return (jnp.float32(0), f32_zeros(embed_p),
+                                f32_zeros(stacked_p), f32_zeros(head_p),
+                                jnp.zeros(act_shape, act_dtype))
+
+                    def mid_b_zb():
+                        _, vjp_x = jax.vjp(
+                            lambda x: call_stage(stacked_p, x, bc), x_saved)
+                        (g_x,) = vjp_x(recv_b)
+                        return (jnp.float32(0), f32_zeros(embed_p),
+                                f32_zeros(stacked_p), f32_zeros(head_p), g_x)
+
+                    def last_b_zb():
+                        def full_x(x):
+                            return head_loss_fn(
+                                head_p, call_stage(stacked_p, x, bc), lbl,
+                                *extras)
+
+                        lval, g_x = jax.value_and_grad(full_x)(x_saved)
+                        inv_m = 1.0 / M_f
+                        return (lval.astype(jnp.float32) / M_f,
+                                f32_zeros(embed_p), f32_zeros(stacked_p),
+                                f32_zeros(head_p),
+                                jax.tree_util.tree_map(
+                                    lambda g: g * inv_m, g_x))
+
+                    branches += [first_b_zb, mid_b_zb, last_b_zb]
+                    deferred = (bm < Z_defer).astype(jnp.int32)
+                    sel = branch_idx + 3 * deferred
+                else:
+                    sel = branch_idx
+                lval, g_ep, g_sp, g_hp, g_x = jax.lax.switch(sel, branches)
                 return (tree_add(dep, g_ep), tree_add(dsp, g_sp),
                         tree_add(dhp, g_hp), loss_acc + lval, g_x)
 
@@ -522,20 +584,91 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
                     dep, dsp, dhp, loss_acc, jnp.zeros(act_shape, act_dtype)),
                 dep, dsp, dhp, loss_acc)
 
+            if zero_bubble:
+                # bank the incoming cotangent for the deferred W tick (last
+                # stage is loss-sourced and first-stage W re-derives dx, but
+                # both reread cheap ring slots; store uniformly except last)
+                save_dy = b_valid & (bm < Z_defer) & ~is_last
+                dyring = jax.lax.cond(
+                    save_dy,
+                    lambda r: jax.lax.dynamic_update_index_in_dim(
+                        r, recv_b, slot_b, 0),
+                    lambda r: r, dyring)
+
+                # ---- W sub-tick: weight grad of microbatch k - s - M ----
+                wi = k - stage - M
+                w_valid = (wi >= 0) & (wi < Z_defer)
+                wm = jnp.clip(wi, 0, M - 1)
+                slot_w = wm % R
+
+                def do_w(dep, dsp, dhp):
+                    x_sv = jax.lax.dynamic_index_in_dim(
+                        ring, slot_w, 0, keepdims=False)
+                    dy = jax.lax.dynamic_index_in_dim(
+                        dyring, slot_w, 0, keepdims=False)
+                    lbl_w = jax.lax.dynamic_index_in_dim(
+                        mb_lbl, wm, 0, keepdims=False)
+                    ids_w = jax.lax.dynamic_index_in_dim(
+                        mb_in, wm, 0, keepdims=False)
+                    widx = jnp.where(is_first, 0,
+                                     jnp.where(is_last, 2, 1))
+
+                    def first_w():
+                        # full stage vjp (dW and the dx the embed vjp needs)
+                        _, vjp = jax.vjp(
+                            lambda sp, x: call_stage(sp, x, 0),
+                            stacked_p, x_sv)
+                        g_sp, g_x = vjp(dy)
+                        _, evjp = jax.vjp(
+                            lambda ep: embed_fn(ep, ids_w, *extras)
+                            .astype(act_dtype), embed_p)
+                        (g_ep,) = evjp(g_x)
+                        return (f32_tree(g_ep), f32_tree(g_sp),
+                                f32_zeros(head_p))
+
+                    def mid_w():
+                        _, vjp_p = jax.vjp(
+                            lambda sp: call_stage(sp, x_sv, 0), stacked_p)
+                        (g_sp,) = vjp_p(dy)
+                        return (f32_zeros(embed_p), f32_tree(g_sp),
+                                f32_zeros(head_p))
+
+                    def last_w():
+                        def full_p(sp, hp):
+                            return head_loss_fn(
+                                hp, call_stage(sp, x_sv, 0), lbl_w, *extras)
+
+                        g_sp, g_hp = jax.grad(full_p, argnums=(0, 1))(
+                            stacked_p, head_p)
+                        inv_m = 1.0 / M_f
+                        scale = lambda t: jax.tree_util.tree_map(
+                            lambda g: g.astype(jnp.float32) * inv_m, t)
+                        return (f32_zeros(embed_p), scale(g_sp), scale(g_hp))
+
+                    g_ep, g_sp, g_hp = jax.lax.switch(
+                        widx, [first_w, mid_w, last_w])
+                    return (tree_add(dep, g_ep), tree_add(dsp, g_sp),
+                            tree_add(dhp, g_hp))
+
+                dep, dsp, dhp = jax.lax.cond(
+                    w_valid, do_w, lambda a, b, c: (a, b, c), dep, dsp, dhp)
+
             recv_f = _permute(y, fwd_perm)
             recv_b = _permute(dx, bwd_perm)
-            return (recv_f, recv_b, ring, dep, dsp, dhp, loss_acc), None
+            return (recv_f, recv_b, ring, dyring, dep, dsp, dhp, loss_acc), None
 
+        R_dy = R if zero_bubble else 1  # cotangent ring only exists for ZB
         carry0 = (
             jnp.zeros(act_shape, act_dtype),          # recv_f
             jnp.zeros(act_shape, act_dtype),          # recv_b
             jnp.zeros((R,) + act_shape, act_dtype),   # input ring
+            jnp.zeros((R_dy,) + act_shape, act_dtype),  # dy ring (ZB)
             f32_zeros(embed_p),
             f32_zeros(stacked_p),
             f32_zeros(head_p),
             jnp.float32(0),
         )
-        (_, _, _, dep, dsp, dhp, loss_acc), _ = jax.lax.scan(
+        (_, _, _, _, dep, dsp, dhp, loss_acc), _ = jax.lax.scan(
             tick, carry0, jnp.arange(total_f + D))
         # loss lives on the last stage, embed/head grads on their owning
         # stages: scalar + shared-param psums (cheap; the per-stage grads —

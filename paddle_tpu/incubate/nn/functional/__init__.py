@@ -515,16 +515,27 @@ def fused_multi_transformer(
             else:
                 kv_mask = jnp.ones((1, 1, 1, s), bool)
         if gqa:
-            # each group of nh//kvh query heads shares one kv head
-            kk = jnp.repeat(kk, nh // gqa_group_size, axis=1)
-            vv = jnp.repeat(vv, nh // gqa_group_size, axis=1)
-        logits = jnp.einsum("bsnd,bnSd->bnsS", q.astype(jnp.float32),
-                            kk.astype(jnp.float32)) / np.sqrt(hd)
+            # grouped heads contract against the UN-replicated kv cache
+            # (query head h uses kv head h // grp — jnp.repeat semantics
+            # without materializing an nh-wide K/V)
+            grp = nh // gqa_group_size
+            qg = q.reshape(b, s, gqa_group_size, grp, hd)
+            logits = jnp.einsum("bsngd,bnSd->bngsS", qg.astype(jnp.float32),
+                                kk.astype(jnp.float32)) / np.sqrt(hd)
+            logits = logits.reshape(b, nh, s, logits.shape[-1])
+        else:
+            logits = jnp.einsum("bsnd,bnSd->bnsS", q.astype(jnp.float32),
+                                kk.astype(jnp.float32)) / np.sqrt(hd)
         logits = jnp.where(kv_mask, logits, -1e30)
         if attn_mask is not None:
             logits = logits + jnp.asarray(_unwrap(attn_mask), logits.dtype)
         p = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("bnsS,bnSd->bsnd", p.astype(vv.dtype), vv)
+        if gqa:
+            p5 = p.reshape(b, gqa_group_size, grp, s, p.shape[-1])
+            attn = jnp.einsum("bngsS,bnSd->bsngd", p5.astype(vv.dtype),
+                              vv).reshape(b, s, nh, hd)
+        else:
+            attn = jnp.einsum("bnsS,bnSd->bsnd", p.astype(vv.dtype), vv)
         attn = attn.reshape(b, s, nh * hd) @ lw
         if lb is not None:
             attn = attn + lb

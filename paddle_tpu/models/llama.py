@@ -301,7 +301,8 @@ def forward_pp(cfg: LlamaConfig, params, input_ids, mesh, num_microbatches,
 
 def loss_and_grads_1f1b(cfg: LlamaConfig, params, input_ids, labels, mesh,
                         num_microbatches, use_flash=True, remat=True,
-                        num_chunks=1, layers_stage_major=False):
+                        num_chunks=1, layers_stage_major=False,
+                        zero_bubble=False):
     """Pipeline train-step core on the executed 1F1B schedule
     (fleet/pipeline.py one_f_one_b_stacked ≙ pipeline_parallel.py:684 run,
     not simulated).  Stage 0 owns the embedding, the last stage owns final
@@ -396,7 +397,7 @@ def loss_and_grads_1f1b(cfg: LlamaConfig, params, input_ids, labels, mesh,
         embed_fn, stage_fn, head_loss_fn,
         params["embed"], stacked, head_params,
         ids_m, lbl_m, mesh, axis_name="pp", extra_args=(cos, sin),
-        num_chunks=C, **pipe_kw)
+        num_chunks=C, zero_bubble=zero_bubble, **pipe_kw)
     if reorder:
         dsp = _from_vpp(dsp)
 
@@ -436,7 +437,7 @@ def make_mesh(dp=1, mp=1, sharding=1, sep=1, pp=1, devices=None):
 def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
                      beta1=0.9, beta2=0.95, grad_clip=1.0, num_microbatches=None,
                      sep_attn_impl="ring", pipeline_schedule="1f1b",
-                     num_chunks=2):
+                     num_chunks=None):
     """The pjit-compiled train step: forward+backward+AdamW, all sharded.
 
     Data: [b, s] sharded ('dp'+'sharding' on batch, 'sep' on sequence).
@@ -484,16 +485,28 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
     # needs the gpipe region (which binds sep in the same shard_map) — see
     # forward_pp.
     # 'vpp'/'interleave' runs the same executed runner with C>1 virtual
-    # chunks per stage (num_chunks); '1f1b' is C=1
-    use_1f1b = pp > 1 and sep == 1 and pipeline_schedule in ("1f1b", "vpp",
-                                                             "interleave")
-    vpp_chunks = num_chunks if pipeline_schedule in ("vpp", "interleave") else 1
+    # chunks per stage (num_chunks); '1f1b' is C=1; 'zb'/'zero_bubble' is
+    # the executed ZB-H1 (deferred weight grads fill the drain bubble —
+    # needs num_microbatches >= 2*(pp-1)+1)
+    use_1f1b = pp > 1 and sep == 1 and pipeline_schedule in (
+        "1f1b", "vpp", "interleave", "zb", "zero_bubble")
+    zb = pipeline_schedule in ("zb", "zero_bubble")
+    if num_chunks is not None and num_chunks > 1 and not (
+            pipeline_schedule in ("vpp", "interleave")):
+        # the runner asserts the same thing, but a schedule silently
+        # different from the one configured is worse than an early error
+        raise ValueError(
+            f"num_chunks={num_chunks} requires pipeline_schedule="
+            f"'vpp'/'interleave', got {pipeline_schedule!r}")
+    vpp_chunks = ((num_chunks or 2)
+                  if pipeline_schedule in ("vpp", "interleave") else 1)
 
     def train_step(params, opt_state, input_ids, labels):
         if use_1f1b:
             loss, grads = loss_and_grads_1f1b(cfg, params, input_ids, labels,
                                               mesh, num_microbatches,
-                                              num_chunks=vpp_chunks)
+                                              num_chunks=vpp_chunks,
+                                              zero_bubble=zb)
         else:
             if pp > 1:
                 lfn = lambda p: loss_fn_pp(cfg, p, input_ids, labels, mesh,

@@ -458,8 +458,10 @@ class _Converter:
             raise NotImplementedError(
                 "ONNX export: gather mode=fill on non-float dtypes")
         fv = pr.get("fill_value")
+        fill_dt = (np.float32 if str(out_dtype) == "bfloat16"
+                   else out_dtype)  # bf16 serializes as f32 throughout
         fill = self.add_const(np.asarray(np.nan if fv is None else fv,
-                                         np.float32))
+                                         fill_dt))
         valid = self.emit("And", [
             self.emit("GreaterOrEqual", [idx64, lo]),
             self.emit("LessOrEqual", [idx64, hi])])
@@ -601,6 +603,9 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
             os.environ.pop("PADDLE_TPU_DISABLE_PALLAS", None)
         else:
             os.environ["PADDLE_TPU_DISABLE_PALLAS"] = prev_disable
+        # same hazard in reverse: fallback jaxprs traced during export must
+        # not be replayed by later Pallas-enabled calls at the same shapes
+        jax.clear_caches()
     conv = _Converter()
     in_names = []
     for i, (var, ex) in enumerate(zip(closed.jaxpr.invars, examples)):
