@@ -491,6 +491,13 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
     use_1f1b = pp > 1 and sep == 1 and pipeline_schedule in (
         "1f1b", "vpp", "interleave", "zb", "zero_bubble")
     zb = pipeline_schedule in ("zb", "zero_bubble")
+    if not use_1f1b and pipeline_schedule in ("vpp", "interleave", "zb",
+                                              "zero_bubble"):
+        # an explicitly requested schedule that can't run here must not
+        # silently degrade to gpipe / no-pipeline
+        raise ValueError(
+            f"pipeline_schedule={pipeline_schedule!r} needs a mesh with "
+            f"pp > 1 and sep == 1 (got pp={pp}, sep={sep})")
     if num_chunks is not None and num_chunks > 1 and not (
             pipeline_schedule in ("vpp", "interleave")):
         # the runner asserts the same thing, but a schedule silently

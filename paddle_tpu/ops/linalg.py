@@ -234,3 +234,49 @@ def lu(x, pivot=True, get_infos=False, name=None):
     if get_infos:
         return lu_t, piv_t, Tensor(jnp.zeros((), jnp.int32))
     return lu_t, piv_t
+
+
+@register_op("fp8_fp8_half_gemm_fused")
+def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
+                            bias=None, scale=1.0, output_dtype="float16",
+                            act="identity", name=None):
+    """fp8 x fp8 -> half gemm with fused scale/bias/activation (reference:
+    python/paddle/tensor/linalg.py:358 over the cutlass kernel declared at
+    paddle/phi/ops/yaml/fused_ops.yaml:190, kernels/fusion/fp8_gemm/).
+
+    TPU mapping: a dot_general on float8_e4m3fn/e5m2 operands with a half
+    ``preferred_element_type`` — XLA lowers fp8 matmuls natively where the
+    generation supports them and via widening elsewhere — then the scale,
+    bias add, and activation fuse into the epilogue.  The fp8 HBM savings
+    (half the bytes of bf16 weights/activations) are what the op is for.
+    """
+    out_dt = {"float16": jnp.float16, "bfloat16": jnp.bfloat16}.get(output_dtype)
+    if out_dt is None:
+        raise ValueError("The output_dtype must be float16 or bfloat16")
+    act_fns = {"identity": lambda v: v, "relu": jax.nn.relu,
+               "gelu": jax.nn.gelu}
+    if act not in act_fns:
+        raise ValueError(f"unsupported activation {act!r} "
+                         f"(expected one of {sorted(act_fns)})")
+    fp8_dts = (jnp.float8_e4m3fn, jnp.float8_e5m2)
+
+    def fn(a, b, *rest):
+        for nm, v in (("x", a), ("y", b)):
+            if v.dtype not in [jnp.dtype(d) for d in fp8_dts]:
+                raise TypeError(
+                    f"fp8_fp8_half_gemm_fused: {nm} must be float8_e4m3fn or "
+                    f"float8_e5m2, got {v.dtype}")
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        # jnp.matmul batches leading dims (like matmul() above); a raw
+        # dot_general with empty batch dims would outer-product them
+        out = jnp.matmul(a, b, preferred_element_type=jnp.float32)
+        out = out * jnp.float32(scale)
+        if rest:
+            out = out + rest[0].astype(jnp.float32)
+        return act_fns[act](out).astype(out_dt)
+
+    ins = [x, y] + ([bias] if bias is not None else [])
+    return apply_op("fp8_fp8_half_gemm_fused", fn, ins)

@@ -1,0 +1,104 @@
+"""StringTensor + the strings op family.
+
+Reference: paddle/phi/core/string_tensor.h:33 (``StringTensor`` — a dense
+tensor of ``pstring`` values), paddle/phi/ops/yaml/strings_ops.yaml (the
+whole family: ``strings_empty``, ``strings_empty_like``, ``strings_lower``,
+``strings_upper``), kernels in paddle/phi/kernels/strings/
+(strings_lower_upper_kernel.h:30,36 with utf8 vs ascii case conversion via
+case_utils.h/unicode.h).
+
+TPU-native design: strings are HOST data — no accelerator represents
+variable-length text, and the reference's GPU strings kernels just shuttle
+pstrings through device memory to do byte-wise case mapping.  So the
+framework keeps string tensors host-side as numpy object arrays (shape
+semantics intact, values immutable Python str), and the op family runs as
+plain host compute.  This mirrors what the stack is actually for: tokenizer
+front-ends produce int token tensors, and only those enter XLA.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "empty", "empty_like", "lower", "upper",
+           "to_string_tensor"]
+
+
+class StringTensor:
+    """Dense tensor of strings (reference string_tensor.h:33): numpy object
+    array of ``str`` plus the usual shape/numel surface."""
+
+    def __init__(self, data):
+        arr = np.asarray(data, dtype=object)
+        flat = [("" if v is None else str(v)) for v in arr.reshape(-1)]
+        self._data = np.array(flat, dtype=object).reshape(arr.shape)
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    def numel(self) -> int:
+        return int(self._data.size)
+
+    def numpy(self) -> np.ndarray:
+        return self._data.copy()
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return out
+
+    def __eq__(self, other):
+        if isinstance(other, StringTensor):
+            other = other._data
+        return bool(np.array_equal(self._data, np.asarray(other, dtype=object)))
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data.tolist()!r})"
+
+
+def to_string_tensor(data) -> StringTensor:
+    return data if isinstance(data, StringTensor) else StringTensor(data)
+
+
+def empty(shape) -> StringTensor:
+    """strings_empty (strings_ops.yaml): a shape-sized tensor of ""."""
+    return StringTensor(np.full(tuple(shape), "", dtype=object))
+
+
+def empty_like(x) -> StringTensor:
+    """strings_empty_like (strings_ops.yaml)."""
+    return empty(to_string_tensor(x).shape)
+
+
+def _case_map(x, fn_utf8, fn_ascii, use_utf8_encoding):
+    x = to_string_tensor(x)
+    fn = fn_utf8 if use_utf8_encoding else fn_ascii
+    out = np.array([fn(v) for v in x._data.reshape(-1)],
+                   dtype=object).reshape(x.shape)
+    return StringTensor(out)
+
+
+def _ascii_lower(s: str) -> str:
+    # the reference's non-utf8 path maps ASCII bytes only
+    # (case_utils.h AsciiCaseConverter) — multi-byte text passes through
+    return "".join(c.lower() if "A" <= c <= "Z" else c for c in s)
+
+
+def _ascii_upper(s: str) -> str:
+    return "".join(c.upper() if "a" <= c <= "z" else c for c in s)
+
+
+def lower(x, use_utf8_encoding: bool = False) -> StringTensor:
+    """strings_lower (strings_lower_upper_kernel.h:30): per-element case
+    fold; ``use_utf8_encoding`` selects full unicode mapping vs ASCII-only."""
+    return _case_map(x, str.lower, _ascii_lower, use_utf8_encoding)
+
+
+def upper(x, use_utf8_encoding: bool = False) -> StringTensor:
+    """strings_upper (strings_lower_upper_kernel.h:36)."""
+    return _case_map(x, str.upper, _ascii_upper, use_utf8_encoding)

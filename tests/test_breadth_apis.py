@@ -322,3 +322,66 @@ def test_qat_convert_uncalibrated_raises():
     q = qat.quantize(nn.Sequential(nn.Linear(4, 4)))
     with pytest.raises(ValueError, match="calibrat"):
         qat.convert(q)
+
+
+# ---------------- strings (StringTensor family) ----------------
+
+def test_string_tensor_family():
+    """strings_empty/empty_like/lower/upper (reference strings_ops.yaml,
+    string_tensor.h:33) with utf8 vs ascii case paths."""
+    from paddle_tpu import strings
+
+    t = strings.StringTensor([["Hello", "WORLD"], ["Straße", "ÉCOLE"]])
+    assert t.shape == (2, 2)
+    assert t.numel() == 4
+    assert t[0, 1] == "WORLD"
+
+    e = strings.empty([2, 3])
+    assert e.shape == (2, 3) and all(v == "" for v in e.numpy().reshape(-1))
+    assert strings.empty_like(t).shape == t.shape
+
+    lo = strings.lower(t, use_utf8_encoding=True)
+    assert lo.tolist() == [["hello", "world"], ["straße", "école"]]
+    up = strings.upper(t, use_utf8_encoding=True)
+    assert up[1, 1] == "ÉCOLE"
+    assert up[0, 0] == "HELLO"
+
+    # ascii path leaves non-ascii untouched (case_utils.h ascii converter)
+    lo_a = strings.lower(t, use_utf8_encoding=False)
+    assert lo_a[0, 1] == "world"
+    assert lo_a[1, 1] == "École"  # ASCII letters lowered, É untouched
+
+
+def test_fp8_gemm_fused():
+    """fp8_fp8_half_gemm_fused (fused_ops.yaml:190, tensor/linalg.py:358):
+    fp8 e4m3 operands, half output, fused scale/bias/act, vs numpy oracle
+    computed at the fp8-quantized values."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+
+    rs_ = np.random.RandomState(5)
+    x = rs_.randn(8, 16).astype(np.float32)
+    y = rs_.randn(16, 4).astype(np.float32)
+    b = rs_.randn(4).astype(np.float32)
+    x8 = jnp.asarray(x).astype(jnp.float8_e4m3fn)
+    y8 = jnp.asarray(y).astype(jnp.float8_e4m3fn)
+
+    out = paddle.linalg.fp8_fp8_half_gemm_fused(
+        paddle.to_tensor(np.asarray(x8)), paddle.to_tensor(np.asarray(y8)),
+        bias=paddle.to_tensor(b), scale=0.5, output_dtype="bfloat16",
+        act="relu")
+    assert str(jnp.asarray(out.numpy()).dtype) == "bfloat16" or \
+        out.numpy().dtype == np.float32  # bf16 surfaces as f32 via numpy()
+    got = np.asarray(out.numpy(), np.float32)
+    ref = np.maximum(
+        np.asarray(x8, np.float32) @ np.asarray(y8, np.float32) * 0.5 + b, 0)
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+
+    with pytest.raises(TypeError, match="float8"):
+        paddle.linalg.fp8_fp8_half_gemm_fused(
+            paddle.to_tensor(x), paddle.to_tensor(y))
+    with pytest.raises(ValueError, match="output_dtype"):
+        paddle.linalg.fp8_fp8_half_gemm_fused(
+            paddle.to_tensor(np.asarray(x8)), paddle.to_tensor(np.asarray(y8)),
+            output_dtype="float32")
