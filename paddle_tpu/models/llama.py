@@ -436,7 +436,7 @@ def make_mesh(dp=1, mp=1, sharding=1, sep=1, pp=1, devices=None):
 
 def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
                      beta1=0.9, beta2=0.95, grad_clip=1.0, num_microbatches=None,
-                     sep_attn_impl="ring", pipeline_schedule="1f1b",
+                     sep_attn_impl="ring", pipeline_schedule=None,
                      num_chunks=None):
     """The pjit-compiled train step: forward+backward+AdamW, all sharded.
 
@@ -488,25 +488,30 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
     # chunks per stage (num_chunks); '1f1b' is C=1; 'zb'/'zero_bubble' is
     # the executed ZB-H1 (deferred weight grads fill the drain bubble —
     # needs num_microbatches >= 2*(pp-1)+1)
-    use_1f1b = pp > 1 and sep == 1 and pipeline_schedule in (
+    # None = auto (executed 1F1B when the mesh allows, gpipe region when sep
+    # binds); ANY explicit request that can't run here raises — a schedule
+    # silently different from the configured one is worse than an error
+    schedule = "1f1b" if pipeline_schedule is None else pipeline_schedule
+    known = ("1f1b", "vpp", "interleave", "zb", "zero_bubble",
+             "gpipe", "fthenb")
+    if schedule not in known:
+        raise ValueError(f"unknown pipeline_schedule {schedule!r} "
+                         f"(expected one of {known})")
+    use_1f1b = pp > 1 and sep == 1 and schedule in (
         "1f1b", "vpp", "interleave", "zb", "zero_bubble")
-    zb = pipeline_schedule in ("zb", "zero_bubble")
-    if not use_1f1b and pipeline_schedule in ("vpp", "interleave", "zb",
-                                              "zero_bubble"):
-        # an explicitly requested schedule that can't run here must not
-        # silently degrade to gpipe / no-pipeline
+    zb = schedule in ("zb", "zero_bubble")
+    if (pipeline_schedule is not None and not use_1f1b
+            and schedule not in ("gpipe", "fthenb")):
         raise ValueError(
             f"pipeline_schedule={pipeline_schedule!r} needs a mesh with "
             f"pp > 1 and sep == 1 (got pp={pp}, sep={sep})")
     if num_chunks is not None and num_chunks > 1 and not (
-            pipeline_schedule in ("vpp", "interleave")):
-        # the runner asserts the same thing, but a schedule silently
-        # different from the one configured is worse than an early error
+            schedule in ("vpp", "interleave")):
         raise ValueError(
             f"num_chunks={num_chunks} requires pipeline_schedule="
-            f"'vpp'/'interleave', got {pipeline_schedule!r}")
+            f"'vpp'/'interleave', got {schedule!r}")
     vpp_chunks = ((num_chunks or 2)
-                  if pipeline_schedule in ("vpp", "interleave") else 1)
+                  if schedule in ("vpp", "interleave") else 1)
 
     def train_step(params, opt_state, input_ids, labels):
         if use_1f1b:

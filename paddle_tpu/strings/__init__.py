@@ -30,7 +30,16 @@ class StringTensor:
 
     def __init__(self, data):
         arr = np.asarray(data, dtype=object)
-        flat = [("" if v is None else str(v)) for v in arr.reshape(-1)]
+        vals = list(arr.reshape(-1))
+        ragged = [v for v in vals if isinstance(v, (list, tuple, np.ndarray))]
+        if ragged:
+            # a dense tensor of strings, like the reference — ragged nests
+            # would silently str()-ify into repr garbage
+            raise ValueError(
+                f"StringTensor requires rectangular (non-ragged) input; got "
+                f"nested sequence of shape {arr.shape} holding "
+                f"{type(ragged[0]).__name__} elements")
+        flat = [("" if v is None else str(v)) for v in vals]
         self._data = np.array(flat, dtype=object).reshape(arr.shape)
 
     @property
@@ -56,6 +65,9 @@ class StringTensor:
         if isinstance(other, StringTensor):
             other = other._data
         return bool(np.array_equal(self._data, np.asarray(other, dtype=object)))
+
+    # container with value equality — unhashable by design, like np.ndarray
+    __hash__ = None
 
     def __repr__(self):
         return f"StringTensor(shape={self.shape}, {self._data.tolist()!r})"
