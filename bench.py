@@ -180,6 +180,9 @@ def _train_rungs(on_tpu: bool):
     cfg_460m = llama.LlamaConfig(
         vocab_size=32000, hidden_size=1536, intermediate_size=4096,
         num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=4)
+    cfg_xl = llama.LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8)
     return [
         # (name, cfg, batch, seq, warmup, steps[, remat])
         ("tiny", llama.LlamaConfig.tiny(), 2, 128, 1, 3),
@@ -188,12 +191,16 @@ def _train_rungs(on_tpu: bool):
             num_hidden_layers=8, num_attention_heads=8, num_key_value_heads=8,
         ), 4, 1024, 1, 5),
         ("full", cfg_460m, 8, 2048, 2, 10),
-        # ~1.1B: deeper/wider — bigger matmuls usually mean better MXU
+        # ~0.9B: deeper/wider — bigger matmuls usually mean better MXU
         # utilization; ladder structure makes this rung free to attempt
-        ("xl", llama.LlamaConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-            num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8,
-        ), 8, 2048, 2, 10),
+        ("xl", cfg_xl, 8, 2048, 2, 10),
+        # the same config with sequence-chunked cross entropy: ~12.4GB of
+        # param+AdamW state leaves <4GB headroom on a 16GB v5e and the f32
+        # logits alone are 2.1GB at batch 8 (r4: the plain xl rung OOMed
+        # while every smaller rung banked) — chunked xent computes the head
+        # 512 positions at a time inside a remat'd scan (0.5GB peak)
+        ("xl_cx", cfg_xl, 8, 2048, 2, 10, "full", 512),
+        ("xl_b4_cx", cfg_xl, 4, 2048, 2, 10, "full", 512),
         # SAME 460M config, selective recompute (save matmul outputs): fewer
         # recomputed MXU FLOPs if HBM allows.  Last so an OOM here cannot
         # abort earlier rungs (ladder breaks on first failure).
@@ -201,7 +208,8 @@ def _train_rungs(on_tpu: bool):
     ]
 
 
-def run_rung(name, cfg, batch, seq, warmup_steps, bench_steps, remat_policy="full"):
+def run_rung(name, cfg, batch, seq, warmup_steps, bench_steps, remat_policy="full",
+             xent_chunk=0):
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -212,7 +220,9 @@ def run_rung(name, cfg, batch, seq, warmup_steps, bench_steps, remat_policy="ful
     backend = jax.default_backend()
     devices = jax.devices()
     os.environ["PADDLE_TPU_REMAT"] = remat_policy  # read at trace time
-    log(f"rung {name}: building (batch={batch} seq={seq} remat={remat_policy})")
+    os.environ["PADDLE_TPU_XENT_CHUNK"] = str(xent_chunk)
+    log(f"rung {name}: building (batch={batch} seq={seq} remat={remat_policy}"
+        f" xent_chunk={xent_chunk})")
 
     mesh = llama.make_mesh(dp=1, mp=1, sharding=1, sep=1, devices=devices[:1])
     step_fn, opt_init, param_shardings, data_sharding = llama.build_train_step(cfg, mesh)
@@ -268,6 +278,7 @@ def run_rung(name, cfg, batch, seq, warmup_steps, bench_steps, remat_policy="ful
             "device": getattr(devices[0], "device_kind", "?"),
             "flash_kernel_used": flash_kernel_used,
             "remat": remat_policy,
+            "xent_chunk": xent_chunk,
             "disabled_pallas": os.environ.get("PADDLE_TPU_DISABLE_PALLAS", ""),
         },
     }

@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import os
 from typing import Any
 
 import jax
@@ -181,13 +182,20 @@ def _embed_rope(cfg: LlamaConfig, params, input_ids):
     return x, cos, sin
 
 
-def _final_head(cfg: LlamaConfig, params, x):
-    """Shared tail: final rms_norm + (possibly tied) lm head."""
-    x = rms.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+def _norm_and_head(cfg: LlamaConfig, params, x):
+    """Final rms_norm + resolved (possibly tied) lm head weight — the single
+    source for head tying/dtype, shared by the dense and chunked losses."""
+    xn = rms.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T.astype(cfg.dtype)
-    return x @ head
+    return xn, head
+
+
+def _final_head(cfg: LlamaConfig, params, x):
+    """Shared tail: final rms_norm + (possibly tied) lm head."""
+    xn, head = _norm_and_head(cfg, params, x)
+    return xn @ head
 
 
 def sep_attention(mesh: Mesh, axis: str = "sep", impl: str = "ring"):
@@ -237,10 +245,12 @@ def _remat_wrap(body, remat):
 
 
 def forward(cfg: LlamaConfig, params, input_ids, use_flash=True, remat=True,
-            attn_fn=None):
+            attn_fn=None, return_hidden=False):
     """Logits for [b, s] token ids.  The layer stack is a lax.scan over the
     stacked layer weights with jax.checkpoint (activation recompute ≙ the
-    reference's recompute_sequential over transformer blocks)."""
+    reference's recompute_sequential over transformer blocks).
+    ``return_hidden`` skips the final norm + lm head and returns the last
+    hidden states (the chunked-xent loss fuses the head into the loss)."""
     x, cos, sin = _embed_rope(cfg, params, input_ids)
 
     def body(carry, lp):
@@ -249,11 +259,14 @@ def forward(cfg: LlamaConfig, params, input_ids, use_flash=True, remat=True,
 
     scan_body = _remat_wrap(body, remat)
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    if return_hidden:
+        return x
     return _final_head(cfg, params, x)
 
 
 def forward_pp(cfg: LlamaConfig, params, input_ids, mesh, num_microbatches,
-               use_flash=True, remat=True, sep_attn_impl="ring"):
+               use_flash=True, remat=True, sep_attn_impl="ring",
+               return_hidden=False):
     """Pipeline-parallel forward: the stacked layer dim is sharded over 'pp'
     and executed by the in-jit GPipe engine (fleet/pipeline.py gpipe_stacked ≙
     the reference's PipelineParallel.forward_backward_pipeline at
@@ -296,6 +309,8 @@ def forward_pp(cfg: LlamaConfig, params, input_ids, mesh, num_microbatches,
 
     outs = gpipe_stacked(stage_fn, params["layers"], xm, mesh, "pp",
                          extra_args=(cos, sin), **gp_kw)
+    if return_hidden:
+        return outs.reshape(b, s, h)
     return _final_head(cfg, params, outs.reshape(b, s, h))
 
 
@@ -366,9 +381,10 @@ def loss_and_grads_1f1b(cfg: LlamaConfig, params, input_ids, labels, mesh,
     tied = "lm_head" not in params
 
     def head_loss_fn(hp, y, lbl, cos_, sin_):
-        # hp carries exactly the keys _final_head reads ('final_norm' +
-        # 'embed' or 'lm_head'), so the head path stays single-sourced
-        return _xent(_final_head(cfg, hp, y), lbl)
+        # hp carries exactly the keys _norm_and_head reads ('final_norm' +
+        # 'embed' or 'lm_head'), so the head path stays single-sourced;
+        # head_xent honors PADDLE_TPU_XENT_CHUNK per microbatch
+        return head_xent(cfg, hp, y, lbl)
 
     head_params = {"final_norm": params["final_norm"]}
     head_params["embed" if tied else "lm_head"] = (
@@ -414,12 +430,58 @@ def _xent(logits, labels):
     return -jnp.mean(picked)
 
 
+def _xent_chunk_env() -> int:
+    """``PADDLE_TPU_XENT_CHUNK=<positions>`` (read at trace time, like
+    PADDLE_TPU_REMAT): sequence-chunked cross-entropy.  0/unset = off."""
+    try:
+        return int(os.environ.get("PADDLE_TPU_XENT_CHUNK", "0"))
+    except ValueError:
+        return 0
+
+
+def head_xent(cfg: LlamaConfig, params, x, labels, chunk=None):
+    """final_norm + lm head + cross entropy, optionally WITHOUT materializing
+    the full [b, s, V] f32 logits: with ``chunk`` set (or the
+    PADDLE_TPU_XENT_CHUNK env), the head matmul + log_softmax run per
+    sequence chunk inside a rematerialized lax.scan, so peak logits memory
+    drops from b*s*V*4 bytes to b*chunk*V*4 (2.1 GB -> 0.5 GB for the
+    bench's xl rung) at the cost of recomputing chunk logits in the
+    backward — the standard memory/FLOPs trade for big-vocab heads.
+    Numerics are identical (per-position log_softmax is independent)."""
+    chunk = _xent_chunk_env() if chunk is None else int(chunk)
+    b, s, h = x.shape
+    if chunk <= 0 or s <= chunk or s % chunk:
+        return _xent(_final_head(cfg, params, x), labels)
+    xn, head = _norm_and_head(cfg, params, x)
+    n = s // chunk
+    xc = xn.reshape(b, n, chunk, h).swapaxes(0, 1)      # [n, b, chunk, h]
+    lc = labels.reshape(b, n, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def step(tot, xl):
+        xck, lbl = xl
+        logp = jax.nn.log_softmax((xck @ head).astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(logp, lbl[..., None], axis=-1)[..., 0]
+        return tot + picked.sum(), None
+
+    tot, _ = jax.lax.scan(step, jnp.float32(0), (xc, lc))
+    return -tot / (b * s)
+
+
 def loss_fn(cfg: LlamaConfig, params, input_ids, labels, attn_fn=None):
+    if _xent_chunk_env() > 0:
+        x = forward(cfg, params, input_ids, attn_fn=attn_fn,
+                    return_hidden=True)
+        return head_xent(cfg, params, x, labels)
     return _xent(forward(cfg, params, input_ids, attn_fn=attn_fn), labels)
 
 
 def loss_fn_pp(cfg: LlamaConfig, params, input_ids, labels, mesh, num_microbatches,
                sep_attn_impl="ring"):
+    if _xent_chunk_env() > 0:
+        x = forward_pp(cfg, params, input_ids, mesh, num_microbatches,
+                       sep_attn_impl=sep_attn_impl, return_hidden=True)
+        return head_xent(cfg, params, x, labels)
     logits = forward_pp(cfg, params, input_ids, mesh, num_microbatches,
                         sep_attn_impl=sep_attn_impl)
     return _xent(logits, labels)
@@ -500,11 +562,16 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
     use_1f1b = pp > 1 and sep == 1 and schedule in (
         "1f1b", "vpp", "interleave", "zb", "zero_bubble")
     zb = schedule in ("zb", "zero_bubble")
-    if (pipeline_schedule is not None and not use_1f1b
-            and schedule not in ("gpipe", "fthenb")):
-        raise ValueError(
-            f"pipeline_schedule={pipeline_schedule!r} needs a mesh with "
-            f"pp > 1 and sep == 1 (got pp={pp}, sep={sep})")
+    if pipeline_schedule is not None:
+        if schedule in ("gpipe", "fthenb"):
+            if pp <= 1:
+                raise ValueError(
+                    f"pipeline_schedule={pipeline_schedule!r} needs a mesh "
+                    f"with pp > 1 (got pp={pp})")
+        elif not use_1f1b:
+            raise ValueError(
+                f"pipeline_schedule={pipeline_schedule!r} needs a mesh with "
+                f"pp > 1 and sep == 1 (got pp={pp}, sep={sep})")
     if num_chunks is not None and num_chunks > 1 and not (
             schedule in ("vpp", "interleave")):
         raise ValueError(
