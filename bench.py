@@ -205,6 +205,9 @@ def _train_rungs(on_tpu: bool):
         # recomputed MXU FLOPs if HBM allows.  Last so an OOM here cannot
         # abort earlier rungs (ladder breaks on first failure).
         ("full_dots", cfg_460m, 8, 2048, 2, 10, "dots"),
+        # double the batch with the logits spike removed by chunked xent:
+        # bigger per-step matmuls usually buy MFU if the memory fits
+        ("full_b16_cx", cfg_460m, 16, 2048, 2, 10, "dots", 512),
     ]
 
 

@@ -380,7 +380,8 @@ def fused_multi_transformer(
     pre_layer_norm=True, epsilon=1e-5, cache_kvs=None, rotary_embs=None,
     time_step=None, attn_mask=None, dropout_rate=0.0, rotary_emb_dims=0,
     activation="gelu", training=False, mode="upscale_in_train",
-    use_neox_rotary_style=False, gqa_group_size=-1, name=None,
+    use_neox_rotary_style=False, gqa_group_size=-1, norm_type="layernorm",
+    trans_qkvw=True, name=None,
 ):
     """The reference's whole-decoder fused op (fused_ops.yaml:394,
     python/paddle/incubate/nn/functional/fused_transformer.py
@@ -443,7 +444,16 @@ def fused_multi_transformer(
                             axis=-1).reshape(u.shape)
         return u * cos + rot * sin
 
+    if norm_type not in ("layernorm", "rmsnorm"):
+        raise NotImplementedError(f"norm_type {norm_type!r} not supported "
+                                  "(layernorm | rmsnorm)")
+
     def ln(v, scale_, bias_, eps):
+        if norm_type == "rmsnorm":
+            # llama-family serving (reference fused_transformer.py:1302):
+            # the shared Pallas rms_norm kernel (f32-internal custom VJP)
+            out = _rms.rms_norm(v, scale_, eps)
+            return out + bias_ if bias_ is not None else out
         mu = jnp.mean(v, axis=-1, keepdims=True)
         var = jnp.var(v, axis=-1, keepdims=True)
         out = (v - mu) / jnp.sqrt(var + eps)
@@ -452,6 +462,10 @@ def fused_multi_transformer(
     def one_layer(xv, lns, lnb, qkvw, qkvb, lw, lb, flns, flnb, f1w, f1b,
                   f2w, f2b, cache, t, rot):
         b, s, e = xv.shape
+        if not trans_qkvw:
+            # reference's untransposed layout puts dim_embed FIRST
+            # ([e, 3, nh, hd] / [e, nh+2kvh, hd], fused_ops.yaml:190 attr)
+            qkvw = jnp.moveaxis(qkvw, 0, -1)
         h = ln(xv, lns, lnb, epsilon) if pre_layer_norm else xv
         if gqa:
             # GQA packing [nh + 2*kvh, hd, e] (infermeta/fusion.cc:195)

@@ -247,12 +247,11 @@ class FusedMultiTransformer(nn.Layer):
 
         # unsupported reference variants fail loudly instead of silently
         # building the wrong computation
-        if not trans_qkvw:
+        self.trans_qkvw = bool(trans_qkvw)
+        if norm_type not in ("layernorm", "rmsnorm"):
             raise NotImplementedError(
-                "FusedMultiTransformer: trans_qkvw=False ([e, 3*nh*hd] qkv "
-                "layout) is not supported; use the default layout")
-        if norm_type != "layernorm":
-            raise NotImplementedError(f"norm_type {norm_type!r} not supported")
+                f"norm_type {norm_type!r} not supported (layernorm | rmsnorm)")
+        self.norm_type = norm_type
         if residual_alpha != 1.0:
             raise NotImplementedError("residual_alpha != 1.0 not supported")
         assert embed_dim > 0 and num_heads > 0
@@ -295,6 +294,9 @@ class FusedMultiTransformer(nn.Layer):
         else:
             qkv_shape = (3, nh, hd, embed_dim)
             qkv_b_shape = (3, nh, hd)
+        if not trans_qkvw:
+            # untransposed layout: dim_embed leads (fused_ops.yaml:190)
+            qkv_shape = (embed_dim,) + qkv_shape[:-1]
         self.qkv_weights = plist("qkv_weight", qkv_shape, qkv_weight_attrs)
         self.qkv_biases = plist("qkv_bias", qkv_b_shape, qkv_bias_attrs, bias=True)
         self.linear_weights = plist("linear_weight", (nh * hd, embed_dim),
@@ -327,4 +329,5 @@ class FusedMultiTransformer(nn.Layer):
             rotary_emb_dims=rotary_emb_dims, activation=self.activation,
             training=self.training,
             use_neox_rotary_style=self.use_neox_rotary_style,
-            gqa_group_size=self.gqa_group_size)
+            gqa_group_size=self.gqa_group_size, norm_type=self.norm_type,
+            trans_qkvw=self.trans_qkvw)

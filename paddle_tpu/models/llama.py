@@ -433,10 +433,14 @@ def _xent(logits, labels):
 def _xent_chunk_env() -> int:
     """``PADDLE_TPU_XENT_CHUNK=<positions>`` (read at trace time, like
     PADDLE_TPU_REMAT): sequence-chunked cross-entropy.  0/unset = off."""
+    raw = os.environ.get("PADDLE_TPU_XENT_CHUNK", "0")
     try:
-        return int(os.environ.get("PADDLE_TPU_XENT_CHUNK", "0"))
+        return int(raw)
     except ValueError:
-        return 0
+        # a typo silently disabling chunking would resurface the exact OOM
+        # the flag exists to prevent
+        raise ValueError(
+            f"PADDLE_TPU_XENT_CHUNK must be an integer, got {raw!r}") from None
 
 
 def head_xent(cfg: LlamaConfig, params, x, labels, chunk=None):

@@ -116,11 +116,27 @@ def test_fused_multi_transformer_layer_gqa_rotary_generation():
     assert np.isfinite(out2.numpy()).all()
 
 
+def test_trans_qkvw_layouts_agree():
+    """trans_qkvw=False ([e, 3, nh, hd] qkv layout) computes the same
+    function as the default transposed layout with permuted weights."""
+    e, nh, di = 8, 2, 16
+    lt = inn.FusedMultiTransformer(e, nh, di, num_layers=1)
+    lf = inn.FusedMultiTransformer(e, nh, di, num_layers=1, trans_qkvw=False)
+    assert tuple(lf.qkv_weights[0].shape) == (e, 3, nh, e // nh)
+    # copy lt's weights into lf (transposing qkv)
+    sd = lt.state_dict()
+    sd["qkv_weight_0"] = paddle.to_tensor(
+        np.moveaxis(sd["qkv_weight_0"].numpy(), -1, 0))
+    lf.set_state_dict(sd)
+    lt.eval(); lf.eval()
+    x = T(1, 4, e)
+    np.testing.assert_allclose(lt(x).numpy(), lf(x).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_unsupported_variants_are_loud():
-    with pytest.raises(NotImplementedError, match="trans_qkvw"):
-        inn.FusedMultiTransformer(8, 2, 16, num_layers=1, trans_qkvw=False)
     with pytest.raises(NotImplementedError, match="norm_type"):
-        inn.FusedMultiTransformer(8, 2, 16, num_layers=1, norm_type="rmsnorm")
+        inn.FusedMultiTransformer(8, 2, 16, num_layers=1, norm_type="groupnorm")
     layer = inn.FusedMultiHeadAttention(8, 2, dropout_rate=0.0,
                                         attn_dropout_rate=0.0)
     q, k = T(1, 3, 8), T(1, 3, 8)

@@ -358,6 +358,50 @@ def test_fused_multi_transformer_bidirectional_mask():
     np.testing.assert_allclose(out3[0, 0], out4[0, 0], rtol=1e-6)
 
 
+def test_fused_multi_transformer_rmsnorm():
+    """norm_type='rmsnorm' (llama-family serving, reference
+    fused_transformer.py:1302): matches a numpy rmsnorm oracle on the
+    single-layer no-cache path."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rs = np.random.RandomState(9)
+    b, s, e, nh, hd, di = 1, 3, 8, 2, 4, 16
+    mk = lambda *sh: (rs.randn(*sh) * 0.3).astype(np.float32)
+    lns = mk(e)
+    qkvw = mk(3, nh, hd, e)
+    lw = mk(nh * hd, e)
+    flns = mk(e)
+    f1w, f2w = mk(e, di), mk(di, e)
+    x = mk(b, s, e)
+    t_ = paddle.to_tensor
+
+    out = IF.fused_multi_transformer(
+        t_(x), [t_(lns)], None, [t_(qkvw)], None, [t_(lw)], None,
+        [t_(flns)], None, [t_(f1w)], None, [t_(f2w)], None,
+        norm_type="rmsnorm").numpy()
+
+    def rms_np(v, g):
+        return v / np.sqrt((v * v).mean(-1, keepdims=True) + 1e-5) * g
+
+    h = rms_np(x, lns)
+    qkv = np.einsum("bse,cnde->bscnd", h, qkvw)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    logits = np.einsum("bsnd,bSnd->bnsS", q, k) / np.sqrt(hd)
+    causal = np.tril(np.ones((s, s), bool))
+    logits = np.where(causal[None, None], logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    attn = np.einsum("bnsS,bSnd->bsnd", p, v).reshape(b, s, nh * hd)
+    xa = x + attn @ lw
+    h2 = rms_np(xa, flns)
+    pre = h2 @ f1w
+    gelu = 0.5 * pre * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                    * (pre + 0.044715 * pre ** 3)))
+    ref = xa + gelu @ f2w
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+
 def test_fused_multi_transformer_gqa_matches_duplicated_kv_mha():
     """GQA (qkv packed [nh + 2*kvh, hd, e], infermeta/fusion.cc:195) must
     equal plain MHA whose K/V head weights are the GQA kv heads repeated
