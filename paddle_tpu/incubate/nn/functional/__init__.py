@@ -377,11 +377,11 @@ def fused_multi_transformer(
     x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     linear_weights, linear_biases, ffn_ln_scales, ffn_ln_biases,
     ffn1_weights, ffn1_biases, ffn2_weights, ffn2_biases,
-    pre_layer_norm=True, epsilon=1e-5, cache_kvs=None, rotary_embs=None,
-    time_step=None, attn_mask=None, dropout_rate=0.0, rotary_emb_dims=0,
-    activation="gelu", training=False, mode="upscale_in_train",
-    use_neox_rotary_style=False, gqa_group_size=-1, norm_type="layernorm",
-    trans_qkvw=True, name=None,
+    pre_layer_norm=True, epsilon=1e-5, cache_kvs=None, pre_caches=None,
+    rotary_embs=None, time_step=None, attn_mask=None, dropout_rate=0.0,
+    rotary_emb_dims=0, activation="gelu", training=False,
+    mode="upscale_in_train", use_neox_rotary_style=False, gqa_group_size=-1,
+    norm_type="layernorm", trans_qkvw=True, name=None,
 ):
     """The reference's whole-decoder fused op (fused_ops.yaml:394,
     python/paddle/incubate/nn/functional/fused_transformer.py
@@ -390,9 +390,17 @@ def fused_multi_transformer(
 
     TPU mapping: one jnp composition that XLA fuses per layer — the CUDA
     kernel's fusion work is the compiler's job here; the op's value on TPU is
-    the *cache-threading decode semantics* (prefill writes positions [0, s);
-    decode with ``time_step=t`` appends the single new token at position t
-    and attends over the first t+1 cache slots).
+    the *cache-threading decode semantics*: prefill writes cache positions
+    [pre_len, pre_len + s) (pre_len = 0 without ``pre_caches``), and decode
+    with ``time_step=t`` appends the single new token at cache position
+    pre_len + t and attends over the first pre_len + t + 1 slots.
+    ``time_step`` is PROMPT-RELATIVE — it counts tokens after the prefix,
+    which the op offsets internally (rotary positions included).
+    ``pre_caches`` ([2, b, nh_or_kvh, pre_len, hd] per layer) is a
+    read-only prefix KV (prefix tuning / shared system prompt) committed
+    into the main cache at prefill; it requires ``cache_kvs``.
+    ``norm_type`` selects layernorm | rmsnorm; ``trans_qkvw=False`` accepts
+    the dim_embed-first qkv weight layout.
 
     Shapes (reference layout): x [b, s, e]; qkv_weights[i] [3, nh, hd, e]
     (MHA) or, with ``gqa_group_size=kvh`` kv heads, [nh + 2*kvh, hd, e]
@@ -430,6 +438,12 @@ def fused_multi_transformer(
     decode = time_step is not None
     use_rotary = rotary_embs is not None and rotary_emb_dims > 0
     gqa = gqa_group_size > 0
+    use_pre = pre_caches is not None
+    if use_pre and not use_cache:
+        raise ValueError(
+            "fused_multi_transformer: pre_caches requires cache_kvs (the "
+            "prefix is committed into the main cache at prefill)")
+    pre_len = int(pre_caches[0].shape[3]) if use_pre else 0
 
     def apply_rotary(u, cos, sin):
         # u [b, s, n, hd]; cos/sin [b, s, hd] (broadcast over heads)
@@ -460,7 +474,7 @@ def fused_multi_transformer(
         return out * scale_ + (bias_ if bias_ is not None else 0.0)
 
     def one_layer(xv, lns, lnb, qkvw, qkvb, lw, lb, flns, flnb, f1w, f1b,
-                  f2w, f2b, cache, t, rot):
+                  f2w, f2b, cache, t, rot, pre=None):
         b, s, e = xv.shape
         if not trans_qkvw:
             # reference's untransposed layout puts dim_embed FIRST
@@ -485,12 +499,16 @@ def fused_multi_transformer(
                 qkv = qkv + qkvb[None, None]
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b, s, nh, hd]
         if rot is not None:
-            # rot [2, b, 1, S, hd]: slice this call's positions — [0, s) for
-            # prefill, position t for the single decode token
+            # rot [2, b, 1, S, hd]: slice this call's ABSOLUTE positions —
+            # [pre_len, pre_len + s) for prefill, pre_len + t for the single
+            # decode token (a pre_caches prefix occupies positions
+            # [0, pre_len), so new tokens continue after it; without a
+            # prefix these reduce to [0, s) and t)
             if decode:
-                cs = jax.lax.dynamic_slice_in_dim(rot[:, :, 0], t, 1, axis=2)
+                cs = jax.lax.dynamic_slice_in_dim(rot[:, :, 0], t + pre_len,
+                                                  1, axis=2)
             else:
-                cs = rot[:, :, 0, :s]
+                cs = rot[:, :, 0, pre_len:pre_len + s]
             cos_p, sin_p = cs[0], cs[1]                # [b, s, hd]
             q = apply_rotary(q, cos_p, sin_p)
             k = apply_rotary(k, cos_p, sin_p)
@@ -502,23 +520,32 @@ def fused_multi_transformer(
         if use_cache:
             S = cache.shape[3]
             if decode:
-                # append the single new token at position t; slots > t are
-                # unwritten garbage and always masked
+                # append the single new token at position pre_len + t;
+                # slots past it are unwritten garbage and always masked
                 cache = jax.lax.dynamic_update_slice(
                     cache, jnp.stack([k, v]).transpose(0, 1, 3, 2, 4),
-                    (0, 0, 0, t, 0))
+                    (0, 0, 0, t + pre_len, 0))
                 kk = cache[0]
                 vv = cache[1]
-                kv_mask = jnp.arange(S)[None, None, None, :] <= t
+                kv_mask = jnp.arange(S)[None, None, None, :] <= t + pre_len
             else:
+                if pre is not None:
+                    # commit the read-only prefix KV (prefix tuning /
+                    # system prompt — reference pre_caches) into slots
+                    # [0, pre_len) so decode attends over it for free
+                    cache = jax.lax.dynamic_update_slice(
+                        cache, jnp.asarray(pre, cache.dtype), (0, 0, 0, 0, 0))
                 cache = jax.lax.dynamic_update_slice(
                     cache, jnp.stack([k, v]).transpose(0, 1, 3, 2, 4),
-                    (0, 0, 0, 0, 0))
+                    (0, 0, 0, pre_len, 0))
                 kk = cache[0]
                 vv = cache[1]
                 q_pos = jnp.arange(s)[None, None, :, None]
-                valid = jnp.arange(S)[None, None, None, :] < s
-                kv_mask = (valid & (jnp.arange(S)[None, None, None, :] <= q_pos)
+                idx = jnp.arange(S)[None, None, None, :]
+                valid = idx < pre_len + s
+                # prefix slots (idx < pre_len) are visible to every query;
+                # the written region stays causal in prompt-relative terms
+                kv_mask = (valid & (idx - pre_len <= q_pos)
                            if causal_default else valid)
         else:
             kk = k.transpose(0, 2, 1, 3)
@@ -569,10 +596,13 @@ def fused_multi_transformer(
         t = None
         if decode:
             t = jnp.asarray(_unwrap(time_step), jnp.int32).reshape(())
-        per = 12  # tensors per layer in `flat` (before caches/rotary)
+        per = 12  # tensors per layer in `flat` (before caches/pre/rotary)
         rot = flat[-1] if use_rotary else None
         if use_rotary:
             flat = flat[:-1]
+        pres = list(flat[-L:]) if use_pre else [None] * L
+        if use_pre:
+            flat = flat[:-L]
         caches = list(flat[per * L:]) if use_cache else [None] * L
         new_caches = []
         out = xv
@@ -580,7 +610,7 @@ def fused_multi_transformer(
             lns, lnb, qkvw, qkvb, lw, lb, flns, flnb, f1w, f1b, f2w, f2b = (
                 flat[per * i: per * (i + 1)])
             out, c = one_layer(out, lns, lnb, qkvw, qkvb, lw, lb, flns, flnb,
-                               f1w, f1b, f2w, f2b, caches[i], t, rot)
+                               f1w, f1b, f2w, f2b, caches[i], t, rot, pres[i])
             new_caches.append(c)
         if use_cache:
             return tuple([out] + new_caches)
@@ -603,6 +633,7 @@ def fused_multi_transformer(
     xdt = _unwrap(x).dtype
     flat = [f if f is not None else jnp.zeros((), xdt) for f in flat]
     inputs = ([x] + flat + (list(cache_kvs) if use_cache else [])
+              + (list(pre_caches) if use_pre else [])
               + ([rotary_embs] if use_rotary else []))
     res = apply_op("fused_multi_transformer", fn, inputs)
     if use_cache:

@@ -316,16 +316,17 @@ class FusedMultiTransformer(nn.Layer):
         self.ffn2_biases = plist("ffn2_bias", (embed_dim,), ffn2_bias_attrs,
                                  bias=True)
 
-    def forward(self, src, attn_mask=None, caches=None, rotary_embs=None,
-                rotary_emb_dims=0, time_step=None):
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, time_step=None):
         return F.fused_multi_transformer(
             src, self.ln_scales, self.ln_biases, self.qkv_weights,
             self.qkv_biases, self.linear_weights, self.linear_biases,
             self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
             self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
             pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
-            cache_kvs=caches, rotary_embs=rotary_embs, time_step=time_step,
-            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            cache_kvs=caches, pre_caches=pre_caches, rotary_embs=rotary_embs,
+            time_step=time_step, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate,
             rotary_emb_dims=rotary_emb_dims, activation=self.activation,
             training=self.training,
             use_neox_rotary_style=self.use_neox_rotary_style,

@@ -358,6 +358,91 @@ def test_fused_multi_transformer_bidirectional_mask():
     np.testing.assert_allclose(out3[0, 0], out4[0, 0], rtol=1e-6)
 
 
+def test_fused_multi_transformer_pre_caches():
+    """pre_caches (read-only prefix KV — prefix tuning / system prompt,
+    reference fused_transformer.py pre_caches arg): splitting a prompt into
+    (prefix KV from part 1) + (prefill of part 2) must reproduce the
+    one-shot full-prompt outputs for part 2, and decode must continue
+    identically.  No rotary, so attention is position-free except
+    causality."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rs = np.random.RandomState(10)
+    L, b, e, nh, hd, di, S = 2, 1, 16, 4, 4, 32, 12
+    s1, s2 = 3, 4
+    mk = lambda *sh: paddle.to_tensor((rs.randn(*sh) * 0.2).astype(np.float32))
+    args = ([mk(e)], [mk(e)], [mk(3, nh, hd, e)], [mk(3, nh, hd)],
+            [mk(nh * hd, e)], [mk(e)], [mk(e)], [mk(e)],
+            [mk(e, di)], [mk(di)], [mk(di, e)], [mk(e)])
+    args = tuple(a * L for a in args)  # reuse layer 0 weights for both layers
+    x = (rs.randn(b, s1 + s2, e) * 0.3).astype(np.float32)
+
+    # one-shot: full prompt through a fresh cache
+    caches = [paddle.to_tensor(np.zeros((2, b, nh, S, hd), np.float32))
+              for _ in range(L)]
+    out_full, caches_full = IF.fused_multi_transformer(
+        paddle.to_tensor(x), *args, cache_kvs=caches)
+
+    # two-phase: prefill part 1, harvest its KV as the prefix
+    c1 = [paddle.to_tensor(np.zeros((2, b, nh, S, hd), np.float32))
+          for _ in range(L)]
+    _, c1 = IF.fused_multi_transformer(
+        paddle.to_tensor(x[:, :s1]), *args, cache_kvs=c1)
+    prefix = [paddle.to_tensor(c.numpy()[:, :, :, :s1]) for c in c1]
+
+    c2 = [paddle.to_tensor(np.zeros((2, b, nh, S, hd), np.float32))
+          for _ in range(L)]
+    out_p2, c2 = IF.fused_multi_transformer(
+        paddle.to_tensor(x[:, s1:]), *args, cache_kvs=c2, pre_caches=prefix)
+    np.testing.assert_allclose(out_p2.numpy(), out_full.numpy()[:, s1:],
+                               rtol=1e-4, atol=1e-5)
+
+    # decode continues identically from both cache states
+    tok = (rs.randn(b, 1, e) * 0.3).astype(np.float32)
+    d_full, _ = IF.fused_multi_transformer(
+        paddle.to_tensor(tok), *args, cache_kvs=caches_full,
+        time_step=paddle.to_tensor(np.int32(s1 + s2)))
+    d_pre, _ = IF.fused_multi_transformer(
+        paddle.to_tensor(tok), *args, cache_kvs=c2, pre_caches=prefix,
+        time_step=paddle.to_tensor(np.int32(s2)))
+    np.testing.assert_allclose(d_pre.numpy(), d_full.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+    # pre_caches without a main cache is a loud error
+    with pytest.raises(ValueError, match="pre_caches"):
+        IF.fused_multi_transformer(paddle.to_tensor(x), *args,
+                                   pre_caches=prefix)
+
+    # WITH rotary: positions must offset by the prefix length (llama-style
+    # serving with a system-prompt prefix) — same split-vs-one-shot check
+    inv = 1.0 / 10000 ** (np.arange(0, hd, 2) / hd)
+    ang = np.arange(S)[:, None] * inv[None]
+    rot = np.zeros((2, b, 1, S, hd), np.float32)
+    rot[0, :, 0] = np.concatenate([np.cos(ang), np.cos(ang)], -1)
+    rot[1, :, 0] = np.concatenate([np.sin(ang), np.sin(ang)], -1)
+    rot_t = paddle.to_tensor(rot)
+    rkw = dict(rotary_embs=rot_t, rotary_emb_dims=1,
+               use_neox_rotary_style=True)
+
+    cr = [paddle.to_tensor(np.zeros((2, b, nh, S, hd), np.float32))
+          for _ in range(L)]
+    out_full_r, _ = IF.fused_multi_transformer(
+        paddle.to_tensor(x), *args, cache_kvs=cr, **rkw)
+    c1r = [paddle.to_tensor(np.zeros((2, b, nh, S, hd), np.float32))
+           for _ in range(L)]
+    _, c1r = IF.fused_multi_transformer(
+        paddle.to_tensor(x[:, :s1]), *args, cache_kvs=c1r, **rkw)
+    prefix_r = [paddle.to_tensor(c.numpy()[:, :, :, :s1]) for c in c1r]
+    c2r = [paddle.to_tensor(np.zeros((2, b, nh, S, hd), np.float32))
+           for _ in range(L)]
+    out_p2_r, _ = IF.fused_multi_transformer(
+        paddle.to_tensor(x[:, s1:]), *args, cache_kvs=c2r,
+        pre_caches=prefix_r, **rkw)
+    np.testing.assert_allclose(out_p2_r.numpy(), out_full_r.numpy()[:, s1:],
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_fused_multi_transformer_rmsnorm():
     """norm_type='rmsnorm' (llama-family serving, reference
     fused_transformer.py:1302): matches a numpy rmsnorm oracle on the
