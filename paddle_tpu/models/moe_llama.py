@@ -48,7 +48,9 @@ class MoEConfig:
     # "dense" = GShard one-hot einsum routing (O(tokens*E*C) FLOPs; compiles
     # to clean all-to-alls under EP sharding), "sort" = stable-argsort
     # scatter/gather routing (O(tokens*K) data movement — the winner at
-    # DeepSeek-scale E), "auto" = sort above _SORT_DISPATCH_MIN_EXPERTS
+    # DeepSeek-scale E), "ragged" = DROPLESS lax.ragged_dot grouped matmuls
+    # (no capacity, no padding; opt-in — changes drop semantics),
+    # "auto" = sort above _SORT_DISPATCH_MIN_EXPERTS
     dispatch: str = "auto"
     max_position_embeddings: int = 4096
     rms_norm_eps: float = 1e-5
@@ -144,7 +146,7 @@ _SORT_DISPATCH_MIN_EXPERTS = 9
 def moe_ffn(cfg: MoEConfig, x, lp):
     """Routed-expert FFN for x: [b, s, h] → (out, aux_loss, z_loss).
 
-    Two dispatch engines behind one routing front-end (cfg.dispatch):
+    Three dispatch engines behind one routing front-end (cfg.dispatch):
 
     * dense — GShard one-hot formulation: capacity-bounded dispatch tensor
       [g, E, C] → einsum into per-expert batches [E, C, h] → swiglu → combine.
@@ -158,6 +160,12 @@ def moe_ffn(cfg: MoEConfig, x, lp):
       (same within-expert ordering, same capacity drops) — the scalable path
       for DeepSeek-class expert counts (reference moe_layer.py routes through
       variable-size global_scatter for the same reason).
+    * ragged — DROPLESS ``lax.ragged_dot`` grouped matmuls (no capacity,
+      no padding, keeps tokens GShard would drop).  Opt-in only: drop
+      semantics differ from dense/sort, and GSPMD cannot usefully shard the
+      ragged group dimension, so under an expert-parallel mesh the expert
+      weights are gathered to each device — prefer sort/dense for EP
+      meshes, ragged for single-device or pure-dp serving/training.
     """
     b, s, h = x.shape
     E, K = cfg.num_experts, cfg.top_k
@@ -181,20 +189,22 @@ def moe_ffn(cfg: MoEConfig, x, lp):
     aux = E * jnp.sum(frac_tokens * frac_probs)
 
     mode = resolved_dispatch(cfg)
-    route = _dispatch_sort if mode == "sort" else _dispatch_dense
+    route = {"sort": _dispatch_sort, "ragged": _dispatch_ragged,
+             "dense": _dispatch_dense}[mode]
     out = route(cfg, xf, lp, topk_p, topk_i, cap)
     return out.reshape(b, s, h), aux, z_loss
 
 
 def resolved_dispatch(cfg: MoEConfig) -> str:
-    """The dispatch engine a config actually runs: 'dense' or 'sort'."""
+    """The dispatch engine a config actually runs: 'dense'|'sort'|'ragged'."""
     mode = cfg.dispatch
     if mode == "auto":
         mode = ("sort" if cfg.num_experts >= _SORT_DISPATCH_MIN_EXPERTS
                 else "dense")
-    if mode not in ("dense", "sort"):
+    if mode not in ("dense", "sort", "ragged"):
         raise ValueError(
-            f"MoEConfig.dispatch must be 'auto'|'dense'|'sort', got {cfg.dispatch!r}")
+            f"MoEConfig.dispatch must be 'auto'|'dense'|'sort'|'ragged', "
+            f"got {cfg.dispatch!r}")
     return mode
 
 
@@ -227,6 +237,33 @@ def _dispatch_dense(cfg, xf, lp, topk_p, topk_i, cap):
     expert_in = jnp.einsum("gec,gh->ech", dispatch, xf)        # [E, C, h]
     expert_out = _expert_compute(lp, expert_in)
     return jnp.einsum("gec,ech->gh", combine, expert_out)
+
+
+def _dispatch_ragged(cfg, xf, lp, topk_p, topk_i, cap):
+    """DROPLESS dispatch over ``lax.ragged_dot`` (the TPU-native grouped
+    matmul; MegaBlocks-style): (token, k) pairs stable-sorted by expert form
+    contiguous groups, and the three expert matmuls run as ragged dots with
+    per-expert group sizes — no capacity, no padding FLOPs, no dropped
+    tokens.  ``cap`` is ignored; numerics match dense/sort exactly when no
+    capacity drops occur (cap_factor >= E), and otherwise keep the tokens
+    GShard would drop — a quality/perf point, not a parity point, so it is
+    opt-in (cfg.dispatch='ragged'), never chosen by 'auto'."""
+    g, h = xf.shape
+    E, K = cfg.num_experts, cfg.top_k
+    N = g * K
+
+    flat_e = topk_i.reshape(N)
+    order = jnp.argsort(flat_e, stable=True)
+    tok = order // K
+    xs = xf[tok]                                   # [N, h] grouped by expert
+    counts = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+    gate = jax.lax.ragged_dot(xs, lp["e_gate"], counts)
+    up = jax.lax.ragged_dot(xs, lp["e_up"], counts)
+    act = swiglu_mod.swiglu(gate, up)
+    out_s = jax.lax.ragged_dot(act, lp["e_down"], counts)   # [N, h]
+    w = topk_p.reshape(N)[order].astype(xf.dtype)
+    y = jnp.zeros((g, h), xf.dtype)
+    return y.at[tok].add(out_s * w[:, None])
 
 
 def _dispatch_sort(cfg, xf, lp, topk_p, topk_i, cap):

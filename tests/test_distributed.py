@@ -670,7 +670,7 @@ def test_1f1b_vs_gpipe_step_time(eight_devices):
     labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 64)))
 
     times = {}
-    for sched in ("1f1b", "gpipe"):
+    for sched in ("1f1b", "gpipe", "zb"):
         step, oinit, pshard, dshard = llama.build_train_step(
             cfg, mesh, num_microbatches=8, pipeline_schedule=sched)
         p = jax.device_put(llama.init_params(cfg, jax.random.key(0)), pshard)
@@ -684,7 +684,8 @@ def test_1f1b_vs_gpipe_step_time(eight_devices):
             l, p, o = step(p, o, i, y)
         float(l)
         times[sched] = time.perf_counter() - t0
-    print(f"\n[pp step-time] 1f1b={times['1f1b']:.3f}s gpipe={times['gpipe']:.3f}s")
+    print(f"\n[pp step-time] 1f1b={times['1f1b']:.3f}s "
+          f"gpipe={times['gpipe']:.3f}s zb={times['zb']:.3f}s")
     # recorded comparison, not a hard ratio — wall-clock ratios over 3 steps
     # are load-sensitive on shared CI hosts; both paths completing finite
     # steps is the structural assertion
