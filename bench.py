@@ -645,13 +645,18 @@ def moe_ladder_main(compact: bool = False) -> int:
     # scalable path at E>=16); fewer layers keep params/optimizer in 16GB
     full_e16 = dataclasses.replace(full, num_experts=16, num_hidden_layers=8,
                                    dispatch="sort")
+    # dropless grouped-matmul engine on the same config: sort-vs-ragged is
+    # the TPU dispatch-engine comparison (lax.ragged_dot vs scatter/gather)
+    full_e16_rg = dataclasses.replace(full_e16, dispatch="ragged")
     rungs = ([("tiny", moe_llama.MoEConfig.tiny(), 2, 128, 1, 3),
               ("full", full, 4, 1024, 1, 8),
-              ("full_e16_sort", full_e16, 4, 1024, 1, 8)]
+              ("full_e16_sort", full_e16, 4, 1024, 1, 8),
+              ("full_e16_ragged", full_e16_rg, 4, 1024, 1, 8)]
              if on_tpu else [("cpu_smoke", moe_llama.MoEConfig.tiny(), 2, 64, 1, 2)])
     if compact and on_tpu:
         rungs = [("full", full, 4, 1024, 1, 6),
-                 ("full_e16_sort", full_e16, 4, 1024, 1, 6)]
+                 ("full_e16_sort", full_e16, 4, 1024, 1, 6),
+                 ("full_e16_ragged", full_e16_rg, 4, 1024, 1, 6)]
     banked = 0
     for rung in rungs:
         try:

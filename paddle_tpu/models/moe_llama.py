@@ -62,6 +62,30 @@ class MoEConfig:
         return self.hidden_size // self.num_attention_heads
 
     @staticmethod
+    def deepseek_moe_16b():
+        """DeepSeekMoE-16B structure (BASELINE ladder row #5): 64 routed +
+        2 shared experts (shared width = intermediate_size * num_shared =
+        2816), top-6 token-choice gating.  At E=64 the 'auto' dispatch
+        resolves to the sort engine."""
+        return MoEConfig(
+            vocab_size=102400, hidden_size=2048, intermediate_size=1408,
+            moe_intermediate_size=1408, num_hidden_layers=28,
+            num_attention_heads=16, num_key_value_heads=16,
+            num_experts=64, num_shared_experts=2, top_k=6,
+        )
+
+    @staticmethod
+    def qwen2_moe_a14b():
+        """Qwen2-57B-A14B structure: 64 routed + shared block of width
+        8 * 2560 = 20480, top-8."""
+        return MoEConfig(
+            vocab_size=151936, hidden_size=3584, intermediate_size=2560,
+            moe_intermediate_size=2560, num_hidden_layers=28,
+            num_attention_heads=28, num_key_value_heads=4,
+            num_experts=64, num_shared_experts=8, top_k=8,
+        )
+
+    @staticmethod
     def tiny(vocab=256, hidden=64, layers=2, heads=4, kv_heads=2,
              experts=4, top_k=2, inter=128, moe_inter=64):
         return MoEConfig(

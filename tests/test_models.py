@@ -198,3 +198,27 @@ def test_chunked_xent_matches_dense():
                     jax.tree_util.tree_leaves(g_chunk)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-6)
+
+
+def test_named_model_presets_match_published_sizes():
+    """Config presets reproduce the published parameter counts (BASELINE
+    ladder rows #3/#5): Llama-3-8B, DeepSeekMoE-16B (2.8B active),
+    Qwen2-57B-A14B (14B active).  eval_shape only — no weights allocated."""
+    from paddle_tpu.models import llama, moe_llama
+
+    total = moe_llama.count_params  # works on eval_shape avals too
+
+    lcfg = llama.LlamaConfig.llama3_8b()
+    lt = total(jax.eval_shape(lambda: llama.init_params(lcfg, jax.random.key(0))))
+    assert abs(lt / 1e9 - 8.0) < 0.3, lt
+
+    d = moe_llama.MoEConfig.deepseek_moe_16b()
+    dt = total(jax.eval_shape(lambda: moe_llama.init_params(d, jax.random.key(0))))
+    assert abs(dt / 1e9 - 16.4) < 0.8, dt
+    assert abs(moe_llama.active_params_per_token(d) / 1e9 - 2.8) < 0.3
+    assert moe_llama.resolved_dispatch(d) == "sort"
+
+    q = moe_llama.MoEConfig.qwen2_moe_a14b()
+    qt = total(jax.eval_shape(lambda: moe_llama.init_params(q, jax.random.key(0))))
+    assert abs(qt / 1e9 - 57.4) < 1.5, qt
+    assert abs(moe_llama.active_params_per_token(q) / 1e9 - 14.2) < 0.8

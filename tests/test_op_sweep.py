@@ -11,6 +11,7 @@ is classified and reports the grad-check ratio (>=90% of differentiable ops).
 
 from __future__ import annotations
 
+import ml_dtypes
 import numpy as np
 import pytest
 
@@ -232,6 +233,15 @@ SPECS = [
     S("kron", [F(2, 2), F(2, 3)], np.kron, atol=1e-4),
     S("lstsq", [F(4, 3), F(4, 2)], lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0], atol=1e-3, grad=False),
     S("matmul", [F(2, 3), F(3, 4)], np.matmul, atol=1e-4),
+    # fp8 x fp8 -> bf16 fused gemm: inputs quantized to e4m3 FIRST so the
+    # oracle sees the same rounded values; bf16 output -> loose tolerance
+    S("fp8_fp8_half_gemm_fused",
+      [np.asarray(F(4, 8), ml_dtypes.float8_e4m3fn),
+       np.asarray(F(8, 2), ml_dtypes.float8_e4m3fn)],
+      lambda a, b: a.astype(np.float32) @ b.astype(np.float32),
+      kw=dict(output_dtype="bfloat16"),
+      fn=paddle.linalg.fp8_fp8_half_gemm_fused,
+      grad=False, atol=0.2, rtol=0.05),
     S("matrix_power", [m33], lambda x: np.linalg.matrix_power(x, 3), kw=dict(n=3), atol=1e-2, grad=False),
     S("matrix_rank", [m33], np.linalg.matrix_rank, grad=False),
     S("cond", [m33], lambda x: np.linalg.cond(x), atol=1e-3, grad=False),
