@@ -14,6 +14,7 @@ from ...core import dtype as dtypes
 from ...core import rng
 from ...core.tensor import Tensor, apply_op, _unwrap
 from ...ops.manipulation import pad  # noqa: F401  (exported as F.pad)
+from ...ops.manipulation import unfold  # noqa: F401  (F.unfold = im2col)
 from ...ops.registry import register_op
 
 __all__: list[str] = []
@@ -1090,3 +1091,527 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
     m = int(maxlen) if maxlen is not None else int(jnp.max(v))
     mask = jnp.arange(m)[None, :] < v[..., None]
     return Tensor(mask.astype(dtypes.convert_dtype(dtype)))
+
+
+# ============== reference loss tail (python/paddle/nn/functional/loss.py) ====
+
+@_export
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """loss.py:4193: log(1 + exp(-label * input)), label in {-1, 1}."""
+    def fn(x, y):
+        return _reduce_loss(jnp.log1p(jnp.exp(-y.astype(x.dtype) * x)),
+                            reduction)
+
+    return apply_op("soft_margin_loss", fn, [input, label])
+
+
+@_export
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """loss.py:4066: hinge between the true-class score and every other."""
+    def fn(x, y, *rest):
+        n, c = x.shape
+        true = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), axis=1)
+        m = jnp.maximum(margin - true + x, 0.0) ** p
+        if rest:
+            m = m * rest[0][y.astype(jnp.int32)][:, None]
+        m = m * (1 - jax.nn.one_hot(y, c, dtype=x.dtype))  # exclude true class
+        return _reduce_loss(m.sum(-1) / c, reduction)
+
+    ins = [input, label] + ([weight] if weight is not None else [])
+    return apply_op("multi_margin_loss", fn, ins)
+
+
+@_export
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    """loss.py:3438: per-class binary logistic loss, labels in {0, 1}."""
+    def fn(x, y, *rest):
+        y = y.astype(x.dtype)
+        per = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if rest:
+            per = per * rest[0]
+        return _reduce_loss(per.mean(-1), reduction)
+
+    ins = [input, label] + ([weight] if weight is not None else [])
+    return apply_op("multi_label_soft_margin_loss", fn, ins)
+
+
+@_export
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    """loss.py:1563: Poisson negative log likelihood."""
+    def fn(x, y):
+        y = y.astype(x.dtype)
+        if log_input:
+            per = jnp.exp(x) - y * x
+        else:
+            per = x - y * jnp.log(x + epsilon)
+        if full:
+            # Stirling approximation for label! (only where label > 1)
+            stir = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            per = per + jnp.where(y > 1, stir, 0.0)
+        return _reduce_loss(per, reduction)
+
+    return apply_op("poisson_nll_loss", fn, [input, label])
+
+
+@_export
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """loss.py: 0.5*(log(var) + (x-label)^2/var), variance clamped."""
+    def fn(x, y, var):
+        var = jnp.maximum(var.astype(x.dtype), epsilon)
+        per = 0.5 * (jnp.log(var) + (x - y.astype(x.dtype)) ** 2 / var)
+        if full:
+            per = per + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, x.dtype))
+        return _reduce_loss(per, reduction)
+
+    return apply_op("gaussian_nll_loss", fn, [input, label, variance])
+
+
+@_export
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    """loss.py:3660: 1-cos for label=1, max(0, cos - margin) for label=-1."""
+    def fn(a, b, y):
+        cos = (a * b).sum(-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        per = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce_loss(per, reduction)
+
+    return apply_op("cosine_embedding_loss", fn, [input1, input2, label])
+
+
+@_export
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    """loss.py:3936: max(d(a,p) - d(a,n) + margin, 0)."""
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return ((jnp.abs(u - v) + epsilon) ** p).sum(-1) ** (1.0 / p)
+
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_an = jnp.minimum(d_an, dist(pos, neg))
+        return _reduce_loss(jnp.maximum(d_ap - d_an + margin, 0.0), reduction)
+
+    return apply_op("triplet_margin_loss", fn, [input, positive, negative])
+
+
+@_export
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """loss.py:50: 1 - 2*intersection/total over one-hot labels."""
+    def fn(x, y):
+        d = x.shape[-1]
+        oh = jax.nn.one_hot(y[..., 0], d, dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = (x * oh).sum(red)
+        total = x.sum(red) + oh.sum(red)
+        return (1 - (2 * inter + epsilon) / (total + epsilon)).mean()
+
+    return apply_op("dice_loss", fn, [input, label])
+
+
+@_export
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """loss.py:346: cross entropy on the anchor x positive similarity matrix
+    (both directions) + L2 regularizer on the embeddings."""
+    def fn(a, p, y):
+        y = y.reshape(-1)
+        sim = a @ p.T                                  # [n, n]
+        tgt = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = tgt / tgt.sum(-1, keepdims=True)
+        xe_r = -(jax.nn.log_softmax(sim, axis=-1) * tgt).sum(-1).mean()
+        xe_c = -(jax.nn.log_softmax(sim.T, axis=-1) * tgt).sum(-1).mean()
+        l2 = (jnp.sum(a * a) + jnp.sum(p * p)) / a.shape[0]
+        return (xe_r + xe_c) / 2 + l2_reg * l2 * 0.25
+
+    return apply_op("npair_loss", fn, [anchor, positive, labels])
+
+
+@_export
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC loss (loss.py:1906; the warp-ctc alpha recursion as a lax.scan).
+
+    log_probs [T, B, C] (softmax applied internally, like warp-ctc);
+    labels [B, U] int; the extended sequence interleaves blanks
+    (length 2U+1) and the forward variable alpha runs the standard
+    three-way recursion in log space, frozen past each sequence's
+    input_length."""
+    def fn(lp, lab, in_len, lab_len):
+        T, B, C = lp.shape
+        U = lab.shape[1]
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        S = 2 * U + 1
+        ninf = jnp.float32(-1e30)
+        # extended labels: even slots blank, odd slots the labels
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        # repeat rule: s can skip from s-2 unless same label or blank
+        ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32),
+                                  ext[:, :-2]], axis=1)
+        can_skip = (ext != blank) & (ext != ext_m2)         # [B, S]
+        pos = jnp.arange(S)[None, :]
+        valid_s = pos < (2 * lab_len[:, None] + 1)          # live slots
+
+        def emit(t_lp, a):
+            # a [B, S] -> next alpha at time t
+            a1 = jnp.concatenate([jnp.full((B, 1), ninf), a[:, :-1]], axis=1)
+            a2 = jnp.concatenate([jnp.full((B, 2), ninf), a[:, :-2]], axis=1)
+            a2 = jnp.where(can_skip, a2, ninf)
+            tot = jnp.logaddexp(jnp.logaddexp(a, a1), a2)
+            e = jnp.take_along_axis(t_lp, ext, axis=1)      # [B, S]
+            return jnp.where(valid_s, tot + e, ninf)
+
+        alpha0 = jnp.full((B, S), ninf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first = jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, first, ninf))
+
+        def step(carry, t):
+            a = carry
+            nxt = emit(lp[t], a)
+            a = jnp.where((t < in_len)[:, None], nxt, a)    # freeze past T_b
+            return a, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        end = 2 * lab_len.astype(jnp.int32)                 # [B] blank slot
+        last_b = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+        last_l = jnp.take_along_axis(
+            alpha, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
+        last_l = jnp.where(lab_len > 0, last_l, ninf)
+        loss = -jnp.logaddexp(last_b, last_l)               # [B]
+        if norm_by_times:
+            # gradient normalized by each sequence's length, value unchanged
+            # (warp-ctc's norm_by_times; moot under 'mean' per the docs)
+            inv_t = 1.0 / jnp.maximum(in_len.astype(loss.dtype), 1)
+            loss = loss * inv_t + jax.lax.stop_gradient(loss * (1 - inv_t))
+        if reduction == "mean":
+            return (loss / jnp.maximum(lab_len.astype(loss.dtype), 1)).mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return apply_op("ctc_loss", fn,
+                    [log_probs, labels, input_lengths, label_lengths])
+
+
+@_export
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T transducer loss (loss.py:2054; warp-transducer's forward DP as
+    a lax.scan over time carrying the alpha row over label positions).
+
+    input [B, T, U+1, C] log-probs (log_softmax applied internally);
+    loss_b = -alpha[T_b-1, U_b] - lp[T_b-1, U_b, blank].  FastEmit
+    (arxiv 2010.11148, warp-transducer semantics): the LOSS VALUE is the
+    exact NLL; the EMIT-path gradient is scaled by (1 + lambda) via a
+    stop_gradient identity on the emit log-probs."""
+    def fn(lp, lab, in_len, lab_len):
+        B, T, U1, C = lp.shape
+        U = U1 - 1
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        ninf = jnp.float32(-1e30)
+        upos = jnp.arange(U1)[None, :]                      # [1, U+1]
+        # per-(b, t, u): blank prob and emit prob of label u (consumed to u+1)
+        blank_lp = lp[:, :, :, blank]                       # [B, T, U+1]
+        lab_pad = jnp.concatenate(
+            [lab.astype(jnp.int32), jnp.zeros((B, 1), jnp.int32)], axis=1)
+        emit_lp = jnp.take_along_axis(
+            lp, lab_pad[:, None, :, None], axis=3)[..., 0]  # [B, T, U+1]
+        if fastemit_lambda:
+            # value-preserving gradient scale: a + l*(a - sg(a)) == a in
+            # value, d/da == 1 + l — exactly FastEmit's emit-grad scaling
+            emit_lp = emit_lp + fastemit_lambda * (
+                emit_lp - jax.lax.stop_gradient(emit_lp))
+
+        def time_step(a_prev, t):
+            # horizontal (blank) move from t-1 at same u
+            horiz = a_prev + blank_lp[:, t - 1]             # [B, U+1]
+
+            # alpha[t, u] = logaddexp(horiz[u], alpha[t, u-1] + emit[t, u-1])
+            def chain(carry, inputs):
+                h_u, e_um1 = inputs
+                cur = jnp.logaddexp(h_u, carry + e_um1)
+                return cur, cur
+
+            init = horiz[:, 0]                              # u=0: blank only
+            _, rest = jax.lax.scan(
+                chain, init,
+                (horiz[:, 1:].T, emit_lp[:, t, :-1].T))
+            a_t = jnp.concatenate([init[:, None], rest.T], axis=1)
+            a_t = jnp.where(upos <= lab_len[:, None], a_t, ninf)
+            return jnp.where((t < in_len)[:, None], a_t, a_prev), None
+
+        # t = 0 row: only emits along u
+        def chain0(carry, e):
+            cur = carry + e
+            return cur, cur
+
+        _, r0 = jax.lax.scan(chain0, jnp.zeros((B,), jnp.float32),
+                             emit_lp[:, 0, :-1].T)
+        a0 = jnp.concatenate([jnp.zeros((B, 1), jnp.float32), r0.T], axis=1)
+        a0 = jnp.where(upos <= lab_len[:, None], a0, ninf)
+
+        alpha, _ = jax.lax.scan(time_step, a0, jnp.arange(1, T))
+        # final: the frozen carry IS row T_b-1; read it at u = U_b and add
+        # the final blank emission
+        final_blank = jnp.take_along_axis(
+            blank_lp[jnp.arange(B), jnp.maximum(in_len.astype(jnp.int32) - 1, 0)],
+            lab_len.astype(jnp.int32)[:, None], axis=1)[:, 0]
+        a_end = jnp.take_along_axis(
+            alpha, lab_len.astype(jnp.int32)[:, None], axis=1)[:, 0]
+        loss = -(a_end + final_blank)
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return apply_op("rnnt_loss", fn,
+                    [input, label, input_lengths, label_lengths])
+
+
+# ====== reference vision/misc tail (nn/functional/{vision,common,pooling}) ===
+
+@_export
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """distance.py: ||x - y + eps||_p along the last axis."""
+    def fn(a, b):
+        d = a - b + epsilon
+        return (jnp.abs(d) ** p).sum(-1, keepdims=keepdim) ** (1.0 / p)
+
+    return apply_op("pairwise_distance", fn, [x, y])
+
+
+@_export
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """vision.py: interleave channel groups (ShuffleNet)."""
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            return (v.reshape(n, groups, c // groups, h, w)
+                    .swapaxes(1, 2).reshape(n, c, h, w))
+        n, h, w, c = v.shape
+        return (v.reshape(n, h, w, groups, c // groups)
+                .swapaxes(3, 4).reshape(n, h, w, c))
+
+    return apply_op("channel_shuffle", fn, [x])
+
+
+@_export
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """vision.py temporal_shift (TSM): shift 1/ratio of channels one segment
+    forward/backward along the time axis."""
+    def fn(v):
+        if data_format != "NCHW":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [v5[:, 1:, :c1], jnp.zeros_like(v5[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v5[:, :1, c1:c2]), v5[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([back, fwd, v5[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_op("temporal_shift", fn, [x])
+
+
+@_export
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    """pooling.py lp_pool2d: (sum of p-th powers over the window)^(1/p)."""
+    ks = _pair(kernel_size)
+    st = _pair(stride) if stride is not None else ks
+    pd = _pair(padding)
+
+    def fn(v):
+        if data_format != "NCHW":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        p = float(norm_type)
+        hi = [pd[0], pd[1]]
+        if ceil_mode:
+            # extra high-side padding so partial windows produce outputs
+            # (zero-padded |x|^p contributes nothing to the sum)
+            for d in (0, 1):
+                n = v.shape[2 + d] + 2 * pd[d]
+                out_ceil = -(-(n - ks[d]) // st[d]) + 1
+                hi[d] = pd[d] + max(0, (out_ceil - 1) * st[d] + ks[d] - n)
+        s = jax.lax.reduce_window(
+            jnp.abs(v) ** p, 0.0, jax.lax.add, (1, 1) + ks, (1, 1) + st,
+            [(0, 0), (0, 0), (pd[0], hi[0]), (pd[1], hi[1])])
+        out = s ** (1.0 / p)
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_op("lp_pool2d", fn, [x])
+
+
+@_export
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    """activation.py rrelu: random leaky slope in [lower, upper] when
+    training, the midpoint slope in eval (the reference's inference mode)."""
+    def fn(v):
+        if training:
+            key = rng.next_key()
+            a = jax.random.uniform(key, v.shape, jnp.float32,
+                                   lower, upper).astype(v.dtype)
+        else:
+            a = jnp.asarray((lower + upper) / 2.0, v.dtype)
+        return jnp.where(v >= 0, v, a * v)
+
+    return apply_op("rrelu", fn, [x])
+
+
+@_export
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """vision.py affine_grid: sampling grid [N, H, W, 2] from a batch of
+    2x3 affine matrices (grid_sample's companion)."""
+    n, _, h, w = [int(d) for d in out_shape]
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    def fn(th):
+        ys = axis_coords(h)
+        xs = axis_coords(w)
+        gx, gy = jnp.meshgrid(xs, ys)                     # [h, w]
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [h, w, 3]
+        return jnp.einsum("hwk,nck->nhwc", base.astype(th.dtype), th)
+
+    return apply_op("affine_grid", fn, [theta])
+
+
+@_export
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """common.py fold (col2im — unfold's inverse, overlaps summed)."""
+    out_hw = _pair(output_sizes)
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _pair(paddings)
+    dl = _pair(dilations)
+
+    def fn(v):
+        n, ckk, l = v.shape
+        c = ckk // (ks[0] * ks[1])
+        lh = (out_hw[0] + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        lw = (out_hw[1] + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        v6 = v.reshape(n, c, ks[0], ks[1], lh, lw)
+        H = out_hw[0] + 2 * pd[0]
+        W = out_hw[1] + 2 * pd[1]
+        out = jnp.zeros((n, c, H, W), v.dtype)
+        # scatter-add each kernel tap's grid of patches
+        oh = jnp.arange(lh) * st[0]
+        ow = jnp.arange(lw) * st[1]
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                rows = oh + i * dl[0]
+                cols = ow + j * dl[1]
+                out = out.at[:, :, rows[:, None], cols[None, :]].add(
+                    v6[:, :, i, j])
+        return out[:, :, pd[0]:H - pd[0] or None, pd[1]:W - pd[1] or None]
+
+    return apply_op("fold", fn, [x])
+
+
+@_export
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """pooling.py fractional_max_pool2d (Graham, arXiv:1412.6071):
+    pseudo-random pooling regions from a single u in (0, 1); deterministic
+    given ``random_u`` (drawn from the framework RNG otherwise)."""
+    out_hw = _pair(output_size)
+
+    def starts(n, o, k, u):
+        # the paper's pseudorandom sequence: ceil(alpha*(i+u)) spaced starts
+        alpha = (n - k) / max(o - 1, 1)
+        idx = np.arange(o, dtype=np.float64)
+        s = np.ceil(alpha * (idx + u)).astype(np.int64) - int(np.ceil(alpha * u))
+        return np.clip(s, 0, n - k)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        u = (float(random_u) if random_u is not None
+             else float(jax.random.uniform(rng.next_key(), ())))
+        kh, kw = _pair(kernel_size) if kernel_size is not None else (
+            h // out_hw[0], w // out_hw[1])
+        rs = starts(h, out_hw[0], kh, u)
+        cs = starts(w, out_hw[1], kw, u)
+        # gather each region and max over it
+        rows = rs[:, None] + np.arange(kh)[None, :]      # [oh, kh]
+        cols = cs[:, None] + np.arange(kw)[None, :]      # [ow, kw]
+        patches = v[:, :, rows][:, :, :, :, cols]        # [n,c,oh,kh,ow,kw]
+        return patches.max(axis=(3, 5))
+
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool2d(return_mask=True) is not supported")
+    return apply_op("fractional_max_pool2d", fn, [x])
+
+
+@_export
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """common.py class_center_sample (PLSC partial-fc): sample the positive
+    class centers plus random negatives up to num_samples; returns
+    (remapped_label, sampled_class_index).  Host-side sampling (the sampled
+    set is data-dependent by design — the reference's GPU kernel also
+    produces variable content in a fixed-size buffer)."""
+    lab = np.asarray(_unwrap(label)).astype(np.int64)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos  # every positive center is always kept (reference)
+    else:
+        rest = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos)
+        seed = int(jax.random.randint(rng.next_key(), (), 0, 2 ** 31 - 1))
+        extra = np.random.RandomState(seed).permutation(rest)[
+            : num_samples - len(pos)]
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = np.full(num_classes, -1, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lab])),
+            Tensor(jnp.asarray(sampled)))
+
+
+@_export
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """loss.py:2223 (ArcFace family): softmax CE with the true-class logit
+    cos(theta) replaced by cos(m1*theta + m2) - m3, all scaled by s.
+    Covers SphereFace (m1), ArcFace (m2), CosFace (m3)."""
+    def fn(lg, y):
+        n, c = lg.shape
+        cos = jnp.clip(lg.astype(jnp.float32), -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        mod = jnp.cos(margin1 * theta + margin2) - margin3
+        oh = jax.nn.one_hot(y, c, dtype=jnp.float32)
+        out = scale * (oh * mod + (1 - oh) * cos)
+        logp = jax.nn.log_softmax(out, axis=-1)
+        per = -(oh * logp).sum(-1)
+        sm = jnp.exp(logp)
+        loss = (per.mean() if reduction == "mean"
+                else per.sum() if reduction == "sum" else per)
+        return loss, sm
+
+    loss, sm = apply_op("margin_cross_entropy", fn, [logits, label],
+                        n_outputs=2)
+    if return_softmax:
+        return loss, sm
+    return loss

@@ -558,6 +558,38 @@ def tensordot(x, y, axes=2, name=None):
     return apply_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), [x, y])
 
 
+@register_op("as_strided", tensor_method="as_strided")
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view of x's flattened storage (reference:
+    python/paddle/tensor/manipulation.py as_strided over the stride kernels,
+    FLAGS_use_stride_kernel).  XLA has no aliasing views, so this is a
+    gather producing the same VALUES: out[i0, i1, ...] =
+    flat(x)[offset + sum_k i_k * stride[k]] — numerically identical,
+    functionally copied (mutating the result does not alias x, matching
+    the framework's functional tensor semantics)."""
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+    if len(shape) != len(stride):
+        raise ValueError(f"shape {shape} and stride {stride} rank mismatch")
+    max_idx = int(offset) + sum(max(d - 1, 0) * st
+                                for d, st in zip(shape, stride))
+    if max_idx >= 2 ** 31:
+        # index math below is int32 (x64 mode is off framework-wide):
+        # refuse rather than silently wrap into wrong values
+        raise ValueError(
+            f"as_strided: max flat index {max_idx} exceeds int32 range")
+
+    def fn(v):
+        flat = v.reshape(-1)
+        idx = jnp.asarray(offset, jnp.int32)
+        for k, (dim, st) in enumerate(zip(shape, stride)):
+            ax = jnp.arange(dim, dtype=jnp.int32) * st
+            idx = idx[..., None] + ax.reshape((1,) * k + (dim,))
+        return flat[idx]
+
+    return apply_op("as_strided", fn, [x])
+
+
 @register_op("unfold")
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     ks = _ints(kernel_sizes) if not isinstance(kernel_sizes, int) else (kernel_sizes, kernel_sizes)

@@ -1,0 +1,317 @@
+"""Reference nn.functional tail: losses (incl. CTC/RNN-T dynamic programs
+vs brute-force path enumeration), vision/pooling utilities.  Mirrors the
+reference's per-op tests under test/legacy_test/ (test_ctc_loss,
+test_warprnnt_op, test_fractional_max_pool2d, ...)."""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+rs = np.random.RandomState(31)
+t_ = paddle.to_tensor
+
+
+# ---------------- simple losses vs numpy oracles ----------------
+
+def test_soft_margin_loss():
+    x = rs.randn(4, 5).astype(np.float32)
+    y = np.sign(rs.randn(4, 5)).astype(np.float32)
+    got = float(F.soft_margin_loss(t_(x), t_(y)).numpy())
+    np.testing.assert_allclose(got, np.log1p(np.exp(-y * x)).mean(), rtol=1e-5)
+
+
+def test_multi_margin_loss():
+    x = rs.randn(4, 6).astype(np.float32)
+    y = rs.randint(0, 6, (4,))
+    got = float(F.multi_margin_loss(t_(x), t_(y)).numpy())
+    ref = 0.0
+    for i in range(4):
+        for j in range(6):
+            if j != y[i]:
+                ref += max(0.0, 1.0 - x[i, y[i]] + x[i, j]) / 6
+    np.testing.assert_allclose(got, ref / 4, rtol=1e-5)
+
+
+def test_multi_label_soft_margin_loss():
+    x = rs.randn(3, 4).astype(np.float32)
+    y = (rs.rand(3, 4) > 0.5).astype(np.float32)
+    got = float(F.multi_label_soft_margin_loss(t_(x), t_(y)).numpy())
+    sig = 1 / (1 + np.exp(-x))
+    ref = -(y * np.log(sig) + (1 - y) * np.log(1 - sig)).mean(-1).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_poisson_and_gaussian_nll():
+    x = rs.rand(3, 4).astype(np.float32) + 0.1
+    y = rs.poisson(2.0, (3, 4)).astype(np.float32)
+    got = float(F.poisson_nll_loss(t_(np.log(x)), t_(y)).numpy())
+    np.testing.assert_allclose(got, (x - y * np.log(x)).mean(), rtol=1e-4)
+
+    var = rs.rand(3, 4).astype(np.float32) + 0.5
+    g = float(F.gaussian_nll_loss(t_(x), t_(y), t_(var)).numpy())
+    np.testing.assert_allclose(
+        g, (0.5 * (np.log(var) + (x - y) ** 2 / var)).mean(), rtol=1e-4)
+
+
+def test_cosine_embedding_and_triplet_and_pairwise():
+    a = rs.randn(4, 8).astype(np.float32)
+    b = rs.randn(4, 8).astype(np.float32)
+    y = np.array([1, -1, 1, -1], np.int64)
+    got = float(F.cosine_embedding_loss(t_(a), t_(b), t_(y), margin=0.2).numpy())
+    cos = (a * b).sum(-1) / (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1))
+    ref = np.where(y == 1, 1 - cos, np.maximum(0, cos - 0.2)).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    n = rs.randn(4, 8).astype(np.float32)
+    tm = float(F.triplet_margin_loss(t_(a), t_(b), t_(n)).numpy())
+    dap = np.sqrt(((np.abs(a - b) + 1e-6) ** 2).sum(-1))
+    dan = np.sqrt(((np.abs(a - n) + 1e-6) ** 2).sum(-1))
+    np.testing.assert_allclose(tm, np.maximum(dap - dan + 1.0, 0).mean(), rtol=1e-4)
+
+    pd = F.pairwise_distance(t_(a), t_(b)).numpy()
+    np.testing.assert_allclose(pd, np.sqrt(((a - b + 1e-6) ** 2).sum(-1)), rtol=1e-4)
+
+
+def test_dice_loss():
+    x = rs.rand(2, 5, 3).astype(np.float32)
+    y = rs.randint(0, 3, (2, 5, 1)).astype(np.int64)
+    got = float(F.dice_loss(t_(x), t_(y)).numpy())
+    oh = np.eye(3, dtype=np.float32)[y[..., 0]]
+    inter = (x * oh).sum((1, 2))
+    total = x.sum((1, 2)) + oh.sum((1, 2))
+    np.testing.assert_allclose(got, (1 - (2 * inter + 1e-5) / (total + 1e-5)).mean(),
+                               rtol=1e-5)
+
+
+# ---------------- CTC vs brute-force path enumeration ----------------
+
+def _ctc_brute(lp, lab, blank):
+    """Sum over all alignments: paths of length T whose collapse equals lab."""
+    T, C = lp.shape
+    p = np.exp(lp)
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: remove repeats then blanks
+        out = []
+        prev = None
+        for s in path:
+            if s != prev:
+                out.append(s)
+            prev = s
+        out = [s for s in out if s != blank]
+        if out == list(lab):
+            total += np.prod([p[t, path[t]] for t in range(T)])
+    return -np.log(total)
+
+
+def test_ctc_loss_matches_brute_force():
+    T, B, C, U = 4, 2, 3, 2
+    logits = rs.randn(T, B, C).astype(np.float32)
+    labels = np.array([[1, 2], [2, 1]], np.int32)
+    in_len = np.array([4, 3], np.int64)
+    lab_len = np.array([2, 1], np.int64)
+
+    got = F.ctc_loss(t_(logits), t_(labels), t_(in_len), t_(lab_len),
+                     reduction="none").numpy()
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    want0 = _ctc_brute(lp[:4, 0], labels[0, :2], 0)
+    want1 = _ctc_brute(lp[:3, 1], labels[1, :1], 0)
+    np.testing.assert_allclose(got, [want0, want1], rtol=1e-4)
+
+    # mean reduction divides by label lengths first (reference semantics)
+    m = float(F.ctc_loss(t_(logits), t_(labels), t_(in_len), t_(lab_len)).numpy())
+    np.testing.assert_allclose(m, (want0 / 2 + want1 / 1) / 2, rtol=1e-4)
+
+    # grads flow
+    g = jax.grad(lambda l: F.ctc_loss(paddle.Tensor(l), t_(labels),
+                                      t_(in_len), t_(lab_len)).value())(
+        jnp.asarray(logits))
+    assert np.isfinite(np.asarray(g)).all()
+
+
+# ---------------- RNN-T vs brute-force path enumeration ----------------
+
+def _rnnt_brute(lp, lab, blank):
+    """Sum over monotonic alignments consuming T blanks (time advances) and
+    U emits; path = interleaving; final blank at (T-1, U) included."""
+    T, U1, C = lp.shape
+    U = len(lab)
+    p = np.exp(lp)
+
+    from functools import lru_cache
+
+    def rec(t, u):
+        if t >= T:
+            return 0.0
+        acc = 0.0
+        # emit label u at (t, u)
+        if u < U:
+            acc += p[t, u, lab[u]] * rec(t, u + 1)
+        # blank advances time
+        if t == T - 1 and u == U:
+            return p[t, u, blank]
+        if t < T - 1:
+            acc += p[t, u, blank] * rec(t + 1, u)
+        return acc
+
+    return -np.log(rec(0, 0))
+
+
+def test_rnnt_loss_matches_brute_force():
+    B, T, U, C = 2, 3, 2, 4
+    logits = rs.randn(B, T, U + 1, C).astype(np.float32)
+    labels = np.array([[1, 2], [3, 0]], np.int32)
+    in_len = np.array([3, 2], np.int64)
+    lab_len = np.array([2, 1], np.int64)
+
+    got = F.rnnt_loss(t_(logits), t_(labels), t_(in_len), t_(lab_len),
+                      fastemit_lambda=0.0, reduction="none").numpy()
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    want0 = _rnnt_brute(lp[0, :3], labels[0, :2], 0)
+    want1 = _rnnt_brute(lp[1, :2], labels[1, :1], 0)
+    np.testing.assert_allclose(got, [want0, want1], rtol=1e-4)
+
+
+# ---------------- vision/pooling utilities ----------------
+
+def test_channel_shuffle_and_temporal_shift():
+    x = np.arange(2 * 4 * 2 * 2, dtype=np.float32).reshape(2, 4, 2, 2)
+    out = F.channel_shuffle(t_(x), groups=2).numpy()
+    ref = x.reshape(2, 2, 2, 2, 2).swapaxes(1, 2).reshape(2, 4, 2, 2)
+    np.testing.assert_allclose(out, ref)
+
+    xt = rs.randn(4, 4, 2, 2).astype(np.float32)  # nt=4, seg=2
+    out = F.temporal_shift(t_(xt), seg_num=2, shift_ratio=0.25).numpy()
+    v5 = xt.reshape(2, 2, 4, 2, 2)
+    assert np.allclose(out.reshape(2, 2, 4, 2, 2)[:, 0, 0], v5[:, 1, 0])  # shifted back
+    assert np.allclose(out.reshape(2, 2, 4, 2, 2)[:, 1, 1], v5[:, 0, 1])  # shifted fwd
+    np.testing.assert_allclose(out.reshape(2, 2, 4, 2, 2)[:, :, 2:], v5[:, :, 2:])
+
+
+def test_lp_pool2d():
+    x = rs.rand(1, 2, 4, 4).astype(np.float32)
+    out = F.lp_pool2d(t_(x), norm_type=2, kernel_size=2, stride=2).numpy()
+    ref = np.zeros((1, 2, 2, 2), np.float32)
+    for i in range(2):
+        for j in range(2):
+            win = x[:, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+            ref[:, :, i, j] = np.sqrt((win ** 2).sum((2, 3)))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_lp_pool2d_ceil_mode():
+    x = rs.rand(1, 1, 5, 5).astype(np.float32)
+    out = F.lp_pool2d(t_(x), norm_type=2, kernel_size=2, stride=2,
+                      ceil_mode=True).numpy()
+    assert out.shape == (1, 1, 3, 3)
+    # partial last window = norm over the remaining 1x2 / 2x1 / 1x1 cells
+    np.testing.assert_allclose(
+        out[0, 0, 2, 2], np.abs(x[0, 0, 4, 4]), rtol=1e-5)
+
+
+def test_class_center_sample_keeps_all_positives():
+    lab = np.array([0, 2, 4, 6, 8], np.int64)
+    remapped, sampled = F.class_center_sample(t_(lab), num_classes=10,
+                                              num_samples=3)
+    s = sampled.numpy()
+    assert set([0, 2, 4, 6, 8]).issubset(set(s.tolist()))
+    r = remapped.numpy()
+    assert (r >= 0).all()
+    for i, v in enumerate(lab):
+        assert s[r[i]] == v
+
+
+def test_rnnt_fastemit_value_preserved_grad_scaled():
+    B, T, U, C = 1, 3, 2, 4
+    logits = rs.randn(B, T, U + 1, C).astype(np.float32)
+    labels = np.array([[1, 2]], np.int32)
+    in_len = np.array([3], np.int64)
+    lab_len = np.array([2], np.int64)
+
+    def loss(l, lam):
+        return F.rnnt_loss(paddle.Tensor(l), t_(labels), t_(in_len),
+                           t_(lab_len), fastemit_lambda=lam).value()
+
+    l0 = float(loss(jnp.asarray(logits), 0.0))
+    l1 = float(loss(jnp.asarray(logits), 0.5))
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)  # value identical
+    g0 = np.asarray(jax.grad(lambda l: loss(l, 0.0))(jnp.asarray(logits)))
+    g1 = np.asarray(jax.grad(lambda l: loss(l, 0.5))(jnp.asarray(logits)))
+    assert np.abs(g0 - g1).max() > 1e-6  # emit-path gradient changed
+
+
+def test_rrelu_eval_and_train():
+    x = rs.randn(3, 4).astype(np.float32)
+    out = F.rrelu(t_(x), training=False).numpy()
+    mid = (1 / 8 + 1 / 3) / 2
+    np.testing.assert_allclose(out, np.where(x >= 0, x, mid * x), rtol=1e-6)
+    tr = F.rrelu(t_(x), training=True).numpy()
+    neg = x < 0
+    slopes = tr[neg] / x[neg]
+    assert ((slopes >= 1 / 8 - 1e-6) & (slopes <= 1 / 3 + 1e-6)).all()
+    np.testing.assert_allclose(tr[~neg], x[~neg])
+
+
+def test_affine_grid_identity():
+    theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32), (1, 1, 1))
+    grid = F.affine_grid(t_(theta), [1, 1, 3, 3]).numpy()
+    np.testing.assert_allclose(grid[0, :, :, 0], np.tile(np.linspace(-1, 1, 3), (3, 1)),
+                               atol=1e-6)
+    np.testing.assert_allclose(grid[0, :, :, 1], np.tile(np.linspace(-1, 1, 3), (3, 1)).T,
+                               atol=1e-6)
+
+
+def test_fold_inverts_unfold_on_disjoint_patches():
+    x = rs.randn(1, 2, 4, 4).astype(np.float32)
+    cols = F.unfold(t_(x), kernel_sizes=2, strides=2)
+    back = F.fold(cols, output_sizes=[4, 4], kernel_sizes=2, strides=2).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-6)
+
+
+def test_fractional_max_pool2d_properties():
+    x = rs.randn(1, 1, 8, 8).astype(np.float32)
+    out = F.fractional_max_pool2d(t_(x), output_size=4, random_u=0.4).numpy()
+    assert out.shape == (1, 1, 4, 4)
+    # every output is an input value and >= the global min
+    assert np.isin(out, x).all()
+    # deterministic given random_u
+    out2 = F.fractional_max_pool2d(t_(x), output_size=4, random_u=0.4).numpy()
+    np.testing.assert_allclose(out, out2)
+
+
+def test_class_center_sample_and_margin_ce():
+    lab = np.array([3, 7, 3, 1], np.int64)
+    remapped, sampled = F.class_center_sample(t_(lab), num_classes=10,
+                                              num_samples=6)
+    s = sampled.numpy()
+    r = remapped.numpy()
+    assert len(s) == 6 and len(np.unique(s)) == 6
+    for orig in (1, 3, 7):
+        assert orig in s
+    # remap consistency: label -> index of its class in `sampled`
+    for i, v in enumerate(lab):
+        assert s[r[i]] == v
+
+    # margin CE reduces to plain softmax CE with zero margins, scale 1
+    cos = np.clip(rs.randn(4, 5).astype(np.float32) * 0.3, -1, 1)
+    y = rs.randint(0, 5, (4,))
+    got = float(F.margin_cross_entropy(t_(cos), t_(y), margin1=1.0,
+                                       margin2=0.0, margin3=0.0,
+                                       scale=1.0).numpy())
+    e = np.exp(cos - cos.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    ref = -np.log(sm[np.arange(4), y]).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+    # arcface margin increases the loss for the true class
+    harder = float(F.margin_cross_entropy(t_(cos), t_(y), margin2=0.5,
+                                          scale=1.0).numpy())
+    assert harder > got

@@ -353,6 +353,10 @@ SPECS = [
     S("unbind", [F(3, 4)], lambda x: [x[i] for i in range(3)], kw=dict(axis=0), out=0),
     S("unstack", [F(3, 4)], lambda x: [x[i] for i in range(3)], kw=dict(axis=0), out=0),
     S("unfold", [F(1, 1, 4, 4)], lambda x: _np_unfold_2x2(x), kw=dict(kernel_sizes=2, strides=2), grad=False),
+    # element-strides (not numpy's byte-strides): overlapping windows of a flat [12]
+    S("as_strided", [F(12)],
+      lambda x: np.stack([x.reshape(-1)[o:o + 4] for o in (0, 2, 4)]),
+      kw=dict(shape=[3, 4], stride=[2, 1]), grad=True),
     S("unique", [I(8, high=4)], lambda x: np.unique(x), grad=False, jit=False),
     S("unique_consecutive", [np.array([1, 1, 2, 2, 3, 1])], lambda x: _np_uniq_consec(x), grad=False, jit=False),
     S("where", [B(2, 3), F(2, 3), F(2, 3)], np.where, grad_inputs=[1, 2]),
