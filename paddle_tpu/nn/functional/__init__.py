@@ -1533,35 +1533,50 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
 @_export
 def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
-    """pooling.py fractional_max_pool2d (Graham, arXiv:1412.6071):
-    pseudo-random pooling regions from a single u in (0, 1); deterministic
-    given ``random_u`` (drawn from the framework RNG otherwise)."""
-    out_hw = _pair(output_size)
+    """pooling.py fractional_max_pool2d (Graham, arXiv:1412.6071).
 
-    def starts(n, o, k, u):
-        # the paper's pseudorandom sequence: ceil(alpha*(i+u)) spaced starts
-        alpha = (n - k) / max(o - 1, 1)
-        idx = np.arange(o, dtype=np.float64)
-        s = np.ceil(alpha * (idx + u)).astype(np.int64) - int(np.ceil(alpha * u))
-        return np.clip(s, 0, n - k)
+    Default (kernel_size=None) is the reference's DISJOINT mode: variable
+    windows [ceil(a*(i+u)-1), ceil(a*(i+1+u)-1)) with a = n/out, which
+    tile the input exactly (pooling.py:2108 example reproduced in tests).
+    With kernel_size set, fixed windows start at the same pseudo-random
+    positions (overlapping mode).  Deterministic given ``random_u``."""
+    out_hw = _pair(output_size)
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool2d(return_mask=True) is not supported")
+
+    def bounds(n, o, u):
+        a = n / o
+        i = np.arange(o, dtype=np.float64)
+        start = np.ceil(a * (i + u) - 1).astype(np.int64)
+        end = np.ceil(a * (i + 1 + u) - 1).astype(np.int64)
+        return np.clip(start, 0, n - 1), np.clip(end, 1, n)
 
     def fn(v):
         n, c, h, w = v.shape
         u = (float(random_u) if random_u is not None
              else float(jax.random.uniform(rng.next_key(), ())))
-        kh, kw = _pair(kernel_size) if kernel_size is not None else (
-            h // out_hw[0], w // out_hw[1])
-        rs = starts(h, out_hw[0], kh, u)
-        cs = starts(w, out_hw[1], kw, u)
-        # gather each region and max over it
-        rows = rs[:, None] + np.arange(kh)[None, :]      # [oh, kh]
-        cols = cs[:, None] + np.arange(kw)[None, :]      # [ow, kw]
-        patches = v[:, :, rows][:, :, :, :, cols]        # [n,c,oh,kh,ow,kw]
+        if kernel_size is None:
+            rs_, re_ = bounds(h, out_hw[0], u)
+            cs_, ce_ = bounds(w, out_hw[1], u)
+        else:
+            kh_, kw_ = _pair(kernel_size)
+            rs_, _ = bounds(h, out_hw[0], u)
+            cs_, _ = bounds(w, out_hw[1], u)
+            rs_ = np.clip(rs_, 0, h - kh_)
+            cs_ = np.clip(cs_, 0, w - kw_)
+            re_, ce_ = rs_ + kh_, cs_ + kw_
+        kh = int((re_ - rs_).max())
+        kw = int((ce_ - cs_).max())
+        rows = np.minimum(rs_[:, None] + np.arange(kh)[None, :], h - 1)
+        cols = np.minimum(cs_[:, None] + np.arange(kw)[None, :], w - 1)
+        rmask = np.arange(kh)[None, :] < (re_ - rs_)[:, None]   # [oh, kh]
+        cmask = np.arange(kw)[None, :] < (ce_ - cs_)[:, None]   # [ow, kw]
+        patches = v[:, :, rows][:, :, :, :, cols]  # [n,c,oh,kh,ow,kw]
+        mask = (rmask[:, :, None, None] & cmask[None, None, :, :])
+        patches = jnp.where(mask[None, None], patches, -jnp.inf)
         return patches.max(axis=(3, 5))
 
-    if return_mask:
-        raise NotImplementedError(
-            "fractional_max_pool2d(return_mask=True) is not supported")
     return apply_op("fractional_max_pool2d", fn, [x])
 
 

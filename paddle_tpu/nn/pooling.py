@@ -10,6 +10,7 @@ __all__ = [
     "AvgPool1D", "AvgPool2D", "AvgPool3D",
     "AdaptiveAvgPool1D", "AdaptiveAvgPool2D", "AdaptiveAvgPool3D",
     "AdaptiveMaxPool1D", "AdaptiveMaxPool2D", "AdaptiveMaxPool3D",
+    "LPPool2D", "FractionalMaxPool2D",
 ]
 
 
@@ -87,3 +88,32 @@ class AdaptiveMaxPool2D(_AdaptivePool):
 class AdaptiveMaxPool3D(_AdaptivePool):
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self.output_size)
+
+
+class LPPool2D(Layer):
+    """layer/pooling.py LPPool2D over F.lp_pool2d."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.norm_type, self.kernel_size = norm_type, kernel_size
+        self.stride, self.padding = stride, padding
+        self.ceil_mode, self.data_format = ceil_mode, data_format
+
+    def forward(self, x):
+        return F.lp_pool2d(x, self.norm_type, self.kernel_size, self.stride,
+                           self.padding, self.ceil_mode, self.data_format)
+
+
+class FractionalMaxPool2D(Layer):
+    """layer/pooling.py FractionalMaxPool2D over F.fractional_max_pool2d."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size, self.kernel_size = output_size, kernel_size
+        self.random_u, self.return_mask = random_u, return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)

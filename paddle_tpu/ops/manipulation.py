@@ -578,6 +578,12 @@ def as_strided(x, shape, stride, offset=0, name=None):
         # refuse rather than silently wrap into wrong values
         raise ValueError(
             f"as_strided: max flat index {max_idx} exceeds int32 range")
+    numel = int(np.prod(_unwrap(x).shape))
+    if max_idx >= numel:
+        # JAX gather clamps out-of-range indices — refuse, don't corrupt
+        raise ValueError(
+            f"as_strided: max flat index {max_idx} out of bounds for "
+            f"storage of {numel} elements")
 
     def fn(v):
         flat = v.reshape(-1)

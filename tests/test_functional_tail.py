@@ -315,3 +315,37 @@ def test_class_center_sample_and_margin_ce():
     harder = float(F.margin_cross_entropy(t_(cos), t_(y), margin2=0.5,
                                           scale=1.0).numpy())
     assert harder > got
+
+
+def test_fractional_max_pool2d_reference_docstring_example():
+    """pooling.py:2119: seq [2,4,3,1,5,2,3], output 5, u=0.3 -> [2,4,1,5,3]
+    (disjoint variable windows [1,2,1,2,1])."""
+    seq = np.array([2, 4, 3, 1, 5, 2, 3], np.float32).reshape(1, 1, 1, 7)
+    x = np.repeat(seq, 7, axis=2)
+    out = F.fractional_max_pool2d(t_(x), output_size=(1, 5), random_u=0.3)
+    np.testing.assert_allclose(out.numpy()[0, 0, 0], [2, 4, 1, 5, 3])
+
+
+def test_as_strided_out_of_bounds_raises():
+    x = t_(np.arange(6, dtype=np.float32))
+    with pytest.raises(ValueError, match="out of bounds"):
+        paddle.as_strided(x, shape=[3], stride=[4])
+    # valid overlapping windows still work
+    got = paddle.as_strided(x, shape=[2, 3], stride=[2, 1]).numpy()
+    np.testing.assert_allclose(got, [[0, 1, 2], [2, 3, 4]])
+
+
+def test_loss_layer_wrappers_delegate():
+    """New Layer wrappers produce the same numbers as their functionals."""
+    import paddle_tpu.nn as nn
+
+    a = rs.randn(3, 4).astype(np.float32)
+    y = np.sign(rs.randn(3, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        float(nn.SoftMarginLoss()(t_(a), t_(y)).numpy()),
+        float(F.soft_margin_loss(t_(a), t_(y)).numpy()))
+    b = rs.randn(3, 4).astype(np.float32)
+    lab = np.array([1, -1, 1], np.int64)
+    np.testing.assert_allclose(
+        float(nn.CosineEmbeddingLoss(margin=0.1)(t_(a), t_(b), t_(lab)).numpy()),
+        float(F.cosine_embedding_loss(t_(a), t_(b), t_(lab), margin=0.1).numpy()))
