@@ -1201,6 +1201,26 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
 
 
 @_export
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """loss.py triplet_margin_with_distance_loss: triplet hinge with a
+    caller-supplied distance (default pairwise L2)."""
+    dist = distance_function or (lambda a, b: pairwise_distance(a, b))
+    d_ap = dist(input, positive)
+    d_an = dist(input, negative)
+    if swap:
+        from ...ops import math as _m
+        d_an = _m.minimum(d_an, dist(positive, negative))
+
+    def fn(ap, an):
+        return _reduce_loss(jnp.maximum(ap - an + margin, 0.0), reduction)
+
+    return apply_op("triplet_margin_with_distance_loss", fn, [d_ap, d_an])
+
+
+@_export
 def dice_loss(input, label, epsilon=1e-5, name=None):
     """loss.py:50: 1 - 2*intersection/total over one-hot labels."""
     def fn(x, y):
@@ -1544,6 +1564,7 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
     if return_mask:
         raise NotImplementedError(
             "fractional_max_pool2d(return_mask=True) is not supported")
+    
 
     def bounds(n, o, u):
         a = n / o
@@ -1554,6 +1575,10 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
 
     def fn(v):
         n, c, h, w = v.shape
+        if out_hw[0] > h or out_hw[1] > w:
+            raise ValueError(
+                f"fractional_max_pool2d: output_size {out_hw} exceeds input "
+                f"spatial size {(h, w)} (fractional pooling downsamples)")
         u = (float(random_u) if random_u is not None
              else float(jax.random.uniform(rng.next_key(), ())))
         if kernel_size is None:
@@ -1574,7 +1599,9 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
         cmask = np.arange(kw)[None, :] < (ce_ - cs_)[:, None]   # [ow, kw]
         patches = v[:, :, rows][:, :, :, :, cols]  # [n,c,oh,kh,ow,kw]
         mask = (rmask[:, :, None, None] & cmask[None, None, :, :])
-        patches = jnp.where(mask[None, None], patches, -jnp.inf)
+        fill = (jnp.iinfo(v.dtype).min if jnp.issubdtype(v.dtype, jnp.integer)
+                else jnp.asarray(-jnp.inf, v.dtype))  # dtype-preserving
+        patches = jnp.where(mask[None, None], patches, fill)
         return patches.max(axis=(3, 5))
 
     return apply_op("fractional_max_pool2d", fn, [x])

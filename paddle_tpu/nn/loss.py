@@ -205,8 +205,8 @@ class TripletMarginLoss(Layer):
 
 
 class TripletMarginWithDistanceLoss(Layer):
-    """layer/loss.py TripletMarginWithDistanceLoss: custom distance_function
-    (default: pairwise L2)."""
+    """layer/loss.py TripletMarginWithDistanceLoss over
+    F.triplet_margin_with_distance_loss."""
 
     def __init__(self, distance_function=None, margin=1.0, swap=False,
                  reduction="mean", name=None):
@@ -215,25 +215,10 @@ class TripletMarginWithDistanceLoss(Layer):
         self.margin, self.swap, self.reduction = margin, swap, reduction
 
     def forward(self, input, positive, negative):
-        dist = self.distance_function or (
-            lambda a, b: F.pairwise_distance(a, b))
-        d_ap = dist(input, positive)
-        d_an = dist(input, negative)
-        if self.swap:
-            from ..ops import math as _m
-            d_an = _m.minimum(d_an, dist(positive, negative))
-        from ..core.tensor import apply_op
-        import jax.numpy as jnp
-
-        def fn(ap, an):
-            per = jnp.maximum(ap - an + self.margin, 0.0)
-            if self.reduction == "mean":
-                return per.mean()
-            if self.reduction == "sum":
-                return per.sum()
-            return per
-
-        return apply_op("triplet_margin_with_distance", fn, [d_ap, d_an])
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative,
+            distance_function=self.distance_function, margin=self.margin,
+            swap=self.swap, reduction=self.reduction)
 
 
 class CTCLoss(Layer):

@@ -571,19 +571,21 @@ def as_strided(x, shape, stride, offset=0, name=None):
     stride = tuple(int(s) for s in stride)
     if len(shape) != len(stride):
         raise ValueError(f"shape {shape} and stride {stride} rank mismatch")
-    max_idx = int(offset) + sum(max(d - 1, 0) * st
-                                for d, st in zip(shape, stride))
+    max_idx = int(offset) + sum(max(d - 1, 0) * st for d, st
+                                in zip(shape, stride) if st > 0)
+    min_idx = int(offset) + sum(max(d - 1, 0) * st for d, st
+                                in zip(shape, stride) if st < 0)
     if max_idx >= 2 ** 31:
         # index math below is int32 (x64 mode is off framework-wide):
         # refuse rather than silently wrap into wrong values
         raise ValueError(
             f"as_strided: max flat index {max_idx} exceeds int32 range")
     numel = int(np.prod(_unwrap(x).shape))
-    if max_idx >= numel:
-        # JAX gather clamps out-of-range indices — refuse, don't corrupt
+    if max_idx >= numel or min_idx < 0:
+        # JAX gather clamps/wraps out-of-range indices — refuse, don't corrupt
         raise ValueError(
-            f"as_strided: max flat index {max_idx} out of bounds for "
-            f"storage of {numel} elements")
+            f"as_strided: flat index range [{min_idx}, {max_idx}] out of "
+            f"bounds for storage of {numel} elements")
 
     def fn(v):
         flat = v.reshape(-1)

@@ -34,6 +34,9 @@ __all__ = [
     "NAdam",
     "RAdam",
     "Lamb",
+    "ASGD",
+    "Rprop",
+    "LBFGS",
     "lr",
 ]
 lr = lr_mod
@@ -102,12 +105,12 @@ class Optimizer:
 
     # functional entry for the jit path: same math over a pytree
     def init_state_pytree(self, params):
-        names = self._state_names()
+        # delegates to _init_param_state so non-zero-init optimizers (Rprop's
+        # elem_lr, ASGD's ring of grads) have ONE init definition
         return {
             "step": jnp.zeros((), jnp.int32),
             "acc": jax.tree_util.tree_map(
-                lambda p: {n: jnp.zeros(jnp.shape(p), jnp.float32) for n in names}, params
-            ),
+                lambda p: self._init_param_state(p), params),
         }
 
     def apply_gradients_pytree(self, params, grads, opt_state, lr=None):
@@ -458,3 +461,172 @@ class Lamb(Optimizer):
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return p - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class ASGD(Optimizer):
+    """Averaged/aggregated SGD (reference: optimizer/asgd.py:41 — the
+    finite-sum SAG-style rule: d accumulates the freshest gradient of each
+    of the last ``batch_num`` batches, y_i remembers batch i's gradient):
+
+        d = d - y_i + g;  y_i = g;  x -= lr * (d / min(m+1, n) + wd * x)
+    """
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._n = int(batch_num)
+
+    def _init_param_state(self, p):
+        return {
+            "d": jnp.zeros(tuple(jnp.shape(p)), jnp.float32),
+            "ys": jnp.zeros((self._n,) + tuple(jnp.shape(p)), jnp.float32),
+        }
+
+    def _update(self, p, g, state, lr, step):
+        m = step - 1  # 0-based batch counter
+        i = m % self._n
+        y_i = state["ys"][i]
+        d = state["d"] - y_i + g
+        ys = state["ys"].at[i].set(g)
+        denom = jnp.minimum(jnp.asarray(m + 1, jnp.float32), float(self._n))
+        new_p = p - lr * (d / denom + self._weight_decay * p)
+        return new_p, {"d": d, "ys": ys}
+
+
+class Rprop(Optimizer):
+    """Resilient backpropagation (reference: optimizer/rprop.py:40):
+    per-element step sizes grown by eta+ on agreeing gradient signs, shrunk
+    by eta- on sign flips (with the flip's update suppressed)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr0 = float(learning_rate)
+        self._lr_min, self._lr_max = (float(v) for v in learning_rate_range)
+        self._eta_minus, self._eta_plus = (float(v) for v in etas)
+
+    def _init_param_state(self, p):
+        return {
+            "prev_grad": jnp.zeros(tuple(jnp.shape(p)), jnp.float32),
+            "elem_lr": jnp.full(tuple(jnp.shape(p)), self._lr0, jnp.float32),
+        }
+
+    def _update(self, p, g, state, lr, step):
+        prod = state["prev_grad"] * g
+        elr = jnp.where(
+            prod > 0, jnp.minimum(state["elem_lr"] * self._eta_plus, self._lr_max),
+            jnp.where(prod < 0,
+                      jnp.maximum(state["elem_lr"] * self._eta_minus, self._lr_min),
+                      state["elem_lr"]))
+        g_eff = jnp.where(prod < 0, 0.0, g)
+        new_p = p - jnp.sign(g_eff) * elr
+        return new_p, {"prev_grad": g_eff, "elem_lr": elr}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with closure-driven line search (reference:
+    optimizer/lbfgs.py — step(closure) re-evaluates the loss; two-loop
+    recursion over the last ``history_size`` (s, y) pairs; 'strong_wolfe'
+    is approximated by backtracking Armijo, which the reference also falls
+    back to between wolfe probes)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, tolerance_grad=1e-7,
+                 tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        if grad_clip is not None:
+            raise NotImplementedError(
+                "LBFGS: grad_clip inside the line search is not supported")
+        self._max_iter = int(max_iter)
+        self._tol_grad = float(tolerance_grad)
+        self._tol_change = float(tolerance_change)
+        self._hist = int(history_size)
+        self._line_search = line_search_fn
+        self._s: list = []
+        self._y: list = []
+        self._prev_flat = None
+        self._prev_grad = None
+
+    def _flat_params(self):
+        return jnp.concatenate([
+            _unwrap(p).astype(jnp.float32).reshape(-1)
+            for p in self._parameter_list])
+
+    def _flat_grads(self):
+        g = jnp.concatenate([
+            (_unwrap(p.grad).astype(jnp.float32).reshape(-1)
+             if p.grad is not None else jnp.zeros(int(np.prod(p.shape)),
+                                                  jnp.float32))
+            for p in self._parameter_list])
+        if self._weight_decay:
+            g = g + self._weight_decay * self._flat_params()
+        return g
+
+    def _write_flat(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p.shape))
+            p._value = flat[off:off + n].reshape(p.shape).astype(p.dtype)
+            off += n
+
+    def _direction(self, g):
+        q = g
+        alphas = []
+        for s_i, y_i in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y_i, s_i), 1e-10)
+            a = rho * jnp.vdot(s_i, q)
+            q = q - a * y_i
+            alphas.append((a, rho, s_i, y_i))
+        if self._y:
+            gamma = (jnp.vdot(self._s[-1], self._y[-1])
+                     / jnp.maximum(jnp.vdot(self._y[-1], self._y[-1]), 1e-10))
+            q = q * gamma
+        for a, rho, s_i, y_i in reversed(alphas):
+            b = rho * jnp.vdot(y_i, q)
+            q = q + (a - b) * s_i
+        return -q
+
+    def step(self, closure):
+        """closure: re-evaluates the model and returns the loss (it must
+        call loss.backward() itself, reference lbfgs.py contract)."""
+        for p in self._parameter_list:
+            p.clear_grad()  # a prior step()'s last probe leaves grads behind
+        loss = closure()
+        for _ in range(self._max_iter):
+            flat = self._flat_params()
+            g = self._flat_grads()
+            if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
+                break
+            if self._prev_flat is not None:
+                s_k = flat - self._prev_flat
+                y_k = g - self._prev_grad
+                if float(jnp.vdot(s_k, y_k)) > 1e-10:
+                    self._s.append(s_k)
+                    self._y.append(y_k)
+                    if len(self._s) > self._hist:
+                        self._s.pop(0)
+                        self._y.pop(0)
+            d = self._direction(g)
+            self._prev_flat, self._prev_grad = flat, g
+            t = self.get_lr()
+            f0 = float(loss)
+            gtd = float(jnp.vdot(g, d))
+            # backtracking Armijo (the reference's wolfe search reduces to
+            # this when the curvature probe succeeds immediately)
+            for _bt in range(20):
+                self._write_flat(flat + t * d)
+                for p in self._parameter_list:
+                    p.clear_grad()
+                loss = closure()
+                if float(loss) <= f0 + 1e-4 * t * gtd or self._line_search is None:
+                    break
+                t *= 0.5
+            if abs(float(jnp.max(jnp.abs(t * d)))) < self._tol_change:
+                break
+        return loss

@@ -157,3 +157,91 @@ def test_multi_precision_master_weights():
     opt.step()
     assert w.dtype == paddle.bfloat16
     assert id(w) in opt._master_weights
+
+
+def test_asgd_matches_sgd_at_batch_num_1():
+    """ASGD with batch_num=1 degenerates to SGD+wd (asgd.py:41 recursion
+    with n=1: d == g every step)."""
+    import paddle_tpu.nn as nn
+
+    r = np.random.RandomState(3)
+    w0 = r.randn(4, 2).astype(np.float32)
+    x = r.randn(8, 4).astype(np.float32)
+
+    def run(opt_cls, **kw):
+        lin = nn.Linear(4, 2)
+        lin.weight.set_value(w0.copy())
+        lin.bias.set_value(np.zeros(2, np.float32))
+        o = opt_cls(learning_rate=0.1, parameters=lin.parameters(), **kw)
+        for _ in range(3):
+            loss = (lin(paddle.to_tensor(x)) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        return lin.weight.numpy()
+
+    np.testing.assert_allclose(run(optimizer.ASGD, batch_num=1), run(optimizer.SGD),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_asgd_averages_last_n_batch_grads():
+    """With batch_num=2 the update uses (g_t + g_{t-1}) / 2 once warm."""
+    import paddle_tpu.nn as nn
+
+    lin = nn.Linear(1, 1)
+    lin.weight.set_value(np.zeros((1, 1), np.float32))
+    lin.bias.set_value(np.zeros(1, np.float32))
+    lin.bias.stop_gradient = True
+    o = optimizer.ASGD(learning_rate=1.0, batch_num=2, parameters=[lin.weight])
+    # craft inputs so dL/dw alternates between 2 and 4 exactly: L = g_k * w
+    for k, gval in enumerate([2.0, 4.0, 2.0]):
+        loss = (lin(paddle.to_tensor(np.full((1, 1), gval, np.float32)))).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    # steps: w0=0; s1: d=2, denom=1 -> w=-2; s2: d=2+4=6, denom=2 -> w=-5;
+    # s3: y_0 replaced (2->2): d=6-2+2=6, denom=2 -> w=-8
+    np.testing.assert_allclose(float(lin.weight.numpy()), -8.0, rtol=1e-5)
+
+
+def test_rprop_sign_adaptation():
+    """Element step sizes grow on agreeing signs (eta+), shrink and skip the
+    update on flips (eta-), per rprop.py:46."""
+    p = paddle.Parameter(np.zeros(1, np.float32))
+    o = optimizer.Rprop(learning_rate=0.1, etas=(0.5, 1.2),
+                  learning_rate_range=(1e-5, 50.0), parameters=[p])
+    # manually drive grads: two agreeing steps then a flip
+    for g, want_delta in [(1.0, -0.1),       # first: sign*lr0
+                          (1.0, -0.12),      # grew by eta+
+                          (-1.0, 0.0)]:      # flip: lr shrinks, no move
+        p._grad = paddle.to_tensor(np.full(1, g, np.float32))
+        before = float(p.numpy())
+        o.step()
+        o.clear_grad()
+        np.testing.assert_allclose(float(p.numpy()) - before, want_delta,
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_lbfgs_minimizes_quadratic_exactly():
+    """LBFGS with closure + line search drives a linear least-squares loss
+    to ~0 in one outer step (lbfgs.py step(closure) contract)."""
+    import paddle_tpu.nn as nn
+
+    r = np.random.RandomState(5)
+    W = r.randn(4, 1).astype(np.float32)
+    xs = r.randn(64, 4).astype(np.float32)
+    ys = xs @ W
+    lin = nn.Linear(4, 1)
+    o = optimizer.LBFGS(learning_rate=1.0, max_iter=15,
+                  line_search_fn="strong_wolfe", parameters=lin.parameters())
+
+    def closure():
+        loss = ((lin(paddle.to_tensor(xs)) - paddle.to_tensor(ys)) ** 2).mean()
+        loss.backward()
+        return loss
+
+    l0 = float(closure().numpy())
+    for p in lin.parameters():
+        p.clear_grad()
+    lf = float(o.step(closure).numpy())
+    assert lf < l0 * 1e-3, (l0, lf)
