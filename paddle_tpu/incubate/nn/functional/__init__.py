@@ -7,6 +7,7 @@ implementation in paddle_tpu.ops.pallas."""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ....core.tensor import Tensor, _unwrap, apply_op
@@ -26,6 +27,11 @@ __all__ = [
     "masked_multihead_attention",
     "block_multihead_attention",
     "fused_multi_transformer",
+    "fused_matmul_bias",
+    "fused_dropout_add",
+    "fused_dot_product_attention",
+    "fused_gate_attention",
+    "blha_get_max_len",
 ]
 
 
@@ -106,15 +112,7 @@ def swiglu(x, y=None, name=None):
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
-    def fn(v, w, *rest):
-        w_ = w.T if transpose_weight else w
-        out = v @ w_
-        if rest:
-            out = out + rest[0]
-        return out
-
-    inputs = [x, weight] + ([bias] if bias is not None else [])
-    return apply_op("fused_linear", fn, inputs)
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
 
 
 def fused_bias_act(x, bias=None, act_method="gelu", **kw):
@@ -639,3 +637,140 @@ def fused_multi_transformer(
     if use_cache:
         return res[0], list(res[1:])
     return res
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """fused_matmul_bias.py: matmul + bias add in one op (cublasLt epilogue
+    on the reference; one fused XLA dot here)."""
+    def fn(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if rest:
+            out = out + rest[0]
+        return out
+
+    ins = [x, y] + ([bias] if bias is not None else [])
+    return apply_op("fused_matmul_bias", fn, ins)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """fused_dropout_add.py: dropout(x) + y without materializing the
+    intermediate (XLA fuses the mask/scale/add)."""
+    from ....core import rng as _rng
+
+    def fn(a, b):
+        if not training or p == 0.0:
+            out = a if mode == "upscale_in_train" else a * (1.0 - p)
+            return out + b
+        keep = jax.random.bernoulli(_rng.next_key(), 1.0 - p, a.shape)
+        if mode == "upscale_in_train":
+            a = jnp.where(keep, a / (1.0 - p), 0.0)
+        else:
+            a = jnp.where(keep, a, 0.0)
+        return a + b
+
+    return apply_op("fused_dropout_add", fn, [x, y])
+
+
+def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                is_causal=False, training=True,
+                                scaling_factor=None, name=None):
+    """fused_dot_product_attention.py (cuDNN fused attention on the
+    reference): BSHD q/k/v -> BSHD out, optional additive mask / causal."""
+    import math as _math
+
+    def fn(qv, kv, vv, *rest):
+        b, s, h, d = qv.shape
+        scale = scaling_factor if scaling_factor is not None else 1.0 / _math.sqrt(d)
+        logits = jnp.einsum("bshd,bShd->bhsS", qv.astype(jnp.float32),
+                            kv.astype(jnp.float32)) * scale
+        if rest:
+            m = rest[0]
+            logits = (jnp.where(m, logits, -1e30) if m.dtype == jnp.bool_
+                      else logits + m.astype(logits.dtype))
+        if is_causal:
+            S = kv.shape[1]
+            cm = jnp.arange(S)[None, :] <= jnp.arange(s)[:, None]
+            logits = jnp.where(cm[None, None], logits, -1e30)
+        w = jax.nn.softmax(logits, axis=-1)
+        if dropout_p and training:
+            from ....core import rng as _rng
+            keep = jax.random.bernoulli(_rng.next_key(), 1.0 - dropout_p,
+                                        w.shape)
+            w = jnp.where(keep, w / (1.0 - dropout_p), 0.0)
+        return jnp.einsum("bhsS,bShd->bshd", w.astype(vv.dtype), vv)
+
+    ins = [q, k, v] + ([attn_mask] if attn_mask is not None else [])
+    return apply_op("fused_dot_product_attention", fn, ins)
+
+
+def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
+                         value_weight=None, qkv_weight=None,
+                         gate_linear_weight=None, gate_linear_bias=None,
+                         out_linear_weight=None, out_linear_bias=None,
+                         nonbatched_bias=None, attn_mask=None,
+                         has_gating=True, merge_qkv=True,
+                         use_flash_attn=False, name=None):
+    """fused_gate_attention.py:26 (AlphaFold gated MSA self-attention; the
+    docstring's einsum program executed verbatim): query [n, b, q, a],
+    per-head projections, optional nonbatched bias, sigmoid gating on the
+    weighted average, and the output projection."""
+    ins = [query]
+    names = []
+    for nm, t in (("key", key), ("qw", query_weight), ("kw", key_weight),
+                  ("vw", value_weight), ("qkvw", qkv_weight),
+                  ("gw", gate_linear_weight), ("gb", gate_linear_bias),
+                  ("ow", out_linear_weight), ("ob", out_linear_bias),
+                  ("nbias", nonbatched_bias), ("mask", attn_mask)):
+        if t is not None:
+            ins.append(t)
+            names.append(nm)
+
+    def fn(qd, *rest):
+        g = dict(zip(names, rest))
+        m_data = g.get("key", qd)
+        if merge_qkv:
+            # qkv_weight [3, heads, head_dim, a]
+            qw = jnp.moveaxis(g["qkvw"][0], -1, 0)   # [a, h, c]
+            kw = jnp.moveaxis(g["qkvw"][1], -1, 0)
+            vw = jnp.moveaxis(g["qkvw"][2], -1, 0)
+        else:
+            qw, kw, vw = g["qw"], g["kw"], g["vw"]
+        c = qw.shape[-1] ** -0.5
+        q = jnp.einsum("nbqa,ahc->nbqhc", qd, qw) * c
+        k = jnp.einsum("nbka,ahc->nbkhc", m_data, kw)
+        v = jnp.einsum("nbka,ahc->nbkhc", m_data, vw)
+        logits = jnp.einsum("nbqhc,nbkhc->nbhqk",
+                            q.astype(jnp.float32), k.astype(jnp.float32))
+        if "mask" in g:
+            # [n, b, 1, 1, k] additive mask
+            logits = logits + g["mask"].astype(logits.dtype)
+        if "nbias" in g:
+            logits = logits + jnp.expand_dims(g["nbias"], 1).astype(logits.dtype)
+        w = jax.nn.softmax(logits, axis=-1)
+        avg = jnp.einsum("nbhqk,nbkhc->nbqhc", w.astype(v.dtype), v)
+        if has_gating:
+            gate = jnp.einsum("nbqc,chv->nbqhv", qd, g["gw"]) + g["gb"]
+            avg = avg * jax.nn.sigmoid(gate)
+        out = jnp.einsum("nbqhc,hco->nbqo", avg, g["ow"])
+        if "ob" in g:
+            out = out + g["ob"]
+        return out
+
+    return apply_op("fused_gate_attention", fn, ins)
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size,
+                     name=None):
+    """blha_get_max_len.py: max encoder/decoder lengths for the block
+    attention launch config (two scalar maxes)."""
+    def fn(e, d):
+        return jnp.max(e).reshape(1), jnp.max(d).reshape(1)
+
+    return apply_op("blha_get_max_len", fn,
+                    [seq_lens_encoder, seq_lens_decoder], n_outputs=2)

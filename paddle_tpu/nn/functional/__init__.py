@@ -1564,7 +1564,6 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
     if return_mask:
         raise NotImplementedError(
             "fractional_max_pool2d(return_mask=True) is not supported")
-    
 
     def bounds(n, o, u):
         a = n / o

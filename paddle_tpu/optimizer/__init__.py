@@ -559,14 +559,11 @@ class LBFGS(Optimizer):
             for p in self._parameter_list])
 
     def _flat_grads(self):
-        g = jnp.concatenate([
+        return jnp.concatenate([
             (_unwrap(p.grad).astype(jnp.float32).reshape(-1)
              if p.grad is not None else jnp.zeros(int(np.prod(p.shape)),
                                                   jnp.float32))
             for p in self._parameter_list])
-        if self._weight_decay:
-            g = g + self._weight_decay * self._flat_params()
-        return g
 
     def _write_flat(self, flat):
         off = 0
@@ -595,12 +592,25 @@ class LBFGS(Optimizer):
     def step(self, closure):
         """closure: re-evaluates the model and returns the loss (it must
         call loss.backward() itself, reference lbfgs.py contract)."""
+        wd = self._weight_decay
+
+        def F_of(loss_val, flat):
+            # the line search must probe the REGULARIZED objective the
+            # gradient describes, or wd-steps get accepted/rejected against
+            # the wrong directional derivative
+            f = float(loss_val)
+            if wd:
+                f += 0.5 * wd * float(jnp.vdot(flat, flat))
+            return f
+
         for p in self._parameter_list:
             p.clear_grad()  # a prior step()'s last probe leaves grads behind
         loss = closure()
         for _ in range(self._max_iter):
             flat = self._flat_params()
             g = self._flat_grads()
+            if wd:
+                g = g + wd * flat
             if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
                 break
             if self._prev_flat is not None:
@@ -615,7 +625,7 @@ class LBFGS(Optimizer):
             d = self._direction(g)
             self._prev_flat, self._prev_grad = flat, g
             t = self.get_lr()
-            f0 = float(loss)
+            f0 = F_of(loss, flat)
             gtd = float(jnp.vdot(g, d))
             # backtracking Armijo (the reference's wolfe search reduces to
             # this when the curvature probe succeeds immediately)
@@ -624,7 +634,8 @@ class LBFGS(Optimizer):
                 for p in self._parameter_list:
                     p.clear_grad()
                 loss = closure()
-                if float(loss) <= f0 + 1e-4 * t * gtd or self._line_search is None:
+                if (F_of(loss, flat + t * d) <= f0 + 1e-4 * t * gtd
+                        or self._line_search is None):
                     break
                 t *= 0.5
             if abs(float(jnp.max(jnp.abs(t * d)))) < self._tol_change:

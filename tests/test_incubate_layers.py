@@ -145,3 +145,72 @@ def test_unsupported_variants_are_loud():
     # key is query is fine (reference self-attn calling convention)
     out = layer(q, key=q, value=q)
     assert tuple(out.shape) == (1, 3, 8)
+
+
+def test_incubate_functional_tail_oracles():
+    """fused_matmul_bias / fused_dropout_add / fused_dot_product_attention /
+    fused_gate_attention / blha_get_max_len vs numpy oracles (reference:
+    incubate/nn/functional/{fused_matmul_bias,fused_dropout_add,
+    fused_dot_product_attention,fused_gate_attention,blha_get_max_len}.py)."""
+    import paddle_tpu.incubate.nn.functional as IF
+
+    rs = np.random.RandomState(0)
+    t_ = paddle.to_tensor
+
+    x, y, b = (rs.randn(3, 4).astype(np.float32),
+               rs.randn(4, 5).astype(np.float32),
+               rs.randn(5).astype(np.float32))
+    np.testing.assert_allclose(
+        IF.fused_matmul_bias(t_(x), t_(y), t_(b)).numpy(), x @ y + b,
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        IF.fused_matmul_bias(t_(x.T), t_(y), transpose_x=True).numpy(),
+        x @ y, rtol=1e-5)
+
+    a, c = rs.randn(3, 4).astype(np.float32), rs.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        IF.fused_dropout_add(t_(a), t_(c), p=0.5, training=False).numpy(),
+        a + c, rtol=1e-6)
+    tr = IF.fused_dropout_add(t_(a), t_(c), p=0.5, training=True).numpy()
+    kept = tr != c  # dropped entries equal the residual exactly
+    np.testing.assert_allclose(tr[kept], (a / 0.5 + c)[kept], rtol=1e-5)
+
+    q = rs.randn(2, 5, 2, 4).astype(np.float32)
+    k = rs.randn(2, 5, 2, 4).astype(np.float32)
+    v = rs.randn(2, 5, 2, 4).astype(np.float32)
+    out = IF.fused_dot_product_attention(t_(q), t_(k), t_(v),
+                                         is_causal=True).numpy()
+    lo = np.einsum("bshd,bShd->bhsS", q, k) / 2.0
+    cm = np.tril(np.ones((5, 5), bool))
+    lo = np.where(cm[None, None], lo, -1e30)
+    w = np.exp(lo - lo.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, np.einsum("bhsS,bShd->bshd", w, v),
+                               rtol=1e-4, atol=1e-5)
+
+    n, b_, q_, a_, h, cdim = 1, 2, 3, 8, 2, 4
+    qd = rs.randn(n, b_, q_, a_).astype(np.float32)
+    qkvw = rs.randn(3, h, cdim, a_).astype(np.float32)
+    gw = rs.randn(a_, h, cdim).astype(np.float32)
+    gb = rs.randn(h, cdim).astype(np.float32)
+    ow = rs.randn(h, cdim, a_).astype(np.float32)
+    ob = rs.randn(a_).astype(np.float32)
+    got = IF.fused_gate_attention(
+        t_(qd), qkv_weight=t_(qkvw), gate_linear_weight=t_(gw),
+        gate_linear_bias=t_(gb), out_linear_weight=t_(ow),
+        out_linear_bias=t_(ob)).numpy()
+    qw, kw, vw = (np.moveaxis(qkvw[i], -1, 0) for i in range(3))
+    qq = np.einsum("nbqa,ahc->nbqhc", qd, qw) * (cdim ** -0.5)
+    kk = np.einsum("nbka,ahc->nbkhc", qd, kw)
+    vv = np.einsum("nbka,ahc->nbkhc", qd, vw)
+    lg = np.einsum("nbqhc,nbkhc->nbhqk", qq, kk)
+    wts = np.exp(lg - lg.max(-1, keepdims=True))
+    wts /= wts.sum(-1, keepdims=True)
+    avg = np.einsum("nbhqk,nbkhc->nbqhc", wts, vv)
+    gate = 1 / (1 + np.exp(-(np.einsum("nbqc,chv->nbqhv", qd, gw) + gb)))
+    ref = np.einsum("nbqhc,hco->nbqo", avg * gate, ow) + ob
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    e, d = IF.blha_get_max_len(t_(np.array([3, 7, 2])),
+                               t_(np.array([1, 9, 4])), 3)
+    assert int(e.numpy()[0]) == 7 and int(d.numpy()[0]) == 9
