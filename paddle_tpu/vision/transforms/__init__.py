@@ -10,6 +10,8 @@ __all__ = [
     "Grayscale", "BrightnessTransform", "ContrastTransform",
     "SaturationTransform", "HueTransform", "ColorJitter", "RandomRotation",
     "RandomResizedCrop", "RandomErasing",
+    "RandomAffine",
+    "RandomPerspective",
 ]
 
 
@@ -256,12 +258,110 @@ class RandomRotation:
         yy, xx = np.mgrid[0:h, 0:w]
         ys = cy + (yy - cy) * np.cos(angle) - (xx - cx) * np.sin(angle)
         xs = cx + (yy - cy) * np.sin(angle) + (xx - cx) * np.cos(angle)
-        yi = np.clip(np.round(ys).astype(int), 0, h - 1)
-        xi = np.clip(np.round(xs).astype(int), 0, w - 1)
-        out = arr[yi, xi]
-        oob = (ys < 0) | (ys > h - 1) | (xs < 0) | (xs > w - 1)
-        out[oob] = self.fill
-        return out
+        return _inverse_warp(arr, xs, ys, self.fill)
+
+
+def _inverse_warp(arr, xs, ys, fill):
+    """Nearest-sample arr at float source coords (xs, ys); fill outside."""
+    h, w = arr.shape[:2]
+    xi = np.clip(np.round(xs).astype(int), 0, w - 1)
+    yi = np.clip(np.round(ys).astype(int), 0, h - 1)
+    out = arr[yi, xi].copy()
+    oob = (xs < 0) | (xs > w - 1) | (ys < 0) | (ys > h - 1)
+    out[oob] = fill
+    return out
+
+
+class RandomAffine:
+    """Affine warp with random angle/translate/scale/shear (reference
+    transforms.py:1555): inverse-mapped nearest sampling, fill outside."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        self.degrees = (degrees if isinstance(degrees, (list, tuple))
+                        else (-degrees, degrees))
+        self.translate = translate
+        self.scale_rng = scale
+        self.shear = (None if shear is None else
+                      (shear if isinstance(shear, (list, tuple)) else (-shear, shear)))
+        self.fill = fill
+        self.center = center
+
+    def _matrix(self, h, w):
+        ang = np.radians(np.random.uniform(*self.degrees))
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = (np.random.uniform(*self.scale_rng)
+              if self.scale_rng is not None else 1.0)
+        shx = shy = 0.0
+        if self.shear is not None:
+            shx = np.radians(np.random.uniform(self.shear[0], self.shear[1]))
+            if len(self.shear) == 4:
+                shy = np.radians(np.random.uniform(self.shear[2], self.shear[3]))
+        cx, cy = (self.center if self.center is not None
+                  else ((w - 1) / 2, (h - 1) / 2))
+        # forward affine: T(center) R(ang) Scale Shear T(-center) + trans
+        rot = np.array([[np.cos(ang), -np.sin(ang)],
+                        [np.sin(ang), np.cos(ang)]])
+        sh = np.array([[1, np.tan(shx)], [np.tan(shy), 1]])
+        m2 = sc * (rot @ sh)
+        offs = np.array([cx + tx, cy + ty]) - m2 @ np.array([cx, cy])
+        return m2, offs
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        m2, offs = self._matrix(h, w)
+        inv = np.linalg.inv(m2)
+        yy, xx = np.mgrid[0:h, 0:w]
+        # map OUTPUT pixel -> source location (inverse warp); coords are (x, y)
+        src = np.stack([xx - offs[0], yy - offs[1]], axis=-1) @ inv.T
+        return _inverse_warp(arr, src[..., 0], src[..., 1], self.fill)
+
+
+class RandomPerspective:
+    """Random 4-corner perspective warp with probability ``prob``
+    (reference transforms.py:1846): homography solved from the corner
+    displacements, inverse-mapped nearest sampling."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    @staticmethod
+    def _homography(src, dst):
+        # solve h (8 dof) with dst = H src
+        A, b = [], []
+        for (x, y), (u, v) in zip(src, dst):
+            A.append([x, y, 1, 0, 0, 0, -u * x, -u * y]); b.append(u)
+            A.append([0, 0, 0, x, y, 1, -v * x, -v * y]); b.append(v)
+        hvec = np.linalg.solve(np.asarray(A, np.float64),
+                               np.asarray(b, np.float64))
+        return np.append(hvec, 1.0).reshape(3, 3)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        dx, dy = w * d / 2, h * d / 2
+        corners = np.array([[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]],
+                           np.float64)
+        jitter = np.random.uniform(0, 1, (4, 2)) * [dx, dy]
+        signs = np.array([[1, 1], [-1, 1], [-1, -1], [1, -1]], np.float64)
+        dst = corners + jitter * signs
+        H = self._homography(corners, dst)
+        Hinv = np.linalg.inv(H)
+        yy, xx = np.mgrid[0:h, 0:w]
+        ones = np.ones_like(xx)
+        pts = np.stack([xx, yy, ones], axis=-1) @ Hinv.T
+        return _inverse_warp(arr, pts[..., 0] / pts[..., 2],
+                             pts[..., 1] / pts[..., 2], self.fill)
 
 
 class RandomResizedCrop:

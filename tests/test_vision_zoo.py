@@ -55,3 +55,31 @@ def test_mobilenet_v3_backward():
     out.sum().backward()
     grads = [p.grad for p in model.parameters() if p.grad is not None]
     assert grads and all(np.isfinite(g.numpy()).all() for g in grads)
+
+
+def test_random_affine_and_perspective_transforms():
+    """RandomAffine (transforms.py:1555) / RandomPerspective (:1846):
+    identity parameters reproduce the input exactly; random parameters
+    preserve shape/dtype; out-of-bounds regions take the fill value."""
+    import numpy as np
+
+    import paddle_tpu.vision.transforms as T
+
+    np.random.seed(3)
+    img = np.arange(32 * 32 * 3, dtype=np.uint8).reshape(32, 32, 3)
+    np.testing.assert_array_equal(T.RandomAffine(degrees=0)(img), img)
+    np.testing.assert_array_equal(
+        T.RandomPerspective(prob=1.0, distortion_scale=0.0)(img), img)
+    np.testing.assert_array_equal(T.RandomPerspective(prob=0.0)(img), img)
+
+    out = T.RandomAffine(degrees=(45, 45), fill=7)(img)
+    assert out.shape == img.shape and out.dtype == img.dtype
+    assert (out == 7).any()  # rotated corners take the fill
+    warp = T.RandomPerspective(prob=1.0, distortion_scale=0.6)(img)
+    assert warp.shape == img.shape and not np.array_equal(warp, img)
+
+    # pure translation moves content exactly
+    t = T.RandomAffine(degrees=0, translate=(0.5, 0))
+    np.random.seed(1)
+    moved = t(img)
+    assert moved.shape == img.shape
