@@ -107,3 +107,11 @@ in_dynamic_mode = lambda: True
 grad = autograd.grad
 
 __version__ = "0.1.0"
+
+# top-level namespace tail: constants, places, in-place variants, long-tail
+# functions (reference python/paddle/__init__.py __all__ parity)
+import sys as _sys  # noqa: E402
+
+from . import _compat_tail as _ct  # noqa: E402
+
+_ct._install(_sys.modules[__name__])

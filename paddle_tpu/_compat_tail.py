@@ -1,0 +1,567 @@
+"""Top-level namespace tail: the remaining names from the reference's
+``python/paddle/__init__.py`` ``__all__`` — constants, dtype introspection,
+in-place op variants (functional rebinding like ``reshape_``), place shims,
+and the long tail of small tensor functions.  Kept out of the core modules
+so the main op files stay focused; everything here is a thin composition
+over them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import rng as _rng
+from .core.tensor import Tensor, apply_op, _unwrap
+
+__all__: list[str] = []
+
+
+def _export(obj, name=None):
+    __all__.append(name or obj.__name__)
+    return obj
+
+
+# ---------------- constants (reference: paddle.pi etc.) ----------------
+
+pi = float(np.pi)
+e = float(np.e)
+inf = float("inf")
+nan = float("nan")
+newaxis = None
+__all__ += ["pi", "e", "inf", "nan", "newaxis"]
+
+
+# ---------------- dtype introspection ----------------
+
+@_export
+def iinfo(dtype):
+    return jnp.iinfo(np.dtype(str(dtype)) if not hasattr(dtype, "dtype") else dtype)
+
+
+@_export
+def finfo(dtype):
+    from .core.dtype import convert_dtype
+
+    return jnp.finfo(convert_dtype(dtype) if isinstance(dtype, str) else dtype)
+
+
+# ---------------- places (device identity is PJRT's; these are API shims) ---
+
+class _Place:
+    _kind = "undefined"
+
+    def __init__(self, device_id=0):
+        self._device_id = int(device_id)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._device_id})"
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self._device_id == other._device_id)
+
+    __hash__ = None
+
+
+class CPUPlace(_Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class CUDAPlace(_Place):
+    """Accepted for API compatibility; the accelerator here is the TPU."""
+    _kind = "gpu"
+
+
+class CUDAPinnedPlace(_Place):
+    _kind = "cuda_pinned"
+
+
+class XPUPlace(_Place):
+    _kind = "xpu"
+
+
+__all__ += ["CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "XPUPlace"]
+
+
+# ---------------- small tensor predicates / views ----------------
+
+@_export
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+@_export
+def is_complex(x):
+    return jnp.issubdtype(_unwrap(x).dtype, jnp.complexfloating)
+
+
+@_export
+def is_integer(x):
+    return jnp.issubdtype(_unwrap(x).dtype, jnp.integer)
+
+
+@_export
+def is_floating_point(x):
+    return jnp.issubdtype(_unwrap(x).dtype, jnp.floating)
+
+
+@_export
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_unwrap(x).size == 0))
+
+
+@_export
+def tolist(x):
+    return np.asarray(_unwrap(x)).tolist()
+
+
+@_export
+def rank(x):
+    """Tensor rank (ndim) as a 0-D int32 tensor (reference paddle.rank)."""
+    return Tensor(jnp.asarray(_unwrap(x).ndim, jnp.int32))
+
+
+@_export
+def shape(x):
+    """Runtime shape as an int32 tensor (reference paddle.shape)."""
+    return Tensor(jnp.asarray(_unwrap(x).shape, jnp.int32))
+
+
+@_export
+def view(x, shape_or_dtype, name=None):
+    """reshape/bitcast view (functional copy — no aliasing in XLA)."""
+    from .ops import manipulation as M
+
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return M.reshape(x, shape_or_dtype)
+    from .core.dtype import convert_dtype
+
+    dt = convert_dtype(shape_or_dtype) if isinstance(shape_or_dtype, str) else shape_or_dtype
+
+    def fn(v):
+        # reference view(dtype) SCALES the last dim by the byte ratio
+        # (manipulation.py:7119); jax's bitcast adds/removes a trailing dim
+        bin_, bout = v.dtype.itemsize, np.dtype(dt).itemsize
+        if bout == bin_:
+            return jax.lax.bitcast_convert_type(v, dt)
+        if bout < bin_:
+            r = bin_ // bout
+            out = jax.lax.bitcast_convert_type(v, dt)   # [..., last, r]
+            return out.reshape(v.shape[:-1] + (v.shape[-1] * r,))
+        r = bout // bin_
+        if v.shape[-1] % r:
+            raise ValueError(
+                f"view: last dim {v.shape[-1]} not divisible by the dtype "
+                f"byte ratio {r} ({v.dtype} -> {np.dtype(dt)})")
+        vr = v.reshape(v.shape[:-1] + (v.shape[-1] // r, r))
+        return jax.lax.bitcast_convert_type(vr, dt)
+
+    return apply_op("view", fn, [x])
+
+
+@_export
+def view_as(x, other, name=None):
+    from .ops import manipulation as M
+
+    return M.reshape(x, tuple(_unwrap(other).shape))
+
+
+@_export
+def matrix_transpose(x, name=None):
+    return apply_op("matrix_transpose", lambda v: jnp.swapaxes(v, -1, -2), [x])
+
+
+# ---------------- math tail ----------------
+
+@_export
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (reference tensor/math.py:2099)."""
+    ts = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+
+    def fn(*vs):
+        out = vs[0]
+        for v in vs[1:]:
+            out = out + v
+        return out
+
+    return apply_op("add_n", fn, ts)
+
+
+@_export
+def vecdot(x, y, axis=-1, name=None):
+    return apply_op("vecdot", lambda a, b: jnp.sum(a * b, axis=axis), [x, y])
+
+
+@_export
+def signbit(x, name=None):
+    return apply_op("signbit", jnp.signbit, [x])
+
+
+@_export
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of [n, d] rows (reference pdist)."""
+    def fn(v):
+        n = v.shape[0]
+        iu, ju = jnp.triu_indices(n, k=1)
+        diff = jnp.abs(v[iu] - v[ju])
+        if p == jnp.inf:
+            return diff.max(-1)
+        return (diff ** p).sum(-1) ** (1.0 / p)
+
+    return apply_op("pdist", fn, [x])
+
+
+@_export
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor's elements (host index build)."""
+    import itertools
+
+    n = int(_unwrap(x).shape[0])
+    pool = (itertools.combinations_with_replacement(range(n), r)
+            if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(pool), np.int32).reshape(-1, r)
+    return apply_op("combinations", lambda v: v[jnp.asarray(idx)], [x])
+
+
+@_export
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    def fn(v):
+        lo, hi = (jnp.min(v), jnp.max(v)) if min == 0 and max == 0 else (min, max)
+        lo, hi = jnp.where(lo == hi, lo - 0.5, lo), jnp.where(lo == hi, hi + 0.5, hi)
+        return jnp.linspace(lo, hi, bins + 1)
+
+    return apply_op("histogram_bin_edges", fn, [x])
+
+
+@_export
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write y along a diagonal of x (reference diagonal_scatter)."""
+    def fn(v, u):
+        v2 = jnp.moveaxis(v, (axis1, axis2), (-2, -1))
+        h, w = v2.shape[-2:]
+        if offset >= 0:
+            rows = jnp.arange(min(h, w - offset))
+            cols = rows + offset
+        else:
+            cols = jnp.arange(min(w, h + offset))
+            rows = cols - offset
+        v2 = v2.at[..., rows, cols].set(u)
+        return jnp.moveaxis(v2, (-2, -1), (axis1, axis2))
+
+    return apply_op("diagonal_scatter", fn, [x, y])
+
+
+@_export
+def multigammaln(x, p, name=None):
+    return apply_op("multigammaln",
+                    lambda v: jax.scipy.special.multigammaln(v, p), [x])
+
+
+@_export
+def polygamma(x, n, name=None):
+    return apply_op("polygamma",
+                    lambda v: jax.scipy.special.polygamma(n, v), [x])
+
+
+@_export
+def i0e(x, name=None):
+    return apply_op("i0e", jax.scipy.special.i0e, [x])
+
+
+@_export
+def i1(x, name=None):
+    return apply_op("i1", jax.scipy.special.i1, [x])
+
+
+@_export
+def i1e(x, name=None):
+    return apply_op("i1e", jax.scipy.special.i1e, [x])
+
+
+@_export
+def binomial(count, prob, name=None):
+    def fn(n, p):
+        return jax.random.binomial(_rng.next_key(), n.astype(jnp.float32),
+                                   p).astype(jnp.int64)
+
+    return apply_op("binomial", fn, [count, prob])
+
+
+@_export
+def standard_gamma(x, name=None):
+    return apply_op("standard_gamma",
+                    lambda a: jax.random.gamma(_rng.next_key(), a), [x])
+
+
+@_export
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Re-offset indices into a shard's local range, others -> ignore_value
+    (reference tensor/manipulation.py:688; the PS embedding-shard helper)."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for nshards {nshards}")
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def fn(v):
+        lo = shard_id * shard_size
+        inside = (v >= lo) & (v < lo + shard_size)
+        return jnp.where(inside, v - lo, ignore_value)
+
+    return apply_op("shard_index", fn, [input])
+
+
+# ---------------- misc framework shims ----------------
+
+@_export
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from . import Parameter
+    from .core.dtype import convert_dtype
+    from .nn import initializer as I
+
+    init = default_initializer or (I.Constant(0.0) if is_bias
+                                   else I.XavierNormal())
+    arr = np.zeros(tuple(int(s) for s in shape), convert_dtype(dtype))
+    p = Parameter(arr)
+    init(p)
+    return p
+
+
+@_export
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+@_export
+def disable_signal_handler():
+    """The reference unhooks its C++ signal handlers; no-op here (no C++
+    signal handlers are installed by this framework)."""
+
+
+@_export
+def get_cuda_rng_state():
+    """Accelerator RNG state (the framework Generator's state here)."""
+    return [_rng.get_rng_state()]
+
+
+@_export
+def set_cuda_rng_state(state):
+    _rng.set_rng_state(state[0] if isinstance(state, (list, tuple)) else state)
+
+
+@_export
+@contextlib.contextmanager
+def LazyGuard():
+    """Reference LazyGuard defers parameter materialization; parameters here
+    are cheap host arrays until device_put, so eager init under the guard is
+    behaviorally equivalent (documented shim)."""
+    yield
+
+
+@_export
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Estimate forward FLOPs by running a traced forward and counting
+    dot/conv FLOPs from the jaxpr (reference hapi/dynamic_flops.py:40 hooks
+    Layer forwards; counting the compiled program is the TPU-native
+    equivalent and covers the same matmul/conv terms)."""
+    x = jnp.zeros(tuple(int(s) for s in input_size), jnp.float32)
+
+    def fwd(v):
+        out = net(Tensor(v))
+        return _unwrap(out)
+
+    jaxpr = jax.make_jaxpr(fwd)(x)
+    total = 0
+
+    def count(jx):
+        nonlocal total
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "dot_general":
+                (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+                lhs = eqn.invars[0].aval.shape
+                rhs = eqn.invars[1].aval.shape
+                out = eqn.outvars[0].aval.shape
+                k = int(np.prod([lhs[i] for i in lc])) if lc else 1
+                total += 2 * int(np.prod(out)) * k
+            elif eqn.primitive.name == "conv_general_dilated":
+                out = eqn.outvars[0].aval.shape
+                rhs = eqn.invars[1].aval.shape
+                total += 2 * int(np.prod(out)) * int(np.prod(rhs[1:]))
+            for v in eqn.params.values():
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None:
+                    count(inner)
+    count(jaxpr.jaxpr)
+    if print_detail:
+        print(f"Total FLOPs: {total}")
+    return total
+
+
+class pstring:
+    """String element-type marker (reference phi pstring; see
+    paddle_tpu.strings.StringTensor for the actual container)."""
+
+
+class raw:
+    """Opaque/raw element-type marker (reference DataType::RAW)."""
+
+
+def check_shape(shape, op_name,
+                expected_shape_type=(list, tuple, Tensor),
+                expected_element_type=(int, Tensor),
+                expected_tensor_dtype=("int32", "int64")):
+    """Shape-argument validator (reference base/data_feeder.py:230)."""
+    if not isinstance(shape, expected_shape_type):
+        raise TypeError(f"{op_name}: shape must be one of "
+                        f"{expected_shape_type}, got {type(shape)}")
+    if isinstance(shape, (list, tuple)):
+        for el in shape:
+            if not isinstance(el, expected_element_type):
+                raise TypeError(f"{op_name}: shape element {el!r} must be "
+                                f"one of {expected_element_type}")
+
+
+# ---------------- in-place variants (functional rebinding) ----------------
+
+def _rebind(x, out):
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def _make_inplace(base, name):
+    def fn_(x, *args, **kw):
+        out = base(x._snapshot() if isinstance(x, Tensor) else x, *args, **kw)
+        return _rebind(x, out)
+
+    fn_.__name__ = name
+    fn_.__doc__ = f"In-place variant of ``{base.__name__}`` (functional rebinding)."
+    return fn_
+
+
+def where_(condition, x, y, name=None):
+    """In-place where: the result lands in ``x`` (reference search.py:860),
+    NOT in the condition."""
+    from .ops import manipulation as _m2
+
+    out = _m2.where(condition, x._snapshot() if isinstance(x, Tensor) else x, y)
+    return _rebind(x, out)
+
+
+__all__.append("where_")
+
+
+# random in-place initializers draw from the framework Generator
+def _make_random_inplace(name, draw):
+    def fn_(x, *args, **kw):
+        v = _unwrap(x)
+        x._value = draw(v, *args, **kw).astype(v.dtype)
+        # the fresh random draw is independent of the old compute graph —
+        # sever the stale autograd node or backward would flow through it
+        x._node, x._out_idx = None, 0
+        return x
+
+    fn_.__name__ = name
+    return fn_
+
+
+normal_ = _make_random_inplace(
+    "normal_", lambda v, mean=0.0, std=1.0: mean + std * jax.random.normal(
+        _rng.next_key(), v.shape))
+log_normal_ = _make_random_inplace(
+    "log_normal_", lambda v, mean=1.0, std=2.0: jnp.exp(
+        mean + std * jax.random.normal(_rng.next_key(), v.shape)))
+bernoulli_ = _make_random_inplace(
+    "bernoulli_", lambda v, p=0.5: jax.random.bernoulli(
+        _rng.next_key(), p, v.shape))
+cauchy_ = _make_random_inplace(
+    "cauchy_", lambda v, loc=0.0, scale=1.0: loc + scale * jax.random.cauchy(
+        _rng.next_key(), v.shape))
+geometric_ = _make_random_inplace(
+    "geometric_", lambda v, probs=0.5: jax.random.geometric(
+        _rng.next_key(), probs, v.shape).astype(jnp.float32))
+__all__ += ["normal_", "log_normal_", "bernoulli_", "cauchy_", "geometric_"]
+
+
+def _install(ns):
+    """Install the in-place tail + aliases into the paddle namespace and
+    Tensor methods.  Called once from paddle_tpu/__init__ after all op
+    modules are loaded."""
+    # aliases
+    alias_map = {
+        "less": "less_than",
+        "bitwise_invert": "bitwise_not",
+    }
+    for new, old in alias_map.items():
+        if not hasattr(ns, new) and hasattr(ns, old):
+            setattr(ns, new, getattr(ns, old))
+            __all__.append(new)
+
+    inplace_bases = [
+        "bitwise_left_shift", "bitwise_right_shift",
+        "addmm", "t", "cumsum", "cumprod", "logit", "equal", "cos",
+        "tan", "unsqueeze", "logical_and", "less_than", "less", "squeeze",
+        "floor_divide", "remainder", "floor_mod", "logical_or",
+        "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+        "bitwise_invert", "triu", "sin", "mod", "abs", "tril", "pow",
+        "acos", "expm1", "sinh", "sinc", "neg", "lgamma", "gammaincc",
+        "gammainc", "square", "divide", "gammaln", "atan", "gcd", "lcm",
+        "cast", "greater_equal", "erf", "greater_than", "tanh", "transpose",
+        "multiply", "logical_not", "scatter", "log", "log2", "log10",
+        "trunc", "frac", "digamma", "renorm", "multigammaln", "nan_to_num",
+        "ldexp", "i0", "polygamma", "copysign", "masked_fill",
+        "masked_scatter", "hypot", "less_equal", "flatten",
+    ]
+    # this module's functions land on the namespace FIRST so their in-place
+    # variants (multigammaln_, polygamma_, ...) can be synthesized below
+    for nm in __all__:
+        if not hasattr(ns, nm):
+            setattr(ns, nm, globals()[nm])
+    # re-exports living in submodules
+    from .nn.layer_base import ParamAttr
+    from .distributed import DataParallel
+    from .utils.dlpack import from_dlpack, to_dlpack
+    for nm, obj in (("ParamAttr", ParamAttr), ("DataParallel", DataParallel),
+                    ("from_dlpack", from_dlpack), ("to_dlpack", to_dlpack),
+                    ("dtype", jnp.dtype), ("pstring", pstring), ("raw", raw),
+                    ("check_shape", check_shape)):
+        if not hasattr(ns, nm):
+            setattr(ns, nm, obj)
+    made = []
+    for base_name in dict.fromkeys(inplace_bases):
+        nm = base_name + "_"
+        if hasattr(ns, nm) or not hasattr(ns, base_name):
+            continue
+        fn_ = _make_inplace(getattr(ns, base_name), nm)
+        setattr(ns, nm, fn_)
+        if not hasattr(Tensor, nm):
+            setattr(Tensor, nm, fn_)
+        made.append(nm)
+    for nm in ("normal_", "log_normal_", "bernoulli_", "cauchy_",
+               "geometric_", "tolist", "view", "view_as"):
+        if not hasattr(Tensor, nm):
+            setattr(Tensor, nm, globals().get(nm) or getattr(ns, nm))
+    return made

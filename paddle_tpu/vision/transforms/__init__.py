@@ -305,7 +305,10 @@ class RandomAffine:
         # forward affine: T(center) R(ang) Scale Shear T(-center) + trans
         rot = np.array([[np.cos(ang), -np.sin(ang)],
                         [np.sin(ang), np.cos(ang)]])
-        sh = np.array([[1, np.tan(shx)], [np.tan(shy), 1]])
+        # two unit-determinant triangular shears (reference
+        # functional.py:598 composition) — never singular
+        sh = (np.array([[1, np.tan(shx)], [0, 1]])
+              @ np.array([[1, 0], [np.tan(shy), 1]]))
         m2 = sc * (rot @ sh)
         offs = np.array([cx + tx, cy + ty]) - m2 @ np.array([cx, cy])
         return m2, offs
