@@ -183,6 +183,13 @@ def _train_rungs(on_tpu: bool):
     cfg_xl = llama.LlamaConfig(
         vocab_size=32000, hidden_size=2048, intermediate_size=5632,
         num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8)
+    # ~0.7B: same width, 12 layers — the largest xl-class config whose
+    # fixed state (bf16 params + f32 AdamW m/v/master ~ 9.8GB) leaves real
+    # activation headroom on a 16GB v5e; the L=16 rungs above it are free
+    # attempts that may OOM (the ladder keeps going)
+    cfg_xl12 = llama.LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=12, num_attention_heads=16, num_key_value_heads=8)
     return [
         # (name, cfg, batch, seq, warmup, steps[, remat])
         ("tiny", llama.LlamaConfig.tiny(), 2, 128, 1, 3),
@@ -201,6 +208,7 @@ def _train_rungs(on_tpu: bool):
         # 512 positions at a time inside a remat'd scan (0.5GB peak)
         ("xl_cx", cfg_xl, 8, 2048, 2, 10, "full", 512),
         ("xl_b4_cx", cfg_xl, 4, 2048, 2, 10, "full", 512),
+        ("xl_l12_cx", cfg_xl12, 8, 2048, 2, 10, "dots", 512),
         # SAME 460M config, selective recompute (save matmul outputs): fewer
         # recomputed MXU FLOPs if HBM allows.  Last so an OOM here cannot
         # abort earlier rungs (ladder breaks on first failure).
