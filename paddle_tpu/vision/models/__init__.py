@@ -1,6 +1,6 @@
 """Vision models (reference: python/paddle/vision/models/ — the full zoo:
-lenet, resnet, vgg, mobilenet v1/v2/v3, alexnet, squeezenet, densenet,
-shufflenetv2, googlenet, inceptionv3)."""
+lenet, resnet/resnext/wide-resnet, vgg, mobilenet v1/v2/v3, alexnet,
+squeezenet, densenet, shufflenetv2, googlenet, inceptionv3)."""
 
 from .extra import (  # noqa: F401
     AlexNet,
@@ -9,7 +9,18 @@ from .extra import (  # noqa: F401
     SqueezeNet,
     alexnet,
     densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+    densenet264,
+    shufflenet_v2_swish,
+    shufflenet_v2_x0_5,
+    shufflenet_v2_x0_25,
+    shufflenet_v2_x0_33,
     shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0,
+    squeezenet1_0,
     squeezenet1_1,
 )
 from .googlenet_inception import (  # noqa: F401
@@ -17,6 +28,8 @@ from .googlenet_inception import (  # noqa: F401
     InceptionV3,
     MobileNetV1,
     MobileNetV3,
+    MobileNetV3Large,
+    MobileNetV3Small,
     googlenet,
     inception_v3,
     mobilenet_v1,
@@ -32,6 +45,13 @@ from .resnet import (  # noqa: F401
     resnet50,
     resnet101,
     resnet152,
+    resnext50_32x4d,
+    resnext50_64x4d,
+    resnext101_32x4d,
+    resnext101_64x4d,
+    resnext152_32x4d,
+    resnext152_64x4d,
     wide_resnet50_2,
+    wide_resnet101_2,
 )
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401

@@ -51,20 +51,36 @@ class _Fire(nn.Layer):
 
 
 class SqueezeNet(nn.Layer):
-    """Reference: vision/models/squeezenet.py (v1.1)."""
+    """Reference: vision/models/squeezenet.py (v1.0: 96-ch 7x7 stem with
+    late pools, reference squeezenet.py:150-167; v1.1: 64-ch 3x3 stem)."""
 
     def __init__(self, version="1.1", num_classes=1000):
         super().__init__()
-        self.features = nn.Sequential(
-            nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
-            nn.MaxPool2D(3, stride=2),
-            _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
-            nn.MaxPool2D(3, stride=2),
-            _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
-            nn.MaxPool2D(3, stride=2),
-            _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
-            _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
-        )
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(512, 64, 256, 256),
+            )
+        elif version == "1.1":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            )
+        else:
+            raise ValueError(f"supported versions are ['1.0', '1.1'] but input version is {version}")
         self.classifier = nn.Sequential(
             nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
             nn.AdaptiveAvgPool2D((1, 1)),
@@ -72,6 +88,10 @@ class SqueezeNet(nn.Layer):
 
     def forward(self, x):
         return flatten(self.classifier(self.features(x)), 1)
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    return SqueezeNet("1.0", **kw)
 
 
 def squeezenet1_1(pretrained=False, **kw):
@@ -111,7 +131,8 @@ class DenseNet(nn.Layer):
     def __init__(self, layers=121, growth_rate=32, bn_size=4, num_classes=1000):
         super().__init__()
         cfg = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
-               169: (6, 12, 32, 32), 201: (6, 12, 48, 32)}[layers]
+               169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+               264: (6, 12, 64, 48)}[layers]
         if layers == 161:
             growth_rate, init_c = 48, 96
         else:
@@ -144,8 +165,24 @@ def densenet121(pretrained=False, **kw):
     return DenseNet(121, **kw)
 
 
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(264, **kw)
+
+
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, in_c, out_c, stride):
+    def __init__(self, in_c, out_c, stride, act=nn.ReLU):
         super().__init__()
         self.stride = stride
         branch_c = out_c // 2
@@ -154,19 +191,19 @@ class _ShuffleUnit(nn.Layer):
                 nn.Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c, bias_attr=False),
                 nn.BatchNorm2D(in_c),
                 nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_c), nn.ReLU())
+                nn.BatchNorm2D(branch_c), act())
             b2_in = in_c
         else:
             self.branch1 = None
             b2_in = in_c // 2
         self.branch2 = nn.Sequential(
             nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_c), nn.ReLU(),
+            nn.BatchNorm2D(branch_c), act(),
             nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
                       groups=branch_c, bias_attr=False),
             nn.BatchNorm2D(branch_c),
             nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_c), nn.ReLU())
+            nn.BatchNorm2D(branch_c), act())
 
     def forward(self, x):
         if self.stride == 1:
@@ -182,33 +219,61 @@ class _ShuffleUnit(nn.Layer):
 
 
 class ShuffleNetV2(nn.Layer):
-    """Reference: vision/models/shufflenetv2.py (x1.0)."""
+    """Reference: vision/models/shufflenetv2.py (stage channel table at
+    shufflenetv2.py:282-291; `act` relu/swish per `create_activation_layer`)."""
 
-    def __init__(self, scale=1.0, num_classes=1000):
+    def __init__(self, scale=1.0, num_classes=1000, act="relu"):
         super().__init__()
-        stage_c = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
-                   1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048)}[scale]
+        # (stem, stage1, stage2, stage3, head) channels per scale
+        stage_c = {0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+                   0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+                   1.5: (24, 176, 352, 704, 1024), 2.0: (24, 224, 488, 976, 2048)}[scale]
+        Act = {"relu": nn.ReLU, "swish": nn.Swish}[act]
         self.stem = nn.Sequential(
-            nn.Conv2D(3, 24, 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(24), nn.ReLU(), nn.MaxPool2D(3, stride=2, padding=1))
-        c = 24
+            nn.Conv2D(3, stage_c[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(stage_c[0]), Act(), nn.MaxPool2D(3, stride=2, padding=1))
+        c = stage_c[0]
         stages = []
-        for out_c, repeats in zip(stage_c[:3], (4, 8, 4)):
-            stages.append(_ShuffleUnit(c, out_c, 2))
+        for out_c, repeats in zip(stage_c[1:4], (4, 8, 4)):
+            stages.append(_ShuffleUnit(c, out_c, 2, Act))
             for _ in range(repeats - 1):
-                stages.append(_ShuffleUnit(out_c, out_c, 1))
+                stages.append(_ShuffleUnit(out_c, out_c, 1, Act))
             c = out_c
         self.stages = nn.Sequential(*stages)
         self.head = nn.Sequential(
-            nn.Conv2D(c, stage_c[3], 1, bias_attr=False),
-            nn.BatchNorm2D(stage_c[3]), nn.ReLU())
+            nn.Conv2D(c, stage_c[4], 1, bias_attr=False),
+            nn.BatchNorm2D(stage_c[4]), Act())
         self.pool = nn.AdaptiveAvgPool2D((1, 1))
-        self.fc = nn.Linear(stage_c[3], num_classes)
+        self.fc = nn.Linear(stage_c[4], num_classes)
 
     def forward(self, x):
         x = self.pool(self.head(self.stages(self.stem(x))))
         return self.fc(flatten(x, 1))
 
 
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
 def shufflenet_v2_x1_0(pretrained=False, **kw):
     return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, act="swish", **kw)

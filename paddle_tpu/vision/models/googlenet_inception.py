@@ -348,9 +348,25 @@ class MobileNetV3(nn.Layer):
         return x
 
 
+class MobileNetV3Small(MobileNetV3):
+    """reference: mobilenetv3.py `MobileNetV3Small`."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 1024, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    """reference: mobilenetv3.py `MobileNetV3Large`."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 1280, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV3(_V3_SMALL, 1024, scale=scale, **kwargs)
+    return MobileNetV3Small(scale=scale, **kwargs)
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV3(_V3_LARGE, 1280, scale=scale, **kwargs)
+    return MobileNetV3Large(scale=scale, **kwargs)

@@ -22,8 +22,14 @@ def _img(n=1, size=64):
     (lambda: M.mobilenet_v3_small(scale=0.5, num_classes=10), 64),
     (lambda: M.mobilenet_v3_large(scale=0.35, num_classes=10), 64),
     (lambda: M.alexnet(num_classes=10), 96),
+    (lambda: M.squeezenet1_0(num_classes=10), 96),
     (lambda: M.squeezenet1_1(num_classes=10), 64),
-    (lambda: M.shufflenet_v2_x1_0(num_classes=10), 64),
+    (lambda: M.shufflenet_v2_x0_25(num_classes=10), 64),
+    (lambda: M.shufflenet_v2_x0_33(num_classes=10), 64),
+    (lambda: M.shufflenet_v2_swish(num_classes=10), 64),
+    (lambda: M.densenet169(num_classes=10), 64),
+    (lambda: M.resnext50_32x4d(num_classes=10), 64),
+    (lambda: M.wide_resnet101_2(num_classes=10), 64),
 ])
 def test_zoo_forward_shapes(ctor, size):
     model = ctor()
