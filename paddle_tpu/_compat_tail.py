@@ -332,10 +332,9 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
 
     init = default_initializer or (I.Constant(0.0) if is_bias
                                    else I.XavierNormal())
-    arr = np.zeros(tuple(int(s) for s in shape), convert_dtype(dtype))
-    p = Parameter(arr)
-    init(p)
-    return p
+    np_dtype = convert_dtype(dtype)
+    arr = init(tuple(int(s) for s in shape), np_dtype)
+    return Parameter(np.asarray(arr, np_dtype))
 
 
 @_export

@@ -7,8 +7,9 @@ from . import initializer as I
 from .layer_base import Layer
 
 __all__ = [
-    "ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax", "LogSoftmax",
-    "LeakyReLU", "ELU", "CELU", "SELU", "SiLU", "Swish", "Mish", "GLU",
+    "ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax", "Softmax2D",
+    "LogSoftmax", "LeakyReLU", "ELU", "CELU", "SELU", "SiLU", "Silu",
+    "Swish", "Mish", "GLU",
     "Hardswish", "Hardsigmoid", "Hardtanh", "Hardshrink", "Softshrink",
     "Softplus", "Softsign", "Tanhshrink", "ThresholdedReLU", "LogSigmoid",
     "Maxout", "PReLU", "RReLU",
@@ -103,6 +104,21 @@ class SELU(Layer):
 class SiLU(Layer):
     def forward(self, x):
         return F.silu(x)
+
+
+Silu = SiLU  # reference activation.py exports the `Silu` spelling
+
+
+class Softmax2D(Layer):
+    """activation.py Softmax2D: softmax over the channel axis of NCHW / CHW
+    inputs (each spatial location's channel vector sums to 1)."""
+
+    def forward(self, x):
+        ndim = len(x.shape)
+        if ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects a 3D or 4D input, got {ndim}D")
+        return F.softmax(x, axis=-3)
 
 
 class Swish(Layer):

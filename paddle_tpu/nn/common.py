@@ -306,3 +306,56 @@ class Unfold(Layer):
     def forward(self, x):
         return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
                         self.dilations)
+
+
+class ZeroPad2D(Layer):
+    """common.py ZeroPad2D over F.zeropad2d."""
+
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self.padding, self.data_format = padding, data_format
+
+    def forward(self, x):
+        return F.zeropad2d(x, self.padding, self.data_format)
+
+
+class _ZeroPadNd(Layer):
+    """Shared zero-pad forward over F.pad (one padding entry point)."""
+
+    _n = 2
+
+    def __init__(self, padding, data_format=None, name=None):
+        super().__init__()
+        self.padding = ([padding] * (2 * self._n) if isinstance(padding, int)
+                        else list(padding))
+        self.data_format = data_format or self._fmt
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode="constant", value=0.0,
+                     data_format=self.data_format)
+
+
+class ZeroPad1D(_ZeroPadNd):
+    """common.py ZeroPad1D: zero-pad the last axis by [left, right]."""
+
+    _n, _fmt = 1, "NCL"
+
+
+class ZeroPad3D(_ZeroPadNd):
+    """common.py ZeroPad3D: zero-pad D/H/W by [l, r, t, b, f, bk]."""
+
+    _n, _fmt = 3, "NCDHW"
+
+
+class FeatureAlphaDropout(Layer):
+    """common.py FeatureAlphaDropout over F.feature_alpha_dropout."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, training=self.training)
+
+
+__all__ += ["ZeroPad1D", "ZeroPad2D", "ZeroPad3D", "FeatureAlphaDropout"]

@@ -1,0 +1,193 @@
+"""Seq2seq decoding API (reference: python/paddle/nn/decode.py —
+Decoder:50, BeamSearchDecoder:161, dynamic_decode:1279).
+
+TPU-native design: beams are merged into the batch dimension
+([batch*beam, ...]) so every step is one batched cell call; the decode loop
+runs eagerly (each step is a jitted dispatch) mirroring the reference's
+imperative path, and the final back-trace is the in-graph
+``F.gather_tree`` scan."""
+
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, _unwrap
+from . import functional as F
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+_INF = 1e9
+
+
+class Decoder:
+    """decode.py:50 — interface: initialize / step / finalize."""
+
+    tracks_own_finished = False
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+
+BeamSearchDecoderOutput = collections.namedtuple(
+    "BeamSearchDecoderOutput", ["scores", "predicted_ids", "parent_ids"])
+BeamSearchState = collections.namedtuple(
+    "BeamSearchState", ["cell_states", "log_probs", "finished", "lengths"])
+
+
+def _map_state(fn, state):
+    if isinstance(state, (tuple, list)):
+        return type(state)(_map_state(fn, s) for s in state)
+    return fn(_unwrap(state))
+
+
+def _zip_state(fn, a, b):
+    if isinstance(a, (tuple, list)):
+        return type(a)(_zip_state(fn, x, y) for x, y in zip(a, b))
+    return fn(_unwrap(a), _unwrap(b))
+
+
+class BeamSearchDecoder(Decoder):
+    """decode.py:161 — beam search over an RNNCellBase-like cell."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] with each row repeated."""
+        v = _unwrap(x)
+        v = jnp.repeat(v, beam_size, axis=0)
+        return Tensor(v) if isinstance(x, Tensor) else v
+
+    def _merge(self, v):
+        # [batch, beam, ...] -> [batch*beam, ...]
+        return v.reshape((-1,) + v.shape[2:])
+
+    def _split(self, v):
+        return v.reshape((-1, self.beam_size) + v.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        cell_states = _map_state(
+            lambda v: jnp.repeat(v, self.beam_size, axis=0),
+            initial_cell_states)
+        some = cell_states
+        while isinstance(some, (tuple, list)):
+            some = some[0]
+        batch = some.shape[0] // self.beam_size
+        log_probs = jnp.tile(
+            jnp.array([0.0] + [-_INF] * (self.beam_size - 1), jnp.float32),
+            (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        lengths = jnp.zeros((batch, self.beam_size), jnp.int32)
+        init_ids = jnp.full((batch, self.beam_size), self.start_token,
+                            jnp.int32)
+        inputs = self._embed(init_ids)
+        return inputs, BeamSearchState(cell_states, log_probs, finished,
+                                       lengths), finished
+
+    def _embed(self, ids):
+        # ids: [batch, beam] -> merged [batch*beam(, emb)] so the cell always
+        # sees the same leading dim as its (merged) states
+        if self.embedding_fn is None:
+            return self._merge(ids)
+        out = self.embedding_fn(Tensor(self._merge(ids)))
+        return _unwrap(out)
+
+    def step(self, time, inputs, states, **kwargs):
+        beam = self.beam_size
+        cell_inputs = inputs if isinstance(inputs, Tensor) else Tensor(inputs)
+        cell_state_t = _map_state(Tensor, states.cell_states)
+        cell_out, next_cell_states = self.cell(cell_inputs, cell_state_t,
+                                               **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = _unwrap(cell_out)                      # [batch*beam, vocab]
+        vocab = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        step_lp = self._split(step_lp)                  # [batch, beam, vocab]
+        # finished beams may only emit end_token, at no extra cost
+        fin = states.finished[:, :, None]
+        noend = jnp.full((vocab,), -_INF, jnp.float32).at[self.end_token].set(0.0)
+        step_lp = jnp.where(fin, noend[None, None, :], step_lp)
+        total = states.log_probs[:, :, None] + step_lp  # [batch, beam, vocab]
+        flat = total.reshape(total.shape[0], -1)
+        scores, flat_idx = jax.lax.top_k(flat, beam)    # [batch, beam]
+        parents = (flat_idx // vocab).astype(jnp.int32)
+        tokens = (flat_idx % vocab).astype(jnp.int32)
+        batch_idx = jnp.arange(flat.shape[0])[:, None]
+        next_finished = states.finished[batch_idx, parents] | \
+            (tokens == self.end_token)
+        next_lengths = states.lengths[batch_idx, parents] + \
+            (~states.finished[batch_idx, parents]).astype(jnp.int32)
+        gather = lambda v: self._merge(
+            self._split(v)[batch_idx, parents])
+        next_cells = _map_state(gather, next_cell_states)
+        next_inputs = self._embed(tokens)
+        outputs = BeamSearchDecoderOutput(scores, tokens, parents)
+        next_states = BeamSearchState(next_cells, scores, next_finished,
+                                      next_lengths)
+        return outputs, next_states, next_inputs, next_finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        predicted = F.gather_tree(Tensor(outputs.predicted_ids),
+                                  Tensor(outputs.parent_ids))
+        return BeamSearchDecoderOutput(
+            Tensor(outputs.scores), predicted, Tensor(outputs.parent_ids))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """decode.py:1279 — step the decoder until every beam finishes or
+    ``max_step_num`` is hit, then stack the per-step outputs over time and
+    hand them to ``decoder.finalize``."""
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    time = 0
+    while True:
+        outputs, next_states, inputs, finished = decoder.step(
+            time, inputs, states, **kwargs)
+        step_outputs.append(outputs)
+        if impute_finished and not decoder.tracks_own_finished:
+            # rows already finished BEFORE this step keep their old cell
+            # state, so final_states is exact at each row's own end step
+            prev_fin = states.finished.reshape(-1)
+
+            def _carry(old, new):
+                m = prev_fin.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(m, old, new)
+
+            next_states = next_states._replace(cell_states=_zip_state(
+                _carry, states.cell_states, next_states.cell_states))
+        states = next_states
+        time += 1
+        if bool(jnp.all(finished)):
+            break
+        if max_step_num is not None and time > int(max_step_num):
+            break
+    stacked = type(step_outputs[0])(*(
+        jnp.stack([getattr(o, f) for o in step_outputs])
+        for f in step_outputs[0]._fields))
+    lengths = states.lengths
+    final = decoder.finalize(stacked, states, lengths)
+    if not output_time_major:
+        final = type(final)(*(
+            Tensor(jnp.swapaxes(_unwrap(f), 0, 1)) for f in final))
+    if return_length:
+        return final, Tensor(lengths)
+    return final

@@ -290,22 +290,28 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return dropout(x, p, axis=[0, ch_axis], training=training)
 
 
-@_export
-def alpha_dropout(x, p=0.5, training=True, name=None):
+def _alpha_dropout(x, p, training, mask_shape_of, op_name):
+    """SELU-preserving dropout core (Klambauer et al.): dropped positions take
+    alpha' = -alpha*scale, then an affine (a, b) correction restores zero mean
+    and unit variance.  ``mask_shape_of`` maps the value shape to the
+    bernoulli mask shape (full shape = per-element, [:2]+(1,...) = per-channel)."""
     if not training or p == 0.0:
-        return x
+        return x if isinstance(x, Tensor) else Tensor(_unwrap(x))
     key = rng.next_key()
-    alpha = 1.6732632423543772
-    scale = 1.0507009873554805
-    alpha_p = -alpha * scale
+    alpha_p = -1.6732632423543772 * 1.0507009873554805
 
     def fn(v):
-        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape_of(v.shape))
         a = (1.0 / _math.sqrt((1 - p) * (1 + p * alpha_p**2))) if p < 1 else 1.0
         b = -a * alpha_p * p
         return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
 
-    return apply_op("alpha_dropout", fn, [x])
+    return apply_op(op_name, fn, [x])
+
+
+@_export
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    return _alpha_dropout(x, p, training, lambda s: s, "alpha_dropout")
 
 
 # ============================ convolution ============================
@@ -443,21 +449,47 @@ def _pool(x, ksize, stride, padding, ndim, data_format, reducer, init, name, cou
     pd = _pair(padding, ndim)
 
     def fn(v):
-        if data_format[1] == "C":
+        ch_first = data_format[1] == "C"
+        sp_axes = range(2, 2 + ndim) if ch_first else range(1, 1 + ndim)
+        # ceil_mode: extend the high side so partial windows emit outputs,
+        # with the reference's rule that a window must start inside
+        # input+padding (pooling.py ceil-mode contract)
+        extra = [0] * ndim
+        if ceil_mode:
+            for i, ax in enumerate(sp_axes):
+                n = v.shape[ax] + 2 * pd[i]
+                o = -(-(n - ks[i]) // st[i]) + 1
+                if (o - 1) * st[i] >= v.shape[ax] + pd[i]:
+                    o -= 1
+                extra[i] = max(0, (o - 1) * st[i] + ks[i] - n)
+        if ch_first:
             window = (1, 1) + ks
             strides = (1, 1) + st
-            pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+            pads = ((0, 0), (0, 0)) + tuple(
+                (p, p + e) for p, e in zip(pd, extra))
         else:
             window = (1,) + ks + (1,)
             strides = (1,) + st + (1,)
-            pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
+            pads = ((0, 0),) + tuple(
+                (p, p + e) for p, e in zip(pd, extra)) + ((0, 0),)
         if reducer == "max":
             neg = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
             return jax.lax.reduce_window(v, neg, jax.lax.max, window, strides, pads)
         s = jax.lax.reduce_window(v.astype(jnp.float32), 0.0, jax.lax.add, window, strides, pads)
         if count_include_pad:
-            denom = float(np.prod(ks))
-            return (s / denom).astype(v.dtype)
+            if not any(extra):
+                return (s / float(np.prod(ks))).astype(v.dtype)
+            # symmetric padding counts toward the divisor, the ceil-mode
+            # extension does not: count over ones that cover input+padding
+            sym = [(0, 0)] * v.ndim
+            for i, ax in enumerate(sp_axes):
+                sym[ax] = (pd[i], pd[i])
+            ones = jnp.pad(jnp.ones_like(v, jnp.float32), sym,
+                           constant_values=1.0)
+            zpads = tuple((0, pads[d][1] - sym[d][1]) for d in range(v.ndim))
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides, zpads)
+            return (s / cnt).astype(v.dtype)
         ones = jnp.ones_like(v, jnp.float32)
         cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
         return (s / cnt).astype(v.dtype)
@@ -467,32 +499,41 @@ def _pool(x, ksize, stride, padding, ndim, data_format, reducer, init, name, cou
 
 @_export
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
-    return _pool(x, kernel_size, stride, padding, 1, "NCW", "max", None, "max_pool1d")
+    if return_mask:
+        return _max_pool_mask(x, kernel_size, stride, padding, 1,
+                              "max_pool1d", ceil_mode)
+    return _pool(x, kernel_size, stride, padding, 1, "NCW", "max", None, "max_pool1d", ceil_mode=ceil_mode)
 
 
 @_export
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 2, data_format, "max", None, "max_pool2d")
+    if return_mask:
+        return _max_pool_mask(x, kernel_size, stride, padding, 2,
+                              "max_pool2d", ceil_mode, data_format)
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "max", None, "max_pool2d", ceil_mode=ceil_mode)
 
 
 @_export
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 3, data_format, "max", None, "max_pool3d")
+    if return_mask:
+        return _max_pool_mask(x, kernel_size, stride, padding, 3,
+                              "max_pool3d", ceil_mode, data_format)
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "max", None, "max_pool3d", ceil_mode=ceil_mode)
 
 
 @_export
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
-    return _pool(x, kernel_size, stride, padding, 1, "NCW", "avg", None, "avg_pool1d", count_include_pad=not exclusive)
+    return _pool(x, kernel_size, stride, padding, 1, "NCW", "avg", None, "avg_pool1d", count_include_pad=not exclusive, ceil_mode=ceil_mode)
 
 
 @_export
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", None, "avg_pool2d", count_include_pad=not exclusive)
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", None, "avg_pool2d", count_include_pad=not exclusive, ceil_mode=ceil_mode)
 
 
 @_export
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", None, "avg_pool3d", count_include_pad=not exclusive)
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", None, "avg_pool3d", count_include_pad=not exclusive, ceil_mode=ceil_mode)
 
 
 def _adaptive_pool(x, output_size, ndim, data_format, mode, name):
@@ -1463,13 +1504,16 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
         hi = [pd[0], pd[1]]
         if ceil_mode:
             # extra high-side padding so partial windows produce outputs
-            # (zero-padded |x|^p contributes nothing to the sum)
+            # (zero-padded x^p contributes nothing to the sum)
             for d in (0, 1):
                 n = v.shape[2 + d] + 2 * pd[d]
                 out_ceil = -(-(n - ks[d]) // st[d]) + 1
                 hi[d] = pd[d] + max(0, (out_ceil - 1) * st[d] + ks[d] - n)
+        # plain powf like the reference kernel (pooling.h:84): XLA pow has
+        # C powf semantics, so odd norm types keep sign and net-negative
+        # windows go NaN at the 1/p root exactly as the reference does
         s = jax.lax.reduce_window(
-            jnp.abs(v) ** p, 0.0, jax.lax.add, (1, 1) + ks, (1, 1) + st,
+            v ** p, 0.0, jax.lax.add, (1, 1) + ks, (1, 1) + st,
             [(0, 0), (0, 0), (pd[0], hi[0]), (pd[1], hi[1])])
         out = s ** (1.0 / p)
         if data_format != "NCHW":
@@ -1559,51 +1603,10 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
     windows [ceil(a*(i+u)-1), ceil(a*(i+1+u)-1)) with a = n/out, which
     tile the input exactly (pooling.py:2108 example reproduced in tests).
     With kernel_size set, fixed windows start at the same pseudo-random
-    positions (overlapping mode).  Deterministic given ``random_u``."""
-    out_hw = _pair(output_size)
-    if return_mask:
-        raise NotImplementedError(
-            "fractional_max_pool2d(return_mask=True) is not supported")
-
-    def bounds(n, o, u):
-        a = n / o
-        i = np.arange(o, dtype=np.float64)
-        start = np.ceil(a * (i + u) - 1).astype(np.int64)
-        end = np.ceil(a * (i + 1 + u) - 1).astype(np.int64)
-        return np.clip(start, 0, n - 1), np.clip(end, 1, n)
-
-    def fn(v):
-        n, c, h, w = v.shape
-        if out_hw[0] > h or out_hw[1] > w:
-            raise ValueError(
-                f"fractional_max_pool2d: output_size {out_hw} exceeds input "
-                f"spatial size {(h, w)} (fractional pooling downsamples)")
-        u = (float(random_u) if random_u is not None
-             else float(jax.random.uniform(rng.next_key(), ())))
-        if kernel_size is None:
-            rs_, re_ = bounds(h, out_hw[0], u)
-            cs_, ce_ = bounds(w, out_hw[1], u)
-        else:
-            kh_, kw_ = _pair(kernel_size)
-            rs_, _ = bounds(h, out_hw[0], u)
-            cs_, _ = bounds(w, out_hw[1], u)
-            rs_ = np.clip(rs_, 0, h - kh_)
-            cs_ = np.clip(cs_, 0, w - kw_)
-            re_, ce_ = rs_ + kh_, cs_ + kw_
-        kh = int((re_ - rs_).max())
-        kw = int((ce_ - cs_).max())
-        rows = np.minimum(rs_[:, None] + np.arange(kh)[None, :], h - 1)
-        cols = np.minimum(cs_[:, None] + np.arange(kw)[None, :], w - 1)
-        rmask = np.arange(kh)[None, :] < (re_ - rs_)[:, None]   # [oh, kh]
-        cmask = np.arange(kw)[None, :] < (ce_ - cs_)[:, None]   # [ow, kw]
-        patches = v[:, :, rows][:, :, :, :, cols]  # [n,c,oh,kh,ow,kw]
-        mask = (rmask[:, :, None, None] & cmask[None, None, :, :])
-        fill = (jnp.iinfo(v.dtype).min if jnp.issubdtype(v.dtype, jnp.integer)
-                else jnp.asarray(-jnp.inf, v.dtype))  # dtype-preserving
-        patches = jnp.where(mask[None, None], patches, fill)
-        return patches.max(axis=(3, 5))
-
-    return apply_op("fractional_max_pool2d", fn, [x])
+    positions (overlapping mode).  Deterministic given ``random_u``;
+    return_mask yields flat-spatial argmax indices like max_pool2d."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 2, "fractional_max_pool2d")
 
 
 @_export
@@ -1656,3 +1659,394 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
     if return_softmax:
         return loss, sm
     return loss
+
+
+# ==================== pooling-with-indices / unpooling ====================
+
+def _windowed_argmax(v, pos, valid):
+    """Shared core for every pool-with-indices variant: gather variable
+    windows described by per-dim ``pos``/``valid`` [out_i, k_i] tables from an
+    NC* tensor and return (window max, flat-spatial argmax indices).
+
+    Mirrors the reference max_pool*(return_mask=True) semantics
+    (pooling.py:750+): indices address the flattened *input* spatial volume
+    per (n, c) plane; invalid (padding) positions are -inf so they are never
+    selected."""
+    ndim = len(pos)
+    S = v.shape[2:]
+    out_sizes = [p.shape[0] for p in pos]
+    ks = [p.shape[1] for p in pos]
+    out = v
+    for i in range(ndim):
+        ax = 2 + 2 * i  # spatial dim i, after earlier dims became (o, k) pairs
+        out = jnp.take(out, jnp.asarray(pos[i].reshape(-1)), axis=ax)
+        out = out.reshape(out.shape[:ax] + (out_sizes[i], ks[i]) + out.shape[ax + 1:])
+    mask = None
+    for i, vd in enumerate(valid):
+        shape = [1] * (2 * ndim)
+        shape[2 * i], shape[2 * i + 1] = vd.shape
+        mm = jnp.asarray(vd).reshape(shape)
+        mask = mm if mask is None else (mask & mm)
+    neg = (jnp.iinfo(v.dtype).min if jnp.issubdtype(v.dtype, jnp.integer)
+           else jnp.asarray(-jnp.inf, v.dtype))
+    patches = jnp.where(mask[None, None], out, neg)
+    perm = ([0, 1] + [2 + 2 * i for i in range(ndim)]
+            + [3 + 2 * i for i in range(ndim)])
+    patches = jnp.transpose(patches, perm)
+    flat = patches.reshape(patches.shape[:2 + ndim] + (-1,))
+    arg = jnp.argmax(flat, axis=-1)            # [n, c, *out] in k-space
+    vals = jnp.max(flat, axis=-1)
+    # k-space argmax -> global input coords -> row-major flat spatial index
+    rem, flat_idx = arg, 0
+    for i in range(ndim):
+        stride_k = int(np.prod(ks[i + 1:])) if i + 1 < ndim else 1
+        ki = rem // stride_k
+        rem = rem % stride_k
+        o_idx = jnp.arange(out_sizes[i]).reshape(
+            [1] * (2 + i) + [out_sizes[i]] + [1] * (ndim - 1 - i))
+        coord = jnp.asarray(pos[i])[o_idx, ki]
+        flat_idx = flat_idx + coord * (int(np.prod(S[i + 1:])) if i + 1 < ndim else 1)
+    return vals, flat_idx.astype(jnp.int32)
+
+
+def _max_pool_mask(x, kernel_size, stride, padding, ndim, op_name,
+                   ceil_mode=False, data_format=None):
+    if data_format is not None and data_format[-1] == "C":
+        raise ValueError(
+            f"{op_name}: return_mask=True only supports channels-first "
+            f"data_format, got {data_format} (matches the reference, "
+            "pooling.py:1215)")
+    ks = _pair(kernel_size, ndim)
+    st = _pair(stride if stride is not None else kernel_size, ndim)
+    pd = _pair(padding, ndim)
+
+    def fn(v):
+        S = v.shape[2:]
+        pos, valid = [], []
+        for i in range(ndim):
+            n = S[i] + 2 * pd[i]
+            if ceil_mode:
+                o = -(-(n - ks[i]) // st[i]) + 1
+                # ceil-mode windows must start inside input+padding
+                if (o - 1) * st[i] >= S[i] + pd[i]:
+                    o -= 1
+            else:
+                o = (n - ks[i]) // st[i] + 1
+            p = (np.arange(o)[:, None] * st[i] - pd[i]
+                 + np.arange(ks[i])[None, :])
+            valid.append((p >= 0) & (p < S[i]))
+            pos.append(np.clip(p, 0, S[i] - 1))
+        return _windowed_argmax(v, pos, valid)
+
+    return apply_op(op_name, fn, [x], n_outputs=2)
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, ndim, output_size,
+                op_name, data_format=None):
+    """Scatter pooled values back to argmax positions (reference
+    pooling.py:750/873/1005 max_unpool1d/2d/3d)."""
+    if data_format is not None and data_format[-1] == "C":
+        raise ValueError(
+            f"{op_name}: only channels-first data_format is supported, "
+            f"got {data_format} (matches the reference, pooling.py:750+)")
+    ks = _pair(kernel_size, ndim)
+    st = _pair(stride if stride is not None else kernel_size, ndim)
+    pd = _pair(padding, ndim)
+    in_sp = tuple(int(s) for s in x.shape[2:])
+    if output_size is None:
+        out_sp = tuple((in_sp[i] - 1) * st[i] - 2 * pd[i] + ks[i]
+                       for i in range(ndim))
+    else:
+        out_sp = tuple(int(s) for s in tuple(output_size)[-ndim:])
+
+    def fn(v, idx):
+        n, c = v.shape[:2]
+        flat_v = v.reshape(n, c, -1)
+        flat_i = idx.reshape(n, c, -1).astype(jnp.int32)
+        res = jnp.zeros((n, c, int(np.prod(out_sp))), v.dtype)
+        bi = jnp.arange(n)[:, None, None]
+        ci = jnp.arange(c)[None, :, None]
+        res = res.at[bi, ci, flat_i].set(flat_v, mode="drop")
+        return res.reshape((n, c) + out_sp)
+
+    return apply_op(op_name, fn, [x, indices])
+
+
+@_export
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 1,
+                       output_size, "max_unpool1d", data_format)
+
+
+@_export
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 2,
+                       output_size, "max_unpool2d", data_format)
+
+
+@_export
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 3,
+                       output_size, "max_unpool3d", data_format)
+
+
+@_export
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """pooling.py:2403 lp_pool1d: p-norm pooling over the length axis."""
+    k = _pair(kernel_size, 1)[0]
+    s = _pair(stride, 1)[0] if stride is not None else k
+    p0 = _pair(padding, 1)[0]
+
+    def fn(v):
+        if data_format == "NLC":
+            v = jnp.transpose(v, (0, 2, 1))
+        p = float(norm_type)
+        hi = p0
+        if ceil_mode:
+            n = v.shape[2] + 2 * p0
+            out_ceil = -(-(n - k) // s) + 1
+            hi = p0 + max(0, (out_ceil - 1) * s + k - n)
+        # plain powf like the reference kernel (pooling.h:84) — see lp_pool2d
+        acc = jax.lax.reduce_window(
+            v.astype(jnp.float32) ** p, 0.0, jax.lax.add,
+            (1, 1, k), (1, 1, s), [(0, 0), (0, 0), (p0, hi)])
+        out = (acc ** (1.0 / p)).astype(v.dtype)
+        if data_format == "NLC":
+            out = jnp.transpose(out, (0, 2, 1))
+        return out
+
+    return apply_op("lp_pool1d", fn, [x])
+
+
+def _fractional_pool_tables(sp, out_sz, kernel_size, random_u, ndim, op_name):
+    """Per-dim pos/valid [out, kmax] window tables for fractional pooling
+    (Graham, arXiv:1412.6071).  Default (kernel_size=None) is the reference's
+    DISJOINT mode: variable windows [ceil(a*(i+u)-1), ceil(a*(i+1+u)-1)) with
+    a = n/out, which tile the input exactly; with kernel_size set, fixed
+    windows start at the same pseudo-random positions."""
+    for d in range(ndim):
+        if out_sz[d] > sp[d]:
+            raise ValueError(
+                f"{op_name}: output_size {tuple(out_sz)} exceeds input "
+                f"spatial size {tuple(sp)} (fractional pooling downsamples)")
+    u = (float(random_u) if random_u is not None
+         else float(jax.random.uniform(rng.next_key(), ())))
+    ksz = _pair(kernel_size, ndim) if kernel_size is not None else None
+
+    def bounds(n, o):
+        a = n / o
+        i = np.arange(o, dtype=np.float64)
+        start = np.ceil(a * (i + u) - 1).astype(np.int64)
+        end = np.ceil(a * (i + 1 + u) - 1).astype(np.int64)
+        return np.clip(start, 0, n - 1), np.clip(end, 1, n)
+
+    pos, valid = [], []
+    for d in range(ndim):
+        s_, e_ = bounds(sp[d], out_sz[d])
+        if ksz is not None:
+            s_ = np.clip(s_, 0, sp[d] - ksz[d])
+            e_ = s_ + ksz[d]
+        kmax = int((e_ - s_).max())
+        pos.append(np.minimum(s_[:, None] + np.arange(kmax)[None, :],
+                              sp[d] - 1))
+        valid.append(np.arange(kmax)[None, :] < (e_ - s_)[:, None])
+    return pos, valid
+
+
+def _fractional_max_pool(x, output_size, kernel_size, random_u, return_mask,
+                         ndim, op_name):
+    out_sz = _pair(output_size, ndim)
+
+    def fn(v):
+        pos, valid = _fractional_pool_tables(
+            v.shape[2:], out_sz, kernel_size, random_u, ndim, op_name)
+        vals, idx = _windowed_argmax(v, pos, valid)
+        return (vals, idx) if return_mask else vals
+
+    return apply_op(op_name, fn, [x], n_outputs=2 if return_mask else 1)
+
+
+@_export
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """pooling.py fractional_max_pool3d — the 2d scheme over (D, H, W);
+    return_mask yields flat-spatial argmax indices like max_pool3d."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 3, "fractional_max_pool3d")
+
+
+# ==================== padding / dropout tail ====================
+
+@_export
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """common.py:2068 — zero-pad H/W by [left, right, top, bottom]; thin
+    wrapper over the shared constant-pad path (ops/manipulation.py pad)."""
+    if isinstance(padding, int):
+        padding = [padding] * 4
+    return pad(x, list(padding), mode="constant", value=0.0,
+               data_format=data_format)
+
+
+@_export
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """common.py:1646 — alpha dropout zeroing whole channel maps (the
+    SELU-preserving variant of dropout2d/3d)."""
+    return _alpha_dropout(x, p, training,
+                          lambda s: s[:2] + (1,) * (len(s) - 2),
+                          "feature_alpha_dropout")
+
+
+# ==================== hierarchical sigmoid ====================
+
+@_export
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """loss.py:926 hierarchical sigmoid loss.
+
+    Default tree follows the reference's SimpleCode
+    (phi/kernels/funcs/matrix_bit_code.h:100): class c encodes as
+    c + num_classes in a 1-rooted heap; classifier index at bit b is
+    (code >> (b+1)) - 1 and the target bit is (code >> b) & 1.  Custom
+    trees pass explicit path_table / path_code (negative entries pad).
+    """
+    use_custom = path_table is not None and path_code is not None
+    if not use_custom and (num_classes is None or num_classes < 2):
+        raise ValueError("hsigmoid_loss: num_classes must be >= 2 for the "
+                         "default tree")
+    inputs = [input, label, weight] + ([bias] if bias is not None else []) \
+        + ([path_table, path_code] if use_custom else [])
+
+    def fn(xv, yv, wv, *rest):
+        rest = list(rest)
+        bv = rest.pop(0) if bias is not None else None
+        if use_custom:
+            tbl, code = rest
+            tbl = tbl.astype(jnp.int32)
+            bits = code.astype(jnp.int32)
+            valid = tbl >= 0
+            idx = jnp.where(valid, tbl, 0)
+        else:
+            y = yv.reshape(-1).astype(jnp.int32) + jnp.int32(num_classes)
+            L = int(2 * num_classes - 1).bit_length() - 1  # max path length
+            b_r = jnp.arange(L, dtype=jnp.int32)[None, :]
+            length = jnp.floor(
+                jnp.log2(y.astype(jnp.float32))).astype(jnp.int32)[:, None]
+            valid = b_r < length
+            idx = jnp.where(valid, (y[:, None] >> (b_r + 1)) - 1, 0)
+            bits = (y[:, None] >> b_r) & 1
+        logits = jnp.take(wv, idx, axis=0) @ xv[..., None]  # [N, L, 1]
+        logits = logits[..., 0]
+        if bv is not None:
+            logits = logits + jnp.take(bv.reshape(-1), idx)
+        # BCE-with-logits, summed over the path
+        per_bit = jax.nn.softplus(logits) - bits.astype(logits.dtype) * logits
+        loss = jnp.where(valid, per_bit, 0.0).sum(-1, keepdims=True)
+        return loss.astype(xv.dtype)
+
+    return apply_op("hsigmoid_loss", fn, inputs)
+
+
+# ==================== in-place activation aliases ====================
+# JAX arrays are immutable; the reference's x.relu_() contract is "result
+# lands in x and is returned".  Functional rebinding (the tensor in-place
+# machinery in _compat_tail) preserves that contract under the tape; the
+# _snapshot() call breaks the would-be tape self-cycle so gradients still
+# flow to upstream producers (see Tensor._snapshot).
+
+def _make_act_inplace(base):
+    def fn_(x, *args, **kw):
+        from ..._compat_tail import _make_inplace
+
+        return _make_inplace(base, fn_.__name__)(x, *args, **kw)
+
+    fn_.__name__ = base.__name__ + "_"
+    fn_.__doc__ = f"In-place variant of ``{base.__name__}``."
+    __all__.append(fn_.__name__)
+    return fn_
+
+
+elu_ = _make_act_inplace(elu)
+hardtanh_ = _make_act_inplace(hardtanh)
+leaky_relu_ = _make_act_inplace(leaky_relu)
+relu_ = _make_act_inplace(relu)
+softmax_ = _make_act_inplace(softmax)
+tanh_ = _make_act_inplace(tanh)
+thresholded_relu_ = _make_act_inplace(thresholded_relu)
+
+
+@_export
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """loss.py:4458 adaptive softmax (Grave et al.).  The reference gathers
+    per-cluster row subsets with nonzero(); here every cluster projection is
+    computed masked over the full batch — identical math, static shapes
+    (XLA-friendly; tail clusters are small by construction)."""
+    cutoffs = [int(c) for c in cutoffs]
+    n_classes = cutoffs[-1]
+    shortlist = cutoffs[0]
+    n_clusters = len(cutoffs) - 1
+    tail_flat = [w for pair in tail_weights for w in pair]
+    inputs = ([input, label, head_weight]
+              + ([head_bias] if head_bias is not None else []) + tail_flat)
+
+    lab = _unwrap(label)
+    if not isinstance(lab, jax.core.Tracer):  # concrete labels only: the
+        lab_np = np.asarray(lab)              # check cannot raise under jit
+        if lab_np.size and (lab_np.min() < 0 or lab_np.max() >= n_classes):
+            raise ValueError(
+                f"label values should be in [0, {n_classes - 1}], but values "
+                f"in range [{lab_np.min()}, {lab_np.max()}] were found. ")
+
+    def fn(xv, yv, hw, *rest):
+        rest = list(rest)
+        hb = rest.pop(0) if head_bias is not None else None
+        pairs = [(rest[2 * i], rest[2 * i + 1]) for i in range(n_clusters)]
+        squeeze = yv.ndim == 0
+        if squeeze:
+            xv, yv = xv[None], yv[None]
+        y = yv.astype(jnp.int32)
+        head = xv @ hw + (hb if hb is not None else 0.0)
+        head_lp = jax.nn.log_softmax(head, axis=1)
+        gather = jnp.where(y < shortlist, y, 0)
+        out = jnp.zeros(y.shape, xv.dtype)
+        for i in range(n_clusters):
+            low, high = cutoffs[i], cutoffs[i + 1]
+            mask = (y >= low) & (y < high)
+            rel = jnp.clip(y - low, 0, high - low - 1)
+            h = (xv @ pairs[i][0]) @ pairs[i][1]
+            clp = jax.nn.log_softmax(h, axis=1)
+            local = jnp.take_along_axis(clp, rel[:, None], axis=1)[:, 0]
+            out = out + jnp.where(mask, local, 0.0)
+            gather = jnp.where(mask, shortlist + i, gather)
+        out = out + jnp.take_along_axis(head_lp, gather[:, None], axis=1)[:, 0]
+        loss = -out.mean()
+        if squeeze:
+            out = out[0]
+        return out, loss
+
+    return apply_op("adaptive_log_softmax_with_loss", fn, inputs, n_outputs=2)
+
+
+@_export
+def gather_tree(ids, parents):
+    """extension.py:149 gather_tree: back-trace beam-search parent pointers
+    so every [time, batch, beam] column holds a full candidate sequence."""
+    def fn(idv, par):
+        k = idv.shape[2]
+        init = jnp.tile(jnp.arange(k, dtype=par.dtype)[None, :],
+                        (idv.shape[1], 1))
+
+        def step(beams, x):
+            step_ids, step_par = x
+            out = jnp.take_along_axis(step_ids, beams, axis=1)
+            return jnp.take_along_axis(step_par, beams, axis=1), out
+
+        _, outs = jax.lax.scan(step, init,
+                               (jnp.flip(idv, 0), jnp.flip(par, 0)))
+        return jnp.flip(outs, 0)
+
+    return apply_op("gather_tree", fn, [ids, parents])

@@ -246,3 +246,124 @@ class RNNTLoss(Layer):
                            blank=self.blank,
                            fastemit_lambda=self.fastemit_lambda,
                            reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """loss.py:457 hierarchical sigmoid loss layer over F.hsigmoid_loss.
+
+    Owns weight [num_classes-1, feature_size] and bias [num_classes-1, 1]
+    exactly like the reference; ``is_custom`` switches to caller-supplied
+    path_table/path_code trees."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError("num_classes must not be less than 2 "
+                             "with default tree")
+        self.feature_size = feature_size
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        self.is_sparse = is_sparse
+        from . import initializer as I
+
+        std = 1.0 / (num_classes ** 0.5)
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (num_classes - 1, 1), attr=bias_attr, is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self.is_custom and (path_table is None or path_code is None):
+            raise ValueError("path_table and path_code are required "
+                             "when is_custom is True")
+        return F.hsigmoid_loss(
+            input, label, self.num_classes, self.weight, self.bias,
+            path_table=path_table if self.is_custom else None,
+            path_code=path_code if self.is_custom else None,
+            is_sparse=self.is_sparse)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """loss.py:2393 adaptive softmax layer (Grave et al. 2017).
+
+    head: [in_features, shortlist + n_clusters]; cluster i projects through
+    [in_features, in_features/div_value^(i+1)] @ [hsz, cutoff-span] low-rank
+    pairs.  forward returns (per-sample logprob, mean loss)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if (cutoffs != sorted(cutoffs) or min(cutoffs) <= 0
+                or max(cutoffs) > n_classes - 1
+                or len(set(cutoffs)) != len(cutoffs)
+                or any(int(c) != c for c in cutoffs)):
+            raise ValueError(
+                "cutoffs should be a sequence of unique, positive integers "
+                "sorted in an increasing order, where each value is between "
+                "1 and n_classes-1")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = [*[int(c) for c in cutoffs], n_classes]
+        self.div_value = div_value
+        self.shortlist_size = self.cutoffs[0]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.shortlist_size + self.n_clusters
+        self.head_weight = self.create_parameter(
+            (in_features, self.head_size), attr=weight_attr)
+        self.head_bias = (self.create_parameter(
+            (self.head_size,), attr=bias_attr, is_bias=True)
+            if head_bias else None)
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = int(in_features // (div_value ** (i + 1)))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w0 = self.create_parameter((in_features, hsz), attr=weight_attr)
+            w1 = self.create_parameter((hsz, osz), attr=weight_attr)
+            self.add_parameter(f"tail_w{i}_0", w0)
+            self.add_parameter(f"tail_w{i}_1", w1)
+            self.tail_weights.append([w0, w1])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, self.head_bias)
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probability table."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import apply_op
+
+        n_clusters = self.n_clusters
+        cutoffs = self.cutoffs
+        shortlist = self.shortlist_size
+        tail_flat = [w for pair in self.tail_weights for w in pair]
+        inputs = ([input, self.head_weight]
+                  + ([self.head_bias] if self.head_bias is not None else [])
+                  + tail_flat)
+
+        def fn(xv, hw, *rest):
+            rest = list(rest)
+            hb = rest.pop(0) if self.head_bias is not None else None
+            head = xv @ hw + (hb if hb is not None else 0.0)
+            head_lp = jax.nn.log_softmax(head, axis=1)
+            pieces = [head_lp[:, :shortlist]]
+            for i in range(n_clusters):
+                h = (xv @ rest[2 * i]) @ rest[2 * i + 1]
+                clp = jax.nn.log_softmax(h, axis=1)
+                pieces.append(clp + head_lp[:, shortlist + i][:, None])
+            return jnp.concatenate(pieces, axis=1)
+
+        return apply_op("adaptive_log_prob", fn, inputs)
+
+    def predict(self, input):
+        from ..ops.manipulation import argmax
+
+        return argmax(self.log_prob(input), axis=-1)
+
+
+__all__ += ["HSigmoidLoss", "AdaptiveLogSoftmaxWithLoss"]

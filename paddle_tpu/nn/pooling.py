@@ -10,48 +10,101 @@ __all__ = [
     "AvgPool1D", "AvgPool2D", "AvgPool3D",
     "AdaptiveAvgPool1D", "AdaptiveAvgPool2D", "AdaptiveAvgPool3D",
     "AdaptiveMaxPool1D", "AdaptiveMaxPool2D", "AdaptiveMaxPool3D",
-    "LPPool2D", "FractionalMaxPool2D",
+    "LPPool1D", "LPPool2D", "FractionalMaxPool2D", "FractionalMaxPool3D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
 ]
 
 
 class _Pool(Layer):
-    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False, **kw):
+    """Positional argument orders match the reference exactly
+    (layer/pooling.py): MaxPool* take (..., return_mask, ceil_mode),
+    AvgPool1D (..., exclusive, ceil_mode), AvgPool2D/3D
+    (..., ceil_mode, exclusive, divisor_override)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, exclusive=True, **kw):
         super().__init__()
         self.kernel_size = kernel_size
         self.stride = stride if stride is not None else kernel_size
         self.padding = padding
         self.ceil_mode = ceil_mode
+        self.return_mask = return_mask
+        self.exclusive = exclusive
         self.kw = kw
 
 
 class MaxPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode,
+                         return_mask=return_mask)
+
     def forward(self, x):
-        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask,
+                            ceil_mode=self.ceil_mode)
 
 
 class MaxPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode,
+                         return_mask=return_mask)
+
     def forward(self, x):
-        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask,
+                            ceil_mode=self.ceil_mode)
 
 
 class MaxPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCDHW", name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode,
+                         return_mask=return_mask)
+
     def forward(self, x):
-        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask,
+                            ceil_mode=self.ceil_mode)
 
 
 class AvgPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode,
+                         exclusive=exclusive)
+
     def forward(self, x):
-        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            exclusive=self.exclusive,
+                            ceil_mode=self.ceil_mode)
 
 
 class AvgPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCHW",
+                 name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode,
+                         exclusive=exclusive)
+
     def forward(self, x):
-        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            exclusive=self.exclusive,
+                            ceil_mode=self.ceil_mode)
 
 
 class AvgPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode,
+                         exclusive=exclusive)
+
     def forward(self, x):
-        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            exclusive=self.exclusive,
+                            ceil_mode=self.ceil_mode)
 
 
 class _AdaptivePool(Layer):
@@ -117,3 +170,63 @@ class FractionalMaxPool2D(Layer):
     def forward(self, x):
         return F.fractional_max_pool2d(x, self.output_size, self.kernel_size,
                                        self.random_u, self.return_mask)
+
+
+class LPPool1D(Layer):
+    """layer/pooling.py LPPool1D over F.lp_pool1d."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.norm_type, self.kernel_size = norm_type, kernel_size
+        self.stride, self.padding = stride, padding
+        self.ceil_mode, self.data_format = ceil_mode, data_format
+
+    def forward(self, x):
+        return F.lp_pool1d(x, self.norm_type, self.kernel_size, self.stride,
+                           self.padding, self.ceil_mode, self.data_format)
+
+
+class FractionalMaxPool3D(Layer):
+    """layer/pooling.py FractionalMaxPool3D over F.fractional_max_pool3d."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size, self.kernel_size = output_size, kernel_size
+        self.random_u, self.return_mask = random_u, return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)
+
+
+class _MaxUnPool(Layer):
+    _fn = None
+    _fmt = "NCHW"
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format=None,
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format = data_format or self._fmt
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return type(self)._fn(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format, self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPool):
+    _fn = staticmethod(F.max_unpool1d)
+    _fmt = "NCL"
+
+
+class MaxUnPool2D(_MaxUnPool):
+    _fn = staticmethod(F.max_unpool2d)
+    _fmt = "NCHW"
+
+
+class MaxUnPool3D(_MaxUnPool):
+    _fn = staticmethod(F.max_unpool3d)
+    _fmt = "NCDHW"

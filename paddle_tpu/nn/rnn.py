@@ -212,13 +212,45 @@ class SimpleRNN(_RNNBase):
 SimpleRNN.GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
 
 
-class LSTMCell(Layer):
+class RNNCellBase(Layer):
+    """rnn.py:591 RNNCellBase — base for cells usable with RNN/BiRNN and the
+    decoding API; provides zero-filled initial states shaped per batch.
+    ``state_shape`` is a (possibly nested) tuple of per-state trailing shapes;
+    cells with tuple states (LSTM) override it and receive matching nested
+    initial states."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ..core.dtype import convert_dtype
+
+        batch = batch_ref.shape[batch_dim_idx]
+        spec = shape if shape is not None else self.state_shape
+        jdtype = jnp.float32 if dtype is None else convert_dtype(dtype)
+
+        def build(s):
+            if isinstance(s, (tuple, list)) and s and isinstance(s[0], (tuple, list)):
+                return tuple(build(sub) for sub in s)
+            return Tensor(jnp.full((batch,) + tuple(int(d) for d in s),
+                                   init_value, jdtype))
+
+        return build(spec)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
     def __init__(self, input_size, hidden_size, weight_attr=None, bias_attr=None, name=None):
         super().__init__()
         self.hidden_size = hidden_size
         self.wi, self.wh, self.bi, self.bh = None, None, None, None
         ws = _rnn_params(self, input_size, hidden_size, 4, "cell", weight_attr, bias_attr)
         self._ws = ws
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
 
     def forward(self, inputs, states=None):
         wi, wh, bi, bh = (
@@ -239,7 +271,7 @@ class LSTMCell(Layer):
         return h2, (h2, c2)
 
 
-class GRUCell(Layer):
+class GRUCell(RNNCellBase):
     def __init__(self, input_size, hidden_size, weight_attr=None, bias_attr=None, name=None):
         super().__init__()
         self.hidden_size = hidden_size
@@ -262,7 +294,7 @@ class GRUCell(Layer):
         return h2, h2
 
 
-class SimpleRNNCell(Layer):
+class SimpleRNNCell(RNNCellBase):
     def __init__(self, input_size, hidden_size, activation="tanh", weight_attr=None, bias_attr=None, name=None):
         super().__init__()
         self.hidden_size = hidden_size
@@ -311,3 +343,27 @@ class RNN(Layer):
             outs = outs[::-1]
         out = M.stack(outs, axis=seq_axis)
         return out, states
+
+
+class BiRNN(Layer):
+    """rnn.py BiRNN: run a forward cell and a backward cell over the sequence
+    and concatenate the outputs feature-wise."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw, self.cell_bw = cell_fw, cell_bw
+        self.time_major = time_major
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, fin_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, fin_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        from ..ops.manipulation import concat
+
+        return concat([out_fw, out_bw], axis=-1), (fin_fw, fin_bw)
+
+
+__all__ += ["RNNCellBase", "BiRNN"]

@@ -1,0 +1,346 @@
+"""nn / nn.functional tail parity (reference: python/paddle/nn/layer/
+pooling.py max-unpool family, loss.py HSigmoidLoss:457 +
+AdaptiveLogSoftmaxWithLoss:2393, decode.py BeamSearchDecoder:161,
+functional/pooling.py lp_pool1d:2403, common.py zeropad2d:2068)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rs = np.random.RandomState(7)
+
+
+@pytest.mark.parametrize("ndim,shape,k,s,pad", [
+    (1, (2, 4, 12), 3, 2, 1),
+    (2, (2, 3, 8, 10), 2, 2, 0),
+    (2, (1, 2, 9, 7), (3, 2), (2, 1), 1),
+    (3, (1, 2, 6, 6, 6), 2, 2, 0),
+])
+def test_max_pool_indices_match_torch(ndim, shape, k, s, pad):
+    x = rs.randn(*shape).astype(np.float32)
+    poolf = [F.max_pool1d, F.max_pool2d, F.max_pool3d][ndim - 1]
+    tpool = [TF.max_pool1d, TF.max_pool2d, TF.max_pool3d][ndim - 1]
+    out, idx = poolf(paddle.to_tensor(x), k, stride=s, padding=pad,
+                     return_mask=True)
+    tout, tidx = tpool(torch.tensor(x), k, s, pad, return_indices=True)
+    np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(idx.numpy(), tidx.numpy())
+
+
+def test_max_unpool_round_trip_and_grad():
+    x = rs.randn(2, 3, 8, 10).astype(np.float32)
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    out, idx = F.max_pool2d(xt, 2, stride=2, return_mask=True)
+    un = F.max_unpool2d(out, idx, 2, stride=2)
+    tout, tidx = TF.max_pool2d(torch.tensor(x), 2, 2, return_indices=True)
+    tun = TF.max_unpool2d(tout, tidx, 2, 2)
+    np.testing.assert_allclose(un.numpy(), tun.numpy(), rtol=1e-6)
+    un.sum().backward()
+    assert np.isfinite(xt.grad.numpy()).all()
+    # layer wrappers
+    o2, i2 = nn.MaxPool2D(2, return_mask=True)(paddle.to_tensor(x))
+    u2 = nn.MaxUnPool2D(2)(o2, i2)
+    np.testing.assert_allclose(u2.numpy(), tun.numpy(), rtol=1e-6)
+
+
+def test_max_pool_mask_ceil_mode_and_format_guard():
+    x = rs.randn(1, 2, 7, 7).astype(np.float32)
+    out, idx = F.max_pool2d(paddle.to_tensor(x), 3, stride=2, return_mask=True,
+                            ceil_mode=True)
+    tout, tidx = TF.max_pool2d(torch.tensor(x), 3, 2, return_indices=True,
+                               ceil_mode=True)
+    np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(idx.numpy(), tidx.numpy())
+    with pytest.raises(ValueError):
+        F.max_pool2d(paddle.to_tensor(x), 2, return_mask=True,
+                     data_format="NHWC")
+
+
+def test_pool_ceil_mode_without_mask():
+    """ceil_mode must change output shape on the plain reduce_window path
+    too, not only under return_mask (review finding)."""
+    x = rs.randn(1, 2, 7, 7).astype(np.float32)
+    o = F.max_pool2d(paddle.to_tensor(x), 3, stride=2, ceil_mode=True)
+    t = TF.max_pool2d(torch.tensor(x), 3, 2, ceil_mode=True)
+    np.testing.assert_allclose(o.numpy(), t.numpy(), rtol=1e-6)
+    o = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2, ceil_mode=True)
+    t = TF.avg_pool2d(torch.tensor(x), 3, 2, ceil_mode=True)
+    np.testing.assert_allclose(o.numpy(), t.numpy(), rtol=1e-5)
+    # layer plumbs it through as well
+    o = nn.MaxPool2D(3, stride=2, ceil_mode=True)(paddle.to_tensor(x))
+    assert tuple(o.shape) == tuple(t.shape)
+    # with padding + exclusive=False (count includes symmetric padding)
+    o = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1,
+                     ceil_mode=True, exclusive=False)
+    t = TF.avg_pool2d(torch.tensor(x), 3, 2, 1, ceil_mode=True,
+                      count_include_pad=True)
+    np.testing.assert_allclose(o.numpy(), t.numpy(), rtol=1e-5)
+    with pytest.raises(ValueError):
+        F.max_unpool2d(paddle.to_tensor(x), paddle.to_tensor(x), 2,
+                       data_format="NHWC")
+
+
+def test_max_unpool_1d_3d():
+    x1 = rs.randn(2, 4, 12).astype(np.float32)
+    o, i = F.max_pool1d(paddle.to_tensor(x1), 3, stride=2, padding=1,
+                        return_mask=True)
+    un = F.max_unpool1d(o, i, 3, stride=2, padding=1, output_size=[12])
+    to, ti = TF.max_pool1d(torch.tensor(x1), 3, 2, 1, return_indices=True)
+    tun = TF.max_unpool1d(to, ti, 3, 2, 1, output_size=[2, 4, 12])
+    np.testing.assert_allclose(un.numpy(), tun.numpy())
+    x3 = rs.randn(1, 2, 6, 6, 6).astype(np.float32)
+    o, i = F.max_pool3d(paddle.to_tensor(x3), 2, stride=2, return_mask=True)
+    un = F.max_unpool3d(o, i, 2, stride=2)
+    to, ti = TF.max_pool3d(torch.tensor(x3), 2, 2, return_indices=True)
+    tun = TF.max_unpool3d(to, ti, 2, 2)
+    np.testing.assert_allclose(un.numpy(), tun.numpy())
+
+
+def test_lp_pool1d_vs_torch():
+    x = rs.randn(2, 3, 10).astype(np.float32)
+    o = F.lp_pool1d(paddle.to_tensor(x), 2, 3, stride=2)
+    t = TF.lp_pool1d(torch.tensor(x), 2, 3, 2)
+    np.testing.assert_allclose(o.numpy(), t.numpy(), rtol=1e-4, atol=1e-5)
+    o2 = nn.LPPool1D(2, 3, stride=2)(paddle.to_tensor(x))
+    np.testing.assert_allclose(o2.numpy(), t.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_fractional_max_pool3d():
+    x = rs.randn(1, 2, 8, 8, 8).astype(np.float32)
+    o = F.fractional_max_pool3d(paddle.to_tensor(x), 4, random_u=0.3)
+    assert tuple(o.shape) == (1, 2, 4, 4, 4)
+    # disjoint windows tile the input: global max survives
+    assert np.isclose(o.numpy().max(), x.max())
+    o2 = nn.FractionalMaxPool3D(2, random_u=0.5)(paddle.to_tensor(x))
+    assert tuple(o2.shape) == (1, 2, 2, 2, 2)
+
+
+def test_fractional_max_pool_return_mask():
+    """Indices address the flattened input volume: scattering the pooled
+    values back through the index reproduces them exactly."""
+    x = rs.randn(1, 2, 8, 8).astype(np.float32)
+    o, idx = F.fractional_max_pool2d(paddle.to_tensor(x), 4, random_u=0.3,
+                                     return_mask=True)
+    flat = x.reshape(1, 2, -1)
+    gathered = np.take_along_axis(flat, idx.numpy().reshape(1, 2, -1), axis=2)
+    np.testing.assert_array_equal(gathered.reshape(o.shape), o.numpy())
+    x3 = rs.randn(1, 1, 6, 6, 6).astype(np.float32)
+    o3, idx3 = nn.FractionalMaxPool3D(3, random_u=0.7, return_mask=True)(
+        paddle.to_tensor(x3))
+    g3 = np.take_along_axis(x3.reshape(1, 1, -1),
+                            idx3.numpy().reshape(1, 1, -1), axis=2)
+    np.testing.assert_array_equal(g3.reshape(o3.shape), o3.numpy())
+
+
+def test_lp_pool_signed_power_matches_reference_kernel():
+    """Reference LPPool accumulates signed powf(x, p) (pooling.h:84): an odd
+    norm type over a net-negative window roots a negative sum -> NaN."""
+    x = np.array([[[-1.0, -1.0, 0.5, 0.5]]], np.float32)
+    out = F.lp_pool1d(paddle.to_tensor(x), 3, 2, stride=2).numpy()
+    assert np.isnan(out[0, 0, 0])          # (-1)^3 + (-1)^3 = -2 -> NaN root
+    assert np.isclose(out[0, 0, 1], (2 * 0.5 ** 3) ** (1 / 3), rtol=1e-5)
+
+
+def test_zeropad_layers():
+    x = rs.randn(1, 2, 4, 4).astype(np.float32)
+    o = F.zeropad2d(paddle.to_tensor(x), [1, 2, 3, 0])
+    t = TF.pad(torch.tensor(x), (1, 2, 3, 0))
+    np.testing.assert_array_equal(o.numpy(), t.numpy())
+    np.testing.assert_array_equal(
+        nn.ZeroPad2D([1, 2, 3, 0])(paddle.to_tensor(x)).numpy(), t.numpy())
+    x1 = rs.randn(1, 2, 5).astype(np.float32)
+    assert tuple(nn.ZeroPad1D([1, 2])(paddle.to_tensor(x1)).shape) == (1, 2, 8)
+    x3 = rs.randn(1, 2, 3, 3, 3).astype(np.float32)
+    assert tuple(nn.ZeroPad3D(1)(paddle.to_tensor(x3)).shape) == (1, 2, 5, 5, 5)
+
+
+def test_feature_alpha_dropout():
+    x = rs.randn(4, 8, 5, 5).astype(np.float32)
+    out = F.feature_alpha_dropout(paddle.to_tensor(x), 0.5, training=False)
+    np.testing.assert_array_equal(out.numpy(), x)
+    layer = nn.FeatureAlphaDropout(0.5)
+    layer.eval()
+    np.testing.assert_array_equal(layer(paddle.to_tensor(x)).numpy(), x)
+    layer.train()
+    o = layer(paddle.to_tensor(x)).numpy()
+    assert o.shape == x.shape
+    # whole channels are either kept (affine of x) or dropped to a constant
+    per_chan_std = o.reshape(4, 8, -1).std(-1)
+    assert ((per_chan_std < 1e-6) | (per_chan_std > 0.1)).all()
+
+
+def test_inplace_activation_aliases():
+    t = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+    assert F.relu_(t) is t
+    np.testing.assert_array_equal(t.numpy(), [0.0, 2.0])
+    for name in ["elu_", "hardtanh_", "leaky_relu_", "softmax_", "tanh_",
+                 "thresholded_relu_"]:
+        fn = getattr(F, name)
+        v = paddle.to_tensor(np.array([0.3, -0.2], np.float32))
+        assert fn(v) is v
+
+
+def test_inplace_activation_gradient_flow():
+    """Rebinding must snapshot first — otherwise the tape node's parent is
+    the rebound tensor itself and backward never reaches upstream
+    producers (review finding, reproduced)."""
+    x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+    x.stop_gradient = False
+    y = x * 2.0
+    F.relu_(y)
+    y.sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0])
+
+
+def test_hsigmoid_loss_default_tree():
+    """Default-tree bit coding mirrors the reference SimpleCode
+    (matrix_bit_code.h:113: index=(c>>(b+1))-1, bit=(c>>b)&1)."""
+    import math
+
+    N, D, C = 4, 3, 5
+    x = rs.randn(N, D).astype(np.float32)
+    w = rs.randn(C - 1, D).astype(np.float32)
+    b = rs.randn(C - 1).astype(np.float32)
+    lab = np.array([0, 1, 4, 2])
+    loss = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(lab), C,
+                           paddle.to_tensor(w), paddle.to_tensor(b))
+    expect = []
+    for i in range(N):
+        c = int(lab[i]) + C
+        tot = 0.0
+        for bit in range(c.bit_length() - 1):
+            widx = (c >> (bit + 1)) - 1
+            tgt = (c >> bit) & 1
+            logit = float(w[widx] @ x[i] + b[widx])
+            tot += (math.log1p(math.exp(-abs(logit))) + max(logit, 0)
+                    - tgt * logit)
+        expect.append([tot])
+    np.testing.assert_allclose(loss.numpy(), np.array(expect, np.float32),
+                               rtol=1e-4)
+
+
+def test_hsigmoid_loss_custom_tree_and_layer():
+    N, D, C = 4, 3, 5
+    x = rs.randn(N, D).astype(np.float32)
+    lab = np.array([0, 1, 4, 2])
+    tbl = np.array([[0, 1, -1], [2, 0, 1], [3, -1, -1], [1, 2, 3]])
+    code = np.array([[1, 0, 0], [0, 1, 1], [1, 0, 0], [0, 0, 1]])
+    layer = nn.HSigmoidLoss(D, C, is_custom=True)
+    out = layer(paddle.to_tensor(x), paddle.to_tensor(lab),
+                paddle.to_tensor(tbl), paddle.to_tensor(code))
+    assert tuple(out.shape) == (N, 1)
+    out.sum().backward()
+    assert layer.weight.grad is not None
+    with pytest.raises(ValueError):
+        layer(paddle.to_tensor(x), paddle.to_tensor(lab))
+
+
+def test_adaptive_log_softmax_vs_torch():
+    N, D, C = 6, 8, 10
+    m = nn.AdaptiveLogSoftmaxWithLoss(D, C, cutoffs=[4], div_value=2.0,
+                                      head_bias=True)
+    tm = torch.nn.AdaptiveLogSoftmaxWithLoss(D, C, cutoffs=[4],
+                                             div_value=2.0, head_bias=True)
+    with torch.no_grad():
+        tm.head.weight.copy_(torch.tensor(m.head_weight.numpy().T))
+        tm.head.bias.copy_(torch.tensor(m.head_bias.numpy()))
+        for i, (w0, w1) in enumerate(m.tail_weights):
+            tm.tail[i][0].weight.copy_(torch.tensor(w0.numpy().T))
+            tm.tail[i][1].weight.copy_(torch.tensor(w1.numpy().T))
+    x = rs.randn(N, D).astype(np.float32)
+    y = np.array([0, 3, 5, 9, 4, 1])
+    out, loss = m(paddle.to_tensor(x), paddle.to_tensor(y))
+    tout, tloss = tm(torch.tensor(x), torch.tensor(y))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(loss.numpy(), tloss.detach().numpy(),
+                               rtol=1e-4)
+    np.testing.assert_allclose(
+        m.log_prob(paddle.to_tensor(x)).numpy(),
+        tm.log_prob(torch.tensor(x)).detach().numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(
+        m.predict(paddle.to_tensor(x)).numpy(),
+        tm.predict(torch.tensor(x)).numpy())
+    with pytest.raises(ValueError):
+        m(paddle.to_tensor(x), paddle.to_tensor(np.array([0, 1, 2, 3, 4, C])))
+    with pytest.raises(ValueError):
+        nn.AdaptiveLogSoftmaxWithLoss(D, C, cutoffs=[4, 3])
+
+
+def test_gather_tree_doc_example():
+    ids = paddle.to_tensor(np.array(
+        [[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]]))
+    par = paddle.to_tensor(np.array(
+        [[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]]))
+    expect = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]])
+    np.testing.assert_array_equal(F.gather_tree(ids, par).numpy(), expect)
+
+
+def test_beam_search_decode():
+    V, D, H, B, BEAM = 7, 4, 8, 3, 2
+    emb = nn.Embedding(V, D)
+    cell = nn.GRUCell(D, H)
+    out_layer = nn.Linear(H, V)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2,
+                               beam_size=BEAM, embedding_fn=emb,
+                               output_fn=out_layer)
+    h0 = paddle.to_tensor(rs.rand(B, H).astype(np.float32))
+    final, lengths = nn.dynamic_decode(dec, inits=h0, max_step_num=6,
+                                       return_length=True)
+    ids = final.predicted_ids.numpy()          # [batch, time, beam]
+    assert ids.shape[0] == B and ids.shape[2] == BEAM
+    assert (ids >= 0).all() and (ids < V).all()
+    sc = final.scores.numpy()
+    assert (sc[:, -1, 0] >= sc[:, -1, 1]).all()  # beams sorted best-first
+    tm = nn.dynamic_decode(dec, inits=h0, max_step_num=6,
+                           output_time_major=True)
+    assert tm.predicted_ids.shape[1] == B
+    nn.dynamic_decode(dec, inits=h0, max_step_num=4, impute_finished=True)
+
+
+def test_rnn_cell_base_and_birnn():
+    cell = nn.LSTMCell(4, 8)
+    assert isinstance(cell, nn.RNNCellBase)
+    x = paddle.to_tensor(rs.rand(3, 5, 4).astype(np.float32))
+    # LSTM states are an (h, c) tuple per reference state_shape
+    h0, c0 = cell.get_initial_states(x)
+    assert tuple(h0.shape) == (3, 8) and tuple(c0.shape) == (3, 8)
+    out, (h1, c1) = cell(paddle.to_tensor(rs.rand(3, 4).astype(np.float32)),
+                         (h0, c0))
+    assert tuple(h1.shape) == (3, 8)
+    # GRU states stay a single tensor
+    g = nn.GRUCell(4, 8)
+    assert tuple(g.get_initial_states(x).shape) == (3, 8)
+    bi = nn.BiRNN(nn.GRUCell(4, 8), nn.GRUCell(4, 8))
+    out, (sf, sb) = bi(x)
+    assert tuple(out.shape) == (3, 5, 16)
+
+
+def test_misc_layer_tail():
+    x = rs.rand(2, 3, 4, 4).astype(np.float32)
+    sm = nn.Softmax2D()(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(sm.sum(1), np.ones((2, 4, 4)), rtol=1e-5)
+    with pytest.raises(ValueError):
+        nn.Softmax2D()(paddle.to_tensor(x[0, 0]))
+    assert isinstance(nn.Silu()(paddle.to_tensor(x)), paddle.Tensor)
+    pd = nn.ParameterDict({"w": paddle.create_parameter([2, 2], "float32")})
+    pd["b"] = paddle.create_parameter([3], "float32", is_bias=True)
+    assert set(pd.keys()) == {"w", "b"} and len(list(pd.parameters())) == 2
+    del pd["b"]
+    assert "b" not in pd and len(pd) == 1
+
+
+def test_create_parameter_initializes():
+    w = paddle.create_parameter([16, 16], "float32")
+    assert w.numpy().std() > 0  # Xavier, not zeros
+    b = paddle.create_parameter([16], "float32", is_bias=True)
+    assert (b.numpy() == 0).all()
