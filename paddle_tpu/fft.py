@@ -79,3 +79,58 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply_op("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), [x])
+
+
+def _hfft_axes(v_ndim, s, axes):
+    if axes is not None:
+        ax = [a if a >= 0 else a + v_ndim for a in axes]
+    elif s is not None:
+        ax = list(range(v_ndim - len(s), v_ndim))
+    else:
+        ax = list(range(v_ndim))
+    return ax
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """fft.py:830 hfftn — N-D FFT of a signal with Hermitian symmetry along
+    the LAST transform axis (half-spectrum input, like the reference /
+    torch): complex fftn over the leading axes composed with hfft on the
+    last, so each norm mode factorizes correctly."""
+    def fn(v):
+        ax = _hfft_axes(v.ndim, s, axes)
+        ss = (list(s) if s is not None
+              else [v.shape[a] for a in ax[:-1]] + [2 * (v.shape[ax[-1]] - 1)])
+        y = v
+        if len(ax) > 1:
+            y = jnp.fft.fftn(y, s=ss[:-1], axes=ax[:-1], norm=_norm(norm))
+        return jnp.fft.hfft(y, n=ss[-1], axis=ax[-1], norm=_norm(norm))
+
+    return apply_op("hfftn", fn, [x])
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """fft.py:885 ihfftn — inverse of hfftn: ihfft on the last axis then
+    complex ifftn over the leading axes (output keeps the half-spectrum
+    last axis)."""
+    def fn(v):
+        ax = _hfft_axes(v.ndim, s, axes)
+        ss = list(s) if s is not None else [v.shape[a] for a in ax]
+        y = jnp.fft.ihfft(v, n=ss[-1], axis=ax[-1], norm=_norm(norm))
+        if len(ax) > 1:
+            y = jnp.fft.ifftn(y, s=ss[:-1], axes=ax[:-1], norm=_norm(norm))
+        return y
+
+    return apply_op("ihfftn", fn, [x])
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """fft.py:1214 hfft2 = hfftn over two axes."""
+    return hfftn(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """fft.py:1270 ihfft2 = ihfftn over two axes."""
+    return ihfftn(x, s, axes, norm)
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

@@ -2,4 +2,6 @@
 of tensor.linalg).  The implementations live in ops/linalg.py (XLA lax.linalg
 backends)."""
 
+from .ops.creation import diagonal  # noqa: F401
 from .ops.linalg import *  # noqa: F401,F403
+from .ops.math import cross  # noqa: F401

@@ -280,3 +280,198 @@ def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
 
     ins = [x, y] + ([bias] if bias is not None else [])
     return apply_op("fp8_fp8_half_gemm_fused", fn, ins)
+
+
+def matrix_transpose(x, name=None):
+    """linalg.py matrix_transpose: swap the last two axes."""
+    return apply_op("matrix_transpose", lambda v: jnp.swapaxes(v, -1, -2), [x])
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """linalg.py vecdot: (conjugated) vector dot along ``axis``."""
+    def fn(a, b):
+        a = jnp.conj(a) if jnp.iscomplexobj(a) else a
+        return (a * b).sum(axis=axis)
+
+    return apply_op("vecdot", fn, [x, y])
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """linalg.py vector_norm: p-norm over ``axis`` (flattened if None)."""
+    def fn(v):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if ax is None:
+            v = v.reshape(-1)
+            ax2 = None
+        else:
+            ax2 = ax
+        pf = float(p)
+        if pf == float("inf"):
+            return jnp.abs(v).max(axis=ax2, keepdims=keepdim)
+        if pf == float("-inf"):
+            return jnp.abs(v).min(axis=ax2, keepdims=keepdim)
+        if pf == 0:
+            return (v != 0).astype(v.dtype).sum(axis=ax2, keepdims=keepdim)
+        return (jnp.abs(v) ** pf).sum(axis=ax2, keepdims=keepdim) ** (1.0 / pf)
+
+    return apply_op("vector_norm", fn, [x])
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """linalg.py matrix_norm: fro / nuc / 1 / -1 / 2 / -2 / inf / -inf over
+    the two ``axis`` dims."""
+    def fn(v):
+        ax = tuple(a if a >= 0 else a + v.ndim for a in axis)
+        # move the matrix axes last
+        rest = [d for d in range(v.ndim) if d not in ax]
+        m = jnp.transpose(v, rest + list(ax))
+        if p == "fro":
+            out = jnp.sqrt((jnp.abs(m) ** 2).sum((-2, -1)))
+        elif p == "nuc":
+            out = jnp.linalg.svd(m, compute_uv=False).sum(-1)
+        elif p in (2, -2, 2.0, -2.0):
+            s = jnp.linalg.svd(m, compute_uv=False)
+            out = s.max(-1) if float(p) > 0 else s.min(-1)
+        elif p in (1, -1, 1.0, -1.0):
+            colsums = jnp.abs(m).sum(-2)
+            out = colsums.max(-1) if float(p) > 0 else colsums.min(-1)
+        elif p in (float("inf"), float("-inf")):
+            rowsums = jnp.abs(m).sum(-1)
+            out = rowsums.max(-1) if p > 0 else rowsums.min(-1)
+        else:
+            raise ValueError(f"matrix_norm: unsupported p={p!r}")
+        if keepdim:
+            for a in sorted(ax):
+                out = jnp.expand_dims(out, a)
+        return out
+
+    return apply_op("matrix_norm", fn, [x])
+
+
+def svdvals(x, name=None):
+    """linalg.py svdvals: singular values only."""
+    return apply_op("svdvals",
+                    lambda v: jnp.linalg.svd(v, compute_uv=False), [x])
+
+
+def matrix_exp(x, name=None):
+    """linalg.py matrix_exp via jax.scipy.linalg.expm (Pade + squaring)."""
+    from jax.scipy.linalg import expm
+
+    return apply_op("matrix_exp", lambda v: expm(v), [x])
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """linalg.py cholesky_inverse: inverse of A given its Cholesky factor —
+    solve L L^H Z = I (or U^H U Z = I) instead of forming the inverse of x."""
+    def fn(f):
+        eye = jnp.eye(f.shape[-1], dtype=f.dtype)
+        if upper:  # x = U, A = U^H U
+            y = jax.scipy.linalg.solve_triangular(
+                jnp.swapaxes(f, -1, -2), eye, lower=True)
+            return jax.scipy.linalg.solve_triangular(f, y, lower=False)
+        # x = L, A = L L^H
+        y = jax.scipy.linalg.solve_triangular(f, eye, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(f, -1, -2), y, lower=False)
+
+    return apply_op("cholesky_inverse", fn, [x])
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """linalg.py lu_unpack: split packed LU into (P, L, U).  ``y`` holds
+    1-based pivot rows as returned by ``lu`` (reference tensor/linalg.py)."""
+    def fn(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+        # pivots -> permutation: apply row swaps i <-> piv[i]-1 in order
+        def perm_one(pv):
+            def body(i, pm):
+                j = pv[i] - 1
+                a, b = pm[i], pm[j]
+                return pm.at[i].set(b).at[j].set(a)
+
+            pm = jax.lax.fori_loop(0, pv.shape[0], body, jnp.arange(m))
+            return jax.nn.one_hot(pm, m, dtype=lu_.dtype).T
+
+        pv = piv.astype(jnp.int32)
+        P = (perm_one(pv) if lu_.ndim == 2 else
+             jax.vmap(perm_one)(pv.reshape((-1, pv.shape[-1]))).reshape(
+                 lu_.shape[:-2] + (m, m)))
+        return P, L, U
+
+    P, L, U = apply_op("lu_unpack", fn, [x, y], n_outputs=3)
+    return P, L, U
+
+
+def householder_product(x, tau, name=None):
+    """linalg.py householder_product: assemble Q from geqrf-style
+    (reflectors, taus) via jax.lax.linalg.householder_product."""
+    def fn(a, t):
+        return jax.lax.linalg.householder_product(a, t)
+
+    return apply_op("householder_product", fn, [x, tau])
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """linalg.py ormqr: multiply ``y`` by the Q of a geqrf factorization.
+    Q is assembled with householder_product — O(m^2 k) like forming Q
+    explicitly, which XLA fuses into the following matmul."""
+    def fn(a, t, other):
+        # assemble the FULL m x m Q (torch/paddle contract): pad reflectors
+        # and taus with zeros so the extra Householder steps are identity
+        m, k = a.shape[-2], t.shape[-1]
+        if k < m:
+            a = jnp.concatenate(
+                [a[..., :, :k],
+                 jnp.zeros(a.shape[:-1] + (m - k,), a.dtype)], axis=-1)
+            t = jnp.concatenate(
+                [t, jnp.zeros(t.shape[:-1] + (m - k,), t.dtype)], axis=-1)
+        q = jax.lax.linalg.householder_product(a, t)
+        if transpose:
+            q = jnp.swapaxes(jnp.conj(q), -1, -2)
+        return q @ other if left else other @ q
+
+    return apply_op("ormqr", fn, [x, tau, y])
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """linalg.py svd_lowrank (Halko et al. 2009): randomized low-rank SVD
+    with ``niter`` power iterations."""
+    from ..core import rng as _rng
+
+    key = _rng.next_key()
+    inputs = [x] + ([M] if M is not None else [])
+
+    def fn(a, *rest):
+        am = a - rest[0] if rest else a
+        m, n = am.shape[-2], am.shape[-1]
+        k = min(q, m, n)
+        omega = jax.random.normal(key, am.shape[:-2] + (n, k), jnp.float32
+                                  ).astype(am.dtype)
+        Y = am @ omega
+        Q, _ = jnp.linalg.qr(Y)
+        for _i in range(niter):
+            Z = jnp.swapaxes(am, -1, -2) @ Q
+            Qz, _ = jnp.linalg.qr(Z)
+            Y = am @ Qz
+            Q, _ = jnp.linalg.qr(Y)
+        B = jnp.swapaxes(Q, -1, -2) @ am
+        u, s, vh = jnp.linalg.svd(B, full_matrices=False)
+        return Q @ u, s, jnp.swapaxes(vh, -1, -2)
+
+    U, S, V = apply_op("svd_lowrank", fn, inputs, n_outputs=3)
+    return U, S, V
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """linalg.py pca_lowrank: randomized PCA — svd_lowrank on the
+    (optionally) column-centered matrix."""
+    centered = (apply_op("pca_center",
+                         lambda v: v - v.mean(axis=-2, keepdims=True), [x])
+                if center else x)
+    kq = q if q is not None else min(6, _unwrap(x).shape[-2],
+                                     _unwrap(x).shape[-1])
+    return svd_lowrank(centered, q=kq, niter=niter)

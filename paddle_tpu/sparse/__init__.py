@@ -52,6 +52,12 @@ class SparseCooTensor:
         return Tensor(self._bcoo.data)
 
     def to_dense(self):
+        if self._bcoo.data.dtype == jnp.bool_:
+            # BCOO densify scatter-adds, which rejects bool — round-trip int8
+            cast = jsparse.BCOO(
+                (self._bcoo.data.astype(jnp.int8), self._bcoo.indices),
+                shape=self._bcoo.shape)
+            return Tensor(cast.todense().astype(jnp.bool_))
         return Tensor(self._bcoo.todense())
 
     def to_sparse_csr(self):
@@ -216,9 +222,9 @@ def multiply(x, y, name=None):
     # row-major strides: strides[i] = prod(shape[i+1:]), last stride 1
     strides = jnp.asarray(
         np.append(np.cumprod(np.asarray(a.shape[1:])[::-1])[::-1], 1)
-        if len(a.shape) > 1 else [1], jnp.int64)
-    ka = (a.indices.astype(jnp.int64) * strides).sum(-1)
-    kb = (b.indices.astype(jnp.int64) * strides).sum(-1)
+        if len(a.shape) > 1 else [1], jnp.int32)
+    ka = (a.indices.astype(jnp.int32) * strides).sum(-1)
+    kb = (b.indices.astype(jnp.int32) * strides).sum(-1)
     order = jnp.argsort(kb)
     kb_sorted = kb[order]
     pos = jnp.searchsorted(kb_sorted, ka)
@@ -282,3 +288,132 @@ class _SparseReLU:
 
 class nn:
     ReLU = _SparseReLU
+
+
+# ---- unary tail (f(0)=0 family, reference sparse/unary.py) ----
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+
+
+def isnan(x, name=None):
+    """reference sparse/unary.py isnan: same pattern, bool values."""
+    coo = _as_coo(x)
+    return SparseCooTensor(jsparse.BCOO(
+        (jnp.isnan(coo._bcoo.data), coo._bcoo.indices), shape=coo.shape))
+
+
+def coalesce(x, name=None):
+    """reference sparse COO coalesce: merge duplicate coordinates."""
+    coo = _as_coo(x)
+    return SparseCooTensor(coo._bcoo.sum_duplicates())
+
+
+def reshape(x, shape, name=None):
+    """reference sparse/unary.py reshape: remap flat coordinates."""
+    coo = _as_coo(x)._bcoo.sum_duplicates()
+    old_shape = np.asarray(coo.shape, np.int64)
+    new_shape = list(int(s) for s in shape)
+    neg = [i for i, s in enumerate(new_shape) if s == -1]
+    total = int(old_shape.prod())
+    if neg:
+        known = int(np.prod([s for s in new_shape if s != -1]))
+        new_shape[neg[0]] = total // known
+    strides_old = jnp.asarray(
+        np.append(np.cumprod(old_shape[1:][::-1])[::-1], 1), jnp.int32)
+    flat = (coo.indices.astype(jnp.int32) * strides_old).sum(-1)
+    strides_new = np.append(
+        np.cumprod(np.asarray(new_shape[1:], np.int64)[::-1])[::-1], 1)
+    new_idx = jnp.stack(
+        [(flat // int(s)) % int(d) for s, d in zip(strides_new, new_shape)],
+        axis=-1)
+    return SparseCooTensor(jsparse.BCOO(
+        (coo.data, new_idx.astype(coo.indices.dtype)),
+        shape=tuple(new_shape)))
+
+
+def slice(x, axes, starts, ends, name=None):
+    """reference sparse slice: keep entries inside the window and shift
+    their coordinates."""
+    coo = _as_coo(x)._bcoo.sum_duplicates()
+    shape = list(coo.shape)
+    idx = coo.indices
+    keep = jnp.ones(idx.shape[0], bool)
+    shift = np.zeros(len(shape), np.int64)
+    for ax, st, en in zip(axes, starts, ends):
+        ax = int(ax) % len(shape)
+        st = int(st) if st >= 0 else int(st) + shape[ax]
+        en = min(int(en) if en >= 0 else int(en) + shape[ax], shape[ax])
+        keep &= (idx[:, ax] >= st) & (idx[:, ax] < en)
+        shift[ax] = st
+        shape[ax] = en - st
+    kept = np.asarray(keep)
+    new_idx = np.asarray(idx)[kept] - shift[None, :]
+    return SparseCooTensor(jsparse.BCOO(
+        (np.asarray(coo.data)[kept], new_idx.astype(np.int32)),
+        shape=tuple(shape)))
+
+
+def mv(x, vec, name=None):
+    """reference sparse/matmul.py mv: sparse matrix @ dense vector."""
+    coo = _as_coo(x)
+    return Tensor(coo._bcoo @ jnp.asarray(_unwrap(vec)))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """reference sparse/matmul.py addmm: beta*input + alpha*(x @ y)."""
+    xv = x._bcoo if isinstance(x, SparseCooTensor) else jnp.asarray(_unwrap(x))
+    yv = jnp.asarray(_unwrap(y))
+    prod = xv @ yv
+    base = _unwrap(input)
+    return Tensor(beta * jnp.asarray(base) + alpha * prod)
+
+
+def divide(x, y, name=None):
+    """Elementwise divide on the intersection pattern (reference
+    sparse/binary.py divide; a-entry with no b-match divides by zero, as the
+    dense kernel would)."""
+    a, b = _as_coo(x)._bcoo.sum_duplicates(), _as_coo(y)._bcoo.sum_duplicates()
+    strides = jnp.asarray(
+        np.append(np.cumprod(np.asarray(a.shape[1:])[::-1])[::-1], 1)
+        if len(a.shape) > 1 else [1], jnp.int32)
+    ka = (a.indices.astype(jnp.int32) * strides).sum(-1)
+    kb = (b.indices.astype(jnp.int32) * strides).sum(-1)
+    order = jnp.argsort(kb)
+    kb_sorted = kb[order]
+    pos = jnp.clip(jnp.searchsorted(kb_sorted, ka), 0, kb_sorted.shape[0] - 1)
+    match = kb_sorted[pos] == ka
+    bvals = b.data[order][pos]
+    data = a.data / jnp.where(match, bvals, 0)
+    return SparseCooTensor(jsparse.BCOO((data, a.indices), shape=a.shape))
+
+
+def mask_as(x, mask, name=None):
+    """reference sparse mask_as: take dense ``x``'s values at ``mask``'s
+    sparsity pattern."""
+    m = _as_coo(mask)._bcoo.sum_duplicates()
+    xv = jnp.asarray(_unwrap(x))
+    # values (and dtype) come from x; only the PATTERN comes from mask
+    vals = xv[tuple(m.indices[:, i] for i in range(m.indices.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((vals, m.indices), shape=m.shape))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """reference sparse pca_lowrank: densify and run the randomized PCA
+    (sparse input is a storage format here, not a compute path)."""
+    from ..ops.linalg import pca_lowrank as _dense_pca
+
+    dense = Tensor(_as_coo(x)._bcoo.todense())
+    return _dense_pca(dense, q=q, center=center, niter=niter)
+
+
+__all__ += ["tan", "asin", "atan", "sinh", "asinh", "atanh", "log1p",
+            "expm1", "deg2rad", "rad2deg", "isnan", "coalesce", "reshape",
+            "slice", "mv", "addmm", "divide", "mask_as", "pca_lowrank"]

@@ -1,0 +1,207 @@
+"""linalg / fft / sparse namespace tail (reference: python/paddle/linalg.py
+re-exports of tensor/linalg.py, python/paddle/fft.py hfftn:830 ihfftn:885,
+python/paddle/sparse/ unary & matmul families)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.fft as fft
+import paddle_tpu.linalg as L
+import paddle_tpu.sparse as sp
+
+rs = np.random.RandomState(11)
+
+
+# ----------------------------- linalg -----------------------------
+
+def test_matrix_transpose_vecdot_norms():
+    B = rs.randn(5, 3).astype(np.float32)
+    np.testing.assert_allclose(L.matrix_transpose(paddle.to_tensor(B)).numpy(),
+                               B.T)
+    A = rs.randn(4, 6).astype(np.float32)
+    np.testing.assert_allclose(
+        L.vecdot(paddle.to_tensor(A), paddle.to_tensor(A)).numpy(),
+        (A * A).sum(-1), rtol=1e-5)
+    np.testing.assert_allclose(
+        L.vector_norm(paddle.to_tensor(B), 3, axis=0).numpy(),
+        torch.linalg.vector_norm(torch.tensor(B), 3, dim=0).numpy(),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        L.vector_norm(paddle.to_tensor(B), float("inf")).numpy(),
+        np.abs(B).max(), rtol=1e-6)
+
+
+@pytest.mark.parametrize("p", ["fro", "nuc", 1, -1, 2, -2, float("inf")])
+def test_matrix_norm_vs_torch(p):
+    B = rs.randn(5, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        L.matrix_norm(paddle.to_tensor(B), p).numpy(),
+        torch.linalg.matrix_norm(torch.tensor(B), p).numpy(), rtol=1e-4)
+
+
+def test_svdvals_matrix_exp_cholesky_inverse():
+    B = rs.randn(5, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        L.svdvals(paddle.to_tensor(B)).numpy(),
+        torch.linalg.svdvals(torch.tensor(B)).numpy(), rtol=1e-4)
+    A = rs.randn(4, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        L.matrix_exp(paddle.to_tensor(A)).numpy(),
+        torch.linalg.matrix_exp(torch.tensor(A)).numpy(), rtol=1e-4,
+        atol=1e-4)
+    S = A @ A.T + 4 * np.eye(4, dtype=np.float32)
+    Lc = np.linalg.cholesky(S).astype(np.float32)
+    np.testing.assert_allclose(
+        L.cholesky_inverse(paddle.to_tensor(Lc)).numpy(), np.linalg.inv(S),
+        rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        L.cholesky_inverse(paddle.to_tensor(Lc.T.copy()), upper=True).numpy(),
+        np.linalg.inv(S), rtol=1e-3, atol=1e-4)
+
+
+def test_lu_unpack_round_trip():
+    A = rs.randn(5, 5).astype(np.float32)
+    S = A @ A.T + 5 * np.eye(5, dtype=np.float32)
+    lu_t, piv_t = torch.linalg.lu_factor(torch.tensor(S))
+    P, Lm, U = L.lu_unpack(paddle.to_tensor(lu_t.numpy()),
+                           paddle.to_tensor(piv_t.numpy()))
+    np.testing.assert_allclose(P.numpy() @ Lm.numpy() @ U.numpy(), S,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_householder_product_and_ormqr():
+    a = torch.tensor(rs.randn(5, 3).astype(np.float32))
+    geqrf, tau = torch.geqrf(a)
+    Q = L.householder_product(paddle.to_tensor(geqrf.numpy()),
+                              paddle.to_tensor(tau.numpy()))
+    np.testing.assert_allclose(
+        Q.numpy(), torch.linalg.householder_product(geqrf, tau).numpy(),
+        rtol=1e-4, atol=1e-5)
+    C = rs.randn(5, 2).astype(np.float32)
+    for left, transpose, other in [(True, False, C), (True, True, C),
+                                   (False, False, C.T.copy())]:
+        om = L.ormqr(paddle.to_tensor(geqrf.numpy()),
+                     paddle.to_tensor(tau.numpy()),
+                     paddle.to_tensor(other), left=left, transpose=transpose)
+        tom = torch.ormqr(geqrf, tau, torch.tensor(other), left=left,
+                          transpose=transpose)
+        np.testing.assert_allclose(om.numpy(), tom.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_lowrank_factorizations():
+    W = (rs.randn(20, 3) @ rs.randn(3, 15)).astype(np.float32)
+    U, S, V = L.svd_lowrank(paddle.to_tensor(W), q=5)
+    np.testing.assert_allclose(U.numpy() @ np.diag(S.numpy()) @ V.numpy().T,
+                               W, rtol=1e-2, atol=1e-3)
+    U, S, V = L.pca_lowrank(paddle.to_tensor(W), q=4)
+    np.testing.assert_allclose(U.numpy() @ np.diag(S.numpy()) @ V.numpy().T,
+                               W - W.mean(0), rtol=1e-2, atol=1e-3)
+    assert hasattr(L, "cross") and hasattr(L, "diagonal")
+
+
+# ----------------------------- fft -----------------------------
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_hfft2_ihfft2_vs_torch(norm):
+    x = (rs.randn(4, 5) + 1j * rs.randn(4, 5)).astype(np.complex64)
+    o = fft.hfft2(paddle.to_tensor(x), norm=norm)
+    t = torch.fft.hfft2(torch.tensor(x), norm=norm)
+    np.testing.assert_allclose(o.numpy(), t.numpy(), rtol=1e-4, atol=1e-5)
+    oi = fft.ihfft2(paddle.to_tensor(t.numpy()), norm=norm)
+    ti = torch.fft.ihfft2(t, norm=norm)
+    np.testing.assert_allclose(oi.numpy(), ti.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_hfftn_ihfftn_vs_torch():
+    x3 = (rs.randn(3, 4, 5) + 1j * rs.randn(3, 4, 5)).astype(np.complex64)
+    np.testing.assert_allclose(
+        fft.hfftn(paddle.to_tensor(x3)).numpy(),
+        torch.fft.hfftn(torch.tensor(x3)).numpy(), rtol=1e-4, atol=1e-4)
+    t = torch.fft.hfftn(torch.tensor(x3))
+    np.testing.assert_allclose(
+        fft.ihfftn(paddle.to_tensor(t.numpy())).numpy(),
+        torch.fft.ihfftn(t).numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        fft.hfftn(paddle.to_tensor(x3), s=[4, 8], axes=[1, 2]).numpy(),
+        torch.fft.hfftn(torch.tensor(x3), s=[4, 8], dim=[1, 2]).numpy(),
+        rtol=1e-4, atol=1e-4)
+
+
+# ----------------------------- sparse -----------------------------
+
+def _coo():
+    idx = np.array([[0, 1], [1, 0], [1, 2]])
+    return sp.sparse_coo_tensor(idx.T, np.array([2.0, 4.0, 6.0], np.float32),
+                                shape=(2, 3))
+
+
+def test_sparse_unary_tail():
+    x = _coo()
+    d = np.array([[0, 2.0, 0], [4.0, 0, 6.0]], np.float32)
+    np.testing.assert_allclose(sp.tan(x).to_dense().numpy(),
+                               np.tan(d) * (d != 0), rtol=1e-5)
+    np.testing.assert_allclose(sp.log1p(x).to_dense().numpy(), np.log1p(d),
+                               rtol=1e-5)
+    np.testing.assert_allclose(sp.deg2rad(x).to_dense().numpy(),
+                               np.deg2rad(d), rtol=1e-5)
+    for name in ["asin", "atan", "sinh", "asinh", "atanh", "expm1",
+                 "rad2deg"]:
+        assert hasattr(sp, name), name
+    assert not sp.isnan(x).to_dense().numpy().any()
+    xn = sp.sparse_coo_tensor(np.array([[0], [1]]),
+                              np.array([np.nan], np.float32), shape=(2, 3))
+    assert sp.isnan(xn).to_dense().numpy()[0, 1]
+
+
+def test_sparse_reshape_slice():
+    x = _coo()
+    d = np.array([[0, 2.0, 0], [4.0, 0, 6.0]], np.float32)
+    np.testing.assert_allclose(sp.reshape(x, [3, 2]).to_dense().numpy(),
+                               d.reshape(3, 2))
+    np.testing.assert_allclose(sp.reshape(x, [-1]).to_dense().numpy(),
+                               d.reshape(-1))
+    np.testing.assert_allclose(sp.slice(x, [1], [1], [3]).to_dense().numpy(),
+                               d[:, 1:3])
+
+
+def test_sparse_matmul_tail():
+    x = _coo()
+    d = np.array([[0, 2.0, 0], [4.0, 0, 6.0]], np.float32)
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(sp.mv(x, paddle.to_tensor(v)).numpy(), d @ v,
+                               rtol=1e-5)
+    y = rs.rand(3, 4).astype(np.float32)
+    base = rs.rand(2, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        sp.addmm(paddle.to_tensor(base), x, paddle.to_tensor(y),
+                 0.5, 2.0).numpy(),
+        0.5 * base + 2.0 * (d @ y), rtol=1e-4)
+
+
+def test_sparse_divide_mask_as_coalesce_pca():
+    x = _coo()
+    idx = np.array([[0, 1], [1, 0], [1, 2]])
+    x2 = sp.sparse_coo_tensor(idx.T, np.array([1.0, 2.0, 3.0], np.float32),
+                              shape=(2, 3))
+    np.testing.assert_allclose(sp.divide(x, x2).to_dense().numpy(),
+                               [[0, 2, 0], [2, 0, 2]])
+    d = np.arange(6, dtype=np.float32).reshape(2, 3)
+    masked = sp.mask_as(paddle.to_tensor(d), x)
+    np.testing.assert_allclose(masked.to_dense().numpy(),
+                               d * np.array([[0, 1, 0], [1, 0, 1]]))
+    c = sp.coalesce(sp.sparse_coo_tensor(
+        np.array([[0, 0], [1, 1]]), np.array([1.0, 2.0], np.float32),
+        shape=(2, 3)))
+    assert float(c.to_dense().numpy()[0, 1]) == 3.0
+    W = (rs.randn(10, 3) @ rs.randn(3, 8)).astype(np.float32)
+    Widx = np.argwhere(np.abs(W) > 0)
+    Wsp = sp.sparse_coo_tensor(Widx.T, W[Widx[:, 0], Widx[:, 1]],
+                               shape=W.shape)
+    U, S, V = sp.pca_lowrank(Wsp, q=4)
+    np.testing.assert_allclose(U.numpy() @ np.diag(S.numpy()) @ V.numpy().T,
+                               W - W.mean(0), rtol=1e-2, atol=1e-3)
