@@ -36,6 +36,7 @@ __all__ = [
     "hessian",
     "vjp",
     "jvp",
+    "saved_tensors_hooks",
 ]
 
 
@@ -101,13 +102,45 @@ class PyLayerContext:
         self.__dict__["_attrs"] = {}
 
     def save_for_backward(self, *tensors):
-        self._saved = tuple(tensors)
+        # the hook pair active at SAVE time governs this ctx (reference:
+        # saved_tensors_hooks semantics — pack on save, matching unpack on
+        # access during backward)
+        if _saved_tensors_hooks:
+            pack, self._unpack = _saved_tensors_hooks[-1]
+            self._saved = tuple(pack(t) for t in tensors)
+        else:
+            self._unpack = None
+            self._saved = tuple(tensors)
 
     def saved_tensor(self):
+        if getattr(self, "_unpack", None) is not None:
+            return tuple(self._unpack(t) for t in self._saved)
         return self._saved
 
     # arbitrary attribute stashing, like the reference PyLayerContext
-    saved_tensors = property(lambda self: self._saved)
+    saved_tensors = property(lambda self: self.saved_tensor())
+
+
+# stack of (pack, unpack) pairs; innermost wins (reference:
+# python/paddle/autograd/saved_tensors_hooks.py)
+_saved_tensors_hooks: list = []
+
+
+class saved_tensors_hooks:
+    """Context manager customizing how PyLayer saves residuals for backward:
+    ``pack_hook(tensor)`` runs at save time (e.g. offload to host numpy),
+    ``unpack_hook(obj)`` reconstructs the tensor when backward reads it."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pair = (pack_hook, unpack_hook)
+
+    def __enter__(self):
+        _saved_tensors_hooks.append(self.pair)
+        return self
+
+    def __exit__(self, *exc):
+        _saved_tensors_hooks.remove(self.pair)
+        return False
 
 
 class PyLayerMeta(type):

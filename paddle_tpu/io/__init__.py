@@ -44,6 +44,7 @@ __all__ = [
     "Sampler",
     "SequenceSampler",
     "RandomSampler",
+    "SubsetRandomSampler",
     "WeightedRandomSampler",
     "BatchSampler",
     "DistributedBatchSampler",
@@ -184,6 +185,27 @@ class RandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample the given indices in random order (reference:
+    python/paddle/io/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices, generator=None):
+        if len(indices) == 0:
+            raise ValueError(
+                "The length of `indices` in SubsetRandomSampler should be greater than 0.")
+        self.indices = list(indices)
+        self.generator = generator
+
+    def __iter__(self):
+        g = self.generator
+        perm = (g.permutation(len(self.indices)) if hasattr(g, "permutation")
+                else np.random.permutation(len(self.indices)))
+        return iter(self.indices[i] for i in perm)
+
+    def __len__(self):
+        return len(self.indices)
 
 
 class WeightedRandomSampler(Sampler):

@@ -22,12 +22,48 @@ from ..nn.layer_base import Layer
 __all__ = [
     "to_static",
     "not_to_static",
+    "enable_to_static",
+    "ignore_module",
+    "set_code_level",
+    "set_verbosity",
     "functional_state",
     "functional_call",
+    "TranslatedLayer",
     "TrainStep",
     "save",
     "load",
 ]
+
+# dy2static global switch (reference: python/paddle/jit/api.py
+# enable_to_static) — when off, to_static returns the callable un-jitted
+_to_static_enabled = True
+# modules the reference's AST transpiler skips (jit/utils.py ignore_module);
+# tracing-native to_static has no transpiler, but the registry is honored by
+# returning functions from these modules unwrapped
+_ignored_modules: list = []
+# dy2static logging knobs (jit/dy2static/logging_utils.py)
+_verbosity = 0
+_code_level = 0
+
+
+def enable_to_static(enable_to_static_bool: bool) -> None:
+    global _to_static_enabled
+    _to_static_enabled = bool(enable_to_static_bool)
+
+
+def ignore_module(modules) -> None:
+    _ignored_modules.extend(modules if isinstance(modules, (list, tuple))
+                            else [modules])
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False) -> None:
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False) -> None:
+    global _code_level
+    _code_level = int(level)
 
 
 def functional_state(layer: Layer):
@@ -161,6 +197,10 @@ class StaticFunction:
             self._jitted = pure
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:  # consulted per call, like the
+            # reference's ProgramTranslator switch — disabling after
+            # decoration must still fall back to eager
+            return self._function(*args, **kwargs)
         if self._jitted is None:
             self._build()
         arg_vals = jax.tree_util.tree_map(
@@ -192,6 +232,12 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
     """``paddle.jit.to_static`` analog: decorate a function or Layer."""
 
     def decorate(obj):
+        if not _to_static_enabled:
+            return obj
+        mod = getattr(obj, "__module__", None)
+        if mod is not None and any(
+                getattr(m, "__name__", m) == mod for m in _ignored_modules):
+            return obj
         if isinstance(obj, Layer):
             sf = StaticFunction(obj.forward, layer=obj, input_spec=input_spec)
             obj.forward = sf
@@ -277,21 +323,86 @@ class TrainStep:
 # ---- jit.save / jit.load (reference: paddle.jit.save TranslatedLayer) ----
 
 def save(layer, path, input_spec=None, **config):
-    """Serialize a Layer's state + class info (weights-level save; the compiled
-    executable is rebuilt by jit on load — XLA compile cache makes this cheap)."""
+    """Serialize a Layer: always writes ``<path>.pdparams`` (numpy weights);
+    when ``input_spec`` is given, additionally writes the jax.export StableHLO
+    program (``<path>.pdmodel`` + ``.pdiparams``) so ``load`` can return a
+    runnable TranslatedLayer without the defining Python code (reference:
+    python/paddle/jit/api.py save/load contract)."""
     import pickle
+
+    import numpy as np
 
     state = {}
     if isinstance(layer, Layer):
-        import numpy as np
-
         state = {k: np.asarray(_unwrap(v)) for k, v in layer.state_dict().items()}
     with open(path + ".pdparams", "wb") as f:
         pickle.dump(state, f)
 
+    if input_spec is not None and isinstance(layer, Layer):
+        import warnings
+
+        from ..inference import save_inference_model
+
+        examples = []
+        for spec in input_spec:
+            shape = tuple(1 if (s is None or int(s) < 0) else int(s)
+                          for s in spec.shape)
+            if shape != tuple(spec.shape):
+                warnings.warn(
+                    "jit.save: dynamic dims in InputSpec are pinned to 1 — "
+                    "the exported program is fixed-shape (AOT StableHLO)")
+            examples.append(jnp.zeros(shape, spec.dtype))
+        params, buffers = functional_state(layer)
+
+        def fwd(state, *inputs):
+            p, b = state
+            return functional_call(layer, p, b, *inputs)
+
+        save_inference_model(path, fwd, examples, params=(params, buffers))
+
+
+class TranslatedLayer(Layer):
+    """Layer reconstructed from a saved program (reference:
+    python/paddle/jit/translated_layer.py) — executes the deserialized
+    StableHLO export; no Python model code needed."""
+
+    def __init__(self, exported, params, state=None):
+        super().__init__()
+        self._exported = exported
+        self._exec_params = params
+        self._state = state or {}
+
+    def state_dict(self, *a, **kw):
+        return dict(self._state)
+
+    def forward(self, *inputs):
+        vals = [_unwrap(x) for x in inputs]
+        out = self._exported.call(self._exec_params, *vals)
+        return jax.tree_util.tree_map(
+            lambda o: Tensor(o) if isinstance(o, (jax.Array, jnp.ndarray)) else o,
+            out)
+
+    def program(self):
+        return self._exported.mlir_module()
+
 
 def load(path, **config):
+    """Returns a TranslatedLayer when ``save`` exported a program for this
+    path, else the raw pickled state dict (weights-only save)."""
+    import os
     import pickle
 
-    with open(path + ".pdparams", "rb") as f:
-        return pickle.load(f)
+    state = {}
+    has_params = os.path.exists(path + ".pdparams")
+    if not has_params and not os.path.exists(path + ".pdmodel"):
+        raise FileNotFoundError(
+            f"jit.load: neither {path}.pdparams nor {path}.pdmodel exists")
+    if has_params:
+        with open(path + ".pdparams", "rb") as f:
+            state = pickle.load(f)
+    if os.path.exists(path + ".pdmodel"):
+        from ..inference import load_inference_model
+
+        exported, params = load_inference_model(path)
+        return TranslatedLayer(exported, params, state)
+    return state

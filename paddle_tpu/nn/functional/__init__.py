@@ -1106,6 +1106,9 @@ def scaled_dot_product_attention(
         qh = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
         kh = jnp.swapaxes(k, 1, 2)
         vh = jnp.swapaxes(v, 1, 2)
+        if qh.shape[1] != kh.shape[1]:  # GQA: repeat kv heads to q heads
+            kh = jnp.repeat(kh, qh.shape[1] // kh.shape[1], axis=1)
+            vh = jnp.repeat(vh, qh.shape[1] // vh.shape[1], axis=1)
         scale = 1.0 / _math.sqrt(qh.shape[-1])
         logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
         if is_causal:
@@ -1125,6 +1128,24 @@ def scaled_dot_product_attention(
     return apply_op("sdpa", fn, inputs)
 
 
+from .flash_attention import (  # noqa: E402
+    calc_reduced_attention_scores,
+    flash_attention,
+    flash_attn_qkvpacked,
+    flash_attn_unpadded,
+    flash_attn_varlen_qkvpacked,
+    flashmask_attention,
+    sdp_kernel,
+)
+from .sparse_attention import sparse_attention  # noqa: E402
+
+__all__ += [
+    "flash_attention", "flash_attn_unpadded", "flashmask_attention",
+    "flash_attn_qkvpacked", "flash_attn_varlen_qkvpacked",
+    "calc_reduced_attention_scores", "sdp_kernel", "sparse_attention",
+]
+
+
 # sequence mask utility
 @_export
 def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
@@ -1135,6 +1156,17 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
 
 
 # ============== reference loss tail (python/paddle/nn/functional/loss.py) ====
+
+@_export
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """loss.py:129: -label*log(input+eps) - (1-label)*log(1-input+eps),
+    elementwise (no reduction)."""
+    def fn(x, y):
+        y = y.astype(x.dtype)
+        return -y * jnp.log(x + epsilon) - (1.0 - y) * jnp.log(1.0 - x + epsilon)
+
+    return apply_op("log_loss", fn, [input, label])
+
 
 @_export
 def soft_margin_loss(input, label, reduction="mean", name=None):

@@ -1,0 +1,283 @@
+"""Flash-attention functional family (reference:
+python/paddle/nn/functional/flash_attention.py).
+
+The reference routes these through CUDA flash-attn kernels; on TPU the same
+contract is met by the Pallas flash kernel (ops/pallas/flash_attention.py)
+when it applies, falling back to an XLA-composed masked attention that the
+compiler fuses and tiles onto the MXU.  All entry points run through
+``apply_op`` so eager autograd records them on the tape.
+"""
+
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+
+from ...core import rng
+from ...core.tensor import Tensor, apply_op, _unwrap
+
+__all__ = [
+    "flash_attention",
+    "flash_attn_qkvpacked",
+    "flash_attn_unpadded",
+    "flash_attn_varlen_qkvpacked",
+    "flashmask_attention",
+    "calc_reduced_attention_scores",
+    "sdp_kernel",
+]
+
+
+def sdp_kernel(enable_math=False, enable_flash=True, enable_mem_efficient=True):
+    """No-op context manager kept for parity (flash_attention.py:144): TPU
+    dispatch is decided by FLAGS_use_pallas_kernels, not a CUDA-arch probe."""
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def _dropout_probs(probs, dropout, training):
+    if dropout and training:
+        keep = jax.random.bernoulli(rng.next_key(), 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+    return probs
+
+
+def _dense_attention(q, k, v, mask, causal, scale, dropout, training,
+                     return_softmax):
+    """Masked attention core on [B, S, H, D] (paddle layout).  ``mask`` is a
+    broadcastable boolean [B|1, H|1, Sq, Sk] where True = attend."""
+    qh = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    nq, nk = qh.shape[1], kh.shape[1]
+    if nq != nk:  # GQA: repeat kv heads onto the query-head axis
+        kh = jnp.repeat(kh, nq // nk, axis=1)
+        vh = jnp.repeat(vh, nq // nk, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        tri = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(tri, logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    # fully-masked rows produce NaN from softmax(-inf row); zero them like
+    # the reference kernel does for padding queries
+    probs = jnp.nan_to_num(probs, nan=0.0)
+    probs = _dropout_probs(probs, dropout, training).astype(q.dtype)
+    out = jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", probs, vh), 1, 2)
+    return (out, probs) if return_softmax else (out,)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, *, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """flash_attention.py:358 — [B, S, H, D] in, (out, softmax|None) out."""
+    from . import scaled_dot_product_attention
+
+    # sdpa's causal mask is top-left aligned (the torch/paddle sdpa
+    # convention); flash_attention follows the flash-attn kernel convention
+    # of BOTTOM-RIGHT alignment when sq != sk, so only delegate on equal
+    # lengths where the two agree
+    if not return_softmax and not dropout and \
+            int(query.shape[1]) == int(key.shape[1]):
+        out = scaled_dot_product_attention(query, key, value,
+                                           is_causal=causal, training=training)
+        return out, None
+    scale = 1.0 / _math.sqrt(int(query.shape[-1]))
+
+    def fn(q, k, v):
+        return _dense_attention(q, k, v, None, causal, scale, dropout,
+                                training, return_softmax)
+
+    res = apply_op("flash_attention", fn, [query, key, value])
+    if return_softmax:
+        return res[0], res[1]
+    return res[0], None
+
+
+def _split_qkvpacked(qkv):
+    """[..., G+2, NKV, D] → q [..., G*NKV, D], k/v [..., NKV, D] (packed
+    layout documented at flash_attention.py:632)."""
+    g = int(qkv.shape[-3]) - 2
+    q = qkv[..., :g, :, :].reshape(*qkv.shape[:-3], g * int(qkv.shape[-2]),
+                                   int(qkv.shape[-1]))
+    return q, qkv[..., g, :, :], qkv[..., g + 1, :, :]
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         *, fixed_seed_offset=None, rng_name="",
+                         training=True, name=None):
+    """flash_attention.py:590 — qkv [B, S, G+2, NKV, D]."""
+    scale = 1.0 / _math.sqrt(int(qkv.shape[-1]))
+
+    def fn(packed):
+        q, k, v = _split_qkvpacked(packed)
+        return _dense_attention(q, k, v, None, causal, scale, dropout,
+                                training, return_softmax)
+
+    res = apply_op("flash_attn_qkvpacked", fn, [qkv])
+    if return_softmax:
+        return res[0], res[1]
+    return res[0], None
+
+
+def _varlen_mask(cu_q, cu_k, sq, sk, causal):
+    """Packed-layout segment mask: token i of the flat q buffer may attend
+    token j of the flat k buffer iff they belong to the same sequence (and
+    j's in-sequence position <= i's when causal)."""
+    tq = jnp.arange(sq)
+    tk = jnp.arange(sk)
+    seg_q = jnp.searchsorted(cu_q[1:], tq, side="right")
+    seg_k = jnp.searchsorted(cu_k[1:], tk, side="right")
+    valid_q = tq < cu_q[-1]
+    valid_k = tk < cu_k[-1]
+    mask = (seg_q[:, None] == seg_k[None, :]) & valid_q[:, None] & valid_k[None, :]
+    if causal:
+        pos_q = tq - cu_q[seg_q]
+        pos_k = tk - cu_k[seg_k]
+        mask &= pos_k[None, :] <= pos_q[:, None]
+    return mask[None, None]  # [1, 1, sq, sk]
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """flash_attention.py:756 — packed [total, H, D] with cu_seqlens
+    boundaries; padding tokens (past cu_seqlens[-1]) produce zero output."""
+    def fn(q, k, v, cu_q, cu_k):
+        mask = _varlen_mask(cu_q, cu_k, q.shape[0], k.shape[0], causal)
+        res = _dense_attention(q[None], k[None], v[None], mask, False, scale,
+                               dropout, training, return_softmax)
+        return tuple(r[0] for r in res)
+
+    res = apply_op("flash_attn_unpadded", fn,
+                   [query, key, value, cu_seqlens_q, cu_seqlens_k])
+    if return_softmax:
+        return res[0], res[1]
+    return res[0], None
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                                max_seqlen_k, scale, dropout=0.0, causal=False,
+                                return_softmax=False, fixed_seed_offset=None,
+                                rng_name="", varlen_padded=True, training=True,
+                                name=None):
+    """flash_attention.py:1011 — packed qkv [total, G+2, NKV, D].  With
+    ``varlen_padded`` the buffer is batch-major padded to max_seqlen per
+    sequence; either way attention is confined within each sequence."""
+    def fn(packed, cu_q, cu_k):
+        q, k, v = _split_qkvpacked(packed)
+        if varlen_padded:
+            b = q.shape[0] // int(max_seqlen_q)
+            qb = q.reshape(b, int(max_seqlen_q), *q.shape[1:])
+            kb = k.reshape(b, int(max_seqlen_k), *k.shape[1:])
+            vb = v.reshape(b, int(max_seqlen_k), *v.shape[1:])
+            len_q = (cu_q[1:] - cu_q[:-1])[:, None]
+            len_k = (cu_k[1:] - cu_k[:-1])[:, None]
+            ok_q = jnp.arange(int(max_seqlen_q))[None, :] < len_q
+            ok_k = jnp.arange(int(max_seqlen_k))[None, :] < len_k
+            mask = (ok_q[:, None, :, None] & ok_k[:, None, None, :])
+            res = _dense_attention(qb, kb, vb, mask, causal, scale, dropout,
+                                   training, return_softmax)
+            out = res[0] * ok_q[..., None, None]  # zero padding rows
+            out = out.reshape(q.shape)
+            return (out,) + tuple(r.reshape(-1, *r.shape[2:]) for r in res[1:])
+        mask = _varlen_mask(cu_q, cu_k, q.shape[0], k.shape[0], causal)
+        res = _dense_attention(q[None], k[None], v[None], mask, False, scale,
+                               dropout, training, return_softmax)
+        return tuple(r[0] for r in res)
+
+    res = apply_op("flash_attn_varlen_qkvpacked", fn,
+                   [qkv, cu_seqlens_q, cu_seqlens_k])
+    if return_softmax:
+        return res[0], res[1]
+    return res[0], None
+
+
+def _flashmask_bands(idx, sq, sk, causal):
+    """Column-band mask from startend_row_indices [B, KH, Sk, {1,2,4}]
+    (flash_attention.py:1299): each column j carries row-bands that are
+    DISALLOWED; returns True where attention is allowed."""
+    rows = jnp.arange(sq)[None, None, :, None]  # broadcast [b, h, i, j]
+    nb = int(idx.shape[-1])
+    col = lambda n: idx[..., n][..., None, :]  # noqa: E731 — [B, KH, 1, Sk]
+
+    if causal:
+        if nb == 1:      # mask rows [LTS, inf)
+            banned = rows >= col(0)
+        elif nb == 2:    # mask rows [LTS, LTE)
+            banned = (rows >= col(0)) & (rows < col(1))
+        else:
+            raise ValueError("causal flashmask expects last dim 1 or 2")
+    else:
+        if nb == 2:      # mask rows [LTS, inf) and [0, UTE)
+            banned = (rows >= col(0)) | (rows < col(1))
+        elif nb == 4:    # mask rows [LTS, LTE) and [UTS, UTE)
+            banned = ((rows >= col(0)) & (rows < col(1))) | \
+                     ((rows >= col(2)) & (rows < col(3)))
+        else:
+            raise ValueError("non-causal flashmask expects last dim 2 or 4")
+    return ~banned
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None, *,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """flash_attention.py:1299 — column-wise sparse banded masking.  Bands
+    are evaluated as a dense boolean mask; XLA folds it into the fused
+    attention (the O(S^2) mask is bool, not a materialized score bias)."""
+    if return_softmax_lse or return_seed_offset:
+        raise NotImplementedError(
+            "flashmask_attention: return_softmax_lse/return_seed_offset are "
+            "CUDA-kernel introspection outputs not exposed by the TPU path")
+    scale = 1.0 / _math.sqrt(int(query.shape[-1]))
+    sq, sk = int(query.shape[1]), int(key.shape[1])
+    if window_size is not None:
+        window_size = ((window_size, window_size)
+                       if isinstance(window_size, int) else tuple(window_size))
+
+    inputs = [query, key, value]
+    if startend_row_indices is not None:
+        inputs.append(startend_row_indices)
+
+    def fn(q, k, v, *rest):
+        mask = None
+        if rest:
+            nkv = k.shape[2]
+            idx = rest[0]
+            if idx.shape[1] == 1 and nkv > 1:
+                idx = jnp.broadcast_to(idx, (idx.shape[0], nkv) + idx.shape[2:])
+            # repeat over the q-head grouping to match post-GQA head count
+            idx = jnp.repeat(idx, q.shape[2] // idx.shape[1], axis=1)
+            mask = _flashmask_bands(idx, sq, sk, causal)
+        if window_size is not None:
+            rows = jnp.arange(sq)[:, None]
+            cols = jnp.arange(sk)[None, :]
+            win = (rows - cols <= window_size[0]) & (cols - rows <= window_size[1])
+            mask = win[None, None] if mask is None else mask & win[None, None]
+        res = _dense_attention(q, k, v, mask, causal, scale, dropout,
+                               training, False)
+        return res[0]
+
+    return apply_op("flashmask_attention", fn, inputs)
+
+
+def calc_reduced_attention_scores(query, key, softmax_lse=None, name=None):
+    """flash_attention.py:2033 — column-wise sum over queries of the softmax
+    attention probabilities, reduced across q heads; [B, H, S, D] in (torch
+    layout, matching the reference op), [B, 1, 1, Sk] out."""
+    def fn(q, k, *rest):
+        scale = 1.0 / _math.sqrt(q.shape[-1])
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        return jnp.sum(probs, axis=(1, 2), keepdims=True)
+
+    inputs = [query, key] + ([softmax_lse] if softmax_lse is not None else [])
+    return apply_op("calc_reduced_attention_scores", fn, inputs)

@@ -1,0 +1,67 @@
+"""CSR-masked attention (reference:
+python/paddle/nn/functional/sparse_attention.py:22 — a CUDA-11.3 sparse
+kernel there; on TPU the CSR layout is expanded to a boolean mask and the
+computation stays a dense fused attention, which is how the MXU wants it:
+the win of the reference kernel is memory, and XLA gets that back by fusing
+the mask into the softmax instead of materializing scores)."""
+
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import apply_op
+
+__all__ = ["sparse_attention"]
+
+
+def _csr_to_mask(offset, columns, seq_len):
+    """offset [S+1], columns [nnz] (one (b,h) slice) → bool [S, S]."""
+    nnz = columns.shape[0]
+    n = jnp.arange(nnz)
+    # row of the n-th nonzero = how many row-starts are <= n, minus 1
+    rows = jnp.searchsorted(offset, n, side="right") - 1
+    valid = n < offset[-1]
+    rows = jnp.clip(rows, 0, seq_len - 1)
+    cols = jnp.clip(columns, 0, seq_len - 1)
+    mask = jnp.zeros((seq_len, seq_len), bool)
+    # .max, not .set: padded entries (valid=False) land on clipped indices
+    # that may collide with real nonzeros, and duplicate-index set order is
+    # unspecified — max() makes a True win regardless of order
+    return mask.at[rows, cols].max(valid)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Inputs [B, H, S, D] (torch layout, matching the reference op); the CSR
+    (offset, columns) pair marks which (row, col) score entries participate
+    in the softmax.  key_padding_mask [B, S] and attn_mask [S, S] use 0 =
+    masked, like the reference."""
+    inputs = [query, key, value, sparse_csr_offset, sparse_csr_columns]
+    n_extra = 0
+    if key_padding_mask is not None:
+        inputs.append(key_padding_mask)
+        n_extra += 1
+    if attn_mask is not None:
+        inputs.append(attn_mask)
+
+    def fn(q, k, v, off, cols, *rest):
+        s = q.shape[2]
+        mask = jax.vmap(jax.vmap(lambda o, c: _csr_to_mask(o, c, s)))(off, cols)
+        mask = mask[:, :, :, :]  # [B, H, S, S]
+        i = 0
+        if key_padding_mask is not None:
+            kp = rest[i]; i += 1
+            mask &= (kp != 0)[:, None, None, :]
+        if attn_mask is not None:
+            mask &= (rest[i] != 0)[None, None, :, :]
+        scale = 1.0 / _math.sqrt(q.shape[-1])
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        logits = jnp.where(mask, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        probs = jnp.nan_to_num(probs, nan=0.0).astype(q.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+    return apply_op("sparse_attention", fn, inputs)

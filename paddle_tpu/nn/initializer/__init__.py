@@ -23,6 +23,9 @@ __all__ = [
     "KaimingUniform",
     "Assign",
     "Orthogonal",
+    "Bilinear",
+    "Dirac",
+    "set_global_initializer",
     "calculate_gain",
 ]
 
@@ -177,6 +180,68 @@ class Orthogonal(Initializer):
         q, _ = jnp.linalg.qr(a)
         q = q.T if r < c else q
         return (self.gain * q[:r, :c]).reshape(shape).astype(dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel for transposed convs (reference:
+    python/paddle/nn/initializer/bilinear.py:110 — weight[...,y,x] =
+    (1-|x/f-c|)(1-|y/f-c|) with f=ceil(K/2), c=(2f-1-f%2)/(2f), identical
+    over the channel dims)."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("the length of shape must be 4.")
+        if shape[2] != shape[3]:
+            raise ValueError("shape[2] must be equal to shape[3].")
+        size = shape[3]
+        f = math.ceil(size / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        # the reference computes y with TRUE division — (i/size)%size keeps a
+        # fractional x/size term — so the filter is not exactly separable;
+        # replicate the flat-index formula verbatim for numerical parity
+        i = np.arange(int(np.prod(shape)), dtype=np.float64)
+        x = i % size
+        y = (i / size) % size
+        w = ((1 - np.abs(x / f - c)) * (1 - np.abs(y / f - c))).reshape(shape)
+        return jnp.asarray(w.astype(np.float32), dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference:
+    python/paddle/nn/initializer/dirac.py:179 — per group i, channel j,
+    weight[j+i*out_per_group, j, center...] = 1, everything else 0)."""
+
+    def __init__(self, groups=1, name=None):
+        if not (isinstance(groups, int) and groups > 0):
+            raise AssertionError(" 'groups' must be a positive integer. ")
+        self._groups = groups
+
+    def __call__(self, shape, dtype):
+        if not 3 <= len(shape) <= 5:
+            raise ValueError("Only tensors with 3/4/5 dimensions are supported.")
+        if shape[0] % self._groups != 0:
+            raise AssertionError("Tensor 0-dimension must be divisible by groups")
+        w = np.zeros(shape, dtype=np.float32)
+        num_per_group = shape[0] // self._groups
+        min_shape = min(num_per_group, shape[1])
+        center = tuple(s // 2 for s in shape[2:])
+        for i in range(self._groups):
+            for j in range(min_shape):
+                w[(j + i * num_per_group, j) + center] = 1.0
+        return jnp.asarray(w, dtype)
+
+
+# global default initializers consulted by Layer.create_parameter when a
+# param/bias attr does not carry its own (reference:
+# python/paddle/base/initializer.py:46 — attr-level initializers win)
+_global_weight_init: Initializer | None = None
+_global_bias_init: Initializer | None = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
 
 
 # lowercase aliases matching paddle.nn.initializer usage in configs

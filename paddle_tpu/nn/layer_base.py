@@ -125,7 +125,14 @@ class Layer:
         if attr is None:
             return None
         dtype = dtypes.convert_dtype(dtype) if dtype is not None else self._dtype
-        init = attr.initializer or default_initializer
+        # precedence (reference: python/paddle/base/initializer.py:46): an
+        # initializer set via ParamAttr wins; the global initializer beats
+        # the layer's built-in default
+        init = attr.initializer
+        if init is None:
+            init = I._global_bias_init if is_bias else I._global_weight_init
+        if init is None:
+            init = default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         value = init(tuple(int(s) for s in shape), dtype)

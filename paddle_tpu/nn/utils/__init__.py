@@ -1,6 +1,22 @@
-"""nn.utils (reference: python/paddle/nn/utils/)."""
+"""nn.utils (reference: python/paddle/nn/utils/ — weight_norm_hook.py,
+spectral_norm_hook.py, clip_grad_value_.py, transform_parameters.py)."""
 
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Parameter, Tensor, _unwrap
 from ..clip import clip_grad_norm_  # noqa: F401
+
+__all__ = [
+    "weight_norm",
+    "remove_weight_norm",
+    "spectral_norm",
+    "clip_grad_norm_",
+    "clip_grad_value_",
+    "parameters_to_vector",
+    "vector_to_parameters",
+]
 
 
 def parameters_to_vector(parameters, name=None):
@@ -11,10 +27,6 @@ def parameters_to_vector(parameters, name=None):
 
 def vector_to_parameters(vec, parameters, name=None):
     offset = 0
-    import jax.numpy as jnp
-
-    from ...core.tensor import _unwrap
-
     v = _unwrap(vec)
     for p in parameters:
         n = p.size
@@ -22,9 +34,159 @@ def vector_to_parameters(vec, parameters, name=None):
         offset += n
 
 
+def clip_grad_value_(parameters, clip_value):
+    """Clamp every parameter's gradient to [-clip_value, clip_value] in place
+    (reference: python/paddle/nn/utils/clip_grad_value_.py)."""
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    clip_value = float(clip_value)
+    for p in params:
+        if p._grad is not None:
+            p._grad = jnp.clip(p._grad, -clip_value, clip_value)
+
+
+def _norm_except_dim(w, dim):
+    """L2 norm reduced over every axis except ``dim`` (paddle's
+    norm_except_dim); ``dim=None`` reduces everything to a scalar."""
+    w = w.astype(jnp.float32)
+    if dim is None:
+        return jnp.sqrt(jnp.sum(w * w))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes))
+
+
+def _wn_broadcast(vec, ndim, dim):
+    if dim is None:
+        return vec
+    shape = [1] * ndim
+    shape[dim] = -1
+    return jnp.reshape(vec, shape)
+
+
+def _compute_weight_norm(g, v, dim):
+    """g * v / ||v||, recorded through apply_op so eager backward reaches
+    the g/v parameters (they are the only trainables after weight_norm)."""
+    from ...core.tensor import apply_op
+
+    out_dtype = _unwrap(v).dtype
+
+    def fn(gv, vv):
+        vv32 = vv.astype(jnp.float32)
+        norm = _wn_broadcast(_norm_except_dim(vv32, dim), vv32.ndim, dim)
+        w = _wn_broadcast(gv.astype(jnp.float32), vv32.ndim, dim) * vv32 \
+            / jnp.maximum(norm, 1e-12)
+        return w.astype(out_dtype)
+
+    return apply_op("weight_norm_recompute", fn, [g, v])
+
+
 def weight_norm(layer, name="weight", dim=0):
-    return layer  # placeholder: spectral/weight norm reparameterization
+    """Reparameterize ``layer.<name>`` as g * v / ||v|| (reference:
+    python/paddle/nn/utils/weight_norm_hook.py).  Adds trainable
+    ``<name>_g`` / ``<name>_v`` and recomputes the weight in a
+    forward-pre-hook, so optimizer steps on g/v flow into the layer."""
+    if hasattr(layer, f"_{name}_wn_hook"):
+        raise ValueError(f"weight_norm already applied to parameter {name}")
+    w = getattr(layer, name)
+    wv = _unwrap(w)
+    g = Parameter(_norm_except_dim(wv, dim).astype(wv.dtype), name=f"{name}_g")
+    v = Parameter(wv, name=f"{name}_v")
+    del layer._parameters[name]
+    layer.add_parameter(f"{name}_g", g)
+    layer.add_parameter(f"{name}_v", v)
+
+    def hook(lyr, inputs):
+        computed = _compute_weight_norm(
+            lyr._parameters[f"{name}_g"], lyr._parameters[f"{name}_v"], dim)
+        object.__setattr__(lyr, name, computed)
+        return None
+
+    hook(layer, None)  # materialize immediately so eager access works
+    handle = layer.register_forward_pre_hook(hook)
+    object.__setattr__(layer, f"_{name}_wn_hook", (handle, dim))
+    return layer
 
 
 def remove_weight_norm(layer, name="weight"):
+    """Fold g/v back into a single ``<name>`` parameter and drop the hook."""
+    state = getattr(layer, f"_{name}_wn_hook", None)
+    if state is None:
+        raise ValueError(f"weight_norm not applied to parameter {name}")
+    handle, dim = state
+    handle.remove()
+    w = _unwrap(_compute_weight_norm(layer._parameters[f"{name}_g"],
+                                     layer._parameters[f"{name}_v"], dim))
+    del layer._parameters[f"{name}_g"]
+    del layer._parameters[f"{name}_v"]
+    object.__delattr__(layer, f"_{name}_wn_hook")
+    if name in layer.__dict__:
+        object.__delattr__(layer, name)
+    layer.add_parameter(name, Parameter(w, name=name))
     return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=0):
+    """Divide ``layer.<name>`` by its largest singular value, estimated with
+    power iteration (reference: python/paddle/nn/utils/spectral_norm_hook.py).
+    The u/v iteration vectors live in non-persistable buffers and advance one
+    step per forward while the layer is training."""
+    if hasattr(layer, f"_{name}_sn_hook"):
+        raise ValueError(f"spectral_norm already applied to parameter {name}")
+    w = getattr(layer, name)
+    wv = _unwrap(w)
+    if wv.ndim < 2:
+        raise ValueError("spectral_norm expects a weight with ndim >= 2")
+    import jax
+
+    from ...core import rng
+    from ...core.tensor import apply_op
+
+    mat0 = jnp.moveaxis(wv.astype(jnp.float32), dim, 0).reshape(wv.shape[dim], -1)
+    h, wdim = mat0.shape
+    u0 = jax.random.normal(rng.next_key(), (h,), jnp.float32)
+    v0 = jax.random.normal(rng.next_key(), (wdim,), jnp.float32)
+    orig = Parameter(wv, name=f"{name}_orig")
+    del layer._parameters[name]
+    layer.add_parameter(f"{name}_orig", orig)
+    layer.register_buffer(f"{name}_u", Tensor(u0 / jnp.linalg.norm(u0)),
+                          persistable=False)
+    layer.register_buffer(f"{name}_v", Tensor(v0 / jnp.linalg.norm(v0)),
+                          persistable=False)
+
+    def hook(lyr, inputs):
+        # power iteration on detached values (the reference also detaches
+        # u/v); only the final w/sigma division is recorded on the tape so
+        # backward reaches weight_orig
+        wcur = _unwrap(lyr._parameters[f"{name}_orig"])
+        mat = jnp.moveaxis(wcur.astype(jnp.float32), dim, 0).reshape(wcur.shape[dim], -1)
+        u = _unwrap(lyr._buffers[f"{name}_u"])
+        v = _unwrap(lyr._buffers[f"{name}_v"])
+        iters = n_power_iterations if getattr(lyr, "training", True) else 0
+        for _ in range(max(iters, 0)):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        lyr._buffers[f"{name}_u"] = Tensor(u)
+        lyr._buffers[f"{name}_v"] = Tensor(v)
+
+        def fn(worig, uu, vv):
+            m = jnp.moveaxis(worig.astype(jnp.float32), dim, 0
+                             ).reshape(worig.shape[dim], -1)
+            sigma = uu @ (m @ vv)
+            return (worig.astype(jnp.float32)
+                    / jnp.maximum(sigma, eps)).astype(worig.dtype)
+
+        computed = apply_op("spectral_norm_recompute", fn,
+                            [lyr._parameters[f"{name}_orig"],
+                             Tensor(u), Tensor(v)])
+        object.__setattr__(lyr, name, computed)
+        return None
+
+    hook(layer, None)
+    handle = layer.register_forward_pre_hook(hook)
+    object.__setattr__(layer, f"_{name}_sn_hook", (handle, dim))
+    return layer
+
+
+def weight_norm_except_dim(w, dim=None):  # parity helper used by some configs
+    return Tensor(_norm_except_dim(_unwrap(w), dim))

@@ -300,6 +300,7 @@ def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
     """linalg.py vector_norm: p-norm over ``axis`` (flattened if None)."""
     def fn(v):
         ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        ndim = v.ndim
         if ax is None:
             v = v.reshape(-1)
             ax2 = None
@@ -307,12 +308,20 @@ def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
             ax2 = ax
         pf = float(p)
         if pf == float("inf"):
-            return jnp.abs(v).max(axis=ax2, keepdims=keepdim)
-        if pf == float("-inf"):
-            return jnp.abs(v).min(axis=ax2, keepdims=keepdim)
-        if pf == 0:
-            return (v != 0).astype(v.dtype).sum(axis=ax2, keepdims=keepdim)
-        return (jnp.abs(v) ** pf).sum(axis=ax2, keepdims=keepdim) ** (1.0 / pf)
+            out = jnp.abs(v).max(axis=ax2, keepdims=keepdim and ax is not None)
+        elif pf == float("-inf"):
+            out = jnp.abs(v).min(axis=ax2, keepdims=keepdim and ax is not None)
+        elif pf == 0:
+            out = (v != 0).astype(v.dtype).sum(
+                axis=ax2, keepdims=keepdim and ax is not None)
+        else:
+            out = (jnp.abs(v) ** pf).sum(
+                axis=ax2, keepdims=keepdim and ax is not None) ** (1.0 / pf)
+        if keepdim and ax is None:  # axis=None reduced a flattened view —
+            # restore an all-ones shape of the input's rank (torch/paddle
+            # keepdim contract)
+            out = out.reshape((1,) * ndim)
+        return out
 
     return apply_op("vector_norm", fn, [x])
 

@@ -19,7 +19,7 @@ from ..nn.layer_base import Layer
 
 __all__ = [
     "QuantConfig", "QAT", "PTQ", "quanter",
-    "AbsmaxObserver", "HistObserver", "KLObserver",
+    "AbsmaxObserver", "HistObserver", "KLObserver", "BaseQuanter",
     "FakeQuanterWithAbsMaxObserver", "QuantizedLinear", "fake_quant",
 ]
 
@@ -114,7 +114,25 @@ class KLObserver(HistObserver):
 
 # ---- quanters (reference quantization/quanter/) ---------------------------
 
-class FakeQuanterWithAbsMaxObserver(Layer):
+class BaseQuanter(Layer):
+    """Abstract quanter contract (reference: quantization/base_quanter.py —
+    scales/zero_points/quant_axis/bit_length define how a tensor maps onto
+    the integer grid)."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return 8
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
     """QAT fake-quant node with a moving-average abs-max scale
     (reference quanter/abs_max.py FakeQuanterWithAbsMaxObserverLayer)."""
 
@@ -136,6 +154,15 @@ class FakeQuanterWithAbsMaxObserver(Layer):
 
     def scale(self):
         return self._scale
+
+    def scales(self):
+        return self._scale
+
+    def zero_points(self):
+        return None  # symmetric
+
+    def bit_length(self):
+        return self.bits
 
 
 def quanter(name):

@@ -222,9 +222,9 @@ def multiply(x, y, name=None):
     # row-major strides: strides[i] = prod(shape[i+1:]), last stride 1
     strides = jnp.asarray(
         np.append(np.cumprod(np.asarray(a.shape[1:])[::-1])[::-1], 1)
-        if len(a.shape) > 1 else [1], jnp.int32)
-    ka = (a.indices.astype(jnp.int32) * strides).sum(-1)
-    kb = (b.indices.astype(jnp.int32) * strides).sum(-1)
+        if len(a.shape) > 1 else [1], jnp.int64)
+    ka = (a.indices.astype(jnp.int64) * strides).sum(-1)
+    kb = (b.indices.astype(jnp.int64) * strides).sum(-1)
     order = jnp.argsort(kb)
     kb_sorted = kb[order]
     pos = jnp.searchsorted(kb_sorted, ka)
@@ -327,8 +327,8 @@ def reshape(x, shape, name=None):
         known = int(np.prod([s for s in new_shape if s != -1]))
         new_shape[neg[0]] = total // known
     strides_old = jnp.asarray(
-        np.append(np.cumprod(old_shape[1:][::-1])[::-1], 1), jnp.int32)
-    flat = (coo.indices.astype(jnp.int32) * strides_old).sum(-1)
+        np.append(np.cumprod(old_shape[1:][::-1])[::-1], 1), jnp.int64)
+    flat = (coo.indices.astype(jnp.int64) * strides_old).sum(-1)
     strides_new = np.append(
         np.cumprod(np.asarray(new_shape[1:], np.int64)[::-1])[::-1], 1)
     new_idx = jnp.stack(
@@ -350,7 +350,9 @@ def slice(x, axes, starts, ends, name=None):
     for ax, st, en in zip(axes, starts, ends):
         ax = int(ax) % len(shape)
         st = int(st) if st >= 0 else int(st) + shape[ax]
+        st = min(max(st, 0), shape[ax])  # clamp into [0, dim] like dense slice
         en = min(int(en) if en >= 0 else int(en) + shape[ax], shape[ax])
+        en = max(en, st)
         keep &= (idx[:, ax] >= st) & (idx[:, ax] < en)
         shift[ax] = st
         shape[ax] = en - st
@@ -383,9 +385,9 @@ def divide(x, y, name=None):
     a, b = _as_coo(x)._bcoo.sum_duplicates(), _as_coo(y)._bcoo.sum_duplicates()
     strides = jnp.asarray(
         np.append(np.cumprod(np.asarray(a.shape[1:])[::-1])[::-1], 1)
-        if len(a.shape) > 1 else [1], jnp.int32)
-    ka = (a.indices.astype(jnp.int32) * strides).sum(-1)
-    kb = (b.indices.astype(jnp.int32) * strides).sum(-1)
+        if len(a.shape) > 1 else [1], jnp.int64)
+    ka = (a.indices.astype(jnp.int64) * strides).sum(-1)
+    kb = (b.indices.astype(jnp.int64) * strides).sum(-1)
     order = jnp.argsort(kb)
     kb_sorted = kb[order]
     pos = jnp.clip(jnp.searchsorted(kb_sorted, ka), 0, kb_sorted.shape[0] - 1)

@@ -13,8 +13,41 @@ from . import dlpack  # noqa: F401
 from . import download  # noqa: F401
 from . import unique_name  # noqa: F401
 
-__all__ = ["deprecated", "try_import", "run_check", "dlpack", "download",
-           "unique_name"]
+__all__ = ["deprecated", "try_import", "run_check", "require_version",
+           "dlpack", "download", "unique_name"]
+
+
+def require_version(min_version: str, max_version: str | None = None) -> None:
+    """Raise unless the installed framework version is within
+    [min_version, max_version] (reference: base/framework.py:573)."""
+    if not isinstance(min_version, str):
+        raise TypeError(f"min_version must be str, but received type of "
+                        f"min_version: {type(min_version)}")
+    if not isinstance(max_version, (str, type(None))):
+        raise TypeError(f"max_version must be str or type(None), but received "
+                        f"type of max_version: {type(max_version)}")
+    import re
+
+    fmt = re.compile(r"\d+(\.\d+){0,3}")
+    for label, v in (("min_version", min_version), ("max_version", max_version)):
+        if v is not None and fmt.fullmatch(v) is None:
+            raise ValueError(f"{label} should be like '1.5.2.0', but received "
+                             f"{v!r}")
+
+    from .. import __version__
+
+    def key(v):
+        parts = [int(x) for x in v.split(".")]
+        return parts + [0] * (4 - len(parts))
+
+    installed = key(__version__.split("+")[0].split("rc")[0] or "0")
+    if installed < key(min_version) or (
+            max_version is not None and installed > key(max_version)):
+        bound = (f"in [{min_version}, {max_version}]" if max_version
+                 else f">= {min_version}")
+        raise Exception(  # noqa: TRY002 — reference raises bare Exception
+            f"VersionError: installed version {__version__} does not satisfy "
+            f"the requirement {bound}")
 
 
 def deprecated(update_to: str = "", since: str = "", reason: str = "",

@@ -54,6 +54,8 @@ __all__ = [
     "RoIAlign",
     "nms",
     "matrix_nms",
+    "read_file",
+    "decode_jpeg",
 ]
 
 
@@ -956,3 +958,38 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     if rois_num is not None:
         return multi_rois, restore_t, nums_per_level
     return multi_rois, restore_t
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a 1-D uint8 Tensor (reference:
+    python/paddle/vision/ops.py read_file — a host IO op there too)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor(data, stop_gradient=True)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte Tensor to uint8 [C, H, W] (reference:
+    python/paddle/vision/ops.py decode_jpeg — nvjpeg there; a host decode
+    here, since image IO feeds the input pipeline, not the TPU graph)."""
+    import io as _io
+
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover - PIL is in the image
+        raise RuntimeError("decode_jpeg requires Pillow") from e
+
+    raw = np.asarray(_unwrap(x), dtype=np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    elif mode != "unchanged":
+        raise ValueError(f"unsupported decode_jpeg mode: {mode!r}")
+    arr = np.asarray(img, dtype=np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]  # [1, H, W]
+    else:
+        arr = np.transpose(arr, (2, 0, 1))  # [C, H, W]
+    return Tensor(arr, stop_gradient=True)

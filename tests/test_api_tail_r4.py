@@ -1,0 +1,451 @@
+"""Round-4 API-tail parity: flash-attn functional family, nn.utils
+reparameterizations, initializer tail, jit TranslatedLayer, autograd
+saved_tensors_hooks, misc namespace names (reference files cited per test)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def _dense_ref(q, k, v, mask=None, causal=False):
+    """numpy oracle: [B,S,H,D] paddle layout, bool mask [.., Sq, Sk]."""
+    qh = np.swapaxes(q, 1, 2).astype(np.float64)
+    kh = np.swapaxes(k, 1, 2).astype(np.float64)
+    vh = np.swapaxes(v, 1, 2).astype(np.float64)
+    rep = qh.shape[1] // kh.shape[1]
+    kh = np.repeat(kh, rep, axis=1)
+    vh = np.repeat(vh, rep, axis=1)
+    logits = np.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(q.shape[-1])
+    sq, sk = logits.shape[-2:]
+    if causal:
+        logits = np.where(np.tril(np.ones((sq, sk), bool)), logits, -np.inf)
+    if mask is not None:
+        logits = np.where(mask, logits, -np.inf)
+    m = logits.max(-1, keepdims=True)
+    m = np.where(np.isfinite(m), m, 0.0)
+    e = np.exp(logits - m)
+    p = e / np.maximum(e.sum(-1, keepdims=True), 1e-30)
+    return np.swapaxes(np.einsum("bhqk,bhkd->bhqd", p, vh), 1, 2)
+
+
+@pytest.fixture
+def qkv_gqa():
+    r = np.random.default_rng(7)
+    B, S, H, NKV, D = 2, 8, 4, 2, 16
+    q = r.standard_normal((B, S, H, D)).astype(np.float32)
+    k = r.standard_normal((B, S, NKV, D)).astype(np.float32)
+    v = r.standard_normal((B, S, NKV, D)).astype(np.float32)
+    return q, k, v
+
+
+class TestFlashFamily:
+    def test_flash_attention_matches_oracle(self, qkv_gqa):
+        q, k, v = qkv_gqa
+        out, sm = F.flash_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                    paddle.to_tensor(v), causal=True)
+        assert sm is None
+        np.testing.assert_allclose(out.numpy(), _dense_ref(q, k, v, causal=True),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_flash_attention_gqa_fast_path(self, qkv_gqa):
+        """The no-dropout path routes through sdpa, which must repeat KV
+        heads for GQA rather than erroring."""
+        q, k, v = qkv_gqa
+        out, _ = F.flash_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                   paddle.to_tensor(v), causal=False)
+        np.testing.assert_allclose(out.numpy(), _dense_ref(q, k, v),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_star_import_exports_flash_family(self):
+        import paddle_tpu.nn.functional as mod
+
+        for name in ("flash_attention", "flash_attn_unpadded", "sdp_kernel",
+                     "calc_reduced_attention_scores"):
+            assert name in mod.__all__
+
+    def test_qkvpacked(self, qkv_gqa):
+        q, k, v = qkv_gqa
+        B, S, H, D = q.shape
+        NKV = k.shape[2]
+        G = H // NKV
+        qkv = np.zeros((B, S, G + 2, NKV, D), np.float32)
+        qkv[:, :, :G] = q.reshape(B, S, G, NKV, D)
+        qkv[:, :, G] = k
+        qkv[:, :, G + 1] = v
+        out, _ = F.flash_attn_qkvpacked(paddle.to_tensor(qkv), causal=True)
+        np.testing.assert_allclose(out.numpy(), _dense_ref(q, k, v, causal=True),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_unpadded_confines_attention_to_sequences(self, qkv_gqa):
+        q, k, v = qkv_gqa
+        B, S, H, D = q.shape
+        NKV = k.shape[2]
+        cu = np.array([0, 5, 8], np.int32)
+        qp, kp, vp = (a.reshape(B * S, *a.shape[2:])[:8] for a in (q, k, v))
+        out, _ = F.flash_attn_unpadded(
+            paddle.to_tensor(qp), paddle.to_tensor(kp), paddle.to_tensor(vp),
+            paddle.to_tensor(cu), paddle.to_tensor(cu), 8, 8,
+            scale=1.0 / np.sqrt(D), causal=True)
+        # oracle: each sequence independently
+        for s in range(2):
+            lo, hi = cu[s], cu[s + 1]
+            ref = _dense_ref(qp[None, lo:hi], kp[None, lo:hi], vp[None, lo:hi],
+                             causal=True)[0]
+            np.testing.assert_allclose(out.numpy()[lo:hi], ref,
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_varlen_qkvpacked_padded_zeroes_padding(self):
+        r = np.random.default_rng(3)
+        B, MS, NKV, D = 2, 6, 2, 8
+        G = 2
+        qkv = r.standard_normal((B * MS, G + 2, NKV, D)).astype(np.float32)
+        lens = np.array([4, 6])
+        cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+        out, _ = F.flash_attn_varlen_qkvpacked(
+            paddle.to_tensor(qkv), paddle.to_tensor(cu), paddle.to_tensor(cu),
+            MS, MS, scale=1.0 / np.sqrt(D), causal=True, varlen_padded=True)
+        o = out.numpy().reshape(B, MS, G * NKV, D)
+        assert np.all(o[0, 4:] == 0)  # rows past seq length are zeroed
+        # valid region of seq 0 == standalone attention over its 4 tokens
+        q = qkv.reshape(B, MS, G + 2, NKV, D)[0:1, :4, :G].reshape(1, 4, G * NKV, D)
+        k = qkv.reshape(B, MS, G + 2, NKV, D)[0:1, :4, G]
+        v = qkv.reshape(B, MS, G + 2, NKV, D)[0:1, :4, G + 1]
+        np.testing.assert_allclose(o[0, :4], _dense_ref(q, k, v, causal=True)[0],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_flashmask_document_mask(self):
+        """Bidirectional doc mask: column j of doc [a,b) masks rows outside
+        [a,b) — LTS=b, UTE=a (flash_attention.py:1299 semantics)."""
+        r = np.random.default_rng(5)
+        B, S, H, D = 1, 8, 2, 8
+        q = r.standard_normal((B, S, H, D)).astype(np.float32)
+        docs = [(0, 3), (3, 8)]
+        idx = np.zeros((B, 1, S, 2), np.int32)
+        dense = np.zeros((S, S), bool)
+        for a, b in docs:
+            idx[0, 0, a:b, 0] = b
+            idx[0, 0, a:b, 1] = a
+            dense[a:b, a:b] = True
+        out = F.flashmask_attention(paddle.to_tensor(q), paddle.to_tensor(q),
+                                    paddle.to_tensor(q),
+                                    paddle.to_tensor(idx), causal=False)
+        ref = _dense_ref(q, q, q, mask=dense, causal=False)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_flashmask_causal_lt_start(self, qkv_gqa):
+        q, k, v = qkv_gqa
+        B, S = q.shape[:2]
+        # LTS = S everywhere → no extra masking beyond causal
+        idx = np.full((B, 1, S, 1), S, np.int32)
+        out = F.flashmask_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                    paddle.to_tensor(v),
+                                    paddle.to_tensor(idx), causal=True)
+        np.testing.assert_allclose(out.numpy(), _dense_ref(q, k, v, causal=True),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_causal_alignment_bottom_right_for_decode(self):
+        """flash-attn convention: with sq != sk, causal is bottom-right
+        aligned — a 1-token query against a 128-token cache attends ALL
+        keys, not just the first."""
+        r = np.random.default_rng(9)
+        q = r.standard_normal((1, 1, 2, 8)).astype(np.float32)
+        k = r.standard_normal((1, 16, 2, 8)).astype(np.float32)
+        v = r.standard_normal((1, 16, 2, 8)).astype(np.float32)
+        out, _ = F.flash_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                   paddle.to_tensor(v), causal=True)
+        ref = _dense_ref(q, k, v, causal=False)  # full attention == BR-causal
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_sparse_attention_csr(self):
+        """Full CSR == dense attention (sparse_attention.py:22)."""
+        r = np.random.default_rng(11)
+        B, H, S, D = 2, 2, 6, 8
+        x = r.standard_normal((B, H, S, D)).astype(np.float32)
+        off = np.broadcast_to(np.arange(S + 1, dtype=np.int32) * S,
+                              (B, H, S + 1)).copy()
+        cols = np.broadcast_to(np.tile(np.arange(S, dtype=np.int32), S),
+                               (B, H, S * S)).copy()
+        out = F.sparse_attention(paddle.to_tensor(x), paddle.to_tensor(x),
+                                 paddle.to_tensor(x), paddle.to_tensor(off),
+                                 paddle.to_tensor(cols))
+        logits = np.einsum("bhqd,bhkd->bhqk", x, x) / np.sqrt(D)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        ref = (e / e.sum(-1, keepdims=True)) @ x
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_log_loss(self):
+        x = np.array([[0.7], [0.3]], np.float32)
+        y = np.array([[1.0], [0.0]], np.float32)
+        out = F.log_loss(paddle.to_tensor(x), paddle.to_tensor(y), epsilon=1e-4)
+        ref = -y * np.log(x + 1e-4) - (1 - y) * np.log(1 - x + 1e-4)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+
+class TestNnUtils:
+    def test_weight_norm_roundtrip(self):
+        lin = nn.Linear(4, 3)
+        w0 = lin.weight.numpy().copy()
+        nn.utils.weight_norm(lin, dim=0)
+        assert "weight_g" in lin._parameters and "weight_v" in lin._parameters
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((2, 4)).astype(np.float32))
+        np.testing.assert_allclose(lin(x).numpy(),
+                                   x.numpy() @ w0 + lin.bias.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        # scaling g scales the effective weight
+        lin._parameters["weight_g"].set_value(
+            lin._parameters["weight_g"].numpy() * 2.0)
+        np.testing.assert_allclose(lin(x).numpy(),
+                                   x.numpy() @ (2 * w0) + lin.bias.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+        nn.utils.remove_weight_norm(lin)
+        assert "weight_g" not in lin._parameters
+        np.testing.assert_allclose(lin.weight.numpy(), 2 * w0, rtol=1e-5)
+
+    def test_weight_norm_eager_grads_reach_g_and_v(self):
+        """Backward must flow into weight_g/weight_v — they are the only
+        trainables after reparameterization."""
+        lin = nn.Linear(4, 3)
+        nn.utils.weight_norm(lin, dim=0)
+        x = paddle.to_tensor(np.random.default_rng(2)
+                             .standard_normal((2, 4)).astype(np.float32))
+        loss = lin(x).sum()
+        loss.backward()
+        g = lin._parameters["weight_g"]
+        v = lin._parameters["weight_v"]
+        assert g.grad is not None and float(np.abs(g.grad.numpy()).sum()) > 0
+        assert v.grad is not None and float(np.abs(v.grad.numpy()).sum()) > 0
+
+    def test_spectral_norm_eager_grads_reach_orig(self):
+        lin = nn.Linear(4, 3)
+        nn.utils.spectral_norm(lin, dim=0)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        lin(x).sum().backward()
+        w = lin._parameters["weight_orig"]
+        assert w.grad is not None and float(np.abs(w.grad.numpy()).sum()) > 0
+        with pytest.raises(ValueError, match="already applied"):
+            nn.utils.spectral_norm(lin, dim=0)
+
+    def test_weight_norm_double_application_guarded(self):
+        lin = nn.Linear(4, 3)
+        nn.utils.weight_norm(lin)
+        with pytest.raises(ValueError, match="already applied"):
+            nn.utils.weight_norm(lin)
+
+    def test_spectral_norm_divides_by_sigma(self):
+        lin = nn.Linear(5, 3)
+        w0 = lin.weight.numpy().copy()
+        nn.utils.spectral_norm(lin, dim=0, n_power_iterations=30)
+        sigma = np.linalg.svd(w0, compute_uv=False)[0]
+        np.testing.assert_allclose(lin.weight.numpy(), w0 / sigma,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_clip_grad_value_(self):
+        import jax.numpy as jnp
+
+        lin = nn.Linear(2, 2)
+        lin.weight._grad = jnp.full(lin.weight.shape, 3.0, jnp.float32)
+        lin.bias._grad = jnp.full(lin.bias.shape, -9.0, jnp.float32)
+        nn.utils.clip_grad_value_(lin.parameters(), 1.5)
+        assert float(np.max(np.asarray(lin.weight._grad))) == 1.5
+        assert float(np.min(np.asarray(lin.bias._grad))) == -1.5
+
+
+class TestInitializerTail:
+    def test_bilinear_matches_reference_formula(self):
+        """bilinear.py:116 flat-index formula (true-division y quirk incl.)."""
+        shape = (2, 1, 4, 4)
+        w = np.asarray(nn.initializer.Bilinear()(shape, "float32"))
+        size, f = 4, int(np.ceil(4 / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        ref = np.zeros(int(np.prod(shape)), np.float32)
+        for i in range(ref.size):
+            x = i % size
+            y = (i / size) % size
+            ref[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        np.testing.assert_allclose(w.ravel(), ref, rtol=1e-6)
+
+    def test_dirac_identity_conv(self):
+        import paddle_tpu.nn.functional as F2
+
+        conv = nn.Conv1D(3, 3, 3, padding=1,
+                         weight_attr=paddle.ParamAttr(
+                             initializer=nn.initializer.Dirac()),
+                         bias_attr=False)
+        x = paddle.to_tensor(np.random.default_rng(1)
+                             .standard_normal((1, 3, 7)).astype(np.float32))
+        np.testing.assert_allclose(conv(x).numpy(), x.numpy(), atol=1e-6)
+
+    def test_set_global_initializer_precedence(self):
+        nn.initializer.set_global_initializer(
+            nn.initializer.Constant(7.0), nn.initializer.Constant(2.0))
+        try:
+            lin = nn.Linear(2, 2)
+            assert np.all(lin.weight.numpy() == 7.0)
+            assert np.all(lin.bias.numpy() == 2.0)
+            # ParamAttr initializer wins over the global
+            lin2 = nn.Linear(2, 2, weight_attr=paddle.ParamAttr(
+                initializer=nn.initializer.Constant(1.0)))
+            assert np.all(lin2.weight.numpy() == 1.0)
+        finally:
+            nn.initializer.set_global_initializer(None)
+        lin3 = nn.Linear(8, 8)
+        assert not np.allclose(lin3.weight.numpy(), 7.0)
+
+
+class TestJitTail:
+    def test_translated_layer_roundtrip(self, tmp_path):
+        from paddle_tpu import jit, static
+
+        lin = nn.Linear(3, 2)
+        path = str(tmp_path / "m")
+        jit.save(lin, path, input_spec=[static.InputSpec([1, 3], "float32")])
+        tl = jit.load(path)
+        assert isinstance(tl, jit.TranslatedLayer)
+        x = paddle.to_tensor(np.ones((1, 3), np.float32))
+        np.testing.assert_allclose(tl(x).numpy(), lin(x).numpy(), rtol=1e-6)
+        assert "weight" in tl.state_dict()
+
+    def test_load_missing_path_raises(self, tmp_path):
+        from paddle_tpu import jit
+
+        with pytest.raises(FileNotFoundError):
+            jit.load(str(tmp_path / "nope"))
+
+    def test_enable_to_static_toggle(self):
+        from paddle_tpu import jit
+
+        jit.enable_to_static(False)
+        try:
+            f = jit.to_static(lambda t: t)
+            assert not isinstance(f, jit.StaticFunction)
+        finally:
+            jit.enable_to_static(True)
+        f2 = jit.to_static(lambda t: t)
+        assert isinstance(f2, jit.StaticFunction)
+        jit.set_verbosity(1)
+        jit.set_code_level(2)
+        jit.ignore_module([np])
+
+    def test_enable_to_static_consulted_per_call(self):
+        """Disabling AFTER decoration must fall back to eager (reference
+        ProgramTranslator semantics)."""
+        from paddle_tpu import jit
+
+        calls = []
+
+        @jit.to_static
+        def f(t):
+            calls.append(1)
+            return t * 2
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        jit.enable_to_static(False)
+        try:
+            out = f(x)
+            np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+            assert calls, "eager fallback should invoke the raw function"
+        finally:
+            jit.enable_to_static(True)
+
+    def test_vector_norm_keepdim_axis_none(self):
+        from paddle_tpu import linalg
+
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        out = linalg.vector_norm(x, 2, axis=None, keepdim=True)
+        assert tuple(out.shape) == (1, 1)
+        out2 = linalg.vector_norm(x, 2, axis=None, keepdim=False)
+        assert tuple(out2.shape) == ()
+
+    def test_sparse_slice_clamps_start(self):
+        from paddle_tpu import sparse
+
+        dense = np.zeros((3, 3), np.float32)
+        dense[0, 1] = 5.0
+        idx = np.array([[0], [1]])
+        sp = sparse.sparse_coo_tensor(idx, np.array([5.0], np.float32), (3, 3))
+        out = sparse.slice(sp, axes=[1], starts=[-10], ends=[2])
+        assert out.shape == (3, 2)
+        np.testing.assert_allclose(out.to_dense().numpy(), dense[:, :2])
+
+
+class TestAutogradHooks:
+    def test_saved_tensors_hooks_pack_unpack(self):
+        from paddle_tpu import autograd
+
+        events = []
+
+        def pack(t):
+            events.append("pack")
+            return np.asarray(t.numpy())
+
+        def unpack(o):
+            events.append("unpack")
+            return paddle.to_tensor(o)
+
+        class Sq(autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, a):
+                ctx.save_for_backward(a)
+                return a * a
+
+            @staticmethod
+            def backward(ctx, g):
+                (a,) = ctx.saved_tensor()
+                return g * 2.0 * a
+
+        a = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        with autograd.saved_tensors_hooks(pack, unpack):
+            out = Sq.apply(a)
+        out.backward()
+        assert events[0] == "pack" and "unpack" in events
+        np.testing.assert_allclose(a.grad.numpy(), [6.0])
+
+
+class TestMiscTail:
+    def test_subset_random_sampler(self):
+        from paddle_tpu.io import SubsetRandomSampler
+
+        s = SubsetRandomSampler([5, 6, 7])
+        assert sorted(s) == [5, 6, 7] and len(s) == 3
+        with pytest.raises(ValueError):
+            SubsetRandomSampler([])
+
+    def test_require_version(self):
+        from paddle_tpu import utils
+
+        utils.require_version("0.0.1")
+        with pytest.raises(Exception, match="VersionError"):
+            utils.require_version("99.0")
+        with pytest.raises(TypeError):
+            utils.require_version(1)
+
+    def test_vision_read_decode_jpeg(self, tmp_path):
+        from PIL import Image
+
+        from paddle_tpu.vision import ops
+
+        img = (np.random.default_rng(0).random((16, 20, 3)) * 255).astype("uint8")
+        p = str(tmp_path / "t.jpg")
+        Image.fromarray(img).save(p, format="JPEG")
+        raw = ops.read_file(p)
+        assert raw.dtype == "uint8" and raw.ndim == 1
+        out = ops.decode_jpeg(raw)
+        assert out.shape == (3, 16, 20)
+        gray = ops.decode_jpeg(raw, mode="gray")
+        assert gray.shape == (1, 16, 20)
+
+    def test_base_quanter(self):
+        from paddle_tpu import quantization as Q
+
+        fq = Q.FakeQuanterWithAbsMaxObserver(bits=8)
+        assert isinstance(fq, Q.BaseQuanter)
+        x = paddle.to_tensor(np.linspace(-1, 1, 16).astype(np.float32))
+        fq(x)
+        assert fq.bit_length() == 8
+        assert fq.scales() is not None and fq.zero_points() is None
