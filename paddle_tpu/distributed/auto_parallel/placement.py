@@ -49,8 +49,20 @@ class Replicate(Placement):
         return "Replicate()"
 
 
+class ReduceType:
+    """Pending-reduction kinds carried by Partial placements (reference:
+    paddle/phi/core/distributed/auto_parallel/dist_attr.h ReduceType)."""
+    kRedSum = "sum"
+    kRedMax = "max"
+    kRedMin = "min"
+    kRedProd = "prod"
+    kRedAvg = "avg"
+    kRedAny = "any"
+    kRedAll = "all"
+
+
 class Partial(Placement):
-    def __init__(self, reduce_type: str = "sum"):
+    def __init__(self, reduce_type: str = ReduceType.kRedSum):
         self.reduce_type = reduce_type
 
     def is_partial(self):

@@ -1,0 +1,89 @@
+"""dist.Strategy — parallelization/optimization config sections (reference:
+python/paddle/distributed/auto_parallel/api.py:1973 over strategy.py:191).
+
+Sections are plain attribute bags with the reference's defaults; consumers
+(static engine, fleet meta-optimizers) read them by name."""
+
+from __future__ import annotations
+
+import copy
+
+
+class _Section:
+    _defaults: dict = {}
+
+    def __init__(self, config: dict | None = None):
+        vals = copy.deepcopy(self._defaults)  # lists must not alias across
+        vals.update(config or {})             # Strategy instances
+        self.__dict__.update(vals)
+
+    def to_dict(self):
+        return {k: v for k, v in self.__dict__.items()}
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={v!r}" for k, v in self.__dict__.items())
+        return f"{type(self).__name__}({inner})"
+
+
+class ShardingConfig(_Section):
+    _defaults = {"enable": False, "stage": 1, "degree": -1}
+
+
+class FusedPassesConfig(_Section):
+    _defaults = {"enable": False, "fused_passes_list": []}
+
+
+class GradientMergeConfig(_Section):
+    _defaults = {"enable": False, "k_steps": 1, "avg": True}
+
+
+class PipelineConfig(_Section):
+    _defaults = {"enable": False, "schedule_mode": "1F1B",
+                 "micro_batch_size": 1, "accumulate_steps": 1, "vpp_degree": 1}
+
+
+class AMPConfig(_Section):
+    _defaults = {"enable": False, "dtype": "float16", "level": "O1",
+                 "init_loss_scaling": 32768.0, "custom_black_list": [],
+                 "custom_white_list": []}
+
+
+class RecomputeConfig(_Section):
+    _defaults = {"enable": False, "refined_ops_patterns": []}
+
+
+class MPOptimizationConfig(_Section):
+    _defaults = {"enable": False, "replace_with_parallel_cross_entropy": False}
+
+
+class Strategy:
+    """Configuration container: ``strategy.sharding.enable = True`` etc."""
+
+    _SECTIONS = {
+        "sharding": ShardingConfig,
+        "fused_passes": FusedPassesConfig,
+        "gradient_merge": GradientMergeConfig,
+        "pipeline": PipelineConfig,
+        "amp": AMPConfig,
+        "recompute": RecomputeConfig,
+        "mp_optimization": MPOptimizationConfig,
+    }
+
+    def __init__(self, config: dict | None = None):
+        if config is not None and not isinstance(config, dict):
+            raise ValueError(f"Expected a dictionary. But received: {config}")
+        self._config_dict = copy.deepcopy(config or {})
+        for name, cls in self._SECTIONS.items():
+            setattr(self, f"_{name}", cls(self._config_dict.get(name)))
+
+    def __getattr__(self, name):
+        if name in Strategy._SECTIONS:
+            return self.__dict__[f"_{name}"]
+        raise AttributeError(name)
+
+    def to_dict(self):
+        return {name: getattr(self, f"_{name}").to_dict()
+                for name in self._SECTIONS}
+
+    def __repr__(self):
+        return f"Strategy({self.to_dict()})"

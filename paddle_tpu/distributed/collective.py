@@ -60,6 +60,10 @@ __all__ = [
     "batch_isend_irecv",
     "wait",
     "stream",
+    "destroy_process_group",
+    "broadcast_object_list",
+    "scatter_object_list",
+    "split",
 ]
 
 
@@ -611,6 +615,102 @@ def wait(tensor, group=None, use_calc_stream=True):
     v = _unwrap(tensor)
     if not _is_traced(v):
         v.block_until_ready()
+
+
+def destroy_process_group(group=None):
+    """Drop one group (or all of them) from the registry (reference:
+    communication/group.py:171)."""
+    global _groups
+    if group is None:
+        _groups.clear()
+        _p2p_store_cache[0], _p2p_store_cache[1] = None, False
+    else:
+        _groups.pop(group.id, None)
+
+
+def _store_object_roundtrip(key_prefix, payload, src, group):
+    """Publish pickled bytes from src via the TCPStore; everyone else waits.
+    Returns the bytes."""
+    import pickle
+
+    me = _process_rank()
+    store = _p2p_store()
+    seq_key = (group.id, "obj", key_prefix)
+    seq = _p2p_seq.get(seq_key, 0)
+    _p2p_seq[seq_key] = seq + 1
+    key = f"obj/{group.id}/{key_prefix}/{seq}"
+    if me == src:
+        data = pickle.dumps(payload)
+        if store is not None:
+            store.set(key, data)
+        return data
+    if store is None:
+        raise RuntimeError(
+            "object collective: multi-process rendezvous store unavailable")
+    return bytes(store.wait(key, timeout=P2P_TIMEOUT))
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Broadcast picklable objects (reference: communication/broadcast.py:83).
+    On non-src ranks the list contents are REPLACED by the src's."""
+    import pickle
+
+    group = group or _default_group()
+    if _process_count() <= 1:
+        return  # single process: src's list is already everyone's list
+    data = _store_object_roundtrip("bcast", list(object_list), src, group)
+    if _process_rank() != src:
+        object_list[:] = pickle.loads(data)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    """Scatter one picklable object to each rank (reference:
+    communication/scatter.py:91)."""
+    import pickle
+
+    group = group or _default_group()
+    n = max(_process_count(), 1)
+    me = _process_rank()
+    if n <= 1:
+        out_object_list[:] = list(in_object_list or [])[:1]
+        return
+    data = _store_object_roundtrip("scatter", list(in_object_list or []),
+                                   src, group)
+    objs = pickle.loads(data) if me != src else list(in_object_list)
+    if len(objs) % n:
+        raise ValueError("scatter_object_list: len(in_object_list) must be "
+                         "divisible by world size")
+    per = len(objs) // n
+    out_object_list[:] = objs[me * per:(me + 1) * per]
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel linear/embedding with the weight split across ranks
+    (reference: fleet/layers/mpu/mp_ops.py:786).  Maps onto the mpu layers:
+    'linear' + axis=1 → ColumnParallelLinear, 'linear' + axis=0 →
+    RowParallelLinear, 'embedding' → VocabParallelEmbedding."""
+    from .fleet import mpu
+
+    if operation == "linear":
+        in_f, out_f = int(size[0]), int(size[1])
+        if axis == 1:
+            layer = mpu.ColumnParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False, gather_output=gather_out)
+        elif axis == 0:
+            layer = mpu.RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False, input_is_parallel=False)
+        else:
+            raise ValueError("split(linear) supports axis 0 or 1")
+    elif operation == "embedding":
+        layer = mpu.VocabParallelEmbedding(int(size[0]), int(size[1]),
+                                           weight_attr=weight_attr)
+    else:
+        raise ValueError(
+            f"split supports 'linear' or 'embedding', got {operation!r}")
+    return layer(x)
 
 
 class stream:

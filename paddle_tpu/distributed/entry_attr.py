@@ -1,0 +1,71 @@
+"""Sparse-embedding entry configs (reference:
+python/paddle/distributed/entry_attr.py — accessor rules for
+static.nn.sparse_embedding large-scale tables).
+
+The parameter-server runtime itself is out of scope (SURVEY §1 excludes the
+PS stack on TPU); these configs are kept as real, validated descriptors so
+recipes that construct them port unchanged, and sparse_embedding consumers
+can read `_to_attr()` exactly like the reference's accessor generator."""
+
+from __future__ import annotations
+
+__all__ = ["EntryAttr", "ProbabilityEntry", "CountFilterEntry",
+           "ShowClickEntry"]
+
+
+class EntryAttr:
+    def __init__(self) -> None:
+        self._name = None
+
+    def _to_attr(self) -> str:
+        raise NotImplementedError("EntryAttr is base class")
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._to_attr()!r})"
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit a new feature id into the table with probability p."""
+
+    def __init__(self, probability: float) -> None:
+        super().__init__()
+        if not isinstance(probability, float):
+            raise ValueError("probability must be a float in (0,1)")
+        if probability <= 0 or probability >= 1:
+            raise ValueError("probability must be a float in (0,1)")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _to_attr(self) -> str:
+        return ":".join([self._name, str(self._probability)])
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a feature id only after it has been seen `count` times."""
+
+    def __init__(self, count: int) -> None:
+        super().__init__()
+        if not isinstance(count, int):
+            raise ValueError("count must be a positive integer")
+        if count < 0:
+            raise ValueError("count must be a positive integer")
+        self._name = "count_filter_entry"
+        self._count = count
+
+    def _to_attr(self) -> str:
+        return ":".join([self._name, str(self._count)])
+
+
+class ShowClickEntry(EntryAttr):
+    """Score table rows by named show/click statistics."""
+
+    def __init__(self, show_name: str, click_name: str) -> None:
+        super().__init__()
+        if not isinstance(show_name, str) or not isinstance(click_name, str):
+            raise ValueError("show_name click_name must be a str")
+        self._name = "show_click_entry"
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self) -> str:
+        return ":".join([self._name, self._show_name, self._click_name])

@@ -1,0 +1,159 @@
+"""File-backed training datasets (reference:
+python/paddle/distributed/fleet/dataset/dataset.py — InMemoryDataset /
+QueueDataset over the C++ MultiSlotDataFeed).
+
+TPU-native subset: the C++ feed pipeline (pipe_command workers + PS global
+shuffle) is replaced by host-side parsing into numpy batches that feed the
+jit path directly.  The MultiSlot text format is parsed exactly like the
+reference feed: per line, for each slot in `use_var` order,
+``<count> v1 ... v_count``.  ``pipe_command`` is honored by piping each file
+through the shell command before parsing (the reference semantics), with the
+default ``cat`` short-circuited."""
+
+from __future__ import annotations
+
+import subprocess
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+
+class DatasetBase:
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.use_var = []
+        self.pipe_command = "cat"
+        self.input_type = 0
+        self.filelist: list[str] = []
+        self._inited = False
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command="cat",
+             input_type=0, fs_name="", fs_ugi="", download_cmd="cat", **kwargs):
+        self.batch_size = int(batch_size)
+        self.thread_num = int(thread_num)
+        self.use_var = list(use_var or [])
+        self.pipe_command = pipe_command
+        self.input_type = input_type
+        self._inited = True
+        return self
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def _var_names(self):
+        return [getattr(v, "name", v) or f"slot_{i}"
+                for i, v in enumerate(self.use_var)]
+
+    def _var_dtypes(self):
+        out = []
+        for v in self.use_var:
+            d = str(getattr(v, "dtype", "float32"))
+            out.append(np.int64 if "int" in d else np.float32)
+        return out
+
+    def _read_lines(self, path):
+        if self.pipe_command and self.pipe_command != "cat":
+            proc = subprocess.run(self.pipe_command, shell=True,
+                                  stdin=open(path, "rb"),
+                                  capture_output=True, check=True)
+            return proc.stdout.decode().splitlines()
+        with open(path) as f:
+            return f.read().splitlines()
+
+    def _parse_line(self, line, slots=None):
+        """MultiSlot: `<count> v...` per slot, in use_var order."""
+        toks = line.split()
+        slots = slots or list(zip(self._var_names(), self._var_dtypes()))
+        sample, pos = {}, 0
+        for name, dt in slots:
+            if pos >= len(toks):
+                raise ValueError(f"malformed MultiSlot line (slot {name}): {line!r}")
+            n = int(toks[pos]); pos += 1
+            sample[name] = np.asarray(toks[pos:pos + n], dtype=dt)
+            pos += n
+        return sample
+
+    def _iter_samples(self):
+        # slot schema hoisted out of the per-line hot path
+        slots = list(zip(self._var_names(), self._var_dtypes()))
+        for path in self.filelist:
+            for line in self._read_lines(path):
+                if line.strip():
+                    yield self._parse_line(line, slots)
+
+    @staticmethod
+    def _collate(samples):
+        """Ragged slots (the reference's LoD case) are zero-padded to the
+        batch max — static shapes are what the TPU jit path wants."""
+        out = {}
+        for k in samples[0]:
+            arrs = [s[k] for s in samples]
+            if len({a.shape for a in arrs}) == 1:
+                out[k] = np.stack(arrs)
+            else:
+                m = max(a.shape[0] for a in arrs)
+                out[k] = np.stack([np.pad(a, (0, m - a.shape[0]))
+                                   for a in arrs])
+        return out
+
+    def _batches_from(self, it):
+        buf = []
+        for s in it:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield self._collate(buf)
+                buf = []
+        if buf:
+            yield self._collate(buf)
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-everything-then-shuffle dataset (dataset.py InMemoryDataset)."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory: list[dict] = []
+        self._loaded = False
+
+    def load_into_memory(self):
+        self._memory = list(self._iter_samples())
+        self._loaded = True
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def local_shuffle(self):
+        np.random.shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single-host: global == local (multi-host PS shuffle is excluded
+        # with the parameter-server stack, SURVEY §1)
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._memory = []
+        self._loaded = False
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._memory)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._memory)
+
+    def __iter__(self):
+        if not self._loaded:
+            raise RuntimeError("call load_into_memory() before iterating")
+        return self._batches_from(iter(self._memory))
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: parse lines on the fly, no memory residency
+    (dataset.py QueueDataset)."""
+
+    def __iter__(self):
+        return self._batches_from(self._iter_samples())

@@ -1,0 +1,91 @@
+"""Distributed persistence helpers (reference:
+python/paddle/distributed/io.py — save/load of a static Program's
+persistable variables, plus distributed inference-model loading).
+
+Persistables of a recorded ``static.Program`` are the Parameter objects the
+program captured by reference (const op inputs); they are saved one numpy
+file per variable (filename=None) or a single pickle (filename given),
+matching the reference's layout contract."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Parameter, _unwrap
+
+__all__ = ["is_persistable", "save_persistables", "load_persistables",
+           "load_inference_model_distributed"]
+
+
+def is_persistable(var) -> bool:
+    """reference io.py:352 — feed/fetch vars excluded."""
+    name = getattr(var, "name", "") or ""
+    if name in ("feed", "fetch"):
+        return False
+    return bool(getattr(var, "persistable", False))
+
+
+def _program_persistables(program):
+    from .. import static
+
+    program = program or static.default_main_program()
+    seen, out = set(), []
+    for op in program.ops:
+        for kind, payload in op.inputs:
+            if kind == "const" and isinstance(payload, Parameter) \
+                    and is_persistable(payload) and id(payload) not in seen:
+                seen.add(id(payload))
+                out.append(payload)
+    return out
+
+
+def _var_filename(p, i):
+    return p.name or f"param_{i}"
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference io.py:387."""
+    params = _program_persistables(main_program)
+    os.makedirs(dirname, exist_ok=True)
+    if filename:
+        blob = {_var_filename(p, i): np.asarray(_unwrap(p))
+                for i, p in enumerate(params)}
+        with open(os.path.join(dirname, filename), "wb") as f:
+            pickle.dump(blob, f, protocol=4)
+    else:
+        for i, p in enumerate(params):
+            np.save(os.path.join(dirname, _var_filename(p, i) + ".npy"),
+                    np.asarray(_unwrap(p)))
+    return params
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """reference io.py:127 — values are restored INTO the program's
+    Parameter objects."""
+    params = _program_persistables(main_program)
+    if filename:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            blob = pickle.load(f)
+        for i, p in enumerate(params):
+            key = _var_filename(p, i)
+            if key in blob:
+                p.set_value(blob[key])
+    else:
+        for i, p in enumerate(params):
+            path = os.path.join(dirname, _var_filename(p, i) + ".npy")
+            if os.path.exists(path):
+                p.set_value(np.load(path))
+    return params
+
+
+def load_inference_model_distributed(dirname, executor, model_filename=None,
+                                     params_filename=None):
+    """reference io.py:459 — delegates to the deployable-artifact loader
+    (jax.export StableHLO + pickled weights)."""
+    from ..inference import load_inference_model
+
+    prefix = os.path.join(dirname, (model_filename or "model").removesuffix(".pdmodel"))
+    return load_inference_model(prefix)

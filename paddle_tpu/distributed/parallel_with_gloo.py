@@ -1,0 +1,55 @@
+"""CPU-side rendezvous group (reference:
+python/paddle/distributed/parallel_with_gloo.py — a gloo group for pure-CPU
+coordination).  The TPU-native equivalent is the TCPStore: barrier is a
+counter rendezvous keyed per round, init/release manage the store client."""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["gloo_init_parallel_env", "gloo_barrier", "gloo_release"]
+
+_state: dict = {"store": None, "rank": 0, "world": 1, "round": 0}
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int,
+                           server_endpoint: str) -> None:
+    """Start (rank 0) or join the rendezvous store at ``server_endpoint``
+    ("host:port"); mirrors gloo_init_parallel_env(rank, nranks, ep)."""
+    from .store import TCPStore
+
+    host, port = server_endpoint.rsplit(":", 1)
+    _state["rank"], _state["world"] = int(rank_id), int(rank_num)
+    _state["store"] = TCPStore(host, int(port), is_master=(int(rank_id) == 0),
+                               world_size=int(rank_num))
+    _state["round"] = 0
+
+
+def gloo_barrier() -> None:
+    """Counter rendezvous: every rank increments this round's key, then waits
+    until the count reaches world size."""
+    store = _state["store"]
+    if store is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    world = _state["world"]
+    if world <= 1:
+        return
+    key = f"gloo/barrier/{_state['round']}"
+    _state["round"] += 1
+    store.add(key, 1)
+    deadline = time.time() + 300
+    while int(store.add(key, 0)) < world:
+        if time.time() > deadline:
+            raise RuntimeError(f"gloo_barrier timed out ({key})")
+        time.sleep(0.01)
+
+
+def gloo_release() -> None:
+    """Drop the store client (reference gloo_release tears the group down)."""
+    store = _state["store"]
+    if store is not None and hasattr(store, "close"):
+        try:
+            store.close()
+        except Exception:
+            pass
+    _state["store"] = None

@@ -1103,27 +1103,14 @@ def scaled_dot_product_attention(
     inputs = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
 
     def fn(q, k, v, *rest):
-        qh = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
-        kh = jnp.swapaxes(k, 1, 2)
-        vh = jnp.swapaxes(v, 1, 2)
-        if qh.shape[1] != kh.shape[1]:  # GQA: repeat kv heads to q heads
-            kh = jnp.repeat(kh, qh.shape[1] // kh.shape[1], axis=1)
-            vh = jnp.repeat(vh, qh.shape[1] // vh.shape[1], axis=1)
-        scale = 1.0 / _math.sqrt(qh.shape[-1])
-        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
-        if is_causal:
-            s_q, s_k = logits.shape[-2], logits.shape[-1]
-            causal = jnp.tril(jnp.ones((s_q, s_k), bool))
-            logits = jnp.where(causal, logits, -jnp.inf)
-        if rest:
-            m = rest[0]
-            if m.dtype == jnp.bool_:
-                logits = jnp.where(m, logits, -jnp.inf)
-            else:
-                logits = logits + m
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
-        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
-        return jnp.swapaxes(out, 1, 2)
+        # single shared core (flash_attention._dense_attention); sdpa keeps
+        # the torch/paddle TOP-LEFT causal alignment
+        from .flash_attention import _dense_attention
+
+        scale = 1.0 / _math.sqrt(q.shape[-1])
+        return _dense_attention(q, k, v, rest[0] if rest else None, is_causal,
+                                scale, dropout_p, training, False,
+                                causal_align="tl")[0]
 
     return apply_op("sdpa", fn, inputs)
 

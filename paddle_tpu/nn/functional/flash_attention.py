@@ -45,9 +45,13 @@ def _dropout_probs(probs, dropout, training):
 
 
 def _dense_attention(q, k, v, mask, causal, scale, dropout, training,
-                     return_softmax):
-    """Masked attention core on [B, S, H, D] (paddle layout).  ``mask`` is a
-    broadcastable boolean [B|1, H|1, Sq, Sk] where True = attend."""
+                     return_softmax, causal_align="br"):
+    """Masked attention core on [B, S, H, D] (paddle layout) — the single
+    implementation behind the flash family, scaled_dot_product_attention's
+    XLA path, and sparse_attention.  ``mask`` is broadcastable
+    [B|1, H|1, Sq, Sk]: boolean (True = attend) or additive float bias.
+    ``causal_align``: "br" = bottom-right (flash-attn convention for
+    sq != sk), "tl" = top-left (torch/paddle sdpa convention)."""
     qh = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
@@ -58,10 +62,14 @@ def _dense_attention(q, k, v, mask, causal, scale, dropout, training,
     logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
     if causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
-        tri = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        off = (sk - sq) if causal_align == "br" else 0
+        tri = jnp.tril(jnp.ones((sq, sk), bool), k=off)
         logits = jnp.where(tri, logits, -jnp.inf)
     if mask is not None:
-        logits = jnp.where(mask, logits, -jnp.inf)
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     # fully-masked rows produce NaN from softmax(-inf row); zero them like
     # the reference kernel does for padding queries

@@ -48,9 +48,10 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
         inputs.append(attn_mask)
 
     def fn(q, k, v, off, cols, *rest):
+        from .flash_attention import _dense_attention
+
         s = q.shape[2]
         mask = jax.vmap(jax.vmap(lambda o, c: _csr_to_mask(o, c, s)))(off, cols)
-        mask = mask[:, :, :, :]  # [B, H, S, S]
         i = 0
         if key_padding_mask is not None:
             kp = rest[i]; i += 1
@@ -58,10 +59,10 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
         if attn_mask is not None:
             mask &= (rest[i] != 0)[None, None, :, :]
         scale = 1.0 / _math.sqrt(q.shape[-1])
-        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-        logits = jnp.where(mask, logits, -jnp.inf)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        probs = jnp.nan_to_num(probs, nan=0.0).astype(q.dtype)
-        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        # shared core works on [B, S, H, D]; this op's contract is [B, H, S, D]
+        out = _dense_attention(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                               jnp.swapaxes(v, 1, 2), mask, False, scale,
+                               0.0, False, False)[0]
+        return jnp.swapaxes(out, 1, 2)
 
     return apply_op("sparse_attention", fn, inputs)

@@ -1,0 +1,289 @@
+"""paddle.distributed parallelize-plan API + misc distributed tail
+(reference: auto_parallel/intermediate/parallelize.py, entry_attr.py,
+fleet/dataset/dataset.py, distributed/io.py, parallel_with_gloo.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+
+
+@pytest.fixture
+def mesh8():
+    return dist.ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 8)
+
+    def forward(self, x):
+        return self.fc2(self.fc1(x))
+
+
+class TestParallelizePlans:
+    def test_colwise_rowwise_numerics_unchanged(self, mesh8):
+        model = MLP()
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((4, 8)).astype(np.float32))
+        ref = model(x).numpy()
+        model, _ = dist.parallelize(
+            model, mesh=mesh8,
+            config={"mp_config": {"parallelize_plan": {
+                "fc1": dist.ColWiseParallel(),
+                "fc2": dist.RowWiseParallel(),
+            }}})
+        out = model(x).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        # fc1 weight [in, out] shards out-features over mp; fc2 weight shards
+        # in-features
+        import jax
+
+        w1 = model.fc1.weight._value
+        assert "mp" in str(w1.sharding.spec)
+        assert model.fc1.weight.dist_attr is not None
+
+    def test_regex_and_param_keys(self, mesh8):
+        model = MLP()
+        model, _ = dist.parallelize(
+            model, mesh=mesh8,
+            config={"mp_config": {"parallelize_plan": {
+                r"fc\d": dist.ColWiseParallel(),
+            }}})
+        assert model.fc1.weight.dist_attr is not None
+        assert model.fc2.weight.dist_attr is not None
+
+        model2 = MLP()
+        model2, _ = dist.parallelize(
+            model2, mesh=mesh8,
+            config={"mp_config": {"parallelize_plan": {
+                "fc1.weight": dist.ColWiseParallel(),
+            }}})
+        assert model2.fc1.weight.dist_attr is not None
+        assert model2.fc1.bias.dist_attr is None if hasattr(
+            model2.fc1.bias, "dist_attr") else True
+
+    def test_gather_output_replicates(self, mesh8):
+        model = MLP()
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        ref = model(x).numpy()
+        model, _ = dist.parallelize(
+            model, mesh=mesh8,
+            config={"mp_config": {"parallelize_plan": {
+                "fc1": dist.ColWiseParallel(gather_output=True),
+                "fc2": dist.ColWiseParallel(gather_output=True),
+            }}})
+        np.testing.assert_allclose(model(x).numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_pp_and_dp_config(self, mesh8):
+        model = MLP()
+        opt = paddle.optimizer.AdamW(parameters=model.parameters())
+        model, opt = dist.parallelize(
+            model, optimizer=opt, mesh=mesh8,
+            config={"pp_config": {"split_spec": {"fc1": dist.SplitPoint.END}},
+                    "dp_config": {"sharding_level": 2}})
+        assert model._pp_split_spec == {"fc1": dist.SplitPoint.END}
+        assert opt is not None and hasattr(opt, "step")
+
+    def test_sequence_parallel_plans_numerics(self, mesh8):
+        emb = nn.Linear(8, 8)
+        x = paddle.to_tensor(np.random.default_rng(1)
+                             .standard_normal((2, 4, 8)).astype(np.float32))
+        ref = emb(x).numpy()
+        dist.SequenceParallelBegin().apply(emb, mesh8)
+        out = emb(x)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+        lyr = nn.Linear(8, 8)
+        ref2 = lyr(x).numpy()
+        dist.SequenceParallelDisable().apply(lyr, mesh8)
+        np.testing.assert_allclose(lyr(x).numpy(), ref2, rtol=1e-5, atol=1e-6)
+
+    def test_parallelize_requires_mesh(self):
+        with pytest.raises(ValueError, match="mesh"):
+            dist.parallelize(MLP(), mesh=None, config={})
+
+
+class TestDTensorTail:
+    def test_dtensor_from_fn(self, mesh8):
+        t = dist.dtensor_from_fn(paddle.ones, mesh8, [dist.Replicate()], [8])
+        assert tuple(t.shape) == (8,)
+        assert t.dist_attr is not None
+
+    def test_local_layer_roundtrip(self, mesh8):
+        class Double(dist.LocalLayer):
+            def forward(self, x):
+                return x * 2
+
+        lyr = Double([(mesh8, [dist.Replicate(), dist.Replicate()])])
+        x = dist.shard_tensor(
+            paddle.to_tensor(np.ones((4, 4), np.float32)), mesh8,
+            [dist.Replicate(), dist.Replicate()])
+        out = lyr(x)
+        np.testing.assert_allclose(out.numpy(), 2 * np.ones((4, 4)))
+        assert out.dist_attr is not None
+
+    def test_reduce_type_and_partial(self):
+        assert dist.ReduceType.kRedSum == "sum"
+        p = dist.Partial(dist.ReduceType.kRedMax)
+        assert p.is_partial() and p.reduce_type == "max"
+
+    def test_strategy_sections(self):
+        s = dist.Strategy()
+        assert s.sharding.enable is False
+        s.sharding.enable = True
+        s.sharding.stage = 2
+        s.pipeline.schedule_mode = "FThenB"
+        assert s.to_dict()["sharding"]["stage"] == 2
+        with pytest.raises(ValueError):
+            dist.Strategy("bad")
+
+    def test_shard_scaler_single_process(self):
+        from paddle_tpu import amp
+
+        scaler = amp.GradScaler(init_loss_scaling=2.0)
+        scaler = dist.shard_scaler(scaler)
+        lin = nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        loss = scaler.scale(lin(paddle.to_tensor(np.ones((1, 2), np.float32))).sum())
+        loss.backward()
+        scaler.step(opt)
+        assert scaler._found_inf is False
+
+    def test_sharding_stage_signature(self, mesh8):
+        st = dist.ShardingStage1("dp", mesh8)
+        assert st.sharding_mesh_dim == "dp" and st.mesh is mesh8
+        st2 = dist.ShardingStage3(mesh8)  # legacy single-arg form
+        assert st2.mesh is mesh8
+
+
+class TestToDistributed:
+    def test_to_distributed_dp(self):
+        from paddle_tpu import io
+
+        xs = np.random.default_rng(0).standard_normal((16, 8)).astype(np.float32)
+
+        class DS(io.Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return xs[i]
+
+        model = MLP()
+        opt = paddle.optimizer.AdamW(parameters=model.parameters())
+        loader = io.DataLoader(DS(), batch_size=8)
+        model, opt, dloader = dist.to_distributed(model, opt, loader,
+                                                  device_num=8)
+        batch = next(iter(dloader))
+        out = model(batch if isinstance(batch, paddle.Tensor) else batch[0])
+        assert out.shape[-1] == 8
+
+
+class TestPSCompatTail:
+    def test_entries(self):
+        e = dist.CountFilterEntry(10)
+        assert e._to_attr() == "count_filter_entry:10"
+        p = dist.ProbabilityEntry(0.1)
+        assert p._to_attr() == "probability_entry:0.1"
+        s = dist.ShowClickEntry("show", "click")
+        assert s._to_attr() == "show_click_entry:show:click"
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(2.0)
+        with pytest.raises(ValueError):
+            dist.CountFilterEntry(-1)
+
+    def test_in_memory_dataset(self, tmp_path):
+        # MultiSlot: two slots -> "<n> ids... <n> vals..."
+        f = tmp_path / "part-0"
+        f.write_text("2 3 4 1 0.5\n1 7 1 1.5\n3 1 2 3 1 2.5\n")
+        ds = dist.InMemoryDataset()
+        ids = type("V", (), {"name": "ids", "dtype": "int64"})()
+        val = type("V", (), {"name": "val", "dtype": "float32"})()
+        ds.init(batch_size=2, use_var=[ids, val])
+        ds.set_filelist([str(f)])
+        with pytest.raises(RuntimeError):
+            iter(ds)
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 3
+        ds.local_shuffle()
+        batches = list(ds)
+        assert len(batches) == 2
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+    def test_queue_dataset_streams(self, tmp_path):
+        f = tmp_path / "q-0"
+        f.write_text("1 5 1 1.0\n1 6 1 2.0\n")
+        ds = dist.QueueDataset()
+        v = type("V", (), {"name": "x", "dtype": "int64"})()
+        w = type("V", (), {"name": "y", "dtype": "float32"})()
+        ds.init(batch_size=2, use_var=[v, w])
+        ds.set_filelist([str(f)])
+        (b,) = list(ds)
+        np.testing.assert_array_equal(b["x"].ravel(), [5, 6])
+
+
+class TestMiscDistributed:
+    def test_object_collectives_single_process(self):
+        objs = [{"foo": [1, 2, 3]}]
+        dist.broadcast_object_list(objs, src=0)
+        assert objs == [{"foo": [1, 2, 3]}]
+        out = []
+        dist.scatter_object_list(out, [{"a": 1}], src=0)
+        assert out == [{"a": 1}]
+
+    def test_destroy_process_group(self):
+        g = dist.new_group([0])
+        dist.destroy_process_group(g)
+        dist.destroy_process_group()  # all — must not raise
+
+    def test_split_linear_single_rank(self):
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        out = dist.split(x, (8, 4), "linear", axis=1, num_partitions=1)
+        assert tuple(out.shape) == (2, 4)
+        out2 = dist.split(x, (8, 4), "linear", axis=0, num_partitions=1)
+        assert tuple(out2.shape) == (2, 4)
+        ids = paddle.to_tensor(np.array([[0, 1]], np.int64))
+        emb = dist.split(ids, (16, 4), "embedding", num_partitions=1)
+        assert tuple(emb.shape) == (1, 2, 4)
+        with pytest.raises(ValueError):
+            dist.split(x, (8, 4), "conv")
+
+    def test_distributed_io_roundtrip(self, tmp_path):
+        from paddle_tpu import static
+
+        prog = static.Program()
+        lin = nn.Linear(3, 2)
+        with static.program_guard(prog):
+            xin = static.data("x", [2, 3], "float32")
+            _ = lin(xin)
+        params = dist.io.save_persistables(None, str(tmp_path),
+                                           main_program=prog)
+        assert len(params) == 2  # weight + bias captured as persistables
+        orig = lin.weight.numpy().copy()
+        lin.weight.set_value(np.zeros_like(orig))
+        dist.io.load_persistables(None, str(tmp_path), main_program=prog)
+        np.testing.assert_allclose(lin.weight.numpy(), orig)
+        assert not dist.io.is_persistable(type("V", (), {"name": "feed",
+                                                         "persistable": True})())
+
+    def test_gloo_barrier_single_rank(self):
+        import socket
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        dist.gloo_init_parallel_env(0, 1, f"127.0.0.1:{port}")
+        dist.gloo_barrier()  # world=1: immediate
+        dist.gloo_release()
+        with pytest.raises(RuntimeError):
+            dist.gloo_barrier()
