@@ -222,15 +222,34 @@ def shard_scaler(scaler):
 
     def unscale_(optimizer):
         inner_unscale(optimizer)
-        from ..collective import ReduceOp, _process_count, all_reduce
+        from ..collective import _p2p_seq, _p2p_store, _process_count
 
-        if _process_count() <= 1:
+        world = _process_count()
+        if world <= 1:
             return  # local flag is already global
-        # multi-process: a failed reduce must NOT be swallowed — ranks would
-        # disagree on found_inf and silently diverge on optimizer.step
-        t = Tensor(jnp.asarray(float(scaler._found_inf), jnp.float32))
-        all_reduce(t, op=ReduceOp.MAX)
-        scaler._found_inf = bool(float(_unwrap(t)) > 0)
+        # multi-process: a host-side max-reduce of the flag through the
+        # rendezvous store (the eager tensor collectives use the stacked
+        # single-controller convention and don't exchange host scalars).
+        # A store failure must NOT be swallowed — ranks would disagree on
+        # found_inf and silently diverge on optimizer.step.
+        store = _p2p_store()
+        if store is None:
+            raise RuntimeError(
+                "shard_scaler: multi-process found_inf sync needs the "
+                "rendezvous store (master endpoint unset?)")
+        import time as _time
+
+        seq = _p2p_seq.get("scaler_sync", 0)
+        _p2p_seq["scaler_sync"] = seq + 1
+        key = f"scaler/{seq}"
+        store.add(key + "/flag", int(bool(scaler._found_inf)))
+        store.add(key + "/n", 1)
+        deadline = _time.time() + 60
+        while int(store.add(key + "/n", 0)) < world:
+            if _time.time() > deadline:
+                raise RuntimeError("shard_scaler: found_inf sync timed out")
+            _time.sleep(0.005)
+        scaler._found_inf = int(store.add(key + "/flag", 0)) > 0
 
     scaler.unscale_ = unscale_
     return scaler

@@ -15,8 +15,10 @@ import dataclasses
 
 import numpy as np
 
+import warnings
+
 from .api import shard_tensor
-from .placement import Replicate, Shard
+from .placement import Replicate
 from .process_mesh import ProcessMesh
 from .static_engine import shard_dataloader
 
@@ -36,6 +38,13 @@ def to_distributed(model, optimizer, dataloader, device_num, node_num=1,
     device_num = int(device_num)
     if device_num <= 0:
         raise ValueError("device_num must be positive")
+    if config is not None and getattr(config, "sequence_parallel", False):
+        # dropped requests must be loud: automatic SP selection needs the
+        # reference's graph pattern-matching; use parallelize() with
+        # SequenceParallel* plans for explicit SP
+        warnings.warn("to_distributed: sequence_parallel is not auto-applied "
+                      "on this backend; use dist.parallelize with "
+                      "SequenceParallelEnable plans", stacklevel=2)
     mesh = ProcessMesh(np.arange(device_num), dim_names=["dp"])
 
     # replicate parameters over the dp mesh (pure DP: grads psum via GSPMD)

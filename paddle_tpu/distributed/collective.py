@@ -635,18 +635,20 @@ def _store_object_roundtrip(key_prefix, payload, src, group):
 
     me = _process_rank()
     store = _p2p_store()
+    if store is None:
+        # every rank must fail together — a src that "succeeds" alone while
+        # receivers raise leaves the job half-past the collective
+        raise RuntimeError(
+            "object collective: multi-process rendezvous store unavailable "
+            "(master endpoint unset or unreachable)")
     seq_key = (group.id, "obj", key_prefix)
     seq = _p2p_seq.get(seq_key, 0)
     _p2p_seq[seq_key] = seq + 1
     key = f"obj/{group.id}/{key_prefix}/{seq}"
     if me == src:
         data = pickle.dumps(payload)
-        if store is not None:
-            store.set(key, data)
+        store.set(key, data)
         return data
-    if store is None:
-        raise RuntimeError(
-            "object collective: multi-process rendezvous store unavailable")
     return bytes(store.wait(key, timeout=P2P_TIMEOUT))
 
 

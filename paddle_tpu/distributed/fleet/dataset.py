@@ -55,9 +55,9 @@ class DatasetBase:
 
     def _read_lines(self, path):
         if self.pipe_command and self.pipe_command != "cat":
-            proc = subprocess.run(self.pipe_command, shell=True,
-                                  stdin=open(path, "rb"),
-                                  capture_output=True, check=True)
+            with open(path, "rb") as f:  # close promptly: one fd per file
+                proc = subprocess.run(self.pipe_command, shell=True, stdin=f,
+                                      capture_output=True, check=True)
             return proc.stdout.decode().splitlines()
         with open(path) as f:
             return f.read().splitlines()

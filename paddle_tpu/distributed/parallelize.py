@@ -276,10 +276,20 @@ def parallelize(model, optimizer=None, mesh=None, config=None):
 
     pp_cfg = config.get("pp_config") or {}
     if pp_cfg.get("split_spec"):
-        # consumed by the executing pipeline engines (fleet/pipeline.py);
-        # recorded as an annotation exactly like the reference's PipelineParallel
-        # wrapper records forward_keys
-        model._pp_split_spec = pp_cfg["split_spec"]
+        # recorded as a validated ANNOTATION: the executing engines
+        # (fleet/pipeline.py) take explicit per-stage functions, so the
+        # split request is carried on the model for the recipe layer to
+        # consume — validated here so a typo'd layer name fails loudly
+        spec = pp_cfg["split_spec"]
+        if isinstance(spec, dict):
+            known = {name for name, _ in model.named_sublayers()}
+            for lname in spec:
+                if not any(n == lname or n.startswith(lname + ".")
+                           for n in known):
+                    raise ValueError(
+                        f"pp_config split_spec names unknown layer {lname!r};"
+                        f" model layers: {sorted(known)[:10]}...")
+        model._pp_split_spec = spec
         model._pp_global_spec = pp_cfg.get("global_spec")
 
     dp_cfg = config.get("dp_config") or {}

@@ -27,7 +27,12 @@ from ..core import dtype as dtypes
 from ..core import tensor as _tensor_mod
 from ..core.tensor import Tensor
 
-__all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
+from .api_tail import *  # noqa: F401,F403,E402  (Variable, io, metrics, scopes…)
+from .api_tail import __all__ as _tail_all
+from . import nn  # noqa: F401,E402
+
+__all__ = _tail_all + ["nn"] + [
+    "InputSpec", "Program", "program_guard", "default_main_program",
            "default_startup_program", "name_scope", "data", "Executor",
            "OpDesc"]
 
@@ -49,6 +54,27 @@ class InputSpec:
     @classmethod
     def from_numpy(cls, ndarray, name=None):
         return cls(ndarray.shape, ndarray.dtype, name)
+
+
+import weakref as _weakref  # noqa: E402
+
+# weak registry of every Program, so APIs that take only a Tensor (e.g.
+# append_backward) can find the program that produced it, like the
+# reference's var.block.program back-pointer
+_all_programs: list = []
+
+
+def _program_of(tensor) -> "Program | None":
+    # prune dead refs while scanning so the registry stays bounded even for
+    # workloads creating many short-lived Programs
+    live = [r for r in _all_programs if r() is not None]
+    if len(live) != len(_all_programs):
+        _all_programs[:] = live
+    for ref in reversed(live):
+        p = ref()
+        if p is not None and id(tensor) in p._known:
+            return p
+    return None
 
 
 class OpDesc:
@@ -84,6 +110,7 @@ class Program:
         # strong refs to every produced/feed Tensor: ids key the graph, so a
         # GC'd-and-reused id would corrupt it
         self._keepalive: list = []
+        _all_programs.append(_weakref.ref(self))
 
     # -- introspection (reference Block API surface) --
     def global_block(self):

@@ -1,0 +1,568 @@
+"""Static-graph API tail (reference: python/paddle/static/__init__.py over
+base/framework.py, base/executor.py, static/io.py, static/nn/metric.py).
+
+The recorded ``Program`` (static/__init__.py) is the graph substrate; these
+helpers add the variable/scope/device surface, program serialization (via
+jax.export of the traceable replay — OpDesc fns are pure jnp), gradients,
+and the static metric ops."""
+
+from __future__ import annotations
+
+import contextlib
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Parameter, Tensor, _unwrap, apply_op
+
+__all__ = [
+    "Variable", "BuildStrategy", "CompiledProgram", "IpuCompiledProgram",
+    "IpuStrategy", "ipu_shard_guard", "set_ipu_shard", "WeightNormParamAttr",
+    "ExponentialMovingAverage", "Print", "py_func", "accuracy", "auc",
+    "ctr_metric_bundle", "append_backward", "gradients", "create_parameter",
+    "create_global_var", "cpu_places", "cuda_places", "xpu_places",
+    "device_guard", "Scope", "global_scope", "scope_guard", "save", "load",
+    "save_to_file", "load_from_file", "serialize_program",
+    "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "normalize_program", "load_program_state",
+    "set_program_state", "save_inference_model", "load_inference_model",
+]
+
+# the recorded graph carries eager Tensors as its variables; the reference's
+# Variable is the static-graph handle for the same role (base/framework.py)
+Variable = Tensor
+
+
+# ---------------------------------------------------------------------------
+# compiled-program / device-strategy shells
+# ---------------------------------------------------------------------------
+
+class BuildStrategy:
+    """Graph-build knobs (reference: BuildStrategy pybind surface).  XLA owns
+    fusion/scheduling, so the knobs are accepted and recorded."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.enable_addto = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_gemm_epilogue = False
+        self.memory_optimize = True
+        self.sequential_run = False
+        self.build_cinn_pass = False
+
+    def __repr__(self):
+        return f"BuildStrategy({self.__dict__})"
+
+
+class CompiledProgram:
+    """reference: base/compiler.py CompiledProgram — wraps a Program for the
+    executor; compilation here is XLA's job at replay-trace time."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_program"], name)
+
+
+class IpuStrategy:  # Graphcore backend has no TPU analog; loud on use
+    def __init__(self):
+        raise NotImplementedError(
+            "IPU (Graphcore) support is CUDA-era hardware plumbing with no "
+            "TPU analog; use the default XLA backend")
+
+
+class IpuCompiledProgram(IpuStrategy):
+    pass
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("IPU sharding has no TPU analog")
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError("IPU sharding has no TPU analog")
+
+
+class WeightNormParamAttr:
+    """ParamAttr requesting g·v/||v|| reparameterization (reference:
+    static WeightNormParamAttr); consumed by Layer.create_parameter through
+    nn.utils.weight_norm applied post-construction."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        from ..nn.layer_base import ParamAttr
+
+        self.dim = dim
+        self._attr = ParamAttr(name=name, initializer=initializer,
+                               learning_rate=learning_rate,
+                               regularizer=regularizer, trainable=trainable,
+                               need_clip=need_clip)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_attr"], name)
+
+
+# ---------------------------------------------------------------------------
+# EMA
+# ---------------------------------------------------------------------------
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference: static/ema.py).  update()
+    folds current param values into the shadow; apply()/restore() swap the
+    shadow in and out (the reference's temporary-variable dance)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._shadow: dict[int, jnp.ndarray] = {}
+        self._backup: dict[int, jnp.ndarray] = {}
+        self._params: list[Parameter] = []
+        self._step = 0
+
+    def _tracked(self, parameters=None):
+        if parameters is not None:
+            self._params = [p for p in parameters if p.trainable]
+        return self._params
+
+    def update(self, parameters=None):
+        params = self._tracked(parameters)
+        if not params:
+            raise ValueError("EMA.update: pass parameters= on first call")
+        self._step += 1
+        d = self._decay
+        for p in params:
+            v = _unwrap(p).astype(jnp.float32)
+            prev = self._shadow.get(id(p))
+            self._shadow[id(p)] = v if prev is None else d * prev + (1 - d) * v
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = _unwrap(p)
+            p._value = self._shadow[id(p)].astype(p.dtype)
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug-print a tensor and pass it through (reference: static/nn/
+    control_flow.py Print); uses jax.debug.print so it also fires under jit."""
+    msg = message or ""
+
+    def fn(v):
+        jax.debug.print(msg + " {}", v)
+        return v
+
+    return apply_op("print", fn, [input])
+
+
+def py_func(func, x, out=None, backward_func=None, skip_vars_in_backward_input=None):
+    """Run a host python function inside the program (reference:
+    static/nn/common.py py_func).  Eager-first design makes this direct; the
+    result re-enters the tape as a constant (non-differentiable unless
+    backward_func is provided via PyLayer)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    if backward_func is not None:
+        from ..autograd import PyLayer
+
+        class _PyFunc(PyLayer):
+            @staticmethod
+            def forward(ctx, *args):
+                ctx.save_for_backward(*args)
+                r = func(*args)
+                return r
+
+            @staticmethod
+            def backward(ctx, *grads):
+                return backward_func(*ctx.saved_tensor(), *grads)
+
+        return _PyFunc.apply(*xs)
+    res = func(*xs)
+    wrap = (lambda r: Tensor(jnp.asarray(_unwrap(r))) if r is not None else None)
+    if isinstance(res, (list, tuple)):
+        return type(res)(wrap(r) for r in res)
+    return wrap(res)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k batch accuracy (reference: static/nn/metric.py:36)."""
+    def fn(pred, y):
+        kk = min(int(k), pred.shape[-1])
+        topk = jnp.argsort(-pred, axis=-1)[..., :kk]
+        y2 = y.reshape(-1, 1)
+        hit = jnp.any(topk == y2, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply_op("accuracy", fn, [input, label])
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None, name=None):
+    """Batch AUC via thresholded confusion counts (reference:
+    static/nn/metric.py:121 — same binned formulation as the C++ kernel).
+    Returns (auc_out, batch_stat) like the reference's tuple."""
+    def fn(pred, y):
+        pos_score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+            else pred.reshape(-1)
+        yv = y.reshape(-1).astype(jnp.float32)
+        bins = jnp.clip((pos_score * num_thresholds).astype(jnp.int32),
+                        0, num_thresholds)
+        pos_hist = jnp.zeros(num_thresholds + 1).at[bins].add(yv)
+        neg_hist = jnp.zeros(num_thresholds + 1).at[bins].add(1.0 - yv)
+        # sweep thresholds high→low: cumulative TP/FP, trapezoid area
+        tp = jnp.cumsum(pos_hist[::-1])
+        fp = jnp.cumsum(neg_hist[::-1])
+        tot_pos = tp[-1]
+        tot_neg = fp[-1]
+        tpr = tp / jnp.maximum(tot_pos, 1e-12)
+        fpr = fp / jnp.maximum(tot_neg, 1e-12)
+        area = jnp.trapezoid(tpr, fpr)
+        return area.astype(jnp.float32)
+
+    a = apply_op("auc", fn, [input, label])
+    return a, [a]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None, name=None):
+    """CTR serving metrics (reference: static/nn/metric.py:304): returns
+    (sqrerr, abserr, prob, q, pos, total) aggregates."""
+    def fn(pred, y):
+        p = pred.reshape(-1)
+        yv = y.reshape(-1).astype(jnp.float32)
+        err = p - yv
+        return (jnp.sum(err * err), jnp.sum(jnp.abs(err)), jnp.sum(p),
+                jnp.sum(p), jnp.sum(yv), jnp.asarray(float(p.shape[0]),
+                                                     jnp.float32))
+
+    return apply_op("ctr_metric_bundle", fn, [input, label])
+
+
+# ---------------------------------------------------------------------------
+# autograd bridges
+# ---------------------------------------------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Populate grads for the loss (reference: base/backward.py:1631).
+    Eager-tape equivalent: run backward, return [(param, grad)] pairs."""
+    loss.backward(retain_graph=True)
+    params = parameter_list
+    if params is None:
+        from . import _program_of, default_main_program
+
+        prog = _program_of(loss) or default_main_program()
+        params = _program_persistables(prog)
+    out = []
+    for p in params:
+        g = p.grad if hasattr(p, "grad") else None
+        out.append((p, g))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(targets)/d(inputs) (reference: base/backward.py:2408)."""
+    from ..autograd import grad as _grad
+
+    ts = targets if isinstance(targets, (list, tuple)) else [targets]
+    xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    outs = _grad(ts, xs, grad_outputs=target_gradients, allow_unused=True,
+                 retain_graph=True)
+    return list(outs)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone trainable parameter (reference: static/nn/common.py
+    create_parameter) — same init rules as Layer.create_parameter."""
+    from ..nn import initializer as I
+    from ..nn.layer_base import ParamAttr
+
+    attr = ParamAttr._to_attr(attr)
+    init = (attr.initializer if attr else None) or default_initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierUniform()
+    value = init(tuple(int(s) for s in shape), dtypes.convert_dtype(dtype))
+    return Parameter(value, trainable=attr.trainable if attr else True,
+                     name=(attr.name if attr else None) or name)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    """Filled global variable (reference: layers/tensor.py create_global_var)."""
+    t = Parameter(jnp.full(tuple(int(s) for s in shape), value,
+                           dtypes.convert_dtype(dtype)),
+                  trainable=False, name=name)
+    t.persistable = persistable
+    return t
+
+
+# ---------------------------------------------------------------------------
+# places / scopes / devices
+# ---------------------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    import os
+
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [f"cpu:{i}" for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places; on this backend they are the TPU chips."""
+    devs = jax.devices()
+    ids = device_ids if device_ids is not None else range(len(devs))
+    return [devs[i] for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Route computation to a device for the with-block (reference:
+    framework.py device_guard) — maps to jax.default_device."""
+    if device in (None, "cpu"):
+        target = jax.devices("cpu")[0] if device == "cpu" else None
+    else:
+        idx = 0
+        if ":" in str(device):
+            device, idx = str(device).split(":")
+            idx = int(idx)
+        target = jax.devices()[idx]
+    if target is None:
+        yield
+        return
+    with jax.default_device(target):
+        yield
+
+
+class Scope:
+    """Variable scope (reference: base/core Scope): name → Tensor."""
+
+    def __init__(self):
+        self._vars: dict[str, Tensor] = {}
+
+    def var(self, name):
+        v = self._vars.setdefault(name, Tensor(jnp.zeros(())))
+        return _ScopeVar(self, name, v)
+
+    def find_var(self, name):
+        v = self._vars.get(name)
+        return _ScopeVar(self, name, v) if v is not None else None
+
+
+class _ScopeVar:
+    def __init__(self, scope, name, value):
+        self._scope, self._name, self._value = scope, name, value
+
+    def get_tensor(self):
+        return self._value
+
+    def set(self, value, place=None):
+        self._scope._vars[self._name] = Tensor(jnp.asarray(np.asarray(value)))
+
+
+_global_scope = Scope()
+_scope_stack: list[Scope] = []
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1] if _scope_stack else _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+# ---------------------------------------------------------------------------
+# program serialization (reference: static/io.py)
+# ---------------------------------------------------------------------------
+
+def _program_persistables(program):
+    from ..distributed.io import _program_persistables as impl
+
+    return impl(program)
+
+
+def _replay_callable(program, feed_names, fetch_vars):
+    """A pure traceable function replaying the program — OpDesc.fn bodies are
+    jnp-pure, so jax.export can AOT the whole graph (weights fold in as
+    constants)."""
+    def fn(*inputs):
+        env = {}
+        for name, v in zip(feed_names, inputs):
+            env[program._feeds[name]] = v
+        for op in program._ops:
+            vals = []
+            for kind, payload in op.inputs:
+                if kind == "var":
+                    vals.append(env[payload])
+                else:
+                    vals.append(_unwrap(payload) if isinstance(payload, Tensor)
+                                else payload)
+            out = op.fn(*vals, **op.attrs)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for oid, o in zip(op.outputs, outs):
+                env[oid] = o
+        return tuple(env[id(f)] for f in fetch_vars)
+
+    return fn
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Prune to the feed→fetch slice (reference: static/io.py:160).  The
+    recorded graph replays exactly the serialized ops, so normalization is a
+    clone annotated with the interface."""
+    p = program.clone()
+    p._interface = ([getattr(v, "name", None) for v in feed_vars],
+                    list(fetch_vars))
+    return p
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """→ bytes (reference: static/io.py:256): the jax.export artifact of the
+    traced replay."""
+    from jax import export as jexport
+
+    from . import default_main_program
+
+    program = program or default_main_program()
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetches = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    names = []
+    by_id = {tid: n for n, tid in program._feeds.items()}
+    for v in feeds:
+        if id(v) not in by_id:
+            raise ValueError("feed_vars must be data() slots of the program")
+        names.append(by_id[id(v)])
+    fn = _replay_callable(program, names, fetches)
+    specs = [jax.ShapeDtypeStruct(tuple(v.shape), _unwrap(v).dtype)
+             for v in feeds]
+    exported = jexport.export(jax.jit(fn))(*specs)
+    return exported.serialize()
+
+
+def deserialize_program(data: bytes):
+    """bytes → runnable program object (jax.export Exported with .call)."""
+    from jax import export as jexport
+
+    return jexport.deserialize(bytearray(data))
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    from . import default_main_program
+
+    params = _program_persistables(program or default_main_program())
+    blob = {(p.name or f"param_{i}"): np.asarray(_unwrap(p))
+            for i, p in enumerate(params)}
+    return pickle.dumps(blob, protocol=4)
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    blob = pickle.loads(bytes(data))
+    params = _program_persistables(program)
+    for i, p in enumerate(params):
+        key = p.name or f"param_{i}"
+        if key in blob:
+            p.set_value(blob[key])
+    return blob
+
+
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Save a program's persistables (reference: static/io.py save →
+    .pdparams + .pdmodel pair; our model part is the exported replay when an
+    interface was recorded via normalize_program)."""
+    params = _program_persistables(program)
+    blob = {(p.name or f"param_{i}"): np.asarray(_unwrap(p))
+            for i, p in enumerate(params)}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(blob, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        blob = pickle.load(f)
+    params = var_list or _program_persistables(program)
+    for i, p in enumerate(params):
+        key = p.name or f"param_{i}"
+        if key in blob:
+            p.set_value(blob[key])
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    params = _program_persistables(program)
+    for i, p in enumerate(params):
+        key = p.name or f"param_{i}"
+        if key in state_dict:
+            p.set_value(state_dict[key])
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    """reference: static/io.py:428 — feed/fetch slice of the recorded
+    program, exported AOT (.pdmodel StableHLO + .pdiparams weights)."""
+    from ..inference import save_inference_model as _save
+    from . import default_main_program
+
+    program = program or default_main_program()
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetches = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    by_id = {tid: n for n, tid in program._feeds.items()}
+    names = [by_id[id(v)] for v in feeds]
+    fn = _replay_callable(program, names, fetches)
+    examples = [jnp.zeros(tuple(v.shape), _unwrap(v).dtype) for v in feeds]
+    _save(path_prefix, fn, examples, params=None)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """reference: static/io.py:575 — returns (program, feed_names,
+    fetch_targets); program here is the deserialized export with .call."""
+    from ..inference import load_inference_model as _load
+
+    exported, params = _load(path_prefix)
+    return exported, [], []

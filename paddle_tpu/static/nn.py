@@ -1,0 +1,539 @@
+"""static.nn — functional layer constructors over the recorded Program
+(reference: python/paddle/static/nn/__init__.py over static/nn/common.py,
+control_flow.py, sequence_lod.py).
+
+Each constructor builds the matching eager Layer (params created with the
+given attrs) and applies it, so the op lands on the recording hook exactly
+like a hand-written eager call.  Sequence ops take the TPU-native padded
+representation: a dense [batch, time, ...] tensor plus an optional
+``lengths`` (the reference's LoD level-1 offsets, converted); ragged LoD has
+no jit-friendly analog and padding is the documented mapping."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, _unwrap, apply_op
+from .api_tail import py_func  # noqa: F401  (re-exported here like the reference)
+
+__all__ = [
+    "fc", "embedding", "sparse_embedding", "conv2d", "conv2d_transpose",
+    "conv3d", "conv3d_transpose", "batch_norm", "instance_norm", "group_norm",
+    "layer_norm", "data_norm", "spectral_norm", "deform_conv2d", "prelu",
+    "bilinear_tensor_product", "nce", "row_conv", "py_func", "cond", "case",
+    "switch_case", "while_loop", "static_pylayer", "sequence_conv",
+    "sequence_softmax", "sequence_pool", "sequence_first_step",
+    "sequence_last_step", "sequence_expand",
+]
+
+
+def _act(out, act):
+    if not act:
+        return out
+    from ..nn import functional as F
+
+    return getattr(F, act)(out)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference: static/nn/common.py fc — flatten trailing dims, affine,
+    optional activation.  Multiple inputs sum their projections."""
+    from ..nn import Linear
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = []
+    for xi in xs:
+        shape = tuple(xi.shape)
+        flat = int(np.prod(shape[num_flatten_dims:]))
+        lin = Linear(flat, size, weight_attr=weight_attr,
+                     bias_attr=bias_attr if len(outs) == 0 else False)
+
+        def reshape_fn(v):
+            return v.reshape(v.shape[:num_flatten_dims] + (flat,))
+
+        flat_x = apply_op("flatten_fc", reshape_fn, [xi])
+        outs.append(lin(flat_x))
+    total = outs[0]
+    for o in outs[1:]:
+        total = total + o
+    return _act(total, activation)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    from ..nn import Embedding
+
+    emb = Embedding(int(size[0]), int(size[1]), padding_idx=padding_idx,
+                    weight_attr=param_attr)
+    return emb(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False, entry=None,
+                     table_class="MemorySparseTable", param_attr=None,
+                     dtype="float32", slot=None):
+    """reference: static/nn/common.py sparse_embedding — the PS large-scale
+    table degrades to a dense embedding here (PS stack excluded, SURVEY §1);
+    the ``entry`` descriptor is validated like the reference does."""
+    if entry is not None:
+        from ..distributed.entry_attr import EntryAttr
+
+        if not isinstance(entry, EntryAttr):
+            raise ValueError("entry must be a ProbabilityEntry / "
+                             "CountFilterEntry / ShowClickEntry")
+        entry._to_attr()
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    from ..nn import Conv2D
+
+    in_ch = int(input.shape[1 if data_format == "NCHW" else -1])
+    conv = Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                  padding=padding, dilation=dilation, groups=groups,
+                  weight_attr=param_attr, bias_attr=bias_attr,
+                  data_format=data_format)
+    return _act(conv(input), act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    from ..nn import Conv3D
+
+    in_ch = int(input.shape[1 if data_format == "NCDHW" else -1])
+    conv = Conv3D(in_ch, num_filters, filter_size, stride=stride,
+                  padding=padding, dilation=dilation, groups=groups,
+                  weight_attr=param_attr, bias_attr=bias_attr,
+                  data_format=data_format)
+    return _act(conv(input), act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    from ..nn import Conv2DTranspose
+
+    if filter_size is None:
+        raise ValueError("conv2d_transpose: pass filter_size= (inferring it "
+                         "from output_size is not supported)")
+    in_ch = int(input.shape[1 if data_format == "NCHW" else -1])
+    conv = Conv2DTranspose(in_ch, num_filters, filter_size, stride=stride,
+                           padding=padding, dilation=dilation, groups=groups,
+                           weight_attr=param_attr, bias_attr=bias_attr,
+                           data_format=data_format)
+    return _act(conv(input), act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    from ..nn import Conv3DTranspose
+
+    in_ch = int(input.shape[1 if data_format == "NCDHW" else -1])
+    conv = Conv3DTranspose(in_ch, num_filters, filter_size, stride=stride,
+                           padding=padding, dilation=dilation, groups=groups,
+                           weight_attr=param_attr, bias_attr=bias_attr,
+                           data_format=data_format)
+    return _act(conv(input), act)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from ..nn import BatchNorm2D
+
+    ch = int(input.shape[1 if data_layout == "NCHW" else -1])
+    bn = BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
+                     weight_attr=param_attr, bias_attr=bias_attr,
+                     data_format=data_layout)
+    if is_test or use_global_stats:
+        bn.eval()
+    return _act(bn(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn import InstanceNorm2D
+
+    inorm = InstanceNorm2D(int(input.shape[1]), epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr)
+    return inorm(input)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from ..nn import GroupNorm
+
+    gn = GroupNorm(groups, int(input.shape[1]), epsilon=epsilon,
+                   weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(gn(input), act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn import LayerNorm
+
+    shape = tuple(int(s) for s in input.shape[begin_norm_axis:])
+    ln = LayerNorm(shape, epsilon=epsilon,
+                   weight_attr=param_attr if scale else False,
+                   bias_attr=bias_attr if shift else False)
+    return _act(ln(input), act)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """reference: static/nn/common.py data_norm — normalization by
+    accumulated batch statistics (no learned affine unless enabled); the
+    stateless functional form normalizes by the current batch stats."""
+    def fn(v):
+        mean = jnp.mean(v, axis=0, keepdims=True)
+        var = jnp.mean((v - mean) ** 2, axis=0, keepdims=True)
+        return (v - mean) / jnp.sqrt(var + epsilon)
+
+    return _act(apply_op("data_norm", fn, [input]), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference: static/nn/common.py spectral_norm — returns the
+    sigma-normalized weight tensor."""
+    def fn(w):
+        mat = jnp.moveaxis(w.astype(jnp.float32), dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((mat.shape[0],), jnp.float32) / np.sqrt(mat.shape[0])
+        v = None
+        for _ in range(max(int(power_iters), 1)):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ (mat @ v)
+        return (w / jnp.maximum(sigma, eps)).astype(w.dtype)
+
+    return apply_op("spectral_norm", fn, [weight])
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import DeformConv2D
+
+    conv = DeformConv2D(int(input.shape[1]), num_filters, filter_size,
+                        stride=stride, padding=padding, dilation=dilation,
+                        groups=groups, deformable_groups=deformable_groups,
+                        weight_attr=param_attr, bias_attr=bias_attr)
+    return conv(input, offset, mask)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from ..nn import initializer as I
+    from .api_tail import create_parameter
+
+    if mode == "all":
+        shape = (1,)
+    elif mode == "channel":
+        shape = (int(x.shape[1 if data_format == "NCHW" else -1]),)
+    elif mode == "element":
+        shape = tuple(int(s) for s in x.shape[1:])
+    else:
+        raise ValueError("prelu mode must be all/channel/element")
+    alpha = create_parameter(shape, "float32", attr=param_attr,
+                             default_initializer=I.Constant(0.25))
+
+    def fn(v, a):
+        if mode == "channel" and data_format == "NCHW":
+            a = a.reshape((1, -1) + (1,) * (v.ndim - 2))
+        return jnp.where(v > 0, v, a * v)
+
+    return apply_op("prelu", fn, [x, alpha])
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    from ..nn import Bilinear
+
+    bl = Bilinear(int(x.shape[-1]), int(y.shape[-1]), size,
+                  weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(bl(x, y), act)
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference: static/nn/common.py
+    nce over the C++ nce_op): logistic loss on the true class plus
+    ``num_neg_samples`` uniformly drawn noise classes."""
+    from ..core import rng
+    from ..nn import initializer as I
+    from .api_tail import create_parameter
+
+    dim = int(input.shape[-1])
+    w = create_parameter((num_total_classes, dim), "float32", attr=param_attr,
+                         default_initializer=I.XavierUniform())
+    b = create_parameter((num_total_classes,), "float32", attr=bias_attr,
+                         is_bias=True)
+
+    def fn(v, y, wv, bv):
+        bsz = v.shape[0]
+        y = y.reshape(bsz)
+        pos_logit = jnp.einsum("bd,bd->b", v, wv[y]) + bv[y]
+        # key drawn per execution (the _dropout_probs convention) — a
+        # build-time key would resample the SAME noise classes every step
+        neg = jax.random.randint(rng.next_key(), (bsz, num_neg_samples), 0,
+                                 num_total_classes)
+        neg_logit = jnp.einsum("bd,bnd->bn", v, wv[neg]) + bv[neg]
+        pos_loss = jax.nn.softplus(-pos_logit)
+        neg_loss = jnp.sum(jax.nn.softplus(neg_logit), axis=-1)
+        return (pos_loss + neg_loss).reshape(bsz, 1)
+
+    return apply_op("nce", fn, [input, label, w, b])
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference: static/nn/common.py row_conv):
+    out[t] = sum_{i=0..k} w[i] * in[t+i], zero-padded at the tail."""
+    from ..nn import initializer as I
+    from .api_tail import create_parameter
+
+    d = int(input.shape[-1])
+    k = int(future_context_size)
+    w = create_parameter((k + 1, d), "float32", attr=param_attr,
+                         default_initializer=I.XavierUniform())
+
+    def fn(v, wv):
+        pad = [(0, 0)] * v.ndim
+        pad[-2] = (0, k)
+        vp = jnp.pad(v, pad)
+        t = v.shape[-2]
+        out = sum(vp[..., i:i + t, :] * wv[i] for i in range(k + 1))
+        return out
+
+    return _act(apply_op("row_conv", fn, [input, w]), act)
+
+
+# ---------------------------------------------------------------------------
+# control flow (reference: static/nn/control_flow.py)
+# ---------------------------------------------------------------------------
+
+def _is_traced_pred(pred):
+    v = _unwrap(pred) if isinstance(pred, Tensor) else pred
+    return isinstance(v, jax.core.Tracer)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """reference control_flow.py cond: lax.cond under trace, host branch on
+    concrete predicates (both branches must return matching structures)."""
+    if _is_traced_pred(pred):
+        from ..jit import functional_state  # noqa: F401 (doc anchor)
+
+        v = _unwrap(pred)
+        t = true_fn() if true_fn else None
+        f = false_fn() if false_fn else None
+        tv = jax.tree_util.tree_map(_unwrap, t)
+        fv = jax.tree_util.tree_map(_unwrap, f)
+        out = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(v.reshape(()), a, b), tv, fv)
+        return jax.tree_util.tree_map(
+            lambda o: Tensor(o) if isinstance(o, (jax.Array, jnp.ndarray)) else o,
+            out)
+    val = bool(np.asarray(_unwrap(pred) if isinstance(pred, Tensor) else pred))
+    if val:
+        return true_fn() if true_fn else None
+    return false_fn() if false_fn else None
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference control_flow.py case: first true predicate wins."""
+    for pred, fn in pred_fn_pairs:
+        val = bool(np.asarray(_unwrap(pred) if isinstance(pred, Tensor) else pred))
+        if val:
+            return fn()
+    if default is not None:
+        return default()
+    # reference falls through to the LAST branch when nothing matches
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference control_flow.py switch_case."""
+    idx = int(np.asarray(_unwrap(branch_index)
+                         if isinstance(branch_index, Tensor) else branch_index))
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    return fns[max(fns)]()
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """reference control_flow.py while_loop; host loop on concrete values
+    (the jit path uses lax.while_loop via the same signature)."""
+    vals = list(loop_vars)
+    if any(_is_traced_pred(v) for v in vals):
+        flat, treedef = jax.tree_util.tree_flatten(
+            [jax.tree_util.tree_map(_unwrap, v) for v in vals])
+
+        def c(fs):
+            args = jax.tree_util.tree_unflatten(treedef, fs)
+            return _unwrap(cond(*args)).reshape(())
+
+        def b(fs):
+            args = jax.tree_util.tree_unflatten(treedef, fs)
+            out = body(*args)
+            return jax.tree_util.tree_flatten(
+                [jax.tree_util.tree_map(_unwrap, o) for o in out])[0]
+
+        out = jax.lax.while_loop(c, b, flat)
+        return jax.tree_util.tree_unflatten(treedef, [Tensor(o) for o in out])
+    while bool(np.asarray(_unwrap(cond(*vals)))):
+        out = body(*vals)
+        vals = list(out) if isinstance(out, (tuple, list)) else [out]
+    return vals
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """reference control_flow.py static_pylayer → PyLayer bridge."""
+    if backward_fn is None:
+        return forward_fn(*inputs)
+    from ..autograd import PyLayer
+
+    class _SP(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            ctx.save_for_backward(*args)
+            return forward_fn(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            return backward_fn(*grads)
+
+    return _SP.apply(*inputs)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (reference: static/nn/sequence_lod.py) — padded representation
+# ---------------------------------------------------------------------------
+
+def _lengths_mask(x, lengths):
+    if lengths is None:
+        return None
+    lv = _unwrap(lengths) if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+    t = x.shape[1]
+    return jnp.arange(t)[None, :] < lv[:, None]
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None, lengths=None):
+    """Context-window projection over time ([B, T, D] padded; sequence_lod.py
+    sequence_conv).  padding_start defaults to -floor(k/2), the reference's
+    centered window."""
+    from ..nn import initializer as I
+    from .api_tail import create_parameter
+
+    d = int(input.shape[-1])
+    k = int(filter_size)
+    start = -(k // 2) if padding_start is None else int(padding_start)
+    w = create_parameter((k * d, num_filters), "float32", attr=param_attr,
+                         default_initializer=I.XavierUniform())
+    b = (create_parameter((num_filters,), "float32", attr=bias_attr,
+                          is_bias=True) if bias_attr is not False else None)
+    inputs = [input, w] + ([b] if b is not None else [])
+
+    def fn(v, wv, *rest):
+        bsz, t, dd = v.shape
+        cols = []
+        for i in range(k):
+            off = start + i
+            rolled = jnp.roll(v, -off, axis=1)
+            idx = jnp.arange(t) + off
+            valid = (idx >= 0) & (idx < t)
+            cols.append(jnp.where(valid[None, :, None], rolled, 0.0))
+        ctx = jnp.concatenate(cols, axis=-1)  # [B, T, k*D]
+        out = ctx @ wv
+        if rest:
+            out = out + rest[0]
+        return out
+
+    return _act(apply_op("sequence_conv", fn, inputs), act)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, lengths=None):
+    """Per-sequence softmax over time ([B, T]; sequence_lod.py)."""
+    mask = _lengths_mask(input, lengths)
+
+    def fn(v):
+        logits = v if mask is None else jnp.where(mask, v, -jnp.inf)
+        p = jax.nn.softmax(logits.astype(jnp.float32), axis=1)
+        return jnp.nan_to_num(p, nan=0.0).astype(v.dtype)
+
+    return apply_op("sequence_softmax", fn, [input])
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  lengths=None):
+    """sum/average/sqrt/max/last/first pooling over time ([B, T, D];
+    sequence_lod.py sequence_pool)."""
+    mask = _lengths_mask(input, lengths)
+    pt = pool_type.lower()
+
+    def fn(v):
+        m = (jnp.ones(v.shape[:2], bool) if mask is None else mask)[..., None]
+        cnt = jnp.maximum(jnp.sum(m, axis=1), 1)
+        if pt == "sum":
+            return jnp.sum(jnp.where(m, v, 0), axis=1)
+        if pt == "average":
+            return jnp.sum(jnp.where(m, v, 0), axis=1) / cnt
+        if pt == "sqrt":
+            return jnp.sum(jnp.where(m, v, 0), axis=1) / jnp.sqrt(
+                cnt.astype(v.dtype))
+        if pt == "max":
+            return jnp.max(jnp.where(m, v, -jnp.inf), axis=1)
+        if pt == "first":
+            return v[:, 0]
+        if pt == "last":
+            idx = (cnt[:, 0] - 1).astype(jnp.int32)
+            return v[jnp.arange(v.shape[0]), idx]
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    return apply_op("sequence_pool", fn, [input])
+
+
+def sequence_first_step(input, lengths=None):
+    return sequence_pool(input, "first", lengths=lengths)
+
+
+def sequence_last_step(input, lengths=None):
+    return sequence_pool(input, "last", lengths=lengths)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None, repeats=None):
+    """Repeat each row of x (sequence_lod.py sequence_expand); the LoD of y
+    degrades to an explicit ``repeats`` vector in the padded world."""
+    if repeats is None:
+        raise ValueError(
+            "sequence_expand needs repeats= (the reference reads them from "
+            "y's LoD; padded tensors carry no LoD)")
+    reps = np.asarray(_unwrap(repeats) if isinstance(repeats, Tensor)
+                      else repeats).astype(np.int64)
+
+    def fn(v):
+        return jnp.repeat(v, jnp.asarray(reps), axis=0,
+                          total_repeat_length=int(reps.sum()))
+
+    return apply_op("sequence_expand", fn, [x])

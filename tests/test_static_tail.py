@@ -1,0 +1,274 @@
+"""paddle.static API tail + static.nn (reference: static/__init__.py,
+static/nn/*, static/io.py, static/ema.py, base/backward.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+class TestStaticMisc:
+    def test_variable_scope_places(self):
+        assert static.Variable is paddle.Tensor
+        sc = static.global_scope()
+        sc.var("w").set(np.ones((2, 2), np.float32))
+        assert float(sc.find_var("w").get_tensor().numpy().sum()) == 4.0
+        inner = static.Scope()
+        with static.scope_guard(inner):
+            assert static.global_scope() is inner
+        assert static.global_scope() is sc
+        assert static.cpu_places(3) == ["cpu:0", "cpu:1", "cpu:2"]
+        assert len(static.cuda_places()) >= 1
+        assert static.xpu_places() == static.cuda_places()
+
+    def test_device_guard(self):
+        with static.device_guard("cpu"):
+            t = paddle.ones([2])
+        assert t.shape == (2,)
+
+    def test_build_strategy_compiled_program(self):
+        bs = static.BuildStrategy()
+        bs.fuse_bn_act_ops = True
+        prog = static.Program()
+        cp = static.CompiledProgram(prog, build_strategy=bs)
+        assert cp.global_block() is prog
+        with pytest.raises(NotImplementedError):
+            static.IpuStrategy()
+
+    def test_create_parameter_and_global_var(self):
+        p = static.create_parameter([2, 3], "float32")
+        assert p.shape == (2, 3) and p.trainable
+        g = static.create_global_var([2], 1.5, "float32", persistable=True)
+        np.testing.assert_allclose(g.numpy(), [1.5, 1.5])
+        assert g.persistable and not g.trainable
+
+    def test_accuracy_auc(self):
+        x = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]],
+                                      np.float32))
+        y = paddle.to_tensor(np.array([[0], [1], [1]], np.int64))
+        assert float(static.accuracy(x, y).numpy()) == pytest.approx(2 / 3)
+        a, _ = static.auc(x, y)
+        assert float(a.numpy()) == pytest.approx(1.0, abs=1e-3)
+        # random scores -> AUC near 0.5
+        r = np.random.default_rng(0)
+        xs = paddle.to_tensor(r.random((2000, 2)).astype(np.float32))
+        ys = paddle.to_tensor(r.integers(0, 2, (2000, 1)))
+        a2, _ = static.auc(xs, ys)
+        assert 0.4 < float(a2.numpy()) < 0.6
+        bundle = static.ctr_metric_bundle(x[:, 1:], y.astype("float32"))
+        assert len(bundle) == 6
+
+    def test_ema(self):
+        lin = nn.Linear(2, 2)
+        ema = static.ExponentialMovingAverage(0.5)
+        ema.update(parameters=lin.parameters())
+        w0 = lin.weight.numpy().copy()
+        lin.weight.set_value(w0 + 1.0)
+        ema.update()
+        with ema.apply():
+            np.testing.assert_allclose(lin.weight.numpy(), w0 + 0.5,
+                                       atol=1e-6)
+        np.testing.assert_allclose(lin.weight.numpy(), w0 + 1.0)
+
+    def test_gradients_and_append_backward(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        (g,) = static.gradients(x * x, x)
+        np.testing.assert_allclose(g.numpy(), [4.0])
+
+        prog = static.Program()
+        lin = nn.Linear(3, 1)
+        with static.program_guard(prog):
+            xin = static.data("x", [2, 3], "float32")
+            loss = lin(xin).sum()
+        pairs = static.append_backward(loss)
+        assert len(pairs) == 2  # weight + bias captured by the program
+
+    def test_py_func_and_print(self, capfd):
+        t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out = static.py_func(lambda a: a * 3, t)
+        np.testing.assert_allclose(out.numpy(), [3.0, 6.0])
+        static.Print(t, message="dbg")  # must not raise
+
+
+class TestStaticIO:
+    def _build(self):
+        prog = static.Program()
+        lin = nn.Linear(3, 2)
+        with static.program_guard(prog):
+            xin = static.data("x", [2, 3], "float32")
+            out = lin(xin)
+        return prog, lin, xin, out
+
+    def test_save_load_inference_model(self, tmp_path):
+        prog, lin, xin, out = self._build()
+        exe = static.Executor()
+        ref = exe.run(prog, feed={"x": np.ones((2, 3), np.float32)},
+                      fetch_list=[out])[0]
+        prefix = str(tmp_path / "m")
+        static.save_inference_model(prefix, [xin], [out], exe, program=prog)
+        exported, _, _ = static.load_inference_model(prefix, exe)
+        got = np.asarray(exported.call(np.ones((2, 3), np.float32))[0])
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_serialize_roundtrip(self):
+        prog, lin, xin, out = self._build()
+        exe = static.Executor()
+        ref = exe.run(prog, feed={"x": np.ones((2, 3), np.float32)},
+                      fetch_list=[out])[0]
+        blob = static.serialize_program([xin], [out], program=prog)
+        ex2 = static.deserialize_program(blob)
+        np.testing.assert_allclose(
+            np.asarray(ex2.call(np.ones((2, 3), np.float32))[0]), ref,
+            rtol=1e-6)
+        pb = static.serialize_persistables([xin], [out], program=prog)
+        orig = lin.weight.numpy().copy()
+        lin.weight.set_value(np.zeros_like(orig))
+        static.deserialize_persistables(prog, pb)
+        np.testing.assert_allclose(lin.weight.numpy(), orig)
+
+    def test_program_state_roundtrip(self, tmp_path):
+        prog, lin, xin, out = self._build()
+        path = str(tmp_path / "st")
+        static.save(prog, path)
+        orig = lin.weight.numpy().copy()
+        lin.weight.set_value(orig * 0)
+        state = static.load_program_state(path)
+        static.set_program_state(prog, state)
+        np.testing.assert_allclose(lin.weight.numpy(), orig)
+        static.save_to_file(path + ".bin", b"abc")
+        assert static.load_from_file(path + ".bin") == b"abc"
+
+
+class TestStaticNN:
+    def test_fc_oracle(self):
+        x = paddle.to_tensor(np.ones((2, 2, 3), np.float32))
+        out = static.nn.fc(x, 4, num_flatten_dims=1)
+        assert tuple(out.shape) == (2, 4)
+        out2 = static.nn.fc(x, 4, num_flatten_dims=2)
+        assert tuple(out2.shape) == (2, 2, 4)
+
+    def test_conv_and_norm_constructors(self):
+        img = paddle.to_tensor(np.random.default_rng(0)
+                               .standard_normal((2, 3, 8, 8)).astype(np.float32))
+        out = static.nn.conv2d(img, 4, 3, padding=1, act="relu")
+        assert tuple(out.shape) == (2, 4, 8, 8)
+        assert float(out.numpy().min()) >= 0  # relu applied
+        out = static.nn.batch_norm(out)
+        out = static.nn.group_norm(out, groups=2)
+        out = static.nn.instance_norm(out)
+        assert tuple(out.shape) == (2, 4, 8, 8)
+        tr = static.nn.conv2d_transpose(img, 4, filter_size=2, stride=2)
+        assert tuple(tr.shape)[-1] == 16
+        ln = static.nn.layer_norm(paddle.to_tensor(np.ones((2, 5), np.float32)))
+        assert tuple(ln.shape) == (2, 5)
+        dn = static.nn.data_norm(paddle.to_tensor(
+            np.random.default_rng(1).standard_normal((8, 4)).astype(np.float32)))
+        assert abs(float(dn.numpy().mean())) < 1e-5
+
+    def test_embeddings(self):
+        ids = paddle.to_tensor(np.array([[1, 2]], np.int64))
+        emb = static.nn.embedding(ids, (10, 4))
+        assert tuple(emb.shape) == (1, 2, 4)
+        from paddle_tpu.distributed import CountFilterEntry
+
+        emb2 = static.nn.sparse_embedding(ids, (10, 4),
+                                          entry=CountFilterEntry(5))
+        assert tuple(emb2.shape) == (1, 2, 4)
+        with pytest.raises(ValueError):
+            static.nn.sparse_embedding(ids, (10, 4), entry="bogus")
+
+    def test_prelu_modes(self):
+        x = paddle.to_tensor(np.array([[-1.0, 2.0], [3.0, -4.0]], np.float32))
+        out = static.nn.prelu(x, mode="all")
+        np.testing.assert_allclose(out.numpy(),
+                                   [[-0.25, 2.0], [3.0, -1.0]], rtol=1e-6)
+
+    def test_spectral_norm_op(self):
+        w = np.random.default_rng(2).standard_normal((4, 3)).astype(np.float32)
+        out = static.nn.spectral_norm(paddle.to_tensor(w), power_iters=30)
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(out.numpy(), w / sigma, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_bilinear_and_row_conv_and_nce(self):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        y = paddle.to_tensor(np.ones((2, 4), np.float32))
+        out = static.nn.bilinear_tensor_product(x, y, 5)
+        assert tuple(out.shape) == (2, 5)
+
+        seq = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(1, 4, 3))
+        rc = static.nn.row_conv(seq, 2)
+        assert tuple(rc.shape) == (1, 4, 3)
+
+        inp = paddle.to_tensor(np.random.default_rng(3)
+                               .standard_normal((4, 6)).astype(np.float32))
+        lab = paddle.to_tensor(np.array([[0], [1], [2], [3]], np.int64))
+        loss = static.nn.nce(inp, lab, num_total_classes=10, num_neg_samples=3)
+        assert tuple(loss.shape) == (4, 1)
+        assert float(loss.numpy().min()) > 0
+
+    def test_control_flow(self):
+        t = static.nn.cond(paddle.to_tensor(np.array(True)),
+                           lambda: paddle.ones([2]), lambda: paddle.zeros([2]))
+        np.testing.assert_allclose(t.numpy(), [1.0, 1.0])
+        r = static.nn.case([(paddle.to_tensor(np.array(False)),
+                             lambda: paddle.zeros([1])),
+                            (paddle.to_tensor(np.array(True)),
+                             lambda: paddle.full([1], 7.0))])
+        np.testing.assert_allclose(r.numpy(), [7.0])
+        s = static.nn.switch_case(paddle.to_tensor(np.array(1, np.int64)),
+                                  {0: lambda: paddle.zeros([1]),
+                                   1: lambda: paddle.full([1], 3.0)})
+        np.testing.assert_allclose(s.numpy(), [3.0])
+        out = static.nn.while_loop(lambda i: i < 5, lambda i: (i + 1,),
+                                   [paddle.to_tensor(np.array(0, np.int64))])
+        assert int(out[0].numpy()) == 5
+
+    def test_while_loop_traced(self):
+        from paddle_tpu import jit
+
+        @jit.to_static
+        def count(n):
+            out = static.nn.while_loop(lambda i: i < n, lambda i: (i + 1,),
+                                       [paddle.zeros([], "int32")])
+            return out[0]
+
+        assert int(count(paddle.to_tensor(np.array(4, np.int32))).numpy()) == 4
+
+    def test_static_pylayer(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        out = static.nn.static_pylayer(lambda a: a * a, [x],
+                                       backward_fn=lambda g: g * 10.0)
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+    def test_sequence_ops(self):
+        x = np.arange(12, dtype=np.float32).reshape(2, 3, 2)
+        t = paddle.to_tensor(x)
+        avg = static.nn.sequence_pool(t, "average", lengths=[2, 3])
+        np.testing.assert_allclose(avg.numpy(), [[1.0, 2.0], [8.0, 9.0]])
+        mx = static.nn.sequence_pool(t, "max", lengths=[2, 3])
+        np.testing.assert_allclose(mx.numpy(), [[2.0, 3.0], [10.0, 11.0]])
+        last = static.nn.sequence_last_step(t, lengths=[2, 3])
+        np.testing.assert_allclose(last.numpy(), [[2.0, 3.0], [10.0, 11.0]])
+        first = static.nn.sequence_first_step(t)
+        np.testing.assert_allclose(first.numpy(), [[0.0, 1.0], [6.0, 7.0]])
+
+        sm = static.nn.sequence_softmax(
+            paddle.to_tensor(np.ones((2, 4), np.float32)), lengths=[2, 4])
+        np.testing.assert_allclose(sm.numpy()[0], [0.5, 0.5, 0.0, 0.0],
+                                   atol=1e-6)
+
+        sc = static.nn.sequence_conv(paddle.to_tensor(x), 5, filter_size=3)
+        assert tuple(sc.shape) == (2, 3, 5)
+
+        ex = static.nn.sequence_expand(
+            paddle.to_tensor(np.array([[1.0], [2.0]], np.float32)), None,
+            repeats=[2, 3])
+        np.testing.assert_allclose(ex.numpy().ravel(),
+                                   [1.0, 1.0, 2.0, 2.0, 2.0])
+        with pytest.raises(ValueError, match="repeats"):
+            static.nn.sequence_expand(t, None)
