@@ -201,6 +201,11 @@ class Tensor:
     def numpy(self) -> np.ndarray:
         return np.asarray(self._value)
 
+    def set(self, value, place=None):
+        """In-place value replacement (the reference LoDTensor's
+        ``t.set(array, place)`` idiom used with scopes/executors)."""
+        self._value = jnp.asarray(np.asarray(value))
+
     def item(self):
         return self._value.item()
 

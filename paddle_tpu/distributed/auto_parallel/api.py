@@ -239,17 +239,27 @@ def shard_scaler(scaler):
                 "rendezvous store (master endpoint unset?)")
         import time as _time
 
+        from ..collective import P2P_TIMEOUT
+
         seq = _p2p_seq.get("scaler_sync", 0)
         _p2p_seq["scaler_sync"] = seq + 1
         key = f"scaler/{seq}"
         store.add(key + "/flag", int(bool(scaler._found_inf)))
         store.add(key + "/n", 1)
-        deadline = _time.time() + 60
+        deadline = _time.time() + P2P_TIMEOUT
         while int(store.add(key + "/n", 0)) < world:
             if _time.time() > deadline:
                 raise RuntimeError("shard_scaler: found_inf sync timed out")
             _time.sleep(0.005)
         scaler._found_inf = int(store.add(key + "/flag", 0)) > 0
+        # reclaim store memory: the last rank to check out deletes the keys
+        # (one step = one key pair; a long run must not grow rank 0's store)
+        if int(store.add(key + "/done", 1)) == world:
+            for suffix in ("/flag", "/n", "/done"):
+                try:
+                    store.delete_key(key + suffix)
+                except Exception:
+                    pass
 
     scaler.unscale_ = unscale_
     return scaler

@@ -472,3 +472,23 @@ def _kl_bernoulli(p, q):
 @register_kl(Uniform, Uniform)
 def _kl_uniform(p, q):
     return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+from . import transform  # noqa: E402,F401
+from .tail import (  # noqa: E402,F401
+    Binomial,
+    Cauchy,
+    Chi2,
+    ContinuousBernoulli,
+    ExponentialFamily,
+    Independent,
+    LKJCholesky,
+    MultivariateNormal,
+    TransformedDistribution,
+)
+
+__all__ += [
+    "Binomial", "Cauchy", "Chi2", "ContinuousBernoulli", "ExponentialFamily",
+    "Independent", "LKJCholesky", "MultivariateNormal",
+    "TransformedDistribution", "transform",
+]

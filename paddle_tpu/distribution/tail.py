@@ -1,0 +1,416 @@
+"""Distribution-family tail (reference: python/paddle/distribution/ —
+binomial.py, cauchy.py, chi2.py, continuous_bernoulli.py,
+exponential_family.py, independent.py, lkj_cholesky.py,
+multivariate_normal.py, transformed_distribution.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln, gammaln, multigammaln
+
+from ..core.tensor import Tensor, apply_op, _unwrap
+from . import Distribution, Gamma, _next_key, _val
+
+__all__ = [
+    "ExponentialFamily", "Binomial", "Cauchy", "Chi2", "ContinuousBernoulli",
+    "Independent", "LKJCholesky", "MultivariateNormal",
+    "TransformedDistribution",
+]
+
+
+class ExponentialFamily(Distribution):
+    """Natural-parameter family base (exponential_family.py): subclasses
+    provide ``_natural_parameters`` and ``_log_normalizer``; entropy comes
+    from the Bregman identity  H = F(θ) - ⟨θ, ∇F(θ)⟩ - E[carrier]."""
+
+    _mean_carrier_measure = 0.0
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    def entropy(self):
+        nparams = [jnp.asarray(p) for p in self._natural_parameters]
+
+        def F(*ps):
+            return jnp.sum(self._log_normalizer(*ps))
+
+        grads = jax.grad(F, argnums=tuple(range(len(nparams))))(*nparams)
+        result = self._log_normalizer(*nparams) - self._mean_carrier_measure
+        for p, g in zip(nparams, grads):
+            result = result - p * g
+        return Tensor(result)
+
+
+class Binomial(Distribution):
+    """binomial.py — counts of successes in ``total_count`` trials."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = jnp.asarray(_unwrap(total_count))
+        self.probs = _val(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        n = jnp.broadcast_to(self.total_count, self.batch_shape)
+        p = jnp.broadcast_to(self.probs, self.batch_shape)
+        return Tensor(jax.random.binomial(
+            _next_key(), n.astype(jnp.float32), p, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(v):
+            n = self.total_count.astype(jnp.float32)
+            p = self.probs
+            return (gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
+                    + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+        return apply_op("binomial_log_prob", fn, [value])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def entropy(self):
+        """Exact by support enumeration (the reference kernel enumerates
+        too; total_count must be concrete)."""
+        n_max = int(jnp.max(self.total_count))
+        k = jnp.arange(n_max + 1, dtype=jnp.float32)
+        shape = (n_max + 1,) + tuple(1 for _ in self.batch_shape)
+        kk = k.reshape(shape)
+        n = self.total_count.astype(jnp.float32)
+        p = self.probs
+        logp = (gammaln(n + 1) - gammaln(kk + 1) - gammaln(n - kk + 1)
+                + kk * jnp.log(p) + (n - kk) * jnp.log1p(-p))
+        valid = kk <= n
+        pmf = jnp.where(valid, jnp.exp(logp), 0.0)
+        return Tensor(-jnp.sum(pmf * jnp.where(valid, logp, 0.0), axis=0))
+
+
+class Cauchy(Distribution):
+    """cauchy.py — heavy-tailed, undefined mean/variance."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_next_key(), shape, minval=1e-7, maxval=1 - 1e-7)
+        return Tensor(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            z = (v - self.loc) / self.scale
+            return -jnp.log(math.pi * self.scale * (1 + z * z))
+
+        return apply_op("cauchy_log_prob", fn, [value])
+
+    def cdf(self, value):
+        def fn(v):
+            return jnp.arctan((v - self.loc) / self.scale) / math.pi + 0.5
+
+        return apply_op("cauchy_cdf", fn, [value])
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * math.pi * self.scale)
+                      * jnp.ones(self.batch_shape))
+
+
+class Chi2(Gamma):
+    """chi2.py — Gamma(df/2, rate=1/2)."""
+
+    def __init__(self, df, name=None):
+        df = _val(df)
+        super().__init__(df * 0.5, jnp.full_like(df, 0.5))
+
+    @property
+    def df(self):
+        return Tensor(self.concentration * 2)
+
+
+class ContinuousBernoulli(Distribution):
+    """continuous_bernoulli.py — [0,1]-supported relaxation with the
+    log-normalizer C(λ) = log(2 atanh(1-2λ) / (1-2λ)) (λ ≠ ½)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _val(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _outside(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _log_norm(self):
+        p = self.probs
+        safe = jnp.where(self._outside(), p, 0.25)
+        cut = jnp.log(2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe))
+        # Taylor expansion around ½ for the removable singularity
+        x = p - 0.5
+        taylor = math.log(2.0) + (4.0 / 3) * x * x
+        return jnp.where(self._outside(), cut, taylor)
+
+    def log_prob(self, value):
+        def fn(v):
+            p = self.probs
+            return (v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                    + self._log_norm())
+
+        return apply_op("cb_log_prob", fn, [value])
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_next_key(), shape, minval=1e-6, maxval=1 - 1e-6)
+        p = self.probs
+        safe = jnp.where(self._outside(), p, 0.25)
+        # inverse CDF for λ ≠ ½ (continuous_bernoulli.py icdf):
+        # F⁻¹(u) = [log1p(-λ + u(2λ-1)) - log1p(-λ)] / [log λ - log1p(-λ)]
+        icdf = (jnp.log1p(-safe + u * (2 * safe - 1)) - jnp.log1p(-safe)) \
+            / (jnp.log(safe) - jnp.log1p(-safe))
+        return Tensor(jnp.where(self._outside(), jnp.clip(icdf, 0, 1), u))
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        p = self.probs
+        safe = jnp.where(self._outside(), p, 0.25)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        x = p - 0.5
+        taylor = 0.5 + x / 3.0
+        return Tensor(jnp.where(self._outside(), m, taylor))
+
+    def entropy(self):
+        def fn(m):
+            p = self.probs
+            return -(m * jnp.log(p) + (1 - m) * jnp.log1p(-p)
+                     + self._log_norm())
+
+        return apply_op("cb_entropy", fn, [self.mean])
+
+
+class Independent(Distribution):
+    """independent.py — reinterpret trailing batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        if self.rank > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_rank exceeds base batch rank")
+        cut = len(base.batch_shape) - self.rank
+        super().__init__(base.batch_shape[:cut],
+                         base.batch_shape[cut:] + base.event_shape)
+
+    def sample(self, shape=(), seed=0):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+
+        def fn(v):
+            return jnp.sum(v, axis=tuple(range(-self.rank, 0)))
+
+        return apply_op("independent_log_prob", fn, [lp])
+
+    def entropy(self):
+        def fn(v):
+            return jnp.sum(v, axis=tuple(range(-self.rank, 0)))
+
+        return apply_op("independent_entropy", fn, [self.base.entropy()])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+
+class MultivariateNormal(Distribution):
+    """multivariate_normal.py — parameterized by covariance, precision, or
+    scale_tril; sampling and log_prob go through the Cholesky factor (the
+    TPU-friendly triangular form)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _val(loc)
+        given = [a is not None for a in
+                 (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError("pass exactly one of covariance_matrix / "
+                             "precision_matrix / scale_tril")
+        if scale_tril is not None:
+            self._L = jnp.asarray(_unwrap(scale_tril), jnp.float32)
+        elif covariance_matrix is not None:
+            self._L = jnp.linalg.cholesky(
+                jnp.asarray(_unwrap(covariance_matrix), jnp.float32))
+        else:
+            prec = jnp.asarray(_unwrap(precision_matrix), jnp.float32)
+            self._L = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        d = self._L.shape[-1]
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1], self._L.shape[:-2])
+        super().__init__(batch, (d,))
+
+    @property
+    def scale_tril(self):
+        return Tensor(self._L)
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._L @ jnp.swapaxes(self._L, -1, -2))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc,
+                                       self.batch_shape + self.event_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(jnp.sum(self._L ** 2, axis=-1),
+                                       self.batch_shape + self.event_shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(_next_key(), shape, jnp.float32)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i", self._L, eps))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            diff = v - self.loc
+            # solve L z = diff (triangular): Mahalanobis via z·z
+            z = jax.scipy.linalg.solve_triangular(
+                self._L, diff[..., None], lower=True)[..., 0]
+            d = self._L.shape[-1]
+            half_logdet = jnp.sum(jnp.log(
+                jnp.diagonal(self._L, axis1=-2, axis2=-1)), -1)
+            return (-0.5 * jnp.sum(z * z, -1) - half_logdet
+                    - 0.5 * d * math.log(2 * math.pi))
+
+        return apply_op("mvn_log_prob", fn, [value])
+
+    def entropy(self):
+        d = self._L.shape[-1]
+        half_logdet = jnp.sum(jnp.log(
+            jnp.diagonal(self._L, axis1=-2, axis2=-1)), -1)
+        return Tensor((0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet)
+                      * jnp.ones(self.batch_shape))
+
+
+class LKJCholesky(Distribution):
+    """lkj_cholesky.py — Cholesky factors of correlation matrices, density
+    ∝ Π_i L_ii^{dim - i - 1 + 2(η-1)} (row i, 0-indexed), sampled with the
+    onion construction."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion",
+                 name=None):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        self.dim = int(dim)
+        self.concentration = _val(concentration)
+        self.sample_method = sample_method
+        super().__init__(self.concentration.shape, (self.dim, self.dim))
+
+    def sample(self, shape=(), seed=0):
+        d = self.dim
+        eta = jnp.broadcast_to(self.concentration, self.batch_shape)
+        shape = tuple(shape) + self.batch_shape
+        rows = [jnp.zeros(shape + (d,)).at[..., 0].set(1.0)]
+        for i in range(1, d):
+            # onion: y ~ Beta(i/2, η + (d-1-i)/2) is the squared radius of
+            # the first i coordinates; direction uniform on S^{i-1}
+            b = jax.random.beta(_next_key(), i / 2.0,
+                                eta + (d - 1 - i) / 2.0, shape)
+            u = jax.random.normal(_next_key(), shape + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            head = jnp.sqrt(b)[..., None] * u
+            diag = jnp.sqrt(1.0 - b)[..., None]
+            pad = jnp.zeros(shape + (d - i - 1,))
+            rows.append(jnp.concatenate([head, diag, pad], axis=-1))
+        return Tensor(jnp.stack(rows, axis=-2))
+
+    def log_prob(self, value):
+        d = self.dim
+        eta = self.concentration
+
+        def fn(L):
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]  # rows 1..d-1
+            i = jnp.arange(1, d, dtype=jnp.float32)
+            order = d - i - 1 + 2 * (eta[..., None] - 1)
+            unnorm = jnp.sum(order * jnp.log(diag), -1)
+            # normalizer (lkj_cholesky.py log_normalizer): dm1 = d-1,
+            # α = η + dm1/2;  log Z = dm1/2·log π + log Γ_{dm1}(α-½) - dm1·log Γ(α)
+            dm1 = d - 1
+            alpha = eta + 0.5 * dm1
+            log_norm = (0.5 * dm1 * math.log(math.pi)
+                        + multigammaln(alpha - 0.5, dm1)
+                        - dm1 * gammaln(alpha))
+            return unnorm - log_norm
+
+        return apply_op("lkj_log_prob", fn, [value])
+
+
+class TransformedDistribution(Distribution):
+    """transformed_distribution.py — push a base distribution through a
+    chain of bijectors; log_prob pulls back through inverses with the
+    log-det corrections."""
+
+    def __init__(self, base, transforms, name=None):
+        from .transform import ChainTransform, Transform
+
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        if not all(isinstance(t, Transform) for t in transforms):
+            raise TypeError("transforms must be Transform instances")
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms)
+        # event_shape must describe what sample() RETURNS: shape-changing
+        # transforms (Reshape, StickBreaking) alter the trailing dims, so
+        # derive the output shape abstractly through the chain
+        in_shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        try:
+            out = jax.eval_shape(self._chain._forward,
+                                 jax.ShapeDtypeStruct(in_shape, jnp.float32))
+            out_shape = tuple(out.shape)
+        except Exception:
+            out_shape = in_shape
+        nb = len(base.batch_shape)
+        super().__init__(out_shape[:nb] if nb else (), out_shape[nb:])
+
+    def sample(self, shape=(), seed=0):
+        x = self.base.sample(shape)
+        return self._chain.forward(x)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(y):
+            event_dim = len(self.base.event_shape)
+            lp = 0.0
+            for t in reversed(self.transforms):
+                x = t._inverse(y)
+                ldj = t._fldj(x)
+                extra = max(event_dim - t._domain_event_dim, 0)
+                if extra and jnp.ndim(ldj) >= extra:
+                    ldj = jnp.sum(ldj, axis=tuple(range(-extra, 0)))
+                lp = lp - ldj
+                y = x
+            return lp + _unwrap(self.base.log_prob(Tensor(y)))
+
+        return apply_op("transformed_log_prob", fn, [value])

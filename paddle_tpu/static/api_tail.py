@@ -92,24 +92,27 @@ def set_ipu_shard(call_func, index=-1, stage=-1):
     raise NotImplementedError("IPU sharding has no TPU analog")
 
 
-class WeightNormParamAttr:
-    """ParamAttr requesting g·v/||v|| reparameterization (reference:
-    static WeightNormParamAttr); consumed by Layer.create_parameter through
-    nn.utils.weight_norm applied post-construction."""
+def _make_weight_norm_attr():
+    from ..nn.layer_base import ParamAttr
 
-    def __init__(self, dim=None, name=None, initializer=None,
-                 learning_rate=1.0, regularizer=None, trainable=True,
-                 do_model_average=False, need_clip=True):
-        from ..nn.layer_base import ParamAttr
+    class WeightNormParamAttr(ParamAttr):
+        """ParamAttr requesting g·v/||v|| reparameterization (reference:
+        base/param_attr.py WeightNormParamAttr); the static.nn constructors
+        apply nn.utils.weight_norm when they see it."""
 
-        self.dim = dim
-        self._attr = ParamAttr(name=name, initializer=initializer,
-                               learning_rate=learning_rate,
-                               regularizer=regularizer, trainable=trainable,
-                               need_clip=need_clip)
+        def __init__(self, dim=None, name=None, initializer=None,
+                     learning_rate=1.0, regularizer=None, trainable=True,
+                     do_model_average=False, need_clip=True):
+            super().__init__(name=name, initializer=initializer,
+                             learning_rate=learning_rate,
+                             regularizer=regularizer, trainable=trainable,
+                             need_clip=need_clip)
+            self.dim = dim
 
-    def __getattr__(self, name):
-        return getattr(self.__dict__["_attr"], name)
+    return WeightNormParamAttr
+
+
+WeightNormParamAttr = _make_weight_norm_attr()
 
 
 # ---------------------------------------------------------------------------
@@ -368,23 +371,27 @@ class Scope:
         self._vars: dict[str, Tensor] = {}
 
     def var(self, name):
-        v = self._vars.setdefault(name, Tensor(jnp.zeros(())))
-        return _ScopeVar(self, name, v)
+        self._vars.setdefault(name, Tensor(jnp.zeros(())))
+        return _ScopeVar(self, name)
 
     def find_var(self, name):
-        v = self._vars.get(name)
-        return _ScopeVar(self, name, v) if v is not None else None
+        return _ScopeVar(self, name) if name in self._vars else None
 
 
 class _ScopeVar:
-    def __init__(self, scope, name, value):
-        self._scope, self._name, self._value = scope, name, value
+    """Live handle into the scope dict — reads always see the latest value,
+    and the canonical ``var.get_tensor().set(arr, place)`` pattern works
+    (the held Tensor's value is updated in place)."""
+
+    def __init__(self, scope, name):
+        self._scope, self._name = scope, name
 
     def get_tensor(self):
-        return self._value
+        return self._scope._vars[self._name]
 
     def set(self, value, place=None):
-        self._scope._vars[self._name] = Tensor(jnp.asarray(np.asarray(value)))
+        t = self._scope._vars[self._name]
+        t._value = jnp.asarray(np.asarray(value))
 
 
 _global_scope = Scope()

@@ -38,6 +38,18 @@ def _act(out, act):
     return getattr(F, act)(out)
 
 
+def _maybe_weight_norm(layer, weight_attr, name="weight"):
+    """Apply the g·v/||v|| reparameterization when the attr asks for it
+    (reference: LayerHelper.append_weight_norm for WeightNormParamAttr)."""
+    from .api_tail import WeightNormParamAttr
+
+    if isinstance(weight_attr, WeightNormParamAttr):
+        from ..nn.utils import weight_norm
+
+        weight_norm(layer, name=name, dim=weight_attr.dim
+                    if weight_attr.dim is not None else 0)
+
+
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
     """reference: static/nn/common.py fc — flatten trailing dims, affine,
@@ -51,9 +63,10 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
         flat = int(np.prod(shape[num_flatten_dims:]))
         lin = Linear(flat, size, weight_attr=weight_attr,
                      bias_attr=bias_attr if len(outs) == 0 else False)
+        _maybe_weight_norm(lin, weight_attr)
 
-        def reshape_fn(v):
-            return v.reshape(v.shape[:num_flatten_dims] + (flat,))
+        def reshape_fn(v, _flat=flat):  # bind now: the loop reuses `flat`
+            return v.reshape(v.shape[:num_flatten_dims] + (_flat,))
 
         flat_x = apply_op("flatten_fc", reshape_fn, [xi])
         outs.append(lin(flat_x))
@@ -99,6 +112,7 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                   padding=padding, dilation=dilation, groups=groups,
                   weight_attr=param_attr, bias_attr=bias_attr,
                   data_format=data_format)
+    _maybe_weight_norm(conv, param_attr)
     return _act(conv(input), act)
 
 
@@ -112,6 +126,7 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                   padding=padding, dilation=dilation, groups=groups,
                   weight_attr=param_attr, bias_attr=bias_attr,
                   data_format=data_format)
+    _maybe_weight_norm(conv, param_attr)
     return _act(conv(input), act)
 
 
@@ -454,13 +469,16 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
     b = (create_parameter((num_filters,), "float32", attr=bias_attr,
                           is_bias=True) if bias_attr is not False else None)
     inputs = [input, w] + ([b] if b is not None else [])
+    mask = _lengths_mask(input, lengths)
 
     def fn(v, wv, *rest):
         bsz, t, dd = v.shape
+        # padded timesteps must not leak into any context window
+        vm = v if mask is None else jnp.where(mask[..., None], v, 0.0)
         cols = []
         for i in range(k):
             off = start + i
-            rolled = jnp.roll(v, -off, axis=1)
+            rolled = jnp.roll(vm, -off, axis=1)
             idx = jnp.arange(t) + off
             valid = (idx >= 0) & (idx < t)
             cols.append(jnp.where(valid[None, :, None], rolled, 0.0))
@@ -468,6 +486,8 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
         out = ctx @ wv
         if rest:
             out = out + rest[0]
+        if mask is not None:  # zero rows past each sequence's length
+            out = jnp.where(mask[..., None], out, 0.0)
         return out
 
     return _act(apply_op("sequence_conv", fn, inputs), act)

@@ -1,0 +1,247 @@
+"""Distribution-family tail vs scipy oracles (reference:
+python/paddle/distribution/{binomial,cauchy,chi2,continuous_bernoulli,
+exponential_family,independent,lkj_cholesky,multivariate_normal,
+transformed_distribution}.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+from scipy import stats
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def t(x, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+class TestBinomial:
+    def test_log_prob_entropy_moments(self):
+        b = D.Binomial(10, 0.3)
+        assert float(b.log_prob(t(3.0)).numpy()) == pytest.approx(
+            stats.binom.logpmf(3, 10, 0.3), rel=1e-5)
+        assert float(b.entropy().numpy()) == pytest.approx(
+            stats.binom.entropy(10, 0.3), rel=1e-5)
+        assert float(b.mean.numpy()) == pytest.approx(3.0)
+        assert float(b.variance.numpy()) == pytest.approx(2.1)
+        s = b.sample([3000]).numpy()
+        assert abs(s.mean() - 3.0) < 0.15
+
+
+class TestCauchy:
+    def test_log_prob_cdf_entropy(self):
+        c = D.Cauchy(1.0, 2.0)
+        assert float(c.log_prob(t(0.5)).numpy()) == pytest.approx(
+            stats.cauchy.logpdf(0.5, 1.0, 2.0), rel=1e-5)
+        assert float(c.cdf(t(0.5)).numpy()) == pytest.approx(
+            stats.cauchy.cdf(0.5, 1.0, 2.0), rel=1e-5)
+        assert float(c.entropy().numpy()) == pytest.approx(
+            stats.cauchy.entropy(1.0, 2.0), rel=1e-5)
+        s = c.sample([5000]).numpy()
+        assert abs(np.median(s) - 1.0) < 0.2  # median is loc (mean undefined)
+
+
+class TestChi2:
+    def test_gamma_specialization(self):
+        ch = D.Chi2(3.0)
+        assert float(ch.log_prob(t(2.0)).numpy()) == pytest.approx(
+            stats.chi2.logpdf(2.0, 3), rel=1e-5)
+        assert float(ch.df.numpy()) == pytest.approx(3.0)
+        assert isinstance(ch, D.Gamma)
+
+
+class TestContinuousBernoulli:
+    def test_log_prob_normalized(self):
+        lam = 0.3
+        cb = D.ContinuousBernoulli(lam)
+        C = 2 * np.arctanh(1 - 2 * lam) / (1 - 2 * lam)
+        for x in (0.1, 0.7):
+            ref = np.log(C * lam ** x * (1 - lam) ** (1 - x))
+            assert float(cb.log_prob(t(x)).numpy()) == pytest.approx(ref, rel=1e-4)
+        # density integrates to 1
+        xs = np.linspace(1e-4, 1 - 1e-4, 2001, dtype=np.float32)
+        p = cb.prob(t(xs)).numpy()
+        assert np.trapezoid(p, xs) == pytest.approx(1.0, abs=1e-3)
+
+    def test_sampling_matches_mean(self):
+        cb = D.ContinuousBernoulli(0.3)
+        s = cb.sample([8000]).numpy()
+        assert abs(s.mean() - float(cb.mean.numpy())) < 0.02
+        half = D.ContinuousBernoulli(0.5)  # singular point → uniform
+        s2 = half.sample([4000]).numpy()
+        assert abs(s2.mean() - 0.5) < 0.03
+
+
+class TestIndependent:
+    def test_sums_event_dims(self):
+        base = D.Normal(np.zeros((3, 4), np.float32), np.ones((3, 4), np.float32))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+        lp = ind.log_prob(t(np.zeros((3, 4))))
+        np.testing.assert_allclose(lp.numpy(),
+                                   4 * stats.norm.logpdf(0) * np.ones(3),
+                                   rtol=1e-5)
+        ent = ind.entropy()
+        np.testing.assert_allclose(ent.numpy(),
+                                   4 * stats.norm.entropy() * np.ones(3),
+                                   rtol=1e-5)
+        with pytest.raises(ValueError):
+            D.Independent(base, 3)
+
+
+class TestMultivariateNormal:
+    COV = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+
+    def test_log_prob_entropy(self):
+        mvn = D.MultivariateNormal(np.zeros(2, np.float32),
+                                   covariance_matrix=self.COV)
+        v = np.array([0.3, -0.2], np.float32)
+        assert float(mvn.log_prob(t(v)).numpy()) == pytest.approx(
+            stats.multivariate_normal.logpdf(v, np.zeros(2), self.COV),
+            rel=1e-5)
+        assert float(mvn.entropy().numpy()) == pytest.approx(
+            stats.multivariate_normal(np.zeros(2), self.COV).entropy(),
+            rel=1e-5)
+
+    def test_three_parameterizations_agree(self):
+        v = t(np.array([1.0, -1.0], np.float32))
+        by_cov = D.MultivariateNormal(np.zeros(2, np.float32),
+                                      covariance_matrix=self.COV)
+        by_prec = D.MultivariateNormal(np.zeros(2, np.float32),
+                                       precision_matrix=np.linalg.inv(self.COV))
+        by_tril = D.MultivariateNormal(np.zeros(2, np.float32),
+                                       scale_tril=np.linalg.cholesky(self.COV))
+        ref = float(by_cov.log_prob(v).numpy())
+        assert float(by_prec.log_prob(v).numpy()) == pytest.approx(ref, rel=1e-4)
+        assert float(by_tril.log_prob(v).numpy()) == pytest.approx(ref, rel=1e-5)
+        with pytest.raises(ValueError):
+            D.MultivariateNormal(np.zeros(2, np.float32))
+
+    def test_sample_covariance(self):
+        mvn = D.MultivariateNormal(np.zeros(2, np.float32),
+                                   covariance_matrix=self.COV)
+        s = mvn.sample([20000]).numpy()
+        np.testing.assert_allclose(np.cov(s.T), self.COV, atol=0.1)
+
+
+class TestLKJCholesky:
+    def test_d2_marginal_uniform(self):
+        """For d=2, the correlation under LKJ(η) is Beta(η, η) on (-1, 1);
+        η=1 → uniform with std 1/√3."""
+        lkj = D.LKJCholesky(2, 1.0)
+        L = lkj.sample([4000]).numpy()
+        # rows are unit-norm lower-triangular
+        np.testing.assert_allclose((L ** 2).sum(-1), 1.0, atol=1e-5)
+        corr = L[:, 1, 0]
+        assert abs(corr.mean()) < 0.05
+        assert abs(corr.std() - 1 / np.sqrt(3)) < 0.03
+
+    def test_d2_log_prob_uniform_density(self):
+        lkj = D.LKJCholesky(2, 1.0)
+        L = lkj.sample([1]).numpy()[0]
+        # uniform density over corr in (-1,1) = 1/2
+        assert float(lkj.log_prob(t(L)).numpy()) == pytest.approx(
+            np.log(0.5), abs=1e-5)
+
+    def test_concentration_tightens(self):
+        loose = D.LKJCholesky(3, 1.0).sample([2000]).numpy()
+        tight = D.LKJCholesky(3, 10.0).sample([2000]).numpy()
+        off = lambda L: np.abs(np.einsum("bij,bkj->bik", L, L)[
+            :, np.triu_indices(3, 1)[0], np.triu_indices(3, 1)[1]])  # noqa: E731
+        assert off(tight).mean() < off(loose).mean()
+        with pytest.raises(ValueError):
+            D.LKJCholesky(1)
+
+
+class TestTransformedDistribution:
+    def test_exp_normal_is_lognormal(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                       [D.transform.ExpTransform()])
+        for v in (0.5, 1.7):
+            assert float(td.log_prob(t(v)).numpy()) == pytest.approx(
+                stats.lognorm.logpdf(v, 1.0), rel=1e-5)
+        s = td.sample([8000]).numpy()
+        assert abs(np.median(s) - 1.0) < 0.1
+
+    def test_affine_chain(self):
+        td = D.TransformedDistribution(
+            D.Normal(0.0, 1.0), [D.transform.AffineTransform(3.0, 2.0)])
+        assert float(td.log_prob(t(4.0)).numpy()) == pytest.approx(
+            stats.norm.logpdf(4.0, 3.0, 2.0), rel=1e-5)
+        with pytest.raises(TypeError):
+            D.TransformedDistribution(D.Normal(0.0, 1.0), ["not a transform"])
+
+
+class TestTransforms:
+    def test_roundtrips_and_jacobians(self):
+        x = np.linspace(-2, 2, 11).astype(np.float32)
+        for tr, deriv in [
+            (D.transform.ExpTransform(), lambda v: v),  # log|e^x|' = x
+            (D.transform.TanhTransform(),
+             lambda v: np.log(1 - np.tanh(v) ** 2)),
+            (D.transform.SigmoidTransform(),
+             lambda v: np.log(1 / (1 + np.exp(-v)) * (1 - 1 / (1 + np.exp(-v))))),
+            (D.transform.AffineTransform(1.0, 2.5),
+             lambda v: np.full_like(v, np.log(2.5))),
+        ]:
+            y = tr.forward(t(x))
+            back = tr.inverse(y)
+            np.testing.assert_allclose(back.numpy(), x, rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(tr.forward_log_det_jacobian(t(x)).numpy(),
+                                       deriv(x), rtol=1e-4, atol=1e-5)
+
+    def test_stickbreaking_simplex(self):
+        tr = D.transform.StickBreakingTransform()
+        x = np.array([0.2, -0.5, 1.0], np.float32)
+        y = tr.forward(t(x)).numpy()
+        assert y.shape == (4,) and np.all(y > 0)
+        assert y.sum() == pytest.approx(1.0, abs=1e-6)
+        np.testing.assert_allclose(tr.inverse(t(y)).numpy(), x, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_shape_changing_transform_event_shape(self):
+        base = D.Independent(
+            D.Normal(np.zeros(4, np.float32), np.ones(4, np.float32)), 1)
+        td = D.TransformedDistribution(
+            base, [D.transform.ReshapeTransform((4,), (2, 2))])
+        assert td.event_shape == (2, 2)
+        assert tuple(td.sample([3]).shape) == (3, 2, 2)
+
+    def test_chain_mixed_event_rank_fldj(self):
+        """Scalar Exp feeding event-rank-1 StickBreaking: terms must align
+        (was a broadcast error)."""
+        ch = D.transform.ChainTransform(
+            [D.transform.AffineTransform(0.0, 2.0),
+             D.transform.StickBreakingTransform()])
+        x = t(np.array([[0.1, -0.2, 0.3]], np.float32))
+        ldj = ch.forward_log_det_jacobian(x)
+        assert tuple(ldj.shape) == (1,)
+        # numeric jacobian oracle
+        import jax
+        import jax.numpy as jnp
+
+        J = jax.jacfwd(lambda v: ch._forward(v)[:-1])(
+            jnp.asarray([0.1, -0.2, 0.3], jnp.float32) )
+        ref = np.log(abs(np.linalg.det(np.asarray(J))))
+        assert float(ldj.numpy()[0]) == pytest.approx(ref, rel=1e-4)
+
+    def test_exponential_family_bregman_entropy(self):
+        import jax.numpy as jnp
+
+        class EFNormal(D.ExponentialFamily):
+            def __init__(self, loc, scale):
+                self.loc = jnp.float32(loc)
+                self.scale = jnp.float32(scale)
+                super().__init__(())
+
+            @property
+            def _natural_parameters(self):
+                return (self.loc / self.scale ** 2, -0.5 / self.scale ** 2)
+
+            def _log_normalizer(self, n1, n2):
+                return -n1 * n1 / (4 * n2) + 0.5 * jnp.log(-jnp.pi / n2)
+
+        assert float(EFNormal(0.0, 2.0).entropy().numpy()) == pytest.approx(
+            stats.norm.entropy(0, 2), rel=1e-5)

@@ -150,6 +150,64 @@ class TestStaticNN:
         out2 = static.nn.fc(x, 4, num_flatten_dims=2)
         assert tuple(out2.shape) == (2, 2, 4)
 
+    def test_fc_multi_input_replays_in_program(self):
+        """Regression: late-binding closure made multi-input fc replay with
+        the last input's flatten dim."""
+        prog = static.Program()
+        a = np.ones((2, 3), np.float32)
+        b = np.ones((2, 5), np.float32)
+        with static.program_guard(prog):
+            xa = static.data("a", [2, 3], "float32")
+            xb = static.data("b", [2, 5], "float32")
+            out = static.nn.fc([xa, xb], 4)
+        exe = static.Executor()
+        z3, z5 = np.zeros_like(a), np.zeros_like(b)
+        both = exe.run(prog, feed={"a": a, "b": b}, fetch_list=[out])[0]
+        only_a = exe.run(prog, feed={"a": a, "b": z5}, fetch_list=[out])[0]
+        only_b = exe.run(prog, feed={"a": z3, "b": b}, fetch_list=[out])[0]
+        zero = exe.run(prog, feed={"a": z3, "b": z5}, fetch_list=[out])[0]
+        # affine linearity: f(a,b) = f(a,0) + f(0,b) - f(0,0); holds only if
+        # each input replays through ITS OWN flatten/projection
+        np.testing.assert_allclose(both, only_a + only_b - zero, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_weight_norm_param_attr_applied(self):
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        out = static.nn.fc(x, 3,
+                           weight_attr=static.WeightNormParamAttr(dim=0))
+        assert tuple(out.shape) == (2, 3)
+        # the reparameterized layer exposes weight_g/weight_v somewhere in
+        # the recorded op inputs — verify via a fresh layer path
+        from paddle_tpu import nn as _nn
+
+        lin = _nn.Linear(4, 3, weight_attr=None)
+        from paddle_tpu.static.nn import _maybe_weight_norm
+
+        _maybe_weight_norm(lin, static.WeightNormParamAttr(dim=0))
+        assert "weight_g" in lin._parameters
+
+    def test_sequence_conv_masks_padding(self):
+        r = np.random.default_rng(0)
+        x = r.standard_normal((1, 6, 2)).astype(np.float32)
+        short = x.copy()
+        short[:, 2:] = 99.0  # garbage past length
+        out_a = static.nn.sequence_conv(paddle.to_tensor(x), 3,
+                                        filter_size=3, lengths=[2])
+        # same weights? each call creates new params — instead check the
+        # invariant: rows past the length are zero and the valid rows don't
+        # see the pad garbage (run twice on same layer is impossible here,
+        # so check zeroing only)
+        assert np.all(out_a.numpy()[:, 2:] == 0)
+
+    def test_scope_set_pattern(self):
+        sc = static.Scope()
+        v = sc.var("w")
+        v.get_tensor().set(np.full((2,), 7.0, np.float32))
+        np.testing.assert_allclose(sc.var("w").get_tensor().numpy(),
+                                   [7.0, 7.0])
+        v.set(np.zeros(3, np.float32))
+        assert tuple(v.get_tensor().shape) == (3,)
+
     def test_conv_and_norm_constructors(self):
         img = paddle.to_tensor(np.random.default_rng(0)
                                .standard_normal((2, 3, 8, 8)).astype(np.float32))
