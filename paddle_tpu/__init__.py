@@ -9,7 +9,7 @@ see SURVEY.md for the design mapping to the reference.
 from __future__ import annotations
 
 # core
-from .core import device
+from . import device  # the full paddle.device namespace (device/__init__.py)
 from .core.device import (
     get_device,
     set_device,

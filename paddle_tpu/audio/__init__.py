@@ -190,3 +190,13 @@ class MFCC(Layer):
         return apply_op("mfcc_dct",
                         lambda s, d: jnp.einsum("...mt,mk->...kt", s, d),
                         [lm, self.dct])
+
+
+from . import backends  # noqa: E402,F401
+from . import datasets  # noqa: E402,F401
+from . import features  # noqa: E402,F401
+from . import functional  # noqa: E402,F401
+from .backends import info, load, save  # noqa: E402,F401
+
+__all__ += ["backends", "datasets", "features", "functional", "info",
+            "load", "save"]

@@ -1,0 +1,112 @@
+"""Audio IO backend (reference: python/paddle/audio/backends/wave_backend.py
+— PCM16 WAV via the stdlib wave module; backend registry surface from
+backends/init_backend.py)."""
+
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save", "get_current_backend",
+           "list_available_backends", "set_backend"]
+
+
+class AudioInfo:
+    """Return type of info() (reference backends/backend.py:25)."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample}, "
+                f"encoding={self.encoding!r})")
+
+
+def info(filepath) -> AudioInfo:
+    """wave_backend.py:43 — header-only metadata read."""
+    file_obj = filepath if hasattr(filepath, "read") else open(filepath, "rb")
+    try:
+        f = wave.open(file_obj)
+    except wave.Error as e:
+        file_obj.close()
+        raise NotImplementedError(
+            "only PCM16 WAV is supported by the wave backend") from e
+    out = AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                    f.getsampwidth() * 8, "PCM_S")
+    file_obj.close()
+    return out
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """wave_backend.py:95 — returns (waveform Tensor, sample_rate);
+    normalize=True → float32 in (-1, 1), else raw int16 values."""
+    from ..core.tensor import Tensor
+
+    file_obj = filepath if hasattr(filepath, "read") else open(filepath, "rb")
+    try:
+        f = wave.open(file_obj)
+    except wave.Error as e:
+        file_obj.close()
+        raise NotImplementedError(
+            "only PCM16 WAV is supported by the wave backend") from e
+    channels = f.getnchannels()
+    sample_rate = f.getframerate()
+    frames = f.getnframes()
+    content = f.readframes(frames)
+    file_obj.close()
+    audio = np.frombuffer(content, dtype=np.int16).astype(np.float32)
+    if normalize:
+        audio = audio / (2 ** 15)
+    waveform = np.reshape(audio, (frames, channels))
+    if num_frames != -1:
+        waveform = waveform[frame_offset:frame_offset + num_frames, :]
+    elif frame_offset:
+        waveform = waveform[frame_offset:, :]
+    if channels_first:
+        waveform = waveform.T
+    return Tensor(np.ascontiguousarray(waveform)), sample_rate
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding=None,
+         bits_per_sample=16):
+    """wave_backend.py:174 — PCM16 WAV writer."""
+    from ..core.tensor import _unwrap
+
+    arr = np.asarray(_unwrap(src))
+    assert arr.ndim == 2, "Expected 2D tensor"
+    if bits_per_sample not in (None, 16):
+        raise ValueError("wave backend supports 16 bits per sample only")
+    if channels_first:
+        arr = arr.T  # → (time, channels)
+    if arr.dtype != np.int16:
+        arr = (np.clip(arr, -1.0, 1.0) * (2 ** 15 - 1)).astype(np.int16)
+    with wave.open(str(filepath), "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(arr.tobytes())
+
+
+def get_current_backend() -> str:
+    return "wave_backend"
+
+
+def list_available_backends() -> list[str]:
+    return ["wave_backend"]
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            "only the stdlib wave backend ships in this build (soundfile "
+            "is an optional dependency the image does not carry)")

@@ -1,0 +1,123 @@
+"""Audio datasets (reference: python/paddle/audio/datasets/ — tess.py,
+esc50.py).  Zero-egress: a local extracted archive dir is required; the
+waveform/feature pipeline matches the reference (wave backend load +
+optional feature mode)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+from .backends import load as _load_wav
+
+__all__ = ["TESS", "ESC50"]
+
+
+class AudioClassificationDataset(Dataset):
+    """Common machinery (reference audio/datasets/dataset.py): files +
+    labels, feature_method in raw/mfcc/logmelspectrogram/melspectrogram/
+    spectrogram."""
+
+    def __init__(self, files, labels, feature_method="raw",
+                 **feature_kwargs):
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feature_method = feature_method
+        self.feature_kwargs = feature_kwargs
+        self._feature_layer = None  # built once: filterbank/DCT/window are
+        self._feature_sr = None     # sample-rate-dependent constants
+
+    def _feature(self, waveform, sr):
+        from ..core.tensor import Tensor
+
+        if self.feature_method == "raw":
+            return waveform
+        if self._feature_layer is None or self._feature_sr != sr:
+            from . import (LogMelSpectrogram, MelSpectrogram, MFCC,
+                           Spectrogram)
+
+            cls = {"spectrogram": Spectrogram,
+                   "melspectrogram": MelSpectrogram,
+                   "logmelspectrogram": LogMelSpectrogram,
+                   "mfcc": MFCC}.get(self.feature_method)
+            if cls is None:
+                raise ValueError(
+                    f"unknown feature_method {self.feature_method!r}")
+            kwargs = dict(self.feature_kwargs)
+            if self.feature_method != "spectrogram":
+                kwargs.setdefault("sr", sr)
+            self._feature_layer = cls(**kwargs)
+            self._feature_sr = sr
+        x = waveform if isinstance(waveform, Tensor) else Tensor(waveform)
+        return self._feature_layer(x)
+
+    def __getitem__(self, idx):
+        waveform, sr = _load_wav(self.files[idx])
+        feat = self._feature(waveform, sr)
+        return np.asarray(feat.numpy()), np.array(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(AudioClassificationDataset):
+    """tess.py — Toronto emotional speech set: 7 emotions encoded in the
+    filename (``..._<emotion>.wav``); 5-fold split by file order."""
+
+    n_folds = 5
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feature_type="raw",
+                 archive=None, **kwargs):
+        if archive is None or not os.path.isdir(str(archive)):
+            raise RuntimeError(
+                "TESS: zero-egress build — pass archive= pointing at the "
+                "extracted TESS directory of wav files")
+        assert 1 <= split <= n_folds
+        files, labels = [], []
+        wavs = sorted(
+            os.path.join(r, f) for r, _, fs in os.walk(archive)
+            for f in fs if f.lower().endswith(".wav"))
+        for i, path in enumerate(wavs):
+            emotion = os.path.splitext(os.path.basename(path))[0] \
+                .split("_")[-1].lower()
+            if emotion not in self.label_list:
+                continue
+            fold = i % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                files.append(path)
+                labels.append(self.label_list.index(emotion))
+        super().__init__(files, labels, feature_method=feature_type, **kwargs)
+
+
+class ESC50(AudioClassificationDataset):
+    """esc50.py — environmental sounds: 50 classes, fold encoded as the
+    first filename field (``fold-srcfile-take-target.wav``); ``split`` picks
+    the held-out fold."""
+
+    n_folds = 5
+
+    def __init__(self, mode="train", split=1, feature_type="raw",
+                 archive=None, **kwargs):
+        if archive is None or not os.path.isdir(str(archive)):
+            raise RuntimeError(
+                "ESC50: zero-egress build — pass archive= pointing at the "
+                "extracted ESC-50 audio directory")
+        files, labels = [], []
+        wavs = sorted(
+            os.path.join(r, f) for r, _, fs in os.walk(archive)
+            for f in fs if f.lower().endswith(".wav"))
+        for path in wavs:
+            base = os.path.splitext(os.path.basename(path))[0]
+            parts = base.split("-")
+            if len(parts) != 4:
+                continue
+            fold, target = int(parts[0]), int(parts[3])
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                files.append(path)
+                labels.append(target)
+        super().__init__(files, labels, feature_method=feature_type, **kwargs)

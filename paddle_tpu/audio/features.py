@@ -1,0 +1,6 @@
+"""paddle.audio.features (reference: python/paddle/audio/features/layers.py)
+— submodule view over the feature Layers."""
+
+from . import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram  # noqa: F401
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

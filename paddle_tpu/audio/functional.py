@@ -1,0 +1,31 @@
+"""paddle.audio.functional (reference: python/paddle/audio/functional/) —
+submodule view over the window/filterbank math."""
+
+from . import (  # noqa: F401
+    compute_fbank_matrix,
+    create_dct,
+    get_window,
+    hz_to_mel,
+    mel_to_hz,
+)
+
+__all__ = ["compute_fbank_matrix", "create_dct", "get_window", "hz_to_mel",
+           "mel_to_hz"]
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """functional.py power_to_db — 10 log10(S/ref) with floor + dynamic-range
+    clip."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor, _unwrap
+
+    s = _unwrap(spect)
+    log_spec = 10.0 * (jnp.log10(jnp.maximum(s, amin))
+                       - jnp.log10(jnp.maximum(jnp.asarray(ref_value), amin)))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+__all__.append("power_to_db")

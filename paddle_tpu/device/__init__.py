@@ -1,0 +1,201 @@
+"""paddle.device namespace (reference: python/paddle/device/__init__.py).
+
+PJRT/XLA owns streams and contexts on TPU, so Stream/Event keep the API
+surface with host-side synchronization semantics (synchronize = device
+fence via a blocking transfer; events record completion points)."""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ..core.device import (  # noqa: F401
+    Place,
+    current_device,
+    device_count,
+    empty_cache,
+    get_device,
+    local_device_count,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    max_memory_allocated,
+    max_memory_reserved,
+    memory_allocated,
+    memory_stats,
+    set_device,
+    synchronize,
+)
+
+__all__ = [
+    "get_cudnn_version", "set_device", "get_device", "XPUPlace", "IPUPlace",
+    "is_compiled_with_xpu", "is_compiled_with_ipu", "is_compiled_with_cinn",
+    "is_compiled_with_cuda", "is_compiled_with_rocm",
+    "is_compiled_with_distribute", "is_compiled_with_custom_device",
+    "get_all_device_type", "get_all_custom_device_type",
+    "get_available_device", "get_available_custom_device", "Stream", "Event",
+    "current_stream", "set_stream", "stream_guard", "synchronize",
+]
+
+
+def get_cudnn_version():
+    """None — no CUDA in this build (the reference returns the cudnn int)."""
+    return None
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False  # XLA plays CINN's role (SURVEY §1 L9)
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True  # collectives/mesh support is built in
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    return any(d.platform == device_type for d in jax.devices())
+
+
+def get_all_device_type() -> list[str]:
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type() -> list[str]:
+    return [p for p in get_all_device_type() if p not in ("cpu", "gpu")]
+
+
+def get_available_device() -> list[str]:
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device() -> list[str]:
+    return [s for s in get_available_device()
+            if not s.startswith(("cpu", "gpu"))]
+
+
+def XPUPlace(dev_id: int = 0):
+    raise NotImplementedError("XPU (Kunlun) hardware has no TPU analog; "
+                              "use set_device('tpu')")
+
+
+def IPUPlace():
+    raise NotImplementedError("IPU (Graphcore) hardware has no TPU analog; "
+                              "use set_device('tpu')")
+
+
+class Event:
+    """Completion marker (reference device/__init__.py Event).  record()
+    snapshots the device's in-flight work; synchronize()/query() fence it."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._device = device
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+        # the fence target is whatever was enqueued before record(): on
+        # PJRT the only observable fence is a blocking sync
+        self._fence = True
+
+    def query(self) -> bool:
+        return True  # after a blocking fence nothing is pending
+
+    def synchronize(self):
+        if self._recorded:
+            synchronize()
+
+    def elapsed_time(self, end_event) -> float:
+        raise NotImplementedError("PJRT exposes no device-side timers; use "
+                                  "the profiler (paddle_tpu.profiler)")
+
+
+class Stream:
+    """Work queue handle (reference device/__init__.py Stream).  XLA orders
+    work internally; the surface keeps priority/synchronize/record_event."""
+
+    def __init__(self, device=None, priority=2, stream_base=None):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize()
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def query(self) -> bool:
+        return True
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _current_stream
+
+
+def set_stream(stream: Stream) -> Stream:
+    global _current_stream
+    prev, _current_stream = _current_stream, stream
+    return prev
+
+
+@contextlib.contextmanager
+def stream_guard(stream: Stream):
+    prev = set_stream(stream)
+    try:
+        yield
+    finally:
+        set_stream(prev)
+
+
+class _CudaNamespace:
+    """paddle.device.cuda compatibility view — the accelerator here is the
+    TPU; memory stats come from PJRT."""
+
+    Stream = Stream
+    Event = Event
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    memory_allocated = staticmethod(memory_allocated)
+    memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+    synchronize = staticmethod(synchronize)
+    current_stream = staticmethod(current_stream)
+    stream_guard = staticmethod(stream_guard)
+
+    @staticmethod
+    def device_count():
+        return jax.device_count()
+
+    @staticmethod
+    def get_device_name(device=None):
+        d = current_device()
+        return getattr(d, "device_kind", d.platform)
+
+    @staticmethod
+    def get_device_capability(device=None):
+        return (0, 0)  # CUDA compute capability has no TPU analog
+
+
+cuda = _CudaNamespace()

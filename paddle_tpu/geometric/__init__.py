@@ -127,9 +127,16 @@ def reindex_graph(x, neighbors, count, name=None):
     xv = np.asarray(_unwrap(x))
     nb = np.asarray(_unwrap(neighbors))
     # local ids: x's nodes keep their order (0..len(x)-1), new neighbor ids
-    # are appended in first-appearance order of the sorted unique set
-    extra = np.setdiff1d(nb, xv)
-    node_ids = np.concatenate([xv, extra])
+    # are appended in FIRST-APPEARANCE order (the reference contract:
+    # x=[0,1,2], neighbors=[8,9,0,4,7,6,7] → out_nodes=[0,1,2,8,9,4,7,6])
+    seen = set(int(v) for v in xv)
+    extra = []
+    for v in nb:
+        if int(v) not in seen:
+            seen.add(int(v))
+            extra.append(v)
+    node_ids = np.concatenate([xv, np.asarray(extra, xv.dtype)]) \
+        if extra else xv.copy()
     lookup = {int(v): i for i, v in enumerate(node_ids)}
     reindex_src = np.fromiter((lookup[int(v)] for v in nb), np.int64, len(nb))
     cnt = np.asarray(_unwrap(count))

@@ -2,3 +2,31 @@
 
 from . import asp  # noqa: F401
 from . import nn  # noqa: F401
+from . import operators  # noqa: F401
+from . import optimizer  # noqa: F401
+from .operators import (  # noqa: F401
+    graph_khop_sampler,
+    graph_reindex,
+    graph_sample_neighbors,
+    graph_send_recv,
+    identity_loss,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+# `paddle.incubate.inference` in the reference is the paddle-inference
+# wrapper namespace; here it aliases the deployable-artifact engine
+from .. import inference  # noqa: F401
+
+__all__ = [
+    "asp", "nn", "operators", "optimizer", "inference",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "graph_send_recv", "graph_khop_sampler", "graph_reindex",
+    "graph_sample_neighbors", "identity_loss", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "LookAhead", "ModelAverage",
+]

@@ -1,0 +1,162 @@
+"""Incubate operator tail (reference: python/paddle/incubate/operators/ —
+graph_send_recv.py, graph_khop_sampler.py, graph_reindex.py,
+graph_sample_neighbors.py, softmax_mask_fuse.py; incubate/nn/loss.py).
+
+The segment/message-passing math lives in paddle_tpu.geometric (the modern
+home); these are the legacy incubate entry points over the same kernels.
+Graph SAMPLING is host-side numpy — it is data-dependent-shape control
+logic feeding the input pipeline, exactly the part that should NOT be on
+the TPU."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, _unwrap
+from ..geometric import (  # noqa: F401  (re-exported, reference aliases)
+    reindex_graph,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+    send_u_recv,
+)
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "graph_send_recv", "graph_reindex", "graph_sample_neighbors",
+    "graph_khop_sampler", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "identity_loss",
+]
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """graph_send_recv.py:46 — legacy name for geometric.send_u_recv."""
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """graph_reindex.py:35 — legacy name for geometric.reindex_graph (the
+    hashtable buffers are a CUDA optimization; ignored here)."""
+    return reindex_graph(x, neighbors, count)
+
+
+def _csc_neighbors(row, colptr, node):
+    return row[colptr[node]:colptr[node + 1]]
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """graph_sample_neighbors.py:77 — uniform neighbor sampling on a CSC
+    graph; returns (out_neighbors, out_count[, out_eids])."""
+    rowv = np.asarray(_unwrap(row)).reshape(-1)
+    cp = np.asarray(_unwrap(colptr)).reshape(-1)
+    nodes = np.asarray(_unwrap(input_nodes)).reshape(-1)
+    eidsv = None if eids is None else np.asarray(_unwrap(eids)).reshape(-1)
+    out_n, out_c, out_e = [], [], []
+    for n in nodes:
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or sample_size >= deg:
+            picked = np.arange(lo, hi)
+        else:
+            picked = lo + np.random.choice(deg, sample_size, replace=False)
+        out_n.append(rowv[picked])
+        out_c.append(len(picked))
+        if eidsv is not None:
+            out_e.append(eidsv[picked])
+    neigh = Tensor(np.concatenate(out_n) if out_n else np.zeros(0, rowv.dtype))
+    count = Tensor(np.asarray(out_c, np.int32))
+    if return_eids:
+        if eidsv is None:
+            raise ValueError("return_eids=True requires eids")
+        return neigh, count, Tensor(np.concatenate(out_e)
+                                    if out_e else np.zeros(0, eidsv.dtype))
+    return neigh, count
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """graph_khop_sampler.py:63 — multi-layer sampling + subgraph reindex;
+    returns (edge_src, edge_dst, sample_index, reindex_nodes[, edge_eids])."""
+    nodes = np.asarray(_unwrap(input_nodes)).reshape(-1)
+    frontier = nodes
+    all_src, all_dst, all_eids = [], [], []
+    for size in sample_sizes:
+        if return_eids:
+            neigh, count, e = graph_sample_neighbors(
+                row, colptr, Tensor(frontier), eids=sorted_eids,
+                sample_size=size, return_eids=True)
+            all_eids.append(np.asarray(_unwrap(e)))
+        else:
+            neigh, count = graph_sample_neighbors(
+                row, colptr, Tensor(frontier), sample_size=size)
+        neigh = np.asarray(_unwrap(neigh))
+        count = np.asarray(_unwrap(count))
+        dst = np.repeat(frontier, count)
+        all_src.append(neigh)
+        all_dst.append(dst)
+        frontier = np.unique(neigh)
+    src = np.concatenate(all_src) if all_src else np.zeros(0, np.int64)
+    dst = np.concatenate(all_dst) if all_dst else np.zeros(0, np.int64)
+    # subgraph reindex: input nodes first, then newly-seen nodes in order
+    order = {int(n): i for i, n in enumerate(nodes)}
+    for n in np.concatenate([src, dst]):
+        if int(n) not in order:
+            order[int(n)] = len(order)
+    remap = np.vectorize(lambda n: order[int(n)])
+    edge_src = remap(src) if src.size else src
+    edge_dst = remap(dst) if dst.size else dst
+    sample_index = np.asarray(sorted(order, key=order.get), np.int64)
+    reindex_nodes = remap(nodes) if nodes.size else nodes
+    outs = (Tensor(np.asarray(edge_src, np.int64)),
+            Tensor(np.asarray(edge_dst, np.int64)),
+            Tensor(sample_index),
+            Tensor(np.asarray(reindex_nodes, np.int64)))
+    if return_eids:
+        return outs + (Tensor(np.concatenate(all_eids)),)
+    return outs
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax_mask_fuse.py:26 — softmax(x + mask); XLA fuses the add into
+    the reduction, which is the entire point of the CUDA kernel."""
+    def fn(v, m):
+        return jax.nn.softmax((v + m).astype(jnp.float32), axis=-1).astype(v.dtype)
+
+    return apply_op("softmax_mask_fuse", fn, [x, mask])
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax_mask_fuse_upper_triangle — causal-masked softmax (mask the
+    upper triangle above the diagonal) without materializing the mask."""
+    def fn(v):
+        sq, sk = v.shape[-2], v.shape[-1]
+        tri = jnp.tril(jnp.ones((sq, sk), bool))
+        logits = jnp.where(tri, v, -jnp.inf)
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
+
+    return apply_op("softmax_mask_fuse_upper_triangle", fn, [x])
+
+
+def identity_loss(x, reduction="none"):
+    """incubate/nn/loss.py:36 — mark/reduce a loss head."""
+    if isinstance(reduction, int):
+        reduction = {0: "sum", 1: "mean", 2: "none"}.get(reduction, "none")
+
+    def fn(v):
+        if reduction == "mean":
+            return jnp.mean(v)
+        if reduction == "sum":
+            return jnp.sum(v)
+        return v
+
+    return apply_op("identity_loss", fn, [x])

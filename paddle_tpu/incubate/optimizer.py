@@ -1,0 +1,120 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/
+lookahead.py:36, modelaverage.py:42)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import _unwrap
+from ..optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """k steps forward, 1 step back (lookahead.py:36): every ``k`` inner
+    steps the slow weights move α of the way toward the fast weights, and
+    the fast weights reset to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError("k should be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        params = inner_optimizer._parameter_list or []
+        super().__init__(learning_rate=inner_optimizer._lr, parameters=params)
+        # slow weights snapshot LAZILY at the first step (the reference's
+        # accumulator init): weights loaded after construction
+        # (set_state_dict) must seed the slow copy, not the init-time values
+        self._slow: dict[int, jnp.ndarray] = {}
+        self._k_count = 0
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def step(self):
+        if not self._slow:
+            self._slow = {id(p): _unwrap(p) for p in self._parameter_list}
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k == 0:
+            for p in self._parameter_list:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (_unwrap(p) - slow)
+                self._slow[id(p)] = slow
+                p._value = slow.astype(p.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters over a sliding window
+    (modelaverage.py:42): accumulates sums, apply() swaps the averaged
+    weights in (optionally restorable)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=list(parameters or []))
+        self.avg_rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._sum: dict[int, jnp.ndarray] = {}
+        self._count = 0
+        self._backup: dict[int, jnp.ndarray] = {}
+
+    def step(self):
+        """Fold the CURRENT parameter values into the running sums (called
+        after the training optimizer's step)."""
+        self._count += 1
+        for p in self._parameter_list:
+            v = _unwrap(p).astype(jnp.float32)
+            acc = self._sum.get(id(p))
+            self._sum[id(p)] = v if acc is None else acc + v
+        # restart the window like the reference when it overruns
+        window = max(self.min_window,
+                     min(self.max_window, int(self._count * self.avg_rate)))
+        if self._count > window + self.max_window:
+            self._sum = {id(p): _unwrap(p).astype(jnp.float32)
+                         for p in self._parameter_list}
+            self._count = 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager swapping in the averaged weights."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            if self._count == 0:
+                raise RuntimeError("ModelAverage.apply before any step()")
+            for p in self._parameter_list:
+                self._backup[id(p)] = _unwrap(p)
+                p._value = (self._sum[id(p)] / self._count).astype(p.dtype)
+            try:
+                yield self
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return cm()
+
+    def restore(self, executor=None):
+        for p in self._parameter_list:
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()

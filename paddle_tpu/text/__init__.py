@@ -84,3 +84,18 @@ class ViterbiDecoder(Layer):
     def forward(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+from . import datasets  # noqa: E402,F401
+from .datasets import (  # noqa: E402,F401
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
+
+__all__ += ["datasets", "Conll05st", "Imdb", "Imikolov", "Movielens",
+            "UCIHousing", "WMT14", "WMT16"]

@@ -1,0 +1,510 @@
+"""Text datasets (reference: python/paddle/text/datasets/ — uci_housing.py,
+imdb.py, imikolov.py, movielens.py, wmt14.py, wmt16.py, conll05.py).
+
+Zero-egress build: the reference's auto-download path is gated — every
+dataset requires a local ``data_file`` (the same archive the reference
+downloads) and parses it with the reference's format logic."""
+
+from __future__ import annotations
+
+import collections
+import re
+import string
+import tarfile
+import zipfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "Conll05st",
+           "WMT14", "WMT16"]
+
+_DOWNLOAD_MSG = ("{name}: this build has no network egress — pass "
+                 "data_file= pointing at the locally available archive "
+                 "(the file the reference would download)")
+
+
+def _require_file(data_file, name):
+    if data_file is None:
+        raise RuntimeError(_DOWNLOAD_MSG.format(name=name))
+    return data_file
+
+
+class UCIHousing(Dataset):
+    """uci_housing.py — 13 features + price, whitespace floats; features
+    mean-normalized by (max-min), 80/20 train/test split."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        assert mode.lower() in ("train", "test")
+        self.mode = mode.lower()
+        self.data_file = _require_file(data_file, "UCIHousing")
+        self.dtype = "float32"
+        self._load_data()
+
+    def _load_data(self, feature_num=14, ratio=0.8):
+        data = np.fromfile(self.data_file, sep=" ")
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        maximums = data.max(axis=0)
+        minimums = data.min(axis=0)
+        avgs = data.sum(axis=0) / data.shape[0]
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maximums[i] - minimums[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (np.array(row[:-1]).astype(self.dtype),
+                np.array(row[-1:]).astype(self.dtype))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """imdb.py — aclImdb tarball; ad-hoc tokenization (punctuation strip +
+    lower), vocab by frequency (> cutoff), pos label 0 / neg label 1."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        assert mode.lower() in ("train", "test")
+        self.mode = mode.lower()
+        self.data_file = _require_file(data_file, "Imdb")
+        self.word_idx = self._build_work_dict(cutoff)
+        self._load_anno()
+
+    def _tokenize(self, pattern):
+        data = []
+        with tarfile.open(self.data_file) as tarf:
+            tf = tarf.next()
+            while tf is not None:
+                if bool(pattern.match(tf.name)):
+                    data.append(
+                        tarf.extractfile(tf).read().rstrip(b"\n\r")
+                        .translate(None, string.punctuation.encode("latin-1"))
+                        .lower().split())
+                tf = tarf.next()
+        return data
+
+    def _build_work_dict(self, cutoff):
+        word_freq = collections.defaultdict(int)
+        pattern = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        for doc in self._tokenize(pattern):
+            for word in doc:
+                word_freq[word] += 1
+        word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+        dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+        if not dictionary:
+            return {b"<unk>": 0}
+        words, _ = list(zip(*dictionary))
+        word_idx = dict(zip(words, range(len(words))))
+        word_idx[b"<unk>"] = len(words)
+        return word_idx
+
+    def _load_anno(self):
+        pos = re.compile(rf"aclImdb/{self.mode}/pos/.*\.txt$")
+        neg = re.compile(rf"aclImdb/{self.mode}/neg/.*\.txt$")
+        unk = self.word_idx[b"<unk>"]
+        self.docs, self.labels = [], []
+        for doc in self._tokenize(pos):
+            self.docs.append([self.word_idx.get(w, unk) for w in doc])
+            self.labels.append(0)
+        for doc in self._tokenize(neg):
+            self.docs.append([self.word_idx.get(w, unk) for w in doc])
+            self.labels.append(1)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """imikolov.py — PTB language modeling from the simple-examples tar;
+    NGRAM windows or SEQ (src, trg) pairs."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        assert data_type.upper() in ("NGRAM", "SEQ")
+        assert mode.lower() in ("train", "test", "valid")
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.mode = "valid" if mode.lower() == "test" else mode.lower()
+        self.min_word_freq = min_word_freq
+        self.data_file = _require_file(data_file, "Imikolov")
+        self.word_idx = self._build_work_dict(min_word_freq)
+        self._load_anno()
+
+    @staticmethod
+    def word_count(f, word_freq=None):
+        if word_freq is None:
+            word_freq = collections.defaultdict(int)
+        for line in f:
+            for w in line.strip().split():
+                word_freq[w] += 1
+            word_freq[b"<s>"] += 1
+            word_freq[b"<e>"] += 1
+        return word_freq
+
+    def _member(self, tf, suffix):
+        for m in tf.getmembers():
+            if m.name.endswith(suffix):
+                return m.name
+        raise KeyError(f"{suffix} not in archive")
+
+    def _build_work_dict(self, cutoff):
+        with tarfile.open(self.data_file) as tf:
+            trainf = tf.extractfile(self._member(tf, "ptb.train.txt"))
+            testf = tf.extractfile(self._member(tf, "ptb.valid.txt"))
+            word_freq = self.word_count(testf, self.word_count(trainf))
+            word_freq.pop(b"<unk>", None)
+            word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+            word_freq_sorted = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+            if not word_freq_sorted:
+                return {b"<unk>": 0, b"<s>": 1, b"<e>": 2}
+            words, _ = list(zip(*word_freq_sorted))
+            word_idx = dict(zip(words, range(len(words))))
+            word_idx[b"<unk>"] = len(words)
+            for tok in (b"<s>", b"<e>"):
+                word_idx.setdefault(tok, len(word_idx))
+        return word_idx
+
+    def _load_anno(self):
+        self.data = []
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(self._member(tf, f"ptb.{self.mode}.txt"))
+            unk = self.word_idx[b"<unk>"]
+            for line in f:
+                if self.data_type == "NGRAM":
+                    assert self.window_size > -1, "Invalid gram length"
+                    toks = [b"<s>", *line.strip().split(), b"<e>"]
+                    if len(toks) >= self.window_size:
+                        ids = [self.word_idx.get(w, unk) for w in toks]
+                        for i in range(self.window_size, len(ids) + 1):
+                            self.data.append(tuple(ids[i - self.window_size:i]))
+                else:
+                    toks = line.strip().split()
+                    ids = [self.word_idx.get(w, unk) for w in toks]
+                    src = [self.word_idx[b"<s>"], *ids]
+                    trg = [*ids, self.word_idx[b"<e>"]]
+                    if self.window_size > 0 and len(src) > self.window_size:
+                        continue
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """movielens.py — ml-1m zip: users/movies metadata joined onto ratings;
+    each sample is (uid, gender, age, job, mov_id, categories, title_ids,
+    rating in [-5, 5])."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        self.mode = mode.lower()
+        self.test_ratio = test_ratio
+        self.data_file = _require_file(data_file, "Movielens")
+        np.random.seed(rand_seed)
+        self._load_meta_info()
+        self._load_data()
+
+    def _load_meta_info(self):
+        self.movie_info, self.user_info = {}, {}
+        self.categories_dict, self.movie_title_dict = {}, {}
+        with zipfile.ZipFile(self.data_file) as package:
+            with package.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    line = line.decode(encoding="latin")
+                    movie_id, title, categories = line.strip().split("::")
+                    categories = categories.split("|")
+                    m = re.match(r"^(.*)\((\d+)\)$", title)
+                    title = m.group(1) if m else title  # strip '(year)'
+                    for c in categories:
+                        self.categories_dict.setdefault(
+                            c, len(self.categories_dict))
+                    for w in title.split():
+                        self.movie_title_dict.setdefault(
+                            w.lower(), len(self.movie_title_dict))
+                    self.movie_info[int(movie_id)] = (int(movie_id), title,
+                                                      categories)
+            with package.open("ml-1m/users.dat") as f:
+                for line in f:
+                    line = line.decode(encoding="latin")
+                    uid, gender, age, job, _ = line.strip().split("::")
+                    self.user_info[int(uid)] = (
+                        int(uid), 0 if gender == "M" else 1, int(age),
+                        int(job))
+
+    def _load_data(self):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self.data_file) as package:
+            with package.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    line = line.decode(encoding="latin")
+                    if (np.random.random() < self.test_ratio) != is_test:
+                        continue
+                    uid, mov_id, rating, _ = line.strip().split("::")
+                    mov_id = int(mov_id)
+                    if mov_id not in self.movie_info:
+                        continue
+                    rating = float(rating) * 2 - 5.0
+                    _, title, cats = self.movie_info[mov_id]
+                    uid_, gender, age, job = self.user_info[int(uid)]
+                    self.data.append((
+                        [uid_], [gender], [age], [job], [mov_id],
+                        [self.categories_dict[c] for c in cats],
+                        [self.movie_title_dict[w.lower()]
+                         for w in title.split()],
+                        [rating]))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+_UNK_IDX = 2
+
+
+class WMT14(Dataset):
+    """wmt14.py — preprocessed tarball with {train,test,gen}/ tsv pairs and
+    src.dict/trg.dict vocabularies; yields (src_ids, trg_ids, trg_ids_next)
+    with <s>/<e>/<unk> at indices 0/1/2."""
+
+    START, END, UNK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        assert mode.lower() in ("train", "test", "gen")
+        self.mode = mode.lower()
+        self.data_file = _require_file(data_file, "WMT14")
+        assert dict_size > 0, "dict_size should be set as positive number"
+        self.dict_size = dict_size
+        self._load_data()
+
+    def _load_data(self):
+        def to_dict(fd, size):
+            out = {}
+            for i, line in enumerate(fd):
+                if i >= size:
+                    break
+                out[line.strip().decode()] = i
+            return out
+
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file, mode="r") as f:
+            src_names = [m.name for m in f if m.name.endswith("src.dict")]
+            trg_names = [m.name for m in f if m.name.endswith("trg.dict")]
+            assert len(src_names) == 1 and len(trg_names) == 1
+            self.src_dict = to_dict(f.extractfile(src_names[0]), self.dict_size)
+            self.trg_dict = to_dict(f.extractfile(trg_names[0]), self.dict_size)
+            data_names = [m.name for m in f
+                          if m.name.endswith(f"{self.mode}/{self.mode}")]
+            for name in data_names:
+                for line in f.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_words = parts[0].split()
+                    src_ids = [self.src_dict.get(w, _UNK_IDX)
+                               for w in [self.START, *src_words, self.END]]
+                    trg_words = parts[1].split()
+                    trg_ids = [self.trg_dict.get(w, _UNK_IDX)
+                               for w in trg_words]
+                    if len(src_ids) > 80 or len(trg_ids) > 80:
+                        continue
+                    self.src_ids.append(src_ids)
+                    self.trg_ids.append([self.trg_dict[self.START], *trg_ids])
+                    self.trg_ids_next.append(
+                        [*trg_ids, self.trg_dict[self.END]])
+
+    def get_dict(self, reverse=False):
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT16(Dataset):
+    """wmt16.py — tarball with wmt16/{train,val,test} tab-separated pairs;
+    vocab built from the corpus with frequency cutoff (the reference writes
+    en/de vocab files next to the archive)."""
+
+    START, END, UNK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        assert mode.lower() in ("train", "test", "val")
+        self.mode = mode.lower()
+        self.data_file = _require_file(data_file, "WMT16")
+        self.lang = lang
+        assert src_dict_size > 0 and trg_dict_size > 0
+        # <s>/<e>/<unk> always present → effective floor of 3
+        self.src_dict_size = max(src_dict_size, 3)
+        self.trg_dict_size = max(trg_dict_size, 3)
+        self._load_data()
+
+    def _build_dict(self, lines, size):
+        freq = collections.defaultdict(int)
+        for line in lines:
+            for w in line.split():
+                freq[w] += 1
+        vocab = {self.START: 0, self.END: 1, self.UNK: 2}
+        for w, _ in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])):
+            if len(vocab) >= size:
+                break
+            vocab.setdefault(w, len(vocab))
+        return vocab
+
+    def _load_data(self):
+        src_col = 0 if self.lang == "en" else 1
+        trg_col = 1 - src_col
+        with tarfile.open(self.data_file) as f:
+            names = {m.name.rsplit("/", 1)[-1]: m.name for m in f
+                     if m.name.rsplit("/", 1)[-1] in ("train", "val", "test")}
+            train_lines = [line.decode().strip() for line in
+                           f.extractfile(names["train"])]
+            mode_lines = (train_lines if self.mode == "train" else
+                          [line.decode().strip() for line in
+                           f.extractfile(names[self.mode])])
+        self.src_dict = self._build_dict(
+            [line.split("\t")[src_col] for line in train_lines
+             if len(line.split("\t")) == 2], self.src_dict_size)
+        self.trg_dict = self._build_dict(
+            [line.split("\t")[trg_col] for line in train_lines
+             if len(line.split("\t")) == 2], self.trg_dict_size)
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        unk = 2
+        for line in mode_lines:
+            parts = line.split("\t")
+            if len(parts) != 2:
+                continue
+            src_ids = [self.src_dict.get(w, unk)
+                       for w in [self.START, *parts[src_col].split(),
+                                 self.END]]
+            trg = [self.trg_dict.get(w, unk) for w in parts[trg_col].split()]
+            self.src_ids.append(src_ids)
+            self.trg_ids.append([0, *trg])
+            self.trg_ids_next.append([*trg, 1])
+
+    def get_dict(self, lang="en", reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class Conll05st(Dataset):
+    """conll05.py — semantic-role labeling: word/verb/target dictionaries
+    plus the test.wsj words/props column files.  Yields
+    (word_ids, predicate_id, label_ids) per proposition; the reference's
+    context-window feature columns are model-side in this port (they are
+    pure index arithmetic over word_ids)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="test",
+                 download=True):
+        self.data_file = _require_file(data_file, "Conll05st")
+        for f, n in ((word_dict_file, "word_dict_file"),
+                     (verb_dict_file, "verb_dict_file"),
+                     (target_dict_file, "target_dict_file")):
+            if f is None:
+                raise RuntimeError(_DOWNLOAD_MSG.format(name=f"Conll05st {n}"))
+        self.word_dict = self._load_dict(word_dict_file)
+        self.verb_dict = self._load_dict(verb_dict_file)
+        self.label_dict = self._load_dict(target_dict_file)
+        self._load_anno()
+
+    @staticmethod
+    def _load_dict(path):
+        d = {}
+        with open(path, "rb") as f:
+            for i, line in enumerate(f):
+                d[line.strip().decode()] = i
+        return d
+
+    def _load_anno(self):
+        import gzip
+
+        self.sentences = []
+        with tarfile.open(self.data_file) as tf:
+            words_name = [m.name for m in tf
+                          if m.name.endswith("words.gz")]
+            props_name = [m.name for m in tf
+                          if m.name.endswith("props.gz")]
+            if not words_name or not props_name:
+                raise ValueError("archive must contain words.gz and props.gz")
+            wordsf = gzip.GzipFile(
+                fileobj=tf.extractfile(words_name[0]))
+            propsf = gzip.GzipFile(
+                fileobj=tf.extractfile(props_name[0]))
+            sentence, props = [], []
+            for wline, pline in zip(wordsf, propsf):
+                w = wline.strip().decode()
+                p = pline.strip().decode().split()
+                if w:
+                    sentence.append(w)
+                    props.append(p)
+                    continue
+                self._emit(sentence, props)
+                sentence, props = [], []
+            if sentence:
+                self._emit(sentence, props)
+
+    def _emit(self, sentence, props):
+        if not props:
+            return
+        unk_w = self.word_dict.get("<unk>", 0)
+        n_props = len(props[0]) - 1  # col 0 is the predicate lemma column
+        for k in range(n_props):
+            verb = next((row[0] for row in props if row[0] != "-"), None)
+            labels = []
+            cur = "O"
+            for row in props:
+                tag = row[1 + k]
+                # (S*) / (S*)... bracket format → BIO-ish label ids
+                m = re.match(r"\(([^*]*)\*", tag)
+                if m:
+                    cur = m.group(1)
+                    labels.append("B-" + cur if cur else "O")
+                elif cur != "O" and not tag.startswith("*)"):
+                    labels.append("I-" + cur)
+                elif tag.startswith("*)"):
+                    labels.append("I-" + cur if cur != "O" else "O")
+                    cur = "O"
+                else:
+                    labels.append("O")
+            word_ids = [self.word_dict.get(w.lower(), unk_w)
+                        for w in sentence]
+            verb_id = self.verb_dict.get(verb, 0)
+            label_ids = [self.label_dict.get(lb, 0) for lb in labels]
+            self.sentences.append((word_ids, [verb_id], label_ids))
+
+    def get_dict(self):
+        return self.word_dict, self.verb_dict, self.label_dict
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.sentences[idx])
+
+    def __len__(self):
+        return len(self.sentences)

@@ -421,3 +421,58 @@ class RandomErasing:
                     arr[y:y + eh, x:x + ew] = self.value
                 return arr
         return arr
+
+
+class BaseTransform:
+    """User-extensible transform base (reference:
+    vision/transforms/transforms.py BaseTransform): ``keys`` names each
+    element of a tuple input ('image', 'boxes', ...); subclasses implement
+    ``_apply_<key>`` and optionally ``_get_params`` for shared randomness."""
+
+    def __init__(self, keys=None):
+        if keys is None:
+            keys = ("image",)
+        elif not isinstance(keys, (list, tuple)):
+            raise TypeError("keys must be a list or tuple")
+        self.keys = tuple(keys)
+        self.params = None
+
+    def _get_params(self, inputs):
+        return None
+
+    def __call__(self, inputs):
+        single = not isinstance(inputs, (tuple, list))
+        ins = (inputs,) if single else tuple(inputs)
+        self.params = self._get_params(ins)
+        outputs = []
+        for i, x in enumerate(ins):
+            key = self.keys[i] if i < len(self.keys) else None
+            fn = getattr(self, f"_apply_{key}", None) if key and key != "none" else None
+            outputs.append(fn(x) if fn is not None else x)
+        return outputs[0] if single else tuple(outputs)
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+
+from . import functional  # noqa: E402,F401
+from .functional import (  # noqa: E402,F401
+    adjust_brightness,
+    adjust_contrast,
+    adjust_hue,
+    affine,
+    center_crop,
+    crop,
+    erase,
+    hflip,
+    normalize,
+    pad,
+    perspective,
+    resize,
+    rotate,
+    to_grayscale,
+    to_tensor,
+    vflip,
+)
+
+__all__ += ["BaseTransform", "functional"] + functional.__all__

@@ -1,0 +1,205 @@
+"""Deterministic functional transforms (reference:
+python/paddle/vision/transforms/functional.py + functional_cv2.py) — the
+random Transform classes in __init__ are parameter samplers over these.
+Convention follows the class transforms: numpy arrays, HWC for photometric
+and warp ops unless stated."""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = [
+    "to_tensor", "resize", "pad", "crop", "center_crop", "hflip", "vflip",
+    "rotate", "affine", "perspective", "normalize", "erase", "to_grayscale",
+    "adjust_brightness", "adjust_contrast", "adjust_hue",
+]
+
+
+def _hi(arr):
+    return 255.0 if np.asarray(arr).max() > 1.5 else 1.0
+
+
+def to_tensor(pic, data_format="CHW"):
+    """functional.py to_tensor: HWC uint8 [0,255] → CHW float [0,1]."""
+    from ...core.tensor import Tensor
+
+    a = np.asarray(pic, np.float32)
+    if a.max() > 1.5:
+        a = a / 255.0
+    if a.ndim == 2:
+        a = a[..., None]
+    if data_format == "CHW":
+        a = a.transpose(2, 0, 1)
+    return Tensor(a)
+
+
+def resize(img, size, interpolation="bilinear"):
+    import jax
+
+    a = np.asarray(img, np.float32)
+    if isinstance(size, int):
+        h, w = a.shape[:2]
+        # shorter side to `size`, aspect preserved (reference semantics)
+        if h <= w:
+            size = (size, max(1, int(round(w * size / h))))
+        else:
+            size = (max(1, int(round(h * size / w))), size)
+    out_shape = tuple(size) + tuple(a.shape[2:])
+    method = {"bilinear": "bilinear", "nearest": "nearest",
+              "bicubic": "cubic", "lanczos": "lanczos3"}.get(interpolation,
+                                                             "bilinear")
+    return np.asarray(jax.image.resize(a, out_shape, method=method))
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1]) * 2
+    l, t, r, b = padding
+    pads = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "reflect": "reflect", "edge": "edge",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, pads, mode=mode, **kw)
+
+
+def crop(img, top, left, height, width):
+    arr = np.asarray(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = np.asarray(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    th, tw = output_size
+    h, w = arr.shape[:2]
+    return crop(arr, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def hflip(img):
+    return np.ascontiguousarray(np.asarray(img)[:, ::-1])
+
+
+def vflip(img):
+    return np.ascontiguousarray(np.asarray(img)[::-1])
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """functional.py rotate — inverse-mapped sampling; ``expand`` grows the
+    canvas to hold the whole rotated image."""
+    from . import _inverse_warp
+
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    rad = np.radians(angle)
+    cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None \
+        else (center[1], center[0])
+    if expand:
+        nh = int(np.ceil(abs(h * np.cos(rad)) + abs(w * np.sin(rad))))
+        nw = int(np.ceil(abs(w * np.cos(rad)) + abs(h * np.sin(rad))))
+        oy, ox = (nh - 1) / 2, (nw - 1) / 2
+    else:
+        nh, nw, oy, ox = h, w, cy, cx
+    yy, xx = np.mgrid[0:nh, 0:nw]
+    ys = cy + (yy - oy) * np.cos(rad) - (xx - ox) * np.sin(rad)
+    xs = cx + (yy - oy) * np.sin(rad) + (xx - ox) * np.cos(rad)
+    return _inverse_warp(arr, xs, ys, fill)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """functional.py affine — same matrix composition as RandomAffine with
+    explicit parameters."""
+    from . import _inverse_warp
+
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    ang = np.radians(angle)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    shx, shy = np.radians(shear[0]), np.radians(shear[1] if len(shear) > 1
+                                                else 0.0)
+    cx, cy = ((w - 1) / 2, (h - 1) / 2) if center is None else center
+    rot = np.array([[np.cos(ang), -np.sin(ang)],
+                    [np.sin(ang), np.cos(ang)]])
+    sh = (np.array([[1, np.tan(shx)], [0, 1]])
+          @ np.array([[1, 0], [np.tan(shy), 1]]))
+    m2 = float(scale) * (rot @ sh)
+    offs = np.array([cx + translate[0], cy + translate[1]]) \
+        - m2 @ np.array([cx, cy])
+    inv = np.linalg.inv(m2)
+    yy, xx = np.mgrid[0:h, 0:w]
+    src = np.stack([xx - offs[0], yy - offs[1]], axis=-1) @ inv.T
+    return _inverse_warp(arr, src[..., 0], src[..., 1], fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """functional.py perspective — homography from 4 point pairs."""
+    from . import RandomPerspective, _inverse_warp
+
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    H = RandomPerspective._homography(np.asarray(startpoints, np.float64),
+                                      np.asarray(endpoints, np.float64))
+    Hinv = np.linalg.inv(H)
+    yy, xx = np.mgrid[0:h, 0:w]
+    pts = np.stack([xx, yy, np.ones_like(xx)], axis=-1) @ Hinv.T
+    return _inverse_warp(arr, pts[..., 0] / pts[..., 2],
+                         pts[..., 1] / pts[..., 2], fill)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    a = np.asarray(img, np.float32)
+    shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
+    return (a - np.asarray(mean, np.float32).reshape(shape)) \
+        / np.asarray(std, np.float32).reshape(shape)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """functional.py erase — CHW or HWC; region [i:i+h, j:j+w] ← v."""
+    arr = np.asarray(img) if inplace else np.array(img, copy=True)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+    if chw:
+        arr[:, i:i + h, j:j + w] = v
+    else:
+        arr[i:i + h, j:j + w] = v
+    return arr
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = np.asarray(img, np.float32)
+    g = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+    return np.repeat(g[..., None], num_output_channels, axis=-1)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = np.asarray(img, np.float32)
+    return np.clip(arr * brightness_factor, 0, _hi(arr))
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = np.asarray(img, np.float32)
+    mean = arr.mean()
+    return np.clip((arr - mean) * contrast_factor + mean, 0, _hi(arr))
+
+
+def adjust_hue(img, hue_factor):
+    """YIQ chroma rotation by hue_factor (in [-0.5, 0.5] turns), matching
+    HueTransform's deterministic core."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = np.asarray(img, np.float32)
+    theta = hue_factor * 2 * np.pi
+    c, s = np.cos(theta), np.sin(theta)
+    yiq_m = np.array([[0.299, 0.587, 0.114],
+                      [0.596, -0.274, -0.322],
+                      [0.211, -0.523, 0.312]], np.float32)
+    rot = np.array([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
+    m = np.linalg.inv(yiq_m) @ rot @ yiq_m
+    return np.clip(arr @ m.T, 0, _hi(arr))

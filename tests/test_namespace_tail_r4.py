@@ -1,0 +1,429 @@
+"""Round-4 namespace-tail behavior: vision functional transforms, incubate
+operators/optimizers, text datasets, audio backends/datasets, paddle.device
+(reference files cited per test)."""
+
+from __future__ import annotations
+
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestVisionFunctional:
+    IMG = (np.random.default_rng(0).random((8, 10, 3)) * 255).astype("uint8")
+
+    def test_geometry_identities(self):
+        from paddle_tpu.vision import transforms as T
+
+        assert np.array_equal(T.rotate(self.IMG, 0.0), self.IMG)
+        assert np.array_equal(T.affine(self.IMG, 0, (0, 0), 1.0, (0, 0)),
+                              self.IMG)
+        pts = [[0, 0], [9, 0], [9, 7], [0, 7]]
+        assert np.array_equal(T.perspective(self.IMG, pts, pts), self.IMG)
+        assert np.array_equal(T.hflip(T.hflip(self.IMG)), self.IMG)
+        assert np.array_equal(T.vflip(T.vflip(self.IMG)), self.IMG)
+        # 90° expand swaps H and W
+        assert T.rotate(self.IMG, 90.0, expand=True).shape == (10, 8, 3)
+
+    def test_crops_pads_resize(self):
+        from paddle_tpu.vision import transforms as T
+
+        assert T.crop(self.IMG, 1, 2, 3, 4).shape == (3, 4, 3)
+        assert T.center_crop(self.IMG, 4).shape == (4, 4, 3)
+        assert T.pad(self.IMG, 2).shape == (12, 14, 3)
+        assert T.pad(self.IMG, (1, 2)).shape == (12, 12, 3)
+        assert T.resize(self.IMG, (4, 5)).shape == (4, 5, 3)
+        # int size: shorter side, aspect preserved
+        assert T.resize(self.IMG, 4).shape == (4, 5, 3)
+
+    def test_photometric(self):
+        from paddle_tpu.vision import transforms as T
+
+        t = T.to_tensor(self.IMG)
+        assert tuple(t.shape) == (3, 8, 10) and float(t.numpy().max()) <= 1.0
+        n = T.normalize(np.float32(self.IMG.transpose(2, 0, 1)),
+                        [0.0] * 3, [255.0] * 3)
+        assert n.max() <= 1.0
+        e = T.erase(self.IMG, 1, 2, 3, 4, 0)
+        assert (e[1:4, 2:6] == 0).all() and self.IMG[1:4, 2:6].any()
+        assert T.to_grayscale(self.IMG).shape == (8, 10, 1)
+        b2 = T.adjust_brightness(self.IMG, 2.0)
+        assert b2.max() <= 255
+        np.testing.assert_allclose(T.adjust_contrast(self.IMG, 1.0),
+                                   np.float32(self.IMG))
+        np.testing.assert_allclose(T.adjust_hue(self.IMG, 0.0),
+                                   np.float32(self.IMG), atol=1e-3)
+        with pytest.raises(ValueError):
+            T.adjust_hue(self.IMG, 0.7)
+
+    def test_base_transform_keys(self):
+        from paddle_tpu.vision import transforms as T
+
+        class Zero(T.BaseTransform):
+            def __init__(self):
+                super().__init__(keys=("image", "none"))
+
+            def _apply_image(self, im):
+                return im * 0
+
+        img, label = Zero()((self.IMG, "y"))
+        assert label == "y" and (img == 0).all()
+        single = Zero()(self.IMG)
+        assert (single == 0).all()
+        with pytest.raises(TypeError):
+            T.BaseTransform(keys="image")  # must be list/tuple
+
+
+class TestIncubateTail:
+    def test_segments_alias_geometric(self):
+        from paddle_tpu import incubate as I
+
+        data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                         np.float32))
+        seg = paddle.to_tensor(np.array([0, 0, 1], np.int64))
+        np.testing.assert_allclose(I.segment_sum(data, seg).numpy(),
+                                   [[4., 6.], [5., 6.]])
+        np.testing.assert_allclose(I.segment_mean(data, seg).numpy(),
+                                   [[2., 3.], [5., 6.]])
+
+    def test_graph_send_recv(self):
+        from paddle_tpu import incubate as I
+
+        x = paddle.to_tensor(np.array([[1.], [2.], [3.]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int64))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int64))
+        np.testing.assert_allclose(
+            I.graph_send_recv(x, src, dst, "sum").numpy().ravel(),
+            [1., 4., 2.])
+
+    def test_graph_reindex_reference_example(self):
+        """graph_reindex.py:59 doc example — exact output parity."""
+        from paddle_tpu import incubate as I
+
+        src, dst, nodes = I.graph_reindex(
+            paddle.to_tensor(np.array([0, 1, 2], np.int64)),
+            paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7], np.int64)),
+            paddle.to_tensor(np.array([2, 3, 2], np.int64)))
+        np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+        np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+        np.testing.assert_array_equal(nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6])
+
+    def test_graph_sampling(self):
+        from paddle_tpu import incubate as I
+
+        # CSC: col0 in-nbrs [2]; col1 [0,2]; col2 [0,1]
+        row = paddle.to_tensor(np.array([2, 0, 2, 0, 1], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 1, 3, 5], np.int64))
+        n, c = I.graph_sample_neighbors(
+            row, colptr, paddle.to_tensor(np.array([1, 2], np.int64)))
+        np.testing.assert_array_equal(c.numpy(), [2, 2])
+        assert set(n.numpy()[:2]) == {0, 2} and set(n.numpy()[2:]) == {0, 1}
+        n1, c1 = I.graph_sample_neighbors(
+            row, colptr, paddle.to_tensor(np.array([1], np.int64)),
+            sample_size=1)
+        assert len(n1.numpy()) == 1 and int(c1.numpy()[0]) == 1
+
+        es, ed, si, rn = I.graph_khop_sampler(
+            row, colptr, paddle.to_tensor(np.array([1], np.int64)), [2, 2])
+        assert len(es.numpy()) == len(ed.numpy())
+        assert int(rn.numpy()[0]) == 0  # input node reindexes to 0
+
+    def test_fused_softmax_and_identity_loss(self):
+        from paddle_tpu import incubate as I
+
+        logits = paddle.to_tensor(np.random.default_rng(0)
+                                  .standard_normal((1, 1, 4, 4))
+                                  .astype(np.float32))
+        m = paddle.to_tensor(np.zeros((1, 1, 4, 4), np.float32))
+        a = I.softmax_mask_fuse(logits, m).numpy()
+        np.testing.assert_allclose(a.sum(-1), 1.0, rtol=1e-5)
+        b = I.softmax_mask_fuse_upper_triangle(logits).numpy()
+        assert b[0, 0, 0, 1:].sum() == 0  # causal row 0 sees only col 0
+        assert float(I.identity_loss(
+            paddle.to_tensor(np.array([1., 2., 3.], np.float32)),
+            "mean").numpy()) == pytest.approx(2.0)
+
+    def test_lookahead(self):
+        from paddle_tpu import incubate as I, nn, optimizer
+
+        lin = nn.Linear(2, 1, bias_attr=False)
+        w0 = lin.weight.numpy().copy()
+        inner = optimizer.SGD(learning_rate=0.1,
+                              parameters=lin.parameters())
+        la = I.LookAhead(inner, alpha=0.5, k=2)
+        xb = paddle.to_tensor(np.ones((4, 2), np.float32))
+        for _ in range(2):
+            lin(xb).sum().backward()
+            la.step()
+            la.clear_grad()
+        fast = w0 - 0.1 * 4 * 2
+        np.testing.assert_allclose(lin.weight.numpy(),
+                                   w0 + 0.5 * (fast - w0), atol=1e-5)
+        with pytest.raises(ValueError):
+            I.LookAhead(inner, alpha=2.0)
+
+    def test_lookahead_slow_weights_seed_lazily(self):
+        """Weights loaded AFTER construction must seed the slow copy
+        (regression: eager snapshot corrupted fine-tuning)."""
+        from paddle_tpu import incubate as I, nn, optimizer
+
+        lin = nn.Linear(2, 1, bias_attr=False)
+        inner = optimizer.SGD(learning_rate=0.0,
+                              parameters=lin.parameters())
+        la = I.LookAhead(inner, alpha=0.5, k=1)
+        loaded = np.full_like(lin.weight.numpy(), 9.0)
+        lin.weight.set_value(loaded)  # simulate set_state_dict after init
+        lin(paddle.to_tensor(np.ones((1, 2), np.float32))).sum().backward()
+        la.step()  # lr=0 → fast unchanged; k=1 sync must be a no-op vs 9.0
+        la.clear_grad()
+        np.testing.assert_allclose(lin.weight.numpy(), loaded)
+
+    def test_model_average(self):
+        from paddle_tpu import incubate as I, nn
+
+        lin = nn.Linear(2, 1, bias_attr=False)
+        ma = I.ModelAverage(0.15, parameters=lin.parameters(),
+                            min_average_window=2, max_average_window=10)
+        for v in (1.0, 2.0, 3.0):
+            lin.weight.set_value(np.full_like(lin.weight.numpy(), v))
+            ma.step()
+        with ma.apply():
+            np.testing.assert_allclose(lin.weight.numpy(), 2.0, atol=1e-6)
+        np.testing.assert_allclose(lin.weight.numpy(), 3.0)
+
+
+class TestTextDatasets:
+    def test_uci_housing(self, tmp_path):
+        from paddle_tpu.text.datasets import UCIHousing
+
+        rows = np.random.default_rng(0).random((20, 14))
+        p = str(tmp_path / "housing.data")
+        np.savetxt(p, rows, fmt="%.6f")
+        tr = UCIHousing(data_file=p, mode="train")
+        te = UCIHousing(data_file=p, mode="test")
+        assert len(tr) == 16 and len(te) == 4
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        with pytest.raises(RuntimeError, match="egress"):
+            UCIHousing()
+
+    def test_imikolov_ngram_and_seq(self, tmp_path):
+        from paddle_tpu.text.datasets import Imikolov
+
+        p = str(tmp_path / "ptb.tgz")
+        with tarfile.open(p, "w:gz") as tf:
+            for name, text in [
+                ("simple-examples/data/ptb.train.txt",
+                 "the cat sat\nthe dog sat\n" * 30),
+                ("simple-examples/data/ptb.valid.txt", "the cat ran\n" * 10),
+            ]:
+                data = text.encode()
+                ti = tarfile.TarInfo("./" + name)
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        ngram = Imikolov(data_file=p, data_type="NGRAM", window_size=2,
+                         mode="train", min_word_freq=1)
+        assert len(ngram) == 240  # 60 lines x 4 bigrams
+        seq = Imikolov(data_file=p, data_type="SEQ", mode="train",
+                       min_word_freq=1)
+        src, trg = seq[0]
+        assert src[0] == seq.word_idx[b"<s>"]
+        assert trg[-1] == seq.word_idx[b"<e>"]
+        np.testing.assert_array_equal(src[1:], trg[:-1])
+
+    def test_imdb(self, tmp_path):
+        from paddle_tpu.text.datasets import Imdb
+
+        p = str(tmp_path / "imdb.tgz")
+        with tarfile.open(p, "w:gz") as tf:
+            for i, (split, pol, text) in enumerate([
+                ("train", "pos", b"a great movie, truly great!"),
+                ("train", "neg", b"a bad movie. bad bad."),
+                ("test", "pos", b"great fun"),
+            ]):
+                ti = tarfile.TarInfo(f"aclImdb/{split}/{pol}/{i}.txt")
+                ti.size = len(text)
+                tf.addfile(ti, io.BytesIO(text))
+        ds = Imdb(data_file=p, mode="train", cutoff=0)
+        assert len(ds) == 2
+        labels = sorted(int(ds[i][1][0]) for i in range(2))
+        assert labels == [0, 1]
+        assert b"great" in ds.word_idx
+
+    def test_movielens(self, tmp_path):
+        from paddle_tpu.text.datasets import Movielens
+
+        p = str(tmp_path / "ml.zip")
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("ml-1m/movies.dat",
+                       "1::Toy Story (1995)::Animation|Comedy\n"
+                       "2::Jumanji (1995)::Adventure\n")
+            z.writestr("ml-1m/users.dat",
+                       "1::M::25::4::90210\n2::F::35::2::10001\n")
+            z.writestr("ml-1m/ratings.dat",
+                       "1::1::5::0\n2::2::3::0\n1::2::4::0\n")
+        ds = Movielens(data_file=p, mode="train", test_ratio=0.0)
+        assert len(ds) == 3
+        sample = ds[0]
+        assert len(sample) == 8  # uid,gender,age,job,mov,cats,title,rating
+        assert float(sample[-1][0]) == 5.0  # rating 5 → 5*2-5
+
+    def test_wmt14_and_wmt16(self, tmp_path):
+        from paddle_tpu.text.datasets import WMT14, WMT16
+
+        pair = b"hello world\thallo welt\nworld hello\twelt hallo\n"
+        p14 = str(tmp_path / "wmt14.tgz")
+        with tarfile.open(p14, "w:gz") as tf:
+            for name, data in [
+                ("wmt14/src.dict", b"<s>\n<e>\n<unk>\nhello\nworld\n"),
+                ("wmt14/trg.dict", b"<s>\n<e>\n<unk>\nhallo\nwelt\n"),
+                ("wmt14/train/train", pair),
+            ]:
+                ti = tarfile.TarInfo(name)
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        w = WMT14(data_file=p14, mode="train", dict_size=5)
+        src, trg, nxt = w[0]
+        assert src[0] == 0 and src[-1] == 1  # <s> ... <e>
+        assert trg[0] == 0 and nxt[-1] == 1
+        np.testing.assert_array_equal(trg[1:], nxt[:-1])
+
+        p16 = str(tmp_path / "wmt16.tar")
+        with tarfile.open(p16, "w") as tf:
+            for name in ("wmt16/train", "wmt16/val", "wmt16/test"):
+                ti = tarfile.TarInfo(name)
+                ti.size = len(pair)
+                tf.addfile(ti, io.BytesIO(pair))
+        w16 = WMT16(data_file=p16, mode="val", src_dict_size=10,
+                    trg_dict_size=10)
+        assert len(w16) == 2
+        assert w16.get_dict("en")["<s>"] == 0
+
+    def test_conll05st(self, tmp_path):
+        from paddle_tpu.text.datasets import Conll05st
+
+        wd = str(tmp_path / "w.txt")
+        open(wd, "w").write("<unk>\nthe\ncat\nsat\n")
+        vd = str(tmp_path / "v.txt")
+        open(vd, "w").write("sit\nsat\n")
+        td = str(tmp_path / "t.txt")
+        open(td, "w").write("O\nB-A0\nI-A0\nB-V\n")
+        p = str(tmp_path / "conll.tgz")
+        with tarfile.open(p, "w:gz") as tf:
+            for name, data in [
+                ("conll05st/test.wsj.words.gz",
+                 gzip.compress(b"The\ncat\nsat\n\n")),
+                ("conll05st/test.wsj.props.gz",
+                 gzip.compress(b"-\t(A0*\n-\t*)\nsat\t(V*)\n\n")),
+            ]:
+                ti = tarfile.TarInfo(name)
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        ds = Conll05st(data_file=p, word_dict_file=wd, verb_dict_file=vd,
+                       target_dict_file=td)
+        words, verb, labels = ds[0]
+        np.testing.assert_array_equal(words, [1, 2, 3])
+        assert int(verb[0]) == 1  # 'sat'
+        np.testing.assert_array_equal(labels, [1, 2, 3])  # B-A0 I-A0 B-V
+
+
+class TestAudioTail:
+    def _wav(self, tmp_path, name="t.wav"):
+        from paddle_tpu import audio
+
+        wav = (np.sin(np.linspace(0, 40, 800)) * 0.3).astype(np.float32)[None]
+        path = str(tmp_path / name)
+        audio.save(path, paddle.to_tensor(wav), 16000)
+        return path, wav
+
+    def test_wav_roundtrip_and_info(self, tmp_path):
+        from paddle_tpu import audio
+
+        path, wav = self._wav(tmp_path)
+        back, sr = audio.load(path)
+        assert sr == 16000
+        np.testing.assert_allclose(back.numpy(), wav, atol=1e-3)
+        raw, _ = audio.load(path, normalize=False)
+        assert np.abs(raw.numpy()).max() > 1000  # int16 scale
+        seg, _ = audio.load(path, frame_offset=100, num_frames=200)
+        assert seg.shape == (1, 200)
+        inf = audio.info(path)
+        assert (inf.sample_rate, inf.num_samples, inf.num_channels,
+                inf.bits_per_sample) == (16000, 800, 1, 16)
+        assert audio.backends.get_current_backend() == "wave_backend"
+        with pytest.raises(NotImplementedError):
+            audio.backends.set_backend("soundfile")
+
+    def test_tess_and_esc50(self, tmp_path):
+        from paddle_tpu import audio
+
+        tess = tmp_path / "tess"
+        tess.mkdir()
+        for i, emo in enumerate(["angry", "happy", "sad"]):
+            self._wav(tess, f"OAF_w{i}_{emo}.wav")
+        tr = audio.datasets.TESS(mode="train", split=1, archive=str(tess))
+        dv = audio.datasets.TESS(mode="dev", split=1, archive=str(tess))
+        assert len(tr) + len(dv) == 3
+        x, y = tr[0]
+        assert x.shape == (1, 800)
+
+        esc = tmp_path / "esc"
+        esc.mkdir()
+        for fold in (1, 2):
+            for tgt in (0, 7):
+                self._wav(esc, f"{fold}-1-A-{tgt}.wav")
+        ds = audio.datasets.ESC50(mode="train", split=1, archive=str(esc))
+        assert len(ds) == 2 and sorted(ds.labels) == [0, 7]
+        with pytest.raises(RuntimeError, match="egress"):
+            audio.datasets.ESC50()
+
+    def test_mfcc_feature_mode(self, tmp_path):
+        from paddle_tpu import audio
+
+        tess = tmp_path / "t2"
+        tess.mkdir()
+        self._wav(tess, "OAF_x_happy.wav")
+        ds = audio.datasets.TESS(mode="dev", split=1, archive=str(tess),
+                                 feature_type="mfcc", n_mfcc=13)
+        x, _ = ds[0]
+        assert x.shape[-2] == 13
+
+
+class TestDeviceNamespace:
+    def test_surface(self):
+        d = paddle.device
+        assert d.get_cudnn_version() is None
+        assert not d.is_compiled_with_rocm()
+        assert not d.is_compiled_with_xpu()
+        assert d.is_compiled_with_distribute()
+        assert d.get_all_device_type()
+        assert d.get_available_device()
+        with pytest.raises(NotImplementedError):
+            d.XPUPlace(0)
+
+    def test_streams_events(self):
+        d = paddle.device
+        s = d.Stream()
+        e = s.record_event()
+        e.synchronize()
+        assert s.query() and e.query()
+        prev = d.current_stream()
+        with d.stream_guard(d.Stream()):
+            assert d.current_stream() is not prev
+        assert d.current_stream() is prev
+        with pytest.raises(NotImplementedError):
+            e.elapsed_time(d.Event())
+
+    def test_cuda_compat_namespace(self):
+        c = paddle.device.cuda
+        assert c.device_count() >= 1
+        assert isinstance(c.get_device_name(), str)
+        assert c.memory_allocated() >= 0
+        c.synchronize()
