@@ -64,9 +64,10 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
     frames = f.getnframes()
     content = f.readframes(frames)
     file_obj.close()
-    audio = np.frombuffer(content, dtype=np.int16).astype(np.float32)
+    audio = np.frombuffer(content, dtype=np.int16)
     if normalize:
-        audio = audio / (2 ** 15)
+        audio = audio.astype(np.float32) / (2 ** 15)
+    # else: raw int16, like the reference wave backend
     waveform = np.reshape(audio, (frames, channels))
     if num_frames != -1:
         waveform = waveform[frame_offset:frame_offset + num_frames, :]

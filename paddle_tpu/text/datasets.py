@@ -476,22 +476,27 @@ class Conll05st(Dataset):
             return
         unk_w = self.word_dict.get("<unk>", 0)
         n_props = len(props[0]) - 1  # col 0 is the predicate lemma column
+        lemmas = [row[0] for row in props if row[0] != "-"]
         for k in range(n_props):
-            verb = next((row[0] for row in props if row[0] != "-"), None)
+            # proposition k belongs to the k-th predicate of the sentence
+            verb = lemmas[k] if k < len(lemmas) else None
             labels = []
             cur = "O"
             for row in props:
                 tag = row[1 + k]
-                # (S*) / (S*)... bracket format → BIO-ish label ids
+                # bracket format: '(X*' opens span X, '*)' closes the open
+                # span, '(X*)' is a single-token span (opens AND closes)
                 m = re.match(r"\(([^*]*)\*", tag)
                 if m:
                     cur = m.group(1)
                     labels.append("B-" + cur if cur else "O")
-                elif cur != "O" and not tag.startswith("*)"):
-                    labels.append("I-" + cur)
-                elif tag.startswith("*)"):
+                    if tag.endswith(")"):
+                        cur = "O"  # single-token span closed in place
+                elif tag.endswith(")"):
                     labels.append("I-" + cur if cur != "O" else "O")
                     cur = "O"
+                elif cur != "O":
+                    labels.append("I-" + cur)
                 else:
                     labels.append("O")
             word_ids = [self.word_dict.get(w.lower(), unk_w)

@@ -18,15 +18,21 @@ __all__ = [
 
 
 def _hi(arr):
-    return 255.0 if np.asarray(arr).max() > 1.5 else 1.0
+    """Value ceiling for clipping, decided by DTYPE (deterministic — a
+    value-based max() heuristic misclassifies dark frames and binary
+    masks): integer images live on [0, 255], float images on [0, 1]."""
+    return 255.0 if np.issubdtype(np.asarray(arr).dtype, np.integer) else 1.0
 
 
 def to_tensor(pic, data_format="CHW"):
-    """functional.py to_tensor: HWC uint8 [0,255] → CHW float [0,1]."""
+    """functional.py to_tensor: HWC uint8 [0,255] → CHW float [0,1].  The
+    /255 scaling applies to INTEGER dtypes only (the reference divides for
+    uint8 input and passes float input through unchanged)."""
     from ...core.tensor import Tensor
 
-    a = np.asarray(pic, np.float32)
-    if a.max() > 1.5:
+    raw = np.asarray(pic)
+    a = raw.astype(np.float32)
+    if np.issubdtype(raw.dtype, np.integer):
         a = a / 255.0
     if a.ndim == 2:
         a = a[..., None]
@@ -156,16 +162,19 @@ def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
 
 def normalize(img, mean, std, data_format="CHW", to_rgb=False):
     a = np.asarray(img, np.float32)
+    if to_rgb:  # reference: flip BGR → RGB before normalizing
+        a = a[::-1] if data_format == "CHW" else a[..., ::-1]
     shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
     return (a - np.asarray(mean, np.float32).reshape(shape)) \
         / np.asarray(std, np.float32).reshape(shape)
 
 
 def erase(img, i, j, h, w, v, inplace=False):
-    """functional.py erase — CHW or HWC; region [i:i+h, j:j+w] ← v."""
+    """functional.py erase — input contract is CHW for 3-D arrays/Tensors
+    (the reference documents shape (C, H, W)); 2-D arrays are plain HW.
+    Region [i:i+h, j:j+w] ← v."""
     arr = np.asarray(img) if inplace else np.array(img, copy=True)
-    chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
-    if chw:
+    if arr.ndim == 3:
         arr[:, i:i + h, j:j + w] = v
     else:
         arr[i:i + h, j:j + w] = v
@@ -179,14 +188,16 @@ def to_grayscale(img, num_output_channels=1):
 
 
 def adjust_brightness(img, brightness_factor):
+    hi = _hi(img)  # dtype of the ORIGINAL input decides the ceiling
     arr = np.asarray(img, np.float32)
-    return np.clip(arr * brightness_factor, 0, _hi(arr))
+    return np.clip(arr * brightness_factor, 0, hi)
 
 
 def adjust_contrast(img, contrast_factor):
+    hi = _hi(img)
     arr = np.asarray(img, np.float32)
     mean = arr.mean()
-    return np.clip((arr - mean) * contrast_factor + mean, 0, _hi(arr))
+    return np.clip((arr - mean) * contrast_factor + mean, 0, hi)
 
 
 def adjust_hue(img, hue_factor):
@@ -194,6 +205,7 @@ def adjust_hue(img, hue_factor):
     HueTransform's deterministic core."""
     if not -0.5 <= hue_factor <= 0.5:
         raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    hi = _hi(img)
     arr = np.asarray(img, np.float32)
     theta = hue_factor * 2 * np.pi
     c, s = np.cos(theta), np.sin(theta)
@@ -202,4 +214,4 @@ def adjust_hue(img, hue_factor):
                       [0.211, -0.523, 0.312]], np.float32)
     rot = np.array([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
     m = np.linalg.inv(yiq_m) @ rot @ yiq_m
-    return np.clip(arr @ m.T, 0, _hi(arr))
+    return np.clip(arr @ m.T, 0, hi)

@@ -1,0 +1,59 @@
+"""Sub-namespace __all__ parity audit: every public name the reference
+exports in each sub-namespace must resolve on the paddle_tpu analog
+(reference: python/paddle/<ns>/__init__.py __all__ lists, parsed by AST so
+the torch/CUDA reference never has to import)."""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import os
+
+import pytest
+
+REF = "/root/reference/python/paddle"
+
+NAMESPACES = [
+    "nn", "nn/functional", "nn/initializer", "nn/utils", "distributed",
+    "linalg", "fft", "signal", "sparse", "static", "static/nn", "optimizer",
+    "optimizer/lr", "io", "amp", "jit", "metric", "distribution",
+    "vision/ops", "vision/transforms", "vision/models", "autograd",
+    "quantization", "incubate", "onnx", "text", "audio", "sysconfig",
+    "device", "regularizer", "utils",
+]
+
+
+def _ref_all(relpath):
+    for cand in (os.path.join(REF, relpath, "__init__.py"),
+                 os.path.join(REF, relpath + ".py")):
+        if os.path.exists(cand):
+            break
+    else:
+        return None
+    tree = ast.parse(open(cand).read())
+    names = []
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "__all__":
+                try:
+                    names.extend(ast.literal_eval(node.value))
+                except (ValueError, TypeError):
+                    pass
+    return sorted(set(names))
+
+
+@pytest.mark.skipif(not os.path.isdir(REF),
+                    reason="reference tree not mounted")
+@pytest.mark.parametrize("ns", NAMESPACES)
+def test_namespace_all_parity(ns):
+    ref_names = _ref_all(ns)
+    if not ref_names:
+        pytest.skip(f"reference {ns} has no __all__")
+    mod = importlib.import_module("paddle_tpu." + ns.replace("/", "."))
+    missing = [n for n in ref_names if not hasattr(mod, n)]
+    assert not missing, f"{ns}: missing {len(missing)} names: {missing}"

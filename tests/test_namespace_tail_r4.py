@@ -51,8 +51,19 @@ class TestVisionFunctional:
         n = T.normalize(np.float32(self.IMG.transpose(2, 0, 1)),
                         [0.0] * 3, [255.0] * 3)
         assert n.max() <= 1.0
-        e = T.erase(self.IMG, 1, 2, 3, 4, 0)
-        assert (e[1:4, 2:6] == 0).all() and self.IMG[1:4, 2:6].any()
+        chw = self.IMG.transpose(2, 0, 1)  # erase contract is CHW (ref doc)
+        e = T.erase(chw, 1, 2, 3, 4, 0)
+        assert (e[:, 1:4, 2:6] == 0).all() and chw[:, 1:4, 2:6].any()
+        # dtype-based scaling: a uint8 binary mask still divides by 255
+        mask = np.zeros((4, 4), np.uint8)
+        mask[0, 0] = 1
+        assert float(T.to_tensor(mask).numpy().max()) == pytest.approx(1 / 255)
+        # to_rgb flips channels before normalizing
+        bgr = np.zeros((2, 2, 3), np.float32)
+        bgr[..., 0] = 1.0  # blue plane
+        out = T.normalize(bgr, [0.0] * 3, [1.0] * 3, data_format="HWC",
+                          to_rgb=True)
+        assert out[..., 2].max() == 1.0 and out[..., 0].max() == 0.0
         assert T.to_grayscale(self.IMG).shape == (8, 10, 1)
         b2 = T.adjust_brightness(self.IMG, 2.0)
         assert b2.max() <= 255
@@ -333,6 +344,37 @@ class TestTextDatasets:
         assert int(verb[0]) == 1  # 'sat'
         np.testing.assert_array_equal(labels, [1, 2, 3])  # B-A0 I-A0 B-V
 
+    def test_conll05st_single_token_spans_and_multi_predicate(self, tmp_path):
+        """Regression: '(V*)' must close in place (next token is O), and
+        proposition k takes the k-th predicate lemma."""
+        from paddle_tpu.text.datasets import Conll05st
+
+        wd = str(tmp_path / "w.txt")
+        open(wd, "w").write("<unk>\nthe\ncat\nsat\nran\n")
+        vd = str(tmp_path / "v.txt")
+        open(vd, "w").write("sit\nsat\nran\n")
+        td = str(tmp_path / "t.txt")
+        open(td, "w").write("O\nB-A0\nI-A0\nB-V\nI-V\n")
+        p = str(tmp_path / "conll.tgz")
+        words = gzip.compress(b"The\ncat\nsat\nran\n\n")
+        # two predicates: prop0 = sat (V on tok2), prop1 = ran (V on tok3)
+        props = gzip.compress(
+            b"-\t(A0*\t(A0*\n-\t*)\t*)\nsat\t(V*)\t*\nran\t*\t(V*)\n\n")
+        with tarfile.open(p, "w:gz") as tf:
+            for name, data in [("c/test.wsj.words.gz", words),
+                               ("c/test.wsj.props.gz", props)]:
+                ti = tarfile.TarInfo(name)
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        ds = Conll05st(data_file=p, word_dict_file=wd, verb_dict_file=vd,
+                       target_dict_file=td)
+        assert len(ds) == 2
+        _, verb0, labels0 = ds[0]
+        _, verb1, labels1 = ds[1]
+        assert int(verb0[0]) == 1 and int(verb1[0]) == 2  # sat, ran
+        np.testing.assert_array_equal(labels0, [1, 2, 3, 0])  # ... B-V O
+        np.testing.assert_array_equal(labels1, [1, 2, 0, 3])  # ... O B-V
+
 
 class TestAudioTail:
     def _wav(self, tmp_path, name="t.wav"):
@@ -351,7 +393,8 @@ class TestAudioTail:
         assert sr == 16000
         np.testing.assert_allclose(back.numpy(), wav, atol=1e-3)
         raw, _ = audio.load(path, normalize=False)
-        assert np.abs(raw.numpy()).max() > 1000  # int16 scale
+        assert raw.numpy().dtype == np.int16  # reference raw contract
+        assert np.abs(raw.numpy()).max() > 1000
         seg, _ = audio.load(path, frame_offset=100, num_frames=200)
         assert seg.shape == (1, 200)
         inf = audio.info(path)

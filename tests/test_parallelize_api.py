@@ -106,8 +106,15 @@ class TestParallelizePlans:
         np.testing.assert_allclose(lyr(x).numpy(), ref2, rtol=1e-5, atol=1e-6)
 
     def test_parallelize_requires_mesh(self):
-        with pytest.raises(ValueError, match="mesh"):
-            dist.parallelize(MLP(), mesh=None, config={})
+        # isolate from suite order: another test may have set the global
+        # mesh, which parallelize legitimately falls back to
+        prev = dist.get_mesh()
+        dist.set_mesh(None)
+        try:
+            with pytest.raises(ValueError, match="mesh"):
+                dist.parallelize(MLP(), mesh=None, config={})
+        finally:
+            dist.set_mesh(prev)
 
 
 class TestDTensorTail:
