@@ -126,15 +126,15 @@ def tolist(x):
 
 
 @_export
-def rank(x):
+def rank(input):
     """Tensor rank (ndim) as a 0-D int32 tensor (reference paddle.rank)."""
-    return Tensor(jnp.asarray(_unwrap(x).ndim, jnp.int32))
+    return Tensor(jnp.asarray(_unwrap(input).ndim, jnp.int32))
 
 
 @_export
-def shape(x):
+def shape(input):
     """Runtime shape as an int32 tensor (reference paddle.shape)."""
-    return Tensor(jnp.asarray(_unwrap(x).shape, jnp.int32))
+    return Tensor(jnp.asarray(_unwrap(input).shape, jnp.int32))
 
 
 @_export
@@ -234,7 +234,8 @@ def combinations(x, r=2, with_replacement=False, name=None):
 
 
 @_export
-def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    x = input
     def fn(v):
         lo, hi = (jnp.min(v), jnp.max(v)) if min == 0 and max == 0 else (min, max)
         lo, hi = jnp.where(lo == hi, lo - 0.5, lo), jnp.where(lo == hi, hi + 0.5, hi)

@@ -110,7 +110,7 @@ from .env import (  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 
 
-def get_backend():
+def get_backend(group=None):
     return "xla"  # collectives are XLA ops over ICI/DCN (no NCCL)
 
 

@@ -130,10 +130,10 @@ def new_group(ranks=None, backend=None, timeout=None) -> Group:
         return g
 
 
-def get_group(gid: int = 0) -> Group:
-    if gid == 0 and 0 not in _groups:
+def get_group(id: int = 0) -> Group:
+    if id == 0 and 0 not in _groups:
         _groups[0] = Group(gid=0)
-    return _groups[gid]
+    return _groups[id]
 
 
 def _default_group() -> Group:
@@ -195,7 +195,7 @@ def _lax_reduce(v, op, axis_name):
 
 # ---- collectives ----
 
-def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False):
     """NOTE eager mode: non-differentiable (reference parity) — executed under
     no_grad so the tape records nothing; in-program (traced) use lowers to
     lax collectives which ARE differentiable under jax.grad."""
@@ -217,7 +217,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return out
 
 
-def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False):
     group = group or _default_group()
     v = _unwrap(tensor)
     if _is_traced(v) and _axis_in_scope(group.axis_name):
@@ -235,18 +235,18 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return out
 
 
-def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True, use_calc_stream=False, axis=0):
     group = group or _default_group()
-    if isinstance(tensor_or_list, list) and tensor is not None:
+    if isinstance(tensor_list, list) and tensor is not None:
         # paddle API: all_gather(tensor_list, tensor) — stacked eager mode
         v = _unwrap(tensor)
         if v.ndim == 0:
             raise ValueError("all_gather requires >=1-D tensor")
         # stacked global [nranks, ...local]: gathered result is every slot
         parts = [Tensor(v[i]) for i in range(v.shape[0])]
-        tensor_or_list.extend(parts)
-        return tensor_or_list
-    x = tensor_or_list
+        tensor_list.extend(parts)
+        return tensor_list
+    x = tensor_list
     v = _unwrap(x)
     if _is_traced(v) and _axis_in_scope(group.axis_name):
         out = jax.lax.all_gather(v, group.axis_name, axis=axis, tiled=True)
@@ -262,12 +262,12 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
         return apply_op("all_gather", fn, [x])
 
 
-def all_gather_object(obj_list, obj, group=None):
-    obj_list.append(obj)
-    return obj_list
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+    return object_list
 
 
-def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_op=True, axis=0):
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False, axis=0):
     group = group or _default_group()
     v = _unwrap(tensor)
     if _is_traced(v) and _axis_in_scope(group.axis_name):
@@ -284,7 +284,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_o
         return apply_op("reduce_scatter", fn, [tensor])
 
 
-def alltoall(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
+def alltoall(out_tensor_list, in_tensor_list=None, group=None, sync_op=True, use_calc_stream=False):
     group = group or _default_group()
     # stacked eager form: single tensor [nranks, nranks, ...] OR paddle list API
     if isinstance(out_tensor_list, Tensor) and in_tensor_list is None:
@@ -305,7 +305,7 @@ def alltoall(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
     return out_tensor_list
 
 
-def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True, use_calc_stream=False):
     group = group or _default_group()
     v = _unwrap(in_tensor)
     if _is_traced(v) and _axis_in_scope(group.axis_name):
@@ -326,7 +326,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
     return res
 
 
-def broadcast(tensor, src=0, group=None, sync_op=True):
+def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
     group = group or _default_group()
     v = _unwrap(tensor)
     if _is_traced(v) and _axis_in_scope(group.axis_name):
@@ -346,7 +346,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     return out
 
 
-def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True, use_calc_stream=False):
     """Rank i receives tensor_list[i] from src.
 
     Traced (inside shard_map over the group's axis): each rank selects its
@@ -384,7 +384,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return Tensor(v)
 
 
-def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True, use_calc_stream=False):
     """Collect every rank's tensor at dst (inverse of scatter).
 
     Traced: lowers to ``all_gather`` over the group axis — every rank
@@ -511,7 +511,7 @@ def _unpack(b: bytes):
     return _np.load(_io.BytesIO(b), allow_pickle=False)
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
     group = group or _default_group()
     v = _unwrap(tensor)
     if _is_traced(v) and _axis_in_scope(group.axis_name):
@@ -531,7 +531,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     return None
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
     group = group or _default_group()
     v = _unwrap(tensor)
     if _is_traced(v) and _axis_in_scope(group.axis_name):

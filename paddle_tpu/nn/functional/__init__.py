@@ -14,7 +14,7 @@ from ...core import dtype as dtypes
 from ...core import rng
 from ...core.tensor import Tensor, apply_op, _unwrap
 from ...ops.manipulation import pad  # noqa: F401  (exported as F.pad)
-from ...ops.manipulation import unfold  # noqa: F401  (F.unfold = im2col)
+from ...ops.manipulation import unfold_im2col as unfold  # noqa: F401  (F.unfold = im2col)
 from ...ops.registry import register_op
 
 __all__: list[str] = []
@@ -54,7 +54,6 @@ _act("softsign", jax.nn.soft_sign)
 _act("tanhshrink", lambda v: v - jnp.tanh(v))
 _act("log_sigmoid", jax.nn.log_sigmoid)
 _act("hardswish", lambda v: v * jnp.clip(v + 3, 0, 6) / 6)
-_act("hardsigmoid", lambda v: jnp.clip(v / 6 + 0.5, 0, 1))
 
 
 @_export
@@ -217,9 +216,31 @@ def linear(x, weight, bias=None, name=None):
 
 
 @_export
-def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    """activation.py hardsigmoid — clip(slope·x + offset, 0, 1); the
+    reference defaults are slope=1/6, offset=0.5."""
+    return apply_op("hardsigmoid",
+                    lambda v: jnp.clip(v * slope + offset, 0, 1), [x])
+
+
+@_export
+def embedding(x, weight, padding_idx=None, sparse=False, max_norm=None,
+              norm_type=2.0, scale_grad_by_freq=False, name=None):
+    if scale_grad_by_freq:
+        raise NotImplementedError(
+            "scale_grad_by_freq: frequency-scaled sparse gradients are a "
+            "row-sparse-grad optimization; dense XLA grads make it a no-op "
+            "risk — not supported")
+
     def fn(ids, w):
         out = jnp.take(w, ids, axis=0)
+        if max_norm is not None:
+            # renorm looked-up vectors whose p-norm exceeds max_norm
+            n = jnp.linalg.norm(out.astype(jnp.float32), ord=norm_type,
+                                axis=-1, keepdims=True)
+            scale_f = jnp.where(n > max_norm, max_norm / jnp.maximum(n, 1e-12),
+                                1.0)
+            out = (out * scale_f).astype(out.dtype)
         if padding_idx is not None:
             mask = (ids == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out)
@@ -426,18 +447,54 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, 
     return apply_op(name, fn, inputs)
 
 
+
+def _op_from_output_size(x, weight, stride, padding, dilation, output_size,
+                         ndim, data_format):
+    """output_size → output_padding (common.py conv_transpose contract):
+    out = (in-1)·stride - 2·pad + dilation·(k-1) + 1 + output_padding."""
+    st = _pair(stride, ndim)
+    pd = _pair(padding, ndim)
+    dl = _pair(dilation, ndim)
+    ks = _unwrap(weight).shape[2:2 + ndim]
+    ch_first = data_format[1] == "C"
+    sp = (_unwrap(x).shape[2:2 + ndim] if ch_first
+          else _unwrap(x).shape[1:1 + ndim])
+    want = _pair(output_size, ndim)
+    opad = []
+    for i in range(ndim):
+        base = (sp[i] - 1) * st[i] - 2 * pd[i] + dl[i] * (ks[i] - 1) + 1
+        extra = int(want[i]) - base
+        if not 0 <= extra < st[i]:
+            raise ValueError(
+                f"output_size[{i}]={want[i]} unreachable: base {base}, "
+                f"stride {st[i]} allows [{base}, {base + st[i] - 1}]")
+        opad.append(extra)
+    return tuple(opad)
+
+
 @_export
-def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCL", name=None):
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    if output_size is not None:
+        output_padding = _op_from_output_size(x, weight, stride, padding,
+                                              dilation, output_size, 1, "NCL")
     return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, "NCW", 1, "conv1d_transpose")
 
 
 @_export
-def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCHW", name=None):
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    if output_size is not None:
+        output_padding = _op_from_output_size(x, weight, stride, padding,
+                                              dilation, output_size, 2,
+                                              data_format)
     return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, 2, "conv2d_transpose")
 
 
 @_export
-def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCDHW", name=None):
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    if output_size is not None:
+        output_padding = _op_from_output_size(x, weight, stride, padding,
+                                              dilation, output_size, 3,
+                                              data_format)
     return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, 3, "conv3d_transpose")
 
 
@@ -1135,8 +1192,8 @@ __all__ += [
 
 # sequence mask utility
 @_export
-def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
-    v = _unwrap(lengths)
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    v = _unwrap(x)
     m = int(maxlen) if maxlen is not None else int(jnp.max(v))
     mask = jnp.arange(m)[None, :] < v[..., None]
     return Tensor(mask.astype(dtypes.convert_dtype(dtype)))

@@ -122,7 +122,9 @@ def diagflat(x, offset=0, name=None):
 
 
 @register_op("diag_embed")
-def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    x = input
+
     def fn(v):
         n = v.shape[-1] + abs(offset)
         base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
@@ -277,13 +279,17 @@ def standard_normal(shape, dtype=None, name=None):
 
 
 @register_op("bernoulli")
-def bernoulli(x, name=None):
+def bernoulli(x, p=None, name=None):
+    """random.py:53 — probabilities from x, or a scalar ``p`` applied over
+    x's shape when given."""
     key = rng.next_key()
-    return apply_op(
-        "bernoulli",
-        lambda v: jax.random.bernoulli(key, v).astype(v.dtype),
-        [x.detach() if isinstance(x, Tensor) else x],
-    )
+
+    def fn(v):
+        probs = v if p is None else jnp.full(v.shape, p, jnp.float32)
+        return jax.random.bernoulli(key, probs, v.shape).astype(v.dtype)
+
+    return apply_op("bernoulli", fn,
+                    [x.detach() if isinstance(x, Tensor) else x])
 
 
 @register_op("multinomial")

@@ -107,16 +107,40 @@ def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
 
 
 @_reg("nanmedian")
-def nanmedian(x, axis=None, keepdim=False, name=None):
-    return apply_op("nanmedian",
-                    lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim), [x])
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    if mode == "min" and isinstance(axis, int):
+        # reference: mode='min' with an int axis returns (values, indices)
+        def fn(v):
+            vals = jnp.nanquantile(v, 0.5, axis=axis, keepdims=keepdim,
+                                   method="lower")
+            cmp = vals if keepdim else jnp.expand_dims(vals, axis)
+            is_med = (v == cmp) & ~jnp.isnan(v)
+            n = v.shape[axis]
+            # first matching position: argmin of (position + n·not_median)
+            first = jnp.argmin(
+                jnp.where(is_med, 0, 1) * n + jnp.arange(n).reshape(
+                    [-1 if i == axis % v.ndim else 1 for i in range(v.ndim)]),
+                axis=axis, keepdims=keepdim)
+            return vals, first.astype(jnp.int64)
+
+        return apply_op("nanmedian", fn, [x])
+
+    def fn(v):
+        if mode == "min":
+            return jnp.nanquantile(v, 0.5, axis=axis, keepdims=keepdim,
+                                   method="lower")
+        return jnp.nanmedian(v, axis=axis, keepdims=keepdim)
+
+    return apply_op("nanmedian", fn, [x])
 
 
 @_reg("nanquantile")
-def nanquantile(x, q, axis=None, keepdim=False, name=None):
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
     return apply_op(
         "nanquantile",
-        lambda v: jnp.nanquantile(v, q, axis=axis, keepdims=keepdim), [x])
+        lambda v: jnp.nanquantile(v, q, axis=axis, keepdims=keepdim,
+                                  method=interpolation), [x])
 
 
 @_reg("vander")
@@ -135,17 +159,25 @@ def unflatten(x, axis, shape, name=None):
     return apply_op("unflatten", fn, [x])
 
 
-def _split_family(name, jfn):
-    def op(x, num_or_indices, name=None):
-        out = apply_op(
-            name, lambda v: tuple(jfn(v, num_or_indices)), [x])
-        return list(out) if isinstance(out, tuple) else [out]
+def _split_family(name, jfn, with_axis=False):
+    if with_axis:
+        def op(x, num_or_indices, axis=0, name=None):
+            out = apply_op(
+                name, lambda v: tuple(jfn(v, num_or_indices, axis)), [x])
+            return list(out) if isinstance(out, tuple) else [out]
+    else:
+        def op(x, num_or_indices, name=None):
+            out = apply_op(
+                name, lambda v: tuple(jfn(v, num_or_indices)), [x])
+            return list(out) if isinstance(out, tuple) else [out]
 
     op.__name__ = name
     return op
 
 
-tensor_split = _split_family("tensor_split", lambda v, s: jnp.array_split(v, s))
+tensor_split = _split_family(
+    "tensor_split", lambda v, s, ax: jnp.array_split(v, s, axis=ax),
+    with_axis=True)
 hsplit = _split_family("hsplit", jnp.hsplit)
 vsplit = _split_family("vsplit", jnp.vsplit)
 dsplit = _split_family("dsplit", jnp.dsplit)
@@ -232,18 +264,26 @@ def isin(x, test_x, assume_unique=False, invert=False, name=None):
 
 
 @_reg("bitwise_left_shift")
-def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
-    return apply_op("bitwise_left_shift", jnp.left_shift, [x, y])
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    r = apply_op("bitwise_left_shift", jnp.left_shift, [x, y])
+    if out is not None:
+        out._value = r._value
+        return out
+    return r
 
 
 @_reg("bitwise_right_shift")
-def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
     def fn(a, b):
         if is_arithmetic:
             return jnp.right_shift(a, b)
         return jax.lax.shift_right_logical(a, b.astype(a.dtype))
 
-    return apply_op("bitwise_right_shift", fn, [x, y])
+    r = apply_op("bitwise_right_shift", fn, [x, y])
+    if out is not None:
+        out._value = r._value
+        return out
+    return r
 
 
 def block_diag(inputs, name=None):
@@ -389,13 +429,13 @@ def slice_scatter(x, value, axes, starts, ends, strides, name=None):
 
 
 @_reg("select_scatter")
-def select_scatter(x, value, axis, index, name=None):
+def select_scatter(x, values, axis, index, name=None):
     def fn(v, val):
         idx = [slice(None)] * v.ndim
         idx[axis % v.ndim] = int(index)
         return v.at[tuple(idx)].set(val)
 
-    return apply_op("select_scatter", fn, [x, value])
+    return apply_op("select_scatter", fn, [x, values])
 
 
 @_reg("reduce_as")

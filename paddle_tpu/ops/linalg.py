@@ -24,8 +24,8 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
 
 
 @register_op("mm", tensor_method="mm")
-def mm(x, y, name=None):
-    return apply_op("mm", jnp.matmul, [x, y])
+def mm(input, mat2, name=None):
+    return apply_op("mm", jnp.matmul, [input, mat2])
 
 
 @register_op("bmm", tensor_method="bmm")
@@ -67,10 +67,15 @@ def dist(x, y, p=2, name=None):
 
 
 @register_op("histogram")
-def histogram(input, bins=100, min=0, max=0, name=None):
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
     v = _unwrap(input)
     lo, hi = (float(min), float(max)) if (min != 0 or max != 0) else (float(jnp.min(v)), float(jnp.max(v)))
-    h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+    w = _unwrap(weight).reshape(-1) if weight is not None else None
+    h, _ = jnp.histogram(v.reshape(-1), bins=bins, range=(lo, hi), weights=w,
+                         density=density)
+    if density or w is not None:
+        return Tensor(h.astype(jnp.float32))
     return Tensor(h.astype(jnp.int64))
 
 
@@ -170,8 +175,15 @@ def eigvals(x, name=None):
 
 
 @register_op("solve")
-def solve(x, y, name=None):
-    return apply_op("solve", jnp.linalg.solve, [x, y])
+def solve(x, y, left=True, name=None):
+    def fn(a, b):
+        if left:
+            return jnp.linalg.solve(a, b)
+        # right solve X A = B  ⇔  Aᵀ Xᵀ = Bᵀ
+        return jnp.linalg.solve(jnp.swapaxes(a, -1, -2),
+                                jnp.swapaxes(b, -1, -2)).swapaxes(-1, -2)
+
+    return apply_op("solve", fn, [x, y])
 
 
 @register_op("triangular_solve")
@@ -194,9 +206,16 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
 
 
 @register_op("matrix_rank")
-def matrix_rank(x, tol=None, hermitian=False, name=None):
+def matrix_rank(x, tol=None, hermitian=False, atol=None, rtol=None, name=None):
     v = _unwrap(x)
-    return Tensor(jnp.linalg.matrix_rank(v, rtol=tol).astype(jnp.int64))
+    if atol is not None:
+        # count singular values above the absolute threshold
+        sv = jnp.linalg.svd(v, compute_uv=False)
+        thresh = jnp.maximum(jnp.asarray(atol),
+                             (rtol or 0.0) * jnp.max(sv, axis=-1, keepdims=True))
+        return Tensor(jnp.sum(sv > thresh, axis=-1).astype(jnp.int64))
+    eff = rtol if rtol is not None else tol
+    return Tensor(jnp.linalg.matrix_rank(v, rtol=eff).astype(jnp.int64))
 
 
 @register_op("cond")

@@ -98,8 +98,8 @@ def swapaxes(x, axis0, axis1, name=None):
 
 
 @register_op("t", tensor_method="t")
-def t(x, name=None):
-    return apply_op("t", lambda v: v.T, [x])
+def t(input, name=None):
+    return apply_op("t", lambda v: v.T, [input])
 
 
 @register_op("concat")
@@ -157,11 +157,11 @@ def chunk(x, chunks, axis=0, name=None):
 
 
 @register_op("unbind")
-def unbind(x, axis=0, name=None):
-    v = _unwrap(x)
+def unbind(input, axis=0, name=None):
+    v = _unwrap(input)
     n = v.shape[axis]
     return [
-        apply_op("unbind", lambda v, i=i: jnp.take(v, i, axis=axis), [x]) for i in range(n)
+        apply_op("unbind", lambda v, i=i: jnp.take(v, i, axis=axis), [input]) for i in range(n)
     ]
 
 
@@ -202,10 +202,10 @@ def broadcast_to(x, shape, name=None):
 
 
 @register_op("broadcast_tensors")
-def broadcast_tensors(inputs, name=None):
-    shapes = [tuple(_unwrap(t).shape) for t in inputs]
+def broadcast_tensors(input, name=None):
+    shapes = [tuple(_unwrap(t).shape) for t in input]
     out_shape = jnp.broadcast_shapes(*shapes)
-    return [expand(t, out_shape) for t in inputs]
+    return [expand(t, out_shape) for t in input]
 
 
 @register_op("flip", tensor_method="flip", aliases=("reverse",))
@@ -309,34 +309,51 @@ def index_put(x, indices, value, accumulate=False, name=None):
 
 
 @register_op("take_along_axis")
-def take_along_axis(x, indices, axis, broadcast=True, name=None):
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
     return apply_op(
         "take_along_axis",
         lambda v, i: jnp.take_along_axis(v, i, axis=axis),
-        [x, indices],
+        [arr, indices],
     )
 
 
 @register_op("put_along_axis")
-def put_along_axis(x, indices, values, axis, reduce="assign", name=None):
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
     def fn(v, i, u):
-        u = jnp.broadcast_to(u, i.shape) if jnp.ndim(u) else jnp.full(i.shape, u, v.dtype)
+        if broadcast:
+            u = jnp.broadcast_to(u, i.shape) if jnp.ndim(u) else jnp.full(i.shape, u, v.dtype)
+        elif i.shape != u.shape:
+            # reference broadcast=False: exact-shape contract, loud mismatch
+            raise ValueError(
+                f"put_along_axis(broadcast=False): values shape {u.shape} "
+                f"must equal indices shape {i.shape}")
         if reduce == "assign":
             return jnp.put_along_axis(v, i, u.astype(v.dtype), axis=axis, inplace=False)
-        dims = list(range(v.ndim))
         onto = jnp.moveaxis(v, axis, 0)
-        # generic path: scatter add/mul via .at on moved axis
+        # generic path: scatter add/mul/mean via .at on the moved axis
         full_idx = jnp.moveaxis(i, axis, 0)
         upd = jnp.moveaxis(u.astype(v.dtype), axis, 0)
         grid = jnp.meshgrid(*[jnp.arange(s) for s in full_idx.shape], indexing="ij")
         coords = (full_idx,) + tuple(grid[1:])
+        if not include_self:
+            # scattered slots start from the reduce identity, not v's values
+            ident = 1.0 if reduce in ("mul", "multiply") else 0.0
+            onto = onto.at[coords].set(jnp.full_like(upd, ident))
         if reduce == "add":
             return jnp.moveaxis(onto.at[coords].add(upd), 0, axis)
-        if reduce == "mul" or reduce == "multiply":
+        if reduce in ("mul", "multiply"):
             return jnp.moveaxis(onto.at[coords].multiply(upd), 0, axis)
+        if reduce == "mean":
+            summed = onto.at[coords].add(upd)
+            counts = jnp.zeros_like(onto).at[coords].add(jnp.ones_like(upd))
+            if include_self:
+                counts = counts + 1.0  # original value participates
+            counts = jnp.where(counts == 0, 1.0, counts)
+            return jnp.moveaxis((summed / counts).astype(v.dtype), 0, axis)
         raise ValueError(f"unsupported reduce {reduce!r}")
 
-    return apply_op("put_along_axis", fn, [x, indices, values])
+    return apply_op("put_along_axis", fn, [arr, indices, values])
 
 
 @register_op("masked_select")
@@ -392,7 +409,8 @@ def repeat_interleave(x, repeats, axis=None, name=None):
 
 
 @register_op("slice")
-def slice(x, axes, starts, ends, name=None):
+def slice(input, axes, starts, ends, name=None):
+    x = input
     axes = _ints(axes)
     starts = _ints(starts)
     ends = _ints(ends)
@@ -598,8 +616,27 @@ def as_strided(x, shape, stride, offset=0, name=None):
     return apply_op("as_strided", fn, [x])
 
 
-@register_op("unfold")
-def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+@register_op("unfold", tensor_method="unfold")
+def unfold(x, axis, size, step, name=None):
+    """paddle.unfold / Tensor.unfold (tensor/manipulation.py:7230) —
+    sliding windows of ``size`` every ``step`` along ``axis``; the window
+    becomes a NEW LAST dim.  (The im2col operator of the same name lives at
+    nn.functional.unfold — see unfold_im2col.)"""
+    def fn(v):
+        ax = axis % v.ndim
+        n = (v.shape[ax] - size) // step + 1
+        starts = jnp.arange(n) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]  # [n, size]
+        out = jnp.take(v, idx.reshape(-1), axis=ax)
+        out = out.reshape(v.shape[:ax] + (n, size) + v.shape[ax + 1:])
+        # window dim moves to the end (torch/paddle contract)
+        return jnp.moveaxis(out, ax + 1, -1)
+
+    return apply_op("unfold", fn, [x])
+
+
+@register_op("unfold_im2col")
+def unfold_im2col(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     ks = _ints(kernel_sizes) if not isinstance(kernel_sizes, int) else (kernel_sizes, kernel_sizes)
     st = _ints(strides) if not isinstance(strides, int) else (strides, strides)
     pd = _ints(paddings) if not isinstance(paddings, int) else (paddings, paddings)
@@ -614,20 +651,23 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
         l = patches.shape[2] * patches.shape[3]
         return patches.reshape(n, -1, l)
 
-    return apply_op("unfold", fn, [x])
+    return apply_op("unfold_im2col", fn, [x])
 
 
 @register_op("pad", tensor_method=None)
-def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW",
+        pad_from_left_axis=True, name=None):
     """paddle.nn.functional.pad semantics: `pad` is [lo,hi] pairs from last dim backwards
-    when len(pad)==2*ndim is False; full numpy spec when list of pairs."""
+    when len(pad)==2*ndim is False; full numpy spec when list of pairs.
+    ``pad_from_left_axis`` (full-spec only): pairs start at dim 0 (True,
+    the reference default) or at the last dim (False)."""
     p = _ints(pad) if not isinstance(pad, int) else (pad,)
 
     def fn(v):
         nd = v.ndim
         pairs = [(p[2 * i], p[2 * i + 1]) for i in range(len(p) // 2)]
         if len(p) == 2 * nd:
-            cfg = pairs  # full spec pads dim 0 → dim N-1 (paddle constant-mode form)
+            cfg = pairs if pad_from_left_axis else pairs[::-1]
         else:
             # short spec: pairs pad spatial dims, first pair = innermost spatial dim
             cfg = [(0, 0)] * nd

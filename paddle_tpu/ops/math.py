@@ -28,9 +28,22 @@ def _axis(axis):
     return int(_unwrap(axis))
 
 
-def _unary(name, jfn, method=None, aliases=()):
-    def op(x, name=None):
-        return apply_op(name or op.__name__, jfn, [x])
+def _with_out(result, out):
+    """Honor the reference's optional out= (logical/bitwise families write
+    into the given tensor and return it)."""
+    if out is None:
+        return result
+    out._value = result._value
+    return out
+
+
+def _unary(name, jfn, method=None, aliases=(), with_out=False):
+    if with_out:
+        def op(x, out=None, name=None):
+            return _with_out(apply_op(name or op.__name__, jfn, [x]), out)
+    else:
+        def op(x, name=None):
+            return apply_op(name or op.__name__, jfn, [x])
 
     op.__name__ = name
     op.__qualname__ = name
@@ -39,9 +52,13 @@ def _unary(name, jfn, method=None, aliases=()):
     return op
 
 
-def _binary(name, jfn, method=None, aliases=()):
-    def op(x, y, name=None):
-        return apply_op(name or op.__name__, jfn, [x, y])
+def _binary(name, jfn, method=None, aliases=(), with_out=False):
+    if with_out:
+        def op(x, y, out=None, name=None):
+            return _with_out(apply_op(name or op.__name__, jfn, [x, y]), out)
+    else:
+        def op(x, y, name=None):
+            return apply_op(name or op.__name__, jfn, [x, y])
 
     op.__name__ = name
     op.__qualname__ = name
@@ -76,8 +93,6 @@ _unary("acosh", jnp.arccosh)
 _unary("atanh", jnp.arctanh)
 _unary("ceil", jnp.ceil)
 _unary("floor", jnp.floor)
-_unary("round", jnp.round)
-_unary("trunc", jnp.trunc)
 _unary("square", jnp.square)
 _unary("reciprocal", jnp.reciprocal)
 _unary("erf", jax.scipy.special.erf)
@@ -86,12 +101,11 @@ _unary("lgamma", jax.scipy.special.gammaln)
 _unary("digamma", jax.scipy.special.digamma)
 _unary("i0", lambda v: jax.scipy.special.i0(v))
 _unary("sigmoid", jax.nn.sigmoid)
-_unary("logit", jax.scipy.special.logit)
 _unary("isfinite", jnp.isfinite)
 _unary("isinf", jnp.isinf)
 _unary("isnan", jnp.isnan)
-_unary("logical_not", jnp.logical_not)
-_unary("bitwise_not", jnp.bitwise_not)
+_unary("logical_not", jnp.logical_not, with_out=True)
+_unary("bitwise_not", jnp.bitwise_not, with_out=True)
 _unary("conj", jnp.conj)
 _unary("real", jnp.real)
 _unary("imag", jnp.imag)
@@ -113,12 +127,12 @@ _binary("minimum", jnp.minimum)
 _binary("fmax", jnp.fmax)
 _binary("fmin", jnp.fmin)
 _binary("atan2", jnp.arctan2)
-_binary("logical_and", jnp.logical_and)
-_binary("logical_or", jnp.logical_or)
-_binary("logical_xor", jnp.logical_xor)
-_binary("bitwise_and", jnp.bitwise_and)
-_binary("bitwise_or", jnp.bitwise_or)
-_binary("bitwise_xor", jnp.bitwise_xor)
+_binary("logical_and", jnp.logical_and, with_out=True)
+_binary("logical_or", jnp.logical_or, with_out=True)
+_binary("logical_xor", jnp.logical_xor, with_out=True)
+_binary("bitwise_and", jnp.bitwise_and, with_out=True)
+_binary("bitwise_or", jnp.bitwise_or, with_out=True)
+_binary("bitwise_xor", jnp.bitwise_xor, with_out=True)
 _binary("equal", jnp.equal)
 _binary("not_equal", jnp.not_equal)
 _binary("greater_than", jnp.greater)
@@ -136,6 +150,30 @@ _binary("inner", jnp.inner)
 _binary("outer", lambda a, b: jnp.outer(a, b))
 _binary("kron", jnp.kron)
 _binary("dot", lambda a, b: jnp.sum(a * b, axis=-1) if a.ndim > 1 else jnp.dot(a, b))
+
+
+@register_op("trunc", tensor_method="trunc")
+def trunc(input, name=None):
+    return apply_op("trunc", jnp.trunc, [input])
+
+
+@register_op("round", tensor_method="round")
+def round(x, decimals=0, name=None):  # noqa: A001 — paddle exposes paddle.round
+    """tensor/ops.py:797 — round to ``decimals`` places (banker's rounding
+    at .5, like the reference kernel)."""
+    return apply_op("round", lambda v: jnp.round(v, int(decimals)), [x])
+
+
+@register_op("logit", tensor_method="logit")
+def logit(x, eps=None, name=None):
+    """math.py logit — inputs clipped into [eps, 1-eps] first when eps is
+    given (the reference returns NaN outside [0,1] when eps is None)."""
+    def fn(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jax.scipy.special.logit(v)
+
+    return apply_op("logit", fn, [x])
 
 
 @register_op("scale", tensor_method="scale")
@@ -227,10 +265,24 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
 # ---- reductions ----
 
 
-def _reduce(op_name, jfn, method=None, int_out=False):
-    def op(x, axis=None, keepdim=False, name=None):
-        ax = _axis(axis)
-        return apply_op(op_name, lambda v: jfn(v, axis=ax, keepdims=keepdim), [x])
+def _reduce(op_name, jfn, method=None, int_out=False, with_dtype=False):
+    if with_dtype:
+        # reference order: (x, axis, dtype, keepdim) — math.py sum/prod/nansum
+        def op(x, axis=None, dtype=None, keepdim=False, name=None):
+            ax = _axis(axis)
+            dt = dtypes.convert_dtype(dtype) if dtype is not None else None
+
+            def fn(v):
+                if dt is not None:
+                    v = v.astype(dt)
+                return jfn(v, axis=ax, keepdims=keepdim)
+
+            return apply_op(op_name, fn, [x])
+    else:
+        def op(x, axis=None, keepdim=False, name=None):
+            ax = _axis(axis)
+            return apply_op(op_name,
+                            lambda v: jfn(v, axis=ax, keepdims=keepdim), [x])
 
     name = op_name
 
@@ -240,9 +292,9 @@ def _reduce(op_name, jfn, method=None, int_out=False):
     return op
 
 
-_reduce("sum", lambda v, axis, keepdims: jnp.sum(v, axis=axis, keepdims=keepdims))
+_reduce("sum", lambda v, axis, keepdims: jnp.sum(v, axis=axis, keepdims=keepdims), with_dtype=True)
 _reduce("mean", lambda v, axis, keepdims: jnp.mean(v, axis=axis, keepdims=keepdims))
-_reduce("prod", lambda v, axis, keepdims: jnp.prod(v, axis=axis, keepdims=keepdims))
+_reduce("prod", lambda v, axis, keepdims: jnp.prod(v, axis=axis, keepdims=keepdims), with_dtype=True)
 _reduce("max", lambda v, axis, keepdims: jnp.max(v, axis=axis, keepdims=keepdims), method="max")
 _reduce("min", lambda v, axis, keepdims: jnp.min(v, axis=axis, keepdims=keepdims), method="min")
 _reduce("amax", lambda v, axis, keepdims: jnp.max(v, axis=axis, keepdims=keepdims))
@@ -250,7 +302,7 @@ _reduce("amin", lambda v, axis, keepdims: jnp.min(v, axis=axis, keepdims=keepdim
 _reduce("any", lambda v, axis, keepdims: jnp.any(v, axis=axis, keepdims=keepdims))
 _reduce("all", lambda v, axis, keepdims: jnp.all(v, axis=axis, keepdims=keepdims))
 _reduce("logsumexp", lambda v, axis, keepdims: jax.scipy.special.logsumexp(v, axis=axis, keepdims=keepdims))
-_reduce("nansum", lambda v, axis, keepdims: jnp.nansum(v, axis=axis, keepdims=keepdims))
+_reduce("nansum", lambda v, axis, keepdims: jnp.nansum(v, axis=axis, keepdims=keepdims), with_dtype=True)
 _reduce("nanmean", lambda v, axis, keepdims: jnp.nanmean(v, axis=axis, keepdims=keepdims))
 
 
@@ -277,10 +329,14 @@ def median(x, axis=None, keepdim=False, mode="avg", name=None):
 
 
 @register_op("quantile")
-def quantile(x, q, axis=None, keepdim=False, name=None):
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
     ax = _axis(axis)
+    if interpolation not in ("linear", "lower", "higher", "nearest", "midpoint"):
+        raise ValueError(f"unsupported interpolation {interpolation!r}")
     return apply_op(
-        "quantile", lambda v: jnp.quantile(v, jnp.asarray(q), axis=ax, keepdims=keepdim), [x]
+        "quantile",
+        lambda v: jnp.quantile(v, jnp.asarray(q), axis=ax, keepdims=keepdim,
+                               method=interpolation), [x]
     )
 
 
@@ -323,8 +379,12 @@ def cummax(x, axis=None, dtype="int64", name=None):
 
 
 @register_op("logcumsumexp")
-def logcumsumexp(x, axis=None, name=None):
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype) if dtype is not None else None
+
     def fn(v):
+        if dt is not None:
+            v = v.astype(dt)
         if axis is None:
             v = v.reshape(-1)
             ax = 0

@@ -352,7 +352,11 @@ SPECS = [
     S("transpose", [F(2, 3, 4)], lambda x: x.transpose(2, 0, 1), kw=dict(perm=(2, 0, 1))),
     S("unbind", [F(3, 4)], lambda x: [x[i] for i in range(3)], kw=dict(axis=0), out=0),
     S("unstack", [F(3, 4)], lambda x: [x[i] for i in range(3)], kw=dict(axis=0), out=0),
-    S("unfold", [F(1, 1, 4, 4)], lambda x: _np_unfold_2x2(x), kw=dict(kernel_sizes=2, strides=2), grad=False),
+    S("unfold_im2col", [F(1, 1, 4, 4)], lambda x: _np_unfold_2x2(x), kw=dict(kernel_sizes=2, strides=2), grad=False),
+    # paddle.unfold = sliding window along an axis (window dim appended last)
+    S("unfold", [F(2, 6)],
+      lambda x: np.stack([x[:, o:o + 3] for o in (0, 2)], axis=1),
+      kw=dict(axis=1, size=3, step=2), grad=True),
     # element-strides (not numpy's byte-strides): overlapping windows of a flat [12]
     S("as_strided", [F(12)],
       lambda x: np.stack([x.reshape(-1)[o:o + 4] for o in (0, 2, 4)]),

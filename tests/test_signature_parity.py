@@ -1,0 +1,270 @@
+"""Signature-level parity with the reference: shared public functions must
+accept the reference's parameter names (AST-parsed defs vs
+inspect.signature), plus behavior tests for the parameters added to close
+the audit."""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import inspect
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+REF = "/root/reference/python/paddle"
+
+# (reference module path, our module) pairs the audit sweeps
+CHECK = [
+    ("nn/functional", "paddle_tpu.nn.functional"),
+    ("tensor", "paddle_tpu"),
+    ("vision/ops", "paddle_tpu.vision.ops"),
+    ("linalg", "paddle_tpu.linalg"),
+    ("distributed/communication", "paddle_tpu.distributed"),
+    ("optimizer", "paddle_tpu.optimizer"),
+]
+
+# name → params that are intentionally absent (with the reason)
+ALLOW = {
+    # the reference file defines an unrelated inner helper named `cond`
+    # whose params leak into the AST scan; paddle.cond(x, p) matches
+    "cond": {"_", "i"},
+    # the AST scan keys by bare name, so communication/stream/*.py variants
+    # (tensor_or_tensor_list) collide with the TOP-LEVEL functions we match
+    # (reference top-level uses tensor_list / in_/out_tensor_list — see
+    # communication/scatter.py:39, all_gather.py:38, reduce_scatter.py:33)
+    "all_gather": {"tensor_or_tensor_list"},
+    "reduce_scatter": {"tensor_or_tensor_list"},
+    "scatter": {"tensor_or_tensor_list"},
+    "alltoall": {"in_tensor_or_tensor_list", "out_tensor_or_tensor_list"},
+}
+
+
+def _ref_sigs(relpath):
+    out = {}
+    base = os.path.join(REF, relpath)
+    files = []
+    if os.path.isdir(base):
+        for root, _, fs in os.walk(base):
+            files += [os.path.join(root, f) for f in fs if f.endswith(".py")]
+    elif os.path.exists(base + ".py"):
+        files = [base + ".py"]
+    for f in files:
+        try:
+            tree = ast.parse(open(f).read())
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and not node.name.startswith("_"):
+                if any(isinstance(d, ast.Name) and d.id == "overload"
+                       for d in node.decorator_list):
+                    continue
+                a = node.args
+                out[node.name] = {p.arg for p in
+                                  a.posonlyargs + a.args + a.kwonlyargs}
+    return out
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+@pytest.mark.parametrize("ref_ns,mod_name", CHECK)
+def test_shared_functions_accept_reference_params(ref_ns, mod_name):
+    sigs = _ref_sigs(ref_ns)
+    mod = importlib.import_module(mod_name)
+    bad = []
+    for name, ref_params in sorted(sigs.items()):
+        fn = getattr(mod, name, None)
+        if fn is None or not callable(fn) or inspect.isclass(fn):
+            continue
+        try:
+            mine = inspect.signature(fn)
+        except (ValueError, TypeError):
+            continue
+        if any(p.kind == inspect.Parameter.VAR_KEYWORD
+               for p in mine.parameters.values()):
+            continue
+        missing = (ref_params - set(mine.parameters) - {"self", "name"}
+                   - ALLOW.get(name, set()))
+        if missing:
+            bad.append(f"{name}: {sorted(missing)}")
+    assert not bad, f"{mod_name} signature gaps: {bad}"
+
+
+class TestAddedParams:
+    def test_sum_prod_dtype(self):
+        x = paddle.to_tensor(np.array([1, 2, 3], np.int32))
+        s = paddle.sum(x, dtype="float64")
+        assert "float" in str(s.dtype)
+        p = paddle.prod(x, dtype="int64")
+        assert int(p.numpy()) == 6
+
+    def test_round_decimals(self):
+        x = paddle.to_tensor(np.array([1.234, -5.678], np.float32))
+        np.testing.assert_allclose(paddle.round(x, decimals=1).numpy(),
+                                   [1.2, -5.7], atol=1e-6)
+
+    def test_logit_eps(self):
+        x = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+        out = paddle.logit(x, eps=1e-3).numpy()
+        assert np.isfinite(out).all()
+
+    def test_quantile_interpolation(self):
+        x = paddle.to_tensor(np.arange(5, dtype=np.float32))
+        assert float(paddle.quantile(x, 0.5, interpolation="lower").numpy()) == 2.0
+        with pytest.raises(ValueError):
+            paddle.quantile(x, 0.5, interpolation="bogus")
+
+    def test_solve_left_right(self):
+        a = np.array([[2.0, 0.0], [1.0, 3.0]], np.float32)
+        b = np.array([[4.0, 6.0], [2.0, 9.0]], np.float32)
+        right = paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b),
+                                    left=False).numpy()
+        np.testing.assert_allclose(right @ a, b, atol=1e-5)
+
+    def test_matrix_rank_atol_rtol(self):
+        m = np.diag([1.0, 0.5, 1e-8]).astype(np.float32)
+        r = paddle.linalg.matrix_rank(paddle.to_tensor(m), atol=1e-4)
+        assert int(r.numpy()) == 2
+
+    def test_histogram_weight_density(self):
+        x = paddle.to_tensor(np.array([0.1, 0.4, 0.6, 0.9], np.float32))
+        w = paddle.to_tensor(np.array([1.0, 1.0, 2.0, 2.0], np.float32))
+        h = paddle.histogram(x, bins=2, min=0.0, max=1.0, weight=w)
+        np.testing.assert_allclose(h.numpy(), [2.0, 4.0])
+        d = paddle.histogram(x, bins=2, min=0.0, max=1.0, density=True)
+        assert float((d.numpy() * 0.5).sum()) == pytest.approx(1.0)
+
+    def test_bernoulli_p(self):
+        x = paddle.zeros([2000])
+        s = paddle.bernoulli(x, p=0.25).numpy()
+        assert 0.18 < s.mean() < 0.32
+
+    def test_put_along_axis_include_self_and_mean(self):
+        x = paddle.to_tensor(np.array([[10.0, 20.0]], np.float32))
+        idx = paddle.to_tensor(np.array([[0, 0]], np.int64))
+        vals = paddle.to_tensor(np.array([[1.0, 3.0]], np.float32))
+        with_self = paddle.put_along_axis(x, idx, vals, 1, reduce="add")
+        np.testing.assert_allclose(with_self.numpy(), [[14.0, 20.0]])
+        no_self = paddle.put_along_axis(x, idx, vals, 1, reduce="add",
+                                        include_self=False)
+        np.testing.assert_allclose(no_self.numpy(), [[4.0, 20.0]])
+        mean = paddle.put_along_axis(x, idx, vals, 1, reduce="mean",
+                                     include_self=False)
+        np.testing.assert_allclose(mean.numpy(), [[2.0, 20.0]])
+
+    def test_out_param_writes_in_place(self):
+        a = paddle.to_tensor(np.array([True, False]))
+        b = paddle.to_tensor(np.array([True, True]))
+        out = paddle.zeros([2], "bool")
+        r = paddle.logical_and(a, b, out=out)
+        assert r is out
+        np.testing.assert_array_equal(out.numpy(), [True, False])
+
+    def test_unfold_is_sliding_window(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(2, 6))
+        w = paddle.unfold(x, axis=1, size=3, step=2)
+        assert tuple(w.shape) == (2, 2, 3)
+        np.testing.assert_allclose(w.numpy()[0], [[0, 1, 2], [2, 3, 4]])
+        # the Tensor method mirrors it
+        np.testing.assert_allclose(x.unfold(1, 3, 2).numpy(), w.numpy())
+        # im2col remains at nn.functional.unfold
+        import paddle_tpu.nn.functional as F
+
+        img = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        cols = F.unfold(img, kernel_sizes=2, strides=2)
+        assert tuple(cols.shape) == (1, 4, 4)
+
+    def test_conv2d_transpose_output_size(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((1, 2, 5, 5)).astype(np.float32))
+        w = paddle.to_tensor(np.random.default_rng(1)
+                             .standard_normal((2, 3, 3, 3)).astype(np.float32))
+        out = F.conv2d_transpose(x, w, stride=2, output_size=(11, 11))
+        assert tuple(out.shape)[-2:] == (11, 11)
+        with pytest.raises(ValueError, match="unreachable"):
+            F.conv2d_transpose(x, w, stride=2, output_size=(20, 20))
+
+    def test_embedding_max_norm(self):
+        import paddle_tpu.nn.functional as F
+
+        w = paddle.to_tensor(np.array([[3.0, 4.0], [0.3, 0.4]], np.float32))
+        ids = paddle.to_tensor(np.array([0, 1], np.int64))
+        out = F.embedding(ids, w, max_norm=1.0).numpy()
+        assert np.linalg.norm(out[0]) == pytest.approx(1.0, rel=1e-5)
+        assert np.linalg.norm(out[1]) == pytest.approx(0.5, rel=1e-5)
+        with pytest.raises(NotImplementedError):
+            F.embedding(ids, w, scale_grad_by_freq=True)
+
+    def test_pad_from_left_axis(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        left = F.pad(x, [1, 0, 0, 0], pad_from_left_axis=True)
+        assert tuple(left.shape) == (3, 3)
+        last = F.pad(x, [1, 0, 0, 0], pad_from_left_axis=False)
+        assert tuple(last.shape) == (2, 4)
+
+    def test_hardsigmoid_slope_offset(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(np.array([0.0], np.float32))
+        assert float(F.hardsigmoid(x, slope=0.2, offset=0.1).numpy()) == \
+            pytest.approx(0.1)
+
+    def test_tensor_split_axis(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(2, 6))
+        parts = paddle.tensor_split(x, 3, axis=1)
+        assert len(parts) == 3 and tuple(parts[0].shape) == (2, 2)
+
+    def test_nanmedian_mode_min(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+        vals, idx = paddle.nanmedian(x, axis=0, mode="min")
+        assert float(vals.numpy()) == 2.0
+        assert int(idx.numpy()) == 1
+
+    def test_put_along_axis_broadcast_false(self):
+        x = paddle.to_tensor(np.zeros((1, 3), np.float32))
+        idx = paddle.to_tensor(np.array([[0, 1]], np.int64))
+        ok = paddle.put_along_axis(x, idx,
+                                   paddle.to_tensor(np.array([[1.0, 2.0]],
+                                                             np.float32)),
+                                   1, broadcast=False)
+        np.testing.assert_allclose(ok.numpy(), [[1.0, 2.0, 0.0]])
+        with pytest.raises(ValueError, match="broadcast=False"):
+            paddle.put_along_axis(x, idx,
+                                  paddle.to_tensor(np.array([[1.0]],
+                                                            np.float32)),
+                                  1, broadcast=False)
+
+    def test_collectives_keep_reference_keywords(self):
+        import paddle_tpu.distributed as dist
+
+        tl = []
+        dist.all_gather(tensor_list=tl,
+                        tensor=paddle.to_tensor(np.ones((1, 2), np.float32)))
+        assert len(tl) == 1
+        dist.scatter(paddle.to_tensor(np.zeros((1, 2), np.float32)),
+                     tensor_list=[paddle.to_tensor(np.ones((1, 2), np.float32))],
+                     src=0)
+        out = paddle.to_tensor(np.zeros((1, 2), np.float32))
+        dist.bitwise_ok = True  # marker: no TypeError raised above
+
+    def test_keyword_name_compat(self):
+        """Reference keyword call-sites must work verbatim."""
+        x = np.eye(2, dtype=np.float32)
+        assert paddle.mm(input=paddle.to_tensor(x),
+                         mat2=paddle.to_tensor(x)).shape == (2, 2)
+        assert paddle.t(input=paddle.to_tensor(x)).shape == (2, 2)
+        assert paddle.rank(input=paddle.to_tensor(x)).numpy() == 2
+        arr = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+        idx = paddle.to_tensor(np.array([[0]], np.int64))
+        assert paddle.take_along_axis(arr=arr, indices=idx, axis=1).shape == (1, 1)
+        import paddle_tpu.distributed as dist
+
+        t = paddle.to_tensor(np.ones(2, np.float32))
+        dist.all_reduce(t, use_calc_stream=True)
+        assert dist.get_backend(group=None) == "xla"
