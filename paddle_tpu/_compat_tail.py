@@ -506,6 +506,124 @@ geometric_ = _make_random_inplace(
 __all__ += ["normal_", "log_normal_", "bernoulli_", "cauchy_", "geometric_"]
 
 
+def create_tensor(dtype="float32", name=None, persistable=False):
+    """Method-surface parity (creation.py create_tensor): an empty typed
+    tensor to be filled later."""
+    from .core import dtype as _dt
+
+    return Tensor(jnp.zeros((), _dt.convert_dtype(dtype)))
+
+
+def set_(x, source=None, shape=None, stride=None, offset=0, name=None):
+    """In-place re-point (manipulation.py set_): take source's values,
+    optionally re-viewed with (shape, stride, offset) element strides;
+    empty source → empty tensor."""
+    if source is None:
+        x._value = jnp.zeros((0,), _unwrap(x).dtype)
+    else:
+        v = _unwrap(source)
+        if stride is not None:
+            flat = v.reshape(-1)
+            import numpy as _np
+
+            shp = tuple(shape) if shape is not None else v.shape
+            grids = _np.meshgrid(*[_np.arange(s) for s in shp], indexing="ij")
+            idx = sum(g * st for g, st in zip(grids, stride)) + int(offset)
+            x._value = flat[jnp.asarray(idx.reshape(-1))].reshape(shp)
+        elif shape is not None:
+            x._value = v.reshape(tuple(shape))
+        else:
+            x._value = v
+    x._node, x._out_idx = None, 0
+    return x
+
+
+def resize_(x, shape, fill_zero=False, name=None):
+    """In-place resize (manipulation.py resize_): flatten then truncate, or
+    zero-extend — growth requires fill_zero=True, like the reference."""
+    import numpy as _np
+
+    v = _unwrap(x).reshape(-1)
+    n = int(_np.prod(shape)) if len(shape) else 1
+    if n <= v.shape[0]:
+        out = v[:n]
+    elif not fill_zero:
+        raise ValueError(
+            f"resize_: new shape {tuple(shape)} has more elements ({n}) than "
+            f"the tensor ({v.shape[0]}); pass fill_zero=True to zero-extend")
+    else:
+        out = jnp.concatenate([v, jnp.zeros((n - v.shape[0],), v.dtype)])
+    x._value = out.reshape(tuple(shape))
+    x._node, x._out_idx = None, 0
+    return x
+
+
+uniform_ = _make_random_inplace(
+    "uniform_", lambda v, min=-1.0, max=1.0, seed=0: jax.random.uniform(
+        _rng.next_key(), v.shape, jnp.float32, min, max))
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """Nucleus sampling (tensor/random.py top_p_sampling): keep the smallest
+    prefix of sorted probs whose mass exceeds ps, renormalize, sample.
+    Returns (scores, ids) like the reference."""
+    v = _unwrap(x)
+    p = _unwrap(ps).reshape(-1, 1) if not isinstance(ps, float) else ps
+    order = jnp.argsort(-v, axis=-1)
+    sorted_p = jnp.take_along_axis(v, order, axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    keep = cum - sorted_p < p  # first token always kept
+    filtered = jnp.where(keep, sorted_p, 0.0)
+    filtered = filtered / jnp.maximum(filtered.sum(-1, keepdims=True), 1e-12)
+    key = _rng.next_key()
+    idx_in_sorted = jax.random.categorical(key, jnp.log(
+        jnp.maximum(filtered, 1e-12)), axis=-1)
+    ids = jnp.take_along_axis(order, idx_in_sorted[..., None], axis=-1)
+    scores = jnp.take_along_axis(v, ids, axis=-1)
+    return Tensor(scores), Tensor(ids.astype(jnp.int64))
+
+
+__all__ += ["create_tensor", "set_", "resize_", "uniform_", "top_p_sampling"]
+
+
+# the reference's full Tensor-method surface (python/paddle/tensor/__init__.py
+# tensor_method_func) beyond what the op registry already installs: bound
+# generically — the module function's first parameter receives the tensor,
+# exactly like the reference's monkey-patching
+_TENSOR_METHOD_TAIL = [
+    "add_n", "addmm", "as_complex", "as_real", "atleast_1d", "atleast_2d",
+    "atleast_3d", "bincount", "bitwise_invert", "bitwise_left_shift",
+    "bitwise_right_shift", "block_diag", "broadcast_shape",
+    "broadcast_tensors", "broadcast_to", "bucketize", "cdist", "cholesky",
+    "cholesky_inverse", "cholesky_solve", "combinations", "concat", "cond",
+    "corrcoef", "count_nonzero", "cov", "create_parameter", "create_tensor",
+    "cross", "cummax", "cummin", "cumulative_trapezoid", "diag",
+    "diag_embed", "diagflat", "diagonal", "diagonal_scatter", "diff",
+    "dist", "dsplit", "eig", "eigvals", "eigvalsh", "equal_all",
+    "exponential_", "floor_mod", "frexp", "gammainc", "gammaincc",
+    "gammaln", "gather_nd", "histogram", "histogram_bin_edges",
+    "histogramdd", "householder_product", "hsplit", "i0e", "i1", "i1e",
+    "increment", "index_add", "index_fill", "index_put", "index_sample",
+    "index_select", "inverse", "is_complex", "is_empty",
+    "is_floating_point", "is_integer", "is_tensor", "isin", "isneginf",
+    "isposinf", "isreal", "istft", "kthvalue", "ldexp", "less", "lstsq",
+    "lu", "lu_unpack", "masked_scatter", "masked_select", "matrix_power",
+    "matrix_transpose", "mod", "mode", "moveaxis", "multi_dot",
+    "multigammaln", "multinomial", "multiplex", "nan_to_num", "nanmedian",
+    "nanquantile", "negative", "nonzero", "ormqr", "pca_lowrank", "pinv",
+    "polar", "polygamma", "put_along_axis", "qr", "quantile", "rank",
+    "reduce_as", "renorm", "reverse", "rot90", "scatter", "scatter_nd",
+    "scatter_nd_add", "select_scatter", "set_", "sgn", "shard_index",
+    "signbit", "sinc", "slice", "slice_scatter", "solve", "stack", "stanh",
+    "stft", "strided_slice", "svd_lowrank", "take", "take_along_axis",
+    "tensor_split", "tensordot", "top_p_sampling", "trapezoid",
+    "triangular_solve", "unbind", "unflatten", "unique",
+    "unique_consecutive", "unstack", "vander", "vsplit", "where", "where_",
+    "resize_", "uniform_",
+]
+
+
 def _install(ns):
     """Install the in-place tail + aliases into the paddle namespace and
     Tensor methods.  Called once from paddle_tpu/__init__ after all op
@@ -534,6 +652,11 @@ def _install(ns):
         "trunc", "frac", "digamma", "renorm", "multigammaln", "nan_to_num",
         "ldexp", "i0", "polygamma", "copysign", "masked_fill",
         "masked_scatter", "hypot", "less_equal", "flatten",
+        "acosh", "add", "asin", "asinh", "atanh", "ceil", "clip", "cosh",
+        "erfinv", "exp", "floor", "index_add", "index_fill", "index_put",
+        "lerp", "log1p", "logical_xor", "not_equal", "put_along_axis",
+        "reciprocal", "round", "rsqrt", "scale", "sigmoid", "sqrt",
+        "subtract",
     ]
     # this module's functions land on the namespace FIRST so their in-place
     # variants (multigammaln_, polygamma_, ...) can be synthesized below
@@ -564,4 +687,19 @@ def _install(ns):
                "geometric_", "tolist", "view", "view_as"):
         if not hasattr(Tensor, nm):
             setattr(Tensor, nm, globals().get(nm) or getattr(ns, nm))
+    # stft/istft are method-surface names served by the signal module
+    from . import signal as _signal
+
+    for nm, fn in (("stft", _signal.stft), ("istft", _signal.istft)):
+        if not hasattr(ns, nm):
+            setattr(ns, nm, fn)
+    # full reference Tensor-method tail: generic first-arg binding
+    for nm in _TENSOR_METHOD_TAIL:
+        fn = getattr(ns, nm, None) or globals().get(nm)
+        if fn is not None and callable(fn) and not hasattr(Tensor, nm):
+            setattr(Tensor, nm, fn)
+    # synthesized in-place variants become methods too (acosh_ etc.)
+    for nm in made:
+        if not hasattr(Tensor, nm):
+            setattr(Tensor, nm, getattr(ns, nm))
     return made

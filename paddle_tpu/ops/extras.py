@@ -265,25 +265,22 @@ def isin(x, test_x, assume_unique=False, invert=False, name=None):
 
 @_reg("bitwise_left_shift")
 def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
-    r = apply_op("bitwise_left_shift", jnp.left_shift, [x, y])
-    if out is not None:
-        out._value = r._value
-        return out
-    return r
+    from .math import _with_out
+
+    return _with_out(apply_op("bitwise_left_shift", jnp.left_shift, [x, y]),
+                     out)
 
 
 @_reg("bitwise_right_shift")
 def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    from .math import _with_out
+
     def fn(a, b):
         if is_arithmetic:
             return jnp.right_shift(a, b)
         return jax.lax.shift_right_logical(a, b.astype(a.dtype))
 
-    r = apply_op("bitwise_right_shift", fn, [x, y])
-    if out is not None:
-        out._value = r._value
-        return out
-    return r
+    return _with_out(apply_op("bitwise_right_shift", fn, [x, y]), out)
 
 
 def block_diag(inputs, name=None):

@@ -30,10 +30,16 @@ def _axis(axis):
 
 def _with_out(result, out):
     """Honor the reference's optional out= (logical/bitwise families write
-    into the given tensor and return it)."""
+    into the given tensor and return it).  The autograd node is rebound
+    alongside the value — leaving the old node would keep the stale
+    producing-subgraph alive and backward would traverse a graph that did
+    not produce out's value."""
     if out is None:
         return result
     out._value = result._value
+    out._node = getattr(result, "_node", None)
+    out._out_idx = getattr(result, "_out_idx", 0)
+    out.stop_gradient = result.stop_gradient
     return out
 
 
@@ -378,7 +384,7 @@ def cummax(x, axis=None, dtype="int64", name=None):
     return values
 
 
-@register_op("logcumsumexp")
+@register_op("logcumsumexp", tensor_method="logcumsumexp")
 def logcumsumexp(x, axis=None, dtype=None, name=None):
     dt = dtypes.convert_dtype(dtype) if dtype is not None else None
 

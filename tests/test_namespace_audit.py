@@ -49,6 +49,27 @@ def _ref_all(relpath):
 
 @pytest.mark.skipif(not os.path.isdir(REF),
                     reason="reference tree not mounted")
+def test_tensor_method_surface_parity():
+    """Every name in the reference's tensor_method_func list (the methods
+    monkey-patched onto Tensor, python/paddle/tensor/__init__.py) must be a
+    Tensor attribute here."""
+    import paddle_tpu as paddle
+
+    src = open(os.path.join(REF, "tensor/__init__.py")).read()
+    names = []
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "tensor_method_func":
+                    names = ast.literal_eval(node.value)
+    assert names, "reference tensor_method_func not found"
+    t = paddle.to_tensor([1.0, 2.0])
+    missing = [n for n in sorted(set(names)) if not hasattr(t, n)]
+    assert not missing, f"Tensor missing {len(missing)} methods: {missing}"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF),
+                    reason="reference tree not mounted")
 @pytest.mark.parametrize("ns", NAMESPACES)
 def test_namespace_all_parity(ns):
     ref_names = _ref_all(ns)

@@ -162,6 +162,33 @@ class TestAddedParams:
         assert r is out
         np.testing.assert_array_equal(out.numpy(), [True, False])
 
+    def test_method_tail_behaviors(self):
+        t = paddle.to_tensor(np.array([3.0, 1.0, 2.0], np.float32))
+        np.testing.assert_array_equal(
+            t.histogram(bins=3, min=0, max=4).numpy(), [1, 1, 1])
+        e = paddle.zeros([2])
+        np.testing.assert_allclose(e.exp_().numpy(), [1.0, 1.0])
+        assert e.numpy()[0] == 1.0  # wrote in place
+        u = paddle.zeros([64])
+        u.uniform_(min=0.25, max=0.75)
+        assert 0.25 <= float(u.numpy().min()) and float(u.numpy().max()) <= 0.75
+        with pytest.raises(ValueError, match="fill_zero"):
+            paddle.zeros([2]).resize_([3, 3])
+        r = paddle.zeros([9])
+        r.resize_([3, 3], fill_zero=True)
+        assert tuple(r.shape) == (3, 3)
+        s = paddle.zeros([2])
+        s.set_(paddle.ones([4]), shape=[2, 2])
+        assert tuple(s.shape) == (2, 2)
+        probs = paddle.to_tensor(np.array([[0.7, 0.2, 0.05, 0.05]], np.float32))
+        scores, ids = paddle.top_p_sampling(
+            probs, paddle.to_tensor(np.array([0.6], np.float32)))
+        assert int(ids.numpy()[0, 0]) == 0  # only the top token survives p=0.6
+        spec = paddle.to_tensor(np.random.default_rng(0)
+                                .standard_normal(256).astype(np.float32)) \
+            .stft(n_fft=64)
+        assert spec.shape[0] == 33
+
     def test_unfold_is_sliding_window(self):
         x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(2, 6))
         w = paddle.unfold(x, axis=1, size=3, step=2)
@@ -247,11 +274,11 @@ class TestAddedParams:
         dist.all_gather(tensor_list=tl,
                         tensor=paddle.to_tensor(np.ones((1, 2), np.float32)))
         assert len(tl) == 1
+        # reaching here without TypeError is the assertion: the reference's
+        # keyword names must be accepted verbatim
         dist.scatter(paddle.to_tensor(np.zeros((1, 2), np.float32)),
                      tensor_list=[paddle.to_tensor(np.ones((1, 2), np.float32))],
                      src=0)
-        out = paddle.to_tensor(np.zeros((1, 2), np.float32))
-        dist.bitwise_ok = True  # marker: no TypeError raised above
 
     def test_keyword_name_compat(self):
         """Reference keyword call-sites must work verbatim."""
