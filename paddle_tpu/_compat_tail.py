@@ -566,10 +566,15 @@ uniform_ = _make_random_inplace(
 def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
                    k=0, mode="truncated", return_top=False, name=None):
     """Nucleus sampling (tensor/random.py top_p_sampling): keep the smallest
-    prefix of sorted probs whose mass exceeds ps, renormalize, sample.
-    Returns (scores, ids) like the reference."""
+    prefix of sorted probs whose mass exceeds ps (tokens below ``threshold``
+    are dropped first), renormalize, sample.  Returns (scores, ids); with
+    ``return_top`` additionally the top-k scores/ids like the reference."""
     v = _unwrap(x)
     p = _unwrap(ps).reshape(-1, 1) if not isinstance(ps, float) else ps
+    if threshold is not None:
+        t = _unwrap(threshold) if not isinstance(threshold, float) else threshold
+        t = t.reshape(-1, 1) if hasattr(t, "reshape") else t
+        v = jnp.where(v >= t, v, 0.0)
     order = jnp.argsort(-v, axis=-1)
     sorted_p = jnp.take_along_axis(v, order, axis=-1)
     cum = jnp.cumsum(sorted_p, axis=-1)
@@ -581,6 +586,12 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
         jnp.maximum(filtered, 1e-12)), axis=-1)
     ids = jnp.take_along_axis(order, idx_in_sorted[..., None], axis=-1)
     scores = jnp.take_along_axis(v, ids, axis=-1)
+    if return_top:
+        kk = int(k) if k else 1
+        top_scores = sorted_p[..., :kk]
+        top_ids = order[..., :kk]
+        return (Tensor(scores), Tensor(ids.astype(jnp.int64)),
+                Tensor(top_scores), Tensor(top_ids.astype(jnp.int64)))
     return Tensor(scores), Tensor(ids.astype(jnp.int64))
 
 
@@ -698,8 +709,4 @@ def _install(ns):
         fn = getattr(ns, nm, None) or globals().get(nm)
         if fn is not None and callable(fn) and not hasattr(Tensor, nm):
             setattr(Tensor, nm, fn)
-    # synthesized in-place variants become methods too (acosh_ etc.)
-    for nm in made:
-        if not hasattr(Tensor, nm):
-            setattr(Tensor, nm, getattr(ns, nm))
     return made

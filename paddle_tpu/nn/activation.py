@@ -97,8 +97,14 @@ class CELU(Layer):
 
 
 class SELU(Layer):
+    def __init__(self, scale=1.0507009873554804934193349852946,
+                 alpha=1.6732632423543772848170429916717, name=None):
+        super().__init__()
+        self._scale = scale
+        self._alpha = alpha
+
     def forward(self, x):
-        return F.selu(x)
+        return F.selu(x, scale=self._scale, alpha=self._alpha)
 
 
 class SiLU(Layer):

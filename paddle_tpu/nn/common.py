@@ -74,12 +74,18 @@ class Embedding(Layer):
         padding_idx=None,
         sparse=False,
         weight_attr=None,
+        max_norm=None,
+        norm_type=2.0,
+        scale_grad_by_freq=False,
         name=None,
     ):
         super().__init__()
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim
         self.padding_idx = padding_idx
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.scale_grad_by_freq = scale_grad_by_freq
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim),
             attr=weight_attr,
@@ -89,7 +95,9 @@ class Embedding(Layer):
             self.weight._value = self.weight._value.at[padding_idx].set(0.0)
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self.padding_idx,
+                           max_norm=self.max_norm, norm_type=self.norm_type,
+                           scale_grad_by_freq=self.scale_grad_by_freq)
 
     def extra_repr(self):
         return f"{self.num_embeddings}, {self.embedding_dim}"

@@ -102,7 +102,7 @@ class Conv1DTranspose(_ConvNd):
         super().__init__(in_channels, out_channels, kernel_size, 1, stride, padding, dilation, groups, "zeros", weight_attr, bias_attr, data_format, transposed=True, output_padding=output_padding)
 
     def forward(self, x, output_size=None):
-        return F.conv1d_transpose(x, self.weight, self.bias, self.stride, self.padding, self.output_padding, self.groups, self.dilation, self.data_format)
+        return F.conv1d_transpose(x, self.weight, self.bias, self.stride, self.padding, self.output_padding, self.groups, self.dilation, output_size=output_size, data_format=self.data_format)
 
 
 class Conv2DTranspose(_ConvNd):
@@ -110,7 +110,7 @@ class Conv2DTranspose(_ConvNd):
         super().__init__(in_channels, out_channels, kernel_size, 2, stride, padding, dilation, groups, "zeros", weight_attr, bias_attr, data_format, transposed=True, output_padding=output_padding)
 
     def forward(self, x, output_size=None):
-        return F.conv2d_transpose(x, self.weight, self.bias, self.stride, self.padding, self.output_padding, self.groups, self.dilation, self.data_format)
+        return F.conv2d_transpose(x, self.weight, self.bias, self.stride, self.padding, self.output_padding, self.groups, self.dilation, output_size=output_size, data_format=self.data_format)
 
 
 class Conv3DTranspose(_ConvNd):
@@ -118,4 +118,4 @@ class Conv3DTranspose(_ConvNd):
         super().__init__(in_channels, out_channels, kernel_size, 3, stride, padding, dilation, groups, "zeros", weight_attr, bias_attr, data_format, transposed=True, output_padding=output_padding)
 
     def forward(self, x, output_size=None):
-        return F.conv3d_transpose(x, self.weight, self.bias, self.stride, self.padding, self.output_padding, self.groups, self.dilation, self.data_format)
+        return F.conv3d_transpose(x, self.weight, self.bias, self.stride, self.padding, self.output_padding, self.groups, self.dilation, output_size=output_size, data_format=self.data_format)

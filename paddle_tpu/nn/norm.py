@@ -70,7 +70,38 @@ class _BatchNormBase(Layer):
 
 
 class BatchNorm(_BatchNormBase):
-    pass
+    """Legacy fluid-era constructor (reference nn/layer/norm.py BatchNorm):
+    num_channels/param_attr/act/data_layout names, plus accepted-but-
+    absorbed knobs (is_test follows train()/eval(); in_place and the
+    moving-stat names are storage details PJRT owns)."""
+
+    def __init__(self, num_channels=None, act=None, is_test=False,
+                 momentum=0.9, epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=True,
+                 use_global_stats=None, trainable_statistics=False,
+                 num_features=None, weight_attr=None, data_format=None,
+                 name=None):
+        features = num_features if num_features is not None else num_channels
+        if features is None:
+            raise ValueError("BatchNorm needs num_channels (or num_features)")
+        super().__init__(
+            features, momentum, epsilon,
+            weight_attr if weight_attr is not None else param_attr,
+            bias_attr, data_format if data_format is not None else data_layout,
+            use_global_stats, name)
+        self._act = act
+        if is_test:
+            self.eval()
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            from . import functional as F
+
+            out = getattr(F, self._act)(out)
+        return out
 
 
 class BatchNorm1D(_BatchNormBase):
@@ -204,11 +235,14 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12, dtype="float32"):
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 eps=None, n_power_iterations=None, dtype="float32"):
         super().__init__()
         self.dim = dim
-        self.power_iters = power_iters
-        self.epsilon = epsilon
+        # torch-style aliases the reference also accepts
+        self.power_iters = (n_power_iterations if n_power_iterations
+                            is not None else power_iters)
+        self.epsilon = eps if eps is not None else epsilon
         h = weight_shape[dim]
         w = 1
         for i, s in enumerate(weight_shape):

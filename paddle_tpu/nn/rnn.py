@@ -18,19 +18,31 @@ from .layer_base import Layer
 __all__ = ["SimpleRNN", "LSTM", "GRU", "LSTMCell", "GRUCell", "SimpleRNNCell", "RNN"]
 
 
-def _rnn_params(layer, input_size, hidden_size, gates, suffix, weight_attr=None, bias_attr=None):
+def _rnn_params(layer, input_size, hidden_size, gates, suffix,
+                weight_attr=None, bias_attr=None, weight_ih_attr=None,
+                weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+    # per-part attrs (the reference's rnn.py granularity) win over the
+    # coarse weight_attr/bias_attr pair
     std = 1.0 / math.sqrt(hidden_size)
     wi = layer.create_parameter(
-        (gates * hidden_size, input_size), attr=weight_attr, default_initializer=I.Uniform(-std, std)
+        (gates * hidden_size, input_size),
+        attr=weight_ih_attr if weight_ih_attr is not None else weight_attr,
+        default_initializer=I.Uniform(-std, std)
     )
     wh = layer.create_parameter(
-        (gates * hidden_size, hidden_size), attr=weight_attr, default_initializer=I.Uniform(-std, std)
+        (gates * hidden_size, hidden_size),
+        attr=weight_hh_attr if weight_hh_attr is not None else weight_attr,
+        default_initializer=I.Uniform(-std, std)
     )
     bi = layer.create_parameter(
-        (gates * hidden_size,), attr=bias_attr, is_bias=True, default_initializer=I.Uniform(-std, std)
+        (gates * hidden_size,),
+        attr=bias_ih_attr if bias_ih_attr is not None else bias_attr,
+        is_bias=True, default_initializer=I.Uniform(-std, std)
     )
     bh = layer.create_parameter(
-        (gates * hidden_size,), attr=bias_attr, is_bias=True, default_initializer=I.Uniform(-std, std)
+        (gates * hidden_size,),
+        attr=bias_hh_attr if bias_hh_attr is not None else bias_attr,
+        is_bias=True, default_initializer=I.Uniform(-std, std)
     )
     layer.add_parameter(f"weight_ih_{suffix}", wi)
     layer.add_parameter(f"weight_hh_{suffix}", wh)
@@ -80,9 +92,18 @@ class _RNNBase(Layer):
         activation="tanh",
         weight_attr=None,
         bias_attr=None,
+        weight_ih_attr=None,
+        weight_hh_attr=None,
+        bias_ih_attr=None,
+        bias_hh_attr=None,
+        proj_size=0,
         name=None,
     ):
         super().__init__()
+        if proj_size:
+            raise NotImplementedError(
+                "LSTM proj_size (LSTMP cell projection) is not supported; "
+                "project the outputs with a Linear layer instead")
         self.input_size = input_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -97,7 +118,9 @@ class _RNNBase(Layer):
                 in_sz = input_size if layer_i == 0 else hidden_size * self.bidirect
                 suffix = f"l{layer_i}" + ("_reverse" if d == 1 else "")
                 self._weights.append(
-                    _rnn_params(self, in_sz, hidden_size, gates, suffix, weight_attr, bias_attr)
+                    _rnn_params(self, in_sz, hidden_size, gates, suffix,
+                                weight_attr, bias_attr, weight_ih_attr,
+                                weight_hh_attr, bias_ih_attr, bias_hh_attr)
                 )
 
     def _scan_layer(self, seq_len):
@@ -241,11 +264,15 @@ class RNNCellBase(Layer):
 
 
 class LSTMCell(RNNCellBase):
-    def __init__(self, input_size, hidden_size, weight_attr=None, bias_attr=None, name=None):
+    def __init__(self, input_size, hidden_size, weight_attr=None, bias_attr=None,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, proj_size=0, name=None):
+        if proj_size:
+            raise NotImplementedError("LSTMCell proj_size is not supported")
         super().__init__()
         self.hidden_size = hidden_size
         self.wi, self.wh, self.bi, self.bh = None, None, None, None
-        ws = _rnn_params(self, input_size, hidden_size, 4, "cell", weight_attr, bias_attr)
+        ws = _rnn_params(self, input_size, hidden_size, 4, "cell", weight_attr, bias_attr, weight_ih_attr, weight_hh_attr, bias_ih_attr, bias_hh_attr)
         self._ws = ws
 
     @property
@@ -272,10 +299,12 @@ class LSTMCell(RNNCellBase):
 
 
 class GRUCell(RNNCellBase):
-    def __init__(self, input_size, hidden_size, weight_attr=None, bias_attr=None, name=None):
+    def __init__(self, input_size, hidden_size, weight_attr=None, bias_attr=None,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
         super().__init__()
         self.hidden_size = hidden_size
-        _rnn_params(self, input_size, hidden_size, 3, "cell", weight_attr, bias_attr)
+        _rnn_params(self, input_size, hidden_size, 3, "cell", weight_attr, bias_attr, weight_ih_attr, weight_hh_attr, bias_ih_attr, bias_hh_attr)
 
     def forward(self, inputs, states=None):
         wi, wh, bi, bh = (
@@ -295,11 +324,14 @@ class GRUCell(RNNCellBase):
 
 
 class SimpleRNNCell(RNNCellBase):
-    def __init__(self, input_size, hidden_size, activation="tanh", weight_attr=None, bias_attr=None, name=None):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_attr=None, bias_attr=None, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
         super().__init__()
         self.hidden_size = hidden_size
         self.activation = activation
-        _rnn_params(self, input_size, hidden_size, 1, "cell", weight_attr, bias_attr)
+        _rnn_params(self, input_size, hidden_size, 1, "cell", weight_attr, bias_attr, weight_ih_attr, weight_hh_attr, bias_ih_attr, bias_hh_attr)
 
     def forward(self, inputs, states=None):
         wi, wh, bi, bh = (

@@ -163,6 +163,7 @@ class Optimizer:
                 p32 = _unwrap(p).astype(jnp.float32)
             g32 = _unwrap(g).astype(jnp.float32)
             self._current_param_name = p.name
+            self._current_param = p
             new_p, new_st = self._update(p32, g32, st, lr_val, self._step_count)
             self._accumulators[key] = new_st
             if key in self._master_weights:
@@ -226,15 +227,21 @@ class SGD(Optimizer):
 
 
 class Momentum(Optimizer):
-    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, multi_precision=False, rescale_grad=1.0, use_multi_tensor=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
         self._momentum = momentum
         self._nesterov = use_nesterov
+        # rescale_grad pre-scales incoming grads (the reference's dist-
+        # training hook); use_multi_tensor is a CUDA fused-kernel knob —
+        # XLA fuses the update chain regardless
+        self._rescale_grad = float(rescale_grad)
 
     def _state_names(self):
         return ["velocity"]
 
     def _update(self, p, g, state, lr, step):
+        if self._rescale_grad != 1.0:
+            g = g * self._rescale_grad
         if self._weight_decay:
             g = g + self._weight_decay * p
         v = self._momentum * state["velocity"] + g
@@ -441,11 +448,12 @@ class RAdam(Optimizer):
 
 
 class Lamb(Optimizer):
-    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, multi_precision=False, always_adapt=False, name=None):
         super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._lamb_wd = lamb_weight_decay
         self._exclude_fn = exclude_from_weight_decay_fn
+        self._always_adapt = always_adapt
 
     def _state_names(self):
         return ["moment1", "moment2"]
@@ -456,7 +464,14 @@ class Lamb(Optimizer):
         v = b2 * state["moment2"] + (1 - b2) * g * g
         mhat = m / (1 - b1**step)
         vhat = v / (1 - b2**step)
-        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._lamb_wd * p
+        excluded = (self._exclude_fn is not None
+                    and self._exclude_fn(getattr(self, "_current_param", None)))
+        wd = 0.0 if excluded else self._lamb_wd
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd * p
+        if excluded and not self._always_adapt:
+            # reference: excluded params skip the layer-wise adaptation
+            # unless always_adapt forces it
+            return p - lr * r, {"moment1": m, "moment2": v}
         w_norm = jnp.linalg.norm(p)
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
@@ -534,8 +549,8 @@ class LBFGS(Optimizer):
     is approximated by backtracking Armijo, which the reference also falls
     back to between wolfe probes)."""
 
-    def __init__(self, learning_rate=1.0, max_iter=20, tolerance_grad=1e-7,
-                 tolerance_change=1e-9, history_size=100,
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
                  line_search_fn=None, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
@@ -544,6 +559,9 @@ class LBFGS(Optimizer):
             raise NotImplementedError(
                 "LBFGS: grad_clip inside the line search is not supported")
         self._max_iter = int(max_iter)
+        # reference default: max_iter * 5 / 4 closure evaluations
+        self._max_eval = (int(max_eval) if max_eval is not None
+                          else self._max_iter * 5 // 4)
         self._tol_grad = float(tolerance_grad)
         self._tol_change = float(tolerance_change)
         self._hist = int(history_size)
@@ -606,7 +624,10 @@ class LBFGS(Optimizer):
         for p in self._parameter_list:
             p.clear_grad()  # a prior step()'s last probe leaves grads behind
         loss = closure()
+        n_evals = 1
         for _ in range(self._max_iter):
+            if n_evals >= self._max_eval:
+                break
             flat = self._flat_params()
             g = self._flat_grads()
             if wd:
@@ -634,8 +655,10 @@ class LBFGS(Optimizer):
                 for p in self._parameter_list:
                     p.clear_grad()
                 loss = closure()
+                n_evals += 1
                 if (F_of(loss, flat + t * d) <= f0 + 1e-4 * t * gtd
-                        or self._line_search is None):
+                        or self._line_search is None
+                        or n_evals >= self._max_eval):
                     break
                 t *= 0.5
             if abs(float(jnp.max(jnp.abs(t * d)))) < self._tol_change:

@@ -85,19 +85,34 @@ class RandomHorizontalFlip:
 
 
 class RandomCrop:
-    def __init__(self, size, padding=0):
+    def __init__(self, size, padding=0, pad_if_needed=False, fill=0,
+                 padding_mode="constant"):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
         self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
 
     def __call__(self, img):
         a = np.asarray(img)
         chw = a.ndim == 3 and a.shape[0] in (1, 3)
         h_ax, w_ax = (1, 2) if chw else (0, 1)
+        mode = {"constant": "constant", "reflect": "reflect", "edge": "edge",
+                "symmetric": "symmetric"}[self.padding_mode]
+        kw = {"constant_values": self.fill} if mode == "constant" else {}
         if self.padding:
             pad = [(0, 0)] * a.ndim
             pad[h_ax] = pad[w_ax] = (self.padding, self.padding)
-            a = np.pad(a, pad)
+            a = np.pad(a, pad, mode=mode, **kw)
         th, tw = self.size
+        if self.pad_if_needed:  # reference: grow to at least the crop size
+            extra_h = max(th - a.shape[h_ax], 0)
+            extra_w = max(tw - a.shape[w_ax], 0)
+            if extra_h or extra_w:
+                pad = [(0, 0)] * a.ndim
+                pad[h_ax] = (extra_h, extra_h)
+                pad[w_ax] = (extra_w, extra_w)
+                a = np.pad(a, pad, mode=mode, **kw)
         i = np.random.randint(0, a.shape[h_ax] - th + 1)
         j = np.random.randint(0, a.shape[w_ax] - tw + 1)
         sl = [slice(None)] * a.ndim
@@ -396,11 +411,13 @@ class RandomResizedCrop:
 class RandomErasing:
     """Reference transforms.py:RandomErasing (operates on CHW tensors/arrays)."""
 
-    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3), value=0):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
         self.prob = prob
         self.scale = scale
         self.ratio = ratio
         self.value = value
+        self.inplace = inplace
 
     def __call__(self, img):
         arr = np.array(img, copy=True)
@@ -476,3 +493,54 @@ from .functional import (  # noqa: E402,F401
 )
 
 __all__ += ["BaseTransform", "functional"] + functional.__all__
+
+
+def _keysify(cls):
+    """Give a transform class the BaseTransform ``keys`` protocol
+    (reference: every transforms.py class takes keys=None): tuple inputs
+    dispatch per key — 'image' entries run the transform, anything else
+    passes through.  Note: with MULTIPLE image-typed keys, random
+    transforms re-sample per entry here (the reference shares one
+    _get_params draw across keys)."""
+    import inspect as _inspect
+
+    orig_init = cls.__init__
+    orig_call = cls.__call__
+
+    def __init__(self, *args, keys=None, **kwargs):
+        orig_init(self, *args, **kwargs)
+        if keys is not None and not isinstance(keys, (list, tuple)):
+            raise TypeError("keys must be a list or tuple")
+        self.keys = tuple(keys) if keys is not None else ("image",)
+
+    # keep introspection honest: expose the original parameters + keys
+    # (a bare (*args, **kwargs) signature would also blind the
+    # constructor-parity audit to these classes)
+    orig_sig = _inspect.signature(orig_init)
+    params = [p for p in orig_sig.parameters.values()
+              if p.kind not in (p.VAR_POSITIONAL, p.VAR_KEYWORD)]
+    params.append(_inspect.Parameter("keys", _inspect.Parameter.KEYWORD_ONLY,
+                                     default=None))
+    __init__.__signature__ = orig_sig.replace(parameters=params)
+
+    def __call__(self, inputs):
+        if isinstance(inputs, (tuple, list)):
+            outs = []
+            for i, x in enumerate(inputs):
+                key = self.keys[i] if i < len(self.keys) else None
+                outs.append(orig_call(self, x) if key == "image" else x)
+            return tuple(outs)
+        return orig_call(self, inputs)
+
+    cls.__init__ = __init__
+    cls.__call__ = __call__
+    return cls
+
+
+for _cls in (Normalize, ToTensor, Transpose, Resize, RandomHorizontalFlip,
+             RandomCrop, CenterCrop, RandomVerticalFlip, Pad, Grayscale,
+             BrightnessTransform, ContrastTransform, SaturationTransform,
+             HueTransform, ColorJitter, RandomRotation, RandomAffine,
+             RandomPerspective, RandomResizedCrop, RandomErasing):
+    _keysify(_cls)
+del _cls

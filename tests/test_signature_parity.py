@@ -92,6 +92,147 @@ def test_shared_functions_accept_reference_params(ref_ns, mod_name):
     assert not bad, f"{mod_name} signature gaps: {bad}"
 
 
+CLASS_CHECK = [
+    ("nn", "paddle_tpu.nn"),
+    ("optimizer", "paddle_tpu.optimizer"),
+    ("vision/transforms", "paddle_tpu.vision.transforms"),
+    ("io", "paddle_tpu.io"),
+    ("amp", "paddle_tpu.amp"),
+    ("metric", "paddle_tpu.metric"),
+]
+
+
+def _ref_class_inits(relpath):
+    out = {}
+    base = os.path.join(REF, relpath)
+    files = []
+    if os.path.isdir(base):
+        for root, _, fs in os.walk(base):
+            files += [os.path.join(root, f) for f in fs if f.endswith(".py")]
+    elif os.path.exists(base + ".py"):
+        files = [base + ".py"]
+    for f in files:
+        try:
+            tree = ast.parse(open(f).read())
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and not node.name.startswith("_"):
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef) and \
+                            item.name == "__init__":
+                        a = item.args
+                        out[node.name] = {p.arg for p in
+                                          a.posonlyargs + a.args + a.kwonlyargs}
+    return out
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+@pytest.mark.parametrize("ref_ns,mod_name", CLASS_CHECK)
+def test_shared_classes_accept_reference_params(ref_ns, mod_name):
+    sigs = _ref_class_inits(ref_ns)
+    mod = importlib.import_module(mod_name)
+    bad = []
+    for name, ref_params in sorted(sigs.items()):
+        cls = getattr(mod, name, None)
+        if cls is None or not inspect.isclass(cls):
+            continue
+        try:
+            mine = inspect.signature(cls.__init__)
+        except (ValueError, TypeError):
+            continue
+        if any(p.kind == inspect.Parameter.VAR_KEYWORD
+               for p in mine.parameters.values()):
+            continue
+        missing = ref_params - set(mine.parameters) - {"self", "name"}
+        if missing:
+            bad.append(f"{name}: {sorted(missing)}")
+    assert not bad, f"{mod_name} class-constructor gaps: {bad}"
+
+
+class TestAddedClassParams:
+    def test_transform_keys_protocol(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = (np.random.default_rng(0).random((8, 8, 3)) * 255).astype("uint8")
+        out_img, label = T.Resize((4, 4), keys=("image", "none"))((img, "y"))
+        assert out_img.shape == (4, 4, 3) and label == "y"
+        assert T.Resize((4, 4))(img).shape == (4, 4, 3)
+        with pytest.raises(TypeError):
+            T.Resize((4, 4), keys="image")
+
+    def test_random_crop_pad_if_needed(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.ones((4, 4, 3), np.uint8)
+        out = T.RandomCrop(8, pad_if_needed=True, fill=7)(img)
+        assert out.shape[:2] == (8, 8)
+        assert (out == 7).any()
+
+    def test_embedding_layer_max_norm(self):
+        from paddle_tpu import nn
+
+        emb = nn.Embedding(4, 8, max_norm=1.0)
+        out = emb(paddle.to_tensor(np.array([0, 1], np.int64)))
+        norms = np.linalg.norm(out.numpy(), axis=-1)
+        assert (norms <= 1.0 + 1e-5).all()
+
+    def test_rnn_fine_grained_attrs(self):
+        from paddle_tpu import nn
+
+        lstm = nn.LSTM(4, 8, weight_ih_attr=paddle.ParamAttr(
+            initializer=nn.initializer.Constant(0.1)))
+        assert np.allclose(lstm.weight_ih_l0.numpy(), 0.1)
+        assert not np.allclose(lstm.weight_hh_l0.numpy(), 0.1)
+        with pytest.raises(NotImplementedError):
+            nn.LSTM(4, 8, proj_size=3)
+
+    def test_legacy_batch_norm(self):
+        from paddle_tpu import nn
+
+        bn = nn.BatchNorm(num_channels=3, act="relu", data_layout="NCHW")
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((2, 3, 4, 4)).astype(np.float32))
+        out = bn(x)
+        assert float(out.numpy().min()) >= 0  # act applied
+        with pytest.raises(ValueError):
+            nn.BatchNorm()
+
+    def test_selu_custom_params(self):
+        from paddle_tpu import nn
+
+        act = nn.SELU(scale=2.0, alpha=1.0)
+        out = act(paddle.to_tensor(np.array([1.0], np.float32)))
+        assert float(out.numpy()[0]) == pytest.approx(2.0)
+
+    def test_momentum_rescale_grad(self):
+        from paddle_tpu import nn, optimizer
+
+        lin = nn.Linear(2, 1, bias_attr=False)
+        w0 = lin.weight.numpy().copy()
+        opt = optimizer.Momentum(learning_rate=1.0, momentum=0.0,
+                                 parameters=lin.parameters(),
+                                 rescale_grad=0.5)
+        lin(paddle.to_tensor(np.ones((1, 2), np.float32))).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(lin.weight.numpy(), w0 - 0.5, atol=1e-6)
+
+    def test_lamb_exclude_and_always_adapt(self):
+        from paddle_tpu import nn, optimizer
+
+        lin = nn.Linear(2, 1, bias_attr=False)
+        opt = optimizer.Lamb(learning_rate=0.1,
+                             parameters=lin.parameters(),
+                             exclude_from_weight_decay_fn=lambda p: True,
+                             always_adapt=False)
+        lin(paddle.to_tensor(np.ones((1, 2), np.float32))).sum().backward()
+        opt.step()  # must run the non-adapted branch without error
+        opt2 = optimizer.Lamb(learning_rate=0.1,
+                              parameters=lin.parameters(), always_adapt=True)
+        lin(paddle.to_tensor(np.ones((1, 2), np.float32))).sum().backward()
+        opt2.step()
+
+
 class TestAddedParams:
     def test_sum_prod_dtype(self):
         x = paddle.to_tensor(np.array([1, 2, 3], np.int32))
