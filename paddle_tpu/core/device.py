@@ -116,6 +116,13 @@ def max_memory_reserved(device=None) -> int:
     return int(s.get("bytes_reserved", s.get("peak_bytes_in_use", 0)))
 
 
+def memory_reserved(device=None) -> int:
+    """Current reserved bytes (falls back to current bytes_in_use — PJRT
+    reports no separate live reserved-pool counter)."""
+    s = memory_stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
 def empty_cache() -> None:
     """Best-effort allocator release (XLA owns the allocator; no-op if unsupported)."""
     try:

@@ -203,8 +203,11 @@ class Tensor:
 
     def set(self, value, place=None):
         """In-place value replacement (the reference LoDTensor's
-        ``t.set(array, place)`` idiom used with scopes/executors)."""
+        ``t.set(array, place)`` idiom used with scopes/executors).  Severs
+        the autograd node like set_/resize_: the old graph did not produce
+        this value, so backward through it would be wrong."""
         self._value = jnp.asarray(np.asarray(value))
+        self._node, self._out_idx = None, 0
 
     def item(self):
         return self._value.item()

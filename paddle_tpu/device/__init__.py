@@ -22,6 +22,7 @@ from ..core.device import (  # noqa: F401
     max_memory_allocated,
     max_memory_reserved,
     memory_allocated,
+    memory_reserved,
     memory_stats,
     set_device,
     synchronize,
@@ -178,7 +179,7 @@ class _CudaNamespace:
     max_memory_allocated = staticmethod(max_memory_allocated)
     max_memory_reserved = staticmethod(max_memory_reserved)
     memory_allocated = staticmethod(memory_allocated)
-    memory_reserved = staticmethod(max_memory_reserved)
+    memory_reserved = staticmethod(memory_reserved)
     empty_cache = staticmethod(empty_cache)
     synchronize = staticmethod(synchronize)
     current_stream = staticmethod(current_stream)

@@ -674,7 +674,9 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None)
     n = max(_process_count(), 1)
     me = _process_rank()
     if n <= 1:
-        out_object_list[:] = list(in_object_list or [])[:1]
+        # same per-rank slice semantics as the multi-process path at world=1:
+        # this rank receives all len(objs)//1 objects, not just the first
+        out_object_list[:] = list(in_object_list or [])
         return
     data = _store_object_roundtrip("scatter", list(in_object_list or []),
                                    src, group)

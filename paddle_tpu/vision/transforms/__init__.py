@@ -171,15 +171,16 @@ class Pad:
 
 
 class Grayscale:
-    """Reference transforms.py:Grayscale; HWC input."""
+    """Reference transforms.py:Grayscale; HWC input.  Delegates to the
+    functional op so dtype preservation lives in one place."""
 
     def __init__(self, num_output_channels=1):
         self.num_output_channels = num_output_channels
 
     def __call__(self, img):
-        arr = np.asarray(img, np.float32)
-        g = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
-        return np.repeat(g[..., None], self.num_output_channels, axis=-1)
+        from . import functional as _F
+
+        return _F.to_grayscale(img, self.num_output_channels)
 
 
 class BrightnessTransform:
@@ -187,8 +188,10 @@ class BrightnessTransform:
         self.value = value
 
     def __call__(self, img):
+        from . import functional as _F
+
         f = 1.0 + np.random.uniform(-self.value, self.value)
-        return np.clip(np.asarray(img, np.float32) * f, 0, 255 if np.asarray(img).max() > 1 else 1)
+        return _F.adjust_brightness(img, f)
 
 
 class ContrastTransform:
@@ -196,11 +199,10 @@ class ContrastTransform:
         self.value = value
 
     def __call__(self, img):
-        arr = np.asarray(img, np.float32)
+        from . import functional as _F
+
         f = 1.0 + np.random.uniform(-self.value, self.value)
-        mean = arr.mean()
-        hi = 255 if arr.max() > 1 else 1
-        return np.clip((arr - mean) * f + mean, 0, hi)
+        return _F.adjust_contrast(img, f)
 
 
 class SaturationTransform:
@@ -208,31 +210,28 @@ class SaturationTransform:
         self.value = value
 
     def __call__(self, img):
-        arr = np.asarray(img, np.float32)
+        from . import functional as _F
+
         f = 1.0 + np.random.uniform(-self.value, self.value)
-        g = (arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114)[..., None]
-        hi = 255 if arr.max() > 1 else 1
-        return np.clip(g + (arr - g) * f, 0, hi)
+        return _F.adjust_saturation(img, f)
 
 
 class HueTransform:
     """Approximate hue shift via channel rotation mix (reference uses HSV;
-    the YIQ rotation here matches for small angles)."""
+    the YIQ rotation in functional.adjust_hue matches for small angles).
+    ``value`` is bounded to [0, 0.5] like the reference (transforms.py
+    HueTransform), so the sampled factor always satisfies adjust_hue's
+    [-0.5, 0.5] contract."""
 
     def __init__(self, value):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
         self.value = value
 
     def __call__(self, img):
-        arr = np.asarray(img, np.float32)
-        theta = np.random.uniform(-self.value, self.value) * np.pi
-        c, s = np.cos(theta), np.sin(theta)
-        yiq_m = np.array([[0.299, 0.587, 0.114],
-                          [0.596, -0.274, -0.322],
-                          [0.211, -0.523, 0.312]], np.float32)
-        rot = np.array([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
-        m = np.linalg.inv(yiq_m) @ rot @ yiq_m
-        hi = 255 if arr.max() > 1 else 1
-        return np.clip(arr @ m.T, 0, hi)
+        from . import functional as _F
+
+        return _F.adjust_hue(img, np.random.uniform(-self.value, self.value))
 
 
 class ColorJitter:
@@ -477,6 +476,7 @@ from .functional import (  # noqa: E402,F401
     adjust_brightness,
     adjust_contrast,
     adjust_hue,
+    adjust_saturation,
     affine,
     center_crop,
     crop,

@@ -13,15 +13,24 @@ import numpy as np
 __all__ = [
     "to_tensor", "resize", "pad", "crop", "center_crop", "hflip", "vflip",
     "rotate", "affine", "perspective", "normalize", "erase", "to_grayscale",
-    "adjust_brightness", "adjust_contrast", "adjust_hue",
+    "adjust_brightness", "adjust_contrast", "adjust_hue", "adjust_saturation",
 ]
 
 
-def _hi(arr):
-    """Value ceiling for clipping, decided by DTYPE (deterministic — a
-    value-based max() heuristic misclassifies dark frames and binary
-    masks): integer images live on [0, 255], float images on [0, 1]."""
-    return 255.0 if np.issubdtype(np.asarray(arr).dtype, np.integer) else 1.0
+def _like_input(out, img):
+    """Photometric ops preserve the input dtype (the reference cv2 path
+    returns uint8 for uint8 input) — otherwise adjust_*(uint8) → to_tensor()
+    silently skips the /255 scaling, which only applies to integer dtypes.
+    Integer outputs saturate to the DTYPE's own range (np.iinfo, not a
+    hardcoded 255 — int16 images carry values past 255); float outputs are
+    returned unclipped, because a deterministic dtype rule cannot tell a
+    normalized [0,1] float image from one carrying raw 0-255 values, and
+    clipping the latter to 1.0 would destroy it."""
+    dt = np.asarray(img).dtype
+    if np.issubdtype(dt, np.integer):
+        info = np.iinfo(dt)
+        return np.rint(np.clip(out, info.min, info.max)).astype(dt)
+    return np.asarray(out).astype(dt)
 
 
 def to_tensor(pic, data_format="CHW"):
@@ -184,20 +193,27 @@ def erase(img, i, j, h, w, v, inplace=False):
 def to_grayscale(img, num_output_channels=1):
     arr = np.asarray(img, np.float32)
     g = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
-    return np.repeat(g[..., None], num_output_channels, axis=-1)
+    return _like_input(np.repeat(g[..., None], num_output_channels, axis=-1),
+                       img)
 
 
 def adjust_brightness(img, brightness_factor):
-    hi = _hi(img)  # dtype of the ORIGINAL input decides the ceiling
     arr = np.asarray(img, np.float32)
-    return np.clip(arr * brightness_factor, 0, hi)
+    return _like_input(arr * brightness_factor, img)
 
 
 def adjust_contrast(img, contrast_factor):
-    hi = _hi(img)
     arr = np.asarray(img, np.float32)
     mean = arr.mean()
-    return np.clip((arr - mean) * contrast_factor + mean, 0, hi)
+    return _like_input((arr - mean) * contrast_factor + mean, img)
+
+
+def adjust_saturation(img, saturation_factor):
+    """Blend toward the luma channel (factor 0 = grayscale, 1 = identity)."""
+    arr = np.asarray(img, np.float32)
+    g = (arr[..., 0] * 0.299 + arr[..., 1] * 0.587
+         + arr[..., 2] * 0.114)[..., None]
+    return _like_input(g + (arr - g) * saturation_factor, img)
 
 
 def adjust_hue(img, hue_factor):
@@ -205,7 +221,6 @@ def adjust_hue(img, hue_factor):
     HueTransform's deterministic core."""
     if not -0.5 <= hue_factor <= 0.5:
         raise ValueError("hue_factor must be in [-0.5, 0.5]")
-    hi = _hi(img)
     arr = np.asarray(img, np.float32)
     theta = hue_factor * 2 * np.pi
     c, s = np.cos(theta), np.sin(theta)
@@ -214,4 +229,4 @@ def adjust_hue(img, hue_factor):
                       [0.211, -0.523, 0.312]], np.float32)
     rot = np.array([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
     m = np.linalg.inv(yiq_m) @ rot @ yiq_m
-    return np.clip(arr @ m.T, 0, hi)
+    return _like_input(arr @ m.T, img)

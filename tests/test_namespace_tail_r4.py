@@ -67,6 +67,15 @@ class TestVisionFunctional:
         assert T.to_grayscale(self.IMG).shape == (8, 10, 1)
         b2 = T.adjust_brightness(self.IMG, 2.0)
         assert b2.max() <= 255
+        # photometric ops preserve the input dtype (reference cv2 contract):
+        # uint8 in -> uint8 out, so to_tensor() still applies /255 scaling
+        assert b2.dtype == np.uint8
+        assert T.adjust_contrast(self.IMG, 0.5).dtype == np.uint8
+        assert T.adjust_hue(self.IMG, 0.1).dtype == np.uint8
+        assert T.to_grayscale(self.IMG).dtype == np.uint8
+        fimg = self.IMG.astype(np.float32) / 255.0
+        assert T.adjust_brightness(fimg, 1.5).dtype == np.float32
+        assert float(T.to_tensor(b2).numpy().max()) <= 1.0
         np.testing.assert_allclose(T.adjust_contrast(self.IMG, 1.0),
                                    np.float32(self.IMG))
         np.testing.assert_allclose(T.adjust_hue(self.IMG, 0.0),

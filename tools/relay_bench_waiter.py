@@ -1,0 +1,80 @@
+"""Round-long relay watcher: probe the TPU relay every ~10 min; on the first
+healthy window run the FULL bench sweep (`python bench.py` — the driver's
+exact command), which banks every fresh TPU rung to BENCH_TPU_CACHE.json.
+Keeps watching until every target rung family is banked or the deadline
+passes, so a mid-round relay outage can't cost the round its hardware
+evidence (the failure mode of rounds 3 and 4).
+
+Usage: python tools/relay_bench_waiter.py [hours] [--once]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CACHE = os.path.join(REPO, "BENCH_TPU_CACHE.json")
+# one banked rung key per evidence family we want this round
+TARGETS = {
+    "train": "llama_train_mfu_single_chip/",
+    "decode": "llama_cb_decode_tokens_per_sec/",
+    "moe": "moe_train_mfu_single_chip/",
+    "vision": "resnet_train_images_per_sec/",
+    "dit": "dit_train_images_per_sec/",
+}
+
+
+def families_banked() -> dict:
+    try:
+        with open(CACHE) as f:
+            keys = list(json.load(f).get("rungs", {}))
+    except (OSError, json.JSONDecodeError):
+        keys = []
+    return {fam: any(k.startswith(p) for k in keys)
+            for fam, p in TARGETS.items()}
+
+
+def relay_healthy(timeout: int = 150) -> bool:
+    probe = [sys.executable, "-c",
+             "import jax; d=jax.devices(); print(d); "
+             "import jax.numpy as jnp; print(float((jnp.ones((8,8))@"
+             "jnp.ones((8,8))).sum()))"]
+    try:
+        out = subprocess.run(probe, capture_output=True, timeout=timeout,
+                             cwd=REPO)
+        return b"TPU" in out.stdout and b"512" in out.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> int:
+    hours = next((float(a) for a in sys.argv[1:] if not a.startswith("-")),
+                 10.0)
+    once = "--once" in sys.argv
+    deadline = time.time() + hours * 3600
+    while time.time() < deadline:
+        missing = [f for f, ok in families_banked().items() if not ok]
+        if not missing:
+            print("all rung families banked — done", flush=True)
+            return 0
+        if relay_healthy():
+            print(f"relay healthy; sweeping (missing: {missing})", flush=True)
+            try:
+                subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                               cwd=REPO, timeout=3600)
+            except subprocess.TimeoutExpired:
+                print("sweep timed out (rungs banked so far are kept)",
+                      flush=True)
+            if once:
+                return 0
+        else:
+            print(f"relay down; missing={missing}; retry in 600s", flush=True)
+        time.sleep(600)
+    print("deadline reached", flush=True)
+    return 0 if not [f for f, ok in families_banked().items() if not ok] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
