@@ -8,6 +8,7 @@ import jax
 
 from .strategy import DistributedStrategy, Strategy  # noqa: F401
 from . import meta_optimizers, utils  # noqa: F401
+from .perf import collective_perf  # noqa: F401
 from .recompute import recompute, recompute_sequential, recompute_hybrid  # noqa: F401
 from .topology import (  # noqa: F401
     CommunicateTopology,

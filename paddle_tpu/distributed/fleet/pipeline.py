@@ -140,16 +140,29 @@ def gpipe_stacked(stage_fn, stacked_params, microbatches, mesh, axis_name="pp",
             x0 = jax.lax.dynamic_index_in_dim(
                 mb_in, jnp.clip(t, 0, num_micro - 1), axis=0, keepdims=False)
             x_in = jnp.where(is_first, x0, recv)
-            # bubble ticks (fill/drain) skip the stage compute entirely via
-            # cond — garbage ticks used to run stage_fn and discard the
-            # result, burning (P-1)/(M+P-1) of stage FLOPs (round-3 verdict
-            # weak #3; the reference only computes valid microbatches,
-            # pipeline_parallel.py:684)
-            y = jax.lax.cond(
-                tick_valid,
-                lambda x: stage_fn(local_params, x, *extras),
-                lambda x: jnp.zeros_like(x),
-                x_in)
+            if manual_axes:
+                # stage_fn contains collectives over the extra manual axes
+                # (ring attention's ppermute).  CollectivePermute lowers with
+                # EVERY device as a participant, so skipping it on bubble
+                # ticks — whose validity predicate differs per pp rank —
+                # desynchronizes the rendezvous across pp and silently
+                # corrupts (or deadlocks) the ring.  Uniform execution is the
+                # price of in-stage collectives: compute every tick, select
+                # the result (bubble FLOPs ~ (P-1)/(M+P-1)).
+                y = jnp.where(tick_valid,
+                              stage_fn(local_params, x_in, *extras),
+                              jnp.zeros_like(x_in))
+            else:
+                # bubble ticks (fill/drain) skip the stage compute entirely
+                # via cond — garbage ticks used to run stage_fn and discard
+                # the result, burning (P-1)/(M+P-1) of stage FLOPs (round-3
+                # verdict weak #3; the reference only computes valid
+                # microbatches, pipeline_parallel.py:684)
+                y = jax.lax.cond(
+                    tick_valid,
+                    lambda x: stage_fn(local_params, x, *extras),
+                    lambda x: jnp.zeros_like(x),
+                    x_in)
             # last stage writes its result at microbatch slot i
             w_valid = is_last & tick_valid
             iw = jnp.clip(i, 0, num_micro - 1)
@@ -190,7 +203,8 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
                         extra_args=(), boundary_f32=None,
                         batch_axes=(), zero_axis=None,
                         embed_specs=None, stacked_specs=None, head_specs=None,
-                        num_chunks=1, zero_bubble=False):
+                        num_chunks=1, zero_bubble=False,
+                        seq_axis=None, extra_specs=None):
     """Executed 1F1B pipeline schedule as ONE compiled SPMD program (the
     reference's PipelineParallel.forward_backward_pipeline, pipeline_parallel
     .py:684, re-thought for a TPU mesh — not simulated, not AD-through-scan).
@@ -267,6 +281,17 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
         stage forward (the same recompute fused-B already pays once).
         Requires ``num_chunks == 1`` and ``M >= 2(P-1) + 1`` (so every
         stage's first idle F-slot falls after its corresponding backward).
+      seq_axis: a context-parallel mesh axis (the reference's 'sep',
+        topology.py:77) to bind MANUALLY in the same shard_map: microbatch
+        data is sequence-sharded over it (dim 2 of [M, mb, s] inputs, dim 1
+        of [mb, s, ...] activations), and ``stage_fn`` is expected to run
+        ring/Ulysses attention over the axis (ops/ring_attention.py).  The
+        reference's 1F1B runtime composes with sep the same way — sep is
+        just another comm group to its P2P schedule (pipeline_parallel
+        .py:684).  Params never shard over seq_axis; their grads psum over
+        it, and the loss scales to the global token mean.
+      extra_specs: shard_map in_specs for ``extra_args`` over the manual
+        axes (e.g. rope tables seq-sharded over 'sep'); default replicated.
       num_chunks: C > 1 executes the INTERLEAVED/virtual-pipeline 1F1B
         schedule (the reference's PipelineParallelWithInterleave,
         pipeline_parallel.py:1308; tick order = :func:`schedule_interleave`):
@@ -298,6 +323,10 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
     D = 2 * (P_ - 1) + (C - 1) * P_     # B-stream clock offset
     if zero_bubble:
         assert C == 1, "zero_bubble composes with num_chunks=1 only"
+        assert seq_axis is None, (
+            "zero_bubble does not compose with a manual seq_axis: the W "
+            "sub-tick's stage recompute runs at stage-dependent ticks, which "
+            "cannot be made collective-uniform across pp; use '1f1b'")
         assert M >= D + 1, (
             f"ZB-H1 needs microbatches ({M}) >= 2*(pp-1)+1 ({D + 1}): the "
             "first idle F-slot must fall after the matching backward")
@@ -332,6 +361,9 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
         return (m // P_) * P_ * C + c * P_ + m % P_
 
     manual = {axis_name, *batch_axes}
+    if seq_axis is not None:
+        manual.add(seq_axis)
+    sep_size = mesh.shape[seq_axis] if seq_axis is not None else 1
     K_batch = 1
     for a in batch_axes:
         K_batch *= mesh.shape[a]
@@ -341,18 +373,20 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
         return tuple(e) if isinstance(e, (tuple, list)) else (e,)
 
     # params may be sharded over the ZeRO axis (gathered before use) but not
-    # over any other batch axis — such a leaf would enter the region as an
-    # ungathered shard and mis-reduce; fail fast instead
+    # over any other batch axis or the seq axis — such a leaf would enter the
+    # region as an ungathered shard and mis-reduce; fail fast instead
     for tree in (embed_specs, stacked_specs, head_specs):
-        if tree is None or not batch_axes:
+        if tree is None or not manual - {axis_name}:
             continue
         for sp in jax.tree_util.tree_leaves(
                 tree, is_leaf=lambda s: s is None or isinstance(s, P)):
             for e in (sp or ()):
                 bad = [a for a in _entries(e)
                        if a in batch_axes and a != zero_axis]
+                if seq_axis is not None:
+                    bad += [a for a in _entries(e) if a == seq_axis]
                 assert not bad, (
-                    f"param spec {sp} shards over batch axis {bad}; only the "
+                    f"param spec {sp} shards over axis {bad}; only the "
                     f"zero_axis ({zero_axis}) may shard params")
 
     def _proj(spec):
@@ -399,13 +433,17 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
     def _reduce_tree(tree, specs, with_pp):
         """psum each grad leaf over the batch axes its spec does NOT shard
         (zero-axis-sharded dims were already reduce-scattered by the gather
-        transpose), plus pp for the stage-owned embed/head params."""
+        transpose), plus the seq axis (params are always replicated over it;
+        each shard saw its token slice), plus pp for the stage-owned
+        embed/head params."""
+        seq_extra = (seq_axis,) if seq_axis is not None else ()
+
         def axes_of(sp):
             named = set()
             if sp is not None:
                 for e in sp:
                     named |= {a for a in _entries(e) if a is not None}
-            extra = tuple(a for a in batch_axes if a not in named)
+            extra = tuple(a for a in batch_axes if a not in named) + seq_extra
             return (axis_name, *extra) if with_pp else extra
 
         def r(g, sp):
@@ -418,12 +456,17 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
             r, tree, specs, is_leaf=lambda s: s is None or isinstance(s, P))
 
     # local activation shape: the batch dim (dim 0 of the embed output) is
-    # split over the manual batch axes inside the region
+    # split over the manual batch axes inside the region; with a seq_axis the
+    # sequence dim (dim 1) is additionally split over it
     act_aval = jax.eval_shape(embed_fn, embed_params, micro_inputs[0], *extra_args)
     assert act_aval.shape[0] % K_batch == 0, (
         f"microbatch {act_aval.shape[0]} not divisible by batch axes {batch_axes}"
         f" product {K_batch}")
     act_shape = (act_aval.shape[0] // K_batch,) + act_aval.shape[1:]
+    if sep_size > 1:
+        assert act_shape[1] % sep_size == 0, (
+            f"sequence dim {act_shape[1]} not divisible by {seq_axis}={sep_size}")
+        act_shape = (act_shape[0], act_shape[1] // sep_size) + act_shape[2:]
     act_dtype = act_aval.dtype
 
     if batch_axes:
@@ -657,6 +700,78 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
             recv_b = _permute(dx, bwd_perm)
             return (recv_f, recv_b, ring, dyring, dep, dsp, dhp, loss_acc), None
 
+        def tick_uniform(carry, k):
+            """Tick body for meshes with in-stage collectives (seq_axis
+            bound): ring attention's CollectivePermute lowers with EVERY
+            device as a participant, so skipping stage compute on bubble
+            ticks — whose validity predicate differs per pp rank —
+            desynchronizes the rendezvous across pp and silently corrupts
+            the ring (the failure gpipe_stacked's manual_axes branch
+            documents).  Here validity selects RESULTS, never execution:
+            every device runs the stage forward, the stage vjp, and the
+            (local-only) head/embed role work on every tick."""
+            recv_f, recv_b, ring, dyring, dep, dsp, dhp, loss_acc = carry
+
+            # ---- F sub-tick (uniform) ----
+            fi = k - stage
+            f_valid = (fi >= 0) & (fi < total_f)
+            fi_c = jnp.clip(fi, 0, total_f - 1)
+            fm, fc = _f_to_mc(fi_c)
+            ids_f = jax.lax.dynamic_index_in_dim(mb_in, fm, 0, keepdims=False)
+            x_emb = embed_fn(embed_p, ids_f, *extras).astype(act_dtype)
+            x_in = jnp.where(is_first & (fc == 0), x_emb, recv_f)
+            slot_f = fi_c % R
+            old_f = jax.lax.dynamic_index_in_dim(ring, slot_f, 0,
+                                                 keepdims=False)
+            ring = jax.lax.dynamic_update_index_in_dim(
+                ring, jnp.where(f_valid, x_in, old_f), slot_f, 0)
+            y_full = call_stage(stacked_p, x_in, fc)   # collectives: always
+            y = jnp.where(f_valid & ~(is_last & (fc == C - 1)), y_full,
+                          jnp.zeros(act_shape, act_dtype))
+
+            # ---- B sub-tick (uniform) ----
+            bi = k - D + stage
+            b_valid = (bi >= 0) & (bi < total_f)
+            bi_c = jnp.clip(bi, 0, total_f - 1)
+            bm, bfc = _f_to_mc(bi_c)
+            bc = C - 1 - bfc
+            slot_b = _mc_to_f(bm, bc) % R
+            x_saved = jax.lax.dynamic_index_in_dim(ring, slot_b, 0,
+                                                   keepdims=False)
+            lbl = jax.lax.dynamic_index_in_dim(mb_lbl, bm, 0, keepdims=False)
+            ids_b = jax.lax.dynamic_index_in_dim(mb_in, bm, 0, keepdims=False)
+            is_head = is_last & (bc == C - 1)
+            is_emb = is_first & (bc == 0)
+            # stage fwd+bwd as ONE uniform vjp; role differences (head loss
+            # grad, embed vjp) are local-only and resolved by select
+            y_b, vjp_fn = jax.vjp(
+                lambda sp, x: call_stage(sp, x, bc), stacked_p, x_saved)
+            lval_h, (g_hp_h, dy_h) = jax.value_and_grad(
+                lambda hp, y_: head_loss_fn(hp, y_, lbl, *extras),
+                argnums=(0, 1))(head_p, y_b)
+            inv_m = 1.0 / M_f
+            dy = jnp.where(is_head, (dy_h * inv_m).astype(act_dtype), recv_b)
+            g_sp, g_x = vjp_fn(dy)
+            _, evjp = jax.vjp(
+                lambda ep: embed_fn(ep, ids_b, *extras).astype(act_dtype),
+                embed_p)
+            (g_ep_e,) = evjp(g_x)
+            sel = lambda c, s_, t: jax.tree_util.tree_map(
+                lambda g: jnp.where(c, g.astype(jnp.float32) * s_, 0.0), t)
+            dep = tree_add(dep, sel(b_valid & is_emb, 1.0, g_ep_e))
+            dsp = tree_add(dsp, sel(b_valid, 1.0, g_sp))
+            dhp = tree_add(dhp, sel(b_valid & is_head, inv_m, g_hp_h))
+            loss_acc = loss_acc + jnp.where(
+                b_valid & is_head, lval_h.astype(jnp.float32) * inv_m, 0.0)
+            dx = jnp.where(b_valid & ~is_emb, g_x,
+                           jnp.zeros(act_shape, act_dtype))
+
+            recv_f = _permute(y, fwd_perm)
+            recv_b = _permute(dx, bwd_perm)
+            return (recv_f, recv_b, ring, dyring, dep, dsp, dhp,
+                    loss_acc), None
+
+        tick_fn = tick_uniform if seq_axis is not None else tick
         R_dy = R if zero_bubble else 1  # cotangent ring only exists for ZB
         carry0 = (
             jnp.zeros(act_shape, act_dtype),          # recv_f
@@ -669,42 +784,48 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
             jnp.float32(0),
         )
         (_, _, _, _, dep, dsp, dhp, loss_acc), _ = jax.lax.scan(
-            tick, carry0, jnp.arange(total_f + D))
+            tick_fn, carry0, jnp.arange(total_f + D))
         # loss lives on the last stage, embed/head grads on their owning
         # stages: scalar + shared-param psums (cheap; the per-stage grads —
         # the big ones — never cross stage boundaries).  With batch axes
         # bound manually, each device saw 1/K_batch of every microbatch:
         # grads sum over the axes their leaf is not sharded on, and
         # everything scales by 1/K_batch to make the loss the global mean.
-        loss = jax.lax.psum(loss_acc, (axis_name, *batch_axes))
+        seq_extra = (seq_axis,) if seq_axis is not None else ()
+        loss = jax.lax.psum(loss_acc, (axis_name, *batch_axes, *seq_extra))
         dep = _reduce_tree(dep, embed_specs if batch_axes else None, with_pp=True)
         dhp = _reduce_tree(dhp, head_specs if batch_axes else None, with_pp=True)
-        if batch_axes:
+        if batch_axes or seq_axis is not None:
             dsp = _reduce_tree(dsp, stacked_specs, with_pp=False)
-        if K_batch > 1:
-            inv_k = 1.0 / K_batch
+        if K_batch * sep_size > 1:
+            # each device saw 1/K_batch of the batch and 1/sep of the tokens:
+            # the per-shard means sum to K*sep times the global mean
+            inv_k = 1.0 / (K_batch * sep_size)
             sc = lambda t: jax.tree_util.tree_map(lambda g: g * inv_k, t)
             loss, dep, dsp, dhp = loss * inv_k, sc(dep), sc(dsp), sc(dhp)
         return loss, dep, dsp, dhp
 
     pp_leading = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     rep = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+    seq_entry = (seq_axis,) if seq_axis is not None else ()
     if batch_axes:
         embed_in = _proj_tree(embed_params, embed_specs, lambda _: P())
         stacked_in = _proj_tree(stacked_params, stacked_specs,
                                 lambda _: P(axis_name))
         head_in = _proj_tree(head_params, head_specs, lambda _: P())
-        data_in = P(None, tuple(batch_axes))
+        data_in = P(None, tuple(batch_axes), *seq_entry)
     else:
         embed_in, stacked_in, head_in = rep(embed_params), pp_leading, rep(head_params)
-        data_in = P()
+        data_in = P(None, None, *seq_entry) if seq_axis is not None else P()
+    extras_in = (tuple(extra_specs) if extra_specs is not None
+                 else tuple(P() for _ in extra_args))
+    assert len(extras_in) == len(extra_args), (extras_in, len(extra_args))
     loss, dep, dsp, dhp = jax.shard_map(
         inner,
         mesh=mesh,
-        in_specs=(embed_in, stacked_in, head_in, data_in, data_in)
-        + tuple(P() for _ in extra_args),
+        in_specs=(embed_in, stacked_in, head_in, data_in, data_in) + extras_in,
         out_specs=(P(), embed_in, stacked_in, head_in),
-        axis_names={axis_name, *batch_axes},
+        axis_names=manual,
         check_vma=False,
     )(embed_params, stacked_params, head_params, micro_inputs, micro_labels,
       *extra_args)

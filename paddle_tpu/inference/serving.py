@@ -31,8 +31,13 @@ admission is bounded by free blocks rather than worst-case max_seq lanes.
 Attention reads a gathered view of the slot's blocks (XLA fuses the block
 gather into the attention contraction's operand read); when the pool runs
 dry the youngest slot is preempted vLLM-style (blocks freed, request
-requeued with prompt+generated so far — greedy decode makes the recompute
-exact).
+requeued with prompt+generated so far; the stored tokens are teacher-forced
+on resume, which makes the recompute exact for greedy AND sampled decode).
+
+Per-request sampling (reference: ``top_p_sampling``, ops.yaml:4947) runs
+inside the jitted step: temperature/top-p/seed are per-slot DATA vectors, so
+one compiled program serves mixed greedy/sampled batches, and RNG keys
+derive from (slot seed, position) — deterministic, replayable streams.
 
 Admission/retirement/allocation is plain Python around the compiled
 programs — scheduling is control-plane work and costs microseconds next to
@@ -42,6 +47,7 @@ and CUDA kernels.
 
 from __future__ import annotations
 
+import functools
 import time
 from dataclasses import dataclass, field
 
@@ -58,6 +64,11 @@ class Request:
     prompt_ids: np.ndarray  # [s0] int32
     max_new_tokens: int = 32
     eos_token_id: int | None = None
+    # per-request sampling (reference: top_p_sampling,
+    # paddle/phi/ops/yaml/ops.yaml:4947).  temperature == 0 -> greedy.
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int | None = None
     # filled by the engine
     output_ids: list = field(default_factory=list)
     finished: bool = False
@@ -134,9 +145,21 @@ class ContinuousBatchingEngine:
         self._slot_req: list[Request | None] = [None] * max_batch
         self._pos = np.zeros(max_batch, np.int32)      # next write position
         self._last_tok = np.zeros(max_batch, np.int32)
+        # per-slot sampling state (temperature 0 = greedy; one compiled
+        # program serves mixed greedy/sampled batches — the knobs are DATA)
+        self._temp = np.zeros(max_batch, np.float32)
+        self._topp = np.ones(max_batch, np.float32)
+        self._seed = np.zeros(max_batch, np.int32)
         self._queue: list[Request] = []
         impl = self._decode_impl_paged if paged else self._decode_impl
-        self._decode = jax.jit(impl, donate_argnums=(1, 2))
+        # two decode variants behind a STATIC sampling flag: the full-vocab
+        # sort/softmax/categorical of the sampler must not run (XLA cannot
+        # DCE work behind a data-dependent where) when every resident slot
+        # is greedy — the bench headline's configuration
+        self._decode_greedy = jax.jit(
+            functools.partial(impl, sampling=False), donate_argnums=(1, 2))
+        self._decode_sampling = jax.jit(
+            functools.partial(impl, sampling=True), donate_argnums=(1, 2))
         # prefill writes its lane directly into the donated pool arrays —
         # no slice-out/scatter-back copies of the full pool per admission
         pimpl = self._prefill_impl_paged if paged else self._prefill_impl
@@ -209,25 +232,57 @@ class ContinuousBatchingEngine:
                                            write, mask, cos, sin)
         return _inf.lm_head_logits(cfg, params, x[:, -1]), ak, av
 
+    def _sample_tokens(self, logits, pos, temp, topp, seeds):
+        """Per-slot next-token choice inside the compiled step: greedy where
+        temperature == 0, temperature + nucleus (top-p) sampling elsewhere
+        (reference: top_p_sampling, ops.yaml:4947).  The RNG key is derived
+        deterministically from (slot seed, position): sampling is replayable,
+        and a preempted-then-resumed request continues its stream exactly
+        (resume teacher-forces the stored tokens, then position-derived keys
+        make the continuation draw what it would have drawn)."""
+        B = self.max_batch
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = (logits.astype(jnp.float32)
+                  / jnp.maximum(temp, 1e-6)[:, None])
+        # nucleus mask via sorted cumsum: keep the smallest prefix of
+        # descending-prob tokens whose mass reaches top_p (top-1 always kept)
+        order = jnp.argsort(-scaled, axis=-1)
+        sprob = jax.nn.softmax(jnp.take_along_axis(scaled, order, axis=-1),
+                               axis=-1)
+        keep_sorted = (jnp.cumsum(sprob, axis=-1) - sprob) < topp[:, None]
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(B)[:, None], order].set(keep_sorted)
+        masked = jnp.where(keep, scaled, -jnp.inf)
+        keys = jax.vmap(lambda s, p: jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(0), s), p))(seeds, pos)
+        sampled = jax.vmap(jax.random.categorical)(keys, masked)
+        return jnp.where(temp > 0.0, sampled.astype(jnp.int32), greedy)
+
     def _chunk_scan(self, params, cache_k, cache_v, tokens, pos, active,
-                    table=None):
-        """``chunk`` greedy steps in one compiled program; the sampled token
+                    temp, topp, seeds, table=None, sampling=False):
+        """``chunk`` decode steps in one compiled program; the chosen token
         feeds back on-device (no host round-trip inside the chunk).
-        Returns (tokens [chunk, B], caches)."""
+        ``sampling`` is STATIC: the greedy variant compiles without the
+        sampler's full-vocab sort.  Returns (tokens [chunk, B], caches)."""
 
         def one(carry, _):
             ck, cv, tok, p = carry
             logits, ck, cv = self._decode_one(params, ck, cv, tok, p, active,
                                               table)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if sampling:
+                nxt = self._sample_tokens(logits, p, temp, topp, seeds)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return (ck, cv, nxt, p + 1), nxt
 
         (ck, cv, _, _), toks = jax.lax.scan(
             one, (cache_k, cache_v, tokens, pos), None, length=self.chunk)
         return toks, ck, cv
 
-    def _decode_impl(self, params, cache_k, cache_v, tokens, pos, active):
-        return self._chunk_scan(params, cache_k, cache_v, tokens, pos, active)
+    def _decode_impl(self, params, cache_k, cache_v, tokens, pos, active,
+                     temp, topp, seeds, sampling=False):
+        return self._chunk_scan(params, cache_k, cache_v, tokens, pos, active,
+                                temp, topp, seeds, sampling=sampling)
 
     def _prefill_body(self, params, ids, cache_k, cache_v, length, bucket,
                       write):
@@ -279,9 +334,9 @@ class ContinuousBatchingEngine:
     # ---------------- paged (block-table) compiled programs ----------------
 
     def _decode_impl_paged(self, params, cache_k, cache_v, tokens, pos, active,
-                           table):
+                           temp, topp, seeds, table, sampling=False):
         return self._chunk_scan(params, cache_k, cache_v, tokens, pos, active,
-                                table)
+                                temp, topp, seeds, table, sampling=sampling)
 
     def _prefill_impl_paged(self, params, ids, cache_k, cache_v, table_row,
                             length, bucket):
@@ -331,8 +386,10 @@ class ContinuousBatchingEngine:
 
     def _preempt(self, slot: int):
         """vLLM-style recompute preemption: free the slot, requeue the
-        request with prompt + generated-so-far (greedy decode makes the
-        recomputed continuation exact)."""
+        request with prompt + generated-so-far.  Sampling-safe: resume
+        teacher-forces the STORED sampled tokens (no re-decode of history),
+        and the continuation's RNG keys derive from (seed, position), so the
+        stream picks up exactly where it left off."""
         req = self._slot_req[slot]
         ids = np.concatenate([np.asarray(req.prompt_ids, np.int32).ravel(),
                               np.asarray(req.output_ids, np.int32)])
@@ -342,6 +399,7 @@ class ContinuousBatchingEngine:
         req._resume_age = int(self._slot_age[slot])
         self._release(slot)
         self._slot_req[slot] = None
+        self._temp[slot] = 0.0  # re-set on readmission
         self._queue.insert(0, req)
         self.stats["preemptions"] += 1
 
@@ -375,6 +433,10 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"request {req.rid}: prompt length {ids.size} exceeds "
                 f"max_seq-1 = {self.max_seq - 1}")
+        if (req.temperature or 0.0) < 0:  # None -> greedy
+            raise ValueError(f"request {req.rid}: temperature must be >= 0")
+        if not 0 < (req.top_p if req.top_p is not None else 1.0) <= 1:
+            raise ValueError(f"request {req.rid}: top_p must be in (0, 1]")
 
     def add_request(self, req: Request):
         self._validate(req)
@@ -434,11 +496,19 @@ class ContinuousBatchingEngine:
             self._slot_req[slot] = req
             self._pos[slot] = s0 - 1
             self._last_tok[slot] = ids[-1]
+            self._temp[slot] = max(float(req.temperature or 0.0), 0.0)
+            self._topp[slot] = float(req.top_p if req.top_p is not None
+                                     else 1.0)
+            # default seed: the request id, so two concurrent sampled
+            # requests never share a stream
+            self._seed[slot] = np.int32(
+                req.seed if req.seed is not None else req.rid)
             self.stats["prefills"] += 1
 
     def _retire(self, slot):
         self._slot_req[slot].finished = True
         self._slot_req[slot] = None
+        self._temp[slot] = 0.0  # freed slot must not pin the sampling variant
         if self.paged:
             self._release(slot)
 
@@ -453,10 +523,14 @@ class ContinuousBatchingEngine:
             return False
         t0 = time.perf_counter()
         extra = (jnp.asarray(self._table),) if self.paged else ()
-        toks, self.cache_k, self.cache_v = self._decode(
+        # greedy-only resident set takes the sampler-free compiled variant
+        any_sampled = bool((self._temp * active_np).max() > 0)
+        decode = self._decode_sampling if any_sampled else self._decode_greedy
+        toks, self.cache_k, self.cache_v = decode(
             self.params, self.cache_k, self.cache_v,
             jnp.asarray(self._last_tok), jnp.asarray(self._pos),
-            jnp.asarray(active_np), *extra)
+            jnp.asarray(active_np), jnp.asarray(self._temp),
+            jnp.asarray(self._topp), jnp.asarray(self._seed), *extra)
         toks_np = np.asarray(toks)  # [k, B] — ONE host round-trip per chunk
         self.stats["decode_time_s"] += time.perf_counter() - t0
         self.stats["decode_steps"] += k

@@ -317,7 +317,7 @@ def forward_pp(cfg: LlamaConfig, params, input_ids, mesh, num_microbatches,
 def loss_and_grads_1f1b(cfg: LlamaConfig, params, input_ids, labels, mesh,
                         num_microbatches, use_flash=True, remat=True,
                         num_chunks=1, layers_stage_major=False,
-                        zero_bubble=False):
+                        zero_bubble=False, sep_attn_impl="ring"):
     """Pipeline train-step core on the executed 1F1B schedule
     (fleet/pipeline.py one_f_one_b_stacked ≙ pipeline_parallel.py:684 run,
     not simulated).  Stage 0 owns the embedding, the last stage owns final
@@ -332,6 +332,7 @@ def loss_and_grads_1f1b(cfg: LlamaConfig, params, input_ids, labels, mesh,
     both permutes.  Returns (mean_loss, grads) with grads matching the
     params tree (f32)."""
     from ..distributed.fleet.pipeline import one_f_one_b_stacked
+    from ..ops import ring_attention as ra
 
     b, s = input_ids.shape
     M = num_microbatches
@@ -342,16 +343,31 @@ def loss_and_grads_1f1b(cfg: LlamaConfig, params, input_ids, labels, mesh,
                                      dtype=cfg.dtype)
     C = num_chunks
     pp_deg = dict(mesh.shape).get("pp", 1)
+    sep = dict(mesh.shape).get("sep", 1)
     L = cfg.num_hidden_layers
     assert L % (pp_deg * C) == 0, (L, pp_deg, C)
     Lv = L // (pp_deg * C)  # layers per virtual stage
+
+    # sep > 1: the runner binds 'sep' manually in the same region (mirrors
+    # the gpipe region, forward_pp) — sequence-sharded microbatches + rope
+    # slices, ring/Ulysses attention inside each stage
+    if sep > 1:
+        if sep_attn_impl == "ulysses":
+            attn_fn = lambda q, k, v: ra.ulysses_attention(
+                q, k, v, axis_name="sep", causal=True)
+        else:
+            attn_fn = lambda q, k, v: ra.ring_attention(
+                q, k, v, axis_name="sep", causal=True)
+    else:
+        attn_fn = None
 
     def embed_fn(ep, ids, cos_, sin_):
         return jnp.take(ep, ids, axis=0).astype(cfg.dtype)
 
     def _scan_layers(sp, x, cos_, sin_):
         def body(carry, lp):
-            return _layer_forward(cfg, carry, lp, cos_, sin_, use_flash, None), None
+            return _layer_forward(cfg, carry, lp, cos_, sin_, use_flash,
+                                  attn_fn), None
 
         scan_body = _remat_wrap(body, remat)
         y, _ = jax.lax.scan(scan_body, x, sp)
@@ -406,6 +422,10 @@ def loss_and_grads_1f1b(cfg: LlamaConfig, params, input_ids, labels, mesh,
                        zero_axis="sharding" if "sharding" in batch_axes else None,
                        embed_specs=specs["embed"],
                        stacked_specs=specs["layers"], head_specs=head_specs)
+
+    if sep > 1:
+        pipe_kw["seq_axis"] = "sep"
+        pipe_kw["extra_specs"] = (P(None, "sep", None),) * 2  # rope [1, s, d]
 
     reorder = C > 1 and not layers_stage_major
     stacked = _to_vpp(params["layers"]) if reorder else params["layers"]
@@ -545,25 +565,24 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
         }
 
     # the executed-1F1B runner binds 'pp' plus any nontrivial dp/sharding
-    # axes manually (loss_and_grads_1f1b) — the round-3 dp×sharding×pp
-    # partitioner CHECK-fail is gone because the batch dim is never
-    # tuple-sharded over auto axes inside the region.  A sep axis still
-    # needs the gpipe region (which binds sep in the same shard_map) — see
-    # forward_pp.
+    # axes manually (loss_and_grads_1f1b), and since round 5 also a 'sep'
+    # axis (seq-sharded microbatches + ring attention inside each stage —
+    # the reference's 1F1B runtime composes with sep the same way,
+    # pipeline_parallel.py:684 + topology.py:77).
     # 'vpp'/'interleave' runs the same executed runner with C>1 virtual
     # chunks per stage (num_chunks); '1f1b' is C=1; 'zb'/'zero_bubble' is
     # the executed ZB-H1 (deferred weight grads fill the drain bubble —
     # needs num_microbatches >= 2*(pp-1)+1)
-    # None = auto (executed 1F1B when the mesh allows, gpipe region when sep
-    # binds); ANY explicit request that can't run here raises — a schedule
-    # silently different from the configured one is worse than an error
+    # None = auto (executed 1F1B when pp > 1); ANY explicit request that
+    # can't run here raises — a schedule silently different from the
+    # configured one is worse than an error
     schedule = "1f1b" if pipeline_schedule is None else pipeline_schedule
     known = ("1f1b", "vpp", "interleave", "zb", "zero_bubble",
              "gpipe", "fthenb")
     if schedule not in known:
         raise ValueError(f"unknown pipeline_schedule {schedule!r} "
                          f"(expected one of {known})")
-    use_1f1b = pp > 1 and sep == 1 and schedule in (
+    use_1f1b = pp > 1 and schedule in (
         "1f1b", "vpp", "interleave", "zb", "zero_bubble")
     zb = schedule in ("zb", "zero_bubble")
     if pipeline_schedule is not None:
@@ -575,7 +594,7 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
         elif not use_1f1b:
             raise ValueError(
                 f"pipeline_schedule={pipeline_schedule!r} needs a mesh with "
-                f"pp > 1 and sep == 1 (got pp={pp}, sep={sep})")
+                f"pp > 1 (got pp={pp})")
     if num_chunks is not None and num_chunks > 1 and not (
             schedule in ("vpp", "interleave")):
         raise ValueError(
@@ -589,7 +608,8 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
             loss, grads = loss_and_grads_1f1b(cfg, params, input_ids, labels,
                                               mesh, num_microbatches,
                                               num_chunks=vpp_chunks,
-                                              zero_bubble=zb)
+                                              zero_bubble=zb,
+                                              sep_attn_impl=sep_attn_impl)
         else:
             if pp > 1:
                 lfn = lambda p: loss_fn_pp(cfg, p, input_ids, labels, mesh,

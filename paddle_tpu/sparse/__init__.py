@@ -280,14 +280,9 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     return Tensor(out)
 
 
-# nn sub-namespace (reference python/paddle/sparse/nn/)
-class _SparseReLU:
-    def __call__(self, x):
-        return relu(x)
-
-
-class nn:
-    ReLU = _SparseReLU
+# nn sub-namespace: full layer package (Conv3D/SubmConv3D/BatchNorm/ReLU +
+# functional.attention) — imported at the END of this module, after every
+# name it needs here exists (see bottom)
 
 
 # ---- unary tail (f(0)=0 family, reference sparse/unary.py) ----
@@ -419,3 +414,7 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
 __all__ += ["tan", "asin", "atan", "sinh", "asinh", "atanh", "log1p",
             "expm1", "deg2rad", "rad2deg", "isnan", "coalesce", "reshape",
             "slice", "mv", "addmm", "divide", "mask_as", "pca_lowrank"]
+
+from . import nn  # noqa: E402,F401  (after the names nn's functional needs)
+
+__all__ += ["nn"]

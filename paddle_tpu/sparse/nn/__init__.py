@@ -1,0 +1,260 @@
+"""Sparse nn layers (reference: python/paddle/sparse/nn/layer/ — conv.py:308
+Conv3D, :578 SubmConv3D, norm.py BatchNorm, activation.py ReLU).
+
+Layer classes hold parameters through the framework Layer base (so
+state_dict/apply/to work) and delegate math to sparse.nn.functional.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...nn.layer_base import Layer
+from . import functional  # noqa: F401
+from .functional import (attention, conv2d, conv3d, leaky_relu, max_pool3d,
+                         relu as _frelu, relu6 as _frelu6, softmax as _fsoftmax,
+                         subm_conv2d, subm_conv3d)
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "Conv2D", "Conv3D",
+           "SubmConv2D", "SubmConv3D", "BatchNorm", "SyncBatchNorm",
+           "MaxPool3D", "functional"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _frelu(x)
+
+    __call__ = forward
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _frelu6(x)
+
+    __call__ = forward
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return leaky_relu(x, self.negative_slope)
+
+    __call__ = forward
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return _fsoftmax(x, self.axis)
+
+    __call__ = forward
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                          self.data_format)
+
+    __call__ = forward
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v,) * 3
+
+
+class _ConvBase(Layer):
+    _ndim = 3
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+        assert padding_mode == "zeros", padding_mode
+        if groups != 1:
+            raise NotImplementedError(
+                f"{type(self).__name__}: sparse convs support groups=1 only "
+                f"(got groups={groups})")
+        nd = self._ndim
+        tup = (lambda v: tuple(v) if isinstance(v, (tuple, list))
+               else (v,) * nd)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = tup(kernel_size)
+        self.stride = tup(stride)
+        self.padding = padding
+        self.dilation = tup(dilation)
+        self.groups = groups
+        self.data_format = data_format or ("NDHWC" if nd == 3 else "NHWC")
+        # reference default init: Normal(0, sqrt(2 / fan_in))
+        fan_in = in_channels
+        for k in self.kernel_size:
+            fan_in *= k
+        std = math.sqrt(2.0 / fan_in)
+        from ...nn import initializer as I
+
+        self.weight = self.create_parameter(
+            self.kernel_size + (in_channels, out_channels), attr=weight_attr,
+            default_initializer=I.Normal(0.0, std))
+        self.bias = (self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True)
+            if bias_attr is not False else None)
+
+
+class Conv3D(_ConvBase):
+    """Sparse 3-D convolution layer (reference sparse/nn/layer/conv.py:308).
+    Input/output are SparseCooTensors in NDHWC; weight is DHWCM."""
+
+    def forward(self, x):
+        return conv3d(x, self.weight, self.bias, self.stride, self.padding,
+                      self.dilation, self.groups, self.data_format)
+
+    __call__ = forward
+
+
+class SubmConv3D(_ConvBase):
+    """Submanifold sparse conv layer (reference conv.py:578): output keeps
+    the input's sparsity pattern."""
+
+    def __init__(self, *args, key=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.key = key
+
+    def forward(self, x):
+        return subm_conv3d(x, self.weight, self.bias, self.stride,
+                           self.padding, self.dilation, self.groups,
+                           self.data_format, key=self.key)
+
+    __call__ = forward
+
+
+class Conv2D(_ConvBase):
+    """Sparse 2-D conv layer (reference sparse/nn/layer/conv.py Conv2D);
+    NHWC input, HWCM kernel."""
+
+    _ndim = 2
+
+    def forward(self, x):
+        return conv2d(x, self.weight, self.bias, self.stride, self.padding,
+                      self.dilation, self.groups, self.data_format)
+
+    __call__ = forward
+
+
+class SubmConv2D(_ConvBase):
+    _ndim = 2
+
+    def __init__(self, *args, key=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.key = key
+
+    def forward(self, x):
+        return subm_conv2d(x, self.weight, self.bias, self.stride,
+                           self.padding, self.dilation, self.groups,
+                           self.data_format, key=self.key)
+
+    __call__ = forward
+
+
+class BatchNorm(Layer):
+    """Sparse BatchNorm (reference sparse/nn/layer/norm.py BatchNorm):
+    normalizes the COO values [nnz, C] per channel over the non-zero
+    elements — zeros never enter the statistics."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        assert data_format == "NDHWC", data_format
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.use_global_stats = use_global_stats
+        from ...nn import initializer as I
+
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), attr=bias_attr, is_bias=True)
+        self.register_buffer("_mean", jnp.zeros((num_features,), jnp.float32))
+        self.register_buffer("_variance",
+                             jnp.ones((num_features,), jnp.float32))
+
+    def forward(self, x):
+        from ... import sparse as sp
+        from jax.experimental import sparse as jsparse
+
+        b = x._bcoo
+        vals = b.data
+        assert vals.ndim >= 1 and vals.shape[-1] == self.num_features, (
+            vals.shape, self.num_features)
+        v32 = vals.astype(jnp.float32)
+        use_global = (self.use_global_stats
+                      if self.use_global_stats is not None
+                      else not self.training)
+        if use_global:
+            mean = jnp.asarray(self._mean.numpy()
+                               if hasattr(self._mean, "numpy")
+                               else self._mean)
+            var = jnp.asarray(self._variance.numpy()
+                              if hasattr(self._variance, "numpy")
+                              else self._variance)
+        else:
+            axes = tuple(range(v32.ndim - 1))
+            mean = v32.mean(axis=axes)
+            var = v32.var(axis=axes)
+            m = self.momentum
+            old_m = np.asarray(self._mean.numpy()
+                               if hasattr(self._mean, "numpy")
+                               else self._mean)
+            old_v = np.asarray(self._variance.numpy()
+                               if hasattr(self._variance, "numpy")
+                               else self._variance)
+            self._buffers["_mean"] = jnp.asarray(
+                m * old_m + (1 - m) * np.asarray(mean))
+            self._buffers["_variance"] = jnp.asarray(
+                m * old_v + (1 - m) * np.asarray(var))
+        g = jnp.asarray(getattr(self.weight, "_value", self.weight))
+        be = jnp.asarray(getattr(self.bias, "_value", self.bias))
+        out = (v32 - mean) / jnp.sqrt(var + self.epsilon) * g + be
+        return sp.SparseCooTensor(
+            jsparse.BCOO((out.astype(vals.dtype), b.indices), shape=b.shape))
+
+    __call__ = forward
+
+
+class SyncBatchNorm(BatchNorm):
+    """Sparse SyncBatchNorm (reference sparse/nn/layer/norm.py
+    SyncBatchNorm): under pjit/GSPMD the batch statistics reduce across the
+    data-parallel mesh automatically (the mean/var jnp reductions are global
+    under sharding), so the eager single-process behavior is BatchNorm —
+    the same absorption as the dense SyncBatchNorm."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, BatchNorm) and not isinstance(layer, cls):
+            out = cls(layer.num_features, layer.momentum, layer.epsilon)
+            out.weight = layer.weight
+            out.bias = layer.bias
+            out._buffers.update(layer._buffers)
+            return out
+        for name, sub in getattr(layer, "_sub_layers", {}).items():
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer

@@ -1,0 +1,381 @@
+"""Sparse neural-net functional ops (reference: python/paddle/sparse/nn/
+functional/ — conv.py conv3d/subm_conv3d, transformer.py:28 attention,
+activation.py relu).
+
+TPU-first design: the reference lowers these to cuSPARSE/custom CUDA
+"rulebook" kernels.  On TPU the honest mapping is gather/scatter over the
+BCOO coordinate list feeding dense MXU matmuls:
+
+- ``conv3d`` iterates the (static, small) kernel offsets; each offset is one
+  dense [nnz, Cin] @ [Cin, M] matmul whose rows scatter-add into the output
+  grid.  The output pattern is exactly the set of positions receiving any
+  contribution (the reference's output layout), extracted host-side.
+- ``subm_conv3d`` is pattern-preserving: neighbors are located by binary
+  search (searchsorted) over linearized coordinates — a pure gather, no
+  scatter, and the output keeps the input's indices (submanifold semantics,
+  reference conv.py:578).
+- ``attention`` computes the masked dense softmax(QK^T)V restricted to the
+  sparse layout; on TPU a masked dense contraction IS the fast path (the MXU
+  wants dense tiles), while the semantics match the reference's
+  sparse_fused_attention (transformer.py:28).
+
+All ops are composed of jnp primitives, so jax.grad provides the backward
+passes (the reference registers hand-written CUDA grads).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+__all__ = ["conv3d", "subm_conv3d", "conv2d", "subm_conv2d", "attention",
+           "relu", "relu6", "leaky_relu", "softmax", "max_pool3d"]
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v,) * 3
+
+
+def _coords_vals(x):
+    """Normalize a SparseCooTensor carrying an NDHWC image to
+    (coords [nnz, 4] over (n, d, h, w), vals [nnz, C]).  Accepts either the
+    channels-dense layout (indices over 4 dims, values [nnz, C]) or a fully
+    sparse 5-dim COO (regrouped host-side)."""
+    b = x._bcoo
+    if b.indices.shape[1] == 4 and b.data.ndim == 2:
+        return jnp.asarray(b.indices), jnp.asarray(b.data)
+    if b.indices.shape[1] == 5:
+        # regroup (n,d,h,w,c) scalar entries into channel rows (host-side;
+        # creation-time normalization, not a hot path)
+        idx = np.asarray(b.indices)
+        dat = np.asarray(b.data)
+        C = x.shape[4]
+        spatial, inv = np.unique(idx[:, :4], axis=0, return_inverse=True)
+        vals = np.zeros((len(spatial), C), dat.dtype)
+        np.add.at(vals, (inv, idx[:, 4]), dat)
+        return jnp.asarray(spatial), jnp.asarray(vals)
+    raise ValueError(
+        f"expected NDHWC sparse input (4-dim indices + channel values or "
+        f"5-dim COO); got indices {b.indices.shape}, values {b.data.shape}")
+
+
+def _out_dim(size, k, stride, pad, dil):
+    return (size + 2 * pad - (dil * (k - 1) + 1)) // stride + 1
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse 3-D convolution (reference sparse/nn/functional/conv.py:conv3d;
+    layer at conv.py:308).  x: SparseCooTensor [N, D, H, W, C]; weight
+    [kD, kH, kW, C, M] (DHWCM).  Returns a SparseCooTensor whose pattern is
+    the set of output positions covered by any input non-zero."""
+    from .... import sparse as sp
+
+    assert groups == 1, "sparse conv3d currently supports groups=1 only"
+    assert data_format == "NDHWC", data_format
+    w = jnp.asarray(getattr(weight, "_value", weight))
+    kD, kH, kW, Cin, M = w.shape
+    st, pd, dl = _triple(stride), _triple(padding), _triple(dilation)
+    coords, vals = _coords_vals(x)
+    N, D, H, W, C = x.shape
+    assert C == Cin, (C, Cin)
+    Do = _out_dim(D, kD, st[0], pd[0], dl[0])
+    Ho = _out_dim(H, kH, st[1], pd[1], dl[1])
+    Wo = _out_dim(W, kW, st[2], pd[2], dl[2])
+
+    def dense_out(coords, vals, w):
+        out = jnp.zeros((N, Do, Ho, Wo, M), vals.dtype)
+        occ = jnp.zeros((N, Do, Ho, Wo), jnp.int32)
+        for kd in range(kD):
+            for kh in range(kH):
+                for kw in range(kW):
+                    od = coords[:, 1] + pd[0] - kd * dl[0]
+                    oh = coords[:, 2] + pd[1] - kh * dl[1]
+                    ow = coords[:, 3] + pd[2] - kw * dl[2]
+                    valid = ((od % st[0] == 0) & (oh % st[1] == 0)
+                             & (ow % st[2] == 0))
+                    od, oh, ow = od // st[0], oh // st[1], ow // st[2]
+                    valid &= ((od >= 0) & (od < Do) & (oh >= 0) & (oh < Ho)
+                              & (ow >= 0) & (ow < Wo))
+                    contrib = vals @ w[kd, kh, kw]        # [nnz, M] on MXU
+                    contrib = jnp.where(valid[:, None], contrib, 0)
+                    n_ = coords[:, 0]
+                    od = jnp.where(valid, od, 0)
+                    oh = jnp.where(valid, oh, 0)
+                    ow = jnp.where(valid, ow, 0)
+                    out = out.at[n_, od, oh, ow].add(contrib)
+                    occ = occ.at[n_, od, oh, ow].add(valid.astype(jnp.int32))
+        return out, occ
+
+    out, occ = dense_out(coords, vals, w)
+    if bias is not None:
+        b = jnp.asarray(getattr(bias, "_value", bias))
+        out = out + jnp.where(occ[..., None] > 0, b, 0)
+    # output pattern = positions receiving any contribution (exact even when
+    # values cancel to 0); host-side extraction (dynamic nnz)
+    pattern = np.asarray(occ) > 0
+    idx = np.argwhere(pattern).astype(np.int32)           # [nnz_out, 4]
+    out_vals = out[idx[:, 0], idx[:, 1], idx[:, 2], idx[:, 3]]
+    bcoo = jsparse.BCOO((out_vals, jnp.asarray(idx)),
+                        shape=(N, Do, Ho, Wo, M))
+    return sp.SparseCooTensor(bcoo)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse conv (reference conv.py:578 SubmConv3D): the output
+    keeps the INPUT's sparsity pattern — only positions that already hold a
+    non-zero produce output, so deep stacks don't densify.  Neighbor lookup
+    is a searchsorted gather over linearized coordinates."""
+    from .... import sparse as sp
+
+    assert groups == 1, "sparse subm_conv3d currently supports groups=1 only"
+    assert data_format == "NDHWC", data_format
+    if _triple(stride) != (1, 1, 1):
+        raise NotImplementedError(
+            "subm_conv3d is pattern-preserving; stride != 1 is not supported")
+    w = jnp.asarray(getattr(weight, "_value", weight))
+    kD, kH, kW, Cin, M = w.shape
+    dl = _triple(dilation)
+    coords, vals = _coords_vals(x)
+    N, D, H, W, C = x.shape
+    assert C == Cin, (C, Cin)
+
+    def lin(c):  # linearize (n, d, h, w); grids here fit int32
+        return ((c[:, 0] * D + c[:, 1]) * H + c[:, 2]) * W + c[:, 3]
+
+    base = lin(coords)
+    order = jnp.argsort(base)
+    sorted_lin = base[order]
+
+    def gather_out(vals, w):
+        acc = jnp.zeros((coords.shape[0], M), vals.dtype)
+        for kd in range(kD):
+            for kh in range(kH):
+                for kw in range(kW):
+                    # neighbor whose center-aligned offset contributes here
+                    dd = (kd - kD // 2) * dl[0]
+                    dh = (kh - kH // 2) * dl[1]
+                    dw = (kw - kW // 2) * dl[2]
+                    nd = coords[:, 1] + dd
+                    nh = coords[:, 2] + dh
+                    nw = coords[:, 3] + dw
+                    inb = ((nd >= 0) & (nd < D) & (nh >= 0) & (nh < H)
+                           & (nw >= 0) & (nw < W))
+                    nb = ((coords[:, 0] * D + nd) * H + nh) * W + nw
+                    pos = jnp.searchsorted(sorted_lin, nb)
+                    pos_c = jnp.clip(pos, 0, sorted_lin.shape[0] - 1)
+                    found = inb & (sorted_lin[pos_c] == nb)
+                    j = order[pos_c]
+                    nb_vals = jnp.where(found[:, None], vals[j], 0)
+                    # correlation semantics (matches the dense conv3d):
+                    # out[c] += x[c + (k - center)] * w[k]
+                    acc = acc + nb_vals @ w[kd, kh, kw]
+        return acc
+
+    out_vals = gather_out(vals, w)
+    if bias is not None:
+        out_vals = out_vals + jnp.asarray(getattr(bias, "_value", bias))
+    bcoo = jsparse.BCOO((out_vals, coords), shape=(N, D, H, W, M))
+    return sp.SparseCooTensor(bcoo)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-layout attention (reference sparse/nn/functional/transformer
+    .py:28 sparse_fused_attention): softmax(QK^T/sqrt(d)) V evaluated only at
+    the positions present in ``sparse_mask`` (a SparseCsrTensor of dense
+    shape [batch*num_heads, seq, seq]); zeros of ``key_padding_mask``
+    [batch, seq] and ``attn_mask`` [seq, seq] also exclude positions.  On TPU
+    the layout-restricted scores are computed as a masked dense contraction
+    (the MXU-honest lowering of the reference's cuSPARSE SDD kernel)."""
+    from ....core.tensor import Tensor
+
+    q = jnp.asarray(getattr(query, "_value", query))
+    k = jnp.asarray(getattr(key, "_value", key))
+    v = jnp.asarray(getattr(value, "_value", value))
+    B, Hh, S, hd = q.shape
+    mask_dense = sparse_mask.to_dense()
+    md = jnp.asarray(getattr(mask_dense, "_value", mask_dense))
+    keep = (md != 0).reshape(B, Hh, S, S)
+    if key_padding_mask is not None:
+        kp = jnp.asarray(getattr(key_padding_mask, "_value", key_padding_mask))
+        keep = keep & (kp[:, None, None, :] != 0)
+    if attn_mask is not None:
+        am = jnp.asarray(getattr(attn_mask, "_value", attn_mask))
+        keep = keep & (am[None, None] != 0)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+    scores = jnp.where(keep, scores, -jnp.inf)
+    # fully-masked rows softmax to zeros, not NaN
+    mx = jnp.max(scores, axis=-1, keepdims=True)
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+    e = jnp.where(keep, jnp.exp(scores - mx), 0.0)
+    denom = jnp.sum(e, axis=-1, keepdims=True)
+    p = e / jnp.where(denom == 0, 1.0, denom)
+    return Tensor(jnp.einsum("bhqk,bhkd->bhqd", p, v))
+
+
+def relu(x, name=None):
+    from .... import sparse as sp
+
+    return sp.relu(x)
+
+
+def relu6(x, name=None):
+    from .... import sparse as sp
+
+    return sp._as_coo(x)._map(lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    from .... import sparse as sp
+
+    return sp._as_coo(x)._map(
+        lambda v: jnp.where(v >= 0, v, negative_slope * v))
+
+
+def softmax(x, axis=-1, name=None):
+    """Sparse softmax over the stored values of each last-dim row
+    (reference sparse/nn/functional/activation.py softmax: only the
+    non-zero entries participate; zeros are treated as -inf, NOT 0)."""
+    from .... import sparse as sp
+
+    coo = x if isinstance(x, sp.SparseCooTensor) else x.to_sparse_coo()
+    b = coo._bcoo
+    nd = b.indices.shape[1]
+    if axis not in (-1, nd - 1):
+        raise NotImplementedError("sparse softmax supports the last axis")
+    # group rows: linearize all dims but the last
+    key = jnp.zeros(b.indices.shape[0], jnp.int32)
+    mul = 1
+    nrows = 1
+    for d in range(nd - 2, -1, -1):
+        key = key + b.indices[:, d].astype(jnp.int32) * mul
+        mul *= coo.shape[d]
+        nrows *= coo.shape[d]
+    v = b.data.astype(jnp.float32)
+    mx = jax.ops.segment_max(v, key, num_segments=nrows)
+    e = jnp.exp(v - mx[key])
+    den = jax.ops.segment_sum(e, key, num_segments=nrows)
+    out = (e / den[key]).astype(b.data.dtype)
+    res = sp.SparseCooTensor(jsparse.BCOO((out, b.indices), shape=b.shape))
+    return res if isinstance(x, sp.SparseCooTensor) else res.to_sparse_csr()
+
+
+def _as_3d(x):
+    """Lift an NHWC sparse tensor to NDHWC with a singleton depth, so the
+    2-D convs reuse the 3-D gather/scatter engines."""
+    from .... import sparse as sp
+
+    coords, vals = _coords_vals_nd(x, 3)
+    N, H, W, C = x.shape
+    c4 = jnp.concatenate([coords[:, :1],
+                          jnp.zeros((coords.shape[0], 1), coords.dtype),
+                          coords[:, 1:]], axis=1)
+    return sp.SparseCooTensor(jsparse.BCOO((vals, c4),
+                                           shape=(N, 1, H, W, C)))
+
+
+def _coords_vals_nd(x, n_spatial_plus_batch):
+    """_coords_vals generalized to [N, spatial..., C] tensors."""
+    b = x._bcoo
+    nd = n_spatial_plus_batch
+    if b.indices.shape[1] == nd and b.data.ndim == 2:
+        return jnp.asarray(b.indices), jnp.asarray(b.data)
+    if b.indices.shape[1] == nd + 1:
+        idx = np.asarray(b.indices)
+        dat = np.asarray(b.data)
+        C = x.shape[-1]
+        spatial, inv = np.unique(idx[:, :nd], axis=0, return_inverse=True)
+        vals = np.zeros((len(spatial), C), dat.dtype)
+        np.add.at(vals, (inv, idx[:, nd]), dat)
+        return jnp.asarray(spatial), jnp.asarray(vals)
+    raise ValueError((b.indices.shape, b.data.shape))
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v,) * 2
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    """Sparse 2-D conv (reference sparse Conv2D, conv.py): NHWC input,
+    HWCM kernel — runs through the 3-D engine with a singleton depth."""
+    from .... import sparse as sp
+
+    assert data_format == "NHWC", data_format
+    w = jnp.asarray(getattr(weight, "_value", weight))
+    kH, kW, Cin, M = w.shape
+    st, pd, dl = _pair(stride), _pair(padding), _pair(dilation)
+    out3 = conv3d(_as_3d(x), w[None], bias, (1,) + st, (0,) + pd,
+                  (1,) + dl, groups, "NDHWC")
+    b3 = out3._bcoo
+    idx = jnp.concatenate([b3.indices[:, :1], b3.indices[:, 2:]], axis=1)
+    N, _, Ho, Wo, M_ = out3.shape
+    return sp.SparseCooTensor(jsparse.BCOO((b3.data, idx),
+                                           shape=(N, Ho, Wo, M_)))
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    from .... import sparse as sp
+
+    assert data_format == "NHWC", data_format
+    w = jnp.asarray(getattr(weight, "_value", weight))
+    st, pd, dl = _pair(stride), _pair(padding), _pair(dilation)
+    out3 = subm_conv3d(_as_3d(x), w[None], bias, (1,) + st, (0,) + pd,
+                       (1,) + dl, groups, "NDHWC", key=key)
+    b3 = out3._bcoo
+    idx = jnp.concatenate([b3.indices[:, :1], b3.indices[:, 2:]], axis=1)
+    N, _, Ho, Wo, M_ = out3.shape
+    return sp.SparseCooTensor(jsparse.BCOO((b3.data, idx),
+                                           shape=(N, Ho, Wo, M_)))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, data_format="NDHWC",
+               name=None):
+    """Sparse max-pool (reference sparse/nn/functional/pooling.py): the max
+    is over the PRESENT entries of each window — windows with no non-zeros
+    produce no output entry (sparse semantics, not zero-padding)."""
+    from .... import sparse as sp
+
+    assert data_format == "NDHWC", data_format
+    kD, kH, kW = _triple(kernel_size)
+    st = _triple(stride if stride is not None else kernel_size)
+    pd = _triple(padding)
+    coords, vals = _coords_vals(x)
+    N, D, H, W, C = x.shape
+    Do = _out_dim(D, kD, st[0], pd[0], 1)
+    Ho = _out_dim(H, kH, st[1], pd[1], 1)
+    Wo = _out_dim(W, kW, st[2], pd[2], 1)
+    out = jnp.full((N, Do, Ho, Wo, C), -jnp.inf, jnp.float32)
+    occ = jnp.zeros((N, Do, Ho, Wo), jnp.int32)
+    for kd in range(kD):
+        for kh in range(kH):
+            for kw in range(kW):
+                od = coords[:, 1] + pd[0] - kd
+                oh = coords[:, 2] + pd[1] - kh
+                ow = coords[:, 3] + pd[2] - kw
+                valid = ((od % st[0] == 0) & (oh % st[1] == 0)
+                         & (ow % st[2] == 0))
+                od, oh, ow = od // st[0], oh // st[1], ow // st[2]
+                valid &= ((od >= 0) & (od < Do) & (oh >= 0) & (oh < Ho)
+                          & (ow >= 0) & (ow < Wo))
+                contrib = jnp.where(valid[:, None],
+                                    vals.astype(jnp.float32), -jnp.inf)
+                n_ = coords[:, 0]
+                od = jnp.where(valid, od, 0)
+                oh = jnp.where(valid, oh, 0)
+                ow = jnp.where(valid, ow, 0)
+                out = out.at[n_, od, oh, ow].max(contrib)
+                occ = occ.at[n_, od, oh, ow].add(valid.astype(jnp.int32))
+    pattern = np.asarray(occ) > 0
+    idx = np.argwhere(pattern).astype(np.int32)
+    out_vals = out[idx[:, 0], idx[:, 1], idx[:, 2], idx[:, 3]]
+    return sp.SparseCooTensor(jsparse.BCOO(
+        (out_vals.astype(x.dtype), jnp.asarray(idx)),
+        shape=(N, Do, Ho, Wo, C)))

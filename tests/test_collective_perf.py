@@ -1,0 +1,63 @@
+"""fleet.collective_perf microbenchmarks (round-4 verdict #8; reference
+fleet.py:632 collective_perf, :572 _collective_perf_impl)."""
+
+from __future__ import annotations
+
+import logging
+
+import pytest
+
+from paddle_tpu.distributed import fleet
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init_fleet(request):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+
+
+@pytest.mark.parametrize("comm_type,axis,n", [
+    ("allreduce", "data", 4),
+    ("reduce", "data", 4),
+    ("broadcast", "data", 4),
+    ("allgather", "model", 2),
+    ("reduce_scatter", "model", 2),
+])
+def test_collective_perf_runs_and_reports(comm_type, axis, n, eight_devices):
+    rows = fleet.collective_perf(comm_type, round=3, max_nbytes=1 << 21)
+    assert len(rows) == 2  # 1MB, 2MB
+    for r in rows:
+        assert r["axis"] == axis and r["participants"] == n
+        assert r["seconds_per_iter"] > 0
+        assert r["bus_gbps"] > 0
+        assert not r["over_threshold"]
+
+
+def test_collective_perf_threshold_warning(eight_devices, caplog):
+    """A size whose threshold is impossibly tight must emit the reference's
+    Perf Warning (fleet.py:568) and mark the row."""
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.fleet"):
+        rows = fleet.collective_perf("allreduce", round=2,
+                                     size_and_time={1 << 20: 1e-12})
+    assert rows[0]["over_threshold"]
+    assert any("Perf Warning" in r.message for r in caplog.records)
+
+
+def test_collective_perf_explicit_sizes_only(eight_devices):
+    rows = fleet.collective_perf("allgather", round=2,
+                                 size_and_time={1 << 20: -1})
+    assert len(rows) == 1 and rows[0]["nbytes"] == 1 << 20
+
+
+def test_collective_perf_rejects_unknown_type(eight_devices):
+    with pytest.raises(ValueError, match="comm_type"):
+        fleet.collective_perf("alltoallv")
+
+
+def test_collective_perf_p2p(eight_devices):
+    rows = fleet.collective_perf("p2p", round=3, max_nbytes=1 << 21)
+    assert len(rows) == 2
+    for r in rows:
+        assert r["axis"] == "model" and r["bus_gbps"] > 0

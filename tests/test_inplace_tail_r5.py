@@ -1,0 +1,272 @@
+"""Behavioral tests for the synthesized in-place variants and the
+resolvability-only _compat_tail names (round-4 verdict #9: convert tail
+names from "it resolves" to oracle-tested).
+
+Reference: the in-place ops are the ``<op>_`` family the reference generates
+per op (inplace entries in paddle/phi/ops/yaml/ops.yaml); _compat_tail
+synthesizes them by functional rebinding (_compat_tail.py:455)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def _t(a, stop_gradient=True):
+    return paddle.to_tensor(np.asarray(a), stop_gradient=stop_gradient)
+
+
+# name -> (input ndarray, extra args, functional oracle on numpy)
+UNARY_CASES = {
+    "sqrt_": (np.array([1.0, 4.0, 9.0], np.float32), (), np.sqrt),
+    "exp_": (np.array([0.0, 1.0, -1.0], np.float32), (), np.exp),
+    "log_": (np.array([1.0, 2.0, 10.0], np.float32), (), np.log),
+    "abs_": (np.array([-2.0, 3.0, -0.5], np.float32), (), np.abs),
+    "floor_": (np.array([1.7, -1.2], np.float32), (), np.floor),
+    "ceil_": (np.array([1.2, -1.7], np.float32), (), np.ceil),
+    "round_": (np.array([1.4, 2.6, -1.5], np.float32), (), np.round),
+    "trunc_": (np.array([1.9, -1.9], np.float32), (), np.trunc),
+    "reciprocal_": (np.array([2.0, 4.0], np.float32), (),
+                    lambda a: 1.0 / a),
+    "rsqrt_": (np.array([4.0, 16.0], np.float32), (),
+               lambda a: 1.0 / np.sqrt(a)),
+    "sigmoid_": (np.array([0.0, 1.0], np.float32), (),
+                 lambda a: 1 / (1 + np.exp(-a))),
+    "tanh_": (np.array([0.0, 0.5], np.float32), (), np.tanh),
+    "sin_": (np.array([0.0, 1.0], np.float32), (), np.sin),
+    "cos_": (np.array([0.0, 1.0], np.float32), (), np.cos),
+    "erf_": (np.array([0.0, 0.8], np.float32), (),
+             lambda a: np.vectorize(math.erf)(a).astype(np.float32)),
+    "erfinv_": (np.array([0.0, 0.5], np.float32), (),
+                lambda a: np.vectorize(
+                    lambda v: _erfinv(v))(a).astype(np.float32)),
+    "expm1_": (np.array([0.0, 0.5], np.float32), (), np.expm1),
+    "log1p_": (np.array([0.0, 0.5], np.float32), (), np.log1p),
+    "square_": (np.array([2.0, -3.0], np.float32), (), np.square),
+    "neg_": (np.array([2.0, -3.0], np.float32), (), np.negative),
+    "frac_": (np.array([1.75, -1.75], np.float32), (),
+              lambda a: a - np.trunc(a)),
+    "scale_": (np.array([1.0, 2.0], np.float32), (3.0,),
+               lambda a: 3.0 * a),
+    "clip_": (np.array([-2.0, 0.5, 2.0], np.float32), (-1.0, 1.0),
+              lambda a: np.clip(a, -1, 1)),
+}
+
+
+def _erfinv(v):
+    # bisection oracle for erfinv (no scipy in the image)
+    lo, hi = -4.0, 4.0
+    for _ in range(60):
+        mid = (lo + hi) / 2
+        if math.erf(mid) < v:
+            lo = mid
+        else:
+            hi = mid
+    return (lo + hi) / 2
+
+
+@pytest.mark.parametrize("name", sorted(UNARY_CASES))
+def test_inplace_unary_mutates_in_place(name):
+    arr, args, oracle = UNARY_CASES[name]
+    x = _t(arr)
+    fn = getattr(paddle, name)
+    out = fn(x, *args)
+    assert out is x, f"{name} must return the SAME tensor object"
+    np.testing.assert_allclose(x.numpy(), oracle(arr), rtol=1e-5, atol=1e-6)
+    # and as a Tensor method
+    x2 = _t(arr)
+    out2 = getattr(x2, name)(*args)
+    assert out2 is x2
+    np.testing.assert_allclose(x2.numpy(), oracle(arr), rtol=1e-5, atol=1e-6)
+
+
+BINARY_CASES = {
+    "add_": (np.array([1.0, 2.0], np.float32),
+             np.array([10.0, 20.0], np.float32), np.add),
+    "subtract_": (np.array([5.0, 7.0], np.float32),
+                  np.array([1.0, 2.0], np.float32), np.subtract),
+    "multiply_": (np.array([2.0, 3.0], np.float32),
+                  np.array([4.0, 5.0], np.float32), np.multiply),
+    "divide_": (np.array([8.0, 9.0], np.float32),
+                np.array([2.0, 3.0], np.float32), np.divide),
+    "remainder_": (np.array([7.0, 9.0], np.float32),
+                   np.array([4.0, 5.0], np.float32), np.remainder),
+    "pow_": (np.array([2.0, 3.0], np.float32), 2.0,
+             lambda a, b: np.power(a, b)),
+    "copysign_": (np.array([2.0, 3.0], np.float32),
+                  np.array([-1.0, 1.0], np.float32), np.copysign),
+    "hypot_": (np.array([3.0, 5.0], np.float32),
+               np.array([4.0, 12.0], np.float32), np.hypot),
+    "ldexp_": (np.array([1.5, 2.0], np.float32),
+               np.array([2, 3], np.int32),
+               lambda a, b: np.ldexp(a, b)),
+    "lerp_": (np.array([0.0, 10.0], np.float32),
+              (np.array([10.0, 20.0], np.float32), 0.25),
+              lambda a, args: a + 0.25 * (args[0] - a)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(BINARY_CASES))
+def test_inplace_binary_mutates_in_place(name):
+    a, b, oracle = BINARY_CASES[name]
+    x = _t(a)
+    if name == "lerp_":
+        out = getattr(paddle, name)(x, _t(b[0]), b[1])
+        want = oracle(a, b)
+    elif isinstance(b, np.ndarray):
+        out = getattr(paddle, name)(x, _t(b))
+        want = oracle(a, b)
+    else:
+        out = getattr(paddle, name)(x, b)
+        want = oracle(a, b)
+    assert out is x
+    np.testing.assert_allclose(x.numpy(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_inplace_shape_ops():
+    x = _t(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert x.transpose_([1, 0]) is x and tuple(x.shape) == (3, 2)
+    x.t_()
+    assert tuple(x.shape) == (2, 3)
+    x.unsqueeze_(0)
+    assert tuple(x.shape) == (1, 2, 3)
+    x.squeeze_(0)
+    assert tuple(x.shape) == (2, 3)
+    x.flatten_()
+    assert tuple(x.shape) == (6,)
+    y = _t(np.ones((3, 3), np.float32))
+    y.triu_()
+    np.testing.assert_allclose(y.numpy(), np.triu(np.ones((3, 3))))
+    y.tril_()  # triu then tril leaves the diagonal
+    np.testing.assert_allclose(y.numpy(), np.eye(3))
+
+
+def test_inplace_masked_and_index():
+    x = _t(np.zeros((4,), np.float32))
+    x.masked_fill_(_t(np.array([True, False, True, False])), 7.0)
+    np.testing.assert_allclose(x.numpy(), [7, 0, 7, 0])
+    x2 = _t(np.zeros((3,), np.float32))
+    x2.index_fill_(_t(np.array([0, 2])), 0, 5.0)
+    np.testing.assert_allclose(x2.numpy(), [5, 0, 5])
+
+
+def test_inplace_grad_rebinds_autograd():
+    """The in-place result must carry the autograd node of the functional
+    op — backward through a mutated tensor reaches the original leaf."""
+    x = _t(np.array([4.0, 9.0], np.float32), stop_gradient=False)
+    y = x * 2.0
+    y.sqrt_()
+    loss = y.sum()
+    loss.backward()
+    # d/dx sum(sqrt(2x)) = 1/sqrt(2x)
+    np.testing.assert_allclose(x.grad.numpy(), 1.0 / np.sqrt(2 * np.array([4.0, 9.0])),
+                               rtol=1e-5)
+
+
+def test_random_inplace_draws_and_severs():
+    paddle.seed(1234)
+    x = _t(np.zeros((64,), np.float32), stop_gradient=False)
+    y = (x + 1.0)
+    y.normal_(mean=2.0, std=0.5)
+    assert y.is_leaf  # severed: fresh draw is independent of the old graph
+    v = y.numpy()
+    assert abs(v.mean() - 2.0) < 0.3 and 0.2 < v.std() < 0.9
+    b = _t(np.zeros((128,), np.float32))
+    b.bernoulli_(p=0.25)
+    bv = b.numpy()
+    assert set(np.unique(bv)).issubset({0.0, 1.0})
+    assert 0.05 < bv.mean() < 0.5
+    u = _t(np.zeros((128,), np.float32))
+    u.uniform_(min=1.0, max=3.0)
+    uv = u.numpy()
+    assert uv.min() >= 1.0 and uv.max() <= 3.0
+    # determinism given the seed
+    paddle.seed(77)
+    a1 = _t(np.zeros((8,), np.float32)); a1.normal_()
+    paddle.seed(77)
+    a2 = _t(np.zeros((8,), np.float32)); a2.normal_()
+    np.testing.assert_array_equal(a1.numpy(), a2.numpy())
+
+
+# ---------------- resolvability-only names -> oracles ----------------
+
+def test_signbit_oracle():
+    a = np.array([-1.0, 0.0, 2.0, -0.0], np.float32)
+    np.testing.assert_array_equal(paddle.signbit(_t(a)).numpy(),
+                                  np.signbit(a))
+
+
+def test_histogram_bin_edges_oracle():
+    a = np.array([0.0, 1.0, 2.0, 3.0, 4.0], np.float32)
+    got = paddle.histogram_bin_edges(_t(a), bins=4, min=0, max=4).numpy()
+    np.testing.assert_allclose(got, np.histogram_bin_edges(a, 4, (0, 4)),
+                               rtol=1e-6)
+
+
+def test_multigammaln_oracle():
+    from math import lgamma, pi
+
+    x = np.array([3.0, 4.5], np.float32)
+    p = 2
+
+    def oracle(v):
+        return (p * (p - 1) / 4.0) * math.log(pi) + sum(
+            lgamma(v - j / 2.0) for j in range(p))
+
+    got = paddle.multigammaln(_t(x), p).numpy()
+    np.testing.assert_allclose(got, [oracle(v) for v in x], rtol=1e-5)
+
+
+def test_polygamma_oracle():
+    # polygamma(1, x) = trigamma; numeric oracle via central difference of
+    # digamma (itself pinned against the harmonic-series identity)
+    x = np.array([2.0, 3.5], np.float32)
+    eps = 1e-3
+    dig = lambda v: float(paddle.digamma(_t(np.float32(v))).numpy())
+    num = [(dig(v + eps) - dig(v - eps)) / (2 * eps) for v in x]
+    got = paddle.polygamma(_t(x), 1).numpy()
+    np.testing.assert_allclose(got, num, rtol=1e-2)
+    # n=0 is digamma exactly
+    np.testing.assert_allclose(paddle.polygamma(_t(x), 0).numpy(),
+                               paddle.digamma(_t(x)).numpy(), rtol=1e-6)
+
+
+def test_bessel_known_values():
+    # mpmath-derived constants: i0e(1), i1(1), i1e(1)
+    one = _t(np.array([1.0], np.float32))
+    np.testing.assert_allclose(paddle.i0e(one).numpy(), [0.46575961],
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.i1(one).numpy(), [0.56515910],
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.i1e(one).numpy(), [0.20791042],
+                               rtol=1e-5)
+
+
+def test_view_reinterprets_shape_and_dtype():
+    x = _t(np.arange(8, dtype=np.float32))
+    v = paddle.view(x, [2, 4])
+    assert tuple(v.shape) == (2, 4)
+    vd = paddle.view(x, "int32")  # dtype reinterpret, same bytes
+    assert vd.numpy().dtype == np.int32
+    np.testing.assert_array_equal(
+        vd.numpy(), np.arange(8, dtype=np.float32).view(np.int32))
+    other = _t(np.zeros((4, 2), np.float32))
+    assert tuple(paddle.view_as(x, other).shape) == (4, 2)
+
+
+def test_top_p_sampling_stays_in_nucleus():
+    # token 3 holds ~all the mass: with small p only it can be drawn
+    probs = np.full((2, 8), 1e-6, np.float32)
+    probs[:, 3] = 1.0
+    probs /= probs.sum(-1, keepdims=True)
+    ps = np.array([0.5, 0.5], np.float32)
+    out, ids = paddle.top_p_sampling(_t(probs), _t(ps), seed=7)
+    assert ids.numpy().ravel().tolist() == [3, 3]

@@ -1,0 +1,153 @@
+"""sep-composed pipeline schedules (round-4 verdict #3).
+
+The reference's 1F1B runtime composes with every topology axis — sep is just
+another comm group to its P2P schedule (pipeline_parallel.py:684, sep axis
+topology.py:77).  Here the executed-1F1B runner binds 'sep' manually in the
+same shard_map (seq-sharded microbatches + ring attention inside stage_fn)
+and these tests pin loss AND grad parity against the single-device oracle.
+
+Also pins the collective-uniformity regression: CollectivePermute lowers with
+every device as a participant, so ring-attention collectives must execute on
+EVERY pipeline tick (validity selects results, not execution) — skipping them
+on bubble ticks silently corrupted the pp×sep gpipe region (fixed round 5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import llama
+
+rng = np.random.RandomState(7)
+
+
+def _setup(layers=2, seq=256, batch=4):
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=layers,
+                                 heads=4, kv_heads=2, inter=128)
+    cfg.dtype = jnp.float32  # exact parity
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    lbl = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    return cfg, params, ids, lbl
+
+
+def _ref(cfg, params, ids, lbl):
+    return jax.jit(jax.value_and_grad(
+        lambda p: llama.loss_fn(cfg, p, ids, lbl)))(params)
+
+
+def _assert_grads_match(grads, grads_ref, rtol=1e-4, atol=1e-6):
+    flat, _ = jax.tree_util.tree_flatten_with_path(grads)
+    rflat = dict(jax.tree_util.tree_flatten_with_path(grads_ref)[0])
+    for path, g in flat:
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(rflat[path], np.float32),
+            rtol=rtol, atol=atol, err_msg=str(path))
+
+
+@pytest.mark.parametrize("meshkw", [
+    dict(pp=2, sep=2),
+    dict(dp=2, pp=2, sep=2),
+    dict(pp=2, sep=2, sharding=2),  # sep composed with ZeRO gathers
+])
+def test_sep_1f1b_loss_and_grad_parity(meshkw, eight_devices):
+    cfg, params, ids, lbl = _setup()
+    loss_ref, grads_ref = _ref(cfg, params, ids, lbl)
+    mesh = llama.make_mesh(**meshkw)
+    loss, grads = jax.jit(lambda p, i, l: llama.loss_and_grads_1f1b(
+        cfg, p, i, l, mesh, num_microbatches=2))(params, ids, lbl)
+    np.testing.assert_allclose(float(loss), float(loss_ref), rtol=1e-5)
+    _assert_grads_match(grads, grads_ref)
+
+
+def test_sep_vpp_loss_parity(eight_devices):
+    """Interleaved/VPP (num_chunks=2) under sep: same uniform-collective
+    tick, chunked stages."""
+    cfg, params, ids, lbl = _setup(layers=4)
+    loss_ref, grads_ref = _ref(cfg, params, ids, lbl)
+    mesh = llama.make_mesh(pp=2, sep=2)
+    loss, grads = jax.jit(lambda p, i, l: llama.loss_and_grads_1f1b(
+        cfg, p, i, l, mesh, num_microbatches=2, num_chunks=2))(
+        params, ids, lbl)
+    np.testing.assert_allclose(float(loss), float(loss_ref), rtol=1e-5)
+    _assert_grads_match(grads, grads_ref)
+
+
+def test_zb_under_sep_raises(eight_devices):
+    cfg, params, ids, lbl = _setup()
+    mesh = llama.make_mesh(pp=2, sep=2)
+    with pytest.raises(AssertionError, match="seq_axis"):
+        jax.jit(lambda p, i, l: llama.loss_and_grads_1f1b(
+            cfg, p, i, l, mesh, num_microbatches=4, zero_bubble=True))(
+            params, ids, lbl)
+
+
+def test_gpipe_sep_forward_parity(eight_devices):
+    """REGRESSION (round-5 find): forward_pp under pp×sep must equal the
+    single-device forward exactly.  Before the collective-uniform tick, the
+    bubble-skipping cond desynchronized ring attention's ppermute rendezvous
+    across pp ranks and ~99% of hidden states were corrupt — while the loss
+    still looked 'finite and sane' (ln(vocab) at init), which is why a
+    finiteness check never caught it."""
+    cfg, params, ids, _ = _setup()
+    h_ref = jax.jit(lambda p: llama.forward(
+        cfg, p, ids, return_hidden=True))(params)
+    mesh = llama.make_mesh(pp=2, sep=2)
+    h = jax.jit(lambda p: llama.forward_pp(
+        cfg, p, ids, mesh, 2, return_hidden=True))(params)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(h_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sep_1f1b_ulysses_parity(eight_devices):
+    """The Ulysses (all-to-all) sep implementation through the same runner."""
+    cfg, params, ids, lbl = _setup()
+    loss_ref, _ = _ref(cfg, params, ids, lbl)
+    mesh = llama.make_mesh(pp=2, sep=2)
+    loss, _ = jax.jit(lambda p, i, l: llama.loss_and_grads_1f1b(
+        cfg, p, i, l, mesh, num_microbatches=2,
+        sep_attn_impl="ulysses"))(params, ids, lbl)
+    np.testing.assert_allclose(float(loss), float(loss_ref), rtol=1e-5)
+
+
+def test_four_axis_mesh_16dev_subprocess():
+    """dp2×pp2×sharding2×mp2 — four nontrivial axes composing (round-4
+    verdict #6).  Needs 16 virtual devices, so it runs in a subprocess with
+    its own XLA_FLAGS (the session backend is pinned to 8)."""
+    import subprocess
+    import sys
+
+    prog = r"""
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+import jax.numpy as jnp, numpy as np
+from paddle_tpu.models import llama
+cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                             kv_heads=2, inter=128)
+cfg.dtype = jnp.float32
+params = llama.init_params(cfg, jax.random.key(0))
+rs = np.random.RandomState(0)
+ids = jnp.asarray(rs.randint(0, 128, (8, 128)))
+lbl = jnp.asarray(rs.randint(0, 128, (8, 128)))
+ref = float(jax.jit(lambda p: llama.loss_fn(cfg, p, ids, lbl))(params))
+mesh = llama.make_mesh(dp=2, pp=2, sharding=2, mp=2)
+step_fn, opt_init, psh, dsh = llama.build_train_step(cfg, mesh)
+params = jax.device_put(params, psh)
+opt_state = opt_init(params)
+ids = jax.device_put(ids, dsh); lbl = jax.device_put(lbl, dsh)
+loss, params, opt_state = step_fn(params, opt_state, ids, lbl)
+assert abs(float(loss) - ref) < 1e-3, (float(loss), ref)
+print("4AXIS_OK", float(loss))
+"""
+    out = subprocess.run([sys.executable, "-c", prog], capture_output=True,
+                         text=True, timeout=900,
+                         env={**__import__("os").environ,
+                              "XLA_FLAGS": "--xla_force_host_platform_device_count=16"})
+    assert "4AXIS_OK" in out.stdout, out.stdout[-2000:] + out.stderr[-4000:]

@@ -23,6 +23,11 @@ TARGETS = {
     "moe": "moe_train_mfu_single_chip/",
     "vision": "resnet_train_images_per_sec/",
     "dit": "dit_train_images_per_sec/",
+    # round-5 evidence rungs (verdict #1/#4): exact cache keys
+    "moe_bigtok": "moe_train_mfu_single_chip/full_e16_bigtok",
+    "moe_dense_equiv": "moe_dense_equiv_mfu/",
+    "cb_paged": "llama_cb_decode_tokens_per_sec/cb_full_chunk8_paged",
+    "cb_3b_int4": "llama_cb_decode_tokens_per_sec/cb_3b_chunk8_int4",
 }
 
 
