@@ -718,8 +718,13 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
             fi_c = jnp.clip(fi, 0, total_f - 1)
             fm, fc = _f_to_mc(fi_c)
             ids_f = jax.lax.dynamic_index_in_dim(mb_in, fm, 0, keepdims=False)
-            x_emb = embed_fn(embed_p, ids_f, *extras).astype(act_dtype)
-            x_in = jnp.where(is_first & (fc == 0), x_emb, recv_f)
+            # embed is collective-free (its ZeRO gathers use subgroup
+            # lowering, safe under per-rank-constant predicates) — only the
+            # STAGE compute below must run unconditionally
+            x_in = jax.lax.cond(
+                is_first & (fc == 0),
+                lambda: embed_fn(embed_p, ids_f, *extras).astype(act_dtype),
+                lambda: recv_f)
             slot_f = fi_c % R
             old_f = jax.lax.dynamic_index_in_dim(ring, slot_f, 0,
                                                  keepdims=False)
@@ -742,27 +747,44 @@ def one_f_one_b_stacked(embed_fn, stage_fn, head_loss_fn,
             ids_b = jax.lax.dynamic_index_in_dim(mb_in, bm, 0, keepdims=False)
             is_head = is_last & (bc == C - 1)
             is_emb = is_first & (bc == 0)
-            # stage fwd+bwd as ONE uniform vjp; role differences (head loss
-            # grad, embed vjp) are local-only and resolved by select
+            inv_m = 1.0 / M_f
+            # stage fwd+bwd as ONE uniform vjp — this is the ONLY part that
+            # carries sep collectives and must execute on every rank every
+            # tick; the role work (head loss grad, embed vjp) is
+            # collective-free and runs under cond like the non-uniform tick
             y_b, vjp_fn = jax.vjp(
                 lambda sp, x: call_stage(sp, x, bc), stacked_p, x_saved)
-            lval_h, (g_hp_h, dy_h) = jax.value_and_grad(
-                lambda hp, y_: head_loss_fn(hp, y_, lbl, *extras),
-                argnums=(0, 1))(head_p, y_b)
-            inv_m = 1.0 / M_f
-            dy = jnp.where(is_head, (dy_h * inv_m).astype(act_dtype), recv_b)
+
+            def head_work():
+                lval, (g_hp, dy_h) = jax.value_and_grad(
+                    lambda hp, y_: head_loss_fn(hp, y_, lbl, *extras),
+                    argnums=(0, 1))(head_p, y_b)
+                scaled = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32) * inv_m, g_hp)
+                return (lval.astype(jnp.float32) * inv_m, scaled,
+                        (dy_h * inv_m).astype(act_dtype))
+
+            def no_head():
+                return jnp.float32(0), f32_zeros(head_p), recv_b
+
+            lval_h, g_hp32, dy = jax.lax.cond(
+                b_valid & is_head, head_work, no_head)
             g_sp, g_x = vjp_fn(dy)
-            _, evjp = jax.vjp(
-                lambda ep: embed_fn(ep, ids_b, *extras).astype(act_dtype),
-                embed_p)
-            (g_ep_e,) = evjp(g_x)
-            sel = lambda c, s_, t: jax.tree_util.tree_map(
-                lambda g: jnp.where(c, g.astype(jnp.float32) * s_, 0.0), t)
-            dep = tree_add(dep, sel(b_valid & is_emb, 1.0, g_ep_e))
-            dsp = tree_add(dsp, sel(b_valid, 1.0, g_sp))
-            dhp = tree_add(dhp, sel(b_valid & is_head, inv_m, g_hp_h))
-            loss_acc = loss_acc + jnp.where(
-                b_valid & is_head, lval_h.astype(jnp.float32) * inv_m, 0.0)
+
+            def emb_work():
+                _, evjp = jax.vjp(
+                    lambda ep: embed_fn(ep, ids_b, *extras).astype(act_dtype),
+                    embed_p)
+                (g_ep_e,) = evjp(g_x)
+                return f32_tree(g_ep_e)
+
+            dep = tree_add(dep, jax.lax.cond(
+                b_valid & is_emb, emb_work, lambda: f32_zeros(embed_p)))
+            dsp = tree_add(dsp, jax.tree_util.tree_map(
+                lambda g: jnp.where(b_valid, g.astype(jnp.float32), 0.0),
+                g_sp))
+            dhp = tree_add(dhp, g_hp32)
+            loss_acc = loss_acc + lval_h
             dx = jnp.where(b_valid & ~is_emb, g_x,
                            jnp.zeros(act_shape, act_dtype))
 

@@ -639,18 +639,47 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     return _adaptive_pool(x, output_size, 3, data_format, "avg", "adaptive_avg_pool3d")
 
 
+def _adaptive_max_mask(x, output_size, ndim, name):
+    """Adaptive max pool returning (out, mask): mask is each max's flat
+    index in the input's spatial dims (the reference return_mask contract;
+    feeds max_unpool).  Variable adaptive windows ride the same
+    _windowed_argmax as the strided pools, padded to the widest window."""
+    out_sz = _pair(output_size, ndim)
+
+    def fn(v):
+        S = v.shape[2:]
+        pos, valid = [], []
+        for i in range(ndim):
+            in_s, o = S[i], out_sz[i]
+            starts = (np.arange(o) * in_s) // o
+            ends = ((np.arange(o) + 1) * in_s + o - 1) // o
+            kmax = int((ends - starts).max())
+            p = starts[:, None] + np.arange(kmax)[None, :]
+            valid.append(p < ends[:, None])
+            pos.append(np.clip(p, 0, in_s - 1))
+        return _windowed_argmax(v, pos, valid)
+
+    return apply_op(name, fn, [x], n_outputs=2)
+
+
 @_export
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_mask(x, output_size, 1, "adaptive_max_pool1d")
     return _adaptive_pool(x, output_size, 1, "NCW", "max", "adaptive_max_pool1d")
 
 
 @_export
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_mask(x, output_size, 2, "adaptive_max_pool2d")
     return _adaptive_pool(x, output_size, 2, "NCHW", "max", "adaptive_max_pool2d")
 
 
 @_export
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_mask(x, output_size, 3, "adaptive_max_pool3d")
     return _adaptive_pool(x, output_size, 3, "NCDHW", "max", "adaptive_max_pool3d")
 
 

@@ -342,6 +342,16 @@ def cummin(x, axis=None, dtype="int64", name=None):
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
     xv = np.asarray(_unwrap(x))
     wv = np.asarray(_unwrap(weights)) if weights is not None else None
+    if ranges is not None:
+        # reference contract (linalg.py histogramdd): ranges is a FLAT
+        # sequence of 2*D floats [min1, max1, min2, max2, ...]
+        flat = [float(r) for r in ranges]
+        if len(flat) != 2 * xv.shape[-1]:
+            raise ValueError(
+                f"histogramdd: ranges must hold 2*D floats "
+                f"(D={xv.shape[-1]}), got {len(flat)}")
+        ranges = [(flat[2 * i], flat[2 * i + 1])
+                  for i in range(xv.shape[-1])]
     hist, edges = np.histogramdd(xv, bins=bins, range=ranges, density=density,
                                  weights=wv)
     return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]

@@ -75,10 +75,6 @@ class MaxPool3D(Layer):
     __call__ = forward
 
 
-def _triple(v):
-    return tuple(v) if isinstance(v, (tuple, list)) else (v,) * 3
-
-
 class _ConvBase(Layer):
     _ndim = 3
 

@@ -41,26 +41,9 @@ def _triple(v):
 
 
 def _coords_vals(x):
-    """Normalize a SparseCooTensor carrying an NDHWC image to
-    (coords [nnz, 4] over (n, d, h, w), vals [nnz, C]).  Accepts either the
-    channels-dense layout (indices over 4 dims, values [nnz, C]) or a fully
-    sparse 5-dim COO (regrouped host-side)."""
-    b = x._bcoo
-    if b.indices.shape[1] == 4 and b.data.ndim == 2:
-        return jnp.asarray(b.indices), jnp.asarray(b.data)
-    if b.indices.shape[1] == 5:
-        # regroup (n,d,h,w,c) scalar entries into channel rows (host-side;
-        # creation-time normalization, not a hot path)
-        idx = np.asarray(b.indices)
-        dat = np.asarray(b.data)
-        C = x.shape[4]
-        spatial, inv = np.unique(idx[:, :4], axis=0, return_inverse=True)
-        vals = np.zeros((len(spatial), C), dat.dtype)
-        np.add.at(vals, (inv, idx[:, 4]), dat)
-        return jnp.asarray(spatial), jnp.asarray(vals)
-    raise ValueError(
-        f"expected NDHWC sparse input (4-dim indices + channel values or "
-        f"5-dim COO); got indices {b.indices.shape}, values {b.data.shape}")
+    """NDHWC normalization: (coords [nnz, 4] over (n, d, h, w),
+    vals [nnz, C])."""
+    return _coords_vals_nd(x, 4)
 
 
 def _out_dim(size, k, stride, pad, dil):
@@ -301,39 +284,37 @@ def _pair(v):
     return tuple(v) if isinstance(v, (tuple, list)) else (v,) * 2
 
 
+def _strip_depth(out3):
+    """Drop the singleton depth axis the 2-D convs added for the 3-D
+    engine: NDHWC output (D=1) -> NHWC."""
+    from .... import sparse as sp
+
+    b3 = out3._bcoo
+    idx = jnp.concatenate([b3.indices[:, :1], b3.indices[:, 2:]], axis=1)
+    N, _, Ho, Wo, M_ = out3.shape
+    return sp.SparseCooTensor(jsparse.BCOO((b3.data, idx),
+                                           shape=(N, Ho, Wo, M_)))
+
+
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NHWC", name=None):
     """Sparse 2-D conv (reference sparse Conv2D, conv.py): NHWC input,
     HWCM kernel — runs through the 3-D engine with a singleton depth."""
-    from .... import sparse as sp
-
     assert data_format == "NHWC", data_format
     w = jnp.asarray(getattr(weight, "_value", weight))
-    kH, kW, Cin, M = w.shape
     st, pd, dl = _pair(stride), _pair(padding), _pair(dilation)
-    out3 = conv3d(_as_3d(x), w[None], bias, (1,) + st, (0,) + pd,
-                  (1,) + dl, groups, "NDHWC")
-    b3 = out3._bcoo
-    idx = jnp.concatenate([b3.indices[:, :1], b3.indices[:, 2:]], axis=1)
-    N, _, Ho, Wo, M_ = out3.shape
-    return sp.SparseCooTensor(jsparse.BCOO((b3.data, idx),
-                                           shape=(N, Ho, Wo, M_)))
+    return _strip_depth(conv3d(_as_3d(x), w[None], bias, (1,) + st,
+                               (0,) + pd, (1,) + dl, groups, "NDHWC"))
 
 
 def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
                 groups=1, data_format="NHWC", key=None, name=None):
-    from .... import sparse as sp
-
     assert data_format == "NHWC", data_format
     w = jnp.asarray(getattr(weight, "_value", weight))
     st, pd, dl = _pair(stride), _pair(padding), _pair(dilation)
-    out3 = subm_conv3d(_as_3d(x), w[None], bias, (1,) + st, (0,) + pd,
-                       (1,) + dl, groups, "NDHWC", key=key)
-    b3 = out3._bcoo
-    idx = jnp.concatenate([b3.indices[:, :1], b3.indices[:, 2:]], axis=1)
-    N, _, Ho, Wo, M_ = out3.shape
-    return sp.SparseCooTensor(jsparse.BCOO((b3.data, idx),
-                                           shape=(N, Ho, Wo, M_)))
+    return _strip_depth(subm_conv3d(_as_3d(x), w[None], bias, (1,) + st,
+                                    (0,) + pd, (1,) + dl, groups, "NDHWC",
+                                    key=key))
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, data_format="NDHWC",

@@ -1,0 +1,319 @@
+"""Numpy-oracle tests for nn.functional names that previously had no
+behavioral test (round-4 verdict #9 "keep converting"): activations,
+losses, pooling, conv variants, attention, resampling.
+
+Reference semantics: python/paddle/nn/functional/{activation,loss,pooling,
+conv,common,vision}.py."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+X = np.array([-2.0, -0.5, 0.0, 0.7, 3.0], np.float32)
+
+ACT_CASES = {
+    "leaky_relu": ((0.1,), lambda a: np.where(a >= 0, a, 0.1 * a)),
+    "elu": ((1.0,), lambda a: np.where(a > 0, a, np.expm1(a))),
+    "celu": ((1.5,), lambda a: np.maximum(a, 0)
+             + np.minimum(0, 1.5 * np.expm1(a / 1.5))),
+    "selu": ((), lambda a: 1.0507009873554805 * np.where(
+        a > 0, a, 1.6732632423543772 * np.expm1(a))),
+    "softplus": ((), lambda a: np.log1p(np.exp(-np.abs(a)))
+                 + np.maximum(a, 0)),
+    "softshrink": ((0.5,), lambda a: np.where(
+        a > 0.5, a - 0.5, np.where(a < -0.5, a + 0.5, 0.0))),
+    "hardshrink": ((0.5,), lambda a: np.where(np.abs(a) > 0.5, a, 0.0)),
+    "hardtanh": ((-1.0, 1.0), lambda a: np.clip(a, -1, 1)),
+    "thresholded_relu": ((1.0,), lambda a: np.where(a > 1.0, a, 0.0)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(ACT_CASES))
+def test_activation_oracles(name):
+    args, oracle = ACT_CASES[name]
+    got = getattr(F, name)(_t(X), *args).numpy()
+    np.testing.assert_allclose(got, oracle(X).astype(np.float32),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_glu_and_maxout():
+    a = np.arange(8, dtype=np.float32).reshape(2, 4)
+    got = F.glu(_t(a), axis=-1).numpy()
+    half, gate = a[:, :2], a[:, 2:]
+    np.testing.assert_allclose(got, half / (1 + np.exp(-gate)), rtol=1e-5)
+    # maxout: groups of channels reduced by max (NCHW, axis 1)
+    m = np.arange(16, dtype=np.float32).reshape(1, 4, 2, 2)
+    got = F.maxout(_t(m), groups=2, axis=1).numpy()
+    np.testing.assert_allclose(got, m.reshape(1, 2, 2, 2, 2).max(2))
+
+
+def test_gumbel_softmax_properties():
+    paddle.seed(3)
+    logits = _t(np.array([[2.0, 1.0, 0.1]], np.float32))
+    soft = F.gumbel_softmax(logits, temperature=0.5).numpy()
+    np.testing.assert_allclose(soft.sum(-1), 1.0, rtol=1e-5)
+    hard = F.gumbel_softmax(logits, temperature=0.5, hard=True).numpy()
+    assert set(np.unique(hard)).issubset({0.0, 1.0}) and hard.sum() == 1.0
+
+
+def test_temperature_scaled_softmax_and_label_smooth():
+    lg = np.array([[1.0, 2.0, 3.0]], np.float32)
+    got = F.temperature_scaled_softmax(_t(lg), temperature=2.0).numpy()
+    e = np.exp(lg / 2.0 - (lg / 2.0).max())
+    np.testing.assert_allclose(got, e / e.sum(), rtol=1e-5)
+    oh = np.array([[0.0, 1.0, 0.0]], np.float32)
+    sm = F.label_smooth(_t(oh), epsilon=0.1).numpy()
+    np.testing.assert_allclose(sm, 0.9 * oh + 0.1 / 3, rtol=1e-5)
+
+
+LOSS_X = np.array([[0.2, 0.8], [0.6, 0.4]], np.float32)
+LOSS_Y = np.array([[0.0, 1.0], [1.0, 0.0]], np.float32)
+
+
+def test_elementwise_losses():
+    np.testing.assert_allclose(
+        F.mse_loss(_t(LOSS_X), _t(LOSS_Y)).numpy(),
+        np.mean((LOSS_X - LOSS_Y) ** 2), rtol=1e-5)
+    np.testing.assert_allclose(
+        F.l1_loss(_t(LOSS_X), _t(LOSS_Y)).numpy(),
+        np.mean(np.abs(LOSS_X - LOSS_Y)), rtol=1e-5)
+    np.testing.assert_allclose(
+        F.square_error_cost(_t(LOSS_X), _t(LOSS_Y)).numpy(),
+        (LOSS_X - LOSS_Y) ** 2, rtol=1e-5)
+    d = LOSS_X - LOSS_Y
+    sl1 = np.where(np.abs(d) < 1.0, 0.5 * d * d, np.abs(d) - 0.5)
+    np.testing.assert_allclose(
+        F.smooth_l1_loss(_t(LOSS_X), _t(LOSS_Y)).numpy(), sl1.mean(),
+        rtol=1e-5)
+
+
+def test_bce_and_kl():
+    p = np.array([0.3, 0.7], np.float32)
+    y = np.array([0.0, 1.0], np.float32)
+    bce = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+    np.testing.assert_allclose(F.binary_cross_entropy(_t(p), _t(y)).numpy(),
+                               bce.mean(), rtol=1e-5)
+    lg = np.array([0.5, -0.5], np.float32)
+    sig = 1 / (1 + np.exp(-lg))
+    bcel = -(y * np.log(sig) + (1 - y) * np.log(1 - sig))
+    np.testing.assert_allclose(
+        F.binary_cross_entropy_with_logits(_t(lg), _t(y)).numpy(),
+        bcel.mean(), rtol=1e-5)
+    # kl_div(input=log q, label=p) = sum p (log p - log q) / batch (mean)
+    logq = np.log(np.array([[0.4, 0.6]], np.float32))
+    pref = np.array([[0.5, 0.5]], np.float32)
+    kl = (pref * (np.log(pref) - logq))
+    np.testing.assert_allclose(F.kl_div(_t(logq), _t(pref)).numpy(),
+                               kl.mean(), rtol=1e-5)
+
+
+def test_nll_and_softmax_xent():
+    logp = np.log(np.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]], np.float32))
+    lbl = np.array([0, 1])
+    np.testing.assert_allclose(
+        F.nll_loss(_t(logp), _t(lbl)).numpy(),
+        -(logp[0, 0] + logp[1, 1]) / 2, rtol=1e-5)
+    lg = np.array([[2.0, 1.0, 0.1]], np.float32)
+    out = F.softmax_with_cross_entropy(_t(lg), _t(np.array([[0]])))
+    sm = np.exp(lg - lg.max())
+    sm /= sm.sum()
+    np.testing.assert_allclose(np.asarray(out.numpy()).ravel()[0],
+                               -np.log(sm[0, 0]), rtol=1e-5)
+
+
+def test_ranking_losses():
+    a = np.array([0.5, 0.9], np.float32)
+    b = np.array([0.7, 0.2], np.float32)
+    lab = np.array([1.0, -1.0], np.float32)
+    mr = np.maximum(0, -lab * (a - b) + 0.0)
+    np.testing.assert_allclose(
+        F.margin_ranking_loss(_t(a), _t(b), _t(lab)).numpy(), mr.mean(),
+        rtol=1e-5)
+    x = np.array([0.3, 1.5], np.float32)
+    y = np.array([1.0, -1.0], np.float32)
+    he = np.where(y == 1, x, np.maximum(0, 1.0 - x))
+    np.testing.assert_allclose(
+        F.hinge_embedding_loss(_t(x), _t(y)).numpy(), he.mean(), rtol=1e-5)
+
+
+def test_cosine_similarity():
+    a = np.array([[1.0, 0.0], [1.0, 1.0]], np.float32)
+    b = np.array([[0.0, 1.0], [1.0, 1.0]], np.float32)
+    got = F.cosine_similarity(_t(a), _t(b), axis=1).numpy()
+    np.testing.assert_allclose(got, [0.0, 1.0], rtol=1e-5, atol=1e-6)
+
+
+def test_sigmoid_focal_loss():
+    lg = np.array([[0.3], [-0.6]], np.float32)
+    y = np.array([[1.0], [0.0]], np.float32)
+    p = 1 / (1 + np.exp(-lg))
+    alpha, gamma = 0.25, 2.0
+    ce = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+    pt = y * p + (1 - y) * (1 - p)
+    w = (y * alpha + (1 - y) * (1 - alpha)) * (1 - pt) ** gamma
+    # reference default normalizer=None, reduction='sum' over weighted ce?
+    got = float(np.asarray(F.sigmoid_focal_loss(_t(lg), _t(y)).numpy()))
+    want = float((w * ce).sum())
+    assert abs(got - want) / max(abs(want), 1e-6) < 1e-4 or \
+        abs(got - float((w * ce).mean())) / max(abs(want), 1e-6) < 1e-4
+
+
+def test_conv1d_conv3d_oracles():
+    x = np.arange(10, dtype=np.float32).reshape(1, 1, 10)  # NCL
+    w = np.array([[[1.0, -1.0, 2.0]]], np.float32)          # [out, in, k]
+    got = F.conv1d(_t(x), _t(w)).numpy()
+    want = np.stack([np.convolve(x[0, 0], w[0, 0][::-1], mode="valid")])
+    np.testing.assert_allclose(got[0], want, rtol=1e-5)
+    x3 = np.random.RandomState(0).randn(1, 1, 4, 4, 4).astype(np.float32)
+    w3 = np.random.RandomState(1).randn(2, 1, 3, 3, 3).astype(np.float32)
+    got3 = F.conv3d(_t(x3), _t(w3)).numpy()  # NCDHW
+    want3 = np.zeros((1, 2, 2, 2, 2), np.float32)
+    for o in range(2):
+        for d in range(2):
+            for h in range(2):
+                for w_ in range(2):
+                    want3[0, o, d, h, w_] = np.sum(
+                        x3[0, 0, d:d + 3, h:h + 3, w_:w_ + 3] * w3[o, 0])
+    np.testing.assert_allclose(got3, want3, rtol=1e-4, atol=1e-5)
+
+
+def test_avg_and_adaptive_pools():
+    x = np.arange(8, dtype=np.float32).reshape(1, 1, 8)
+    np.testing.assert_allclose(
+        F.avg_pool1d(_t(x), kernel_size=2, stride=2).numpy(),
+        x.reshape(1, 1, 4, 2).mean(-1), rtol=1e-6)
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool1d(_t(x), output_size=2).numpy(),
+        x.reshape(1, 1, 2, 4).mean(-1), rtol=1e-6)
+    x2 = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool2d(_t(x2), output_size=2).numpy(),
+        x2.reshape(1, 1, 2, 2, 2, 2).mean((3, 5)), rtol=1e-6)
+    got, idx = (np.asarray(v.numpy()) for v in
+                F.adaptive_max_pool2d(_t(x2), output_size=2,
+                                      return_mask=True))
+    np.testing.assert_allclose(got, x2.reshape(1, 1, 2, 2, 2, 2).max((3, 5)))
+    # mask = flat spatial index of each max in the INPUT (4x4 grid): for
+    # ascending data the window max sits at its bottom-right corner
+    np.testing.assert_array_equal(idx[0, 0], [[5, 7], [13, 15]])
+    # and the mask feeds max_unpool back to the original positions
+    unp = np.asarray(F.max_unpool2d(_t(got), _t(idx), kernel_size=2,
+                                    stride=2).numpy())
+    want = np.zeros_like(x2)
+    want.reshape(1, 1, -1)[0, 0, idx.ravel()] = got.ravel()
+    np.testing.assert_allclose(unp, want)
+    x3 = np.arange(8, dtype=np.float32).reshape(1, 1, 2, 2, 2)
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool3d(_t(x3), output_size=1).numpy(),
+        x3.mean((2, 3, 4), keepdims=True), rtol=1e-6)
+
+
+def test_pixel_shuffle_roundtrip():
+    x = np.arange(16, dtype=np.float32).reshape(1, 4, 2, 2)
+    up = F.pixel_shuffle(_t(x), upscale_factor=2)
+    assert tuple(up.shape) == (1, 1, 4, 4)
+    back = F.pixel_unshuffle(up, downscale_factor=2).numpy()
+    np.testing.assert_allclose(back, x)
+
+
+def test_interpolate_nearest_and_bilinear():
+    x = np.array([[[[0.0, 1.0], [2.0, 3.0]]]], np.float32)
+    nn_up = F.interpolate(_t(x), size=[4, 4], mode="nearest").numpy()
+    np.testing.assert_allclose(nn_up[0, 0, :2, :2],
+                               np.full((2, 2), 0.0))
+    assert nn_up.shape == (1, 1, 4, 4)
+    bi = F.upsample(_t(x), scale_factor=2, mode="bilinear").numpy()
+    assert bi.shape == (1, 1, 4, 4)
+    assert bi.min() >= 0.0 and bi.max() <= 3.0
+
+
+def test_grid_sample_identity():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = np.stack([xs, ys], -1)[None].astype(np.float32)
+    got = F.grid_sample(_t(x), _t(grid), align_corners=True).numpy()
+    np.testing.assert_allclose(got, x, rtol=1e-5, atol=1e-5)
+
+
+def test_scaled_dot_product_attention_oracle():
+    rs = np.random.RandomState(0)
+    q = rs.randn(1, 4, 2, 8).astype(np.float32)  # [b, s, h, d]
+    k = rs.randn(1, 4, 2, 8).astype(np.float32)
+    v = rs.randn(1, 4, 2, 8).astype(np.float32)
+    got = np.asarray(F.scaled_dot_product_attention(
+        _t(q), _t(k), _t(v), is_causal=True).numpy())
+    sc = np.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(8)
+    mask = np.tril(np.ones((4, 4), bool))
+    sc = np.where(mask, sc, -1e30)
+    p = np.exp(sc - sc.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_mask_oracle():
+    got = F.sequence_mask(_t(np.array([1, 3])), maxlen=4).numpy()
+    np.testing.assert_array_equal(
+        got.astype(bool), np.array([[1, 0, 0, 0], [1, 1, 1, 0]], bool))
+
+
+def test_dropout_nd_and_alpha():
+    paddle.seed(5)
+    x = np.ones((2, 3, 4, 4), np.float32)
+    d2 = F.dropout2d(_t(x), p=0.5, training=True).numpy()
+    # entire channels drop together
+    per_chan = d2.reshape(2, 3, -1)
+    for b in range(2):
+        for c in range(3):
+            vals = np.unique(per_chan[b, c])
+            assert len(vals) == 1  # all-zero or all-scaled
+    assert np.allclose(F.dropout2d(_t(x), p=0.5, training=False).numpy(), x)
+    x3 = np.ones((1, 2, 2, 2, 2), np.float32)
+    d3 = F.dropout3d(_t(x3), p=0.5, training=True).numpy()
+    assert d3.shape == x3.shape
+    a = F.alpha_dropout(_t(np.zeros((64,), np.float32)), p=0.3,
+                        training=True).numpy()
+    assert a.shape == (64,)  # alpha dropout keeps mean/var approximately
+    assert abs(a.mean()) < 1.0
+
+
+def test_local_response_norm_oracle():
+    x = np.random.RandomState(0).rand(1, 4, 3, 3).astype(np.float32)
+    got = F.local_response_norm(_t(x), size=3, alpha=1e-4, beta=0.75,
+                                k=1.0).numpy()
+    # oracle: same-window sum of squares over channels
+    pad = np.pad(x ** 2, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    div = np.stack([pad[:, c:c + 3].sum(1) for c in range(4)], 1)
+    want = x / (1.0 + (1e-4 / 3) * div) ** 0.75
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_npair_and_triplet_with_distance():
+    rs = np.random.RandomState(2)
+    anchor = rs.randn(3, 4).astype(np.float32)
+    pos = rs.randn(3, 4).astype(np.float32)
+    neg = rs.randn(3, 4).astype(np.float32)
+    out = float(np.asarray(F.triplet_margin_with_distance_loss(
+        _t(anchor), _t(pos), _t(neg)).numpy()))
+    dp = np.linalg.norm(anchor - pos, axis=1)
+    dn = np.linalg.norm(anchor - neg, axis=1)
+    np.testing.assert_allclose(out, np.maximum(dp - dn + 1.0, 0).mean(),
+                               rtol=1e-4)
+    lbl = np.array([0, 1, 2])
+    np_loss = F.npair_loss(_t(anchor), _t(pos), _t(lbl))
+    assert np.isfinite(float(np.asarray(np_loss.numpy())))
