@@ -18,9 +18,26 @@ from ..nn.layer_base import Layer
 from .. import signal as _signal
 
 __all__ = [
-    "hz_to_mel", "mel_to_hz", "compute_fbank_matrix", "create_dct",
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "create_dct",
     "get_window", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
 ]
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """n_mels frequencies evenly spaced on the mel scale between f_min and
+    f_max, in Hz (reference audio/functional/functional.py:126)."""
+
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return Tensor(np.asarray(mel_to_hz(mels, htk), dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """FFT bin center frequencies [n_fft//2 + 1] in Hz (reference
+    functional.py:166)."""
+
+    return Tensor(np.linspace(0, sr / 2.0, n_fft // 2 + 1).astype(dtype))
 
 
 def hz_to_mel(freq, htk=False):

@@ -4,13 +4,15 @@ submodule view over the window/filterbank math."""
 from . import (  # noqa: F401
     compute_fbank_matrix,
     create_dct,
+    fft_frequencies,
     get_window,
     hz_to_mel,
+    mel_frequencies,
     mel_to_hz,
 )
 
-__all__ = ["compute_fbank_matrix", "create_dct", "get_window", "hz_to_mel",
-           "mel_to_hz"]
+__all__ = ["compute_fbank_matrix", "create_dct", "fft_frequencies",
+           "get_window", "hz_to_mel", "mel_frequencies", "mel_to_hz"]
 
 
 def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):

@@ -119,26 +119,137 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     return apply_op("send_uv", fn, [x, y, src_index, dst_index])
 
 
-def reindex_graph(x, neighbors, count, name=None):
+def _compact_ids(xv, neighbor_arrays):
+    """First-appearance id compaction shared by reindex_graph and
+    reindex_heter_graph: x's nodes keep their order (0..len(x)-1), new
+    neighbor ids append in first-appearance order (the reference contract:
+    x=[0,1,2], neighbors=[8,9,0,4,7,6,7] -> out_nodes=[0,1,2,8,9,4,7,6])."""
+    import numpy as np
+
+    seen = set(int(v) for v in xv)
+    extra = []
+    for nb in neighbor_arrays:
+        for v in nb:
+            if int(v) not in seen:
+                seen.add(int(v))
+                extra.append(v)
+    node_ids = np.concatenate([xv, np.asarray(extra, xv.dtype)]) \
+        if extra else xv.copy()
+    lookup = {int(v): i for i, v in enumerate(node_ids)}
+    return node_ids, lookup
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
     """Compact global ids to local ids (reference
     geometric/reindex.py:reindex_graph). Host-side utility (ragged)."""
     import numpy as np
 
     xv = np.asarray(_unwrap(x))
     nb = np.asarray(_unwrap(neighbors))
-    # local ids: x's nodes keep their order (0..len(x)-1), new neighbor ids
-    # are appended in FIRST-APPEARANCE order (the reference contract:
-    # x=[0,1,2], neighbors=[8,9,0,4,7,6,7] → out_nodes=[0,1,2,8,9,4,7,6])
-    seen = set(int(v) for v in xv)
-    extra = []
-    for v in nb:
-        if int(v) not in seen:
-            seen.add(int(v))
-            extra.append(v)
-    node_ids = np.concatenate([xv, np.asarray(extra, xv.dtype)]) \
-        if extra else xv.copy()
-    lookup = {int(v): i for i, v in enumerate(node_ids)}
+    node_ids, lookup = _compact_ids(xv, [nb])
     reindex_src = np.fromiter((lookup[int(v)] for v in nb), np.int64, len(nb))
     cnt = np.asarray(_unwrap(count))
     reindex_dst = np.repeat(np.arange(len(cnt)), cnt)
     return Tensor(reindex_src), Tensor(reindex_dst), Tensor(node_ids)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Multi-edge-type reindex (reference geometric/reindex.py:153): the
+    neighbor/count pairs of several graphs share ONE id compaction; the
+    per-graph edge lists concatenate after reindexing."""
+    import numpy as np
+
+    xv = np.asarray(_unwrap(x))
+    nbs = [np.asarray(_unwrap(n)) for n in neighbors]
+    cnts = [np.asarray(_unwrap(c)) for c in count]
+    node_ids, lookup = _compact_ids(xv, nbs)
+    srcs, dsts = [], []
+    for nb, cnt in zip(nbs, cnts):
+        srcs.append(np.fromiter((lookup[int(v)] for v in nb), np.int64,
+                                len(nb)))
+        dsts.append(np.repeat(np.arange(len(cnt)), cnt))
+    return (Tensor(np.concatenate(srcs)), Tensor(np.concatenate(dsts)),
+            Tensor(node_ids))
+
+
+def _sample_neighbors_impl(row, colptr, input_nodes, sample_size, eids,
+                           return_eids, pick_fn):
+    """Shared CSC sampling machinery: per-node neighbor slice, eids
+    packing, framework-Generator seeding (paddle.seed reproducible).
+    ``pick_fn(rs, lo, hi)`` returns the chosen row positions for one node."""
+    import numpy as np
+
+    import jax as _jax
+
+    from ..core import rng as _rng
+
+    if return_eids and eids is None:
+        raise ValueError("`eids` should not be None if `return_eids` is True.")
+    rowv = np.asarray(_unwrap(row)).ravel()
+    cp = np.asarray(_unwrap(colptr)).ravel()
+    nodes = np.asarray(_unwrap(input_nodes)).ravel()
+    ev = np.asarray(_unwrap(eids)).ravel() if eids is not None else None
+    seed = int(_jax.random.randint(_rng.next_key(), (), 0, 2**31 - 1))
+    rs = np.random.RandomState(seed)
+    out_nb, out_cnt, out_eids = [], [], []
+    for n in nodes:
+        lo, hi = int(cp[int(n)]), int(cp[int(n) + 1])
+        if sample_size < 0 or hi - lo <= sample_size:
+            pick = np.arange(lo, hi)
+        else:
+            pick = pick_fn(rs, lo, hi)
+        out_nb.append(rowv[pick])
+        out_cnt.append(len(pick))
+        if ev is not None:
+            out_eids.append(ev[pick])
+    nb = (np.concatenate(out_nb) if out_nb else np.empty((0,), rowv.dtype))
+    cnt = np.asarray(out_cnt, np.int32)
+    if return_eids:
+        ee = (np.concatenate(out_eids) if out_eids
+              else np.empty((0,), rowv.dtype))
+        return Tensor(nb), Tensor(cnt), Tensor(ee)
+    return Tensor(nb), Tensor(cnt)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """CSC neighbor sampling (reference geometric/sampling/neighbors.py:68):
+    for each input node, draw up to ``sample_size`` of its in-neighbors
+    uniformly without replacement (all of them when -1).  Host-side utility
+    (ragged outputs)."""
+    import numpy as np
+
+    def pick(rs, lo, hi):
+        return lo + rs.choice(hi - lo, size=sample_size, replace=False)
+
+    return _sample_neighbors_impl(row, colptr, input_nodes, sample_size,
+                                  eids, return_eids, pick)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-biased sampling without replacement (reference
+    neighbors.py:256), A-Res/Gumbel top-k semantics: probability
+    proportional to ``edge_weight``; zero-weight edges sort last but can
+    still fill the sample when positive-weight edges run out (the
+    reference's reservoir behavior — a p= multinomial would crash there)."""
+    import numpy as np
+
+    wv = np.asarray(_unwrap(edge_weight)).ravel().astype(np.float64)
+
+    def pick(rs, lo, hi):
+        w = wv[lo:hi]
+        with np.errstate(divide="ignore"):
+            keys = np.where(w > 0, np.log(np.maximum(w, 1e-300)), -np.inf)
+        keys = keys + rs.gumbel(size=hi - lo)
+        return lo + np.argsort(-keys)[:sample_size]
+
+    return _sample_neighbors_impl(row, colptr, input_nodes, sample_size,
+                                  eids, return_eids, pick)
+
+
+__all__ += ["reindex_heter_graph", "sample_neighbors",
+            "weighted_sample_neighbors"]

@@ -32,7 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import sparse as jsparse
 
-__all__ = ["conv3d", "subm_conv3d", "conv2d", "subm_conv2d", "attention",
+__all__ = ["conv3d", "subm_conv3d", "conv2d", "subm_conv2d",
+           "subm_conv2d_igemm", "subm_conv3d_igemm", "attention",
            "relu", "relu6", "leaky_relu", "softmax", "max_pool3d"]
 
 
@@ -165,6 +166,22 @@ def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
         out_vals = out_vals + jnp.asarray(getattr(bias, "_value", bias))
     bcoo = jsparse.BCOO((out_vals, coords), shape=(N, D, H, W, M))
     return sp.SparseCooTensor(bcoo)
+
+
+def subm_conv2d_igemm(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                      groups=1, data_format="NHWC", key=None, name=None):
+    """Reference's implicit-GEMM kernel variant of subm_conv2d (a CUDA
+    kernel-choice distinction); on TPU the searchsorted-gather + dense GEMM
+    engine IS the implicit-GEMM formulation, so both names share it."""
+    return subm_conv2d(x, weight, bias, stride, padding, dilation, groups,
+                       data_format, key=key)
+
+
+def subm_conv3d_igemm(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                      groups=1, data_format="NDHWC", key=None, name=None):
+    """Implicit-GEMM variant of subm_conv3d (see subm_conv2d_igemm)."""
+    return subm_conv3d(x, weight, bias, stride, padding, dilation, groups,
+                       data_format, key=key)
 
 
 def attention(query, key, value, sparse_mask, key_padding_mask=None,
