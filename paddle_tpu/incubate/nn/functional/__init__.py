@@ -135,13 +135,37 @@ def fused_bias_act(x, bias=None, act_method="gelu", **kw):
 
 def variable_length_memory_efficient_attention(query, key, value, seq_lens=None, kv_seq_lens=None, mask=None, scale=None, causal=False):
     """Reference: python/paddle/incubate/nn/functional/variable_length_memory_efficient_attention.py.
-    Inputs are BHSD here (paddle's var-len op convention)."""
+    Inputs are BHSD here (paddle's var-len op convention).  ``kv_seq_lens``
+    (default ``seq_lens``) masks each batch row's keys past its true length —
+    the variable-length semantics the op exists for."""
+    import math
+
     from ....nn import functional as F
     from ....ops import manipulation as M
 
     q = M.transpose(query, [0, 2, 1, 3])
     k = M.transpose(key, [0, 2, 1, 3])
     v = M.transpose(value, [0, 2, 1, 3])
+    if scale is not None:
+        # sdpa divides by sqrt(d); pre-scale q so the effective scale is ours
+        hd = int(_unwrap(query).shape[-1])
+        q = q * float(scale) * math.sqrt(hd)
+    lens = kv_seq_lens if kv_seq_lens is not None else seq_lens
+    if lens is not None:
+        lv = jnp.asarray(_unwrap(lens)).reshape(-1)          # [B]
+        s_kv = int(_unwrap(key).shape[2])                    # BHSD input
+        keymask = jnp.arange(s_kv)[None, :] < lv[:, None]    # [B, S_kv]
+        km4 = keymask[:, None, None, :]
+        if mask is None:
+            mv = jnp.where(km4, 0.0, -jnp.inf).astype(jnp.float32)
+        else:
+            mv = jnp.asarray(_unwrap(mask))
+            if mv.dtype == jnp.bool_:
+                # bool masks keep True=attend semantics: AND, don't add
+                mv = mv & km4
+            else:
+                mv = (mv + jnp.where(km4, 0.0, -jnp.inf)).astype(jnp.float32)
+        mask = Tensor(mv)
     out = F.scaled_dot_product_attention(q, k, v, attn_mask=mask, is_causal=causal)
     return M.transpose(out, [0, 2, 1, 3])
 
