@@ -151,3 +151,31 @@ print("4AXIS_OK", float(loss))
                          env={**__import__("os").environ,
                               "XLA_FLAGS": "--xla_force_host_platform_device_count=16"})
     assert "4AXIS_OK" in out.stdout, out.stdout[-2000:] + out.stderr[-4000:]
+
+
+def test_sep_1f1b_training_converges(eight_devices):
+    """End-to-end composition: build_train_step on dp2×pp2×sep2 (executed
+    sep-1F1B + AdamW + global-norm clip + sharded data) actually LEARNS — a
+    fixed batch's loss must drop substantially in 12 steps."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                                 kv_heads=2, inter=64)
+    mesh = llama.make_mesh(dp=2, pp=2, sep=2)
+    step_fn, opt_init, psh, dsh = llama.build_train_step(
+        cfg, mesh, lr=3e-3, num_microbatches=2)
+    params = jax.device_put(llama.init_params(cfg, jax.random.key(0)), psh)
+    opt_state = opt_init(params)
+    ids = jax.device_put(
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 128))), dsh)
+    lbl = jax.device_put(
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 128))), dsh)
+    first = None
+    for i in range(12):
+        loss, params, opt_state = step_fn(params, opt_state, ids, lbl)
+        if first is None:
+            first = float(loss)
+    last = float(loss)
+    assert np.isfinite(last)
+    assert last < first - 0.5, (first, last)  # memorizing a fixed batch
