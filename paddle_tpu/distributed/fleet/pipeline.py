@@ -56,6 +56,7 @@ __all__ = [
     "one_f_one_b_stacked",
     "schedule_fthenb",
     "schedule_1f1b",
+    "schedule_eager_1f1b",
     "schedule_interleave",
     "schedule_zero_bubble",
     "format_schedule",
@@ -1060,6 +1061,42 @@ def schedule_interleave(num_stages: int, num_micro: int, num_chunks: int = 2) ->
     return per_stage
 
 
+def schedule_eager_1f1b(num_stages: int, num_micro: int) -> list[list[Tick]]:
+    """Eager-1F1B (pipeline_eager_1f1b.py:36): warmup DEEPENS to
+    2*(P - s) - 1 forwards per stage (vs 1F1B's P - 1 - s) so more
+    microbatches are in flight when the steady phase starts — the reference
+    uses the extra in-flight work to overlap its p2p sends with compute, at
+    the cost of a proportionally larger activation working set.  Requires
+    num_micro >= 2*(P - s) - 1 at every stage, i.e. M >= 2P - 1.
+
+    TPU note: the EXECUTED runner keeps the plain 1F1B clock — inside one
+    jitted SPMD program the comm/compute overlap eager-1F1B buys is already
+    the XLA latency-hiding scheduler's job, so the deeper warmup would only
+    add memory.  This generator exists as the schedule-spec oracle
+    (golden-string parity with the reference pass)."""
+    assert num_micro >= 2 * num_stages - 1, (
+        f"eager-1F1B needs num_micro ({num_micro}) >= 2*stages - 1 "
+        f"({2 * num_stages - 1}) — the reference pass asserts the same "
+        "(pipeline_eager_1f1b.py:42); fewer microbatches would silently "
+        "degrade to FThenB")
+    per_stage = []
+    for s in range(num_stages):
+        warmup = min(2 * (num_stages - s) - 1, num_micro)
+        ticks = [Tick(s, m, "F") for m in range(warmup)]
+        f = warmup
+        b = 0
+        while f < num_micro:
+            ticks.append(Tick(s, b, "B"))
+            b += 1
+            ticks.append(Tick(s, f, "F"))
+            f += 1
+        while b < num_micro:
+            ticks.append(Tick(s, b, "B"))
+            b += 1
+        per_stage.append(ticks)
+    return per_stage
+
+
 def schedule_zero_bubble(num_stages: int, num_micro: int) -> list[list[Tick]]:
     """ZB-H1 (pipeline_zero_bubble.py:62): split backward into activation-grad
     (B) and weight-grad (W); W ticks fill the cooldown bubble."""
@@ -1103,6 +1140,8 @@ def format_schedule(per_stage: list[list[Tick]]) -> str:
 SCHEDULES = {
     "FThenB": schedule_fthenb,
     "1F1B": schedule_1f1b,
+    "Eager1F1B": schedule_eager_1f1b,
+    "Eager-1F1B": schedule_eager_1f1b,
     "Interleave": schedule_interleave,
     "VPP": schedule_interleave,
     "ZBH1": schedule_zero_bubble,

@@ -577,13 +577,17 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
     # can't run here raises — a schedule silently different from the
     # configured one is worse than an error
     schedule = "1f1b" if pipeline_schedule is None else pipeline_schedule
-    known = ("1f1b", "vpp", "interleave", "zb", "zero_bubble",
+    # eager_1f1b runs the executed 1F1B clock: its deeper warmup exists to
+    # overlap p2p sends with compute, which inside one jitted SPMD program
+    # is already the XLA latency-hiding scheduler's job (see
+    # schedule_eager_1f1b's spec oracle in fleet/pipeline.py)
+    known = ("1f1b", "eager_1f1b", "vpp", "interleave", "zb", "zero_bubble",
              "gpipe", "fthenb")
     if schedule not in known:
         raise ValueError(f"unknown pipeline_schedule {schedule!r} "
                          f"(expected one of {known})")
     use_1f1b = pp > 1 and schedule in (
-        "1f1b", "vpp", "interleave", "zb", "zero_bubble")
+        "1f1b", "eager_1f1b", "vpp", "interleave", "zb", "zero_bubble")
     zb = schedule in ("zb", "zero_bubble")
     if pipeline_schedule is not None:
         if schedule in ("gpipe", "fthenb"):

@@ -373,7 +373,8 @@ def test_ring_attention_grad_finite(eight_devices):
 
 def test_pipeline_schedules_golden():
     from paddle_tpu.distributed.fleet.pipeline import (
-        format_schedule, schedule_1f1b, schedule_fthenb, schedule_zero_bubble,
+        format_schedule, schedule_1f1b, schedule_eager_1f1b, schedule_fthenb,
+        schedule_zero_bubble,
     )
 
     s = format_schedule(schedule_fthenb(2, 3))
@@ -382,6 +383,12 @@ def test_pipeline_schedules_golden():
     s = format_schedule(schedule_1f1b(2, 4))
     # stage0 warms up 1 forward; stage1 none
     assert s.splitlines()[0] == "stage0: F0 F1 B0 F2 B1 F3 B2 B3"
+    assert s.splitlines()[1] == "stage1: F0 B0 F1 B1 F2 B2 F3 B3"
+
+    # eager-1F1B (pipeline_eager_1f1b.py:36): warmup 2*(P-s)-1 forwards —
+    # the reference's job list is F*w then (B,F)* then B*
+    s = format_schedule(schedule_eager_1f1b(2, 4))
+    assert s.splitlines()[0] == "stage0: F0 F1 F2 B0 F3 B1 B2 B3"
     assert s.splitlines()[1] == "stage1: F0 B0 F1 B1 F2 B2 F3 B3"
 
     zb = schedule_zero_bubble(2, 4)

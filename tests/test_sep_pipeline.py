@@ -158,7 +158,6 @@ def test_sep_1f1b_training_converges(eight_devices):
     sep-1F1B + AdamW + global-norm clip + sharded data) actually LEARNS — a
     fixed batch's loss must drop substantially in 12 steps."""
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding
 
     cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
                                  kv_heads=2, inter=64)
