@@ -66,6 +66,11 @@ CACHE_PATH = os.environ.get(
     "BENCH_CACHE_PATH",
     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                  "BENCH_TPU_CACHE.json"))
+# staleness guard for the cached-headline fallback: a cache rung older than
+# this is proof of a *persistent* outage, not evidence — refusing to bank it
+# makes a third consecutive replay of the same number impossible to miss
+# (rounds run ~1-3 days apart; 12 days ≈ many missed rounds)
+CACHE_MAX_AGE_DAYS = float(os.environ.get("BENCH_CACHE_MAX_AGE_DAYS", "12"))
 
 # bf16 peak FLOPs per chip by generation
 PEAK_FLOPS = {
@@ -160,9 +165,36 @@ def probe_main() -> int:
         g = jax.grad(lambda x: rms.rms_norm(x, w).astype(jnp.float32).sum())(x)
         float(g.sum())
 
+    def paged_tiny():
+        # the ragged paged-decode kernel at the CB rungs' geometry (GQA
+        # 20q/4kv heads, hd 128, 64-token pages) on a small pool; a Mosaic
+        # failure here routes the CB rungs back to the gather path instead
+        # of hanging the decode ladder.  BOTH program variants are probed —
+        # the int4 dequant-on-read kernel (int8 page loads, nibble
+        # shift/sign-extend, per-page scales) is a materially different
+        # Mosaic compile than the bf16 one, and the 3B int4 rung depends
+        # on it
+        from paddle_tpu.ops.pallas import paged_attention as pa
+
+        kc = jnp.asarray(rs.randn(8, 4, 64, 128), jnp.bfloat16)
+        vc = jnp.asarray(rs.randn(8, 4, 64, 128), jnp.bfloat16)
+        q = jnp.asarray(rs.randn(4, 20, 128), jnp.bfloat16)
+        tables = jnp.asarray(rs.permutation(8).reshape(4, 2), jnp.int32)
+        lens = jnp.asarray([3, 64, 100, 128], jnp.int32)
+        before = pa.KERNEL_CALLS
+        float(pa.paged_attention_decode(q, kc, vc, tables, lens)
+              .astype(jnp.float32).sum())
+        qk, ks = pa.quantize_kv_cache(kc, "int4")
+        qv, vs = pa.quantize_kv_cache(vc, "int4")
+        float(pa.paged_attention_decode(q, qk, qv, tables, lens,
+                                        kv_quant="int4", k_scale=ks,
+                                        v_scale=vs).astype(jnp.float32).sum())
+        assert pa.KERNEL_CALLS == before + 2, "paged kernel silently fell back"
+
     probe_kernel("rms_norm", rms_tiny)
     probe_kernel("flash_attention", flash_tiny)
     probe_kernel("flash_attention_2048", flash_bench_shape)
+    probe_kernel("paged_attention", paged_tiny)
     # relay-health signature: fleet.collective_perf on whatever devices are
     # live (single chip: measures dispatch+fetch RTT through the relay; a
     # sudden s/iter regression is quantitative link-trouble evidence —
@@ -369,54 +401,97 @@ def run_decode_rung(name, cfg, batch, prompt, new, max_seq):
 
 
 def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
-                quant=None, paged=False):
+                quant=None, paged=False, ragged=False, paged_kernel=True):
     """Continuous-batching throughput: staggered prompt lengths through the
     slot-pool scheduler (inference/serving.py), the serving pattern behind the
     reference's block_multihead_attention stack (fused_ops.yaml:45).
     ``quant``: weight-only int8/int4 matmuls (nn/quant) — the HBM-bandwidth
-    lever for decode."""
+    lever for decode.  ``ragged``: skew prompt lengths (alternating near-max
+    and minimal), the regime where the ragged paged kernel's per-slot page
+    walk wins most over the gather-to-max path.  ``paged_kernel=False`` pins
+    the paged rung to the gather oracle (PADDLE_TPU_DISABLE_PALLAS=
+    paged_attention at trace time) so kernel/gather A-B pairs share one
+    rung family."""
     import numpy as np
     import jax
 
     from paddle_tpu.models import llama
     from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
+    from paddle_tpu.inference.serving import _bucket
 
     log(f"cb rung {name}: building (slots={max_batch} requests={n_requests} "
-        f"quant={quant})")
-    params = llama.init_params(cfg, jax.random.key(0))
-    eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
-                                   max_seq=max_seq, chunk=chunk, quant=quant,
-                                   paged=paged)
-    del params  # quantized rungs: free the fp tree (4.5GB at 3B) before serving
+        f"quant={quant} ragged={ragged} paged_kernel={paged_kernel})")
+    def pow2_buckets(lo_len, hi_len):
+        lo_b, hi_b = min(_bucket(lo_len), max_seq), min(_bucket(hi_len), max_seq)
+        buckets, b = [], lo_b
+        while b <= hi_b:
+            buckets.append(b)
+            b *= 2
+        return buckets
+
     rs = np.random.RandomState(0)
-    # warm the decode step plus one prefill per bucket the timed requests can
-    # land in (lengths span [prompt//2, prompt//2 + prompt - 1]) so no XLA
-    # compile lands inside the timed region
-    from paddle_tpu.inference.serving import _bucket
-    lo_b = min(_bucket(prompt // 2), max_seq)
-    hi_b = min(_bucket(prompt // 2 + prompt - 1), max_seq)
-    buckets = []
-    b = lo_b
-    while b <= hi_b:
-        buckets.append(b)
-        b *= 2
-    t_c = time.perf_counter()
-    for bi, b in enumerate(buckets):
-        warm_len = min(b, max_seq - 1)
-        eng.serve([Request(rid=-1 - bi,
-                           prompt_ids=rs.randint(0, cfg.vocab_size, (warm_len,)).astype(np.int32),
-                           max_new_tokens=2)])
-    log(f"cb rung {name}: compile {time.perf_counter() - t_c:.1f}s (buckets {buckets})")
-    eng.stats.update(decode_steps=0, decode_tokens=0, decode_time_s=0.0)
-    reqs = [Request(rid=i,
-                    prompt_ids=rs.randint(0, cfg.vocab_size,
-                                          (prompt // 2 + rs.randint(prompt),)).astype(np.int32),
-                    max_new_tokens=new)
-            for i in range(n_requests)]
-    t0 = time.perf_counter()
-    eng.serve(reqs)
-    wall = time.perf_counter() - t0
-    total = sum(len(r.output_ids) for r in reqs)
+    if ragged:
+        # skewed batch: half the slots near max context, half tiny — the
+        # gather path pays max_seq HBM for every lane, the kernel only for
+        # the long ones.  Warm EVERY power-of-two bucket from the short
+        # prompt up to the longest preemption-RESUME length (prompt +
+        # generated-so-far, which the oversubscribed pool provokes by
+        # design): no XLA prefill compile may land inside the timed region.
+        long_len, short_len = max_seq - new - 1, 16
+        req_lens = [long_len if i % 2 == 0 else short_len
+                    for i in range(n_requests)]
+        buckets = pow2_buckets(short_len, min(long_len + new - 1, max_seq - 1))
+    else:
+        # legacy rungs: lengths are drawn AFTER the warm-up serves, inline
+        # with each request's ids (below) — the exact RandomState(0) stream
+        # rounds <= 5 banked, so cached numbers stay workload-comparable
+        req_lens = None
+        buckets = pow2_buckets(prompt // 2, prompt // 2 + prompt - 1)
+
+    from paddle_tpu.ops.pallas import paged_attention as _pa
+
+    env_key = "PADDLE_TPU_DISABLE_PALLAS"
+    saved_env = os.environ.get(env_key)
+    if paged and not paged_kernel:
+        os.environ[env_key] = (saved_env + "," if saved_env else "") + "paged_attention"
+    pk0, pf0 = _pa.KERNEL_CALLS, _pa.FALLBACK_CALLS
+    try:
+        params = llama.init_params(cfg, jax.random.key(0))
+        eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
+                                       max_seq=max_seq, chunk=chunk, quant=quant,
+                                       paged=paged)
+        del params  # quantized rungs: free the fp tree (4.5GB at 3B) before serving
+        # warm the decode step plus one prefill per bucket the timed requests
+        # can land in, so no XLA compile lands inside the timed region
+        t_c = time.perf_counter()
+        for bi, b in enumerate(buckets):
+            warm_len = min(b, max_seq - 1)
+            eng.serve([Request(rid=-1 - bi,
+                               prompt_ids=rs.randint(0, cfg.vocab_size, (warm_len,)).astype(np.int32),
+                               max_new_tokens=2)])
+        log(f"cb rung {name}: compile {time.perf_counter() - t_c:.1f}s (buckets {buckets})")
+        eng.stats.update(decode_steps=0, decode_tokens=0, decode_time_s=0.0)
+        if ragged:
+            reqs = [Request(rid=i,
+                            prompt_ids=rs.randint(0, cfg.vocab_size, (ln,)).astype(np.int32),
+                            max_new_tokens=new)
+                    for i, ln in enumerate(req_lens)]
+        else:
+            reqs = [Request(rid=i,
+                            prompt_ids=rs.randint(0, cfg.vocab_size,
+                                                  (prompt // 2 + rs.randint(prompt),)).astype(np.int32),
+                            max_new_tokens=new)
+                    for i in range(n_requests)]
+        t0 = time.perf_counter()
+        eng.serve(reqs)
+        wall = time.perf_counter() - t0
+        total = sum(len(r.output_ids) for r in reqs)
+    finally:
+        if paged and not paged_kernel:
+            if saved_env is None:
+                os.environ.pop(env_key, None)
+            else:
+                os.environ[env_key] = saved_env
     return {
         "metric": "llama_cb_decode_tokens_per_sec",
         "value": round(eng.decode_tokens_per_s, 1),
@@ -425,7 +500,11 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
         "detail": {"rung": name, "slots": max_batch, "requests": n_requests,
                    "total_new_tokens": total, "wall_s": round(wall, 2),
                    "decode_steps": eng.stats["decode_steps"], "chunk": chunk,
-                   "quant": quant, "paged": paged,
+                   "quant": quant, "paged": paged, "ragged": ragged,
+                   # per-rung deltas (flash pattern, bench.py run_rung): the
+                   # A/B evidence of which attention path this rung traced
+                   "paged_kernel_calls": _pa.KERNEL_CALLS - pk0,
+                   "paged_fallback_calls": _pa.FALLBACK_CALLS - pf0,
                    "backend": jax.default_backend()},
     }
 
@@ -455,11 +534,23 @@ def decode_ladder_main(compact: bool = False) -> int:
             break
     # continuous-batching rungs (slot-pool scheduler); chunked decode hides
     # the per-token host round-trip (dominant on a relay-attached TPU)
+    # paged rung naming: cb_full_chunk8_paged keeps its historical meaning
+    # (the gather path — comparable with rounds <= 5's cached numbers);
+    # *_paged_kernel is the ragged Pallas kernel; the cb_paged_ragged_* pair
+    # measures the skewed-seq_lens regime where the kernel's per-slot page
+    # walk wins most (rung tuple tail: chunk, quant, paged, ragged, kernel)
     cb_rungs = ([("cb_tiny", llama.LlamaConfig.tiny(), 2, 6, 16, 16, 64, 1),
                  ("cb_full", full_cfg, 8, 24, 128, 64, 512, 1),
                  ("cb_full_chunk8", full_cfg, 8, 24, 128, 64, 512, 8),
                  ("cb_full_chunk8_int8", full_cfg, 8, 24, 128, 64, 512, 8, "int8"),
-                 ("cb_full_chunk8_paged", full_cfg, 8, 24, 128, 64, 512, 8, None, True)]
+                 ("cb_full_chunk8_paged", full_cfg, 8, 24, 128, 64, 512, 8,
+                  None, True, False, False),
+                 ("cb_full_chunk8_paged_kernel", full_cfg, 8, 24, 128, 64, 512,
+                  8, None, True),
+                 ("cb_paged_ragged_kernel", full_cfg, 8, 24, 128, 64, 512, 8,
+                  None, True, True, True),
+                 ("cb_paged_ragged_gather", full_cfg, 8, 24, 128, 64, 512, 8,
+                  None, True, True, False)]
                 if on_tpu else
                 [("cb_cpu_smoke", llama.LlamaConfig.tiny(), 2, 4, 16, 8, 64, 2)])
     # ~3B-param config (h=2560, L=32): the scale the weight-only path exists
@@ -474,21 +565,37 @@ def decode_ladder_main(compact: bool = False) -> int:
         cb_rungs += [
             ("cb_3b_chunk8_int4", cfg_3b, 4, 8, 128, 64, 512, 8, "int4"),
             ("cb_3b_chunk8_int8", cfg_3b, 4, 8, 128, 64, 512, 8, "int8"),
+            # legacy name stays on the gather path (comparable with the
+            # cached rounds-<=5 numbers); the kernel path banks under its
+            # own rung name so a path change can never masquerade as a
+            # round-over-round perf delta
             ("cb_3b_chunk8_int4_paged", cfg_3b, 4, 8, 128, 64, 512, 8,
+             "int4", True, False, False),
+            ("cb_3b_chunk8_int4_paged_kernel", cfg_3b, 4, 8, 128, 64, 512, 8,
              "int4", True),
         ]
     if compact and on_tpu:
         # best-known config (round-3 headline: chunk=8 hides the per-token
         # relay RTT) fp + weight-only int8, then the paged block-table mode
-        # and the 3B int4/int8 rungs — cheapest first so a timeout keeps the
-        # cheap evidence (each rung emits/banks incrementally)
+        # (gather vs ragged-kernel A-B, plus the skewed-seq_lens pair where
+        # the kernel win is largest) and the 3B int4/int8 rungs — cheapest
+        # first so a timeout keeps the cheap evidence (each rung emits/banks
+        # incrementally)
         cb_rungs = [("cb_full_chunk8", full_cfg, 8, 24, 128, 64, 512, 8),
                     ("cb_full_chunk8_int8", full_cfg, 8, 24, 128, 64, 512, 8, "int8"),
                     ("cb_full_chunk8_paged", full_cfg, 8, 24, 128, 64, 512, 8,
-                     None, True),
+                     None, True, False, False),
+                    ("cb_full_chunk8_paged_kernel", full_cfg, 8, 24, 128, 64,
+                     512, 8, None, True),
+                    ("cb_paged_ragged_kernel", full_cfg, 8, 24, 128, 64, 512,
+                     8, None, True, True, True),
+                    ("cb_paged_ragged_gather", full_cfg, 8, 24, 128, 64, 512,
+                     8, None, True, True, False),
                     ("cb_3b_chunk8_int4", cfg_3b, 4, 8, 128, 64, 512, 8, "int4"),
                     ("cb_3b_chunk8_int4_paged", cfg_3b, 4, 8, 128, 64, 512, 8,
-                     "int4", True),
+                     "int4", True, False, False),
+                    ("cb_3b_chunk8_int4_paged_kernel", cfg_3b, 4, 8, 128, 64,
+                     512, 8, "int4", True),
                     ("cb_3b_chunk8_int8", cfg_3b, 4, 8, 128, 64, 512, 8, "int8")]
     for rung in cb_rungs:
         try:
@@ -872,10 +979,35 @@ def _bank_to_cache(rungs: list[dict]) -> None:
             log(f"cache: write failed: {e}")
 
 
-def _best_cached_train(cache: dict) -> dict | None:
+def _best_cached_train(cache: dict) -> tuple[dict | None, dict | None]:
+    """(best fresh rung, best rung of ANY age) — the staleness cut happens
+    at selection, so one stale-but-higher rung cannot shadow a fresh valid
+    one (unknown timestamps count as stale)."""
     rungs = [r for r in cache.get("rungs", {}).values()
              if r.get("metric") == "llama_train_mfu_single_chip"]
-    return max(rungs, key=lambda r: r.get("vs_baseline", 0)) if rungs else None
+    best = lambda rs: (max(rs, key=lambda r: r.get("vs_baseline", 0))
+                       if rs else None)
+    def age_of(r):
+        # explicit None check: 0.0 is a legitimate age (writer clock at or
+        # ahead of the reader's), not a missing timestamp
+        age = _cache_age_days(r.get("measured_at"))
+        return age if age is not None else float("inf")
+
+    fresh = [r for r in rungs if age_of(r) <= CACHE_MAX_AGE_DAYS]
+    return best(fresh), best(rungs)
+
+
+def _cache_age_days(measured_at: str | None) -> float | None:
+    """Age of a cached rung's ISO-8601 UTC timestamp, in days."""
+    if not measured_at:
+        return None
+    try:
+        import calendar
+
+        ts = calendar.timegm(time.strptime(measured_at, "%Y-%m-%dT%H:%M:%SZ"))
+    except (ValueError, OverflowError):
+        return None
+    return max(0.0, (time.time() - ts) / 86400.0)
 
 
 def main():
@@ -908,6 +1040,8 @@ def main():
         if (by_metric.get("probe_kernel_flash_attention", {}).get("value") != 1
                 or by_metric.get("probe_kernel_flash_attention_2048", {}).get("value") != 1):
             disabled.append("flash_attention")
+        if by_metric.get("probe_kernel_paged_attention", {}).get("value") != 1:
+            disabled.append("paged_attention")
         if disabled:
             log(f"probe: disabling Pallas kernels for the ladder: {disabled}")
     else:
@@ -983,17 +1117,39 @@ def main():
         rungs = _run_worker(decode + ["--cpu"], min(CPU_TIMEOUT, max(60, int(budget_left()))))
         rungs = [r for r in rungs if not r["metric"].startswith("probe_")]
         cpu_head = headline_of(rungs, decode)
-        cached = None if decode else _best_cached_train(_load_cache())
+        cached, cached_any = ((None, None) if decode
+                              else _best_cached_train(_load_cache()))
         if cached is not None:
+            age = _cache_age_days(cached.get("measured_at"))
             result = dict(cached)
             result.pop("measured_at", None)
             result["detail"] = dict(cached.get("detail", {}))
             result["detail"]["source"] = "last_healthy_tpu_cache"
             result["detail"]["measured_at"] = cached.get("measured_at")
+            result["detail"]["cache_age_days"] = round(age, 1)
             result["detail"]["live_cpu_smoke"] = (
                 {"value": cpu_head["value"], "unit": cpu_head["unit"]}
                 if cpu_head else {"error": "cpu smoke failed too"})
-            log(f"using cached TPU rung from {cached.get('measured_at')} as headline")
+            log(f"using cached TPU rung from {cached.get('measured_at')} "
+                f"({age:.1f} days old; refuse-after {CACHE_MAX_AGE_DAYS:.0f}) "
+                f"as headline")
+        elif cached_any is not None:
+            # staleness guard: every cached rung is past the age threshold —
+            # that means multiple consecutive rounds with zero hardware
+            # evidence, so surface THAT loudly instead of replaying the same
+            # headline a third time
+            age = _cache_age_days(cached_any.get("measured_at"))
+            age_str = f"{age:.1f} days" if age is not None else "UNKNOWN age"
+            log(f"REFUSING stale cache headline ({age_str} > "
+                f"{CACHE_MAX_AGE_DAYS:.0f} days); falling back to CPU smoke")
+            result = cpu_head
+            if result is not None:
+                result.setdefault("detail", {})["stale_cache_refused"] = {
+                    "measured_at": cached_any.get("measured_at"),
+                    "age_days": None if age is None else round(age, 1),
+                    "max_age_days": CACHE_MAX_AGE_DAYS,
+                    "refused_value": cached_any.get("value"),
+                }
         else:
             result = cpu_head
 

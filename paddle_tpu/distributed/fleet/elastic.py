@@ -1,9 +1,13 @@
 """Elastic training manager.
 
 Reference: ``ElasticManager`` (python/paddle/distributed/fleet/elastic/
-manager.py:125) — etcd node registry, heartbeat lease (lease_heartbeat
-:254), host-set watch, endpoint rewrite + trainer restart, scale-in/out
-levels (_update_elastic_scale_out :484).
+manager.py:125).  Implemented subset: etcd node registry, heartbeat lease
+(lease_heartbeat :254), host-set watch, endpoint rewrite + restart signal.
+NOT implemented: the reference's scale-in/out *level* logic
+(``_update_elastic_scale_out`` :484 — min/max-np bands, pods-to-offline
+selection, per-level restart budgets); every membership change here is
+treated uniformly as "rewrite endpoints and ask the controller to restart",
+and an empty host set maps to ERROR.
 
 TPU-native: etcd is replaced by the job :class:`~paddle_tpu.distributed.store.
 TCPStore` (the same rendezvous store the launcher uses).  Each node registers
@@ -12,7 +16,10 @@ loop detects dead nodes (stale heartbeat) and joiners, recomputes the
 endpoint list, and signals the controller to restart trainers with rewritten
 ``PADDLE_TRAINER_ENDPOINTS`` — on TPU pods a membership change also forces a
 fresh ``jax.distributed`` init, since the ICI mesh shape is baked into
-compiled programs (SURVEY.md §5 "Failure detection").
+compiled programs (SURVEY.md §5 "Failure detection").  The uniform
+restart-on-change policy is the right TPU default: ICI mesh shapes are
+compile-time constants, so any resize is a full recompile anyway — levels
+would only add restart hysteresis, not save work.
 """
 
 from __future__ import annotations

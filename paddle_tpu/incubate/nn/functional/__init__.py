@@ -382,17 +382,28 @@ def masked_multihead_attention(x, cache_kv, seq_lens, scale=None, **kw):
 
 
 def block_multihead_attention(q, key_cache, value_cache, block_tables,
-                              seq_lens, scale=None, **kw):
+                              seq_lens, scale=None, kv_quant=None,
+                              k_scale=None, v_scale=None, **kw):
     """Paged (block) KV-cache decode attention (reference:
     python/paddle/incubate/nn/functional/block_multihead_attention.py,
-    fused_ops.yaml:45).  See ops/decode_attention.py for layout."""
+    fused_ops.yaml:45).  See ops/decode_attention.py for layout.
+
+    Routed through :func:`ops.decode_attention.paged_decode_attention`, so
+    GQA head groups, int8/int4 quantized KV pages (``kv_quant`` +
+    ``k_scale``/``v_scale``), and the ragged Pallas kernel dispatch all
+    apply here too (disable with PADDLE_TPU_DISABLE_PALLAS=paged_attention)."""
     from ....ops import decode_attention as _da
 
-    def fn(qv, kc, vc, bt, lens):
-        return _da.block_multihead_attention(qv, kc, vc, bt, lens, scale=scale)
+    def fn(qv, kc, vc, bt, lens, *scales):
+        ks, vs = scales if scales else (None, None)
+        return _da.paged_decode_attention(qv, kc, vc, bt, lens, scale=scale,
+                                          kv_quant=kv_quant, k_scale=ks,
+                                          v_scale=vs)
 
-    return apply_op("block_multihead_attention", fn,
-                    [q, key_cache, value_cache, block_tables, seq_lens])
+    inputs = [q, key_cache, value_cache, block_tables, seq_lens]
+    if kv_quant:
+        inputs += [k_scale, v_scale]
+    return apply_op("block_multihead_attention", fn, inputs)
 
 
 def fused_multi_transformer(

@@ -246,7 +246,8 @@ def create_predictor(config: Config) -> Predictor:
 # LLM serving: prefill + KV-cache decode (block_multihead_attention path)
 # ---------------------------------------------------------------------------
 
-def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos, sin):
+def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos,
+                      sin, attend_fn=None):
     """Cache-threading transformer body shared by GenerationEngine and the
     continuous-batching engine (serving.py) — one copy of the GQA attend +
     rms/rope/swiglu scan so masking/grouping fixes can't diverge.
@@ -256,6 +257,10 @@ def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos, sin
     should read (usually the committed cache itself; the slot-prefill path
     returns its single lane so a batch-1 prompt can prefill into a wider
     pool).  ``mask`` broadcasts against logits [b, nkv, rep, s, S].
+    ``attend_fn(q [b, s, nh, hd], k_view, v_view) -> [b, s, nh*hd]``
+    overrides the dense masked attend — the paged decode path passes the
+    ragged paged-attention kernel here, with write_fn returning the RAW
+    paged pool (no gathered view) as k_view/v_view; ``mask`` is then unused.
     Returns (final-normed hidden [b, s, h], all_k, all_v).
     """
     from ..ops.pallas import rms_norm as rms
@@ -278,6 +283,8 @@ def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos, sin
         p = jax.nn.softmax(logits, axis=-1)
         out = jnp.einsum("bngsS,bnSd->bsngd", p.astype(v_all.dtype), v_all)
         return out.reshape(b, s, nh * hd)
+
+    attend = attend_fn or attend
 
     def wmat(entry, dt):
         """Dense [in, out] matrix from a param leaf — either fp as stored,

@@ -28,7 +28,12 @@ layer, each slot owns a host-managed list of block ids, and the compiled
 programs receive the [max_batch, max_blocks] table AS DATA — shapes stay
 static (the TPU requirement) while HBM is shared by actual usage, so
 admission is bounded by free blocks rather than worst-case max_seq lanes.
-Attention reads a gathered view of the slot's blocks (XLA fuses the block
+Decode attention dispatches to the ragged paged-attention Pallas kernel
+(`ops/pallas/paged_attention.py`, docs/paged_attention.md), which walks only
+each slot's LIVE block-table pages — HBM bytes per step scale with resident
+tokens, not the longest request; with the kernel disabled
+(``PADDLE_TPU_DISABLE_PALLAS=paged_attention``) or on unsupported shapes,
+attention reads a gathered view of the slot's blocks (XLA fuses the block
 gather into the attention contraction's operand read); when the pool runs
 dry the youngest slot is preempted vLLM-style (blocks freed, request
 requeued with prompt+generated so far; the stored tokens are teacher-forced
@@ -202,6 +207,7 @@ class ContinuousBatchingEngine:
                 & active[:, None, None, None, None])
         lane = jnp.arange(B)
         writeable = active & (pos < S)
+        attend_fn = None
 
         if table is None:
             def write(ck, k):
@@ -212,15 +218,27 @@ class ContinuousBatchingEngine:
                 out = ck.at[lane, :, safe_pos].set(upd)
                 return out, out
         else:
+            from ..ops import decode_attention as _da
+            from ..ops.pallas import paged_attention as _pa
+
             bs_ = self.block_size
             blk = table[lane, safe_pos // bs_]                   # [B]
             off = safe_pos % bs_
             drop_blk = jnp.where(writeable, blk, self.num_blocks)  # oob -> drop
+            nh = cfg.num_attention_heads
+            # trace-time dispatch: the ragged Pallas kernel walks only each
+            # slot's live pages (PADDLE_TPU_DISABLE_PALLAS=paged_attention
+            # routes back to the gather oracle below)
+            use_kernel = _pa.kernel_supported(nh, nkv, hd, bs_)
 
             def write(ck, k):
                 # ck [num_blocks, nkv, bs, hd].  Allocator invariant:
                 # distinct slots own disjoint pages — no scatter collisions.
                 out = ck.at[drop_blk, :, off].set(k[:, 0], mode="drop")
+                if use_kernel:
+                    # attention reads the paged pool directly — no
+                    # [B, nkv, S, hd] gather materializes per layer per step
+                    return out, out
                 # unallocated (sentinel) pages read as ZEROS — jnp.take's
                 # default oob mode fills NaN, and 0*NaN through the masked
                 # softmax would poison the whole row
@@ -228,8 +246,21 @@ class ContinuousBatchingEngine:
                 view = view.transpose(0, 2, 1, 3, 4).reshape(B, nkv, S, hd)
                 return out, view
 
+            if use_kernel:
+                seq_now = safe_pos + 1  # incl. the token written this step
+
+                def attend_fn(q, k_pool, v_pool):
+                    # q [B, 1, nh, hd] post-rope; sentinel table entries are
+                    # clamped in-kernel and masked by seq_now; inactive
+                    # lanes attend one stale position (finite, masked out
+                    # downstream like the dense path's garbage lanes)
+                    o = _da.paged_decode_attention(q[:, 0], k_pool, v_pool,
+                                                   table, seq_now)
+                    return o.reshape(B, 1, nh * hd)
+
         x, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
-                                           write, mask, cos, sin)
+                                           write, mask, cos, sin,
+                                           attend_fn=attend_fn)
         return _inf.lm_head_logits(cfg, params, x[:, -1]), ak, av
 
     def _sample_tokens(self, logits, pos, temp, topp, seeds):

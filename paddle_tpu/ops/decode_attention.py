@@ -10,7 +10,13 @@ per-sequence block tables, PageAttention-style).
 TPU-native design: both are expressed as gather + batched matmul so XLA tiles
 them onto the MXU; the block-table gather compiles to a dynamic-slice-free
 `take` along the block axis (static shapes — the cache and tables are padded
-to maxima, masking handles the ragged tails).  All functions are functional:
+to maxima, masking handles the ragged tails).  The paged decode hot path
+additionally has a ragged Pallas kernel (`ops/pallas/paged_attention.py`,
+docs/paged_attention.md) behind :func:`paged_decode_attention` that walks
+only each slot's live pages; the gather oracle/fallback lives in
+`pallas.paged_attention.paged_attention_reference` (one home —
+:func:`block_multihead_attention` is a parity alias over it).  All
+functions are functional:
 caches are inputs AND outputs (donated under jit), matching JAX's
 no-mutation model rather than the reference's in-place `_` ops.
 """
@@ -25,6 +31,7 @@ import jax.numpy as jnp
 __all__ = [
     "masked_multihead_attention",
     "block_multihead_attention",
+    "paged_decode_attention",
     "append_to_block_cache",
 ]
 
@@ -92,6 +99,31 @@ def append_to_block_cache(key_cache, value_cache, k, v, block_tables, seq_lens):
     return write_one(key_cache, k), write_one(value_cache, v)
 
 
+def paged_decode_attention(q, key_cache, value_cache, block_tables, seq_lens,
+                           scale=None, kv_quant=None, k_scale=None,
+                           v_scale=None):
+    """Ragged paged-attention decode (the CB engine's ``paged=True`` hot op).
+
+    GQA-aware front door over the Pallas kernel
+    (`ops/pallas/paged_attention.py`): q may carry ``num_heads`` grouped
+    query heads over ``num_kv_heads`` cache heads, and the caches may be
+    weight-only-style quantized (``kv_quant`` in {'int8', 'int4'} with
+    per-page scales).  Dispatches to the kernel — which walks only each
+    slot's LIVE block-table pages, so HBM bytes scale with the tokens
+    actually resident, not with the longest request — and falls back to the
+    :func:`block_multihead_attention`-style gather oracle off-TPU-shapes or
+    under ``PADDLE_TPU_DISABLE_PALLAS=paged_attention``.
+
+    Shapes: q [b, nh, hd]; caches [num_blocks, nkv, block_size, hd]
+    (nh % nkv == 0); block_tables [b, max_blocks]; seq_lens [b].
+    Returns out [b, nh, hd]."""
+    from .pallas import paged_attention as _pa
+
+    return _pa.paged_attention_decode(
+        q, key_cache, value_cache, block_tables, seq_lens, scale=scale,
+        kv_quant=kv_quant, k_scale=k_scale, v_scale=v_scale)
+
+
 def block_multihead_attention(q, key_cache, value_cache, block_tables,
                               seq_lens, scale=None):
     """PageAttention-style decode: q attends over a paged KV cache.
@@ -102,24 +134,12 @@ def block_multihead_attention(q, key_cache, value_cache, block_tables,
       block_tables: [b, max_blocks] physical block ids (-1 for unused slots).
       seq_lens: [b] valid KV length per sequence (incl. the just-appended token).
 
-    Returns out [b, nh, hd].
+    Returns out [b, nh, hd].  Thin reference-parity alias over the single
+    gather-oracle implementation (`ops/pallas/paged_attention.
+    paged_attention_reference` — also the kernel's dispatch fallback), so
+    the two can never drift.
     """
-    num_blocks, nh, bs, hd = key_cache.shape
-    b, _, _ = q.shape
-    max_blocks = block_tables.shape[1]
-    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+    from .pallas.paged_attention import paged_attention_reference
 
-    safe_tables = jnp.maximum(block_tables, 0)
-    # gather per-sequence KV: [b, max_blocks, nh, bs, hd] -> [b, nh, S, hd]
-    k_seq = jnp.take(key_cache, safe_tables, axis=0)
-    v_seq = jnp.take(value_cache, safe_tables, axis=0)
-    S = max_blocks * bs
-    k_seq = k_seq.transpose(0, 2, 1, 3, 4).reshape(b, nh, S, hd)
-    v_seq = v_seq.transpose(0, 2, 1, 3, 4).reshape(b, nh, S, hd)
-
-    logits = jnp.einsum("bnd,bnsd->bns", q.astype(jnp.float32),
-                        k_seq.astype(jnp.float32)) * scale
-    mask = jnp.arange(S)[None, None, :] < seq_lens[:, None, None]
-    logits = jnp.where(mask, logits, -1e30)
-    p = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bns,bnsd->bnd", p.astype(v_seq.dtype), v_seq)
+    return paged_attention_reference(q, key_cache, value_cache, block_tables,
+                                     seq_lens, scale=scale)

@@ -1,7 +1,8 @@
 """Pallas TPU kernel library.
 
 The irreducible native-kernel set identified in SURVEY.md §2 ("Native-component
-summary"): flash attention, fused rms_norm, rotary embedding, swiglu, and MoE
+summary"): flash attention, ragged paged-attention decode (docs/
+paged_attention.md), fused rms_norm, rotary embedding, swiglu, and MoE
 dispatch.  Everything else in the reference's 525k-LoC kernel library lowers
 through XLA.  Each kernel here:
 

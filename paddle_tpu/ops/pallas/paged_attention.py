@@ -1,0 +1,434 @@
+"""Ragged paged-attention decode kernel (Pallas TPU).
+
+Replaces the pure-XLA page-attention fallback for the continuous-batching
+decode path (reference: ``block_multihead_attention_``, fused_ops.yaml:45;
+kernel design: "Ragged Paged Attention" — PAPERS.md).  The gather fallback
+(`ops/decode_attention.py`) reads every slot's KV out to the *maximum*
+logical length (`max_blocks * block_size`) and masks the ragged tail, so
+HBM bytes per decode step scale with the longest request in the batch.
+This kernel walks each slot's block table and streams only the LIVE pages:
+
+- grid ``(slots, kv_heads, logical_pages)`` with the page dim innermost
+  (sequential) — one grid step = one physical KV page for one (slot, head);
+- the block table and per-slot ``seq_lens`` ride in as scalar-prefetch
+  operands (``pltpu.PrefetchScalarGridSpec``), so the K/V BlockSpec index
+  maps resolve the PHYSICAL page id before the DMA is issued — the gather
+  never materializes in HBM;
+- pages past a slot's live count are remapped to its last live page:
+  Mosaic elides the copy when consecutive grid steps fetch the same block,
+  so a slot at 1/8th of max_seq costs ~1/8th of the page reads (the ragged
+  win), and the compute for those steps is skipped with ``pl.when``;
+- online-softmax accumulation in VMEM scratch (same recurrence as
+  ``flash_attention.py``), finalized on the last page;
+- GQA-aware: q is viewed ``[slots, kv_heads, group, head_dim]`` and the
+  whole q-head group rides one grid step (grouped K/V never repeat in HBM);
+- optional dequant-on-read for int8 / packed-int4 KV pages with per
+  (page, kv_head) float32 scales — the serving analog of the weight-only
+  decode configs (KV streams at 1/2 or 1/4 the bytes).
+
+Conventions shared with the other kernels here: interpret mode off-TPU so
+the parity suite runs on CPU, a per-kernel ``PADDLE_TPU_DISABLE_PALLAS``
+opt-out ("paged_attention"), and a pure-JAX reference
+(:func:`paged_attention_reference`) that doubles as the fallback and the
+test oracle.  Decode-only: one query token per slot, no backward pass
+(serving never differentiates through the KV cache).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU-capable installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+from . import interpret_mode, kernel_disabled
+
+NEG_INF = -1e30
+
+# trace-time counters, same contract as flash_attention.py (bench detail +
+# the "did not fall back" assertions in tests)
+KERNEL_CALLS = 0
+FALLBACK_CALLS = 0
+
+# MXU/VPU rows: the q-head group is padded up to this many rows so the
+# logits tile and the scratch accumulators keep a full sublane
+_MIN_GROUP_ROWS = 8
+
+_QUANT_BOUND = {"int8": 127.0, "int4": 7.0}
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def kernel_supported(num_heads: int, num_kv_heads: int, head_dim: int,
+                     block_size: int) -> bool:
+    """Trace-time dispatch predicate: shapes the kernel handles, pltpu
+    availability, AND the operational opt-out.  The single home of the
+    decision — callers (the CB engine, the op layer) consult this once at
+    trace time, so a hung Mosaic compile can be routed around via
+    ``PADDLE_TPU_DISABLE_PALLAS=paged_attention`` without a redeploy."""
+    return (_VMEM is not None
+            and head_dim % 8 == 0
+            and block_size % 8 == 0
+            and num_heads % num_kv_heads == 0
+            and not kernel_disabled("paged_attention"))
+
+
+# ---------------------------------------------------------------------------
+# quantized-KV storage helpers
+# ---------------------------------------------------------------------------
+
+def quantize_kv_cache(cache, mode: str):
+    """Quantize a [num_blocks, nkv, bs, hd] KV cache for dequant-on-read.
+
+    Per-(page, kv_head) symmetric absmax scales (a page is the write/evict
+    granularity, so its scale never needs rescaling mid-decode).  Returns
+    ``(q, scale[num_blocks, nkv] f32)`` with q int8 for mode='int8', or —
+    for 'int4' — adjacent head-dim pairs packed two-nibbles-per-byte into an
+    int8 ``[num_blocks, nkv, bs, hd // 2]`` buffer (element 2i in the low
+    nibble, 2i+1 in the high nibble; see ``_unpack_int4``)."""
+    bound = _QUANT_BOUND[mode]
+    x = cache.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=(2, 3))                 # [blocks, nkv]
+    scale = absmax / bound
+    q = jnp.round(x / jnp.maximum(scale, 1e-10)[:, :, None, None])
+    q = jnp.clip(q, -bound, bound).astype(jnp.int8)
+    if mode == "int8":
+        return q, scale.astype(jnp.float32)
+    lo = q[..., 0::2].astype(jnp.int32)
+    hi = q[..., 1::2].astype(jnp.int32)
+    packed = ((lo & 0xF) | ((hi & 0xF) << 4)).astype(jnp.int8)
+    return packed, scale.astype(jnp.float32)
+
+
+def _unpack_int4(packed_i32):
+    """[..., hd//2] int32 nibble pairs -> [..., hd] f32 in [-7, 7].
+    Arithmetic shifts sign-extend each nibble."""
+    lo = (packed_i32 << 28) >> 28
+    hi = (packed_i32 << 24) >> 28
+    both = jnp.stack([lo, hi], axis=-1)                       # [..., hd//2, 2]
+    return both.reshape(*packed_i32.shape[:-1],
+                        packed_i32.shape[-1] * 2).astype(jnp.float32)
+
+
+def _dequant_page(raw, scale, kv_quant):
+    """One KV page tile -> f32 [bs, hd] (dequantized when kv_quant set)."""
+    if kv_quant == "int8":
+        return raw.astype(jnp.float32) * scale
+    if kv_quant == "int4":
+        return _unpack_int4(raw.astype(jnp.int32)) * scale
+    return raw.astype(jnp.float32)
+
+
+def dequantize_kv_cache(q, scale, mode: str, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv_cache` (reference path / tests)."""
+    if mode == "int4":
+        x = _unpack_int4(q.astype(jnp.int32))
+    else:
+        x = q.astype(jnp.float32)
+    return (x * scale[:, :, None, None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
+                  scale, bs, kv_quant):
+    """Grid: (slots, kv_heads, logical_pages); pages innermost (sequential).
+
+    Scalar-prefetch refs: tables [b, max_blocks], lens [b].  One grid step
+    attends the slot's whole q-head group over one physical KV page."""
+    if kv_quant:
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    o_ref, m_scr, l_scr, acc_scr = rest
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = lens_ref[b]
+
+    # dead pages (the ragged tail): DMA already elided by the index map
+    # (same physical block as the previous step), compute skipped here
+    @pl.when(j * bs < length)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)                   # [group, hd]
+        k = _dequant_page(k_ref[0, 0], ks_ref[0, 0] if kv_quant else None,
+                          kv_quant)                           # [bs, hd]
+        v = _dequant_page(v_ref[0, 0], vs_ref[0, 0] if kv_quant else None,
+                          kv_quant)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [group, bs]
+        cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols < length, s, NEG_INF)
+        m_prev = m_scr[:]                                     # [group, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # exp that is exactly 0 for masked entries even when the running max
+        # is itself NEG_INF (avoids exp(-inf + inf) = 1)
+        p = jnp.where(s > 0.5 * NEG_INF, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.where(m_prev > 0.5 * NEG_INF,
+                          jnp.exp(m_prev - m_new), 0.0)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _resolve_page(b, j, tables_ref, lens_ref, bs: int, num_blocks: int):
+    """Grid position + prefetched (tables, lens) -> physical page.  Pages
+    past the live count repeat the LAST live page, so the pipeline sees
+    identical consecutive indices and elides the copy — that is where the
+    ragged HBM saving comes from.  Single home of the remap so the KV and
+    scale fetches can never diverge."""
+    n_live = jnp.maximum((lens_ref[b] + bs - 1) // bs, 1)
+    j_eff = jnp.minimum(j, n_live - 1)
+    return jnp.clip(tables_ref[b, j_eff], 0, num_blocks - 1)
+
+
+def _page_index_map(bs: int, num_blocks: int):
+    def idx(b, h, j, tables_ref, lens_ref):
+        return (_resolve_page(b, j, tables_ref, lens_ref, bs, num_blocks),
+                h, 0, 0)
+
+    return idx
+
+
+def _scale_index_map(bs: int, num_blocks: int):
+    def idx(b, h, j, tables_ref, lens_ref):
+        return (_resolve_page(b, j, tables_ref, lens_ref, bs, num_blocks), h)
+
+    return idx
+
+
+def _paged_attention_kernel_call(q, key_cache, value_cache, block_tables,
+                                 seq_lens, scale, kv_quant, k_scale, v_scale):
+    """q: [b, nkv, group, hd] (group already padded to sublane rows);
+    caches: [num_blocks, nkv, bs, hd_store].  Returns [b, nkv, group, hd]."""
+    b, nkv, group, hd = q.shape
+    num_blocks, _, bs, _ = key_cache.shape
+    max_blocks = block_tables.shape[1]
+
+    kernel = functools.partial(_paged_kernel, scale=scale, bs=bs,
+                               kv_quant=kv_quant)
+    kv_spec = pl.BlockSpec((1, 1, bs, key_cache.shape[-1]),
+                           _page_index_map(bs, num_blocks))
+    in_specs = [
+        pl.BlockSpec((1, 1, group, hd), lambda b, h, j, t, l: (b, h, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    args = [q, key_cache, value_cache]
+    if kv_quant:
+        sc_spec = pl.BlockSpec((1, 1), _scale_index_map(bs, num_blocks))
+        in_specs += [sc_spec, sc_spec]
+        args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nkv, max_blocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, group, hd),
+                               lambda b, h, j, t, l: (b, h, 0, 0)),
+        scratch_shapes=[
+            _VMEM((group, 1), jnp.float32),
+            _VMEM((group, 1), jnp.float32),
+            _VMEM((group, hd), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, group, hd), q.dtype),
+        interpret=interpret_mode(),
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), *args)
+
+
+# ---------------------------------------------------------------------------
+# pure-JAX reference (fallback + test oracle)
+# ---------------------------------------------------------------------------
+
+def paged_attention_reference(q, key_cache, value_cache, block_tables,
+                              seq_lens, scale=None, kv_quant=None,
+                              k_scale=None, v_scale=None):
+    """The gather oracle: read every slot's KV out to max_blocks * bs and
+    mask the ragged tail (exactly today's serving fallback, GQA- and
+    quant-aware).  O(max_seq) HBM per slot — what the kernel avoids.
+
+    q: [b, nh, hd]; caches: [num_blocks, nkv, bs, hd] (or quantized
+    storage); block_tables: [b, max_blocks]; seq_lens: [b].
+    Returns [b, nh, hd]; slots with seq_len == 0 return zeros (matching the
+    kernel's empty accumulator) instead of softmax-of-garbage."""
+    num_blocks, nkv, bs, hd_store = key_cache.shape
+    hd = hd_store * 2 if kv_quant == "int4" else hd_store
+    b, nh, _ = q.shape
+    rep = nh // nkv
+    max_blocks = block_tables.shape[1]
+    S = max_blocks * bs
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+
+    safe = jnp.clip(block_tables, 0, num_blocks - 1)
+    # gather the live pages FIRST, dequantize only the gathered slice —
+    # dequantizing the whole pool would transiently materialize every page
+    # at full precision (num_blocks >> b * max_blocks), defeating the
+    # quantized cache's footprint on exactly the robustness path
+    k_seq = jnp.take(key_cache, safe, axis=0)  # [b, maxblk, nkv, bs, hd_st]
+    v_seq = jnp.take(value_cache, safe, axis=0)
+    if kv_quant:
+        ks = jnp.take(k_scale, safe, axis=0)[..., None, None]  # [b,mb,nkv,1,1]
+        vs = jnp.take(v_scale, safe, axis=0)[..., None, None]
+        if kv_quant == "int4":
+            k_seq = _unpack_int4(k_seq.astype(jnp.int32)) * ks
+            v_seq = _unpack_int4(v_seq.astype(jnp.int32)) * vs
+        else:
+            k_seq = k_seq.astype(jnp.float32) * ks
+            v_seq = v_seq.astype(jnp.float32) * vs
+    k_seq = k_seq.transpose(0, 2, 1, 3, 4).reshape(b, nkv, S, hd)
+    v_seq = v_seq.transpose(0, 2, 1, 3, 4).reshape(b, nkv, S, hd)
+
+    qg = q.reshape(b, nkv, rep, hd)
+    logits = jnp.einsum("bngd,bnsd->bngs", qg.astype(jnp.float32),
+                        k_seq.astype(jnp.float32)) * scale
+    mask = jnp.arange(S)[None, None, None, :] < seq_lens[:, None, None, None]
+    logits = jnp.where(mask, logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    p = jnp.where(seq_lens[:, None, None, None] > 0, p, 0.0)
+    out = jnp.einsum("bngs,bnsd->bngd", p, v_seq.astype(jnp.float32))
+    return out.reshape(b, nh, hd).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+def _dispatch(q, key_cache, value_cache, block_tables, seq_lens, k_scale,
+              v_scale, scale, kv_quant):
+    """Forward dispatch: Pallas kernel when supported, gather oracle
+    otherwise (and the trace-time path counters)."""
+    global KERNEL_CALLS, FALLBACK_CALLS
+    b, nh, hd = q.shape
+    num_blocks, nkv, bs, _ = key_cache.shape
+    if not kernel_supported(nh, nkv, hd, bs):
+        FALLBACK_CALLS += 1
+        return paged_attention_reference(
+            q, key_cache, value_cache, block_tables, seq_lens, scale=scale,
+            kv_quant=kv_quant, k_scale=k_scale, v_scale=v_scale)
+    KERNEL_CALLS += 1
+
+    rep = nh // nkv
+    group = _round_up(rep, _MIN_GROUP_ROWS)
+    qg = q.reshape(b, nkv, rep, hd)
+    if group != rep:
+        # pad the q-head group to a full sublane; padded rows attend over
+        # the same pages (finite logits) and are sliced off below
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, group - rep), (0, 0)))
+    out = _paged_attention_kernel_call(
+        qg, key_cache, value_cache, block_tables, seq_lens, scale,
+        kv_quant, k_scale, v_scale)
+    return out[:, :, :rep].reshape(b, nh, hd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def _paged_core(q, key_cache, value_cache, block_tables, seq_lens, k_scale,
+                v_scale, scale, kv_quant):
+    # custom_vjp so the eager tape / jit-grad compose (the repo's kernel
+    # contract, ops/pallas/__init__.py): pallas_call has no AD rule, so the
+    # backward recomputes through the pure-JAX reference instead
+    return _dispatch(q, key_cache, value_cache, block_tables, seq_lens,
+                     k_scale, v_scale, scale, kv_quant)
+
+
+def _paged_core_fwd(q, key_cache, value_cache, block_tables, seq_lens,
+                    k_scale, v_scale, scale, kv_quant):
+    out = _dispatch(q, key_cache, value_cache, block_tables, seq_lens,
+                    k_scale, v_scale, scale, kv_quant)
+    return out, (q, key_cache, value_cache, block_tables, seq_lens,
+                 k_scale, v_scale)
+
+
+def _paged_core_bwd(scale, kv_quant, res, g):
+    q, key_cache, value_cache, block_tables, seq_lens, k_scale, v_scale = res
+    zero = lambda x: None if x is None else jnp.zeros_like(x)
+    if kv_quant is None:
+        _, vjp = jax.vjp(
+            lambda q_, kc_, vc_: paged_attention_reference(
+                q_, kc_, vc_, block_tables, seq_lens, scale=scale),
+            q, key_cache, value_cache)
+        dq, dkc, dvc = vjp(g)
+    else:
+        # quantized caches are not differentiable storage: grads flow to q
+        _, vjp = jax.vjp(
+            lambda q_: paged_attention_reference(
+                q_, key_cache, value_cache, block_tables, seq_lens,
+                scale=scale, kv_quant=kv_quant, k_scale=k_scale,
+                v_scale=v_scale),
+            q)
+        (dq,) = vjp(g)
+        dkc, dvc = zero(key_cache), zero(value_cache)
+    return (dq, dkc, dvc, zero(block_tables), zero(seq_lens),
+            zero(k_scale), zero(v_scale))
+
+
+_paged_core.defvjp(_paged_core_fwd, _paged_core_bwd)
+
+
+def paged_attention_decode(q, key_cache, value_cache, block_tables, seq_lens,
+                           scale=None, kv_quant=None, k_scale=None,
+                           v_scale=None):
+    """Ragged paged-attention decode over a block-table KV cache.
+
+    Args:
+      q: [b, num_heads, head_dim] — one query token per slot (GQA/MQA: any
+        num_heads divisible by the caches' kv heads).
+      key_cache/value_cache: [num_blocks, num_kv_heads, block_size, head_dim]
+        pages (bf16/f32), or quantized storage per ``kv_quant``:
+        'int8' → int8 same shape, 'int4' → int8 [..., head_dim // 2] with
+        two nibbles per byte (:func:`quantize_kv_cache`).
+      block_tables: [b, max_blocks] int32 physical page ids; entries past a
+        slot's live pages may be arbitrary/sentinel (they are never read).
+      seq_lens: [b] int32 valid KV length per slot (0 → output zeros).
+      k_scale/v_scale: [num_blocks, num_kv_heads] f32 (quantized caches).
+
+    Returns [b, num_heads, head_dim] in q's dtype.  Dispatches to the Pallas
+    kernel when :func:`kernel_supported`; otherwise (or under
+    ``PADDLE_TPU_DISABLE_PALLAS=paged_attention``) to the gather reference.
+    """
+    assert kv_quant in (None, "int8", "int4"), kv_quant
+    b, nh, hd = q.shape
+    num_blocks, nkv, bs, hd_store = key_cache.shape
+    if kv_quant == "int4":
+        assert hd_store * 2 == hd, (hd_store, hd)
+    else:
+        assert hd_store == hd, (hd_store, hd)
+    if kv_quant:
+        assert k_scale is not None and v_scale is not None, (
+            "quantized KV caches need k_scale/v_scale")
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    return _paged_core(q, key_cache, value_cache, block_tables, seq_lens,
+                       k_scale, v_scale, scale, kv_quant)

@@ -28,6 +28,16 @@ TARGETS = {
     "moe_dense_equiv": "moe_dense_equiv_mfu/",
     "cb_paged": "llama_cb_decode_tokens_per_sec/cb_full_chunk8_paged",
     "cb_3b_int4": "llama_cb_decode_tokens_per_sec/cb_3b_chunk8_int4",
+    # round-6 evidence rungs: ragged paged-attention Pallas kernel vs the
+    # gather oracle, uniform and skewed-seq_lens (docs/paged_attention.md)
+    "cb_paged_kernel":
+        "llama_cb_decode_tokens_per_sec/cb_full_chunk8_paged_kernel",
+    "cb_paged_ragged_kernel":
+        "llama_cb_decode_tokens_per_sec/cb_paged_ragged_kernel",
+    "cb_paged_ragged_gather":
+        "llama_cb_decode_tokens_per_sec/cb_paged_ragged_gather",
+    "cb_3b_paged_kernel":
+        "llama_cb_decode_tokens_per_sec/cb_3b_chunk8_int4_paged_kernel",
 }
 
 
@@ -37,8 +47,15 @@ def families_banked() -> dict:
             keys = list(json.load(f).get("rungs", {}))
     except (OSError, json.JSONDecodeError):
         keys = []
-    return {fam: any(k.startswith(p) for k in keys)
-            for fam, p in TARGETS.items()}
+
+    def hit(k: str, p: str) -> bool:
+        # "metric/" targets are families (any rung counts); full
+        # "metric/rung" targets must match EXACTLY — prefix matching would
+        # let cb_full_chunk8_paged_kernel satisfy cb_full_chunk8_paged and
+        # silently drop the gather half of the kernel-vs-gather A/B
+        return k.startswith(p) if p.endswith("/") else k == p
+
+    return {fam: any(hit(k, p) for k in keys) for fam, p in TARGETS.items()}
 
 
 def relay_healthy(timeout: int = 150) -> bool:
