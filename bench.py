@@ -509,6 +509,91 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
     }
 
 
+def run_cb_prefix_rung(name, cfg, max_batch, n_requests, shared_len,
+                       unique_len, new, max_seq, chunk, num_blocks,
+                       quant=None, hot=True, block_size=64):
+    """Prefix-cache A/B rung (ISSUE 2): ``hot`` serves ``n_requests`` that all
+    share a ``shared_len``-token system prompt (the production workload shape
+    the cache exists for — admission maps the cached prefix and prefills only
+    the unique tail); ``cold`` pushes same-size DISJOINT prompts through the
+    same caching engine (the overhead bound: every request misses).  Records
+    TTFT alongside tokens/s — skipped prefill moves time-to-first-token, not
+    steady-state decode throughput."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
+
+    log(f"cb prefix rung {name}: building (slots={max_batch} "
+        f"requests={n_requests} shared={shared_len if hot else 0} "
+        f"quant={quant})")
+    rs = np.random.RandomState(0)
+    total = shared_len + unique_len
+    shared = rs.randint(0, cfg.vocab_size, (shared_len,)).astype(np.int32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
+                                   max_seq=max_seq, chunk=chunk, quant=quant,
+                                   paged=True, block_size=block_size,
+                                   num_blocks=num_blocks,
+                                   enable_prefix_caching=True)
+    del params  # quantized rungs: free the fp tree before serving
+    t_c = time.perf_counter()
+    # warm the full-prefill bucket + decode programs with a disjoint prompt
+    eng.serve([Request(rid=-1, prompt_ids=rs.randint(
+        0, cfg.vocab_size, (total,)).astype(np.int32), max_new_tokens=2)])
+    if hot:
+        # leave the shared prefix resident AND compile the partial-prefill
+        # bucket — the steady-state the hot rung measures
+        eng.serve([Request(rid=-2, prompt_ids=np.concatenate(
+            [shared, rs.randint(0, cfg.vocab_size, (unique_len,))
+             .astype(np.int32)]), max_new_tokens=2)])
+    log(f"cb prefix rung {name}: compile {time.perf_counter() - t_c:.1f}s")
+    eng.stats.update(decode_steps=0, decode_tokens=0, decode_time_s=0.0,
+                     prefix_hits=0, prefix_blocks_reused=0,
+                     prefix_evictions=0, cow_copies=0,
+                     prefill_tokens_computed=0, prefill_tokens_cached=0)
+    if hot:
+        reqs = [Request(rid=i, prompt_ids=np.concatenate(
+                    [shared, rs.randint(0, cfg.vocab_size, (unique_len,))
+                     .astype(np.int32)]), max_new_tokens=new)
+                for i in range(n_requests)]
+    else:
+        reqs = [Request(rid=i, prompt_ids=rs.randint(
+                    0, cfg.vocab_size, (total,)).astype(np.int32),
+                    max_new_tokens=new)
+                for i in range(n_requests)]
+    t0 = time.perf_counter()
+    eng.serve(reqs)
+    wall = time.perf_counter() - t0
+    ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
+    computed = eng.stats["prefill_tokens_computed"]
+    cached = eng.stats["prefill_tokens_cached"]
+    return {
+        "metric": "llama_cb_decode_tokens_per_sec",
+        "value": round(eng.decode_tokens_per_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "detail": {"rung": name, "slots": max_batch, "requests": n_requests,
+                   "hot": hot, "shared_prefix_tokens": shared_len if hot else 0,
+                   "prompt_tokens": total, "new_tokens": new,
+                   "wall_s": round(wall, 2), "chunk": chunk, "quant": quant,
+                   "ttft_mean_s": round(sum(ttfts) / len(ttfts), 4)
+                   if ttfts else None,
+                   "ttft_max_s": round(max(ttfts), 4) if ttfts else None,
+                   "prefix_hits": eng.stats["prefix_hits"],
+                   "prefix_blocks_reused": eng.stats["prefix_blocks_reused"],
+                   "prefix_evictions": eng.stats["prefix_evictions"],
+                   "cow_copies": eng.stats["cow_copies"],
+                   "prefill_tokens_computed": computed,
+                   "prefill_tokens_cached": cached,
+                   "prefill_hit_rate": round(cached / max(computed + cached, 1),
+                                             4),
+                   "preemptions": eng.stats["preemptions"],
+                   "backend": jax.default_backend()},
+    }
+
+
 def decode_ladder_main(compact: bool = False) -> int:
     import jax
 
@@ -604,6 +689,31 @@ def decode_ladder_main(compact: bool = False) -> int:
         except Exception as e:
             # isolated: a 3B OOM must not cost the paged rung its evidence
             log(f"cb rung {rung[0]} failed: {e}\n{traceback.format_exc()}")
+            continue
+    # automatic-prefix-cache A/B (ISSUE 2): 16 requests sharing a 256-token
+    # system prompt vs disjoint prompts through the SAME caching engine, plus
+    # the 3B int4 variant.  Pool sized so the workload is prefix-bound, not
+    # preemption-bound (6 pages/request resident + cached-prefix headroom).
+    # (rung tuple: cfg, slots, requests, shared, unique, new, max_seq, chunk,
+    # num_blocks, quant, hot[, block_size])
+    prefix_rungs = ([
+        ("cb_prefix_hot", full_cfg, 8, 16, 256, 32, 64, 512, 8, 56,
+         None, True),
+        ("cb_prefix_cold", full_cfg, 8, 16, 256, 32, 64, 512, 8, 56,
+         None, False),
+        ("cb_3b_prefix_hot_int4", cfg_3b, 4, 8, 256, 32, 64, 512, 8, 28,
+         "int4", True),
+    ] if on_tpu else [
+        ("cb_prefix_cpu_smoke", llama.LlamaConfig.tiny(), 2, 4, 16, 8, 8,
+         64, 2, 12, None, True, 8),
+    ])
+    for rung in prefix_rungs:
+        try:
+            emit(run_cb_prefix_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"cb prefix rung {rung[0]} failed: {e}\n"
+                f"{traceback.format_exc()}")
             continue
     return 0 if banked else 1
 

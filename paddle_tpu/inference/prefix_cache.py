@@ -1,0 +1,236 @@
+"""Automatic prefix cache: content-addressed KV block reuse (ISSUE 2 tentpole).
+
+Reference analog: vLLM's automatic prefix caching over the paged KV pool the
+reference's ``block_multihead_attention_`` memory model implies
+(`paddle/phi/ops/yaml/fused_ops.yaml:45`) — production serving traffic is
+dominated by requests sharing a system prompt / few-shot prefix, and
+re-prefilling that prefix burns the same FLOPs and HBM on every request.
+
+Design (docs/prefix_cache.md):
+
+* **Content addressing by hash chain.**  Every FULL block of ``block_size``
+  tokens gets an id ``hash(parent_hash, block_token_ids)``.  Chaining makes
+  the id a digest of the *entire prefix up to and including this block*, so
+  one dict keyed by chained hash IS a radix index over token prefixes: walking
+  a prompt block-by-block and chaining hashes descends the radix tree, and the
+  first missing hash is the divergence point (two prompts sharing k blocks
+  share exactly k chained hashes, never more).
+* **Refcounts, not ownership.**  A cached block records how many engine slots
+  currently map its physical page read-only.  Release decrements; a zero-ref
+  block STAYS RESIDENT (its page is not on the engine free list) so hot
+  prefixes survive between requests.
+* **LRU eviction only under allocation pressure.**  The engine asks for pages
+  only when its free list runs dry; eviction pops least-recently-released
+  zero-ref blocks, leaf-first (a parent is never evicted before its cached
+  children — an unreachable child would strand a page the radix walk can no
+  longer find).  Because a slot that maps block b also maps b's parent,
+  ``parent.refcount >= child.refcount`` always holds and leaf-first order is
+  achievable.
+* **Copy-on-write on divergence.**  The engine never writes a shared page:
+  when an admitted request would decode into a fully-matched block (prompt
+  length a multiple of ``block_size`` with every prompt block cached), the
+  engine copies that page into a private one first (see
+  ``ContinuousBatchingEngine._admit``); mid-block prompt divergence needs no
+  COW at all — block-granular matching simply stops at the last shared block.
+
+The cache stores only host-side metadata (hashes, page ids, refcounts); the
+K/V bytes live in the engine's paged pools and are read by the ragged
+paged-attention Pallas kernel unchanged — shared pages are just block-table
+entries appearing in more than one row.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+
+import numpy as np
+
+__all__ = ["PrefixCache", "CachedBlock"]
+
+
+class CachedBlock:
+    """One cached full block: physical page + chain metadata."""
+
+    __slots__ = ("hash", "page", "parent", "refcount", "children", "last_used")
+
+    def __init__(self, hash_: str, page: int, parent: str | None):
+        self.hash = hash_
+        self.page = page            # physical page index in the engine pool
+        self.parent = parent        # chained hash of the previous block
+        self.refcount = 0           # slots currently mapping this page
+        self.children = 0           # cached blocks whose parent is this one
+        self.last_used = 0          # LRU tick of the last ref drop to zero
+
+    def __repr__(self):  # debugging aid only
+        return (f"CachedBlock({self.hash[:8]}, page={self.page}, "
+                f"ref={self.refcount}, kids={self.children})")
+
+
+class PrefixCache:
+    """Block-granular content-addressed index over a paged KV pool.
+
+    Pure host-side control plane: the engine owns the device pools and the
+    free list; this class owns the hash→block index and the refcount/LRU
+    bookkeeping.  Accounting invariant (asserted by tests): every pool page is
+    in exactly one of {engine free list, a slot's private blocks, this cache}.
+    """
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self._by_hash: dict[str, CachedBlock] = {}
+        self._tick = 0
+        # lazy min-heap of (last_used, hash) eviction candidates: entries are
+        # pushed whenever a block becomes a zero-ref leaf and validated on
+        # pop (still resident / still leaf / still zero-ref / tick current),
+        # so pressure eviction is O(log n) amortized per page instead of a
+        # full-index scan per page in the decode hot loop
+        self._evict_heap: list[tuple[int, str]] = []
+        # exact zero-ref count, maintained incrementally for the same reason:
+        # the engine reads evictable_count() on EVERY admission attempt
+        self._n_zero_ref = 0
+
+    # ---------------- hashing / lookup ----------------
+
+    @staticmethod
+    def chain_hash(parent: str | None, tokens) -> str:
+        """Content id of a full block: digest of (parent chain id, tokens).
+        sha256 over the raw int32 bytes — collisions across distinct prefixes
+        are cryptographically negligible, so hash equality is treated as
+        content equality (the vLLM trade; tests assert non-collision across
+        adversarial near-miss prefixes)."""
+        h = hashlib.sha256()
+        h.update(b"root" if parent is None else parent.encode("ascii"))
+        h.update(b"|")
+        h.update(np.ascontiguousarray(np.asarray(tokens, np.int32)).tobytes())
+        return h.hexdigest()
+
+    def chain_hashes(self, token_ids, n_blocks: int) -> list[str]:
+        """Chained hashes of the first ``n_blocks`` full blocks of a stream."""
+        ids = np.asarray(token_ids, np.int32).ravel()
+        out: list[str] = []
+        parent = None
+        bs = self.block_size
+        for b in range(n_blocks):
+            parent = self.chain_hash(parent, ids[b * bs:(b + 1) * bs])
+            out.append(parent)
+        return out
+
+    def match(self, token_ids) -> list[CachedBlock]:
+        """Longest cached chain of full blocks prefixing ``token_ids``.
+
+        Radix descent: walk full blocks, chain hashes, stop at the first id
+        not in the index.  Does NOT touch refcounts — the caller acquires the
+        blocks it actually maps (and must do so before any allocation that
+        could trigger eviction)."""
+        ids = np.asarray(token_ids, np.int32).ravel()
+        bs = self.block_size
+        out: list[CachedBlock] = []
+        parent = None
+        for b in range(ids.size // bs):
+            h = self.chain_hash(parent, ids[b * bs:(b + 1) * bs])
+            e = self._by_hash.get(h)
+            if e is None:
+                break
+            out.append(e)
+            parent = h
+        return out
+
+    # ---------------- refcounting ----------------
+
+    def acquire(self, block: CachedBlock) -> None:
+        """Pin a matched block: a nonzero refcount makes it unevictable."""
+        if block.refcount == 0:
+            self._n_zero_ref -= 1
+        block.refcount += 1
+
+    def release(self, hash_: str) -> None:
+        """Drop one slot's reference; at zero the block becomes an LRU
+        eviction candidate but stays resident (hot prefixes survive)."""
+        e = self._by_hash[hash_]
+        assert e.refcount > 0, f"release of zero-ref cached block {hash_[:8]}"
+        e.refcount -= 1
+        if e.refcount == 0:
+            self._n_zero_ref += 1
+            self._tick += 1
+            e.last_used = self._tick
+            if e.children == 0:
+                heapq.heappush(self._evict_heap, (e.last_used, e.hash))
+
+    # ---------------- registration ----------------
+
+    def register(self, parent: str | None, tokens, page: int,
+                 refcount: int = 0) -> CachedBlock | None:
+        """Insert one full block (content ``tokens``, physical ``page``).
+
+        Returns the new entry — ownership of ``page`` transfers to the cache —
+        or None when the chained hash already exists (identical content was
+        registered concurrently; the caller keeps its duplicate page and frees
+        it through its normal private-page path, so no page is ever tracked
+        twice)."""
+        h = self.chain_hash(parent, tokens)
+        if h in self._by_hash:
+            return None
+        e = CachedBlock(h, int(page), parent)
+        e.refcount = int(refcount)
+        if refcount == 0:
+            self._n_zero_ref += 1
+            self._tick += 1
+            e.last_used = self._tick
+            heapq.heappush(self._evict_heap, (e.last_used, h))
+        if parent is not None:
+            pe = self._by_hash.get(parent)
+            if pe is None:
+                # parent was evicted between the caller's match and this
+                # register: the block would be unreachable by radix descent —
+                # refuse (caller keeps the page private)
+                return None
+            pe.children += 1
+        self._by_hash[h] = e
+        return e
+
+    # ---------------- eviction (allocation pressure only) ----------------
+
+    def evictable_count(self) -> int:
+        """Pages reclaimable right now (zero-ref; leaf-first order means every
+        zero-ref block is eventually reachable by repeated leaf eviction, so
+        admission headroom may count them all).  O(1): maintained
+        incrementally — the engine calls this per admission attempt."""
+        return self._n_zero_ref
+
+    def evict(self, n: int) -> list[int]:
+        """Reclaim up to ``n`` pages, least-recently-used zero-ref leaves
+        first.  Pops the lazy heap, skipping stale records (re-acquired,
+        re-parented, already evicted, or superseded by a fresher tick);
+        evicting a leaf may turn its parent into a leaf, which is pushed
+        immediately so chains drain oldest-first without any index scan."""
+        pages: list[int] = []
+        while len(pages) < n and self._evict_heap:
+            tick, h = heapq.heappop(self._evict_heap)
+            victim = self._by_hash.get(h)
+            if (victim is None or victim.refcount != 0
+                    or victim.children != 0 or victim.last_used != tick):
+                continue  # stale heap record
+            del self._by_hash[h]
+            self._n_zero_ref -= 1
+            if victim.parent is not None:
+                pe = self._by_hash.get(victim.parent)
+                if pe is not None:
+                    pe.children -= 1
+                    if pe.children == 0 and pe.refcount == 0:
+                        heapq.heappush(self._evict_heap,
+                                       (pe.last_used, pe.hash))
+            pages.append(victim.page)
+        return pages
+
+    # ---------------- accounting / introspection ----------------
+
+    def resident_blocks(self) -> int:
+        """Pages currently owned by the cache (referenced + zero-ref)."""
+        return len(self._by_hash)
+
+    def resident_pages(self) -> list[int]:
+        return [e.page for e in self._by_hash.values()]
+
+    def __len__(self) -> int:
+        return len(self._by_hash)

@@ -39,6 +39,18 @@ dry the youngest slot is preempted vLLM-style (blocks freed, request
 requeued with prompt+generated so far; the stored tokens are teacher-forced
 on resume, which makes the recompute exact for greedy AND sampled decode).
 
+``enable_prefix_caching=True`` (paged mode only) layers an automatic prefix
+cache over the block pool (prefix_cache.py, docs/prefix_cache.md): every full
+block gets a hash-chained content id, admission maps the longest cached
+prefix into the slot's block-table row read-only (refcounted), prefill starts
+at the first uncached token (partial-bucket prefill), release/retire/preempt
+decrement refs instead of freeing, zero-ref blocks stay resident until
+allocation pressure LRU-evicts them, and a fully-matched block that decode
+would write into is copy-on-write duplicated first.  The paged-attention
+kernel reads shared pages unchanged — sharing is purely block-table aliasing.
+Opt-out: ``PADDLE_TPU_PREFIX_CACHE=0``; with caching off (the default) the
+engine is byte-identical to the PR 1 engine.
+
 Per-request sampling (reference: ``top_p_sampling``, ops.yaml:4947) runs
 inside the jitted step: temperature/top-p/seed are per-slot DATA vectors, so
 one compiled program serves mixed greedy/sampled batches, and RNG keys
@@ -53,12 +65,15 @@ and CUDA kernels.
 from __future__ import annotations
 
 import functools
+import os
 import time
 from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..profiler import RecordEvent
 
 __all__ = ["Request", "ContinuousBatchingEngine"]
 
@@ -77,6 +92,7 @@ class Request:
     # filled by the engine
     output_ids: list = field(default_factory=list)
     finished: bool = False
+    ttft_s: float | None = None  # submit -> first generated token (wall s)
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -95,7 +111,8 @@ class ContinuousBatchingEngine:
 
     def __init__(self, cfg, params, max_batch: int = 8, max_seq: int = 512,
                  chunk: int = 1, quant: str | None = None, paged: bool = False,
-                 block_size: int = 64, num_blocks: int | None = None):
+                 block_size: int = 64, num_blocks: int | None = None,
+                 enable_prefix_caching: bool = False):
         """``chunk``: decode steps per compiled call.  Tokens feed back
         on-device inside a lax.scan and the host fetches ``chunk`` tokens per
         round-trip — the lever against host-device latency (one RTT per token
@@ -107,7 +124,10 @@ class ContinuousBatchingEngine:
         ``paged``: block-table KV cache (``block_size`` tokens per page,
         ``num_blocks`` pages shared by all slots; default num_blocks gives
         half the dense pool's capacity — the paged mode's point is serving
-        more logical context than physically reserved HBM)."""
+        more logical context than physically reserved HBM).
+        ``enable_prefix_caching``: content-addressed reuse of full KV blocks
+        across requests (paged mode only; see prefix_cache.py).  Kill switch:
+        ``PADDLE_TPU_PREFIX_CACHE=0`` forces it off regardless."""
         from ..models import llama as _llama  # noqa: F401  (cfg type lives there)
 
         self.cfg = cfg
@@ -136,6 +156,10 @@ class ContinuousBatchingEngine:
             # host allocator state
             self._free: list[int] = list(range(self.num_blocks))
             self._slot_blocks: list[list[int]] = [[] for _ in range(max_batch)]
+            # shared (refcounted, read-only) cached blocks mapped at the FRONT
+            # of each slot's row; private writable pages follow — row layout
+            # [shared..., private...] is the allocator invariant
+            self._slot_shared: list[list[str]] = [[] for _ in range(max_batch)]
             # sentinel num_blocks = unallocated (oob: writes drop, reads are
             # masked by the causal/active mask before they matter)
             self._table = np.full((max_batch, self.max_blocks),
@@ -146,6 +170,31 @@ class ContinuousBatchingEngine:
             shape = (L, max_batch, nkv, max_seq, hd)
         self.cache_k = jnp.zeros(shape, cfg.dtype)
         self.cache_v = jnp.zeros(shape, cfg.dtype)
+        # automatic prefix cache (content-addressed KV block reuse).  The
+        # cache-off path must stay byte-identical to the plain paged engine,
+        # so EVERY cache behavior hangs off self._pcache being non-None.
+        self._pcache = None
+        # the env kill switch is checked FIRST so =0 neutralizes the feature
+        # totally — even an (invalid) paged=False request runs cache-off
+        # instead of raising, honoring "forces it off regardless"
+        if (enable_prefix_caching
+                and os.environ.get("PADDLE_TPU_PREFIX_CACHE", "1") != "0"):
+            if not paged:
+                raise ValueError("enable_prefix_caching requires paged=True "
+                                 "(the cache shares block-table pages)")
+            from .prefix_cache import PrefixCache
+
+            self._pcache = PrefixCache(block_size)
+            # page-granular COW: duplicate pool page src into dst across
+            # all layers (donated — no full-pool copy materializes)
+            self._copy_page = jax.jit(
+                lambda c, dst, src: c.at[:, dst].set(c[:, src]),
+                donate_argnums=(0,))
+            # partial-bucket prefill: compiled per bucket; start/length
+            # are DATA so one program serves every hit depth
+            self._prefill_prefix = jax.jit(
+                self._prefill_impl_paged_prefix, donate_argnums=(2, 3),
+                static_argnums=(7,))
         # slot state (host side)
         self._slot_req: list[Request | None] = [None] * max_batch
         self._pos = np.zeros(max_batch, np.int32)      # next write position
@@ -171,7 +220,13 @@ class ContinuousBatchingEngine:
         self._prefill = jax.jit(pimpl, donate_argnums=(2, 3),
                                 static_argnums=(6,))
         self.stats = {"decode_steps": 0, "decode_tokens": 0,
-                      "prefills": 0, "decode_time_s": 0.0, "preemptions": 0}
+                      "prefills": 0, "decode_time_s": 0.0, "preemptions": 0,
+                      # prefix-cache observability (all zero with caching off;
+                      # prefill token counters tick on every engine so hot/cold
+                      # A-Bs read straight off stats)
+                      "prefix_hits": 0, "prefix_blocks_reused": 0,
+                      "prefix_evictions": 0, "cow_copies": 0,
+                      "prefill_tokens_computed": 0, "prefill_tokens_cached": 0}
 
     # ---------------- compiled programs ----------------
 
@@ -316,7 +371,7 @@ class ContinuousBatchingEngine:
                                 temp, topp, seeds, sampling=sampling)
 
     def _prefill_body(self, params, ids, cache_k, cache_v, length, bucket,
-                      write):
+                      write, start=None):
         """Shared prefill: embed/rope/mask once, write-path injected (dense
         lane vs paged block table) so mask/rope fixes cannot diverge.
 
@@ -324,7 +379,12 @@ class ContinuousBatchingEngine:
         (they still write cache positions, which the causal mask makes
         unreachable until the slot's pos pointer passes them — it never does,
         decode overwrites).  No logits are computed: the last real prompt
-        token is fed to the first decode step instead (standard split)."""
+        token is fed to the first decode step instead (standard split).
+
+        ``start`` (traced scalar, prefix-cache hits only): ``ids`` holds
+        tokens at ABSOLUTE positions start..start+bucket-1 — rope tables and
+        the causal mask shift accordingly, and ``length`` stays the absolute
+        total.  ``start=None`` keeps the original program byte-for-byte."""
         from .. import inference as _inf
         from ..ops.pallas import rope as rope_mod
 
@@ -334,10 +394,17 @@ class ContinuousBatchingEngine:
         cos_full, sin_full = rope_mod.rope_cos_sin(S, cfg.head_dim,
                                                    base=cfg.rope_theta,
                                                    dtype=cfg.dtype)
-        cos = cos_full[:, :bucket]
-        sin = sin_full[:, :bucket]
+        if start is None:
+            cos = cos_full[:, :bucket]
+            sin = sin_full[:, :bucket]
+            q_pos = jnp.arange(bucket)[None, None, None, :, None]
+        else:
+            pos_j = start + jnp.arange(bucket)      # absolute positions
+            safe_j = jnp.minimum(pos_j, S - 1)      # bucket may overrun S
+            cos = jnp.take(cos_full[0], safe_j, axis=0)[None]
+            sin = jnp.take(sin_full[0], safe_j, axis=0)[None]
+            q_pos = pos_j[None, None, None, :, None]
         kv_pos = jnp.arange(S)[None, None, None, None, :]
-        q_pos = jnp.arange(bucket)[None, None, None, :, None]
         mask = (kv_pos <= q_pos) & (kv_pos < length)
         _, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
                                            write, mask, cos, sin)
@@ -394,26 +461,151 @@ class ContinuousBatchingEngine:
         return self._prefill_body(params, ids, cache_k, cache_v, length,
                                   bucket, write)
 
+    def _prefill_impl_paged_prefix(self, params, ids, cache_k, cache_v,
+                                   table_row, start, length, bucket):
+        """Partial-bucket prefill for a prefix-cache hit: ``ids`` [1, bucket]
+        holds the prompt's UNCACHED tail — tokens at ABSOLUTE positions
+        start..start+bucket-1, padded to ``bucket`` (the only static arg, so
+        compile variants stay log2-bounded; start/length are data).  Attention
+        reads the full gathered view, whose leading pages are the shared
+        cached prefix; writes land only at positions in [start, length), so a
+        shared page is never written (COW at admission guarantees the first
+        decode position's block is private too).  Embed/rope/mask come from
+        the shared ``_prefill_body`` (its ``start`` mode) — only the
+        position-offset page scatter lives here."""
+        cfg = self.cfg
+        S = self.max_seq
+        bs_ = self.block_size
+        nkv, hd = cfg.num_key_value_heads, cfg.head_dim
+        pos_j = start + jnp.arange(bucket)  # absolute positions  [bucket]
+        safe_j = jnp.minimum(pos_j, S - 1)
+        blk_j = table_row[safe_j // bs_]
+        # padding (pos >= length) and anything past max_seq must not write
+        blk_j = jnp.where((pos_j < length) & (pos_j < S), blk_j,
+                          self.num_blocks)
+        off_j = safe_j % bs_
+
+        def write(ck, k):
+            out = ck.at[blk_j, :, off_j].set(k[0], mode="drop")
+            view = jnp.take(out, table_row, axis=0,  # [maxblk, nkv, bs, hd]
+                            mode="fill", fill_value=0)
+            view = view.transpose(1, 0, 2, 3).reshape(1, nkv, S, hd)
+            return out, view
+
+        return self._prefill_body(params, ids, cache_k, cache_v, length,
+                                  bucket, write, start=start)
+
     # ---------------- block allocator (host control plane) ----------------
 
     def _blocks_needed(self, last_pos: int) -> int:
         return min(last_pos, self.max_seq - 1) // self.block_size + 1
 
     def _alloc_to(self, slot: int, n_blocks: int) -> bool:
-        """Grow slot to n_blocks pages; False if the pool runs dry."""
+        """Grow slot to n_blocks pages (shared cached prefix counts); False if
+        the pool runs dry.  Under prefix caching, allocation pressure first
+        LRU-evicts zero-ref cached blocks — eviction happens ONLY here, so
+        resident hot prefixes are sacrificed last, never proactively."""
         owned = self._slot_blocks[slot]
-        while len(owned) < n_blocks:
-            if not self._free:
+        base = len(self._slot_shared[slot])
+        while base + len(owned) < n_blocks:
+            if not self._free and not self._reclaim(1):
                 return False
             b = self._free.pop()
-            self._table[slot, len(owned)] = b
+            self._table[slot, base + len(owned)] = b
             owned.append(b)
         return True
+
+    def _reclaim(self, n: int) -> int:
+        """Evict up to n zero-ref cached blocks into the free list."""
+        if self._pcache is None:
+            return 0
+        with RecordEvent("prefix_cache/evict"):
+            pages = self._pcache.evict(n)
+        if pages:
+            self._free.extend(pages)
+            self.stats["prefix_evictions"] += len(pages)
+        return len(pages)
+
+    def _evictable(self) -> int:
+        return self._pcache.evictable_count() if self._pcache is not None else 0
 
     def _release(self, slot: int):
         self._free.extend(self._slot_blocks[slot])
         self._slot_blocks[slot] = []
+        if self._slot_shared[slot]:
+            # shared pages are refcounted, not freed: at zero refs they stay
+            # resident in the cache until eviction needs them
+            for h in self._slot_shared[slot]:
+                self._pcache.release(h)
+            self._slot_shared[slot] = []
         self._table[slot, :] = self.num_blocks
+
+    def _register_prefix_blocks(self, slot: int, ids: np.ndarray,
+                                valid_len: int):
+        """After an admission's prefill: move the newly-computed full prompt
+        blocks (beyond the matched shared prefix) into the cache with this
+        slot holding a reference — a request admitted later in the SAME step
+        already hits.  Transfers are a contiguous front of the private list,
+        preserving the [shared..., private...] row layout."""
+        bs_ = self.block_size
+        n_shared = len(self._slot_shared[slot])
+        limit = valid_len // bs_            # blocks fully written by prefill
+        if limit <= n_shared:
+            return
+        # continue the chain from the mapped shared prefix — each new block
+        # is hashed exactly once (inside register), nothing is re-hashed
+        parent = self._slot_shared[slot][-1] if n_shared else None
+        for b in range(n_shared, limit):
+            e = self._pcache.register(parent, ids[b * bs_:(b + 1) * bs_],
+                                      self._slot_blocks[slot][0], refcount=1)
+            if e is None:
+                # defensive only: in the single-threaded admit flow nothing
+                # can insert between match() and here, and leaf-first
+                # eviction can't orphan a parent mid-chain — but if either
+                # invariant ever breaks, keeping the page private (freed by
+                # _release) is the safe degradation
+                break
+            parent = e.hash
+            self._slot_blocks[slot].pop(0)
+            self._slot_shared[slot].append(e.hash)
+
+    def _register_retired_blocks(self, slot: int):
+        """Before releasing a finishing/preempted slot: donate its full,
+        content-known private blocks to the cache as zero-ref residents, so
+        the prefix (prompt AND generated tokens — the preempt-resume path
+        re-admits exactly this stream) survives for future requests.
+        Positions are trusted only up to min(pos, len(prompt+output),
+        max_seq): chunk-tail writes past the delivered tokens hold post-EOS
+        garbage and must never be content-addressed."""
+        if self._pcache is None:
+            return
+        req = self._slot_req[slot]
+        seq = np.concatenate([np.asarray(req.prompt_ids, np.int32).ravel(),
+                              np.asarray(req.output_ids, np.int32)])
+        trusted = min(int(self._pos[slot]), seq.size, self.max_seq)
+        bs_ = self.block_size
+        n_shared = len(self._slot_shared[slot])
+        limit = trusted // bs_
+        if limit <= n_shared:
+            return
+        # the slot's shared prefix IS the chain over seq's first n_shared
+        # blocks — continue from its tip instead of re-hashing the prefix
+        parent = self._slot_shared[slot][-1] if n_shared else None
+        keep: list[int] = []
+        for i, page in enumerate(self._slot_blocks[slot]):
+            b = n_shared + i
+            if b < limit:
+                tokens = seq[b * bs_:(b + 1) * bs_]
+                e = self._pcache.register(parent, tokens, page, refcount=0)
+                if e is not None:
+                    parent = e.hash
+                    continue               # ownership moved to the cache
+                # duplicate content (identical stream retired earlier): the
+                # page stays private, but later blocks still chain through
+                # the EXISTING entry's id
+                parent = self._pcache.chain_hash(parent, tokens)
+            keep.append(page)              # partial tail / duplicate content
+        self._slot_blocks[slot] = keep
 
     def _preempt(self, slot: int):
         """vLLM-style recompute preemption: free the slot, requeue the
@@ -428,6 +620,10 @@ class ContinuousBatchingEngine:
         # keep seniority across the round trip: a resumed request must not
         # become the youngest slot and the repeat victim (preemption thrash)
         req._resume_age = int(self._slot_age[slot])
+        # donate the computed prefix to the cache first: the resume re-admits
+        # prompt+generated, so its prefill restarts at the first uncached
+        # token instead of recomputing the whole stream
+        self._register_retired_blocks(slot)
         self._release(slot)
         self._slot_req[slot] = None
         self._temp[slot] = 0.0  # re-set on readmission
@@ -471,6 +667,7 @@ class ContinuousBatchingEngine:
 
     def add_request(self, req: Request):
         self._validate(req)
+        req._submit_s = time.perf_counter()  # TTFT epoch (bench rung detail)
         self._queue.append(req)
 
     def _admit(self):
@@ -486,6 +683,7 @@ class ContinuousBatchingEngine:
             if ids is None:
                 ids = np.asarray(req.prompt_ids, np.int32).ravel()
             s0 = ids.size
+            start = 0            # first token whose K/V must be computed
             if self.paged:
                 # admit only if the prompt's pages fit AND the active slots'
                 # imminent growth (next chunk) keeps its headroom — otherwise
@@ -493,19 +691,59 @@ class ContinuousBatchingEngine:
                 # same step, wasting its full-prompt prefill
                 headroom = sum(
                     self._blocks_needed(int(self._pos[s]) + self.chunk - 1)
-                    - len(self._slot_blocks[s])
+                    - len(self._slot_shared[s]) - len(self._slot_blocks[s])
                     for s in range(self.max_batch)
                     if self._slot_req[s] is not None)
                 need = self._blocks_needed(s0 - 1)
                 # gate on the new slot's own first-chunk growth too, or
                 # _ensure_growth would preempt someone in this same step
                 gate = self._blocks_needed(s0 - 2 + self.chunk)
-                if (len(self._free) < gate + headroom
+                # prefix-cache lookup: map the longest cached chain of full
+                # blocks into this row read-only.  Acquire BEFORE any
+                # allocation — a pinned (refcount > 0) block is unevictable,
+                # so _alloc_to's pressure eviction cannot steal the match.
+                matched = (self._pcache.match(ids)
+                           if self._pcache is not None else [])
+                m = len(matched)
+                # a fully-matched block-aligned prompt would put the first
+                # decode write (position s0-1) inside the last matched block:
+                # COW — copy that page into a private one instead of sharing
+                # (the engine NEVER writes a shared page)
+                cow = m > 0 and m * self.block_size > s0 - 1
+                n_map = m - 1 if cow else m
+                for e in matched:       # pin all, incl. the COW source
+                    self._pcache.acquire(e)
+                for i, e in enumerate(matched[:n_map]):
+                    self._table[slot, i] = e.page
+                    self._slot_shared[slot].append(e.hash)
+                avail = len(self._free) + self._evictable()
+                if (avail < gate - n_map + headroom
                         or not self._alloc_to(slot, need)):
-                    # roll back any partial allocation on this EMPTY slot —
-                    # stranded pages are invisible to every release path
+                    # roll back refs + any partial allocation on this EMPTY
+                    # slot — stranded pages/refs are invisible to every
+                    # release path
+                    if cow:
+                        self._pcache.release(matched[-1].hash)
                     self._release(slot)
                     break  # pool dry: keep queue order, retry next step
+                if cow:
+                    # private duplicate of the matched block decode will write
+                    src = matched[-1]
+                    dst = self._slot_blocks[slot][0]   # row index m-1
+                    with RecordEvent("prefix_cache/cow_copy"):
+                        d = jnp.asarray(dst, jnp.int32)
+                        s_ = jnp.asarray(src.page, jnp.int32)
+                        self.cache_k = self._copy_page(self.cache_k, d, s_)
+                        self.cache_v = self._copy_page(self.cache_v, d, s_)
+                    self._pcache.release(src.hash)  # content copied: unpin
+                    self.stats["cow_copies"] += 1
+                if m:
+                    self.stats["prefix_hits"] += 1
+                    self.stats["prefix_blocks_reused"] += m
+                # cached positions: all of a shared/COW block's K/V is
+                # already in the pool — prefill starts at the first
+                # uncached token (never past s0-1, decode's first position)
+                start = min(m * self.block_size, s0 - 1)
                 age = getattr(req, "_resume_age", None)
                 self._slot_age[slot] = self._admit_seq if age is None else age
                 self._admit_seq += 1
@@ -514,16 +752,38 @@ class ContinuousBatchingEngine:
                 del req._resume_ids
             if hasattr(req, "_resume_age"):
                 del req._resume_age
-            bucket = min(_bucket(s0), self.max_seq)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :s0] = ids
-            # the last real token is fed to decode, not prefill, so its
-            # logits come from the decode step (standard split)
-            slot_arg = (jnp.asarray(self._table[slot]) if self.paged
-                        else jnp.asarray(slot, jnp.int32))
-            self.cache_k, self.cache_v = self._prefill(
-                self.params, jnp.asarray(padded), self.cache_k, self.cache_v,
-                slot_arg, jnp.asarray(s0 - 1, jnp.int32), bucket)
+            plen = (s0 - 1) - start
+            self.stats["prefill_tokens_cached"] += start
+            self.stats["prefill_tokens_computed"] += max(plen, 0)
+            if start == 0:
+                bucket = min(_bucket(s0), self.max_seq)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :s0] = ids
+                # the last real token is fed to decode, not prefill, so its
+                # logits come from the decode step (standard split)
+                slot_arg = (jnp.asarray(self._table[slot]) if self.paged
+                            else jnp.asarray(slot, jnp.int32))
+                self.cache_k, self.cache_v = self._prefill(
+                    self.params, jnp.asarray(padded), self.cache_k,
+                    self.cache_v, slot_arg, jnp.asarray(s0 - 1, jnp.int32),
+                    bucket)
+                self.stats["prefills"] += 1
+            elif plen > 0:
+                # partial-bucket prefill over the uncached tail only
+                with RecordEvent("prefix_cache/partial_prefill"):
+                    bucket = min(_bucket(plen), self.max_seq)
+                    padded = np.zeros((1, bucket), np.int32)
+                    padded[0, :plen] = ids[start:s0 - 1]
+                    self.cache_k, self.cache_v = self._prefill_prefix(
+                        self.params, jnp.asarray(padded), self.cache_k,
+                        self.cache_v, jnp.asarray(self._table[slot]),
+                        jnp.asarray(start, jnp.int32),
+                        jnp.asarray(s0 - 1, jnp.int32), bucket)
+                self.stats["prefills"] += 1
+            # else: full hit — nothing to compute, decode starts immediately
+            if self.paged and self._pcache is not None:
+                # share this admission's freshly-computed full prompt blocks
+                self._register_prefix_blocks(slot, ids, s0 - 1)
             self._slot_req[slot] = req
             self._pos[slot] = s0 - 1
             self._last_tok[slot] = ids[-1]
@@ -534,10 +794,11 @@ class ContinuousBatchingEngine:
             # requests never share a stream
             self._seed[slot] = np.int32(
                 req.seed if req.seed is not None else req.rid)
-            self.stats["prefills"] += 1
 
     def _retire(self, slot):
         self._slot_req[slot].finished = True
+        if self.paged:
+            self._register_retired_blocks(slot)  # needs the request's tokens
         self._slot_req[slot] = None
         self._temp[slot] = 0.0  # freed slot must not pin the sampling variant
         if self.paged:
@@ -577,6 +838,11 @@ class ContinuousBatchingEngine:
             for j in range(valid):
                 tok = int(toks_np[j, slot])
                 req.output_ids.append(tok)
+                if req.ttft_s is None:
+                    # time-to-first-token: the cached-prefix admission's
+                    # headline win (prefill skipped, decode starts sooner)
+                    req.ttft_s = (time.perf_counter()
+                                  - getattr(req, "_submit_s", t0))
                 # count only tokens a caller actually receives: chunk steps
                 # past EOS / the token budget / max_seq are trimmed here, so
                 # they must not inflate decode_tokens_per_s (the headline)

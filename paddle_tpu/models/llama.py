@@ -562,6 +562,11 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
             "v": jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
             # master fp32 weights (multi_precision AdamW semantics)
             "master": jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params),
+            # last step's pre-clip grad global-norm: free to export (it is
+            # already computed for clipping) and the multichip dryrun's
+            # numerics fingerprint — loss ≈ ln(vocab) at init cannot
+            # distinguish right from wrong backward compute
+            "gnorm": jnp.zeros((), jnp.float32),
         }
 
     # the executed-1F1B runner binds 'pp' plus any nontrivial dp/sharding
@@ -653,7 +658,8 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
         new_params = jax.tree_util.tree_map(
             lambda w, p: w.astype(p.dtype), unf(new_w), params
         )
-        new_opt = {"step": step, "m": unf(new_m), "v": unf(new_v), "master": unf(new_w)}
+        new_opt = {"step": step, "m": unf(new_m), "v": unf(new_v),
+                   "master": unf(new_w), "gnorm": gnorm}
         return loss, new_params, new_opt
 
     opt_shardings = {
@@ -661,6 +667,7 @@ def build_train_step(cfg: LlamaConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
         "m": param_shardings,
         "v": param_shardings,
         "master": param_shardings,
+        "gnorm": NamedSharding(mesh, P()),
     }
     data_sharding = NamedSharding(mesh, data_spec)
     jitted = jax.jit(

@@ -404,6 +404,9 @@ def build_train_step(cfg: MoEConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
             "m": jax.tree_util.tree_map(z, params),
             "v": jax.tree_util.tree_map(z, params),
             "master": jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params),
+            # pre-clip grad global-norm (multichip dryrun fingerprint;
+            # mirrors models/llama.build_train_step)
+            "gnorm": jnp.zeros((), jnp.float32),
         }
 
     def train_step(params, opt_state, input_ids, labels):
@@ -435,7 +438,8 @@ def build_train_step(cfg: MoEConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
         new_w = jax.tree_util.tree_unflatten(treedef, [t[2] for t in flat])
         new_params = jax.tree_util.tree_map(
             lambda w, p: w.astype(p.dtype), new_w, params)
-        new_opt = {"step": step, "m": new_m, "v": new_v, "master": new_w}
+        new_opt = {"step": step, "m": new_m, "v": new_v, "master": new_w,
+                   "gnorm": gnorm}
         return loss, new_params, new_opt
 
     opt_shardings = {
@@ -443,6 +447,7 @@ def build_train_step(cfg: MoEConfig, mesh: Mesh, lr=3e-4, weight_decay=0.1,
         "m": param_shardings,
         "v": param_shardings,
         "master": param_shardings,
+        "gnorm": NamedSharding(mesh, P()),
     }
     data_sharding = NamedSharding(mesh, data_spec)
     jitted = jax.jit(

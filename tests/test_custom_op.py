@@ -1,0 +1,117 @@
+"""Custom-op extension point (ISSUE 2 satellite; VERDICT Missing #5).
+
+``paddle_tpu.utils.register_custom_op`` must make a user JAX function a
+first-class op: dispatched through the eager tape (apply_op), grad-correct
+through ``Tensor.backward`` (both the autodiff path and a user-supplied
+custom VJP), registry-visible, and installable as a Tensor method."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import registry
+from paddle_tpu.utils import register_custom_op
+
+
+@pytest.fixture(autouse=True)
+def _registry_cleanup():
+    """Custom ops registered here must not leak into the global registry —
+    test_op_sweep.py::test_registry_coverage audits every OPS entry."""
+    before = dict(registry.OPS)
+    yield
+    registry.OPS.clear()
+    registry.OPS.update(before)
+
+
+def test_custom_op_forward_and_autodiff_grad():
+    """No vjp given: backward comes from jax.vjp of the forward — gradients
+    must match jax.grad of the same pure function exactly."""
+    op = register_custom_op("t_softclip", lambda x: jnp.tanh(x) * 2.0)
+    x_np = np.linspace(-2, 2, 12, dtype=np.float32).reshape(3, 4)
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    y = op(x)
+    np.testing.assert_allclose(y.numpy(), np.tanh(x_np) * 2.0, rtol=1e-6)
+    y.sum().backward()
+    want = jax.grad(lambda a: (jnp.tanh(a) * 2.0).sum())(x_np)
+    np.testing.assert_allclose(x.grad.numpy(), np.asarray(want), rtol=1e-6)
+    assert "t_softclip" in registry.op_names()
+
+
+def test_custom_op_custom_vjp_is_used_and_grad_checked():
+    """A user vjp must actually run (counter proof) and its analytic gradient
+    must pass a finite-difference check through Tensor.backward."""
+    calls = []
+
+    def fwd(x, w):
+        return jnp.sin(x) * w
+
+    def vjp(x, w, ct):
+        calls.append(1)  # traced when the custom backward is actually taken
+        return ct * jnp.cos(x) * w, ct * jnp.sin(x)
+
+    op = register_custom_op("t_sinscale", fwd, vjp=vjp)
+    rs = np.random.RandomState(0)
+    x_np = rs.randn(5).astype(np.float32)
+    w_np = rs.randn(5).astype(np.float32)
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    w = paddle.to_tensor(w_np, stop_gradient=False)
+    out = op(x, w)
+    out.sum().backward()
+    assert calls, "custom vjp was never invoked"
+    # analytic grads
+    np.testing.assert_allclose(x.grad.numpy(), np.cos(x_np) * w_np, rtol=1e-5)
+    np.testing.assert_allclose(w.grad.numpy(), np.sin(x_np), rtol=1e-5)
+    # finite-difference check of the registered op end-to-end
+    eps = 1e-3
+    for j in range(5):
+        xp, xm = x_np.copy(), x_np.copy()
+        xp[j] += eps
+        xm[j] -= eps
+        num = (np.sin(xp) * w_np).sum() - (np.sin(xm) * w_np).sum()
+        np.testing.assert_allclose(x.grad.numpy()[j], num / (2 * eps),
+                                   rtol=2e-2, atol=2e-3)
+
+
+def test_custom_op_custom_vjp_overrides_autodiff():
+    """A deliberately scaled vjp shows the custom rule, not XLA autodiff,
+    produces the gradient (the Pallas hand-written-backward contract)."""
+    op = register_custom_op("t_double_grad", lambda x: x * 1.0,
+                            vjp=lambda x, ct: ct * 3.0)
+    x = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    op(x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3.0 * np.ones(4), rtol=1e-6)
+
+
+def test_custom_op_tensor_method_and_jit():
+    op = register_custom_op("t_cube", lambda x: x ** 3, tensor_method="cube")
+    x = paddle.to_tensor(np.arange(3, dtype=np.float32))
+    np.testing.assert_allclose(x.cube().numpy(), [0.0, 1.0, 8.0])
+    # the wrapper stays traceable: same op under jax.jit sees tracers
+    out = jax.jit(lambda a: op(paddle.to_tensor(a)).value())(
+        jnp.arange(3, dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), [0.0, 1.0, 8.0])
+
+
+def test_custom_op_custom_vjp_with_static_kwargs():
+    """Static kwargs must reach both the forward and the custom vjp without
+    leaking into the custom_vjp residuals (review-caught crash: kwargs were
+    resolved into positional primals and broke the vjp arity)."""
+    op = register_custom_op("t_kscale", lambda x, k=2.0: x * k,
+                            vjp=lambda x, ct, k=2.0: ct * k)
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = op(x, k=3.0)
+    np.testing.assert_allclose(y.numpy(), 3.0 * np.ones(3), rtol=1e-6)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3.0 * np.ones(3), rtol=1e-6)
+
+
+def test_custom_op_name_collision_raises():
+    with pytest.raises(ValueError):
+        register_custom_op("add", lambda x, y: x + y)  # builtin
+    register_custom_op("t_once", lambda x: x)
+    with pytest.raises(ValueError):
+        register_custom_op("t_once", lambda x: x)  # custom re-register

@@ -38,6 +38,14 @@ TARGETS = {
         "llama_cb_decode_tokens_per_sec/cb_paged_ragged_gather",
     "cb_3b_paged_kernel":
         "llama_cb_decode_tokens_per_sec/cb_3b_chunk8_int4_paged_kernel",
+    # round-7 evidence rungs: automatic prefix cache hot/cold A-B (16
+    # requests sharing a 256-token system prompt vs disjoint prompts) and
+    # the 3B int4 variant (docs/prefix_cache.md) — exact-key matching so the
+    # hot rung can never satisfy the cold half of the A/B
+    "cb_prefix_hot": "llama_cb_decode_tokens_per_sec/cb_prefix_hot",
+    "cb_prefix_cold": "llama_cb_decode_tokens_per_sec/cb_prefix_cold",
+    "cb_3b_prefix_hot_int4":
+        "llama_cb_decode_tokens_per_sec/cb_3b_prefix_hot_int4",
 }
 
 
