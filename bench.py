@@ -101,6 +101,20 @@ def chip_peak(device) -> float:
     return PEAK_FLOPS["cpu"]
 
 
+def jit_traces(*fns):
+    """Compiled-variant count across a rung's jitted programs (None when
+    uncountable).  Emitted as ``n_traces`` in every rung's detail dict so a
+    jit cache-key regression (silent re-trace/re-compile per step — erases
+    exactly the wins the rungs measure) shows up as a number drifting above
+    its known-good floor in BENCH_*.json instead of as unexplained s/iter."""
+    try:
+        from paddle_tpu.analysis import n_traces
+
+        return n_traces(*fns)
+    except Exception:
+        return None
+
+
 # ---------------------------------------------------------------------------
 # phase 0: backend + kernel probe
 # ---------------------------------------------------------------------------
@@ -338,6 +352,9 @@ def run_rung(name, cfg, batch, seq, warmup_steps, bench_steps, remat_policy="ful
             "remat": remat_policy,
             "xent_chunk": xent_chunk,
             "disabled_pallas": os.environ.get("PADDLE_TPU_DISABLE_PALLAS", ""),
+            # expected 1: warmup compiles the single step variant; anything
+            # higher means the timed loop re-traced (cache-key churn)
+            "n_traces": jit_traces(step_fn),
         },
     }
 
@@ -396,7 +413,9 @@ def run_decode_rung(name, cfg, batch, prompt, new, max_seq):
         "unit": "tok/s",
         "vs_baseline": 0.0,  # no reference decode baseline recorded
         "detail": {"rung": name, "batch": batch, "prompt": prompt,
-                   "new_tokens": new, "backend": jax.default_backend()},
+                   "new_tokens": new, "backend": jax.default_backend(),
+                   # expected 2 (one prefill + one decode program)
+                   "n_traces": jit_traces(eng._prefill, eng._decode)},
     }
 
 
@@ -505,6 +524,9 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
                    # A/B evidence of which attention path this rung traced
                    "paged_kernel_calls": _pa.KERNEL_CALLS - pk0,
                    "paged_fallback_calls": _pa.FALLBACK_CALLS - pf0,
+                   # expected: one decode variant per sampling mode used +
+                   # one prefill per warmed bucket; growth = in-serve churn
+                   "n_traces": eng.n_traces(),
                    "backend": jax.default_backend()},
     }
 
@@ -590,6 +612,7 @@ def run_cb_prefix_rung(name, cfg, max_batch, n_requests, shared_len,
                    "prefill_hit_rate": round(cached / max(computed + cached, 1),
                                              4),
                    "preemptions": eng.stats["preemptions"],
+                   "n_traces": eng.n_traces(),
                    "backend": jax.default_backend()},
     }
 
@@ -768,6 +791,7 @@ def run_vision_rung(name, arch, batch, img, warmup_steps, bench_steps, flops_per
         "vs_baseline": 0.0,
         "detail": {"rung": name, "arch": arch, "batch": batch, "img": img,
                    "loss": loss_v, "est_mfu_pct": round(mfu * 100, 2),
+                   "n_traces": jit_traces(step._step),
                    "backend": jax.default_backend()},
     }
 
@@ -841,6 +865,7 @@ def run_moe_rung(name, cfg, batch, seq, warmup_steps, bench_steps):
                    "dispatch": moe_llama.resolved_dispatch(cfg),
                    "total_params_m": round(moe_llama.count_params(params) / 1e6, 1),
                    "batch": batch, "seq": seq,
+                   "n_traces": jit_traces(step_fn),
                    "backend": jax.default_backend()},
     }
 
@@ -889,6 +914,7 @@ def run_dit_rung(name, cfg, batch, warmup_steps, bench_steps):
         "detail": {"rung": name, "loss": loss_v, "batch": batch,
                    "est_mfu_pct": round(mfu * 100, 2),
                    "params_m": round(dit.count_params(params) / 1e6, 1),
+                   "n_traces": jit_traces(step_fn),
                    "backend": jax.default_backend()},
     }
 

@@ -1,0 +1,103 @@
+"""paddle_tpu.analysis — jaxpr-level TPU lint + serving-engine auditor.
+
+Static analysis over any jittable callable: trace to a ClosedJaxpr (no device
+execution — runs under ``JAX_PLATFORMS=cpu``) and walk it for the properties
+that keep a program on the TPU fast path:
+
+* ``dtype_upcast`` — f32 MXU ops reachable from bf16/int-quant inputs, and
+  weak-type (python-scalar) promotions;
+* ``donation``     — bitwise-dead input buffers not donated (HBM doubled);
+* ``recompile``    — jit cache-key instability under equivalent inputs;
+* ``host_sync``    — callback-class primitives / host round-trips in hot
+  loops;
+* ``resharding``   — implicit all-gathers the SPMD partitioner inserted.
+
+Three surfaces (docs/analysis.md):
+
+* library — ``analyze(fn, *args) -> Report``;
+* CLI     — ``python -m paddle_tpu.analysis --target llama_train_step``;
+* runtime — ``PADDLE_TPU_ENGINE_AUDIT=1`` cross-checks the serving engine's
+  block-pool/prefix-cache invariants every step (engine_audit.py).
+
+``tools/lint_gate.py`` runs the registered targets (targets.py) and exits
+nonzero on non-allowlisted findings; accepted findings live in
+``allowlist.toml`` with one-line justifications.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .report import (AllowRule, Finding, Report, Severity, load_allowlist,
+                     DEFAULT_ALLOWLIST)
+from . import rules as _rules
+from .engine_audit import EngineAuditError, audit_engine, audit_enabled
+
+__all__ = ["analyze", "Report", "Finding", "Severity", "AllowRule",
+           "load_allowlist", "audit_engine", "audit_enabled",
+           "EngineAuditError", "n_traces", "ALL_RULES"]
+
+ALL_RULES = ("dtype_upcast", "donation", "recompile", "host_sync",
+             "resharding")
+
+
+def analyze(fn, *args, target: str = "", rules=None, allowlist=None,
+            allowlist_path: str | None = None,
+            min_donation_bytes: int = 1 << 20,
+            min_gather_bytes: int = 1 << 20) -> Report:
+    """Trace ``fn(*args)`` and lint the program.  ``fn`` may be jit-wrapped
+    (donation/sharding metadata is read off the pjit eqn) or a plain
+    callable.  ``rules`` restricts to a subset of :data:`ALL_RULES`;
+    ``allowlist`` takes parsed :class:`AllowRule` s (or ``allowlist_path`` a
+    TOML file; default: the packaged ``allowlist.toml``)."""
+    active = set(rules if rules is not None else ALL_RULES)
+    unknown = active - set(ALL_RULES)
+    if unknown:
+        raise ValueError(f"unknown rules {sorted(unknown)}; "
+                         f"expected subset of {ALL_RULES}")
+    if allowlist is None:
+        allowlist = load_allowlist(allowlist_path)
+
+    def trace():
+        return jax.make_jaxpr(fn)(*args)
+
+    closed = trace()
+    findings: list[Finding] = []
+    n_sigs = None
+    if "dtype_upcast" in active:
+        findings += _rules.check_dtype_upcast(closed, args, target=target)
+    if "donation" in active:
+        findings += _rules.check_donation(closed, args, target=target,
+                                          min_bytes=min_donation_bytes)
+    if "recompile" in active:
+        churn, n_sigs = _rules.check_recompile(fn, args, target=target,
+                                               trace=trace, baseline=closed)
+        findings += churn
+    if "host_sync" in active:
+        findings += _rules.check_host_sync(closed, target=target)
+    if "resharding" in active:
+        findings += _rules.check_resharding(fn, args, closed=closed,
+                                            target=target,
+                                            min_bytes=min_gather_bytes)
+    sev = {Severity.ERROR: 0, Severity.WARNING: 1, Severity.INFO: 2}
+    findings.sort(key=lambda f: (sev[f.severity], f.rule, f.where))
+    return Report(target or getattr(fn, "__name__", "anonymous"), findings,
+                  allowlist=allowlist, n_traces=n_sigs)
+
+
+def n_traces(*jitted) -> int | None:
+    """Total compiled-variant count across jit-wrapped callables (the
+    bench's jit-cache-churn telemetry: a rung whose detail reports more
+    traces than compiled program variants it legitimately needs is paying
+    silent re-trace/re-compile time).  Objects without a cache counter are
+    skipped; returns None when nothing was countable."""
+    total, counted = 0, False
+    for f in jitted:
+        size = getattr(f, "_cache_size", None)
+        if callable(size):
+            try:
+                total += int(size())
+                counted = True
+            except Exception:
+                pass
+    return total if counted else None

@@ -1,0 +1,73 @@
+"""CLI: ``JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --target <name>``.
+
+Traces the named target (or ``--all``) and prints the findings; exit status
+0 = clean or fully allowlisted, 1 = gating findings, making the module
+directly usable as a pre-submit check.  ``tools/lint_gate.py`` is the CI
+wrapper over the same registry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    # analysis is pure tracing: never let the CLI grab a TPU (or fail when
+    # the relay is down).  Effective only when the backend is not yet
+    # initialized — the canonical invocation sets JAX_PLATFORMS=cpu anyway.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass  # backend already up; proceed on whatever it is
+
+    from . import load_allowlist
+    from .targets import GATE_TARGETS, TARGETS
+    from .targets import run as run_target
+
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="jaxpr-level TPU lint over registered paddle_tpu targets")
+    p.add_argument("--target", action="append", default=[],
+                   help=f"target(s) to lint; registered: {sorted(TARGETS)}")
+    p.add_argument("--all", action="store_true",
+                   help="lint every gate target")
+    p.add_argument("--list", action="store_true",
+                   help="list registered targets and exit")
+    p.add_argument("--allowlist", default=None,
+                   help="allowlist TOML (default: packaged allowlist.toml)")
+    p.add_argument("--no-allowlist", action="store_true",
+                   help="show findings the allowlist would suppress")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="also print allowlisted findings with reasons")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for name in sorted(TARGETS):
+            gate = " [gate]" if name in GATE_TARGETS else ""
+            print(f"{name}{gate}")
+        return 0
+    names = list(args.target) or (list(GATE_TARGETS) if args.all else [])
+    if not names:
+        p.error("pass --target <name> (repeatable), --all, or --list")
+
+    allowlist = [] if args.no_allowlist else load_allowlist(args.allowlist)
+    rc = 0
+    for name in names:
+        report = run_target(name, allowlist=allowlist)
+        print(report.render(verbose=args.verbose))
+        if not report.ok:
+            rc = 1
+    if rc:
+        print("\nlint FAILED: fix the findings above or allowlist them in "
+              "paddle_tpu/analysis/allowlist.toml with a reason",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,171 @@
+"""Serving-engine invariant auditor (``PADDLE_TPU_ENGINE_AUDIT=1``).
+
+The paged continuous-batching engine + prefix cache maintain a handful of
+host-side invariants the whole memory model rests on.  A single bookkeeping
+bug (double-freed page, leaked refcount, COW miss) silently corrupts KV bytes
+for *other* requests — the worst failure class in a multi-tenant server,
+detectable only by cross-checking the allocator, the block tables, and the
+cache against each other.  With the env var set, the engine calls
+:func:`audit_engine` after admission and after every decode chunk; a
+violation raises :class:`EngineAuditError` naming the invariant.
+
+Invariants (paged mode):
+
+I1  page partition — every pool page is in exactly one of {free list, a
+    slot's private blocks, the prefix cache}; no duplicates, total == pool.
+I2  block-table rows — row[i] mirrors [shared pages..., private pages...] in
+    order; every remaining entry is the unallocated sentinel.
+I3  refcounts — each cached block's refcount equals the number of slot
+    mappings over it; the cache's O(1) zero-ref counter matches a scan.
+I4  COW — no cache-resident page is simultaneously a slot's *private*
+    (writable) block: the engine never writes a shared page.
+I5  chain shape — a slot's shared list is a parent-linked hash chain rooted
+    at None; each cached block's ``children`` count matches a scan.
+I6  position bounds — active slots have 0 <= pos <= max_seq and enough
+    mapped blocks to cover every written position.
+
+Dense (non-paged) engines only get I6's bounds check — there is no allocator
+to corrupt.  The audit is O(pool + slots·blocks) pure-host work per step:
+cheap next to a device step, but nonzero, hence opt-in (a debug validator,
+not a production default).
+"""
+
+from __future__ import annotations
+
+from ..utils.envflags import env_bool
+
+__all__ = ["EngineAuditError", "audit_engine", "audit_enabled"]
+
+
+class EngineAuditError(AssertionError):
+    """A serving-engine invariant does not hold (engine state is corrupt)."""
+
+
+def audit_enabled() -> bool:
+    """Parse ``PADDLE_TPU_ENGINE_AUDIT`` (validated: '', '0', '1'; anything
+    else warns and falls back to off — see utils/envflags.py)."""
+    return env_bool("PADDLE_TPU_ENGINE_AUDIT", False)
+
+
+def _fail(invariant: str, detail: str):
+    raise EngineAuditError(f"engine audit {invariant} violated: {detail}")
+
+
+def audit_engine(eng) -> None:
+    """Cross-check a ContinuousBatchingEngine's host state; raises
+    :class:`EngineAuditError` on the first violated invariant."""
+    B = eng.max_batch
+    # I6 first — it applies to dense and paged alike
+    for s in range(B):
+        if eng._slot_req[s] is None:
+            continue
+        pos = int(eng._pos[s])
+        if not 0 <= pos <= eng.max_seq:
+            _fail("I6", f"slot {s} pos {pos} outside [0, {eng.max_seq}]")
+    if not getattr(eng, "paged", False):
+        return
+
+    nb = eng.num_blocks
+    free = list(eng._free)
+    cache = eng._pcache
+    cached_pages = cache.resident_pages() if cache is not None else []
+    private = [p for s in range(B) for p in eng._slot_blocks[s]]
+
+    # I1: exact partition of the pool
+    if len(free) != len(set(free)):
+        _fail("I1", f"duplicate pages in the free list: {sorted(free)}")
+    if len(private) != len(set(private)):
+        _fail("I1", f"page owned by two slots: {sorted(private)}")
+    if len(cached_pages) != len(set(cached_pages)):
+        _fail("I1", f"page cached twice: {sorted(cached_pages)}")
+    everything = sorted(free + private + cached_pages)
+    if everything != sorted(set(everything)):
+        seen, dup = set(), set()
+        for p in free + private + cached_pages:
+            (dup if p in seen else seen).add(p)
+        _fail("I1", f"pages in two owners at once: {sorted(dup)} "
+                    f"(free/slot/cache overlap)")
+    if everything != list(range(nb)):
+        missing = sorted(set(range(nb)) - set(everything))
+        extra = sorted(set(everything) - set(range(nb)))
+        _fail("I1", f"pool accounting does not close: missing={missing} "
+                    f"out-of-range={extra}")
+
+    # I4: cached pages are read-only — never simultaneously private
+    leaked = set(cached_pages) & set(private)
+    if leaked:
+        _fail("I4", f"cache-resident pages mapped writable: {sorted(leaked)}")
+
+    by_hash = cache._by_hash if cache is not None else {}
+
+    # I2: table rows mirror shared+private, sentinel elsewhere
+    for s in range(B):
+        shared = eng._slot_shared[s]
+        owned = eng._slot_blocks[s]
+        row = eng._table[s]
+        expect = [by_hash[h].page if h in by_hash else None for h in shared] \
+            + list(owned)
+        if len(expect) > eng.max_blocks:
+            # must precede the row[i] loop: an over-appended allocator list
+            # would otherwise surface as a bare IndexError, not the named
+            # invariant
+            _fail("I2", f"slot {s} maps {len(expect)} blocks but the table "
+                        f"row holds max_blocks={eng.max_blocks}")
+        for i, want in enumerate(expect):
+            if want is None:
+                _fail("I2", f"slot {s} maps evicted cached block "
+                            f"{shared[i][:8]}")
+            if int(row[i]) != want:
+                _fail("I2", f"slot {s} table[{i}]={int(row[i])} but "
+                            f"allocator says page {want}")
+        for i in range(len(expect), eng.max_blocks):
+            if int(row[i]) != nb:
+                _fail("I2", f"slot {s} table[{i}]={int(row[i])} past the "
+                            f"mapped blocks (sentinel {nb} expected)")
+        # I6 continued: mapped blocks must cover every written position
+        if eng._slot_req[s] is not None and expect:
+            covered = len(expect) * eng.block_size
+            pos = min(int(eng._pos[s]), eng.max_seq)
+            if pos > covered:
+                _fail("I6", f"slot {s} pos {pos} beyond mapped pages "
+                            f"({covered} positions)")
+
+    if cache is None:
+        return
+
+    # I3: refcount == slot mappings; O(1) zero-ref counter == scan
+    mapped: dict[str, int] = {}
+    for s in range(B):
+        for h in eng._slot_shared[s]:
+            mapped[h] = mapped.get(h, 0) + 1
+    for h, e in by_hash.items():
+        if e.refcount != mapped.get(h, 0):
+            _fail("I3", f"block {h[:8]} refcount={e.refcount} but "
+                        f"{mapped.get(h, 0)} slot(s) map it")
+    for h in mapped:
+        if h not in by_hash:
+            _fail("I3", f"slot maps block {h[:8]} that is not resident")
+    n_zero = sum(1 for e in by_hash.values() if e.refcount == 0)
+    if cache._n_zero_ref != n_zero:
+        _fail("I3", f"zero-ref counter {cache._n_zero_ref} != scan {n_zero}")
+
+    # I5: chain shape — parent links + children counts
+    kids: dict[str, int] = {}
+    for e in by_hash.values():
+        if e.parent is not None:
+            kids[e.parent] = kids.get(e.parent, 0) + 1
+    for h, e in by_hash.items():
+        if e.children != kids.get(h, 0):
+            _fail("I5", f"block {h[:8]} children={e.children} but scan "
+                        f"finds {kids.get(h, 0)}")
+    for s in range(B):
+        parent = None
+        for h in eng._slot_shared[s]:
+            e = by_hash.get(h)
+            if e is None:
+                _fail("I5", f"slot {s} chain references evicted {h[:8]}")
+            if e.parent != parent:
+                _fail("I5", f"slot {s} shared chain broken at {h[:8]}: "
+                            f"parent {str(e.parent)[:8]} != previous "
+                            f"{str(parent)[:8]}")
+            parent = h

@@ -1,0 +1,148 @@
+"""Registered lint targets: the framework's own hot paths.
+
+Each target builds a jittable callable + example args at a *tiny* config —
+the lint is shape-generic (dtype flows, donation, cache keys, and callback
+primitives are invariant to width/depth), so tracing the tiny config under
+``JAX_PLATFORMS=cpu`` proves the same properties the production config has,
+in seconds and with zero device time.
+
+``build(name)`` returns an :class:`AnalysisTarget`; ``run(name)`` builds and
+analyzes it.  ``tools/lint_gate.py`` iterates :data:`GATE_TARGETS` (and the
+tier-1 suite runs the gate), so a change that knocks a train step or the
+serving decode path off the fast path fails CI, not a later bench round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+import numpy as np
+
+__all__ = ["AnalysisTarget", "TARGETS", "GATE_TARGETS", "build", "run"]
+
+
+@dataclasses.dataclass
+class AnalysisTarget:
+    name: str
+    fn: typing.Any
+    args: tuple
+    analyze_kwargs: dict = dataclasses.field(default_factory=dict)
+
+
+def _t_llama_train_step() -> AnalysisTarget:
+    import jax
+
+    from ..models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    mesh = llama.make_mesh(devices=jax.devices()[:1])
+    step_fn, opt_init, psh, dsh = llama.build_train_step(cfg, mesh)
+    params = llama.init_params(cfg, jax.random.key(0))
+    opt_state = opt_init(params)
+    rs = np.random.RandomState(0)
+    ids = jax.numpy.asarray(rs.randint(0, cfg.vocab_size, (2, 32)))
+    labels = jax.numpy.asarray(rs.randint(0, cfg.vocab_size, (2, 32)))
+    return AnalysisTarget("llama_train_step", step_fn,
+                          (params, opt_state, ids, labels))
+
+
+def _t_moe_train_step() -> AnalysisTarget:
+    import jax
+
+    from ..models import moe_llama
+
+    cfg = moe_llama.MoEConfig.tiny()
+    mesh = moe_llama.make_mesh(devices=jax.devices()[:1])
+    step_fn, opt_init, psh, dsh = moe_llama.build_train_step(cfg, mesh)
+    params = moe_llama.init_params(cfg, jax.random.key(0))
+    opt_state = opt_init(params)
+    rs = np.random.RandomState(0)
+    ids = jax.numpy.asarray(rs.randint(0, cfg.vocab_size, (2, 32)))
+    labels = jax.numpy.asarray(rs.randint(0, cfg.vocab_size, (2, 32)))
+    return AnalysisTarget("moe_llama_train_step", step_fn,
+                          (params, opt_state, ids, labels))
+
+
+def _serving_engine():
+    import jax
+
+    from ..models import llama
+    from ..inference.serving import ContinuousBatchingEngine
+
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=32, layers=2, heads=4,
+                                 kv_heads=2, inter=64)
+    params = llama.init_params(cfg, jax.random.key(0))
+    return ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=2, paged=True, block_size=8)
+
+
+def _t_serving_decode_step() -> AnalysisTarget:
+    import jax.numpy as jnp
+
+    eng = _serving_engine()
+    B = eng.max_batch
+    tokens = jnp.zeros((B,), jnp.int32)
+    pos = jnp.asarray([5, 0], jnp.int32)
+    active = jnp.asarray([True, False])
+    temp = jnp.zeros((B,), jnp.float32)
+    topp = jnp.ones((B,), jnp.float32)
+    seeds = jnp.zeros((B,), jnp.int32)
+    table = jnp.asarray(eng._table)
+    return AnalysisTarget(
+        "serving_decode_step", eng._decode_greedy,
+        (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active,
+         temp, topp, seeds, table))
+
+
+def _t_serving_prefill_step() -> AnalysisTarget:
+    import jax.numpy as jnp
+
+    eng = _serving_engine()
+    bucket = 16
+    ids = jnp.zeros((1, bucket), jnp.int32)
+    table_row = jnp.asarray(eng._table[0])
+    length = jnp.asarray(bucket - 1, jnp.int32)
+
+    # bucket is a static argnum of the compiled prefill: close over it so
+    # the analyzed callable is purely array-in/array-out
+    def prefill(params, ids, cache_k, cache_v, table_row, length):
+        return eng._prefill(params, ids, cache_k, cache_v, table_row,
+                            length, bucket)
+
+    return AnalysisTarget(
+        "serving_prefill_step", prefill,
+        (eng.params, ids, eng.cache_k, eng.cache_v, table_row, length))
+
+
+TARGETS = {
+    "llama_train_step": _t_llama_train_step,
+    "moe_llama_train_step": _t_moe_train_step,
+    "serving_decode_step": _t_serving_decode_step,
+    "serving_prefill_step": _t_serving_prefill_step,
+}
+
+# the CI gate runs every registered target; kept as an explicit list so an
+# expensive future target (multi-device compile) can register without
+# slowing the tier-1 suite
+GATE_TARGETS = ("llama_train_step", "moe_llama_train_step",
+                "serving_decode_step", "serving_prefill_step")
+
+
+def build(name: str) -> AnalysisTarget:
+    try:
+        builder = TARGETS[name]
+    except KeyError:
+        raise SystemExit(
+            f"unknown target {name!r}; registered: {sorted(TARGETS)}") \
+            from None
+    return builder()
+
+
+def run(name: str, **overrides):
+    """Build and analyze one registered target."""
+    from . import analyze
+
+    t = build(name)
+    kwargs = {**t.analyze_kwargs, **overrides}
+    return analyze(t.fn, *t.args, target=t.name, **kwargs)

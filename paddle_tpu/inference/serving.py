@@ -65,7 +65,6 @@ and CUDA kernels.
 from __future__ import annotations
 
 import functools
-import os
 import time
 from dataclasses import dataclass, field
 
@@ -176,9 +175,13 @@ class ContinuousBatchingEngine:
         self._pcache = None
         # the env kill switch is checked FIRST so =0 neutralizes the feature
         # totally — even an (invalid) paged=False request runs cache-off
-        # instead of raising, honoring "forces it off regardless"
-        if (enable_prefix_caching
-                and os.environ.get("PADDLE_TPU_PREFIX_CACHE", "1") != "0"):
+        # instead of raising, honoring "forces it off regardless".
+        # env_bool validates the value: a typo ('off') warns instead of
+        # silently leaving the cache enabled (utils/envflags.py)
+        from ..utils.envflags import env_bool
+
+        if enable_prefix_caching and env_bool("PADDLE_TPU_PREFIX_CACHE",
+                                              True):
             if not paged:
                 raise ValueError("enable_prefix_caching requires paged=True "
                                  "(the cache shares block-table pages)")
@@ -227,6 +230,13 @@ class ContinuousBatchingEngine:
                       "prefix_hits": 0, "prefix_blocks_reused": 0,
                       "prefix_evictions": 0, "cow_copies": 0,
                       "prefill_tokens_computed": 0, "prefill_tokens_cached": 0}
+        # opt-in runtime invariant auditor (PADDLE_TPU_ENGINE_AUDIT=1):
+        # cross-checks allocator / block-table / prefix-cache bookkeeping
+        # after admission and after every decode chunk, raising
+        # EngineAuditError on corruption (analysis/engine_audit.py)
+        from ..analysis.engine_audit import audit_enabled
+
+        self._audit_every_step = audit_enabled()
 
     # ---------------- compiled programs ----------------
 
@@ -804,9 +814,16 @@ class ContinuousBatchingEngine:
         if self.paged:
             self._release(slot)
 
+    def _maybe_audit(self):
+        if self._audit_every_step:
+            from ..analysis.engine_audit import audit_engine
+
+            audit_engine(self)
+
     def step(self) -> bool:
         """One admit + decode-chunk iteration.  Returns False when idle."""
         self._admit()
+        self._maybe_audit()
         k = self.chunk
         if self.paged:
             self._ensure_growth(k)  # may preempt the youngest slot
@@ -856,6 +873,7 @@ class ContinuousBatchingEngine:
             self._last_tok[slot] = int(toks_np[-1, slot])
             if done or old_pos + k >= self.max_seq:
                 self._retire(slot)
+        self._maybe_audit()
         return True
 
     def serve(self, requests: list[Request]) -> dict[int, list[int]]:
@@ -872,3 +890,17 @@ class ContinuousBatchingEngine:
     def decode_tokens_per_s(self) -> float:
         t = self.stats["decode_time_s"]
         return self.stats["decode_tokens"] / t if t > 0 else 0.0
+
+    def n_traces(self) -> int | None:
+        """Total compiled program variants across this engine's jitted
+        programs (decode greedy/sampling, prefill(s), COW copy) — the
+        bench's jit-cache-churn telemetry: the expected count is small and
+        static (one decode variant per sampling mode actually used + one
+        prefill per warmed bucket), so growth across a serve is a silent
+        recompile in the hot loop (paddle_tpu.analysis.n_traces)."""
+        from ..analysis import n_traces as _n
+
+        fns = [self._decode_greedy, self._decode_sampling, self._prefill]
+        if self._pcache is not None:
+            fns += [self._prefill_prefix, self._copy_page]
+        return _n(*fns)

@@ -13,8 +13,6 @@ through XLA.  Each kernel here:
 
 from __future__ import annotations
 
-import os
-
 import jax
 
 
@@ -30,6 +28,14 @@ def interpret_mode() -> bool:
     return not on_tpu()
 
 
+# the full opt-out vocabulary: every kernel in this package plus 'all'.
+# kernel_disabled() validates against it at parse time so a typo
+# ('paged_attn') warns with a did-you-mean instead of silently keeping the
+# kernel it was meant to disable (utils/envflags.py)
+KNOWN_KERNELS = frozenset({"all", "flash_attention", "rms_norm", "rope",
+                           "swiglu", "paged_attention"})
+
+
 def kernel_disabled(name: str) -> bool:
     """Operational escape hatch: route around a Pallas kernel at runtime.
 
@@ -37,9 +43,12 @@ def kernel_disabled(name: str) -> bool:
     switches the named kernels to their XLA-composed fallbacks.  bench.py's
     kernel probe sets this when a kernel fails to compile standalone, so a
     Mosaic regression in one kernel degrades throughput instead of hanging
-    the whole measurement."""
-    disabled = os.environ.get("PADDLE_TPU_DISABLE_PALLAS", "")
-    if not disabled:
-        return False
-    names = {s.strip() for s in disabled.split(",")}
-    return "all" in names or name in names
+    the whole measurement.  Values outside :data:`KNOWN_KERNELS` warn once
+    (typo guard) but are still honored as opt-outs.  The queried ``name``
+    is always accepted as known — a future kernel that guards itself with
+    ``kernel_disabled("new_kernel")`` must not make its own legitimate
+    opt-out warn as a typo just because the frozenset lagged."""
+    from ...utils.envflags import env_token_set
+
+    names = env_token_set("PADDLE_TPU_DISABLE_PALLAS", KNOWN_KERNELS | {name})
+    return bool(names) and ("all" in names or name in names)

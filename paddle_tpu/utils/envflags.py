@@ -1,0 +1,64 @@
+"""Validated parsing for PADDLE_TPU_* operational env switches.
+
+The switches are operator-facing kill/debug levers read at trace or init
+time; a typo (``paged_attn`` for ``paged_attention``, ``off`` for ``0``)
+used to be silently ignored — the worst failure mode for an escape hatch you
+reach for mid-incident.  Every parse here warns (once per distinct value, so
+trace-time re-reads don't spam) naming the offending token and the closest
+valid spelling.
+"""
+
+from __future__ import annotations
+
+import difflib
+import os
+import warnings
+
+__all__ = ["env_token_set", "env_bool"]
+
+_warned: set[tuple[str, str]] = set()
+
+
+def _warn_once(name: str, raw: str, msg: str) -> None:
+    if (name, raw) in _warned:
+        return
+    _warned.add((name, raw))
+    warnings.warn(msg, stacklevel=3)
+
+
+def env_token_set(name: str, known: frozenset[str] | set[str]) -> set[str]:
+    """Comma-separated token list (e.g. PADDLE_TPU_DISABLE_PALLAS).  Unknown
+    tokens are kept (forward compatibility: an old binary must still honor a
+    newer kernel name as an opt-out) but warned about with a did-you-mean."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return set()
+    tokens = {s.strip() for s in raw.split(",") if s.strip()}
+    unknown = tokens - set(known)
+    if unknown:
+        hints = []
+        for t in sorted(unknown):
+            close = difflib.get_close_matches(t, known, n=1, cutoff=0.5)
+            hints.append(f"{t!r}" + (f" (did you mean {close[0]!r}?)"
+                                     if close else ""))
+        _warn_once(name, raw,
+                   f"{name}={raw!r} contains unrecognized value(s) "
+                   f"{', '.join(hints)}; known: {sorted(known)}")
+    return tokens
+
+
+def env_bool(name: str, default: bool) -> bool:
+    """Boolean switch: '' -> default, '0' -> False, '1' -> True.  Any other
+    value warns and falls back to the default — a typo must not silently
+    flip a kill switch either way."""
+    raw = os.environ.get(name, "")
+    if raw == "":
+        return default
+    if raw == "0":
+        return False
+    if raw == "1":
+        return True
+    _warn_once(name, raw,
+               f"{name}={raw!r} is not '0' or '1'; using the default "
+               f"({'1' if default else '0'})")
+    return default

@@ -26,6 +26,13 @@ warnings.filterwarnings("ignore", message=".*Platform 'axon'.*")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; register the marker so slow-marked
+    # tests (e.g. subprocess CLI smoke) deselect without unknown-mark noise
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 suite (-m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     assert jax.device_count() == 8

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""CI gate: run the jaxpr-level TPU lint over every registered target.
+
+Exits 0 when every target is clean or fully allowlisted
+(``paddle_tpu/analysis/allowlist.toml``), nonzero otherwise — wired into the
+tier-1 suite (tests/test_analysis.py::test_lint_gate_over_registered_targets)
+so a change that knocks a train step or the serving hot path off the TPU
+fast path (f32 upcast, dropped donation, cache-key churn, a stray callback)
+fails the suite instead of surfacing as bench drift rounds later.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/lint_gate.py [--verbose]
+
+Exit codes: 0 clean, 1 gating findings, 2 a target failed to build/trace
+(a broken target is a gate failure, not a skip — otherwise a refactor that
+renames a traced function silently turns the gate off).
+"""
+
+from __future__ import annotations
+
+import sys
+import traceback
+
+
+def main(argv=None) -> int:
+    """Pure gate logic: assumes paddle_tpu is importable and the backend is
+    already configured (the ``__main__`` block does both for script use;
+    the in-process tier-1 test runs under conftest's CPU-forced config) —
+    no process-global mutation here, so an in-process caller's environment
+    survives the gate."""
+    argv = sys.argv[1:] if argv is None else argv
+    verbose = "--verbose" in argv or "-v" in argv
+
+    from paddle_tpu.analysis.targets import GATE_TARGETS, run
+
+    rc = 0
+    for name in GATE_TARGETS:
+        try:
+            report = run(name)
+        except Exception:
+            print(f"== {name}: FAILED to build/trace ==", file=sys.stderr)
+            traceback.print_exc()
+            rc = max(rc, 2)
+            continue
+        print(report.render(verbose=verbose))
+        if not report.ok:
+            rc = max(rc, 1)
+    if rc == 1:
+        print("\nlint gate FAILED: fix the findings or allowlist them in "
+              "paddle_tpu/analysis/allowlist.toml (with a reason)",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    # script invocation: make the repo importable and pin the CPU backend
+    # (analysis is pure tracing — never grab a TPU, never fail on a relay
+    # outage).  Kept out of main() so the in-process tier-1 test does not
+    # leak env/config mutations into the rest of the pytest run.
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+    sys.exit(main())
