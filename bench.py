@@ -617,6 +617,86 @@ def run_cb_prefix_rung(name, cfg, max_batch, n_requests, shared_len,
     }
 
 
+def run_cb_spec_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq,
+                     chunk, num_blocks, speculate=True, num_draft_tokens=4,
+                     workload="hot", block_size=64):
+    """Speculative-decoding A/B rung (ISSUE 4): prompt-lookup n-gram drafting
+    + ragged multi-token verification through the paged-attention kernel
+    family (docs/speculative.md).  ``workload='hot'`` builds self-similar
+    prompts (a short token pattern tiled to ``prompt`` length — the
+    summarize/extract/code-edit regime prompt lookup exists for, where greedy
+    continuations revisit the prompt's own n-grams); ``'cold'`` draws i.i.d.
+    random prompts (the drafter-overhead bound: proposals rarely verify).
+    ``speculate=False`` pins the SAME workload to the plain paged-kernel
+    engine — the matched baseline the >=1.5x acceptance criterion compares
+    against.  Greedy throughout: the accepted stream is token-identical to
+    the baseline engine's, so the A/B measures pure scheduling/verify
+    throughput, never output drift."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
+
+    log(f"cb spec rung {name}: building (slots={max_batch} "
+        f"requests={n_requests} speculate={speculate} workload={workload})")
+    rs = np.random.RandomState(0)
+
+    def make_prompt():
+        if workload == "hot":
+            # pattern short enough to tile at least twice even on the CPU
+            # smoke rung's 16-token prompts — a "hot" prompt with no actual
+            # repetition would never exercise the drafter it smokes
+            pat_len = min(32, max(2, prompt // 2))
+            pat = rs.randint(0, cfg.vocab_size, (pat_len,)).astype(np.int32)
+            reps = (prompt + pat.size - 1) // pat.size
+            return np.tile(pat, reps)[:prompt]
+        return rs.randint(0, cfg.vocab_size, (prompt,)).astype(np.int32)
+
+    params = llama.init_params(cfg, jax.random.key(0))
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
+                                   max_seq=max_seq, chunk=chunk, paged=True,
+                                   block_size=block_size,
+                                   num_blocks=num_blocks,
+                                   enable_speculation=speculate,
+                                   num_draft_tokens=num_draft_tokens)
+    del params
+    t_c = time.perf_counter()
+    # warm the prefill bucket, both decode programs, AND the verify program
+    # (a hot warm-up prompt makes the drafter fire, so the verify variant
+    # compiles outside the timed region)
+    eng.serve([Request(rid=-1, prompt_ids=make_prompt(), max_new_tokens=8)])
+    log(f"cb spec rung {name}: compile {time.perf_counter() - t_c:.1f}s")
+    eng.stats.update(decode_steps=0, decode_tokens=0, decode_time_s=0.0,
+                     spec_steps=0, spec_drafted_tokens=0,
+                     spec_accepted_tokens=0, spec_rejected_tokens=0)
+    reqs = [Request(rid=i, prompt_ids=make_prompt(), max_new_tokens=new)
+            for i in range(n_requests)]
+    t0 = time.perf_counter()
+    eng.serve(reqs)
+    wall = time.perf_counter() - t0
+    total = sum(len(r.output_ids) for r in reqs)
+    return {
+        "metric": "llama_cb_decode_tokens_per_sec",
+        "value": round(eng.decode_tokens_per_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "detail": {"rung": name, "slots": max_batch, "requests": n_requests,
+                   "total_new_tokens": total, "wall_s": round(wall, 2),
+                   "chunk": chunk, "workload": workload,
+                   "speculate": speculate,
+                   "num_draft_tokens": num_draft_tokens if speculate else 0,
+                   "decode_steps": eng.stats["decode_steps"],
+                   "spec_steps": eng.stats["spec_steps"],
+                   "spec_drafted_tokens": eng.stats["spec_drafted_tokens"],
+                   "spec_accepted_tokens": eng.stats["spec_accepted_tokens"],
+                   "spec_acceptance_rate": round(eng.spec_acceptance_rate, 4),
+                   "preemptions": eng.stats["preemptions"],
+                   "n_traces": eng.n_traces(),
+                   "backend": jax.default_backend()},
+    }
+
+
 def decode_ladder_main(compact: bool = False) -> int:
     import jax
 
@@ -736,6 +816,32 @@ def decode_ladder_main(compact: bool = False) -> int:
             banked += 1
         except Exception as e:
             log(f"cb prefix rung {rung[0]} failed: {e}\n"
+                f"{traceback.format_exc()}")
+            continue
+    # speculative-decoding A/B (ISSUE 4): self-similar prompts where the
+    # prompt-lookup drafter hits (hot) vs i.i.d. prompts (cold, the overhead
+    # bound), plus the SAME hot workload with speculation off — the matched
+    # non-speculative paged-kernel baseline the >=1.5x criterion reads
+    # against.  Pool sized like the prefix rungs (6 pages/request resident).
+    # (rung tuple: cfg, slots, requests, prompt, new, max_seq, chunk,
+    # num_blocks, speculate, num_draft_tokens, workload[, block_size])
+    spec_rungs = ([
+        ("cb_spec_ngram_hot", full_cfg, 8, 16, 256, 64, 512, 8, 56,
+         True, 4, "hot"),
+        ("cb_spec_ngram_base", full_cfg, 8, 16, 256, 64, 512, 8, 56,
+         False, 4, "hot"),
+        ("cb_spec_ngram_cold", full_cfg, 8, 16, 256, 64, 512, 8, 56,
+         True, 4, "cold"),
+    ] if on_tpu else [
+        ("cb_spec_cpu_smoke", llama.LlamaConfig.tiny(), 2, 4, 16, 8, 64,
+         2, 12, True, 3, "hot", 8),
+    ])
+    for rung in spec_rungs:
+        try:
+            emit(run_cb_spec_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"cb spec rung {rung[0]} failed: {e}\n"
                 f"{traceback.format_exc()}")
             continue
     return 0 if banked else 1

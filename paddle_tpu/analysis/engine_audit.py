@@ -21,8 +21,12 @@ I4  COW — no cache-resident page is simultaneously a slot's *private*
     (writable) block: the engine never writes a shared page.
 I5  chain shape — a slot's shared list is a parent-linked hash chain rooted
     at None; each cached block's ``children`` count matches a scan.
-I6  position bounds — active slots have 0 <= pos <= max_seq and enough
-    mapped blocks to cover every written position.
+I6  position bounds — active slots have 0 <= pos <= max_seq, the KV-write
+    high-water mark ``_written`` satisfies pos <= written <= max_seq (a
+    speculative verify step appends up to K+1 tokens, then rolls pos back
+    past rejected drafts — pos may trail written, never lead it), and the
+    mapped blocks cover every written position including rejected drafts'
+    (multi-token append must have allocated pages before the device wrote).
 
 Dense (non-paged) engines only get I6's bounds check — there is no allocator
 to corrupt.  The audit is O(pool + slots·blocks) pure-host work per step:
@@ -62,6 +66,14 @@ def audit_engine(eng) -> None:
         pos = int(eng._pos[s])
         if not 0 <= pos <= eng.max_seq:
             _fail("I6", f"slot {s} pos {pos} outside [0, {eng.max_seq}]")
+        w = int(eng._written[s])
+        if w > eng.max_seq:
+            _fail("I6", f"slot {s} written high-water {w} beyond "
+                        f"max_seq {eng.max_seq}")
+        if pos > w:
+            _fail("I6", f"slot {s} pos {pos} ahead of written high-water "
+                        f"{w}: speculative rollback may trail the device's "
+                        f"writes but pos must never pass them")
     if not getattr(eng, "paged", False):
         return
 
@@ -122,13 +134,21 @@ def audit_engine(eng) -> None:
             if int(row[i]) != nb:
                 _fail("I2", f"slot {s} table[{i}]={int(row[i])} past the "
                             f"mapped blocks (sentinel {nb} expected)")
-        # I6 continued: mapped blocks must cover every written position
+        # I6 continued: mapped blocks must cover every written position —
+        # including a speculative verify step's rejected drafts (the device
+        # wrote their K/V before the rollback), hence the _written
+        # high-water mark rather than pos
         if eng._slot_req[s] is not None and expect:
             covered = len(expect) * eng.block_size
             pos = min(int(eng._pos[s]), eng.max_seq)
+            hw = min(int(eng._written[s]), eng.max_seq)
             if pos > covered:
                 _fail("I6", f"slot {s} pos {pos} beyond mapped pages "
                             f"({covered} positions)")
+            if hw > covered:
+                _fail("I6", f"slot {s} written high-water {hw} beyond "
+                            f"mapped pages ({covered} positions): "
+                            f"multi-token append outran its allocation")
 
     if cache is None:
         return

@@ -64,7 +64,7 @@ def _t_moe_train_step() -> AnalysisTarget:
                           (params, opt_state, ids, labels))
 
 
-def _serving_engine():
+def _serving_engine(**kwargs):
     import jax
 
     from ..models import llama
@@ -74,7 +74,8 @@ def _serving_engine():
                                  kv_heads=2, inter=64)
     params = llama.init_params(cfg, jax.random.key(0))
     return ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
-                                    chunk=2, paged=True, block_size=8)
+                                    chunk=2, paged=True, block_size=8,
+                                    **kwargs)
 
 
 def _t_serving_decode_step() -> AnalysisTarget:
@@ -115,18 +116,43 @@ def _t_serving_prefill_step() -> AnalysisTarget:
         (eng.params, ids, eng.cache_k, eng.cache_v, table_row, length))
 
 
+def _t_serving_verify_step() -> AnalysisTarget:
+    import jax.numpy as jnp
+
+    eng = _serving_engine(enable_speculation=True, num_draft_tokens=3)
+    B = eng.max_batch
+    Q = eng._spec_qmax
+    # slot 0 mid-decode carrying a full draft, slot 1 idle — the exact data
+    # regime the speculative hot loop runs (q_lens/active are DATA, so this
+    # one trace covers every per-step raggedness)
+    tokens = jnp.zeros((B, Q), jnp.int32)
+    pos = jnp.asarray([5, 0], jnp.int32)
+    active = jnp.asarray([True, False])
+    q_lens = jnp.asarray([Q, 1], jnp.int32)
+    temp = jnp.zeros((B,), jnp.float32)
+    topp = jnp.ones((B,), jnp.float32)
+    seeds = jnp.zeros((B,), jnp.int32)
+    table = jnp.asarray(eng._table)
+    return AnalysisTarget(
+        "serving_verify_step", eng._verify_greedy,
+        (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active, q_lens,
+         temp, topp, seeds, table))
+
+
 TARGETS = {
     "llama_train_step": _t_llama_train_step,
     "moe_llama_train_step": _t_moe_train_step,
     "serving_decode_step": _t_serving_decode_step,
     "serving_prefill_step": _t_serving_prefill_step,
+    "serving_verify_step": _t_serving_verify_step,
 }
 
 # the CI gate runs every registered target; kept as an explicit list so an
 # expensive future target (multi-device compile) can register without
 # slowing the tier-1 suite
 GATE_TARGETS = ("llama_train_step", "moe_llama_train_step",
-                "serving_decode_step", "serving_prefill_step")
+                "serving_decode_step", "serving_prefill_step",
+                "serving_verify_step")
 
 
 def build(name: str) -> AnalysisTarget:

@@ -51,6 +51,25 @@ kernel reads shared pages unchanged — sharing is purely block-table aliasing.
 Opt-out: ``PADDLE_TPU_PREFIX_CACHE=0``; with caching off (the default) the
 engine is byte-identical to the PR 1 engine.
 
+``enable_speculation=True`` (paged mode only) adds draft-model-free
+speculative decoding (speculative.py, docs/speculative.md; reference: the
+``speculate_*`` op family in paddle/phi/ops/yaml): a host-side prompt-lookup
+n-gram drafter proposes up to K continuation tokens per slot from the
+request's own prompt+generated history, and ONE compiled multi-token verify
+step scores all of them — the pending token plus the drafts ride through the
+ragged paged-attention verify kernel as ``[B, K+1]`` queries with per-slot
+``q_lens`` as DATA (one static program, no shape-family churn) — then the
+acceptance rule runs in-graph: position-derived sampling keys make the
+accepted stream TOKEN-IDENTICAL to the non-speculative engine for greedy AND
+seeded sampled requests, so speculation only changes how many tokens each
+host round-trip banks.  Rejected drafts roll ``pos`` back (their K/V writes
+beyond the accepted point are dead until overwritten, tracked by the
+``_written`` high-water mark the runtime auditor checks) and are never
+content-addressed into the prefix cache.  Steps where no slot drafts run the
+ordinary chunked decode — a drafter miss costs nothing.  Opt-out:
+``PADDLE_TPU_SPECULATE=0``; spec-off the engine is byte-identical to the
+non-speculative engine.
+
 Per-request sampling (reference: ``top_p_sampling``, ops.yaml:4947) runs
 inside the jitted step: temperature/top-p/seed are per-slot DATA vectors, so
 one compiled program serves mixed greedy/sampled batches, and RNG keys
@@ -111,7 +130,9 @@ class ContinuousBatchingEngine:
     def __init__(self, cfg, params, max_batch: int = 8, max_seq: int = 512,
                  chunk: int = 1, quant: str | None = None, paged: bool = False,
                  block_size: int = 64, num_blocks: int | None = None,
-                 enable_prefix_caching: bool = False):
+                 enable_prefix_caching: bool = False,
+                 enable_speculation: bool = False, num_draft_tokens: int = 4,
+                 spec_ngram: int = 3):
         """``chunk``: decode steps per compiled call.  Tokens feed back
         on-device inside a lax.scan and the host fetches ``chunk`` tokens per
         round-trip — the lever against host-device latency (one RTT per token
@@ -126,7 +147,13 @@ class ContinuousBatchingEngine:
         more logical context than physically reserved HBM).
         ``enable_prefix_caching``: content-addressed reuse of full KV blocks
         across requests (paged mode only; see prefix_cache.py).  Kill switch:
-        ``PADDLE_TPU_PREFIX_CACHE=0`` forces it off regardless."""
+        ``PADDLE_TPU_PREFIX_CACHE=0`` forces it off regardless.
+        ``enable_speculation``: prompt-lookup n-gram drafting + multi-token
+        verification (paged mode only; see speculative.py and
+        docs/speculative.md).  ``num_draft_tokens`` (K) bounds drafts per
+        step — the verify step's static query width is K+1;``spec_ngram`` is
+        the longest suffix the drafter matches.  Kill switch:
+        ``PADDLE_TPU_SPECULATE=0`` forces it off regardless."""
         from ..models import llama as _llama  # noqa: F401  (cfg type lives there)
 
         self.cfg = cfg
@@ -201,6 +228,13 @@ class ContinuousBatchingEngine:
         # slot state (host side)
         self._slot_req: list[Request | None] = [None] * max_batch
         self._pos = np.zeros(max_batch, np.int32)      # next write position
+        # KV-write high-water mark per slot: positions [0, _written) hold
+        # device-written (or cache-mapped) K/V.  Equals pos everywhere except
+        # after a speculative verify step with rejections, where pos rolls
+        # back to the accepted point but the rejected drafts' writes remain
+        # (dead until overwritten).  The engine auditor's I6 cross-checks
+        # pos <= written <= mapped-page coverage.
+        self._written = np.zeros(max_batch, np.int32)
         self._last_tok = np.zeros(max_batch, np.int32)
         # per-slot sampling state (temperature 0 = greedy; one compiled
         # program serves mixed greedy/sampled batches — the knobs are DATA)
@@ -222,6 +256,32 @@ class ContinuousBatchingEngine:
         pimpl = self._prefill_impl_paged if paged else self._prefill_impl
         self._prefill = jax.jit(pimpl, donate_argnums=(2, 3),
                                 static_argnums=(6,))
+        # speculative decoding (prompt-lookup drafting + multi-token verify).
+        # Like the prefix cache, EVERY spec behavior hangs off self._spec
+        # being non-None, and the env kill switch is checked FIRST so
+        # PADDLE_TPU_SPECULATE=0 neutralizes the feature totally (even an
+        # invalid paged=False request runs spec-off instead of raising).
+        self._spec = None
+        self._spec_qmax = 0
+        if enable_speculation and env_bool("PADDLE_TPU_SPECULATE", True):
+            if not paged:
+                raise ValueError(
+                    "enable_speculation requires paged=True (the multi-token "
+                    "verify step runs through the paged-attention kernel)")
+            from .speculative import NGramDrafter
+
+            self._spec = NGramDrafter(num_draft_tokens=num_draft_tokens,
+                                      max_ngram=spec_ngram)
+            # the verify step's query width is STATIC at K+1 (per-slot
+            # raggedness is the q_lens data vector): one compiled variant
+            # per sampling mode for the whole serve, no shape-family churn
+            self._spec_qmax = int(num_draft_tokens) + 1
+            self._verify_greedy = jax.jit(
+                functools.partial(self._verify_impl_paged, sampling=False),
+                donate_argnums=(1, 2))
+            self._verify_sampling = jax.jit(
+                functools.partial(self._verify_impl_paged, sampling=True),
+                donate_argnums=(1, 2))
         self.stats = {"decode_steps": 0, "decode_tokens": 0,
                       "prefills": 0, "decode_time_s": 0.0, "preemptions": 0,
                       # prefix-cache observability (all zero with caching off;
@@ -229,7 +289,12 @@ class ContinuousBatchingEngine:
                       # A-Bs read straight off stats)
                       "prefix_hits": 0, "prefix_blocks_reused": 0,
                       "prefix_evictions": 0, "cow_copies": 0,
-                      "prefill_tokens_computed": 0, "prefill_tokens_cached": 0}
+                      "prefill_tokens_computed": 0, "prefill_tokens_cached": 0,
+                      # speculative-decoding observability (all zero spec-off;
+                      # acceptance ticks at the device level — EOS/budget
+                      # host trimming does not retroactively un-accept)
+                      "spec_steps": 0, "spec_drafted_tokens": 0,
+                      "spec_accepted_tokens": 0, "spec_rejected_tokens": 0}
         # opt-in runtime invariant auditor (PADDLE_TPU_ENGINE_AUDIT=1):
         # cross-checks allocator / block-table / prefix-cache bookkeeping
         # after admission and after every decode chunk, raising
@@ -505,6 +570,102 @@ class ContinuousBatchingEngine:
         return self._prefill_body(params, ids, cache_k, cache_v, length,
                                   bucket, write, start=start)
 
+    # ---------------- speculative verify (compiled program) ----------------
+
+    def _verify_one(self, params, cache_k, cache_v, tokens, pos, active,
+                    q_lens, table):
+        """One multi-token verify forward: tokens [B, Q] (row 0 = the pending
+        last token, rows 1.. = n-gram drafts), pos [B] (row 0's write
+        position), q_lens [B] live rows per slot -> (logits [B, Q, V],
+        caches).  The multi-token analog of ``_decode_one``: every row's K/V
+        is scattered into its page at absolute position pos+t (row t of a
+        slot with t >= q_lens, an inactive lane, or a position past max_seq
+        drops), and attention runs the ragged verify kernel over the paged
+        pool — one weight stream from HBM serves up to Q tokens per slot,
+        which is the speculative win in bandwidth-bound decode."""
+        from .. import inference as _inf
+        from ..ops import decode_attention as _da
+        from ..ops.pallas import rope as rope_mod
+
+        cfg = self.cfg
+        B = self.max_batch
+        S = self.max_seq
+        Q = tokens.shape[1]
+        nh = cfg.num_attention_heads
+        bs_ = self.block_size
+        x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+        cos_full, sin_full = rope_mod.rope_cos_sin(S, cfg.head_dim,
+                                                   base=cfg.rope_theta,
+                                                   dtype=cfg.dtype)
+        pos_t = pos[:, None] + jnp.arange(Q)[None, :]          # [B, Q] abs
+        valid_t = (active[:, None] & (jnp.arange(Q)[None, :] < q_lens[:, None])
+                   & (pos_t < S))
+        safe_t = jnp.where(valid_t, pos_t, 0)
+        cos = jnp.take(cos_full[0], safe_t, axis=0)            # [B, Q, d]
+        sin = jnp.take(sin_full[0], safe_t, axis=0)
+        lane = jnp.arange(B)[:, None]
+        blk = table[lane, safe_t // bs_]                       # [B, Q]
+        off = safe_t % bs_
+        drop_blk = jnp.where(valid_t, blk, self.num_blocks)    # oob -> drop
+
+        def write(ck, k):
+            # ck [num_blocks, nkv, bs, hd]; k [B, Q, nkv, hd].  Allocator
+            # invariant: distinct slots own disjoint pages, distinct rows hit
+            # distinct positions — no scatter collisions among live writes.
+            out = ck.at[drop_blk, :, off].set(k, mode="drop")
+            # the verify kernel reads the paged pool directly (no gathered
+            # view materializes; its fallback oracle gathers internally)
+            return out, out
+
+        # total written length per slot incl. every draft; inactive lanes
+        # attend one stale position (finite, masked out downstream like the
+        # dense path's garbage lanes)
+        seq_base = jnp.where(active & (pos < S), pos, 0)
+        seq_now = jnp.minimum(seq_base + jnp.where(active, q_lens, 1), S)
+
+        def attend_fn(q, k_pool, v_pool):
+            # q [B, Q, nh, hd] post-rope
+            o = _da.paged_verify_attention(q, k_pool, v_pool, table,
+                                           seq_now, q_lens)
+            return o.reshape(B, Q, nh * cfg.head_dim)
+
+        x, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
+                                           write, None, cos, sin,
+                                           attend_fn=attend_fn)
+        return _inf.lm_head_logits(cfg, params, x), ak, av
+
+    def _verify_impl_paged(self, params, cache_k, cache_v, tokens, pos,
+                           active, q_lens, temp, topp, seeds, table,
+                           sampling=False):
+        """Verify + accept in ONE compiled program.  Row t's logits condition
+        on draft tokens <= t; the emitted token for position pos+t+1 is drawn
+        with the SAME (seed, pos+t)-derived key ``_sample_tokens`` would use
+        in the non-speculative step — so row 0's token is always what plain
+        decode would have produced, and each draft is accepted exactly when
+        it equals that token.  The accepted stream is therefore
+        token-identical to the non-speculative engine (greedy AND seeded
+        sampled), not merely distribution-preserving.  Returns
+        (out [B, Q] chosen tokens per row, n_emitted [B] in 1..q_lens,
+        caches); host code consumes out[:, :n_emitted]."""
+        logits, ck, cv = self._verify_one(params, cache_k, cache_v, tokens,
+                                          pos, active, q_lens, table)
+        Q = tokens.shape[1]
+        if sampling:
+            pos_t = pos[:, None] + jnp.arange(Q)[None, :]
+            out = jax.vmap(
+                lambda lg, p: self._sample_tokens(lg, p, temp, topp, seeds),
+                in_axes=(1, 1), out_axes=1)(logits, pos_t)
+        else:
+            out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # acceptance: draft t+1 survives iff it equals the token the target
+        # chose at row t AND every earlier draft survived (leading-run via
+        # cumprod); row 0 is always emitted.  t+1 < q_lens bounds n_emitted
+        # by the slot's live rows, so padding rows can never count.
+        ok = ((tokens[:, 1:] == out[:, :-1])
+              & (jnp.arange(1, Q)[None, :] < q_lens[:, None]))
+        n_emitted = 1 + jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+        return out, n_emitted.astype(jnp.int32), ck, cv
+
     # ---------------- block allocator (host control plane) ----------------
 
     def _blocks_needed(self, last_pos: int) -> int:
@@ -636,21 +797,27 @@ class ContinuousBatchingEngine:
         self._register_retired_blocks(slot)
         self._release(slot)
         self._slot_req[slot] = None
+        self._written[slot] = 0
         self._temp[slot] = 0.0  # re-set on readmission
         self._queue.insert(0, req)
         self.stats["preemptions"] += 1
 
-    def _ensure_growth(self, k: int):
+    def _ensure_growth(self, k):
         """Before a decode chunk: every active slot needs pages covering
-        positions up to pos+k-1.  Oldest slots win; when the pool is dry the
-        youngest active slot is preempted and its pages recycled."""
+        positions up to pos+k-1 (``k`` may be a per-slot vector — the
+        speculative verify step appends q_lens tokens to each slot, so a
+        non-drafting slot must not be forced to allocate the drafting
+        slots' pages).  Oldest slots win; when the pool is dry the youngest
+        active slot is preempted and its pages recycled."""
+        karr = np.broadcast_to(np.asarray(k, np.int64), (self.max_batch,))
         order = sorted((s for s in range(self.max_batch)
                         if self._slot_req[s] is not None),
                        key=lambda s: self._slot_age[s])
         for slot in order:
             if self._slot_req[slot] is None:
                 continue  # preempted by an older slot this pass
-            need = self._blocks_needed(int(self._pos[slot]) + k - 1)
+            need = self._blocks_needed(int(self._pos[slot])
+                                       + int(karr[slot]) - 1)
             while not self._alloc_to(slot, need):
                 victims = [s for s in range(self.max_batch)
                            if s != slot and self._slot_req[s] is not None]
@@ -696,18 +863,21 @@ class ContinuousBatchingEngine:
             start = 0            # first token whose K/V must be computed
             if self.paged:
                 # admit only if the prompt's pages fit AND the active slots'
-                # imminent growth (next chunk) keeps its headroom — otherwise
-                # a fresh admit would be preempted by _ensure_growth in the
-                # same step, wasting its full-prompt prefill
+                # imminent growth (next chunk — or the verify step's K+1
+                # appends when speculation is on) keeps its headroom —
+                # otherwise a fresh admit would be preempted by
+                # _ensure_growth in the same step, wasting its full-prompt
+                # prefill.  Spec-off: horizon == chunk, byte-identical.
+                horizon = max(self.chunk, self._spec_qmax)
                 headroom = sum(
-                    self._blocks_needed(int(self._pos[s]) + self.chunk - 1)
+                    self._blocks_needed(int(self._pos[s]) + horizon - 1)
                     - len(self._slot_shared[s]) - len(self._slot_blocks[s])
                     for s in range(self.max_batch)
                     if self._slot_req[s] is not None)
                 need = self._blocks_needed(s0 - 1)
                 # gate on the new slot's own first-chunk growth too, or
                 # _ensure_growth would preempt someone in this same step
-                gate = self._blocks_needed(s0 - 2 + self.chunk)
+                gate = self._blocks_needed(s0 - 2 + horizon)
                 # prefix-cache lookup: map the longest cached chain of full
                 # blocks into this row read-only.  Acquire BEFORE any
                 # allocation — a pinned (refcount > 0) block is unevictable,
@@ -796,6 +966,9 @@ class ContinuousBatchingEngine:
                 self._register_prefix_blocks(slot, ids, s0 - 1)
             self._slot_req[slot] = req
             self._pos[slot] = s0 - 1
+            # prefill committed (or the cache already held) K/V for every
+            # position below s0-1; position s0-1 itself is decode's first write
+            self._written[slot] = s0 - 1
             self._last_tok[slot] = ids[-1]
             self._temp[slot] = max(float(req.temperature or 0.0), 0.0)
             self._topp[slot] = float(req.top_p if req.top_p is not None
@@ -810,6 +983,7 @@ class ContinuousBatchingEngine:
         if self.paged:
             self._register_retired_blocks(slot)  # needs the request's tokens
         self._slot_req[slot] = None
+        self._written[slot] = 0
         self._temp[slot] = 0.0  # freed slot must not pin the sampling variant
         if self.paged:
             self._release(slot)
@@ -821,9 +995,17 @@ class ContinuousBatchingEngine:
             audit_engine(self)
 
     def step(self) -> bool:
-        """One admit + decode-chunk iteration.  Returns False when idle."""
+        """One admit + decode iteration (a chunked decode scan, or — with
+        speculation on and at least one slot drafting — a single multi-token
+        verify step).  Returns False when idle."""
         self._admit()
         self._maybe_audit()
+        if self._spec is not None:
+            drafts = self._draft_proposals()
+            if drafts is not None:
+                return self._spec_step(drafts)
+            # no slot drafted: fall through to the ordinary decode path —
+            # a drafter miss must cost nothing (same step shape as spec-off)
         k = self.chunk
         if self.paged:
             self._ensure_growth(k)  # may preempt the youngest slot
@@ -870,11 +1052,126 @@ class ContinuousBatchingEngine:
                     done = True
                     break
             self._pos[slot] = old_pos + k  # device advanced k regardless
+            # maximum, not overwrite: a prior verify step's rejected drafts
+            # may have written past old_pos+k, and the high-water mark must
+            # keep covering them until they are actually overwritten
+            self._written[slot] = max(int(self._written[slot]),
+                                      min(old_pos + k, self.max_seq))
             self._last_tok[slot] = int(toks_np[-1, slot])
             if done or old_pos + k >= self.max_seq:
                 self._retire(slot)
         self._maybe_audit()
         return True
+
+    # ---------------- speculative scheduling (host control plane) ----------
+
+    def _draft_proposals(self) -> dict[int, np.ndarray] | None:
+        """Run the prompt-lookup drafter over every active slot's
+        prompt+generated history.  Returns {slot: drafts} when at least one
+        slot proposed something, else None (the caller then takes the
+        ordinary decode path).  Drafts are capped so the verify step never
+        writes past max_seq and never drafts past the request's remaining
+        token budget (both would be pure wasted verify lanes)."""
+        out: dict[int, np.ndarray] = {}
+        any_draft = False
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            cap = min(self.max_seq - 1 - int(self._pos[slot]),
+                      req.max_new_tokens - len(req.output_ids) - 1)
+            if cap <= 0:
+                out[slot] = np.zeros(0, np.int32)
+                continue
+            ctx = np.concatenate(
+                [np.asarray(req.prompt_ids, np.int32).ravel(),
+                 np.asarray(req.output_ids, np.int32)])
+            d = self._spec.propose(ctx)[:cap]
+            out[slot] = d
+            if d.size:
+                any_draft = True
+        return out if any_draft else None
+
+    def _spec_step(self, drafts: dict[int, np.ndarray]) -> bool:
+        """One draft-verify-accept round: grow pages for every slot's
+        appends, run the compiled verify step once (ONE host round-trip for
+        up to K+1 tokens per slot), emit the accepted run + the target's
+        correction token, and roll ``pos`` back past any rejected drafts —
+        their K/V writes stay behind as dead bytes above pos (tracked by
+        ``_written``, overwritten by the next step, never content-addressed
+        into the prefix cache because every cache registration trusts only
+        positions below pos)."""
+        B = self.max_batch
+        Q = self._spec_qmax
+        qlens = np.ones(B, np.int64)
+        for s, d in drafts.items():
+            qlens[s] = 1 + d.size
+        self._ensure_growth(qlens)  # may preempt the youngest slot
+        active_np = np.asarray([r is not None for r in self._slot_req])
+        if not active_np.any():
+            return False
+        tokens = np.zeros((B, Q), np.int32)
+        tokens[:, 0] = self._last_tok
+        q_lens = np.ones(B, np.int32)
+        for s, d in drafts.items():
+            if self._slot_req[s] is None or d.size == 0:
+                continue  # preempted after drafting, or no proposal
+            tokens[s, 1:1 + d.size] = d
+            q_lens[s] = 1 + d.size
+        t0 = time.perf_counter()
+        any_sampled = bool((self._temp * active_np).max() > 0)
+        verify = self._verify_sampling if any_sampled else self._verify_greedy
+        out, n_acc, self.cache_k, self.cache_v = verify(
+            self.params, self.cache_k, self.cache_v, jnp.asarray(tokens),
+            jnp.asarray(self._pos), jnp.asarray(active_np),
+            jnp.asarray(q_lens), jnp.asarray(self._temp),
+            jnp.asarray(self._topp), jnp.asarray(self._seed),
+            jnp.asarray(self._table))
+        out_np = np.asarray(out)
+        n_np = np.asarray(n_acc)
+        self.stats["decode_time_s"] += time.perf_counter() - t0
+        self.stats["decode_steps"] += 1
+        self.stats["spec_steps"] += 1
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            old_pos = int(self._pos[slot])
+            n = int(n_np[slot])        # 1..q_lens: accepted run + correction
+            drafted = int(q_lens[slot]) - 1
+            self.stats["spec_drafted_tokens"] += drafted
+            self.stats["spec_accepted_tokens"] += n - 1
+            self.stats["spec_rejected_tokens"] += drafted - (n - 1)
+            done = False
+            for j in range(n):
+                tok = int(out_np[slot, j])
+                req.output_ids.append(tok)
+                if req.ttft_s is None:
+                    req.ttft_s = (time.perf_counter()
+                                  - getattr(req, "_submit_s", t0))
+                self.stats["decode_tokens"] += 1
+                if (len(req.output_ids) >= req.max_new_tokens
+                        or (req.eos_token_id is not None
+                            and tok == req.eos_token_id)):
+                    done = True
+                    break
+            # rejection rollback: pos advances only past ACCEPTED tokens;
+            # the high-water mark remembers how far the device EVER wrote
+            # (a shorter draft after a long rejected one must not shrink it)
+            self._written[slot] = max(int(self._written[slot]),
+                                      min(old_pos + int(q_lens[slot]),
+                                          self.max_seq))
+            self._pos[slot] = old_pos + n
+            self._last_tok[slot] = int(out_np[slot, n - 1])
+            if done or old_pos + n >= self.max_seq:
+                self._retire(slot)
+        self._maybe_audit()
+        return True
+
+    @property
+    def spec_acceptance_rate(self) -> float:
+        """Fraction of drafted tokens the verify step accepted (0.0 before
+        any speculative step — also the spec-off value)."""
+        d = self.stats["spec_drafted_tokens"]
+        return self.stats["spec_accepted_tokens"] / d if d > 0 else 0.0
 
     def serve(self, requests: list[Request]) -> dict[int, list[int]]:
         """Run all requests to completion; returns {rid: generated tokens}."""
@@ -903,4 +1200,9 @@ class ContinuousBatchingEngine:
         fns = [self._decode_greedy, self._decode_sampling, self._prefill]
         if self._pcache is not None:
             fns += [self._prefill_prefix, self._copy_page]
+        if self._spec is not None:
+            # the verify step's query width is static (K+1): exactly one
+            # variant per sampling mode actually used, regardless of how
+            # ragged the per-step drafts were
+            fns += [self._verify_greedy, self._verify_sampling]
         return _n(*fns)

@@ -1,0 +1,74 @@
+"""Speculative decoding: prompt-lookup n-gram drafting (ISSUE 4 tentpole).
+
+Reference analog: the reference ships a full speculative-decoding op family
+(``speculate_*`` / ``top_p_candidates`` in paddle/phi/ops/yaml) behind
+PaddleNLP's speculative serving mode.  The cheapest production drafter is
+DRAFT-MODEL-FREE prompt lookup (the reference's ``ngram_match`` op): most
+serving traffic — summarization, extraction, code edit, RAG over retrieved
+text — repeats long spans of its own context verbatim, so the best predictor
+of the next K tokens is often the continuation of the last place the current
+suffix already appeared in prompt + generated history.
+
+Division of labor (docs/speculative.md):
+
+* **Drafting is host-side numpy** (this module).  It needs the token history
+  the device never stores as a sequence, it is O(context) per slot per step
+  (microseconds next to a device forward), and keeping it off-device means
+  the compiled verify step has ONE static shape ``[B, K+1]`` regardless of
+  how many drafts each slot produced — per-slot raggedness rides in as a
+  ``q_lens`` DATA vector, never as a shape.
+* **Verification is one compiled device step** (`serving.py`
+  ``_verify_impl_paged`` over `ops/pallas/paged_attention.
+  paged_attention_verify`): the target model scores all K+1 tokens in a
+  single forward — one weight stream from HBM for up to K+1 tokens instead
+  of one per token, which is the whole speculative win in bandwidth-bound
+  decode — and the acceptance rule runs in-graph (no host sync per token).
+
+The drafter proposes, never decides: a wrong draft costs one wasted lane of
+the verify forward, never a wrong token (the engine's acceptance rule emits
+exactly the tokens the non-speculative engine would have).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["NGramDrafter"]
+
+
+class NGramDrafter:
+    """Prompt-lookup drafter: longest-suffix n-gram match over the request's
+    own prompt + generated history.
+
+    For n from ``max_ngram`` down to ``min_ngram``: take the context's last n
+    tokens and look for the MOST RECENT earlier occurrence; on a hit, propose
+    the up-to-``num_draft_tokens`` tokens that followed it.  No match at any
+    n → empty proposal (the engine then runs its normal decode step — a miss
+    must cost nothing).  Pure host-side numpy; stateless across calls, so
+    preemption/resume needs no drafter bookkeeping.
+    """
+
+    def __init__(self, num_draft_tokens: int = 4, max_ngram: int = 3,
+                 min_ngram: int = 1):
+        assert num_draft_tokens >= 1, num_draft_tokens
+        assert 1 <= min_ngram <= max_ngram, (min_ngram, max_ngram)
+        self.num_draft_tokens = int(num_draft_tokens)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, context) -> np.ndarray:
+        """Draft tokens continuing ``context`` (1-D int token ids).  Returns
+        an int32 array of 0..num_draft_tokens proposals."""
+        ids = np.asarray(context, np.int32).ravel()
+        L = ids.size
+        # windows over ids[:-1]: a match starting at i has its continuation
+        # at i+n <= L-1, and the context's own trailing n-gram (start L-n)
+        # can never match itself
+        for n in range(min(self.max_ngram, L - 1), self.min_ngram - 1, -1):
+            pat = ids[L - n:]
+            win = np.lib.stride_tricks.sliding_window_view(ids[:-1], n)
+            hits = np.nonzero((win == pat).all(axis=1))[0]
+            if hits.size:
+                start = int(hits[-1]) + n      # most recent occurrence wins
+                return ids[start:start + self.num_draft_tokens].copy()
+        return np.zeros(0, np.int32)

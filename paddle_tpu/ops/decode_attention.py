@@ -32,6 +32,7 @@ __all__ = [
     "masked_multihead_attention",
     "block_multihead_attention",
     "paged_decode_attention",
+    "paged_verify_attention",
     "append_to_block_cache",
 ]
 
@@ -122,6 +123,31 @@ def paged_decode_attention(q, key_cache, value_cache, block_tables, seq_lens,
     return _pa.paged_attention_decode(
         q, key_cache, value_cache, block_tables, seq_lens, scale=scale,
         kv_quant=kv_quant, k_scale=k_scale, v_scale=v_scale)
+
+
+def paged_verify_attention(q, key_cache, value_cache, block_tables, seq_lens,
+                           q_lens, scale=None):
+    """Ragged multi-token verification (the speculative-decoding hot op;
+    reference: the ``speculate_*`` op family in paddle/phi/ops/yaml).
+
+    Each slot verifies ``q_lens[b]`` query tokens at consecutive positions —
+    the pending token plus up to K n-gram-drafted tokens — in ONE launch of
+    the paged-attention kernel family (`ops/pallas/paged_attention.
+    paged_attention_verify`, docs/speculative.md), with a per-row causal
+    mask: drafted token t attends everything up to and including itself,
+    never the later drafts.  Falls back to the gather oracle
+    (``pallas.paged_attention.paged_verify_reference``) off-TPU-shapes or
+    under ``PADDLE_TPU_DISABLE_PALLAS=paged_attention``.
+
+    Shapes: q [b, qmax, nh, hd]; caches [num_blocks, nkv, block_size, hd]
+    (nh % nkv == 0, drafts' K/V already written); block_tables
+    [b, max_blocks]; seq_lens [b] TOTAL written length incl. drafts;
+    q_lens [b] in 1..qmax.  Returns [b, qmax, nh, hd]."""
+    from .pallas import paged_attention as _pa
+
+    return _pa.paged_attention_verify(q, key_cache, value_cache,
+                                      block_tables, seq_lens, q_lens,
+                                      scale=scale)
 
 
 def block_multihead_attention(q, key_cache, value_cache, block_tables,

@@ -32,6 +32,20 @@ opt-out ("paged_attention"), and a pure-JAX reference
 (:func:`paged_attention_reference`) that doubles as the fallback and the
 test oracle.  Decode-only: one query token per slot, no backward pass
 (serving never differentiates through the KV cache).
+
+Speculative decoding (docs/speculative.md) adds a RAGGED MULTI-TOKEN variant,
+:func:`paged_attention_verify`: each slot carries ``q_lens[b] <= qmax`` query
+tokens (the pending token plus up to K drafted tokens) at consecutive
+positions, all verified in ONE kernel launch.  The grid and page walk are
+identical to the decode kernel — the q-head group simply widens to
+``qmax * rep`` rows (row ``t*rep + g`` is query token t, grouped head g) and
+the causal mask becomes per-row: row t sees ``seq_lens[b] - (q_lens[b]-1-t)``
+KV positions, so drafted token t attends everything up to and including
+itself but not the later drafts.  ``q_lens`` rides in as a third
+scalar-prefetch operand; rows past a slot's live queries are fully masked
+(their output is garbage the engine never reads).  The decode kernel is left
+byte-for-byte untouched — spec-off serving must compile the exact same
+program as before this feature existed.
 """
 
 from __future__ import annotations
@@ -59,6 +73,10 @@ NEG_INF = -1e30
 # the "did not fall back" assertions in tests)
 KERNEL_CALLS = 0
 FALLBACK_CALLS = 0
+# the ragged multi-token verify variant keeps its own pair so a spec-decode
+# test can assert its path without the single-token decode calls aliasing it
+VERIFY_KERNEL_CALLS = 0
+VERIFY_FALLBACK_CALLS = 0
 
 # MXU/VPU rows: the q-head group is padded up to this many rows so the
 # logits tile and the scratch accumulators keep a full sublane
@@ -432,3 +450,202 @@ def paged_attention_decode(q, key_cache, value_cache, block_tables, seq_lens,
         scale = 1.0 / math.sqrt(hd)
     return _paged_core(q, key_cache, value_cache, block_tables, seq_lens,
                        k_scale, v_scale, scale, kv_quant)
+
+
+# ---------------------------------------------------------------------------
+# ragged multi-token verification (speculative decoding)
+# ---------------------------------------------------------------------------
+
+def _verify_kernel(tables_ref, lens_ref, qlens_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_scr, l_scr, acc_scr, *, scale, bs, rep):
+    """Grid: (slots, kv_heads, logical_pages) — identical page walk to
+    :func:`_paged_kernel`; the q tile widens to ``R = pad(qmax * rep)`` rows
+    (row ``t*rep + g`` = query token t, grouped head g) and the causal mask
+    becomes per-row.  Scalar-prefetch refs: tables [b, max_blocks], lens [b]
+    (TOTAL written length incl. every drafted token), qlens [b] (live query
+    tokens, 1..qmax)."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = lens_ref[b]
+    qlen = qlens_ref[b]
+
+    @pl.when(j * bs < length)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)                   # [R, hd]
+        k = k_ref[0, 0].astype(jnp.float32)                   # [bs, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [R, bs]
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        t = rows // rep                                       # query token idx
+        cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # query token t sits at absolute position length - qlen + t and sees
+        # everything up to and including itself: length - (qlen - 1 - t)
+        # columns.  Rows past the slot's live queries (incl. sublane padding)
+        # see nothing — their l stays 0 and _finalize emits zeros.
+        row_len = jnp.where(t < qlen, length - (qlen - 1 - t), 0)
+        s = jnp.where(cols < row_len, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(s > 0.5 * NEG_INF, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.where(m_prev > 0.5 * NEG_INF,
+                          jnp.exp(m_prev - m_new), 0.0)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _verify_page_index_map(bs: int, num_blocks: int):
+    # same physical-page resolution as the decode kernel, arity-adjusted for
+    # the third (qlens) scalar-prefetch operand
+    def idx(b, h, j, tables_ref, lens_ref, qlens_ref):
+        return (_resolve_page(b, j, tables_ref, lens_ref, bs, num_blocks),
+                h, 0, 0)
+
+    return idx
+
+
+def _verify_kernel_call(q, key_cache, value_cache, block_tables, seq_lens,
+                        q_lens, scale, rep):
+    """q: [b, nkv, R, hd] (R = qmax*rep padded to sublane rows, t-major).
+    Returns [b, nkv, R, hd]."""
+    b, nkv, R, hd = q.shape
+    num_blocks, _, bs, _ = key_cache.shape
+    max_blocks = block_tables.shape[1]
+
+    kernel = functools.partial(_verify_kernel, scale=scale, bs=bs, rep=rep)
+    kv_spec = pl.BlockSpec((1, 1, bs, hd),
+                           _verify_page_index_map(bs, num_blocks))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, nkv, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, R, hd),
+                         lambda b, h, j, t, l, ql: (b, h, 0, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=pl.BlockSpec((1, 1, R, hd),
+                               lambda b, h, j, t, l, ql: (b, h, 0, 0)),
+        scratch_shapes=[
+            _VMEM((R, 1), jnp.float32),
+            _VMEM((R, 1), jnp.float32),
+            _VMEM((R, hd), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, R, hd), q.dtype),
+        interpret=interpret_mode(),
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q_lens.astype(jnp.int32), q, key_cache, value_cache)
+
+
+def paged_verify_reference(q, key_cache, value_cache, block_tables, seq_lens,
+                           q_lens, scale=None):
+    """Gather oracle for ragged multi-token verification (fallback + test
+    oracle, mirroring :func:`paged_attention_reference`).
+
+    q: [b, qmax, nh, hd]; caches [num_blocks, nkv, bs, hd];
+    block_tables [b, max_blocks]; seq_lens [b] TOTAL written length (incl.
+    every drafted token); q_lens [b] live query tokens per slot (<= qmax).
+    Returns [b, qmax, nh, hd]; rows past q_lens (and slots with an empty
+    window) return zeros."""
+    num_blocks, nkv, bs, hd = key_cache.shape
+    b, qmax, nh, _ = q.shape
+    rep = nh // nkv
+    max_blocks = block_tables.shape[1]
+    S = max_blocks * bs
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+
+    safe = jnp.clip(block_tables, 0, num_blocks - 1)
+    k_seq = jnp.take(key_cache, safe, axis=0)   # [b, maxblk, nkv, bs, hd]
+    v_seq = jnp.take(value_cache, safe, axis=0)
+    k_seq = k_seq.transpose(0, 2, 1, 3, 4).reshape(b, nkv, S, hd)
+    v_seq = v_seq.transpose(0, 2, 1, 3, 4).reshape(b, nkv, S, hd)
+
+    qg = q.reshape(b, qmax, nkv, rep, hd)
+    logits = jnp.einsum("btngd,bnsd->btngs", qg.astype(jnp.float32),
+                        k_seq.astype(jnp.float32)) * scale
+    t = jnp.arange(qmax)[None, :, None, None, None]
+    ql = q_lens[:, None, None, None, None]
+    row_len = jnp.where(t < ql,
+                        seq_lens[:, None, None, None, None] - (ql - 1 - t), 0)
+    mask = jnp.arange(S)[None, None, None, None, :] < row_len
+    logits = jnp.where(mask, logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    p = jnp.where(row_len > 0, p, 0.0)
+    out = jnp.einsum("btngs,bnsd->btngd", p, v_seq.astype(jnp.float32))
+    return out.reshape(b, qmax, nh, hd).astype(q.dtype)
+
+
+def paged_attention_verify(q, key_cache, value_cache, block_tables, seq_lens,
+                           q_lens, scale=None):
+    """Ragged multi-token verification over a block-table KV cache (the
+    speculative-decoding target-model step; docs/speculative.md).
+
+    Args:
+      q: [b, qmax, num_heads, head_dim] — per slot, up to ``qmax`` query
+        tokens at CONSECUTIVE positions (token t at position
+        ``seq_lens[b] - q_lens[b] + t``); rows at or past ``q_lens[b]`` are
+        padding whose output is unspecified.
+      key_cache/value_cache: [num_blocks, num_kv_heads, block_size, head_dim]
+        pages with every query token's K/V already written (incl. drafts).
+      block_tables: [b, max_blocks] int32 physical page ids.
+      seq_lens: [b] int32 TOTAL valid KV length per slot (incl. drafts).
+      q_lens: [b] int32 live query tokens per slot (1..qmax).
+
+    Returns [b, qmax, num_heads, head_dim] in q's dtype: row t is attention
+    for query token t under the per-row causal mask (t sees everything up to
+    and including its own position, never the later drafts).  Dispatches to
+    the Pallas verify kernel when :func:`kernel_supported` (same predicate
+    and ``PADDLE_TPU_DISABLE_PALLAS=paged_attention`` opt-out as decode —
+    one launch-or-gather decision for the whole paged family); no kv_quant
+    variant (the serving engine's KV pools are bf16/f32; weight-only quant
+    does not touch them).  Forward-only like the decode entry — serving
+    never differentiates through the KV cache, and the analysis target
+    traces forward."""
+    global VERIFY_KERNEL_CALLS, VERIFY_FALLBACK_CALLS
+    b, qmax, nh, hd = q.shape
+    num_blocks, nkv, bs, hd_store = key_cache.shape
+    assert hd_store == hd, (hd_store, hd)
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    if not kernel_supported(nh, nkv, hd, bs):
+        VERIFY_FALLBACK_CALLS += 1
+        return paged_verify_reference(q, key_cache, value_cache,
+                                      block_tables, seq_lens, q_lens,
+                                      scale=scale)
+    VERIFY_KERNEL_CALLS += 1
+
+    rep = nh // nkv
+    R = _round_up(qmax * rep, _MIN_GROUP_ROWS)
+    # [b, qmax, nkv, rep, hd] -> [b, nkv, qmax*rep, hd], row = t*rep + g
+    qg = q.reshape(b, qmax, nkv, rep, hd).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, nkv, qmax * rep, hd)
+    if R != qmax * rep:
+        # padded rows index query token t >= qmax >= qlen: fully masked in
+        # the kernel (zero output), sliced off below
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, R - qmax * rep), (0, 0)))
+    out = _verify_kernel_call(qg, key_cache, value_cache, block_tables,
+                              seq_lens, q_lens, scale, rep)
+    out = out[:, :, :qmax * rep].reshape(b, nkv, qmax, rep, hd)
+    return out.transpose(0, 2, 1, 3, 4).reshape(b, qmax, nh, hd)

@@ -6,6 +6,23 @@ used to be silently ignored — the worst failure mode for an escape hatch you
 reach for mid-incident.  Every parse here warns (once per distinct value, so
 trace-time re-reads don't spam) naming the offending token and the closest
 valid spelling.
+
+:data:`BOOL_FLAGS` is the registry of '0'/'1' switches and their defaults —
+the single place a new kill switch gets documented (the engine reads them
+through :func:`env_bool`, which enforces the '0'/'1' vocabulary):
+
+* ``PADDLE_TPU_PREFIX_CACHE`` (default on) — automatic prefix cache
+  (inference/prefix_cache.py); ``0`` forces it off even when the engine was
+  constructed with ``enable_prefix_caching=True``.
+* ``PADDLE_TPU_ENGINE_AUDIT`` (default off) — per-step serving-engine
+  invariant auditor (analysis/engine_audit.py).
+* ``PADDLE_TPU_SPECULATE`` (default on) — speculative decoding
+  (inference/speculative.py, docs/speculative.md); ``0`` forces it off even
+  when the engine was constructed with ``enable_speculation=True``, and the
+  spec-off engine is byte-identical to one built before the feature existed.
+
+(``PADDLE_TPU_DISABLE_PALLAS`` is the token-set switch; its vocabulary lives
+with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``.)
 """
 
 from __future__ import annotations
@@ -14,7 +31,16 @@ import difflib
 import os
 import warnings
 
-__all__ = ["env_token_set", "env_bool"]
+__all__ = ["env_token_set", "env_bool", "BOOL_FLAGS"]
+
+#: '0'/'1' switches -> their library defaults (documentation + test anchor;
+#: callers still pass the default explicitly at the read site so a flag read
+#: can never silently drift from the registry without a test catching it)
+BOOL_FLAGS = {
+    "PADDLE_TPU_PREFIX_CACHE": True,
+    "PADDLE_TPU_ENGINE_AUDIT": False,
+    "PADDLE_TPU_SPECULATE": True,
+}
 
 _warned: set[tuple[str, str]] = set()
 

@@ -458,6 +458,104 @@ def test_paged_attention_unsupported_shape_falls_back():
     assert bool(jnp.all(jnp.isfinite(out)))
 
 
+# ---------------- ragged multi-token verify kernel (speculative) ----------
+# (row t of a verify call must equal a plain decode call whose cache stops at
+# that row's position — the independent oracle that pins the per-row causal
+# mask, docs/speculative.md)
+
+
+def _verify_case(rs, b, nh, nkv, hd, bs, max_blocks, lens, qmax, qlens,
+                 dtype=jnp.float32):
+    q, kc, vc, tables, lens = _paged_case(
+        rs, b=b, nh=nh, nkv=nkv, hd=hd, bs=bs, max_blocks=max_blocks,
+        lens=lens, dtype=dtype)
+    qm = jnp.asarray(rs.randn(b, qmax, nh, hd), dtype)
+    return qm, kc, vc, tables, lens, jnp.asarray(qlens, jnp.int32)
+
+
+@pytest.mark.parametrize("nh,nkv", [(4, 4), (8, 2), (20, 4), (6, 1)])
+def test_paged_verify_gqa_parity(nh, nkv):
+    """Verify kernel vs its gather oracle across GQA ratios with ragged
+    per-slot query counts."""
+    rs = np.random.RandomState(40)
+    q, kc, vc, tables, lens, qlens = _verify_case(
+        rs, b=4, nh=nh, nkv=nkv, hd=32, bs=16, max_blocks=4,
+        lens=[5, 17, 40, 64], qmax=4, qlens=[1, 2, 4, 3])
+    before = pa.VERIFY_KERNEL_CALLS
+    out = pa.paged_attention_verify(q, kc, vc, tables, lens, qlens)
+    assert pa.VERIFY_KERNEL_CALLS > before, "verify kernel path not taken"
+    ref = pa.paged_verify_reference(q, kc, vc, tables, lens, qlens)
+    # compare live rows only (padding rows are unspecified by contract)
+    for b_ in range(4):
+        ql = int(qlens[b_])
+        np.testing.assert_allclose(np.asarray(out)[b_, :ql],
+                                   np.asarray(ref)[b_, :ql],
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_paged_verify_rows_match_single_token_decode():
+    """The defining property: row t of verify(seq_lens=L, q_lens=ql) IS the
+    single-token decode of query t over the first L-(ql-1-t) cache positions
+    (token t sees itself and everything before, never the later drafts)."""
+    rs = np.random.RandomState(41)
+    b, qmax = 3, 3
+    q, kc, vc, tables, lens, qlens = _verify_case(
+        rs, b=b, nh=8, nkv=2, hd=32, bs=16, max_blocks=4,
+        lens=[9, 30, 50], qmax=qmax, qlens=[3, 1, 2])
+    out = pa.paged_attention_verify(q, kc, vc, tables, lens, qlens)
+    for b_ in range(b):
+        ql = int(qlens[b_])
+        for t in range(ql):
+            row_len = int(lens[b_]) - (ql - 1 - t)
+            one = pa.paged_attention_decode(
+                q[b_:b_ + 1, t], kc, vc, tables[b_:b_ + 1],
+                jnp.asarray([row_len], jnp.int32))
+            np.testing.assert_allclose(np.asarray(out)[b_, t],
+                                       np.asarray(one)[0],
+                                       rtol=2e-3, atol=2e-3)
+
+
+def test_paged_verify_qlen1_matches_decode():
+    """q_lens all 1 degenerates to plain decode: the verify family must not
+    drift from the single-token kernel it generalizes."""
+    rs = np.random.RandomState(42)
+    q, kc, vc, tables, lens, qlens = _verify_case(
+        rs, b=3, nh=8, nkv=2, hd=64, bs=16, max_blocks=4,
+        lens=[7, 33, 64], qmax=1, qlens=[1, 1, 1])
+    out = pa.paged_attention_verify(q, kc, vc, tables, lens, qlens)
+    one = pa.paged_attention_decode(q[:, 0], kc, vc, tables, lens)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], np.asarray(one),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_paged_verify_disable_env_routes_to_oracle(monkeypatch):
+    rs = np.random.RandomState(43)
+    q, kc, vc, tables, lens, qlens = _verify_case(
+        rs, b=2, nh=4, nkv=2, hd=32, bs=16, max_blocks=2,
+        lens=[5, 30], qmax=3, qlens=[3, 2])
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_PALLAS", "paged_attention")
+    before = pa.VERIFY_FALLBACK_CALLS
+    out = pa.paged_attention_verify(q, kc, vc, tables, lens, qlens)
+    assert pa.VERIFY_FALLBACK_CALLS > before
+    ref = pa.paged_verify_reference(q, kc, vc, tables, lens, qlens)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_paged_verify_under_jit_and_bf16():
+    rs = np.random.RandomState(44)
+    q, kc, vc, tables, lens, qlens = _verify_case(
+        rs, b=2, nh=8, nkv=2, hd=64, bs=8, max_blocks=4, lens=[9, 25],
+        qmax=4, qlens=[4, 2], dtype=jnp.bfloat16)
+    out = jax.jit(pa.paged_attention_verify)(q, kc, vc, tables, lens, qlens)
+    assert out.dtype == jnp.bfloat16
+    ref = pa.paged_verify_reference(q, kc, vc, tables, lens, qlens)
+    for b_ in range(2):
+        ql = int(qlens[b_])
+        assert float(jnp.max(jnp.abs(
+            out[b_, :ql].astype(jnp.float32)
+            - ref[b_, :ql].astype(jnp.float32)))) <= 1e-2
+
+
 def test_flash_fallback_respects_segment_ids():
     """d%8!=0 routes to the composed fallback, which must still honor
     segment_ids (no cross-document attention)."""

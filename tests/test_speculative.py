@@ -1,0 +1,546 @@
+"""Speculative decoding tests (ISSUE 4).
+
+The correctness bar is strict: speculation may only change how many tokens
+each host round-trip banks, NEVER which tokens come out.  Greedy requests
+must be byte-identical to the non-speculative engine across every CB
+schedule (chunk sizes, staggered admission, preemption), and seeded sampled
+requests must be identical too — the acceptance rule draws each position's
+token with the same (seed, position)-derived key the plain sampler uses, so
+the sampled stream is preserved exactly, not merely in distribution."""
+
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
+from paddle_tpu.inference.speculative import NGramDrafter
+from paddle_tpu.models import llama
+
+
+def _tiny():
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=32, layers=2, heads=4,
+                                 kv_heads=2, inter=64)
+    cfg.dtype = jnp.float32  # exact parity
+    params = llama.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _repetitive_prompts(rs, n=3, pat_len=6, reps=4):
+    """Self-similar prompts (a tiled pattern): the prompt-lookup regime."""
+    return [np.tile(rs.randint(0, 128, (pat_len,)).astype(np.int32), reps)
+            for _ in range(n)]
+
+
+# ---------------- drafter unit tests ----------------
+
+
+def test_drafter_proposes_continuation_of_last_match():
+    d = NGramDrafter(num_draft_tokens=4, max_ngram=3)
+    out = d.propose(np.array([1, 2, 3, 4, 5, 1, 2, 3], np.int32))
+    # suffix [1,2,3] matched at position 0 -> continuation [4,5,1,2]
+    np.testing.assert_array_equal(out, [4, 5, 1, 2])
+
+
+def test_drafter_most_recent_match_wins():
+    d = NGramDrafter(num_draft_tokens=4, max_ngram=3)
+    out = d.propose(np.array([9, 1, 2, 7, 7, 1, 2, 8, 8, 1, 2], np.int32))
+    # [1,2] occurs at 1 and 5; the later one's continuation wins
+    np.testing.assert_array_equal(out, [8, 8, 1, 2])
+
+
+def test_drafter_prefers_longer_ngram():
+    d = NGramDrafter(num_draft_tokens=2, max_ngram=3)
+    # trailing [5,6,7]: 3-gram match at 0 (continues 9); the 1-gram [7] also
+    # occurs at 2 (continues 9) and nowhere later except... the 3-gram must
+    # be tried FIRST
+    out = d.propose(np.array([5, 6, 7, 9, 4, 5, 6, 7], np.int32))
+    np.testing.assert_array_equal(out, [9, 4])
+
+
+def test_drafter_no_match_and_short_context_return_empty():
+    d = NGramDrafter(num_draft_tokens=4, max_ngram=3)
+    assert d.propose(np.array([1, 2, 3, 4, 5], np.int32)).size == 0
+    assert d.propose(np.array([7], np.int32)).size == 0
+    assert d.propose(np.zeros(0, np.int32)).size == 0
+
+
+def test_drafter_truncates_near_context_end():
+    d = NGramDrafter(num_draft_tokens=8, max_ngram=2)
+    out = d.propose(np.array([3, 4, 9, 3, 4], np.int32))
+    # match at 0, continuation [9,3,4] — only 3 tokens exist
+    np.testing.assert_array_equal(out, [9, 3, 4])
+
+
+# ---------------- engine: greedy token identity ----------------
+
+
+@pytest.mark.parametrize("chunk", [1, 4])
+def test_spec_greedy_token_identical_across_schedules(chunk):
+    """Spec-on produces exactly the spec-off token streams across chunked
+    schedules and staggered admission, and the drafter actually fires on the
+    self-similar prompts (the win is real, not vacuous)."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(3)
+    prompts = _repetitive_prompts(rs) + [rs.randint(0, 128, (9,))
+                                         .astype(np.int32)]
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=8 + i)
+                for i, p in enumerate(prompts)]
+
+    base = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=chunk, paged=True, block_size=8)
+    ref = base.serve(build())
+    spec = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=chunk, paged=True, block_size=8,
+                                    enable_speculation=True,
+                                    num_draft_tokens=4)
+    got = spec.serve(build())
+    assert got == ref
+    assert spec.stats["spec_steps"] > 0
+    assert spec.stats["spec_drafted_tokens"] > 0
+    assert (spec.stats["spec_accepted_tokens"]
+            + spec.stats["spec_rejected_tokens"]
+            == spec.stats["spec_drafted_tokens"])
+
+
+def test_spec_accepts_on_cyclic_output_and_saves_steps():
+    """Greedy decode of this tiny model enters a cycle; prompt lookup must
+    then accept drafts and bank multiple tokens per step — fewer engine
+    steps than tokens delivered."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(7)
+    prompts = [rs.randint(0, 128, (7,)).astype(np.int32) for _ in range(2)]
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=40)
+                for i, p in enumerate(prompts)]
+
+    base = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=128,
+                                    chunk=1, paged=True, block_size=8,
+                                    num_blocks=32)
+    ref = base.serve(build())
+    spec = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=128,
+                                    chunk=1, paged=True, block_size=8,
+                                    num_blocks=32, enable_speculation=True,
+                                    num_draft_tokens=4)
+    got = spec.serve(build())
+    assert got == ref
+    assert spec.stats["spec_accepted_tokens"] > 0
+    assert 0.0 < spec.spec_acceptance_rate <= 1.0
+    # the whole point: strictly fewer device round-trips than the chunk=1
+    # baseline's one-per-token
+    assert spec.stats["decode_steps"] < base.stats["decode_steps"]
+
+
+def test_spec_eos_inside_accepted_run_trims():
+    """EOS appearing mid-acceptance must trim exactly like the chunked
+    engine's host-side trimming (parity with the spec-off engine)."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(9)
+    prompts = _repetitive_prompts(rs, n=2)
+    base = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=1, paged=True, block_size=8)
+    probe = base.serve([Request(rid=0, prompt_ids=prompts[0],
+                                max_new_tokens=12)])
+    eos = probe[0][5]  # a token the greedy stream actually emits
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=12,
+                        eos_token_id=eos) for i, p in enumerate(prompts)]
+
+    ref = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                   chunk=4, paged=True,
+                                   block_size=8).serve(build())
+    spec = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=4, paged=True, block_size=8,
+                                    enable_speculation=True,
+                                    num_draft_tokens=4)
+    got = spec.serve(build())
+    assert got == ref
+    assert got[0][-1] == eos
+
+
+def test_spec_max_seq_boundary():
+    """Drafts are capped so the verify step never writes past max_seq; a
+    near-boundary request still matches the spec-off engine exactly."""
+    cfg, params = _tiny()
+    S = 16
+    prompt = np.tile(np.arange(1, 6, dtype=np.int32), 3)[:S - 3]
+
+    def build():
+        return [Request(rid=0, prompt_ids=prompt, max_new_tokens=10)]
+
+    ref = ContinuousBatchingEngine(cfg, params, max_batch=1, max_seq=S,
+                                   chunk=1, paged=True, block_size=8,
+                                   num_blocks=2).serve(build())
+    spec = ContinuousBatchingEngine(cfg, params, max_batch=1, max_seq=S,
+                                    chunk=1, paged=True, block_size=8,
+                                    num_blocks=2, enable_speculation=True,
+                                    num_draft_tokens=4)
+    got = spec.serve(build())
+    assert got == ref
+
+
+# ---------------- engine: sampled streams ----------------
+
+
+def test_spec_sampled_stream_token_identical():
+    """Seeded temperature/top-p requests: position-derived RNG keys make the
+    speculative engine reproduce the plain sampler's stream EXACTLY (mixed
+    greedy/sampled batch included)."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(11)
+    prompts = _repetitive_prompts(rs, n=2) + [rs.randint(0, 128, (9,))
+                                              .astype(np.int32)]
+
+    def build():
+        return [Request(rid=0, prompt_ids=prompts[0], max_new_tokens=10),
+                Request(rid=1, prompt_ids=prompts[1], max_new_tokens=10,
+                        temperature=0.9, top_p=0.8, seed=42),
+                Request(rid=2, prompt_ids=prompts[2], max_new_tokens=10,
+                        temperature=1.3, seed=7)]
+
+    base = ContinuousBatchingEngine(cfg, params, max_batch=3, max_seq=64,
+                                    chunk=2, paged=True, block_size=8)
+    ref = base.serve(build())
+    spec = ContinuousBatchingEngine(cfg, params, max_batch=3, max_seq=64,
+                                    chunk=2, paged=True, block_size=8,
+                                    enable_speculation=True,
+                                    num_draft_tokens=3)
+    got = spec.serve(build())
+    assert got == ref
+    assert spec.stats["spec_steps"] > 0
+
+
+def test_spec_sampled_distribution_preserved_statistically():
+    """ISSUE acceptance: across many seeds, the speculative sampler's output
+    multiset equals the plain sampler's — the empirical distribution is
+    preserved seed-for-seed, which implies distribution preservation."""
+    cfg, params = _tiny()
+    prompt = np.tile(np.arange(1, 7, dtype=np.int32), 4)
+
+    def run(engine_kwargs, seed):
+        eng = ContinuousBatchingEngine(cfg, params, max_batch=1, max_seq=64,
+                                       chunk=1, paged=True, block_size=8,
+                                       num_blocks=8, **engine_kwargs)
+        return tuple(eng.serve([Request(
+            rid=0, prompt_ids=prompt, max_new_tokens=6, temperature=1.1,
+            top_p=0.9, seed=seed)])[0])
+
+    seeds = range(20)
+    plain = [run({}, s) for s in seeds]
+    spec = [run(dict(enable_speculation=True, num_draft_tokens=3), s)
+            for s in seeds]
+    assert spec == plain                       # per-seed identity...
+    assert sorted(spec) == sorted(plain)       # ...hence identical empirical
+    assert len(set(plain)) > 1                 # and the test isn't vacuous
+
+
+# ---------------- engine: zero-overhead miss path ----------------
+
+
+def test_spec_no_match_falls_back_to_normal_decode():
+    """Prompts with no repeated n-gram and a non-cyclic budget: the drafter
+    never proposes, the verify program is never traced (zero overhead — the
+    step shape is the spec-off engine's), and tokens still match."""
+    cfg, params = _tiny()
+    # strictly increasing ids: no n-gram can repeat inside the prompt, and a
+    # 2-token budget is too short for the output to build a cycle
+    prompts = [np.arange(1, 12, dtype=np.int32),
+               np.arange(40, 47, dtype=np.int32)]
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=2)
+                for i, p in enumerate(prompts)]
+
+    base = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=2, paged=True, block_size=8)
+    ref = base.serve(build())
+    spec = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=2, paged=True, block_size=8,
+                                    enable_speculation=True,
+                                    num_draft_tokens=4)
+    got = spec.serve(build())
+    assert got == ref
+    assert spec.stats["spec_steps"] == 0
+    assert spec.stats["spec_drafted_tokens"] == 0
+    # the verify programs exist but were never traced: compiled-variant
+    # count equals the spec-off engine's (no shape-family churn)
+    assert spec.n_traces() == base.n_traces()
+
+
+def test_spec_n_traces_stable_across_spec_steps():
+    """Per-slot draft raggedness is DATA: however many drafts each step
+    carries, the verify family compiles exactly once (greedy serve), and a
+    second serve through the same engine adds nothing."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(5)
+    prompts = _repetitive_prompts(rs, n=4, pat_len=5)
+    spec = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=2, paged=True, block_size=8,
+                                    enable_speculation=True,
+                                    num_draft_tokens=4)
+    spec.serve([Request(rid=i, prompt_ids=p, max_new_tokens=10)
+                for i, p in enumerate(prompts)])
+    assert spec.stats["spec_steps"] > 0
+    n1 = spec.n_traces()
+    spec.serve([Request(rid=10 + i, prompt_ids=p, max_new_tokens=7)
+                for i, p in enumerate(prompts)])
+    assert spec.n_traces() == n1
+
+
+# ---------------- engine: config / env plumbing ----------------
+
+
+def test_spec_requires_paged():
+    cfg, params = _tiny()
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                 enable_speculation=True)
+
+
+def test_spec_env_kill_switch(monkeypatch):
+    """PADDLE_TPU_SPECULATE=0 neutralizes the feature totally: no drafter,
+    no verify programs, byte-identical serve — even on a (normally invalid)
+    dense engine."""
+    cfg, params = _tiny()
+    monkeypatch.setenv("PADDLE_TPU_SPECULATE", "0")
+    # dense + speculation would raise; the kill switch wins instead
+    dense = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                     enable_speculation=True)
+    assert dense._spec is None
+    rs = np.random.RandomState(3)
+    prompts = _repetitive_prompts(rs, n=2)
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=8)
+                for i, p in enumerate(prompts)]
+
+    killed = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                      chunk=2, paged=True, block_size=8,
+                                      enable_speculation=True)
+    assert killed._spec is None
+    got = killed.serve(build())
+    assert killed.stats["spec_steps"] == 0
+    monkeypatch.delenv("PADDLE_TPU_SPECULATE")
+    plain = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                     chunk=2, paged=True, block_size=8)
+    assert plain.serve(build()) == got
+    assert killed.n_traces() == plain.n_traces()
+
+
+def test_spec_env_typo_warns_and_keeps_default(monkeypatch):
+    """A typo'd kill switch must warn and keep speculation ON (the
+    documented default) — never silently flip either way."""
+    from paddle_tpu.utils import envflags
+
+    cfg, params = _tiny()
+    monkeypatch.setenv("PADDLE_TPU_SPECULATE", "off")
+    envflags._warned.clear()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        eng = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                       paged=True, block_size=8,
+                                       enable_speculation=True)
+    assert eng._spec is not None
+    assert any("PADDLE_TPU_SPECULATE" in str(x.message) for x in w)
+
+
+def test_spec_flag_registered_with_default_on():
+    from paddle_tpu.utils.envflags import BOOL_FLAGS
+
+    assert BOOL_FLAGS["PADDLE_TPU_SPECULATE"] is True
+
+
+# ---------------- engine: paged-KV accounting under speculation ----------
+
+
+def test_spec_multi_token_append_crosses_block_boundary():
+    """block_size=4 with K=4 drafts: verify appends routinely straddle page
+    boundaries; streams stay exact and the pool closes to the full free
+    list after every request retires."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(13)
+    prompts = _repetitive_prompts(rs, n=4, pat_len=5, reps=3)
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=9)
+                for i, p in enumerate(prompts)]
+
+    ref = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                   chunk=1, paged=True, block_size=4,
+                                   num_blocks=24).serve(build())
+    spec = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=1, paged=True, block_size=4,
+                                    num_blocks=24, enable_speculation=True,
+                                    num_draft_tokens=4)
+    got = spec.serve(build())
+    assert got == ref
+    assert spec.stats["spec_steps"] > 0
+    assert sorted(spec._free) == list(range(24))
+    assert (spec._table == spec.num_blocks).all()
+
+
+def test_spec_preemption_resume_exact():
+    """An oversubscribed pool preempts mid-speculation; recompute-resume
+    (teacher-forcing + position-derived keys) keeps greedy AND sampled
+    streams exact."""
+    cfg, params = _tiny()
+    prompts = [np.tile(np.arange(1, 9, dtype=np.int32), 5),
+               np.tile(np.arange(2, 9, dtype=np.int32), 5),
+               np.tile(np.arange(3, 9, dtype=np.int32), 5)]
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=10,
+                        temperature=0.9 if i == 1 else 0.0, seed=100 + i)
+                for i, p in enumerate(prompts)]
+
+    dense = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                     chunk=1)
+    ref = dense.serve(build())
+    spec = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=1, paged=True, block_size=8,
+                                    num_blocks=10, enable_speculation=True,
+                                    num_draft_tokens=3)
+    got = spec.serve(build())
+    assert got == ref
+    assert spec.stats["preemptions"] > 0
+
+
+# ---------------- speculation x prefix cache ----------------
+
+
+def test_spec_prefix_cache_interplay(monkeypatch):
+    """Speculation and the prefix cache compose: token parity holds with
+    both on (runtime auditor enabled), rejected drafts are NEVER content-
+    addressed into the cache, and COW stays correct for a later divergent
+    request."""
+    monkeypatch.setenv("PADDLE_TPU_ENGINE_AUDIT", "1")
+    cfg, params = _tiny()
+    rs = np.random.RandomState(17)
+    shared = np.tile(rs.randint(0, 128, (8,)).astype(np.int32), 2)  # 2 blocks
+
+    def build():
+        return [Request(rid=i, prompt_ids=np.concatenate(
+                    [shared, rs_i.astype(np.int32)]), max_new_tokens=12)
+                for i, rs_i in enumerate([np.arange(3, 8), np.arange(9, 14),
+                                          np.arange(20, 25)])]
+
+    ref = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                   chunk=2, paged=True,
+                                   block_size=8).serve(build())
+    spec = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=2, paged=True, block_size=8,
+                                    enable_speculation=True,
+                                    num_draft_tokens=4,
+                                    enable_prefix_caching=True)
+    got = spec.serve(build())
+    assert got == ref
+    assert spec.stats["prefix_hits"] > 0      # the cache actually engaged
+    assert spec.stats["spec_steps"] > 0       # and so did speculation
+    # a second serve of the same prompts reuses the cached prefix (COW on
+    # the fully-matched boundary included) and must reproduce the streams
+    served = spec.serve(build())
+    assert served == ref
+    # pool accounting still closes with both features on
+    cached = [e.page for e in spec._pcache._by_hash.values()]
+    assert sorted(spec._free + cached) == list(range(spec.num_blocks))
+
+
+def test_spec_rejected_tokens_never_cached():
+    """Directly pin the rollback-vs-cache contract: after a serve with
+    rejections, every resident cached chain matches a prefix of some
+    request's delivered prompt+output stream."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(19)
+    prompts = _repetitive_prompts(rs, n=3, pat_len=4, reps=4)
+    spec = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=1, paged=True, block_size=4,
+                                    num_blocks=24, enable_speculation=True,
+                                    num_draft_tokens=4,
+                                    enable_prefix_caching=True)
+    reqs = [Request(rid=i, prompt_ids=p, max_new_tokens=10)
+            for i, p in enumerate(prompts)]
+    spec.serve(reqs)
+    assert spec.stats["spec_rejected_tokens"] > 0  # rollback actually fired
+    streams = [np.concatenate([p, np.asarray(r.output_ids, np.int32)])
+               for p, r in zip(prompts, reqs)]
+    bs = spec.block_size
+    matched_hashes = set()
+    for s in streams:
+        matched_hashes |= {e.hash for e in spec._pcache.match(s)}
+    resident = set(spec._pcache._by_hash)
+    # every resident block is reachable as a prefix of a delivered stream —
+    # a block containing rejected drafts would be unreachable garbage
+    assert resident == matched_hashes, (
+        f"{len(resident - matched_hashes)} cached block(s) hold bytes no "
+        f"delivered stream contains")
+    assert all(len(s) >= bs for s in streams)  # the check above saw blocks
+
+
+# ---------------- runtime audit: multi-token append + rollback ----------
+
+
+def test_audit_spec_serve_clean(monkeypatch):
+    """The full speculative suite of invariants holds live: a serve with
+    drafting, rejection rollback, and retirement passes the auditor after
+    every step."""
+    monkeypatch.setenv("PADDLE_TPU_ENGINE_AUDIT", "1")
+    cfg, params = _tiny()
+    rs = np.random.RandomState(23)
+    prompts = _repetitive_prompts(rs, n=3)
+    spec = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=2, paged=True, block_size=8,
+                                    enable_speculation=True,
+                                    num_draft_tokens=4)
+    assert spec._audit_every_step
+    spec.serve([Request(rid=i, prompt_ids=p, max_new_tokens=10)
+                for i, p in enumerate(prompts)])
+    assert spec.stats["spec_steps"] > 0
+
+
+def test_audit_detects_pos_ahead_of_written(monkeypatch):
+    """Corruption injection: pos advanced past the KV-write high-water mark
+    (a rollback bug — emitting tokens whose K/V was never written) must
+    raise EngineAuditError naming I6."""
+    from paddle_tpu.analysis.engine_audit import EngineAuditError, audit_engine
+
+    cfg, params = _tiny()
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                   chunk=1, paged=True, block_size=8,
+                                   enable_speculation=True,
+                                   num_draft_tokens=3)
+    eng.add_request(Request(rid=0, prompt_ids=np.arange(1, 10, dtype=np.int32),
+                            max_new_tokens=4))
+    eng._admit()
+    audit_engine(eng)  # clean after admission
+    eng._pos[0] = int(eng._written[0]) + 2   # inject: pos outran the writes
+    with pytest.raises(EngineAuditError, match="I6"):
+        audit_engine(eng)
+
+
+def test_audit_detects_written_beyond_mapped_pages(monkeypatch):
+    """Corruption injection: a written high-water mark past the slot's
+    mapped pages (multi-token append outran allocation) must raise."""
+    from paddle_tpu.analysis.engine_audit import EngineAuditError, audit_engine
+
+    cfg, params = _tiny()
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                   chunk=1, paged=True, block_size=8,
+                                   enable_speculation=True,
+                                   num_draft_tokens=3)
+    eng.add_request(Request(rid=0, prompt_ids=np.arange(1, 10, dtype=np.int32),
+                            max_new_tokens=4))
+    eng._admit()
+    audit_engine(eng)
+    covered = (len(eng._slot_shared[0]) + len(eng._slot_blocks[0])) \
+        * eng.block_size
+    eng._written[0] = covered + 1            # inject: write past allocation
+    with pytest.raises(EngineAuditError, match="I6"):
+        audit_engine(eng)

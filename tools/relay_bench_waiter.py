@@ -46,6 +46,14 @@ TARGETS = {
     "cb_prefix_cold": "llama_cb_decode_tokens_per_sec/cb_prefix_cold",
     "cb_3b_prefix_hot_int4":
         "llama_cb_decode_tokens_per_sec/cb_3b_prefix_hot_int4",
+    # round-8 evidence rungs: speculative decoding (n-gram drafting +
+    # ragged multi-token verify) hot/cold, and the SAME hot workload with
+    # speculation off — the matched baseline for the >=1.5x criterion
+    # (docs/speculative.md); exact keys so the hot rung can never satisfy
+    # its own baseline
+    "cb_spec_ngram_hot": "llama_cb_decode_tokens_per_sec/cb_spec_ngram_hot",
+    "cb_spec_ngram_cold": "llama_cb_decode_tokens_per_sec/cb_spec_ngram_cold",
+    "cb_spec_ngram_base": "llama_cb_decode_tokens_per_sec/cb_spec_ngram_base",
 }
 
 
