@@ -844,12 +844,174 @@ def decode_ladder_main(compact: bool = False) -> int:
             log(f"cb spec rung {rung[0]} failed: {e}\n"
                 f"{traceback.format_exc()}")
             continue
+    # chunked-prefill A/B (ISSUE 5): 6 short-prompt requests decode while 2
+    # near-max prompts arrive mid-serve — same workload chunked on vs off,
+    # so the off rung's TBT p99 spike IS the stall the mixed step erases.
+    # Pool sized so the workload is prefill-bound, not preemption-bound.
+    # (rung tuple: cfg, slots, n_decode, n_long, short_prompt, long_prompt,
+    # new, max_seq, num_blocks, chunked[, prefill_chunk, token_budget,
+    # block_size, inject_after])
+    chunked_rungs = ([
+        ("cb_chunked_prefill_mixed", full_cfg, 8, 6, 2, 32, 448, 64, 512,
+         56, True),
+        ("cb_chunked_prefill_off", full_cfg, 8, 6, 2, 32, 448, 64, 512,
+         56, False),
+    ] if on_tpu else [
+        ("cb_chunked_cpu_smoke", llama.LlamaConfig.tiny(), 2, 1, 1, 8, 40,
+         8, 64, 12, True, 8, None, 8, 4),
+    ])
+    for rung in chunked_rungs:
+        try:
+            emit(run_cb_chunked_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"cb chunked rung {rung[0]} failed: {e}\n"
+                f"{traceback.format_exc()}")
+            continue
     return 0 if banked else 1
 
 
 # ---------------------------------------------------------------------------
 # vision ladder (ResNet-50 training — BASELINE.md config ladder row #2)
 # ---------------------------------------------------------------------------
+
+def run_cb_chunked_rung(name, cfg, max_batch, n_decode, n_long, short_prompt,
+                        long_prompt, new, max_seq, num_blocks, chunked=True,
+                        prefill_chunk=128, token_budget=None, block_size=64,
+                        inject_after=8):
+    """Chunked-prefill A/B rung (ISSUE 5): ``n_decode`` short-prompt requests
+    decode steadily; after ``inject_after`` engine steps, ``n_long``
+    near-max prompts arrive mid-decode.  Chunked-off, each arrival's
+    monolithic bucketed prefill stalls every decode lane for the whole
+    prompt — the TBT (inter-token latency) p99 spike this feature erases;
+    chunked-on, the prompts stream through the unified mixed step under the
+    token budget while decode advances every step.  Reports TBT p50/p99
+    over per-request token-arrival gaps, TTFT for the long arrivals,
+    ``decode_stall_steps`` (must be 0 chunked-on) and ``n_traces`` (prefill
+    compiles O(1) variants chunked-on vs the bucketed path's log2(max_seq)
+    family).  chunk=1 throughout so TBT gaps are per-token, not per-scan."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              Request, _bucket)
+    from paddle_tpu.ops.pallas import paged_attention as _pa
+
+    log(f"cb chunked rung {name}: building (slots={max_batch} "
+        f"decode={n_decode} long={n_long} chunked={chunked})")
+    rs = np.random.RandomState(0)
+    params = llama.init_params(cfg, jax.random.key(0))
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
+                                   max_seq=max_seq, chunk=1, paged=True,
+                                   block_size=block_size,
+                                   num_blocks=num_blocks,
+                                   enable_chunked_prefill=chunked,
+                                   prefill_chunk=prefill_chunk,
+                                   token_budget=token_budget)
+    del params
+    pk0, pf0 = _pa.PREFILL_KERNEL_CALLS, _pa.PREFILL_FALLBACK_CALLS
+    # warm every program a timed request can hit: decode + (chunked) the
+    # mixed step, or (bucketed) one prefill per power-of-two bucket between
+    # the short and long prompt lengths — no XLA compile may land inside
+    # the timed region on either arm of the A/B
+    t_c = time.perf_counter()
+    warm_lens = {short_prompt, long_prompt}
+    if not chunked:
+        b = min(_bucket(short_prompt), max_seq)
+        while b <= min(_bucket(long_prompt), max_seq):
+            warm_lens.add(min(b, max_seq - 1))
+            b *= 2
+    for wi, wl in enumerate(sorted(warm_lens)):
+        eng.serve([Request(rid=-1 - wi,
+                           prompt_ids=rs.randint(0, cfg.vocab_size, (wl,))
+                           .astype(np.int32), max_new_tokens=2)])
+    log(f"cb chunked rung {name}: compile {time.perf_counter() - t_c:.1f}s")
+    eng.stats.update(decode_steps=0, decode_tokens=0, decode_time_s=0.0,
+                     prefills=0, prefill_chunks=0, mixed_steps=0,
+                     decode_stall_steps=0)
+    deco = [Request(rid=i, prompt_ids=rs.randint(
+                0, cfg.vocab_size, (short_prompt,)).astype(np.int32),
+                max_new_tokens=new) for i in range(n_decode)]
+    longs = [Request(rid=100 + i, prompt_ids=rs.randint(
+                0, cfg.vocab_size, (long_prompt,)).astype(np.int32),
+                max_new_tokens=8) for i in range(n_long)]
+    for r in deco:
+        eng.add_request(r)
+    # per-request token-arrival timeline: (timestamp, cumulative tokens)
+    seen = {r.rid: 0 for r in deco + longs}
+    arrivals = {r.rid: [] for r in deco + longs}
+    injected = False
+    steps = 0
+    t0 = time.perf_counter()
+    while True:
+        busy = eng.step()
+        steps += 1
+        now = time.perf_counter()
+        for r in deco + longs:
+            if len(r.output_ids) > seen[r.rid]:
+                seen[r.rid] = len(r.output_ids)
+                arrivals[r.rid].append(now)
+        if not injected and (steps >= inject_after or not busy):
+            # the long prompts land while the short batch is mid-decode —
+            # the stall regime the A/B measures
+            for r in longs:
+                eng.add_request(r)
+            injected = True
+            continue
+        if not busy and not eng._queue:
+            break
+    wall = time.perf_counter() - t0
+    # TBT = gaps between consecutive token arrivals per DECODE request
+    # (first arrival is TTFT, excluded); the chunked-off spike shows up as
+    # p99 ~= the long prompts' prefill time
+    gaps = [b_ - a for r in deco for a, b_ in zip(arrivals[r.rid],
+                                                  arrivals[r.rid][1:])]
+    gaps = sorted(gaps)
+    pct = (lambda p: round(
+        1e3 * gaps[min(len(gaps) - 1, int(p * (len(gaps) - 1)))], 3)
+        if gaps else None)
+    ttfts = [r.ttft_s for r in longs if r.ttft_s is not None]
+    # headline = generated tokens over the WHOLE timed serve, measured
+    # identically on both arms.  (engine decode_tokens_per_s would bias the
+    # A/B: the mixed arm's decode_time_s absorbs prefill-chunk compute
+    # inside the unified launch while the off arm's monolithic prefills run
+    # in _admit outside it — kept in detail, never as the headline.)
+    toks_total = sum(len(r.output_ids) for r in deco + longs)
+    return {
+        "metric": "llama_cb_decode_tokens_per_sec",
+        "value": round(toks_total / wall, 1) if wall > 0 else 0.0,
+        "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "detail": {"rung": name, "slots": max_batch,
+                   "decode_requests": n_decode, "long_requests": n_long,
+                   "short_prompt": short_prompt, "long_prompt": long_prompt,
+                   "new_tokens": new, "wall_s": round(wall, 2),
+                   "tokens_generated": toks_total,
+                   "decode_tokens_per_s_engine":
+                       round(eng.decode_tokens_per_s, 1),
+                   "chunked": chunked,
+                   "prefill_chunk": prefill_chunk if chunked else None,
+                   "token_budget": (eng._token_budget if chunked else None),
+                   "tbt_p50_ms": pct(0.50), "tbt_p99_ms": pct(0.99),
+                   "tbt_max_ms": (round(1e3 * gaps[-1], 3) if gaps
+                                  else None),
+                   "ttft_long_mean_s": round(sum(ttfts) / len(ttfts), 4)
+                   if ttfts else None,
+                   "ttft_long_max_s": round(max(ttfts), 4) if ttfts else None,
+                   "decode_stall_steps": eng.stats["decode_stall_steps"],
+                   "mixed_steps": eng.stats["mixed_steps"],
+                   "prefill_chunks": eng.stats["prefill_chunks"],
+                   "prefills": eng.stats["prefills"],
+                   "preemptions": eng.stats["preemptions"],
+                   "prefill_kernel_calls":
+                       _pa.PREFILL_KERNEL_CALLS - pk0,
+                   "prefill_fallback_calls":
+                       _pa.PREFILL_FALLBACK_CALLS - pf0,
+                   "n_traces": eng.n_traces(),
+                   "backend": jax.default_backend()},
+    }
+
 
 def run_vision_rung(name, arch, batch, img, warmup_steps, bench_steps, flops_per_img):
     """ResNet train-step throughput via the fully-jitted TrainStep path

@@ -27,6 +27,13 @@ I6  position bounds — active slots have 0 <= pos <= max_seq, the KV-write
     past rejected drafts — pos may trail written, never lead it), and the
     mapped blocks cover every written position including rejected drafts'
     (multi-token append must have allocated pages before the device wrote).
+I7  chunked-prefill progress (engines with ``enable_chunked_prefill``) — a
+    prefilling slot holds a seated request, its ``prefilled`` cursor stays
+    within [0, prompt_len], the slot's mapped pages cover every prefilled
+    position (a chunk must never have scattered K/V into unallocated
+    pages), and no slot was packed as BOTH a decode lane and a prefill lane
+    in the same mixed step (the unified launch's two roles are disjoint by
+    construction — an overlap means the scheduler double-advanced a slot).
 
 Dense (non-paged) engines only get I6's bounds check — there is no allocator
 to corrupt.  The audit is O(pool + slots·blocks) pure-host work per step:
@@ -149,6 +156,31 @@ def audit_engine(eng) -> None:
                 _fail("I6", f"slot {s} written high-water {hw} beyond "
                             f"mapped pages ({covered} positions): "
                             f"multi-token append outran its allocation")
+
+    # I7: chunked-prefill progress (only when the feature is live)
+    if getattr(eng, "_chunked", False):
+        for s in range(B):
+            ids = eng._prefill_ids[s]
+            if ids is None:
+                continue
+            if eng._slot_req[s] is None:
+                _fail("I7", f"slot {s} is mid-prefill with no request "
+                            f"seated")
+            cur = int(eng._prefilled[s])
+            if not 0 <= cur <= ids.size:
+                _fail("I7", f"slot {s} prefill cursor {cur} outside "
+                            f"[0, {ids.size}] (prompt length)")
+            covered = (len(eng._slot_shared[s])
+                       + len(eng._slot_blocks[s])) * eng.block_size
+            if cur > covered:
+                _fail("I7", f"slot {s} prefilled {cur} positions but its "
+                            f"mapped pages cover only {covered}: a chunk "
+                            f"scattered K/V into unallocated pages")
+        dec, pre = getattr(eng, "_last_pack", ((), ()))
+        overlap = set(dec) & set(pre)
+        if overlap:
+            _fail("I7", f"slot(s) {sorted(overlap)} packed as BOTH decode "
+                        f"and prefill in one mixed step")
 
     if cache is None:
         return

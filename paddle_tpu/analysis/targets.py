@@ -64,7 +64,9 @@ def _t_moe_train_step() -> AnalysisTarget:
                           (params, opt_state, ids, labels))
 
 
-def _serving_engine(**kwargs):
+def _serving_engine(_force_flags=(), **kwargs):
+    import contextlib
+    import os
     import jax
 
     from ..models import llama
@@ -73,9 +75,20 @@ def _serving_engine(**kwargs):
     cfg = llama.LlamaConfig.tiny(vocab=128, hidden=32, layers=2, heads=4,
                                  kv_heads=2, inter=64)
     params = llama.init_params(cfg, jax.random.key(0))
-    return ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
-                                    chunk=2, paged=True, block_size=8,
-                                    **kwargs)
+    # the lint gate analyzes a feature's compiled program even when the
+    # operator's kill switch (e.g. PADDLE_TPU_CHUNKED_PREFILL=0) has it off
+    # at runtime — without the override the ctor would skip building the
+    # program and the target builder would crash the whole gate
+    with contextlib.ExitStack() as stack:
+        for flag in _force_flags:
+            prev = os.environ.get(flag)
+            os.environ[flag] = "1"
+            stack.callback(lambda f=flag, p=prev: (
+                os.environ.__setitem__(f, p) if p is not None
+                else os.environ.pop(f, None)))
+        return ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                        chunk=2, paged=True, block_size=8,
+                                        **kwargs)
 
 
 def _t_serving_decode_step() -> AnalysisTarget:
@@ -119,7 +132,8 @@ def _t_serving_prefill_step() -> AnalysisTarget:
 def _t_serving_verify_step() -> AnalysisTarget:
     import jax.numpy as jnp
 
-    eng = _serving_engine(enable_speculation=True, num_draft_tokens=3)
+    eng = _serving_engine(_force_flags=("PADDLE_TPU_SPECULATE",),
+                          enable_speculation=True, num_draft_tokens=3)
     B = eng.max_batch
     Q = eng._spec_qmax
     # slot 0 mid-decode carrying a full draft, slot 1 idle — the exact data
@@ -139,12 +153,38 @@ def _t_serving_verify_step() -> AnalysisTarget:
          temp, topp, seeds, table))
 
 
+def _t_serving_mixed_step() -> AnalysisTarget:
+    import jax.numpy as jnp
+
+    eng = _serving_engine(_force_flags=("PADDLE_TPU_CHUNKED_PREFILL",),
+                          enable_chunked_prefill=True, prefill_chunk=8)
+    B = eng.max_batch
+    T = eng._prefill_chunk
+    # slot 0 decoding (one live row), slot 1 streaming a full prefill chunk
+    # — the exact mixed regime the unified step compiles once for (pos /
+    # q_lens / active are DATA, so this one trace covers every token-budget
+    # packing the scheduler can emit)
+    tokens = jnp.zeros((B, T), jnp.int32)
+    pos = jnp.asarray([5, 0], jnp.int32)
+    active = jnp.asarray([True, True])
+    q_lens = jnp.asarray([1, T], jnp.int32)
+    temp = jnp.zeros((B,), jnp.float32)
+    topp = jnp.ones((B,), jnp.float32)
+    seeds = jnp.zeros((B,), jnp.int32)
+    table = jnp.asarray(eng._table)
+    return AnalysisTarget(
+        "serving_mixed_step", eng._mixed_greedy,
+        (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active, q_lens,
+         temp, topp, seeds, table))
+
+
 TARGETS = {
     "llama_train_step": _t_llama_train_step,
     "moe_llama_train_step": _t_moe_train_step,
     "serving_decode_step": _t_serving_decode_step,
     "serving_prefill_step": _t_serving_prefill_step,
     "serving_verify_step": _t_serving_verify_step,
+    "serving_mixed_step": _t_serving_mixed_step,
 }
 
 # the CI gate runs every registered target; kept as an explicit list so an
@@ -152,7 +192,7 @@ TARGETS = {
 # slowing the tier-1 suite
 GATE_TARGETS = ("llama_train_step", "moe_llama_train_step",
                 "serving_decode_step", "serving_prefill_step",
-                "serving_verify_step")
+                "serving_verify_step", "serving_mixed_step")
 
 
 def build(name: str) -> AnalysisTarget:

@@ -70,6 +70,28 @@ ordinary chunked decode — a drafter miss costs nothing.  Opt-out:
 ``PADDLE_TPU_SPECULATE=0``; spec-off the engine is byte-identical to the
 non-speculative engine.
 
+``enable_chunked_prefill=True`` (paged mode only) removes the last
+monolithic hot path: instead of one bucketed whole-prompt prefill per
+admission — which stalls every running decode slot for the full prompt
+length and compiles a log2(max_seq) family of prefill variants — every
+prompt streams in as fixed-size ``prefill_chunk``-token chunks co-scheduled
+with decode inside ONE compiled **mixed step** (docs/chunked_prefill.md;
+the Sarathi-style stall-free batching the ragged paged-attention papers
+argue for).  Each engine step packs up to ``token_budget`` tokens as
+[decode slots | prefill chunks]: every decode-ready slot advances exactly
+one token (row 0 of its lane), prefilling slots carry up to
+``prefill_chunk`` prompt rows, and the whole [B, T] launch runs the ragged
+chunked-prefill kernel (`ops/pallas/paged_attention.paged_attention_prefill`
+— per-slot positions/q_lens are DATA, so prefill compiles O(1) variants
+regardless of prompt length).  A prefill lane's final row sits at the last
+prompt token's position, so its logits ARE the first decode step's — TTFT
+costs no extra launch.  Prefix-cache hits start the first chunk at the
+first uncached token and register pages as chunks complete them;
+speculation skips slots still prefilling (mixed steps run while any prompt
+streams, the spec path resumes once prefill drains).  Opt-out:
+``PADDLE_TPU_CHUNKED_PREFILL=0``; chunked-off the engine is byte-identical
+to the bucketed-prefill engine.
+
 Per-request sampling (reference: ``top_p_sampling``, ops.yaml:4947) runs
 inside the jitted step: temperature/top-p/seed are per-slot DATA vectors, so
 one compiled program serves mixed greedy/sampled batches, and RNG keys
@@ -132,7 +154,8 @@ class ContinuousBatchingEngine:
                  block_size: int = 64, num_blocks: int | None = None,
                  enable_prefix_caching: bool = False,
                  enable_speculation: bool = False, num_draft_tokens: int = 4,
-                 spec_ngram: int = 3):
+                 spec_ngram: int = 3, enable_chunked_prefill: bool = False,
+                 prefill_chunk: int = 128, token_budget: int | None = None):
         """``chunk``: decode steps per compiled call.  Tokens feed back
         on-device inside a lax.scan and the host fetches ``chunk`` tokens per
         round-trip — the lever against host-device latency (one RTT per token
@@ -153,7 +176,20 @@ class ContinuousBatchingEngine:
         docs/speculative.md).  ``num_draft_tokens`` (K) bounds drafts per
         step — the verify step's static query width is K+1;``spec_ngram`` is
         the longest suffix the drafter matches.  Kill switch:
-        ``PADDLE_TPU_SPECULATE=0`` forces it off regardless."""
+        ``PADDLE_TPU_SPECULATE=0`` forces it off regardless.
+        ``enable_chunked_prefill``: stream prompts in ``prefill_chunk``-token
+        chunks co-scheduled with decode in one compiled mixed step per
+        iteration (paged mode only; docs/chunked_prefill.md).
+        ``token_budget`` caps total tokens per mixed step (decode rows pack
+        first, prefill chunks fill the remainder; default
+        ``prefill_chunk + max_batch``).  While any prompt streams, every
+        engine step is a mixed step — ONE host round-trip per decode token
+        — so a ``chunk > 1`` engine trades its scan's RTT amortization for
+        stall-freedom exactly while prompts are in flight (the Sarathi
+        tradeoff; the untouched chunk-length scan resumes once prefill
+        drains — docs/chunked_prefill.md "token-budget semantics").  Kill
+        switch: ``PADDLE_TPU_CHUNKED_PREFILL=0`` forces it off
+        regardless."""
         from ..models import llama as _llama  # noqa: F401  (cfg type lives there)
 
         self.cfg = cfg
@@ -282,6 +318,50 @@ class ContinuousBatchingEngine:
             self._verify_sampling = jax.jit(
                 functools.partial(self._verify_impl_paged, sampling=True),
                 donate_argnums=(1, 2))
+        # chunked prefill + unified mixed prefill/decode step (stall-free
+        # continuous batching; docs/chunked_prefill.md).  Like the prefix
+        # cache and speculation, EVERY chunked behavior hangs off
+        # self._chunked, and the env kill switch is checked FIRST so
+        # PADDLE_TPU_CHUNKED_PREFILL=0 neutralizes the feature totally —
+        # chunked-off the engine is byte-identical to the bucketed engine.
+        self._chunked = False
+        if enable_chunked_prefill and env_bool("PADDLE_TPU_CHUNKED_PREFILL",
+                                               True):
+            if not paged:
+                raise ValueError(
+                    "enable_chunked_prefill requires paged=True (prefill "
+                    "chunks stream into block-table pages)")
+            if prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {prefill_chunk}")
+            self._chunked = True
+            self._prefill_chunk = int(prefill_chunk)
+            # per-step token cap: decode rows pack FIRST (decode never
+            # stalls), prefill chunks fill the remainder with a 1-token
+            # floor so admission can never livelock on a tiny budget
+            self._token_budget = (int(token_budget)
+                                  if token_budget is not None
+                                  else self._prefill_chunk + max_batch)
+            # per-slot prefill progress: _prefill_ids[s] holds the FULL id
+            # stream (prompt, or prompt + generated-so-far on a preemption
+            # resume) while the slot is still streaming in; _prefilled[s]
+            # is the cursor — the next position whose K/V must be computed.
+            # A slot is "prefilling" iff _prefill_ids[s] is not None.
+            self._prefill_ids: list[np.ndarray | None] = [None] * max_batch
+            self._prefilled = np.zeros(max_batch, np.int32)
+            # the last mixed step's packing (decode slots, prefill slots) —
+            # the runtime auditor's I7 checks the two sets stay disjoint
+            self._last_pack: tuple[tuple[int, ...], tuple[int, ...]] = ((),
+                                                                        ())
+            # ONE compiled [B, T] program per sampling mode for the whole
+            # serve: chunk packing / per-slot progress are q_lens/pos DATA,
+            # so prefill goes from log2(max_seq) bucketed variants to O(1)
+            self._mixed_greedy = jax.jit(
+                functools.partial(self._mixed_impl_paged, sampling=False),
+                donate_argnums=(1, 2))
+            self._mixed_sampling = jax.jit(
+                functools.partial(self._mixed_impl_paged, sampling=True),
+                donate_argnums=(1, 2))
         self.stats = {"decode_steps": 0, "decode_tokens": 0,
                       "prefills": 0, "decode_time_s": 0.0, "preemptions": 0,
                       # prefix-cache observability (all zero with caching off;
@@ -294,7 +374,15 @@ class ContinuousBatchingEngine:
                       # acceptance ticks at the device level — EOS/budget
                       # host trimming does not retroactively un-accept)
                       "spec_steps": 0, "spec_drafted_tokens": 0,
-                      "spec_accepted_tokens": 0, "spec_rejected_tokens": 0}
+                      "spec_accepted_tokens": 0, "spec_rejected_tokens": 0,
+                      # chunked-prefill observability: prefill_chunks /
+                      # mixed_steps tick only with chunking on;
+                      # decode_stall_steps ticks on EVERY engine — with
+                      # chunking off it counts whole-prompt prefills
+                      # dispatched while decode slots sat waiting (the TBT
+                      # spike this feature erases: must be 0 chunked-on)
+                      "prefill_chunks": 0, "mixed_steps": 0,
+                      "decode_stall_steps": 0}
         # opt-in runtime invariant auditor (PADDLE_TPU_ENGINE_AUDIT=1):
         # cross-checks allocator / block-table / prefix-cache bookkeeping
         # after admission and after every decode chunk, raising
@@ -666,6 +754,98 @@ class ContinuousBatchingEngine:
         n_emitted = 1 + jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
         return out, n_emitted.astype(jnp.int32), ck, cv
 
+    # -------- unified mixed prefill/decode step (compiled program) --------
+
+    def _mixed_one(self, params, cache_k, cache_v, tokens, pos, active,
+                   q_lens, table):
+        """One unified prefill/decode forward: tokens [B, T] (row t of slot
+        b = the token at absolute position pos[b]+t), pos [B] row-0
+        positions, q_lens [B] live rows -> (emit-row logits [B, V], caches).
+        Decode-ready slots ride as q_lens == 1 lanes (row 0 = the pending
+        token — exactly ``_decode_one``'s computation at their position);
+        prefilling slots carry a prefill_chunk-row slice of their prompt.
+        Every live row's K/V scatters into its page and attention runs the
+        ragged chunked-prefill kernel (per-row visibility pos+t+1 — the
+        verify kernel's causal law with T free).  ONLY each slot's last
+        live row projects through the lm_head: a mid-prompt chunk's emit is
+        garbage the host ignores, the FINAL chunk's emit row sits at the
+        last prompt token's position so its logits ARE the first decode
+        step's (TTFT costs no extra launch), and a [B, V] head is T times
+        cheaper than the [B, T, V] one the mixed step never needs."""
+        from .. import inference as _inf
+        from ..ops import decode_attention as _da
+        from ..ops.pallas import rope as rope_mod
+
+        cfg = self.cfg
+        B = self.max_batch
+        S = self.max_seq
+        T = tokens.shape[1]
+        nh = cfg.num_attention_heads
+        bs_ = self.block_size
+        x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+        cos_full, sin_full = rope_mod.rope_cos_sin(S, cfg.head_dim,
+                                                   base=cfg.rope_theta,
+                                                   dtype=cfg.dtype)
+        pos_t = pos[:, None] + jnp.arange(T)[None, :]          # [B, T] abs
+        valid_t = (active[:, None] & (jnp.arange(T)[None, :] < q_lens[:, None])
+                   & (pos_t < S))
+        safe_t = jnp.where(valid_t, pos_t, 0)
+        cos = jnp.take(cos_full[0], safe_t, axis=0)            # [B, T, d]
+        sin = jnp.take(sin_full[0], safe_t, axis=0)
+        lane = jnp.arange(B)[:, None]
+        blk = table[lane, safe_t // bs_]                       # [B, T]
+        off = safe_t % bs_
+        drop_blk = jnp.where(valid_t, blk, self.num_blocks)    # oob -> drop
+
+        def write(ck, k):
+            # ck [num_blocks, nkv, bs, hd]; k [B, T, nkv, hd].  Allocator
+            # invariant: distinct slots own disjoint pages, distinct rows
+            # hit distinct positions — no scatter collisions among live
+            # writes; the kernel reads the paged pool directly.
+            out = ck.at[drop_blk, :, off].set(k, mode="drop")
+            return out, out
+
+        # total written length per slot incl. this chunk; inactive lanes
+        # attend one stale position (finite, masked out downstream like the
+        # dense path's garbage lanes)
+        seq_base = jnp.where(active & (pos < S), pos, 0)
+        seq_now = jnp.minimum(seq_base + jnp.where(active, q_lens, 1), S)
+
+        def attend_fn(q, k_pool, v_pool):
+            # q [B, T, nh, hd] post-rope
+            o = _da.paged_prefill_attention(q, k_pool, v_pool, table,
+                                            seq_now, q_lens)
+            return o.reshape(B, T, nh * cfg.head_dim)
+
+        x, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
+                                           write, None, cos, sin,
+                                           attend_fn=attend_fn)
+        last = jnp.take_along_axis(
+            x, (q_lens - 1).astype(jnp.int32)[:, None, None], axis=1)[:, 0]
+        return _inf.lm_head_logits(cfg, params, last), ak, av
+
+    def _mixed_impl_paged(self, params, cache_k, cache_v, tokens, pos,
+                          active, q_lens, temp, topp, seeds, table,
+                          sampling=False):
+        """Mixed step + emit in ONE compiled program.  The emitted token for
+        slot b is drawn from its emit row's logits with the SAME
+        (seed, pos + q_lens - 1)-derived key ``_sample_tokens`` uses in the
+        plain decode step at that position — so a decode lane's token
+        (q_lens == 1, key (seed, pos)) and a completing prefill's first
+        token (emit row at the last prompt token's position, the exact key
+        the unchunked engine's first decode step derives) are
+        token-identical to the bucketed-prefill engine, greedy AND seeded
+        sampled.  Returns (next token [B], caches); the host consumes a
+        lane's token only when it decoded or finished its prompt."""
+        logits, ck, cv = self._mixed_one(params, cache_k, cache_v, tokens,
+                                         pos, active, q_lens, table)
+        if sampling:
+            nxt = self._sample_tokens(logits, pos + q_lens - 1, temp, topp,
+                                      seeds)
+        else:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return nxt, ck, cv
+
     # ---------------- block allocator (host control plane) ----------------
 
     def _blocks_needed(self, last_pos: int) -> int:
@@ -799,6 +979,12 @@ class ContinuousBatchingEngine:
         self._slot_req[slot] = None
         self._written[slot] = 0
         self._temp[slot] = 0.0  # re-set on readmission
+        if self._chunked:
+            # a mid-prefill victim resumes as a fresh admission: the donated
+            # full blocks above make its re-prefill restart at the first
+            # uncached token, not the prompt's head
+            self._prefill_ids[slot] = None
+            self._prefilled[slot] = 0
         self._queue.insert(0, req)
         self.stats["preemptions"] += 1
 
@@ -869,9 +1055,16 @@ class ContinuousBatchingEngine:
                 # _ensure_growth in the same step, wasting its full-prompt
                 # prefill.  Spec-off: horizon == chunk, byte-identical.
                 horizon = max(self.chunk, self._spec_qmax)
+                # per-slot clamp at 0: a mid-prefill slot already owns its
+                # whole prompt's pages while pos (the chunk cursor) trails
+                # them — surplus must not offset other slots' real growth.
+                # (No-op chunked-off: a decode slot never owns pages beyond
+                # its growth horizon.)
                 headroom = sum(
-                    self._blocks_needed(int(self._pos[s]) + horizon - 1)
-                    - len(self._slot_shared[s]) - len(self._slot_blocks[s])
+                    max(0, self._blocks_needed(int(self._pos[s]) + horizon
+                                               - 1)
+                        - len(self._slot_shared[s])
+                        - len(self._slot_blocks[s]))
                     for s in range(self.max_batch)
                     if self._slot_req[s] is not None)
                 need = self._blocks_needed(s0 - 1)
@@ -935,7 +1128,24 @@ class ContinuousBatchingEngine:
             plen = (s0 - 1) - start
             self.stats["prefill_tokens_cached"] += start
             self.stats["prefill_tokens_computed"] += max(plen, 0)
-            if start == 0:
+            # a whole-prompt prefill dispatched while other slots hold
+            # requests stalls their decode for the full prompt length — the
+            # TBT spike chunked prefill erases (the chunked path below never
+            # ticks this: prompts stream through the mixed step instead)
+            stalls = any(r is not None for r in self._slot_req)
+            if self._chunked:
+                # enqueue-without-prefill: the mixed step streams positions
+                # [start, s0) in prefill_chunk rows; the final row (the last
+                # prompt token, position s0-1) emits the first generated
+                # token, so admission costs no device step here and decode
+                # slots never wait on a prompt.  Same-pass identical-prefix
+                # bursts each stream independently — a still-streaming
+                # slot's pages are private/writable until its chunk
+                # registers them, so they cannot be shared in flight
+                # (docs/chunked_prefill.md "deliberate tradeoff")
+                self._prefill_ids[slot] = ids
+                self._prefilled[slot] = start
+            elif start == 0:
                 bucket = min(_bucket(s0), self.max_seq)
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, :s0] = ids
@@ -948,6 +1158,7 @@ class ContinuousBatchingEngine:
                     self.cache_v, slot_arg, jnp.asarray(s0 - 1, jnp.int32),
                     bucket)
                 self.stats["prefills"] += 1
+                self.stats["decode_stall_steps"] += int(stalls)
             elif plen > 0:
                 # partial-bucket prefill over the uncached tail only
                 with RecordEvent("prefix_cache/partial_prefill"):
@@ -960,15 +1171,27 @@ class ContinuousBatchingEngine:
                         jnp.asarray(start, jnp.int32),
                         jnp.asarray(s0 - 1, jnp.int32), bucket)
                 self.stats["prefills"] += 1
+                self.stats["decode_stall_steps"] += int(stalls)
             # else: full hit — nothing to compute, decode starts immediately
-            if self.paged and self._pcache is not None:
+            if self.paged and self._pcache is not None and not self._chunked:
                 # share this admission's freshly-computed full prompt blocks
+                # (the chunked path registers as each chunk completes them)
                 self._register_prefix_blocks(slot, ids, s0 - 1)
             self._slot_req[slot] = req
-            self._pos[slot] = s0 - 1
-            # prefill committed (or the cache already held) K/V for every
-            # position below s0-1; position s0-1 itself is decode's first write
-            self._written[slot] = s0 - 1
+            if self._chunked:
+                # the prefill cursor IS the position state: pos/_written
+                # advance with each chunk, so preemption's trusted-content
+                # bound and the auditor's I6 read the same fields they do
+                # for decode (cached positions below ``start`` count as
+                # written — the pool already holds their K/V)
+                self._pos[slot] = start
+                self._written[slot] = start
+            else:
+                self._pos[slot] = s0 - 1
+                # prefill committed (or the cache already held) K/V for
+                # every position below s0-1; position s0-1 itself is
+                # decode's first write
+                self._written[slot] = s0 - 1
             self._last_tok[slot] = ids[-1]
             self._temp[slot] = max(float(req.temperature or 0.0), 0.0)
             self._topp[slot] = float(req.top_p if req.top_p is not None
@@ -985,6 +1208,9 @@ class ContinuousBatchingEngine:
         self._slot_req[slot] = None
         self._written[slot] = 0
         self._temp[slot] = 0.0  # freed slot must not pin the sampling variant
+        if self._chunked:
+            self._prefill_ids[slot] = None
+            self._prefilled[slot] = 0
         if self.paged:
             self._release(slot)
 
@@ -995,11 +1221,20 @@ class ContinuousBatchingEngine:
             audit_engine(self)
 
     def step(self) -> bool:
-        """One admit + decode iteration (a chunked decode scan, or — with
-        speculation on and at least one slot drafting — a single multi-token
-        verify step).  Returns False when idle."""
+        """One admit + decode iteration (a chunked decode scan; with
+        speculation on and at least one slot drafting, a single multi-token
+        verify step; with chunked prefill on and at least one prompt still
+        streaming, a single unified mixed prefill/decode step).  Returns
+        False when idle."""
         self._admit()
         self._maybe_audit()
+        if self._chunked and any(i is not None for i in self._prefill_ids):
+            # at least one prompt is streaming in: ONE mixed launch advances
+            # every decode slot a token AND moves the prompts forward under
+            # the token budget.  Once every prompt drains, the ordinary
+            # decode/speculative paths below run their untouched programs —
+            # steady-state throughput is byte-identical to chunked-off.
+            return self._mixed_step()
         if self._spec is not None:
             drafts = self._draft_proposals()
             if drafts is not None:
@@ -1063,6 +1298,131 @@ class ContinuousBatchingEngine:
         self._maybe_audit()
         return True
 
+    # ---------------- chunked-prefill scheduling (host control plane) ------
+
+    def _mixed_step(self) -> bool:
+        """One unified prefill/decode round (docs/chunked_prefill.md): pack
+        up to ``token_budget`` rows as [decode slots | prefill chunks] and
+        dispatch ONE compiled [B, T] launch.  Decode rows pack FIRST — every
+        decode-ready slot advances exactly one token, so decode never waits
+        on a prompt (``decode_stall_steps`` stays 0) — then prefill chunks
+        fill the remaining budget oldest-slot-first, at most
+        ``prefill_chunk`` rows per slot per step, with a 1-token floor so a
+        tiny budget degrades to slow prefill instead of livelock.  A lane
+        whose chunk reaches the last prompt token consumes its emitted
+        token (the fused first decode step); mid-prompt lanes ignore theirs.
+        Freshly-completed full blocks register into the prefix cache chunk
+        by chunk, so a request admitted later in the same serve already
+        hits the streaming prefix."""
+        B = self.max_batch
+        T = self._prefill_chunk
+        decode_slots = [s for s in range(B)
+                        if self._slot_req[s] is not None
+                        and self._prefill_ids[s] is None]
+        budget = max(self._token_budget - len(decode_slots), 1)
+        tokens = np.zeros((B, T), np.int32)
+        q_lens = np.ones(B, np.int32)
+        pos = np.asarray(self._pos, np.int32).copy()   # row-0 positions
+        active = np.zeros(B, bool)
+        growth = np.zeros(B, np.int64)
+        chunk_rows: dict[int, int] = {}
+        for s in decode_slots:
+            tokens[s, 0] = self._last_tok[s]
+            active[s] = True
+            growth[s] = 1
+        prefilling = sorted((s for s in range(B)
+                             if self._prefill_ids[s] is not None),
+                            key=lambda s: self._slot_age[s])
+        for s in prefilling:
+            ids = self._prefill_ids[s]
+            cur = int(self._prefilled[s])
+            n = min(T, ids.size - cur, budget)
+            if n <= 0:
+                continue    # budget drained: the lane idles this step
+            budget -= n
+            tokens[s, :n] = ids[cur:cur + n]
+            pos[s] = cur
+            q_lens[s] = n
+            active[s] = True
+            growth[s] = n
+            chunk_rows[s] = n
+        # the auditor's I7 cross-checks the packing stayed disjoint
+        self._last_pack = (tuple(decode_slots), tuple(sorted(chunk_rows)))
+        self._ensure_growth(growth)  # may preempt the youngest slot
+        for s in range(B):
+            if self._slot_req[s] is None:       # preempted after packing
+                active[s] = False
+                chunk_rows.pop(s, None)
+        if not active.any():
+            return bool(self._queue)
+        t0 = time.perf_counter()
+        any_sampled = bool((self._temp * active).max() > 0)
+        mixed = self._mixed_sampling if any_sampled else self._mixed_greedy
+        nxt, self.cache_k, self.cache_v = mixed(
+            self.params, self.cache_k, self.cache_v, jnp.asarray(tokens),
+            jnp.asarray(pos), jnp.asarray(active), jnp.asarray(q_lens),
+            jnp.asarray(self._temp), jnp.asarray(self._topp),
+            jnp.asarray(self._seed), jnp.asarray(self._table))
+        nxt_np = np.asarray(nxt)   # [B] — ONE host round-trip for the step
+        self.stats["decode_time_s"] += time.perf_counter() - t0
+        self.stats["decode_steps"] += 1
+        self.stats["mixed_steps"] += 1
+        self.stats["prefill_chunks"] += len(chunk_rows)
+        for s in decode_slots:
+            req = self._slot_req[s]
+            if req is None:
+                continue            # preempted by _ensure_growth
+            old_pos = int(self._pos[s])
+            self._pos[s] = old_pos + 1
+            self._written[s] = max(int(self._written[s]),
+                                   min(old_pos + 1, self.max_seq))
+            self._consume_token(s, req, int(nxt_np[s]), t0)
+            if (self._slot_req[s] is not None
+                    and old_pos + 1 >= self.max_seq):
+                self._retire(s)
+        for s, n in chunk_rows.items():
+            req = self._slot_req[s]
+            if req is None:
+                continue            # preempted after packing
+            ids = self._prefill_ids[s]
+            new_cur = int(self._prefilled[s]) + n
+            self._prefilled[s] = new_cur
+            self._pos[s] = new_cur
+            self._written[s] = max(int(self._written[s]),
+                                   min(new_cur, self.max_seq))
+            if self._pcache is not None:
+                # register full freshly-computed prompt blocks as chunks
+                # complete them (all content below new_cur is prompt tokens;
+                # decode's first write lands at position >= ids.size, never
+                # inside a block these cover)
+                self._register_prefix_blocks(s, ids, new_cur)
+            if new_cur >= ids.size:
+                # final chunk: its emit row sat at the last prompt token's
+                # position — consume the fused first decode token
+                self._prefill_ids[s] = None
+                self._prefilled[s] = 0
+                self._consume_token(s, req, int(nxt_np[s]), t0)
+                if (self._slot_req[s] is not None
+                        and new_cur >= self.max_seq):
+                    self._retire(s)
+        self._maybe_audit()
+        return True
+
+    def _consume_token(self, slot: int, req: Request, tok: int, t0: float):
+        """Bank one generated token on a slot (mixed-step emit): append,
+        stamp TTFT, tick the throughput counter, advance the feedback token,
+        and retire on EOS / budget — the single-token analog of the decode
+        chunk's host trimming loop."""
+        req.output_ids.append(tok)
+        if req.ttft_s is None:
+            req.ttft_s = time.perf_counter() - getattr(req, "_submit_s", t0)
+        self.stats["decode_tokens"] += 1
+        self._last_tok[slot] = tok
+        if (len(req.output_ids) >= req.max_new_tokens
+                or (req.eos_token_id is not None
+                    and tok == req.eos_token_id)):
+            self._retire(slot)
+
     # ---------------- speculative scheduling (host control plane) ----------
 
     def _draft_proposals(self) -> dict[int, np.ndarray] | None:
@@ -1076,6 +1436,12 @@ class ContinuousBatchingEngine:
         any_draft = False
         for slot, req in enumerate(self._slot_req):
             if req is None:
+                continue
+            if self._chunked and self._prefill_ids[slot] is not None:
+                # a slot still streaming its prompt has no token to draft
+                # from (step() routes to the mixed path while any prompt is
+                # in flight, so this is belt-and-braces for direct callers)
+                out[slot] = np.zeros(0, np.int32)
                 continue
             cap = min(self.max_seq - 1 - int(self._pos[slot]),
                       req.max_new_tokens - len(req.output_ids) - 1)
@@ -1205,4 +1571,9 @@ class ContinuousBatchingEngine:
             # variant per sampling mode actually used, regardless of how
             # ragged the per-step drafts were
             fns += [self._verify_greedy, self._verify_sampling]
+        if self._chunked:
+            # the mixed step's width is static (prefill_chunk): one variant
+            # per sampling mode for every prompt length — the O(1) that
+            # replaces the bucketed path's log2(max_seq) prefill family
+            fns += [self._mixed_greedy, self._mixed_sampling]
         return _n(*fns)

@@ -33,6 +33,7 @@ __all__ = [
     "block_multihead_attention",
     "paged_decode_attention",
     "paged_verify_attention",
+    "paged_prefill_attention",
     "append_to_block_cache",
 ]
 
@@ -148,6 +149,38 @@ def paged_verify_attention(q, key_cache, value_cache, block_tables, seq_lens,
     return _pa.paged_attention_verify(q, key_cache, value_cache,
                                       block_tables, seq_lens, q_lens,
                                       scale=scale)
+
+
+def paged_prefill_attention(q, key_cache, value_cache, block_tables,
+                            seq_lens, q_lens, scale=None, kv_quant=None,
+                            k_scale=None, v_scale=None):
+    """Ragged chunked prefill (the continuous-batching engine's unified
+    mixed prefill/decode hot op; docs/chunked_prefill.md).
+
+    Each slot carries ``q_lens[b]`` query rows at consecutive positions —
+    a ``prefill_chunk``-token slice of its prompt streaming into
+    already-written pages, or a single pending decode token riding the same
+    launch — all attended in ONE call of the paged-attention kernel family
+    (`ops/pallas/paged_attention.paged_attention_prefill`) under the verify
+    kernel's per-row causal law: chunk row t sees the written prefix plus
+    the chunk through itself, never the later rows.  This is what lets the
+    engine co-schedule prefill chunks with decode in a single compiled step
+    (decode never stalls behind a long prompt).  Supports the decode path's
+    dequant-on-read quantized KV pools (``kv_quant`` in {'int8', 'int4'}
+    with per-page scales).  Falls back to the gather oracle
+    (``pallas.paged_attention.paged_prefill_reference``) off-TPU-shapes or
+    under ``PADDLE_TPU_DISABLE_PALLAS=paged_attention``.
+
+    Shapes: q [b, T, nh, hd]; caches [num_blocks, nkv, block_size, hd]
+    (nh % nkv == 0, the chunk's K/V already written); block_tables
+    [b, max_blocks]; seq_lens [b] TOTAL written length incl. the chunk;
+    q_lens [b] in 1..T.  Returns [b, T, nh, hd]."""
+    from .pallas import paged_attention as _pa
+
+    return _pa.paged_attention_prefill(q, key_cache, value_cache,
+                                       block_tables, seq_lens, q_lens,
+                                       scale=scale, kv_quant=kv_quant,
+                                       k_scale=k_scale, v_scale=v_scale)
 
 
 def block_multihead_attention(q, key_cache, value_cache, block_tables,

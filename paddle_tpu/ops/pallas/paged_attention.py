@@ -46,6 +46,22 @@ scalar-prefetch operand; rows past a slot's live queries are fully masked
 (their output is garbage the engine never reads).  The decode kernel is left
 byte-for-byte untouched — spec-off serving must compile the exact same
 program as before this feature existed.
+
+Chunked prefill (docs/chunked_prefill.md) adds the RAGGED CHUNKED-PREFILL
+member, :func:`paged_attention_prefill`: each slot carries a
+``q_lens[b] <= T`` row slice of its prompt at consecutive positions — a
+prefill chunk streaming into already-written pages, or a single pending
+decode token (``q_lens == 1``) riding the same launch, which is what lets
+the serving engine run ONE mixed prefill/decode step per iteration instead
+of stalling decode behind a whole-prompt prefill.  The mask law is the
+verify kernel's (verify is the T = K+1 special case): row t of slot b sits
+at absolute position ``seq_lens[b] - q_lens[b] + t`` and sees
+``seq_lens[b] - (q_lens[b]-1-t)`` KV positions — the already-written prefix
+plus the chunk's own tokens up to and including itself (the causal in-chunk
+mask), never the later rows.  Unlike verify it also carries the decode
+kernel's dequant-on-read for int8 / packed-int4 KV pages (a KV-quantized
+pool must be prefillable through the same kernel family that decodes it).
+Separate KERNEL/FALLBACK counters; decode and verify stay byte-untouched.
 """
 
 from __future__ import annotations
@@ -77,6 +93,9 @@ FALLBACK_CALLS = 0
 # test can assert its path without the single-token decode calls aliasing it
 VERIFY_KERNEL_CALLS = 0
 VERIFY_FALLBACK_CALLS = 0
+# ditto the ragged chunked-prefill variant (the mixed prefill/decode step)
+PREFILL_KERNEL_CALLS = 0
+PREFILL_FALLBACK_CALLS = 0
 
 # MXU/VPU rows: the q-head group is padded up to this many rows so the
 # logits tile and the scratch accumulators keep a full sublane
@@ -647,5 +666,251 @@ def paged_attention_verify(q, key_cache, value_cache, block_tables, seq_lens,
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, R - qmax * rep), (0, 0)))
     out = _verify_kernel_call(qg, key_cache, value_cache, block_tables,
                               seq_lens, q_lens, scale, rep)
+    out = out[:, :, :qmax * rep].reshape(b, nkv, qmax, rep, hd)
+    return out.transpose(0, 2, 1, 3, 4).reshape(b, qmax, nh, hd)
+
+
+# ---------------------------------------------------------------------------
+# ragged chunked prefill (stall-free continuous batching)
+# ---------------------------------------------------------------------------
+
+def _prefill_kernel(tables_ref, lens_ref, qlens_ref, q_ref, k_ref, v_ref,
+                    *rest, scale, bs, rep, kv_quant):
+    """Grid: (slots, kv_heads, logical_pages) — identical page walk to
+    :func:`_paged_kernel`/:func:`_verify_kernel`.  The q tile carries
+    ``R = pad(T * rep)`` rows (row ``t*rep + g`` = chunk row t, grouped head
+    g) under the verify kernel's per-row causal law — row t sees
+    ``lens[b] - (qlens[b]-1-t)`` KV positions, i.e. the already-written
+    prefix plus the chunk's own tokens through itself — and, unlike verify,
+    the decode kernel's dequant-on-read so a quantized KV pool prefills
+    through the same page stream that decodes it.  Scalar-prefetch refs:
+    tables [b, max_blocks], lens [b] (TOTAL written length incl. this
+    chunk), qlens [b] (live chunk rows, 1..T)."""
+    if kv_quant:
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    o_ref, m_scr, l_scr, acc_scr = rest
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = lens_ref[b]
+    qlen = qlens_ref[b]
+
+    @pl.when(j * bs < length)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)                   # [R, hd]
+        k = _dequant_page(k_ref[0, 0], ks_ref[0, 0] if kv_quant else None,
+                          kv_quant)                           # [bs, hd]
+        v = _dequant_page(v_ref[0, 0], vs_ref[0, 0] if kv_quant else None,
+                          kv_quant)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [R, bs]
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        t = rows // rep                                       # chunk row idx
+        cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # chunk row t sits at absolute position length - qlen + t and sees
+        # everything up to and including itself (the causal in-chunk mask
+        # over the trailing qlen positions, the full prefix below).  Rows
+        # past the slot's live chunk (incl. sublane padding) see nothing —
+        # their l stays 0 and _finalize emits zeros.
+        row_len = jnp.where(t < qlen, length - (qlen - 1 - t), 0)
+        s = jnp.where(cols < row_len, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(s > 0.5 * NEG_INF, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.where(m_prev > 0.5 * NEG_INF,
+                          jnp.exp(m_prev - m_new), 0.0)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _prefill_scale_index_map(bs: int, num_blocks: int):
+    # the decode kernel's scale fetch, arity-adjusted for the third (qlens)
+    # scalar-prefetch operand; same _resolve_page so KV and scale fetches
+    # can never diverge
+    def idx(b, h, j, tables_ref, lens_ref, qlens_ref):
+        return (_resolve_page(b, j, tables_ref, lens_ref, bs, num_blocks), h)
+
+    return idx
+
+
+def _prefill_kernel_call(q, key_cache, value_cache, block_tables, seq_lens,
+                         q_lens, scale, rep, kv_quant, k_scale, v_scale):
+    """q: [b, nkv, R, hd] (R = T*rep padded to sublane rows, t-major).
+    Returns [b, nkv, R, hd]."""
+    b, nkv, R, hd = q.shape
+    num_blocks, _, bs, _ = key_cache.shape
+    max_blocks = block_tables.shape[1]
+
+    kernel = functools.partial(_prefill_kernel, scale=scale, bs=bs, rep=rep,
+                               kv_quant=kv_quant)
+    kv_spec = pl.BlockSpec((1, 1, bs, key_cache.shape[-1]),
+                           _verify_page_index_map(bs, num_blocks))
+    in_specs = [
+        pl.BlockSpec((1, 1, R, hd),
+                     lambda b, h, j, t, l, ql: (b, h, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    args = [q, key_cache, value_cache]
+    if kv_quant:
+        sc_spec = pl.BlockSpec((1, 1), _prefill_scale_index_map(bs, num_blocks))
+        in_specs += [sc_spec, sc_spec]
+        args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, nkv, max_blocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, R, hd),
+                               lambda b, h, j, t, l, ql: (b, h, 0, 0)),
+        scratch_shapes=[
+            _VMEM((R, 1), jnp.float32),
+            _VMEM((R, 1), jnp.float32),
+            _VMEM((R, hd), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, R, hd), q.dtype),
+        interpret=interpret_mode(),
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q_lens.astype(jnp.int32), *args)
+
+
+def paged_prefill_reference(q, key_cache, value_cache, block_tables,
+                            seq_lens, q_lens, scale=None, kv_quant=None,
+                            k_scale=None, v_scale=None):
+    """Gather oracle for ragged chunked prefill (fallback + test oracle).
+
+    The verify oracle's per-row causal mask (verify is the T = K+1 special
+    case) composed with the decode oracle's dequantize-then-gather quant
+    handling.  q: [b, T, nh, hd]; caches [num_blocks, nkv, bs, hd] (or
+    quantized storage per ``kv_quant``); block_tables [b, max_blocks];
+    seq_lens [b] TOTAL written length incl. this chunk; q_lens [b] live
+    chunk rows (<= T).  Returns [b, T, nh, hd]; rows past q_lens (and slots
+    with an empty window) return zeros."""
+    num_blocks, nkv, bs, hd_store = key_cache.shape
+    hd = hd_store * 2 if kv_quant == "int4" else hd_store
+    b, qmax, nh, _ = q.shape
+    rep = nh // nkv
+    max_blocks = block_tables.shape[1]
+    S = max_blocks * bs
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+
+    safe = jnp.clip(block_tables, 0, num_blocks - 1)
+    k_seq = jnp.take(key_cache, safe, axis=0)   # [b, maxblk, nkv, bs, hd_st]
+    v_seq = jnp.take(value_cache, safe, axis=0)
+    if kv_quant:
+        # dequantize only the gathered slice (matching the decode oracle:
+        # the whole pool at full precision would defeat the quantized
+        # footprint on exactly the robustness path)
+        ks = jnp.take(k_scale, safe, axis=0)[..., None, None]  # [b,mb,nkv,1,1]
+        vs = jnp.take(v_scale, safe, axis=0)[..., None, None]
+        if kv_quant == "int4":
+            k_seq = _unpack_int4(k_seq.astype(jnp.int32)) * ks
+            v_seq = _unpack_int4(v_seq.astype(jnp.int32)) * vs
+        else:
+            k_seq = k_seq.astype(jnp.float32) * ks
+            v_seq = v_seq.astype(jnp.float32) * vs
+    k_seq = k_seq.transpose(0, 2, 1, 3, 4).reshape(b, nkv, S, hd)
+    v_seq = v_seq.transpose(0, 2, 1, 3, 4).reshape(b, nkv, S, hd)
+
+    qg = q.reshape(b, qmax, nkv, rep, hd)
+    logits = jnp.einsum("btngd,bnsd->btngs", qg.astype(jnp.float32),
+                        k_seq.astype(jnp.float32)) * scale
+    t = jnp.arange(qmax)[None, :, None, None, None]
+    ql = q_lens[:, None, None, None, None]
+    row_len = jnp.where(t < ql,
+                        seq_lens[:, None, None, None, None] - (ql - 1 - t), 0)
+    mask = jnp.arange(S)[None, None, None, None, :] < row_len
+    logits = jnp.where(mask, logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    p = jnp.where(row_len > 0, p, 0.0)
+    out = jnp.einsum("btngs,bnsd->btngd", p, v_seq.astype(jnp.float32))
+    return out.reshape(b, qmax, nh, hd).astype(q.dtype)
+
+
+def paged_attention_prefill(q, key_cache, value_cache, block_tables,
+                            seq_lens, q_lens, scale=None, kv_quant=None,
+                            k_scale=None, v_scale=None):
+    """Ragged chunked prefill over a block-table KV cache (the serving
+    engine's unified mixed prefill/decode step; docs/chunked_prefill.md).
+
+    Args:
+      q: [b, T, num_heads, head_dim] — per slot, up to ``T`` query tokens at
+        CONSECUTIVE positions (row t at position
+        ``seq_lens[b] - q_lens[b] + t``): a prefill chunk of the slot's
+        prompt, or a single pending decode token (``q_lens[b] == 1``) riding
+        the same launch.  Rows at or past ``q_lens[b]`` are padding whose
+        output is unspecified.
+      key_cache/value_cache: [num_blocks, num_kv_heads, block_size, head_dim]
+        pages with every query row's K/V already written, or quantized
+        storage per ``kv_quant`` ('int8' → int8 same shape, 'int4' → int8
+        [..., head_dim // 2]; :func:`quantize_kv_cache`).
+      block_tables: [b, max_blocks] int32 physical page ids.
+      seq_lens: [b] int32 TOTAL valid KV length per slot (incl. the chunk).
+      q_lens: [b] int32 live chunk rows per slot (1..T).
+      k_scale/v_scale: [num_blocks, num_kv_heads] f32 (quantized caches).
+
+    Returns [b, T, num_heads, head_dim] in q's dtype: row t is attention
+    for chunk row t under the per-row causal mask (the written prefix plus
+    the chunk through itself, never the later rows — the verify kernel's
+    law with T free; verify is the T = K+1 special case).  Dispatches to
+    the Pallas prefill kernel when :func:`kernel_supported` (same predicate
+    and ``PADDLE_TPU_DISABLE_PALLAS=paged_attention`` opt-out as the rest
+    of the paged family); forward-only like decode/verify — serving never
+    differentiates through the KV cache."""
+    global PREFILL_KERNEL_CALLS, PREFILL_FALLBACK_CALLS
+    assert kv_quant in (None, "int8", "int4"), kv_quant
+    b, qmax, nh, hd_q = q.shape
+    num_blocks, nkv, bs, hd_store = key_cache.shape
+    if kv_quant == "int4":
+        assert hd_store * 2 == hd_q, (hd_store, hd_q)
+    else:
+        assert hd_store == hd_q, (hd_store, hd_q)
+    if kv_quant:
+        assert k_scale is not None and v_scale is not None, (
+            "quantized KV caches need k_scale/v_scale")
+    hd = hd_q
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    if not kernel_supported(nh, nkv, hd, bs):
+        PREFILL_FALLBACK_CALLS += 1
+        return paged_prefill_reference(q, key_cache, value_cache,
+                                       block_tables, seq_lens, q_lens,
+                                       scale=scale, kv_quant=kv_quant,
+                                       k_scale=k_scale, v_scale=v_scale)
+    PREFILL_KERNEL_CALLS += 1
+
+    rep = nh // nkv
+    R = _round_up(qmax * rep, _MIN_GROUP_ROWS)
+    # [b, T, nkv, rep, hd] -> [b, nkv, T*rep, hd], row = t*rep + g
+    qg = q.reshape(b, qmax, nkv, rep, hd).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, nkv, qmax * rep, hd)
+    if R != qmax * rep:
+        # padded rows index chunk row t >= T >= qlen: fully masked in the
+        # kernel (zero output), sliced off below
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, R - qmax * rep), (0, 0)))
+    out = _prefill_kernel_call(qg, key_cache, value_cache, block_tables,
+                               seq_lens, q_lens, scale, rep, kv_quant,
+                               k_scale, v_scale)
     out = out[:, :, :qmax * rep].reshape(b, nkv, qmax, rep, hd)
     return out.transpose(0, 2, 1, 3, 4).reshape(b, qmax, nh, hd)

@@ -20,6 +20,10 @@ through :func:`env_bool`, which enforces the '0'/'1' vocabulary):
   (inference/speculative.py, docs/speculative.md); ``0`` forces it off even
   when the engine was constructed with ``enable_speculation=True``, and the
   spec-off engine is byte-identical to one built before the feature existed.
+* ``PADDLE_TPU_CHUNKED_PREFILL`` (default on) — chunked prefill + unified
+  mixed prefill/decode step (docs/chunked_prefill.md); ``0`` forces it off
+  even when the engine was constructed with ``enable_chunked_prefill=True``,
+  reverting to the bucketed whole-prompt prefill path byte-for-byte.
 
 (``PADDLE_TPU_DISABLE_PALLAS`` is the token-set switch; its vocabulary lives
 with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``.)
@@ -40,6 +44,7 @@ BOOL_FLAGS = {
     "PADDLE_TPU_PREFIX_CACHE": True,
     "PADDLE_TPU_ENGINE_AUDIT": False,
     "PADDLE_TPU_SPECULATE": True,
+    "PADDLE_TPU_CHUNKED_PREFILL": True,
 }
 
 _warned: set[tuple[str, str]] = set()

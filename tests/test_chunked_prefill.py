@@ -1,0 +1,504 @@
+"""Chunked prefill + unified mixed prefill/decode step tests (ISSUE 5).
+
+The correctness bar mirrors the speculative suite's: chunking may only
+change WHEN prompt K/V gets computed (streamed in budget-bounded chunks
+co-scheduled with decode instead of one monolithic bucketed prefill), NEVER
+which tokens come out.  Greedy requests must be token-identical to the
+bucketed-prefill engine across chunk sizes, chunk/page boundary phase,
+prefix-cache hits, preemption and speculation; seeded sampled requests must
+be identical too — the mixed step's emit row draws with the same
+(seed, position)-derived key the plain sampler uses.  On top of parity:
+``decode_stall_steps`` must be 0 with chunking on (the stall-free
+invariant), and prefill must compile O(1) program variants where the
+bucketed path compiles a log2(max_seq) family."""
+
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
+from paddle_tpu.models import llama
+
+
+def _tiny():
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=32, layers=2, heads=4,
+                                 kv_heads=2, inter=64)
+    cfg.dtype = jnp.float32  # exact parity
+    params = llama.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _prompts(rs, lens):
+    return [rs.randint(0, 128, (n,)).astype(np.int32) for n in lens]
+
+
+# ---------------- token parity: greedy + seeded sampling ----------------
+
+
+@pytest.mark.parametrize("prefill_chunk", [4, 6])
+def test_chunked_greedy_token_identical(prefill_chunk):
+    """Chunked-on produces exactly the bucketed engine's greedy streams
+    across staggered admission and chunk widths, never stalls decode, and
+    actually exercises the mixed path (the win is real, not vacuous)."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(3)
+    prompts = _prompts(rs, (5, 19, 33, 7))
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=6 + i)
+                for i, p in enumerate(prompts)]
+
+    base = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=2, paged=True, block_size=8)
+    ref = base.serve(build())
+    ch = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                  chunk=2, paged=True, block_size=8,
+                                  enable_chunked_prefill=True,
+                                  prefill_chunk=prefill_chunk)
+    got = ch.serve(build())
+    assert got == ref
+    assert ch.stats["mixed_steps"] > 0
+    assert ch.stats["prefill_chunks"] > 0
+    assert ch.stats["prefills"] == 0          # no bucketed prefill dispatched
+    assert ch.stats["decode_stall_steps"] == 0
+    # the bucketed engine DID stall decode on the staggered admissions
+    assert base.stats["decode_stall_steps"] > 0
+
+
+def test_chunked_sampled_stream_token_identical():
+    """Seeded temperature/top-p requests through a mixed greedy/sampled
+    batch: the emit row's (seed, position)-derived key reproduces the plain
+    sampler's stream exactly — including each request's FIRST token, which
+    chunked-on comes out of the final prefill chunk's fused emit rather
+    than a separate decode step."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(11)
+    prompts = _prompts(rs, (9, 21, 14))
+
+    def build():
+        return [Request(rid=0, prompt_ids=prompts[0], max_new_tokens=8),
+                Request(rid=1, prompt_ids=prompts[1], max_new_tokens=8,
+                        temperature=0.9, top_p=0.8, seed=42),
+                Request(rid=2, prompt_ids=prompts[2], max_new_tokens=8,
+                        temperature=1.3, seed=7)]
+
+    base = ContinuousBatchingEngine(cfg, params, max_batch=3, max_seq=64,
+                                    chunk=2, paged=True, block_size=8)
+    ref = base.serve(build())
+    ch = ContinuousBatchingEngine(cfg, params, max_batch=3, max_seq=64,
+                                  chunk=2, paged=True, block_size=8,
+                                  enable_chunked_prefill=True,
+                                  prefill_chunk=5)
+    got = ch.serve(build())
+    assert got == ref
+    assert ch.stats["mixed_steps"] > 0
+
+
+def test_chunk_boundary_times_page_boundary():
+    """Chunk width deliberately co-prime with the page size (5 vs 8) and
+    prompt lengths sitting on/off both boundaries: every phase of the
+    chunk-crossing-page scatter must land K/V where the bucketed prefill
+    does."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(21)
+    # one short of a page, exactly a page, one over, chunk-aligned, both
+    prompts = _prompts(rs, (7, 8, 9, 15, 16, 17, 40))
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=4)
+                for i, p in enumerate(prompts)]
+
+    kw = dict(max_batch=3, max_seq=64, chunk=1, paged=True, block_size=8)
+    ref = ContinuousBatchingEngine(cfg, params, **kw).serve(build())
+    got = ContinuousBatchingEngine(cfg, params, enable_chunked_prefill=True,
+                                   prefill_chunk=5, **kw).serve(build())
+    assert got == ref
+
+
+def test_single_token_prompt_and_chunk_one():
+    """Degenerate corners: a 1-token prompt (its only chunk IS the fused
+    first decode step) and prefill_chunk=1 (every prompt token is its own
+    mixed-step row)."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(31)
+    prompts = _prompts(rs, (1, 6))
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=5)
+                for i, p in enumerate(prompts)]
+
+    kw = dict(max_batch=2, max_seq=32, chunk=1, paged=True, block_size=8)
+    ref = ContinuousBatchingEngine(cfg, params, **kw).serve(build())
+    got = ContinuousBatchingEngine(cfg, params, enable_chunked_prefill=True,
+                                   prefill_chunk=1, **kw).serve(build())
+    assert got == ref
+
+
+# ---------------- prefix-cache integration ----------------
+
+
+def test_prefix_cache_partial_hit_starts_mid_chunk():
+    """A cached-prefix admission starts its first chunk at the first
+    uncached token — a position unaligned with both the chunk width and the
+    page size — and later requests hit blocks the earlier request's chunks
+    registered as they completed."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(7)
+    shared = rs.randint(0, 128, (21,)).astype(np.int32)  # 2 full 8-blocks
+    tails = _prompts(rs, (4, 4, 4))
+
+    def build():
+        return [Request(rid=i, prompt_ids=np.concatenate([shared, t]),
+                        max_new_tokens=5) for i, t in enumerate(tails)]
+
+    kw = dict(max_batch=2, max_seq=64, chunk=1, paged=True, block_size=8,
+              num_blocks=24, enable_prefix_caching=True)
+    ref = ContinuousBatchingEngine(cfg, params, **kw).serve(build())
+    ch = ContinuousBatchingEngine(cfg, params, enable_chunked_prefill=True,
+                                  prefill_chunk=6, **kw)
+    got = ch.serve(build())
+    assert got == ref
+    # the third request (admitted after the first's chunks registered the
+    # shared blocks) hits; a same-pass neighbor legitimately cannot — the
+    # first chunk had not completed any block yet when it was admitted
+    assert ch.stats["prefix_hits"] >= 1
+    assert ch.stats["prefix_blocks_reused"] >= 2
+    # the hit admission's cursor started at the matched-prefix boundary,
+    # so cached tokens were never recomputed
+    assert ch.stats["prefill_tokens_cached"] > 0
+
+
+def test_chunked_registers_blocks_as_chunks_complete():
+    """Mid-prefill, full blocks the chunks have already written are cache
+    resident (zero-ref or slot-referenced) BEFORE the prompt finishes —
+    the 'registers pages as chunks complete them' contract."""
+    cfg, params = _tiny()
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=1, max_seq=64,
+                                   chunk=1, paged=True, block_size=8,
+                                   num_blocks=12,
+                                   enable_prefix_caching=True,
+                                   enable_chunked_prefill=True,
+                                   prefill_chunk=8)
+    eng.add_request(Request(rid=0,
+                            prompt_ids=np.arange(1, 30, dtype=np.int32),
+                            max_new_tokens=4))
+    eng.step()  # admit + first 8-token chunk -> one full block computed
+    assert eng._prefill_ids[0] is not None     # still mid-prefill
+    assert eng._pcache.resident_blocks() >= 1
+    while eng.step() or eng._queue:
+        pass
+
+
+# ---------------- preemption / resume ----------------
+
+
+def test_preempt_resume_mid_prefill():
+    """An under-provisioned pool preempts the youngest slot while its
+    prompt is STILL streaming in (the tiny token budget keeps it streaming
+    while the older slot's decode growth drains the pool); the resume
+    re-admits and the final streams match the bucketed engine exactly
+    (greedy determinism makes the recompute invisible)."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(13)
+    prompts = [rs.randint(0, 128, (5,)).astype(np.int32),
+               rs.randint(0, 128, (40,)).astype(np.int32)]
+
+    def build():
+        return [Request(rid=0, prompt_ids=prompts[0], max_new_tokens=25),
+                Request(rid=1, prompt_ids=prompts[1], max_new_tokens=5)]
+
+    ref = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                   chunk=1, paged=True, block_size=8,
+                                   num_blocks=16).serve(build())
+    # pool of 8: slot 0 (1 page) + slot 1's prompt (5 pages) leave 2 free;
+    # slot 0's decode claims them at positions 8 and 16, then position 24
+    # evicts slot 1 — whose 40-token prompt at 1 budgeted row/step is still
+    # mid-stream at that point
+    ch = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                  chunk=1, paged=True, block_size=8,
+                                  num_blocks=8, enable_chunked_prefill=True,
+                                  prefill_chunk=4, token_budget=2)
+    reqs = build()
+    for r in reqs:
+        ch.add_request(r)
+    mid_prefill_preempt = False
+    while True:
+        was_streaming = ch._prefill_ids[1] is not None
+        p0 = ch.stats["preemptions"]
+        busy = ch.step()
+        if ch.stats["preemptions"] > p0 and was_streaming:
+            mid_prefill_preempt = True
+        if not busy and not ch._queue:
+            break
+    got = {r.rid: r.output_ids for r in reqs}
+    assert got == ref
+    assert mid_prefill_preempt, "workload never preempted mid-prefill"
+
+
+# ---------------- speculation interplay ----------------
+
+
+def test_spec_skips_prefilling_then_resumes():
+    """Speculation and chunked prefill compose: while any prompt streams,
+    mixed steps run (no drafting); once prefill drains the n-gram drafter
+    fires on the decode-ready slots, and the streams still match the plain
+    engine token for token."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(7)
+    prompts = [np.tile(rs.randint(0, 128, (6,)).astype(np.int32), 4),
+               np.tile(rs.randint(0, 128, (5,)).astype(np.int32), 4)]
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=12)
+                for i, p in enumerate(prompts)]
+
+    kw = dict(max_batch=2, max_seq=64, chunk=2, paged=True, block_size=8)
+    ref = ContinuousBatchingEngine(cfg, params, **kw).serve(build())
+    eng = ContinuousBatchingEngine(cfg, params, enable_speculation=True,
+                                   num_draft_tokens=4,
+                                   enable_chunked_prefill=True,
+                                   prefill_chunk=5, **kw)
+    got = eng.serve(build())
+    assert got == ref
+    assert eng.stats["mixed_steps"] > 0
+    assert eng.stats["spec_steps"] > 0        # drafting resumed after drain
+    assert eng.stats["decode_stall_steps"] == 0
+
+
+# ---------------- compiled-variant count (the O(1) claim) ----------------
+
+
+def test_prefill_compiles_o1_variants_vs_bucketed_log2():
+    """Serving prompts across many power-of-two buckets: the bucketed
+    engine compiles one prefill program per bucket (the log2(max_seq)
+    family), the chunked engine compiles exactly its two mixed/decode
+    programs no matter the prompt lengths — and a second serve through new
+    lengths adds nothing."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(17)
+    lens = (9, 17, 33, 65)                    # buckets 16/32/64/128
+    prompts = _prompts(rs, lens)
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=2)
+                for i, p in enumerate(prompts)]
+
+    kw = dict(max_batch=1, max_seq=128, chunk=1, paged=True, block_size=8,
+              num_blocks=32)
+    base = ContinuousBatchingEngine(cfg, params, **kw)
+    base.serve(build())
+    ch = ContinuousBatchingEngine(cfg, params, enable_chunked_prefill=True,
+                                  prefill_chunk=8, **kw)
+    ch.serve(build())
+    # greedy-only serve: one decode + one mixed variant, total 2 — O(1)
+    assert ch.n_traces() == 2
+    # the bucketed engine paid one prefill trace per distinct bucket on top
+    # of its decode program
+    assert base.n_traces() >= 1 + 4
+    # growth check: a longer, previously-unseen prompt length compiles
+    # nothing new chunked-on
+    ch.serve([Request(rid=99, prompt_ids=rs.randint(0, 128, (100,))
+                      .astype(np.int32), max_new_tokens=2)])
+    assert ch.n_traces() == 2
+
+
+# ---------------- token budget ----------------
+
+
+def test_token_budget_bounds_and_makes_progress():
+    """Per-step packed prefill rows never exceed token_budget minus the
+    decode lanes (observable through the cursor's advance), and a budget
+    too small for even one chunk still advances prefill by the 1-token
+    floor instead of livelocking."""
+    cfg, params = _tiny()
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                   chunk=1, paged=True, block_size=8,
+                                   enable_chunked_prefill=True,
+                                   prefill_chunk=8, token_budget=3)
+    eng.add_request(Request(rid=0, prompt_ids=np.arange(1, 20,
+                                                        dtype=np.int32),
+                            max_new_tokens=3))
+    cursors = []
+    while eng.step() or eng._queue:
+        if eng._prefill_ids[0] is not None:
+            cursors.append(int(eng._prefilled[0]))
+    steps = [b - a for a, b in zip(cursors, cursors[1:])]
+    assert steps and all(0 < d <= 3 for d in steps)
+    # starvation-freedom at the pathological budget
+    eng2 = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                    chunk=1, paged=True, block_size=8,
+                                    enable_chunked_prefill=True,
+                                    prefill_chunk=8, token_budget=1)
+    out = eng2.serve([Request(rid=0, prompt_ids=np.arange(1, 12,
+                                                          dtype=np.int32),
+                              max_new_tokens=2)])
+    assert len(out[0]) == 2
+
+
+# ---------------- TTFT across multi-chunk prefill ----------------
+
+
+def test_ttft_stamped_once_at_first_emitted_token():
+    """A long prompt streams over several mixed steps; ttft_s is stamped
+    exactly when the fused final-chunk token lands — present, positive, and
+    not re-stamped by later tokens."""
+    cfg, params = _tiny()
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=1, max_seq=64,
+                                   chunk=1, paged=True, block_size=8,
+                                   num_blocks=8, enable_chunked_prefill=True,
+                                   prefill_chunk=4)
+    req = Request(rid=0, prompt_ids=np.arange(1, 30, dtype=np.int32),
+                  max_new_tokens=6)
+    eng.add_request(req)
+    first = None
+    while eng.step() or eng._queue:
+        if req.ttft_s is not None and first is None:
+            first = req.ttft_s
+            # the prompt needed ceil(29/4) chunks before any token could
+            # exist, so several mixed steps ticked first
+            assert eng.stats["mixed_steps"] >= 29 // 4
+    assert req.ttft_s == first > 0.0
+    assert len(req.output_ids) == 6
+
+
+# ---------------- config / env plumbing ----------------
+
+
+def test_chunked_requires_paged_and_valid_chunk():
+    cfg, params = _tiny()
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                 enable_chunked_prefill=True)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                 paged=True, block_size=8,
+                                 enable_chunked_prefill=True,
+                                 prefill_chunk=0)
+
+
+def test_chunked_env_kill_switch(monkeypatch):
+    """PADDLE_TPU_CHUNKED_PREFILL=0 neutralizes the feature totally: no
+    mixed programs, the bucketed prefill path runs, tokens unchanged — and
+    even the (invalid) paged=False construction is forgiven instead of
+    raising, honoring 'forces it off regardless'."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(5)
+    prompts = _prompts(rs, (6, 13))
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=4)
+                for i, p in enumerate(prompts)]
+
+    kw = dict(max_batch=2, max_seq=64, chunk=1, paged=True, block_size=8)
+    ref = ContinuousBatchingEngine(cfg, params, **kw).serve(build())
+    monkeypatch.setenv("PADDLE_TPU_CHUNKED_PREFILL", "0")
+    off = ContinuousBatchingEngine(cfg, params, enable_chunked_prefill=True,
+                                   **kw)
+    assert not off._chunked
+    got = off.serve(build())
+    assert got == ref
+    assert off.stats["mixed_steps"] == 0
+    assert off.stats["prefills"] > 0
+    # kill switch trumps even the paged=True requirement
+    ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                             enable_chunked_prefill=True)
+
+
+def test_chunked_env_typo_warns_and_flag_registered(monkeypatch):
+    from paddle_tpu.utils.envflags import BOOL_FLAGS
+
+    assert BOOL_FLAGS["PADDLE_TPU_CHUNKED_PREFILL"] is True
+    cfg, params = _tiny()
+    monkeypatch.setenv("PADDLE_TPU_CHUNKED_PREFILL", "off")  # typo, not '0'
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        eng = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=32,
+                                       paged=True, block_size=8,
+                                       enable_chunked_prefill=True)
+    assert eng._chunked                       # falls back to the default (on)
+    assert any("PADDLE_TPU_CHUNKED_PREFILL" in str(x.message) for x in w)
+
+
+# ---------------- runtime auditor: invariant I7 ----------------
+
+
+def test_audit_i7_clean_through_chunked_serving(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_ENGINE_AUDIT", "1")
+    from paddle_tpu.analysis.engine_audit import audit_engine
+
+    cfg, params = _tiny()
+    rs = np.random.RandomState(9)
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                   chunk=1, paged=True, block_size=8,
+                                   num_blocks=20,
+                                   enable_prefix_caching=True,
+                                   enable_chunked_prefill=True,
+                                   prefill_chunk=5)
+    assert eng._audit_every_step
+    out = eng.serve([Request(rid=i, prompt_ids=p, max_new_tokens=5)
+                     for i, p in enumerate(_prompts(rs, (9, 22, 17)))])
+    assert all(len(v) == 5 for v in out.values())
+    audit_engine(eng)  # drained state also clean
+
+
+def test_audit_i7_detects_cursor_and_pack_corruption(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_ENGINE_AUDIT", "1")
+    from paddle_tpu.analysis.engine_audit import (EngineAuditError,
+                                                  audit_engine)
+
+    cfg, params = _tiny()
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                   chunk=1, paged=True, block_size=8,
+                                   enable_chunked_prefill=True,
+                                   prefill_chunk=4)
+    eng.add_request(Request(rid=0, prompt_ids=np.arange(1, 20,
+                                                        dtype=np.int32),
+                            max_new_tokens=4))
+    eng.step()                                 # admit + first chunk, clean
+    assert eng._prefill_ids[0] is not None
+    save = int(eng._prefilled[0])
+    eng._prefilled[0] = 99                     # inject: cursor past prompt
+    with pytest.raises(EngineAuditError, match="I7"):
+        audit_engine(eng)
+    eng._prefilled[0] = save
+    save_pack = eng._last_pack
+    eng._last_pack = ((0,), (0,))              # inject: decode AND prefill
+    with pytest.raises(EngineAuditError, match="I7"):
+        eng.step()
+    eng._last_pack = save_pack
+
+
+def test_audit_i7_detects_chunk_outrunning_allocation(monkeypatch):
+    """A prefill cursor past the slot's mapped page coverage means a chunk
+    scattered K/V into unallocated pages — the auditor must refuse the
+    state (surfaced as the position-coverage family, I6/I7)."""
+    monkeypatch.setenv("PADDLE_TPU_ENGINE_AUDIT", "1")
+    from paddle_tpu.analysis.engine_audit import (EngineAuditError,
+                                                  audit_engine)
+
+    cfg, params = _tiny()
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                   chunk=1, paged=True, block_size=8,
+                                   enable_chunked_prefill=True,
+                                   prefill_chunk=4)
+    eng.add_request(Request(rid=0, prompt_ids=np.arange(1, 20,
+                                                        dtype=np.int32),
+                            max_new_tokens=4))
+    eng.step()
+    # inject: give a mapped page back to the free list (allocation no
+    # longer covers the cursor); keep the table row consistent so the
+    # coverage check is what fires, not the partition ones
+    page = eng._slot_blocks[0].pop()
+    eng._table[0, len(eng._slot_shared[0]) + len(eng._slot_blocks[0])] = \
+        eng.num_blocks
+    eng._free.append(page)
+    eng._prefilled[0] = 19
+    eng._pos[0] = 19
+    eng._written[0] = 19
+    with pytest.raises(EngineAuditError, match="I[67]"):
+        audit_engine(eng)

@@ -570,3 +570,158 @@ def test_flash_fallback_respects_segment_ids():
     ref = _mask_oracle(q, k, v, same, True, d)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-3, atol=2e-3)
+
+
+# ---------------- ragged chunked-prefill kernel (mixed step) ----------
+# (the verify kernel's per-row causal law with T free — verify is the
+# T=K+1 special case — plus the decode kernel's dequant-on-read;
+# docs/chunked_prefill.md)
+
+
+def _prefill_case(rs, b, nh, nkv, hd, bs, max_blocks, lens, qmax, qlens,
+                  dtype=jnp.float32):
+    q, kc, vc, tables, lens = _paged_case(
+        rs, b=b, nh=nh, nkv=nkv, hd=hd, bs=bs, max_blocks=max_blocks,
+        lens=lens, dtype=dtype)
+    qm = jnp.asarray(rs.randn(b, qmax, nh, hd), dtype)
+    return qm, kc, vc, tables, lens, jnp.asarray(qlens, jnp.int32)
+
+
+@pytest.mark.parametrize("nh,nkv", [(4, 4), (8, 2), (20, 4), (6, 1)])
+def test_paged_prefill_gqa_parity(nh, nkv):
+    """Prefill kernel vs its gather oracle across GQA ratios with ragged
+    per-slot chunk widths (incl. a decode-style q_len==1 lane riding the
+    same launch — the mixed step's defining shape)."""
+    rs = np.random.RandomState(50)
+    q, kc, vc, tables, lens, qlens = _prefill_case(
+        rs, b=4, nh=nh, nkv=nkv, hd=32, bs=16, max_blocks=4,
+        lens=[6, 17, 40, 64], qmax=6, qlens=[6, 1, 4, 3])
+    before = pa.PREFILL_KERNEL_CALLS
+    out = pa.paged_attention_prefill(q, kc, vc, tables, lens, qlens)
+    assert pa.PREFILL_KERNEL_CALLS > before, "prefill kernel path not taken"
+    ref = pa.paged_prefill_reference(q, kc, vc, tables, lens, qlens)
+    # compare live rows only (padding rows are unspecified by contract)
+    for b_ in range(4):
+        ql = int(qlens[b_])
+        np.testing.assert_allclose(np.asarray(out)[b_, :ql],
+                                   np.asarray(ref)[b_, :ql],
+                                   rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("lens,qlens", [([3, 33, 64], [3, 5, 2]),
+                                        ([1, 16, 17], [1, 8, 8])])
+def test_paged_prefill_ragged_tails(lens, qlens):
+    """Chunk windows ending mid-page / exactly at a page boundary / in the
+    first page — every phase of the ragged tail the page walk elides."""
+    rs = np.random.RandomState(51)
+    q, kc, vc, tables, lens, qlens = _prefill_case(
+        rs, b=3, nh=8, nkv=2, hd=64, bs=16, max_blocks=4, lens=lens,
+        qmax=8, qlens=qlens)
+    out = pa.paged_attention_prefill(q, kc, vc, tables, lens, qlens)
+    assert bool(jnp.all(jnp.isfinite(out)))
+    ref = pa.paged_prefill_reference(q, kc, vc, tables, lens, qlens)
+    for b_ in range(3):
+        ql = int(qlens[b_])
+        np.testing.assert_allclose(np.asarray(out)[b_, :ql],
+                                   np.asarray(ref)[b_, :ql],
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_paged_prefill_is_verify_generalized():
+    """The T = K+1 special case: on verify-sized chunks the prefill oracle
+    IS the verify oracle, and the prefill kernel matches the verify kernel
+    row for row — the two family members may never drift."""
+    rs = np.random.RandomState(52)
+    q, kc, vc, tables, lens, qlens = _prefill_case(
+        rs, b=3, nh=8, nkv=2, hd=32, bs=16, max_blocks=4,
+        lens=[9, 30, 50], qmax=4, qlens=[4, 1, 3])
+    ref_p = pa.paged_prefill_reference(q, kc, vc, tables, lens, qlens)
+    ref_v = pa.paged_verify_reference(q, kc, vc, tables, lens, qlens)
+    np.testing.assert_array_equal(np.asarray(ref_p), np.asarray(ref_v))
+    out_p = pa.paged_attention_prefill(q, kc, vc, tables, lens, qlens)
+    out_v = pa.paged_attention_verify(q, kc, vc, tables, lens, qlens)
+    for b_ in range(3):
+        ql = int(qlens[b_])
+        np.testing.assert_allclose(np.asarray(out_p)[b_, :ql],
+                                   np.asarray(out_v)[b_, :ql],
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_paged_prefill_rows_match_single_token_decode():
+    """The defining property: row t of a prefill chunk IS the single-token
+    decode of that query over the first lens-(qlens-1-t) cache positions
+    (the written prefix plus the chunk through itself)."""
+    rs = np.random.RandomState(53)
+    b, qmax = 2, 5
+    q, kc, vc, tables, lens, qlens = _prefill_case(
+        rs, b=b, nh=8, nkv=2, hd=32, bs=16, max_blocks=4,
+        lens=[21, 40], qmax=qmax, qlens=[5, 3])
+    out = pa.paged_attention_prefill(q, kc, vc, tables, lens, qlens)
+    for b_ in range(b):
+        ql = int(qlens[b_])
+        for t in range(ql):
+            row_len = int(lens[b_]) - (ql - 1 - t)
+            one = pa.paged_attention_decode(
+                q[b_:b_ + 1, t], kc, vc, tables[b_:b_ + 1],
+                jnp.asarray([row_len], jnp.int32))
+            np.testing.assert_allclose(np.asarray(out)[b_, t],
+                                       np.asarray(one)[0],
+                                       rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_paged_prefill_quantized_kv(mode):
+    """Dequant-on-read parity over int8 / packed-int4 pages — the decode
+    kernel's quant support the verify member never had, so a KV-quantized
+    pool can prefill through the same kernel family that decodes it."""
+    rs = np.random.RandomState(54)
+    q, kc, vc, tables, lens, qlens = _prefill_case(
+        rs, b=3, nh=8, nkv=4, hd=32, bs=16, max_blocks=4,
+        lens=[7, 37, 64], qmax=4, qlens=[4, 2, 3])
+    qk, ks = pa.quantize_kv_cache(kc, mode)
+    qv, vs = pa.quantize_kv_cache(vc, mode)
+    out = pa.paged_attention_prefill(q, qk, qv, tables, lens, qlens,
+                                     kv_quant=mode, k_scale=ks, v_scale=vs)
+    ref = pa.paged_prefill_reference(q, qk, qv, tables, lens, qlens,
+                                     kv_quant=mode, k_scale=ks, v_scale=vs)
+    for b_ in range(3):
+        ql = int(qlens[b_])
+        np.testing.assert_allclose(np.asarray(out)[b_, :ql],
+                                   np.asarray(ref)[b_, :ql],
+                                   rtol=2e-3, atol=2e-3)
+    # and the quantized result tracks the fp attention within quant noise
+    # (int4 bound matches the roundtrip test's: ~0.5 absmax at 4 bits)
+    fp = pa.paged_prefill_reference(q, kc, vc, tables, lens, qlens)
+    tol = 0.05 if mode == "int8" else 0.5
+    for b_ in range(3):
+        ql = int(qlens[b_])
+        assert float(jnp.max(jnp.abs(np.asarray(out)[b_, :ql]
+                                     - np.asarray(fp)[b_, :ql]))) < tol
+
+
+def test_paged_prefill_disable_env_routes_to_oracle(monkeypatch):
+    rs = np.random.RandomState(55)
+    q, kc, vc, tables, lens, qlens = _prefill_case(
+        rs, b=2, nh=4, nkv=2, hd=32, bs=16, max_blocks=2,
+        lens=[5, 30], qmax=3, qlens=[3, 2])
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_PALLAS", "paged_attention")
+    before = pa.PREFILL_FALLBACK_CALLS
+    out = pa.paged_attention_prefill(q, kc, vc, tables, lens, qlens)
+    assert pa.PREFILL_FALLBACK_CALLS > before
+    ref = pa.paged_prefill_reference(q, kc, vc, tables, lens, qlens)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_paged_prefill_under_jit_and_bf16():
+    rs = np.random.RandomState(56)
+    q, kc, vc, tables, lens, qlens = _prefill_case(
+        rs, b=2, nh=8, nkv=2, hd=64, bs=8, max_blocks=4, lens=[9, 25],
+        qmax=6, qlens=[6, 2], dtype=jnp.bfloat16)
+    out = jax.jit(pa.paged_attention_prefill)(q, kc, vc, tables, lens, qlens)
+    assert out.dtype == jnp.bfloat16
+    ref = pa.paged_prefill_reference(q, kc, vc, tables, lens, qlens)
+    for b_ in range(2):
+        ql = int(qlens[b_])
+        assert float(jnp.max(jnp.abs(
+            out[b_, :ql].astype(jnp.float32)
+            - ref[b_, :ql].astype(jnp.float32)))) <= 1e-2

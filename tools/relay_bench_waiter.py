@@ -54,6 +54,15 @@ TARGETS = {
     "cb_spec_ngram_hot": "llama_cb_decode_tokens_per_sec/cb_spec_ngram_hot",
     "cb_spec_ngram_cold": "llama_cb_decode_tokens_per_sec/cb_spec_ngram_cold",
     "cb_spec_ngram_base": "llama_cb_decode_tokens_per_sec/cb_spec_ngram_base",
+    # round-9 evidence rungs: chunked prefill + unified mixed step A/B —
+    # long-prompt arrivals over an active decode batch, chunked on vs off
+    # (docs/chunked_prefill.md; TBT p50/p99 + TTFT + decode_stall_steps +
+    # n_traces in detail); exact keys so the mixed rung can never satisfy
+    # its own stall baseline
+    "cb_chunked_prefill_mixed":
+        "llama_cb_decode_tokens_per_sec/cb_chunked_prefill_mixed",
+    "cb_chunked_prefill_off":
+        "llama_cb_decode_tokens_per_sec/cb_chunked_prefill_off",
 }
 
 
