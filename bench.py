@@ -868,6 +868,31 @@ def decode_ladder_main(compact: bool = False) -> int:
             log(f"cb chunked rung {rung[0]} failed: {e}\n"
                 f"{traceback.format_exc()}")
             continue
+    # fault-tolerance rung (ISSUE 6): open-loop 2x-oversubscribed arrivals
+    # + injected allocator faults over the full-feature engine — headline is
+    # GOODPUT (tokens/s over requests that actually FINISHED), the number
+    # overload SLOs are written against; failures/rejections/expiries and
+    # every degradation-ladder rung's trip count ride in detail
+    # (docs/fault_tolerance.md).  (rung tuple: cfg, slots, n_requests,
+    # prompt, new, max_seq, num_blocks, block_size, max_queue, arrive_every,
+    # fault_spec)
+    overload_rungs = ([
+        ("cb_overload_degrade", full_cfg, 8, 32, 64, 48, 512, 48, 64, 8, 2,
+         "alloc_fail@p=0.25,seed=3,count=-1;nan_logits@step=40"),
+    ] if on_tpu else [
+        ("cb_overload_cpu_smoke", llama.LlamaConfig.tiny(), 2, 6, 12, 6, 64,
+         10, 8, 2, 1,
+         "alloc_fail@step=3;alloc_fail@step=6;nan_logits@step=9;"
+         "kernel_error@step=12"),
+    ])
+    for rung in overload_rungs:
+        try:
+            emit(run_cb_overload_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"cb overload rung {rung[0]} failed: {e}\n"
+                f"{traceback.format_exc()}")
+            continue
     return 0 if banked else 1
 
 
@@ -1008,6 +1033,119 @@ def run_cb_chunked_rung(name, cfg, max_batch, n_decode, n_long, short_prompt,
                        _pa.PREFILL_KERNEL_CALLS - pk0,
                    "prefill_fallback_calls":
                        _pa.PREFILL_FALLBACK_CALLS - pf0,
+                   "n_traces": eng.n_traces(),
+                   "backend": jax.default_backend()},
+    }
+
+
+def run_cb_overload_rung(name, cfg, max_batch, n_requests, prompt, new,
+                         max_seq, num_blocks, block_size, max_queue,
+                         arrive_every, fault_spec):
+    """Fault-tolerance rung (ISSUE 6, docs/fault_tolerance.md): open-loop
+    arrivals oversubscribe the slot pool ~2x (one new request every
+    ``arrive_every`` engine steps, regardless of completions — the
+    overload regime where closed-loop benchmarks lie), a bounded queue
+    (``max_queue``) sheds the excess as REJECTED, one tail request carries
+    an already-blown deadline (EXPIRED while queued), and ``fault_spec``
+    injects allocator/sampler/kernel faults mid-serve.  The engine must
+    degrade through the ladder instead of falling over; the headline is
+    GOODPUT — tokens/s counting only requests that FINISHED — because raw
+    tokens/s credits work that overload then throws away.  The full-feature
+    engine runs (prefix cache + speculation + chunked prefill) so every
+    ladder rung is reachable."""
+    import os
+
+    import numpy as np
+    import jax
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              Request, TERMINAL_STATUSES)
+    from paddle_tpu.inference.faults import FaultPlan
+
+    log(f"cb overload rung {name}: building (slots={max_batch} "
+        f"requests={n_requests} blocks={num_blocks} spec={fault_spec!r})")
+    rs = np.random.RandomState(0)
+    params = llama.init_params(cfg, jax.random.key(0))
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
+                                   max_seq=max_seq, chunk=1, paged=True,
+                                   block_size=block_size,
+                                   num_blocks=num_blocks,
+                                   enable_prefix_caching=True,
+                                   enable_speculation=True,
+                                   enable_chunked_prefill=True,
+                                   prefill_chunk=min(prompt, 32),
+                                   max_queue=max_queue)
+    del params
+    t_c = time.perf_counter()
+    eng.serve([Request(rid=-1, prompt_ids=rs.randint(
+        0, cfg.vocab_size, (prompt,)).astype(np.int32), max_new_tokens=2)])
+    log(f"cb overload rung {name}: compile {time.perf_counter() - t_c:.1f}s")
+    for key in ("decode_steps", "decode_tokens", "prefills",
+                "prefill_chunks", "mixed_steps"):
+        eng.stats[key] = 0
+    eng.stats["decode_time_s"] = 0.0
+    # arm the chaos AFTER warmup: the plan's step keys are relative to the
+    # timed serve (the replayable contract a chaos run's evidence needs),
+    # so the step counter resets with it
+    os.environ["PADDLE_TPU_FAULT_INJECT"] = fault_spec
+    try:
+        eng._faults = FaultPlan.from_env()
+    finally:
+        os.environ.pop("PADDLE_TPU_FAULT_INJECT", None)
+    eng._step_no = 0
+    reqs = [Request(rid=i, prompt_ids=rs.randint(
+                0, cfg.vocab_size, (prompt,)).astype(np.int32),
+                max_new_tokens=new) for i in range(n_requests)]
+    # one tail request with an already-blown deadline: EXPIRED-while-queued
+    # is part of the degradation surface the rung reports on
+    reqs[-1].deadline_s = 0.0
+    pending = list(reqs)
+    steps = 0
+    t0 = time.perf_counter()
+    while True:
+        busy = eng.step()
+        steps += 1
+        if pending and steps % arrive_every == 0:
+            eng.add_request(pending.pop(0))   # open loop: arrivals don't wait
+            continue
+        if not busy and not pending and not eng._queue:
+            break
+    wall = time.perf_counter() - t0
+    finished = [r for r in reqs if r.status == "FINISHED"]
+    good_toks = sum(len(r.output_ids) for r in finished)
+    statuses = {st: sum(1 for r in reqs if r.status == st)
+                for st in sorted(TERMINAL_STATUSES)}
+    assert sum(statuses.values()) == n_requests, statuses  # all terminal
+    # pool accounting closes exactly: every page is free or a zero-ref
+    # cache resident (retired/donated) — nothing leaked to dead requests
+    cached = (list(eng._pcache.resident_pages())
+              if eng._pcache is not None else [])
+    assert sorted(eng._free + cached) == list(range(num_blocks))
+    return {
+        "metric": "llama_cb_decode_tokens_per_sec",
+        "value": round(good_toks / wall, 1) if wall > 0 else 0.0,
+        "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "detail": {"rung": name, "slots": max_batch,
+                   "requests": n_requests, "prompt": prompt,
+                   "new_tokens": new, "wall_s": round(wall, 2),
+                   "goodput_tokens": good_toks,
+                   "headline_is_goodput": True,
+                   "fault_spec": fault_spec,
+                   "max_queue": max_queue, "num_blocks": num_blocks,
+                   "statuses": statuses,
+                   "requests_failed": eng.stats["requests_failed"],
+                   "requests_rejected": eng.stats["requests_rejected"],
+                   "requests_expired": eng.stats["requests_expired"],
+                   "degrade_evict": eng.stats["degrade_evict"],
+                   "degrade_spec_off": eng.stats["degrade_spec_off"],
+                   "degrade_budget_shrink":
+                       eng.stats["degrade_budget_shrink"],
+                   "degrade_preempt": eng.stats["degrade_preempt"],
+                   "nan_guard_trips": eng.stats["nan_guard_trips"],
+                   "kernel_error_retries":
+                       eng.stats["kernel_error_retries"],
                    "n_traces": eng.n_traces(),
                    "backend": jax.default_backend()},
     }

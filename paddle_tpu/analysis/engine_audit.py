@@ -34,9 +34,18 @@ I7  chunked-prefill progress (engines with ``enable_chunked_prefill``) — a
     pages), and no slot was packed as BOTH a decode lane and a prefill lane
     in the same mixed step (the unified launch's two roles are disjoint by
     construction — an overlap means the scheduler double-advanced a slot).
+I8  terminal ownership (docs/fault_tolerance.md) — a request in a terminal
+    status (FINISHED/FAILED/REJECTED/CANCELLED/EXPIRED) owns zero pages and
+    zero cache refs: it is neither seated on a slot nor waiting in the
+    queue (pages and refs are slot-keyed, so "not seated" + I1's exact pool
+    partition IS the zero-ownership proof); conversely every seated request
+    is RUNNING and every queued request is PENDING.  The fault paths
+    (_fail_slot, expiry, cancel) release before they mark terminal — a
+    violation means a failed request's pages leaked or a zombie is still
+    being scheduled.
 
-Dense (non-paged) engines only get I6's bounds check — there is no allocator
-to corrupt.  The audit is O(pool + slots·blocks) pure-host work per step:
+Dense (non-paged) engines only get I6's bounds check and I8 — there is no
+allocator to corrupt.  The audit is O(pool + slots·blocks) pure-host work per step:
 cheap next to a device step, but nonzero, hence opt-in (a debug validator,
 not a production default).
 """
@@ -81,6 +90,32 @@ def audit_engine(eng) -> None:
             _fail("I6", f"slot {s} pos {pos} ahead of written high-water "
                         f"{w}: speculative rollback may trail the device's "
                         f"writes but pos must never pass them")
+
+    # I8: terminal ownership — dense and paged alike (the journal and the
+    # queue are host structures both engine shapes share)
+    from ..inference.serving import TERMINAL_STATUSES
+
+    seated = {id(r) for r in eng._slot_req if r is not None}
+    queued = {id(r) for r in eng._queue}
+    for req in getattr(eng, "_reqs", {}).values():
+        if req.status in TERMINAL_STATUSES:
+            if id(req) in seated:
+                _fail("I8", f"rid {req.rid} is {req.status} (terminal) but "
+                            f"still seated on a slot: its pages were never "
+                            f"released")
+            if id(req) in queued:
+                _fail("I8", f"rid {req.rid} is {req.status} (terminal) but "
+                            f"still waiting in the queue (zombie: it would "
+                            f"be re-admitted)")
+    for s in range(B):
+        req = eng._slot_req[s]
+        if req is not None and req.status != "RUNNING":
+            _fail("I8", f"slot {s} seats rid {req.rid} with status "
+                        f"{req.status} (seated requests must be RUNNING)")
+    for req in eng._queue:
+        if req.status != "PENDING":
+            _fail("I8", f"queued rid {req.rid} has status {req.status} "
+                        f"(queued requests must be PENDING)")
     if not getattr(eng, "paged", False):
         return
 

@@ -78,9 +78,14 @@ def _serving_engine(_force_flags=(), **kwargs):
     # the lint gate analyzes a feature's compiled program even when the
     # operator's kill switch (e.g. PADDLE_TPU_CHUNKED_PREFILL=0) has it off
     # at runtime — without the override the ctor would skip building the
-    # program and the target builder would crash the whole gate
+    # program and the target builder would crash the whole gate.
+    # PADDLE_TPU_GRACEFUL is forced for EVERY serving target: the graceful
+    # programs carry the in-graph NaN/inf logit guard, and the host_sync
+    # rule must see exactly what production traces (the guard's flags ride
+    # back with the step's tokens — a callback sneaking in would be the
+    # regression the gate exists to catch)
     with contextlib.ExitStack() as stack:
-        for flag in _force_flags:
+        for flag in (*_force_flags, "PADDLE_TPU_GRACEFUL"):
             prev = os.environ.get(flag)
             os.environ[flag] = "1"
             stack.callback(lambda f=flag, p=prev: (

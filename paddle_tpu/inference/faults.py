@@ -1,0 +1,140 @@
+"""Deterministic fault injection for the serving engine (ISSUE 6 tentpole).
+
+The degradation paths the engine ships (preemption, LRU eviction, kernel
+fallback, and now the full overload ladder — docs/fault_tolerance.md) are
+only trustworthy if they are exercised *adversarially*: a fault that only
+ever happens in production is a fault the test suite proves nothing about.
+This module turns ``PADDLE_TPU_FAULT_INJECT`` into a :class:`FaultPlan` the
+engine polls at its three seams:
+
+* **allocator** (``_alloc_to``) — ``alloc_fail`` makes a page grab report
+  the pool dry even when pages are free, driving the overload ladder
+  (evict -> preempt -> fail-one) without needing a genuinely tiny pool;
+* **kernel dispatch** (``_launch``) — ``kernel_error`` raises where the
+  compiled step would be dispatched, BEFORE the call, so host and device
+  state are untouched and the graceful engine can retry the step;
+* **sampler** — ``nan_logits`` sets a per-slot poison bit that the compiled
+  step turns into a genuinely non-finite logits row IN-GRAPH, so the NaN/inf
+  guard proves itself against the real failure shape, not a host-side
+  simulation (requires ``PADDLE_TPU_GRACEFUL=1``: the graceful-off program
+  is byte-identical to the pre-fault-tolerance engine and has no poison
+  operand, so this kind is inert there);
+
+plus two host-side seams that exercise per-request isolation:
+
+* ``slot_error`` — raises while banking one slot's generated token (the
+  consume loop), proving a host-side per-request fault cannot take down the
+  batch;
+* ``cache_error`` — raises inside prefix-cache block registration; the
+  graceful engine degrades (the block stays private, a future request
+  misses where it could have hit) without failing any request.
+
+Grammar (validated by ``utils/envflags.env_fault_spec``; a typo warns with a
+did-you-mean and disables injection entirely)::
+
+    PADDLE_TPU_FAULT_INJECT="alloc_fail@step=7;nan_logits@slot=2,step=11"
+
+Clause keys: ``step`` (engine step number, 1-based; omitted = any step),
+``slot`` / ``rid`` (omitted = first match polled), ``count`` (firings before
+the clause exhausts; default 1, ``-1`` = unlimited), and ``p`` + ``seed``
+for probabilistic chaos — each matching poll fires with probability ``p``
+drawn from a ``seed``-keyed private stream, so a randomized chaos run is
+still exactly replayable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["KNOWN_KINDS", "KNOWN_KEYS", "FaultClause", "FaultPlan",
+           "FaultInjected"]
+
+
+class FaultInjected(RuntimeError):
+    """Raised at a host-side injection seam (kernel dispatch / token
+    banking / cache registration) when a fault-plan clause fires.  A
+    DISTINCT type so the graceful engine's recovery paths catch exactly the
+    faults the plan injected — a genuine error raised by the same code is
+    never silently swallowed as chaos noise.  The raise always happens
+    BEFORE the seam's real work (a compiled launch is never entered), so
+    host and device state are untouched and recovery can retry or fail just
+    the affected request."""
+
+#: fault kinds the engine polls for (the env_fault_spec vocabulary)
+KNOWN_KINDS = frozenset({"alloc_fail", "kernel_error", "nan_logits",
+                         "slot_error", "cache_error"})
+
+#: clause keys the grammar accepts
+KNOWN_KEYS = frozenset({"step", "slot", "rid", "count", "p", "seed"})
+
+
+@dataclasses.dataclass
+class FaultClause:
+    """One parsed clause of a fault plan.  ``count`` is decremented per
+    firing; 0 means exhausted (-1 never exhausts)."""
+
+    kind: str
+    step: int | None = None
+    slot: int | None = None
+    rid: int | None = None
+    count: int = 1
+    p: float = 1.0
+    seed: int = 0
+
+    def __post_init__(self):
+        # private seeded stream per clause: probabilistic firing stays
+        # replayable and independent of every other clause's draw order
+        self._rng = np.random.RandomState(self.seed)
+
+    def matches(self, kind: str, step, slot, rid) -> bool:
+        if self.kind != kind or self.count == 0:
+            return False
+        if self.step is not None and step != self.step:
+            return False
+        if self.slot is not None and slot != self.slot:
+            return False
+        if self.rid is not None and rid != self.rid:
+            return False
+        return True
+
+
+class FaultPlan:
+    """The engine-facing injector: ``fire(kind, ...)`` at a seam returns True
+    when a clause matches (and consumes one firing).  An empty plan is inert
+    and free — the hot-loop polls short-circuit on ``self._clauses``."""
+
+    def __init__(self, clauses=()):
+        self._clauses = [c if isinstance(c, FaultClause) else FaultClause(**c)
+                         for c in clauses]
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        """Parse ``PADDLE_TPU_FAULT_INJECT`` (validated; malformed specs warn
+        once and disable injection — utils/envflags.py)."""
+        from ..utils.envflags import env_fault_spec
+
+        return cls(env_fault_spec("PADDLE_TPU_FAULT_INJECT", KNOWN_KINDS,
+                                  KNOWN_KEYS))
+
+    def __bool__(self) -> bool:
+        return bool(self._clauses)
+
+    def fire(self, kind: str, *, step: int | None = None,
+             slot: int | None = None, rid: int | None = None) -> bool:
+        """Poll one seam: True exactly when a clause matches and fires.
+        Polling order is the engine's deterministic scan order, so a clause
+        with an omitted ``slot`` fires on the first matching poll — the plan
+        stays replayable without pinning every key."""
+        if not self._clauses:
+            return False
+        for c in self._clauses:
+            if not c.matches(kind, step, slot, rid):
+                continue
+            if c.p < 1.0 and float(c._rng.random_sample()) >= c.p:
+                continue
+            if c.count > 0:
+                c.count -= 1
+            return True
+        return False

@@ -92,6 +92,29 @@ streams, the spec path resumes once prefill drains).  Opt-out:
 ``PADDLE_TPU_CHUNKED_PREFILL=0``; chunked-off the engine is byte-identical
 to the bucketed-prefill engine.
 
+Fault tolerance (docs/fault_tolerance.md; default on, kill switch
+``PADDLE_TPU_GRACEFUL=0`` restores the brittle pre-fault-tolerance engine
+byte-identically): every request ends in a terminal ``status``
+(``FINISHED | FAILED | REJECTED | CANCELLED | EXPIRED``) and no per-request
+fault escapes ``step()`` — the offending request is failed, its pages and
+cache refs released, and every surviving request's token stream is
+IDENTICAL to a run that never contained the poison request (each slot's
+stream depends only on its own (seed, position) keys and its own pages, so
+isolation is exact, not best-effort).  Overload walks a degradation ladder
+in strict order — evict prefix-cache leaves, suspend speculation for the
+step, shrink the mixed-step token budget, preempt the youngest slot, and
+only then fail the one unsatisfiable request.  Requests carry an optional
+``deadline_s`` (expire with partial output), ``cancel(rid)`` frees even a
+mid-prefill slot via the chunked-prefill cursor, a bounded queue
+(``max_queue``) applies REJECTED-on-full backpressure, and an IN-GRAPH
+NaN/inf logit guard quarantines a poisoned slot instead of emitting garbage
+(the guard's flags ride back with the step's tokens — no extra host sync).
+``snapshot()``/``restore()`` journal accepted work (prompt, emitted tokens,
+chunk cursor) and resume through the preemption path's teacher-forced
+recompute — the replica-restart primitive the fleet tier needs.  Faults are
+injected deterministically at the allocator / kernel-dispatch / sampler
+seams via ``PADDLE_TPU_FAULT_INJECT`` (faults.py).
+
 Per-request sampling (reference: ``top_p_sampling``, ops.yaml:4947) runs
 inside the jitted step: temperature/top-p/seed are per-slot DATA vectors, so
 one compiled program serves mixed greedy/sampled batches, and RNG keys
@@ -106,6 +129,7 @@ and CUDA kernels.
 from __future__ import annotations
 
 import functools
+import math
 import time
 from dataclasses import dataclass, field
 
@@ -114,8 +138,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..profiler import RecordEvent
+from .faults import FaultInjected
 
-__all__ = ["Request", "ContinuousBatchingEngine"]
+__all__ = ["Request", "ContinuousBatchingEngine", "TERMINAL_STATUSES"]
+
+#: terminal request statuses (docs/fault_tolerance.md status lifecycle);
+#: a request in one of these owns zero pages and zero cache refs — the
+#: runtime auditor's I8 (analysis/engine_audit.py)
+TERMINAL_STATUSES = frozenset({"FINISHED", "FAILED", "REJECTED", "CANCELLED",
+                               "EXPIRED"})
+
+#: terminal status -> engine stats counter (FINISHED ticks decode counters
+#: through the normal retire path instead)
+_STATUS_STAT = {"FAILED": "requests_failed", "REJECTED": "requests_rejected",
+                "CANCELLED": "requests_cancelled",
+                "EXPIRED": "requests_expired"}
 
 
 @dataclass
@@ -129,10 +166,17 @@ class Request:
     temperature: float = 0.0
     top_p: float = 1.0
     seed: int | None = None
+    # wall-clock budget from submission; overdue requests expire with the
+    # partial output they have (status EXPIRED) instead of holding pages
+    deadline_s: float | None = None
     # filled by the engine
     output_ids: list = field(default_factory=list)
     finished: bool = False
     ttft_s: float | None = None  # submit -> first generated token (wall s)
+    # lifecycle: PENDING (queued) -> RUNNING (seated) -> one of
+    # TERMINAL_STATUSES; ``error`` is set for every non-FINISHED terminal
+    status: str = "PENDING"
+    error: str | None = None
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -155,7 +199,8 @@ class ContinuousBatchingEngine:
                  enable_prefix_caching: bool = False,
                  enable_speculation: bool = False, num_draft_tokens: int = 4,
                  spec_ngram: int = 3, enable_chunked_prefill: bool = False,
-                 prefill_chunk: int = 128, token_budget: int | None = None):
+                 prefill_chunk: int = 128, token_budget: int | None = None,
+                 max_queue: int | None = None):
         """``chunk``: decode steps per compiled call.  Tokens feed back
         on-device inside a lax.scan and the host fetches ``chunk`` tokens per
         round-trip — the lever against host-device latency (one RTT per token
@@ -189,7 +234,12 @@ class ContinuousBatchingEngine:
         tradeoff; the untouched chunk-length scan resumes once prefill
         drains — docs/chunked_prefill.md "token-budget semantics").  Kill
         switch: ``PADDLE_TPU_CHUNKED_PREFILL=0`` forces it off
-        regardless."""
+        regardless.
+        ``max_queue``: admission backpressure — when the wait queue already
+        holds this many requests, ``add_request`` marks the newcomer
+        ``REJECTED`` (with ``error``) instead of queueing it; None (the
+        default) keeps the queue unbounded.  Preemption re-inserts are
+        exempt: accepted work is never rejected."""
         from ..models import llama as _llama  # noqa: F401  (cfg type lives there)
 
         self.cfg = cfg
@@ -209,8 +259,12 @@ class ContinuousBatchingEngine:
             assert max_seq % block_size == 0, (max_seq, block_size)
             self.block_size = block_size
             self.max_blocks = max_seq // block_size     # per-slot logical cap
+            # default pool: half the worst-case footprint (continuous
+            # batching oversubscribes), floored at ONE full request so a
+            # max_batch=1 engine is constructible
             self.num_blocks = (num_blocks if num_blocks is not None
-                               else (max_batch * self.max_blocks) // 2)
+                               else max((max_batch * self.max_blocks) // 2,
+                                        self.max_blocks))
             assert self.num_blocks >= self.max_blocks, (
                 f"pool of {self.num_blocks} blocks cannot hold one full "
                 f"request ({self.max_blocks} blocks)")
@@ -278,15 +332,44 @@ class ContinuousBatchingEngine:
         self._topp = np.ones(max_batch, np.float32)
         self._seed = np.zeros(max_batch, np.int32)
         self._queue: list[Request] = []
+        # fault tolerance (docs/fault_tolerance.md).  ``_graceful`` is a
+        # TRACE-TIME static: with PADDLE_TPU_GRACEFUL=0 every compiled
+        # program below traces the pre-fault-tolerance jaxpr byte-for-byte
+        # (no poison operand, no guard flags) and faults raise out of
+        # step() exactly as they always did.
+        self._graceful = env_bool("PADDLE_TPU_GRACEFUL", True)
+        from .faults import FaultPlan
+
+        self._faults = FaultPlan.from_env()
+        self._step_no = 0          # engine step counter (fault-plan key)
+        self.max_queue = max_queue
+        # rid -> Request for every request ever accepted: cancel()'s lookup,
+        # snapshot()'s journal source, and the auditor's I8 witness set
+        self._reqs: dict[int, Request] = {}
+        # per-slot sampler-seam poison bits (nan_logits injection): DATA to
+        # the graceful compiled steps, where they become a genuinely
+        # non-finite logits row the in-graph guard must catch
+        self._poison = np.zeros(max_batch, bool)
+        self._kernel_err_streak = 0
+        # consecutive failed launches tolerated before giving up: a raise at
+        # the dispatch seam leaves state untouched (retry is free), but a
+        # persistent failure means the program itself cannot run
+        self._kernel_err_limit = 3
+        # consecutive steps where admission made no progress with nothing
+        # resident (see step(): waiting cannot help — ladder rung 5 applies
+        # at admission after this many stuck steps)
+        self._admit_stalls = 0
         impl = self._decode_impl_paged if paged else self._decode_impl
         # two decode variants behind a STATIC sampling flag: the full-vocab
         # sort/softmax/categorical of the sampler must not run (XLA cannot
         # DCE work behind a data-dependent where) when every resident slot
         # is greedy — the bench headline's configuration
         self._decode_greedy = jax.jit(
-            functools.partial(impl, sampling=False), donate_argnums=(1, 2))
+            functools.partial(impl, sampling=False, graceful=self._graceful),
+            donate_argnums=(1, 2))
         self._decode_sampling = jax.jit(
-            functools.partial(impl, sampling=True), donate_argnums=(1, 2))
+            functools.partial(impl, sampling=True, graceful=self._graceful),
+            donate_argnums=(1, 2))
         # prefill writes its lane directly into the donated pool arrays —
         # no slice-out/scatter-back copies of the full pool per admission
         pimpl = self._prefill_impl_paged if paged else self._prefill_impl
@@ -313,10 +396,12 @@ class ContinuousBatchingEngine:
             # per sampling mode for the whole serve, no shape-family churn
             self._spec_qmax = int(num_draft_tokens) + 1
             self._verify_greedy = jax.jit(
-                functools.partial(self._verify_impl_paged, sampling=False),
+                functools.partial(self._verify_impl_paged, sampling=False,
+                                  graceful=self._graceful),
                 donate_argnums=(1, 2))
             self._verify_sampling = jax.jit(
-                functools.partial(self._verify_impl_paged, sampling=True),
+                functools.partial(self._verify_impl_paged, sampling=True,
+                                  graceful=self._graceful),
                 donate_argnums=(1, 2))
         # chunked prefill + unified mixed prefill/decode step (stall-free
         # continuous batching; docs/chunked_prefill.md).  Like the prefix
@@ -357,10 +442,12 @@ class ContinuousBatchingEngine:
             # serve: chunk packing / per-slot progress are q_lens/pos DATA,
             # so prefill goes from log2(max_seq) bucketed variants to O(1)
             self._mixed_greedy = jax.jit(
-                functools.partial(self._mixed_impl_paged, sampling=False),
+                functools.partial(self._mixed_impl_paged, sampling=False,
+                                  graceful=self._graceful),
                 donate_argnums=(1, 2))
             self._mixed_sampling = jax.jit(
-                functools.partial(self._mixed_impl_paged, sampling=True),
+                functools.partial(self._mixed_impl_paged, sampling=True,
+                                  graceful=self._graceful),
                 donate_argnums=(1, 2))
         self.stats = {"decode_steps": 0, "decode_tokens": 0,
                       "prefills": 0, "decode_time_s": 0.0, "preemptions": 0,
@@ -382,7 +469,16 @@ class ContinuousBatchingEngine:
                       # dispatched while decode slots sat waiting (the TBT
                       # spike this feature erases: must be 0 chunked-on)
                       "prefill_chunks": 0, "mixed_steps": 0,
-                      "decode_stall_steps": 0}
+                      "decode_stall_steps": 0,
+                      # fault-tolerance observability (docs/
+                      # fault_tolerance.md): terminal-status counters plus
+                      # one counter per degradation-ladder rung, in ladder
+                      # order — a healthy serve keeps all of these 0
+                      "requests_failed": 0, "requests_rejected": 0,
+                      "requests_cancelled": 0, "requests_expired": 0,
+                      "degrade_evict": 0, "degrade_spec_off": 0,
+                      "degrade_budget_shrink": 0, "degrade_preempt": 0,
+                      "nan_guard_trips": 0, "kernel_error_retries": 0}
         # opt-in runtime invariant auditor (PADDLE_TPU_ENGINE_AUDIT=1):
         # cross-checks allocator / block-table / prefix-cache bookkeeping
         # after admission and after every decode chunk, raising
@@ -507,31 +603,66 @@ class ContinuousBatchingEngine:
         sampled = jax.vmap(jax.random.categorical)(keys, masked)
         return jnp.where(temp > 0.0, sampled.astype(jnp.int32), greedy)
 
+    def _guard_logits(self, logits, active, poison):
+        """In-graph NaN/inf logit guard (graceful mode only): flag every
+        ACTIVE slot whose logits row is non-finite — numerically poisoned by
+        the model, or by the ``nan_logits`` fault-injection poison bit —
+        and replace the row with zeros so the sampler stays finite (the
+        host discards a flagged slot's token and quarantines the request).
+        Pure element-wise ops: no callback, no host sync — the flags ride
+        back with the step's tokens in the same device fetch.  Inactive
+        lanes are excluded: their garbage logits may be legitimately
+        non-finite (fully-masked softmax rows).  The poison bit is applied
+        FIRST, turning the slot's row genuinely NaN, so injection exercises
+        the same finiteness check a real numerical blowup hits — never a
+        parallel flag-only path."""
+        row = jnp.where(poison, jnp.float32(jnp.nan), jnp.float32(0.0))
+        logits = logits + row[:, None].astype(logits.dtype)
+        bad = active & ~jnp.isfinite(logits).all(axis=-1)
+        return jnp.where(bad[:, None], jnp.zeros_like(logits), logits), bad
+
     def _chunk_scan(self, params, cache_k, cache_v, tokens, pos, active,
-                    temp, topp, seeds, table=None, sampling=False):
+                    temp, topp, seeds, table=None, poison=None,
+                    sampling=False, graceful=False):
         """``chunk`` decode steps in one compiled program; the chosen token
         feeds back on-device (no host round-trip inside the chunk).
         ``sampling`` is STATIC: the greedy variant compiles without the
-        sampler's full-vocab sort.  Returns (tokens [chunk, B], caches)."""
+        sampler's full-vocab sort.  ``graceful`` is STATIC too: off, the
+        program is byte-identical to the pre-fault-tolerance engine; on, a
+        ``poison`` operand feeds the in-graph NaN/inf guard and per-step
+        guard flags [chunk, B] come back with the tokens.  Returns
+        (tokens [chunk, B][, bad [chunk, B]], caches)."""
+        if graceful and poison is None:
+            # direct callers (lint targets, tests) may omit the injection
+            # operand; a zeros vector traces the same guarded program
+            poison = jnp.zeros_like(active)
 
         def one(carry, _):
             ck, cv, tok, p = carry
             logits, ck, cv = self._decode_one(params, ck, cv, tok, p, active,
                                               table)
+            if graceful:
+                logits, bad = self._guard_logits(logits, active, poison)
             if sampling:
                 nxt = self._sample_tokens(logits, p, temp, topp, seeds)
             else:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (ck, cv, nxt, p + 1), nxt
+            return ((ck, cv, nxt, p + 1),
+                    (nxt, bad) if graceful else nxt)
 
-        (ck, cv, _, _), toks = jax.lax.scan(
+        (ck, cv, _, _), out = jax.lax.scan(
             one, (cache_k, cache_v, tokens, pos), None, length=self.chunk)
-        return toks, ck, cv
+        if graceful:
+            toks, bad = out
+            return toks, bad, ck, cv
+        return out, ck, cv
 
     def _decode_impl(self, params, cache_k, cache_v, tokens, pos, active,
-                     temp, topp, seeds, sampling=False):
+                     temp, topp, seeds, poison=None, sampling=False,
+                     graceful=False):
         return self._chunk_scan(params, cache_k, cache_v, tokens, pos, active,
-                                temp, topp, seeds, sampling=sampling)
+                                temp, topp, seeds, poison=poison,
+                                sampling=sampling, graceful=graceful)
 
     def _prefill_body(self, params, ids, cache_k, cache_v, length, bucket,
                       write, start=None):
@@ -595,9 +726,11 @@ class ContinuousBatchingEngine:
     # ---------------- paged (block-table) compiled programs ----------------
 
     def _decode_impl_paged(self, params, cache_k, cache_v, tokens, pos, active,
-                           temp, topp, seeds, table, sampling=False):
+                           temp, topp, seeds, table, poison=None,
+                           sampling=False, graceful=False):
         return self._chunk_scan(params, cache_k, cache_v, tokens, pos, active,
-                                temp, topp, seeds, table, sampling=sampling)
+                                temp, topp, seeds, table, poison=poison,
+                                sampling=sampling, graceful=graceful)
 
     def _prefill_impl_paged(self, params, ids, cache_k, cache_v, table_row,
                             length, bucket):
@@ -724,7 +857,7 @@ class ContinuousBatchingEngine:
 
     def _verify_impl_paged(self, params, cache_k, cache_v, tokens, pos,
                            active, q_lens, temp, topp, seeds, table,
-                           sampling=False):
+                           poison=None, sampling=False, graceful=False):
         """Verify + accept in ONE compiled program.  Row t's logits condition
         on draft tokens <= t; the emitted token for position pos+t+1 is drawn
         with the SAME (seed, pos+t)-derived key ``_sample_tokens`` would use
@@ -738,6 +871,23 @@ class ContinuousBatchingEngine:
         logits, ck, cv = self._verify_one(params, cache_k, cache_v, tokens,
                                           pos, active, q_lens, table)
         Q = tokens.shape[1]
+        if graceful:
+            # per-slot guard over the LIVE rows only (rows past q_lens are
+            # computed from garbage positions and may be legitimately
+            # non-finite); a flagged slot's whole verify output is discarded
+            # by the host, so one [B] flag per slot suffices
+            if poison is None:
+                poison = jnp.zeros_like(active)
+            # poison bit FIRST, as a genuinely NaN row (same contract as
+            # _guard_logits): injection exercises the finiteness check a
+            # real numerical blowup hits — never a parallel flag-only path
+            row = jnp.where(poison, jnp.float32(jnp.nan), jnp.float32(0.0))
+            logits = logits + row[:, None, None].astype(logits.dtype)
+            live = jnp.arange(Q)[None, :] < q_lens[:, None]
+            rowbad = (~jnp.isfinite(logits).all(axis=-1)) & live
+            bad = active & rowbad.any(axis=-1)
+            logits = jnp.where(bad[:, None, None], jnp.zeros_like(logits),
+                               logits)
         if sampling:
             pos_t = pos[:, None] + jnp.arange(Q)[None, :]
             out = jax.vmap(
@@ -752,6 +902,10 @@ class ContinuousBatchingEngine:
         ok = ((tokens[:, 1:] == out[:, :-1])
               & (jnp.arange(1, Q)[None, :] < q_lens[:, None]))
         n_emitted = 1 + jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+        if graceful:
+            # the guard flags ride back with the step's tokens — no extra
+            # device fetch; the host quarantines flagged slots
+            return out, n_emitted.astype(jnp.int32), bad, ck, cv
         return out, n_emitted.astype(jnp.int32), ck, cv
 
     # -------- unified mixed prefill/decode step (compiled program) --------
@@ -826,7 +980,7 @@ class ContinuousBatchingEngine:
 
     def _mixed_impl_paged(self, params, cache_k, cache_v, tokens, pos,
                           active, q_lens, temp, topp, seeds, table,
-                          sampling=False):
+                          poison=None, sampling=False, graceful=False):
         """Mixed step + emit in ONE compiled program.  The emitted token for
         slot b is drawn from its emit row's logits with the SAME
         (seed, pos + q_lens - 1)-derived key ``_sample_tokens`` uses in the
@@ -839,11 +993,21 @@ class ContinuousBatchingEngine:
         lane's token only when it decoded or finished its prompt."""
         logits, ck, cv = self._mixed_one(params, cache_k, cache_v, tokens,
                                          pos, active, q_lens, table)
+        if graceful:
+            # the emit row is each slot's ONLY row through the lm_head: a
+            # non-finite emit (numerical blowup or the nan_logits poison
+            # bit) flags the slot; the host quarantines the request instead
+            # of banking garbage.  One [B] flag, fetched with the tokens.
+            if poison is None:
+                poison = jnp.zeros_like(active)
+            logits, bad = self._guard_logits(logits, active, poison)
         if sampling:
             nxt = self._sample_tokens(logits, pos + q_lens - 1, temp, topp,
                                       seeds)
         else:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if graceful:
+            return nxt, bad, ck, cv
         return nxt, ck, cv
 
     # ---------------- block allocator (host control plane) ----------------
@@ -858,6 +1022,14 @@ class ContinuousBatchingEngine:
         resident hot prefixes are sacrificed last, never proactively."""
         owned = self._slot_blocks[slot]
         base = len(self._slot_shared[slot])
+        if (base + len(owned) < n_blocks and self._faults
+                and self._faults.fire("alloc_fail", step=self._step_no,
+                                      slot=slot)):
+            # allocator seam (faults.py): report the pool dry even though
+            # pages may be free — drives the overload ladder adversarially
+            # without needing a genuinely tiny pool.  Polled only when a
+            # real grab would happen, so no-op calls never consume firings.
+            return False
         while base + len(owned) < n_blocks:
             if not self._free and not self._reclaim(1):
                 return False
@@ -902,6 +1074,16 @@ class ContinuousBatchingEngine:
         n_shared = len(self._slot_shared[slot])
         limit = valid_len // bs_            # blocks fully written by prefill
         if limit <= n_shared:
+            return
+        if self._faults and self._faults.fire("cache_error",
+                                              step=self._step_no, slot=slot):
+            # prefix-cache seam (faults.py): a registration fault degrades
+            # — the blocks stay private (a future request misses where it
+            # could have hit) and NO request fails; graceful-off restores
+            # the raise-out-of-step behavior
+            if not self._graceful:
+                raise FaultInjected(f"injected cache_error (step "
+                                    f"{self._step_no}, slot {slot})")
             return
         # continue the chain from the mapped shared prefix — each new block
         # is hashed exactly once (inside register), nothing is re-hashed
@@ -985,8 +1167,13 @@ class ContinuousBatchingEngine:
             # uncached token, not the prompt's head
             self._prefill_ids[slot] = None
             self._prefilled[slot] = 0
+        req.status = "PENDING"   # back in the queue; re-seated by _admit
         self._queue.insert(0, req)
         self.stats["preemptions"] += 1
+        if self._graceful:
+            # every preemption is pool-pressure-driven, so in graceful mode
+            # it IS ladder rung 4 (rungs 1-3 already ran and left a deficit)
+            self.stats["degrade_preempt"] += 1
 
     def _ensure_growth(self, k):
         """Before a decode chunk: every active slot needs pages covering
@@ -1008,9 +1195,27 @@ class ContinuousBatchingEngine:
                 victims = [s for s in range(self.max_batch)
                            if s != slot and self._slot_req[s] is not None]
                 if not victims:
-                    raise RuntimeError(
-                        "KV block pool exhausted by a single request; "
-                        "increase num_blocks")
+                    req = self._slot_req[slot]
+                    have = (len(self._slot_shared[slot])
+                            + len(self._slot_blocks[slot]))
+                    pinned = (self._pcache.resident_blocks()
+                              - self._pcache.evictable_count()
+                              if self._pcache is not None else 0)
+                    msg = (f"KV block pool exhausted by a single request: "
+                           f"rid={req.rid} needs {need} block(s) to cover "
+                           f"position {int(self._pos[slot]) + int(karr[slot]) - 1} "
+                           f"({have} mapped, {len(self._free)} free, "
+                           f"{self._evictable()} evictable cached, {pinned} "
+                           f"pinned cached, {self.num_blocks} total); "
+                           f"increase num_blocks")
+                    if self._graceful:
+                        # ladder rung 5 (docs/fault_tolerance.md): eviction,
+                        # degradation and preemption are all exhausted —
+                        # fail ONLY the unsatisfiable request.  Its pages
+                        # free immediately; survivors never see the fault.
+                        self._fail_slot(slot, "FAILED", msg, donate=True)
+                        break
+                    raise RuntimeError(msg)
                 self._preempt(max(victims, key=lambda s: self._slot_age[s]))
 
     # ---------------- scheduler ----------------
@@ -1023,14 +1228,39 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"request {req.rid}: prompt length {ids.size} exceeds "
                 f"max_seq-1 = {self.max_seq - 1}")
-        if (req.temperature or 0.0) < 0:  # None -> greedy
-            raise ValueError(f"request {req.rid}: temperature must be >= 0")
-        if not 0 < (req.top_p if req.top_p is not None else 1.0) <= 1:
-            raise ValueError(f"request {req.rid}: top_p must be in (0, 1]")
+        temp = req.temperature if req.temperature is not None else 0.0
+        # math.isfinite, not just `< 0`: temperature=NaN satisfies neither
+        # comparison and would sail into the compiled sampler as a per-slot
+        # DATA value, poisoning that slot's logits scaling
+        if not math.isfinite(temp) or temp < 0:
+            raise ValueError(f"request {req.rid}: temperature must be "
+                             f"finite and >= 0, got {temp!r}")
+        topp = req.top_p if req.top_p is not None else 1.0
+        if not (math.isfinite(topp) and 0 < topp <= 1):
+            raise ValueError(f"request {req.rid}: top_p must be finite and "
+                             f"in (0, 1], got {topp!r}")
+        if (req.deadline_s is not None
+                and not (math.isfinite(req.deadline_s)
+                         and req.deadline_s >= 0)):
+            raise ValueError(f"request {req.rid}: deadline_s must be finite "
+                             f"and >= 0, got {req.deadline_s!r}")
 
     def add_request(self, req: Request):
         self._validate(req)
         req._submit_s = time.perf_counter()  # TTFT epoch (bench rung detail)
+        self._reqs[req.rid] = req
+        if (self.max_queue is not None
+                and len(self._queue) >= self.max_queue):
+            # bounded-queue backpressure: shedding load at admission keeps
+            # the accepted requests' SLOs intact (preemption re-inserts
+            # bypass add_request — accepted work is never rejected)
+            msg = (f"queue full ({len(self._queue)} waiting, "
+                   f"max_queue={self.max_queue})")
+            if not self._graceful:
+                raise RuntimeError(f"request {req.rid}: {msg}")
+            with RecordEvent("serving/rejected"):
+                self._terminal(req, "REJECTED", msg)
+            return
         self._queue.append(req)
 
     def _admit(self):
@@ -1089,6 +1319,19 @@ class ContinuousBatchingEngine:
                 for i, e in enumerate(matched[:n_map]):
                     self._table[slot, i] = e.page
                     self._slot_shared[slot].append(e.hash)
+                if self._chunked and self._graceful:
+                    # chunk-granular allocation (docs/fault_tolerance.md):
+                    # a streaming prompt owns pages only as its cursor
+                    # advances — _mixed_step's _ensure_growth allocates
+                    # each chunk's pages, so ladder rung 3 can relieve
+                    # pool pressure by shrinking the chunk instead of
+                    # preempting.  Only the COW duplicate must exist at
+                    # admission (its content is copied here).  Admission
+                    # still gates on full-prompt fit (avail check below),
+                    # so the common case admits at the same step it
+                    # always did; graceful-off keeps the pre-PR upfront
+                    # allocation byte-identically.
+                    need = m if cow else n_map
                 avail = len(self._free) + self._evictable()
                 if (avail < gate - n_map + headroom
                         or not self._alloc_to(slot, need)):
@@ -1178,6 +1421,7 @@ class ContinuousBatchingEngine:
                 # (the chunked path registers as each chunk completes them)
                 self._register_prefix_blocks(slot, ids, s0 - 1)
             self._slot_req[slot] = req
+            req.status = "RUNNING"
             if self._chunked:
                 # the prefill cursor IS the position state: pos/_written
                 # advance with each chunk, so preemption's trusted-content
@@ -1202,7 +1446,7 @@ class ContinuousBatchingEngine:
                 req.seed if req.seed is not None else req.rid)
 
     def _retire(self, slot):
-        self._slot_req[slot].finished = True
+        self._terminal(self._slot_req[slot], "FINISHED")
         if self.paged:
             self._register_retired_blocks(slot)  # needs the request's tokens
         self._slot_req[slot] = None
@@ -1213,6 +1457,266 @@ class ContinuousBatchingEngine:
             self._prefilled[slot] = 0
         if self.paged:
             self._release(slot)
+
+    # ---------------- fault tolerance (docs/fault_tolerance.md) ------------
+
+    def _terminal(self, req: Request, status: str, error: str | None = None):
+        """Move a request to its terminal status (status lifecycle:
+        PENDING -> RUNNING -> terminal, exactly one terminal transition).
+        ``finished`` stays the caller-facing "no more tokens coming" flag
+        for every terminal status; ``status`` says why."""
+        req.status = status
+        req.finished = True
+        if error is not None:
+            req.error = error
+        stat = _STATUS_STAT.get(status)
+        if stat is not None:
+            self.stats[stat] += 1
+        # the journal only tracks LIVE requests: a terminal entry would
+        # leak one Request per rid forever in a long-lived engine (the
+        # caller keeps its own reference; cancel() on a terminal rid
+        # correctly reports False via the journal miss)
+        self._reqs.pop(req.rid, None)
+
+    def _fail_slot(self, slot: int, status: str, error: str,
+                   donate: bool = False):
+        """Terminate the request seated on ``slot`` with a non-FINISHED
+        terminal status, releasing every page and cache ref it owns (the
+        auditor's I8).  ``donate=True`` (cancel / expiry / overload — the
+        slot's K/V content is trusted) content-addresses full blocks into
+        the prefix cache first, exactly like retirement; ``donate=False``
+        (NaN quarantine and other fault paths) drops the pages without
+        registering them — a fault step's K/V writes must never be served
+        to a future request.  Partial output already banked stays on the
+        request (EXPIRED/CANCELLED deliver what they have)."""
+        req = self._slot_req[slot]
+        with RecordEvent(f"serving/{status.lower()}"):
+            if donate and self.paged:
+                self._register_retired_blocks(slot)
+            self._slot_req[slot] = None
+            self._written[slot] = 0
+            self._temp[slot] = 0.0
+            self._poison[slot] = False
+            if self._chunked:
+                self._prefill_ids[slot] = None
+                self._prefilled[slot] = 0
+            if self.paged:
+                self._release(slot)
+            self._terminal(req, status, error)
+
+    def _host_fault(self, kind: str, slot: int | None = None,
+                    rid: int | None = None):
+        """Poll one host-side injection seam; raises :class:`FaultInjected`
+        when a plan clause fires (no-op without a plan)."""
+        if self._faults and self._faults.fire(kind, step=self._step_no,
+                                              slot=slot, rid=rid):
+            where = "".join((f", slot {slot}" if slot is not None else "",
+                             f", rid {rid}" if rid is not None else ""))
+            raise FaultInjected(
+                f"injected {kind} (step {self._step_no}{where})")
+
+    def _arm_poison(self):
+        """Sampler seam: set per-slot poison bits for ``nan_logits`` clauses
+        firing this step.  The bits are DATA to the compiled step, where
+        they turn the slot's logits row genuinely non-finite IN-GRAPH — the
+        guard proves itself against the real failure shape.  Graceful-off
+        the compiled program has no poison operand (byte-identical to the
+        pre-fault-tolerance engine), so the kind is inert there."""
+        if not (self._graceful and self._faults):
+            return
+        for s in range(self.max_batch):
+            req = self._slot_req[s]
+            if req is not None and self._faults.fire(
+                    "nan_logits", step=self._step_no, slot=s, rid=req.rid):
+                self._poison[s] = True
+
+    def _retry_launch(self, err: FaultInjected) -> bool:
+        """Graceful handling of a kernel-dispatch fault: the raise happened
+        BEFORE the compiled call, so host and device state (including the
+        donated cache buffers) are untouched and the step can simply run
+        again.  A persistent failure (streak past the limit) means the
+        program itself cannot run — re-raise rather than spin."""
+        if not self._graceful:
+            raise err
+        self._kernel_err_streak += 1
+        self.stats["kernel_error_retries"] += 1
+        if self._kernel_err_streak > self._kernel_err_limit:
+            raise err
+        with RecordEvent("serving/kernel_error_retry"):
+            pass
+        return True    # state untouched: the next step() retries
+
+    def _growth_need(self, growth) -> int:
+        """Block-pool pressure probe: pages the active slots' imminent
+        growth needs beyond what they already own (``growth`` may be a
+        per-slot vector, matching ``_ensure_growth``)."""
+        karr = np.broadcast_to(np.asarray(growth, np.int64),
+                               (self.max_batch,))
+        need = 0
+        for s in range(self.max_batch):
+            if self._slot_req[s] is None or karr[s] <= 0:
+                continue
+            need += max(0, self._blocks_needed(int(self._pos[s])
+                                               + int(karr[s]) - 1)
+                        - len(self._slot_shared[s])
+                        - len(self._slot_blocks[s]))
+        return need
+
+    def _degrade_reclaim(self, growth) -> int:
+        """Ladder rung 1: on pool pressure, proactively evict prefix-cache
+        leaves into the free list (oldest zero-ref first — the same
+        LRU order allocation-pressure eviction uses, just ahead of the
+        allocator instead of inside it, so the rung is observable and
+        strictly ordered before rungs 2-5).  Returns the deficit that
+        REMAINS after eviction; <= 0 means the step fits."""
+        need = self._growth_need(growth)
+        short = need - len(self._free)
+        if short > 0 and self._evictable() > 0:
+            with RecordEvent("serving/degrade_evict"):
+                if self._reclaim(short) > 0:
+                    self.stats["degrade_evict"] += 1
+        return need - len(self._free)
+
+    def _expire_overdue(self):
+        """Deadline enforcement (graceful mode): a request past its
+        ``deadline_s`` wall-clock budget (from submission) terminates
+        EXPIRED with whatever partial output it has, freeing its pages for
+        requests that can still meet their SLO.  Queued and running
+        requests expire alike — a queued request that can no longer finish
+        in time should not consume a slot at all."""
+        now = time.perf_counter()
+
+        def overdue(req):
+            return (req.deadline_s is not None
+                    and now - getattr(req, "_submit_s", now) > req.deadline_s)
+
+        for s in range(self.max_batch):
+            req = self._slot_req[s]
+            if req is not None and overdue(req):
+                self._fail_slot(s, "EXPIRED",
+                                f"deadline_s={req.deadline_s} exceeded "
+                                f"({len(req.output_ids)} token(s) delivered)",
+                                donate=True)
+        if any(overdue(r) for r in self._queue):
+            keep = []
+            for req in self._queue:
+                if overdue(req):
+                    with RecordEvent("serving/expired"):
+                        self._terminal(req, "EXPIRED",
+                                       f"deadline_s={req.deadline_s} "
+                                       f"exceeded while queued")
+                else:
+                    keep.append(req)
+            self._queue = keep
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a request by id: queued requests leave the queue, a
+        running request frees its slot (even mid-prefill — the chunked
+        cursor's pages release like any preemption, and full blocks donate
+        to the prefix cache so a re-submission resumes cheaply).  Partial
+        output stays on the request.  Returns True when the request was
+        still live (False: unknown rid or already terminal).  Requires
+        graceful mode — the PADDLE_TPU_GRACEFUL=0 engine predates the
+        status lifecycle."""
+        if not self._graceful:
+            raise RuntimeError("cancel() requires PADDLE_TPU_GRACEFUL=1")
+        req = self._reqs.get(rid)
+        if req is None or req.status in TERMINAL_STATUSES:
+            return False
+        for s in range(self.max_batch):
+            if self._slot_req[s] is req:
+                self._fail_slot(s, "CANCELLED", "cancelled by caller",
+                                donate=True)
+                return True
+        with RecordEvent("serving/cancelled"):
+            # identity scan, not `in`/`remove`: the dataclass __eq__
+            # compares numpy prompt_ids and would raise on same-shape twins
+            for i, q in enumerate(self._queue):
+                if q is req:
+                    del self._queue[i]
+                    break
+            self._terminal(req, "CANCELLED", "cancelled by caller")
+        return True
+
+    def snapshot(self) -> dict:
+        """Serialize accepted-but-unfinished work: queue order plus a
+        per-request journal (prompt, emitted tokens, sampling params,
+        chunked-prefill cursor).  JSON-serializable, device-free — the
+        KV pool is deliberately NOT captured: :meth:`restore` resumes by
+        teacher-forced recompute (the preemption path), which is exact for
+        greedy AND seeded sampling, so a snapshot costs bytes proportional
+        to the token streams, not the HBM pool.  The replica-restart
+        primitive the fleet tier needs (ROADMAP item 2)."""
+
+        def journal(req, prefilled=0):
+            return {
+                "rid": int(req.rid),
+                "prompt_ids": np.asarray(req.prompt_ids,
+                                         np.int32).ravel().tolist(),
+                "output_ids": [int(t) for t in req.output_ids],
+                "max_new_tokens": int(req.max_new_tokens),
+                "eos_token_id": (None if req.eos_token_id is None
+                                 else int(req.eos_token_id)),
+                "temperature": float(req.temperature or 0.0),
+                "top_p": float(1.0 if req.top_p is None else req.top_p),
+                "seed": None if req.seed is None else int(req.seed),
+                "deadline_s": (None if req.deadline_s is None
+                               else float(req.deadline_s)),
+                # the chunk cursor: restore re-prefills from the first
+                # uncached token, so this is provenance (how far the dead
+                # replica got), not a resume offset into lost KV bytes
+                "prefilled": int(prefilled),
+            }
+
+        with RecordEvent("serving/snapshot"):
+            running = [s for s in range(self.max_batch)
+                       if self._slot_req[s] is not None]
+            if self.paged:
+                running.sort(key=lambda s: int(self._slot_age[s]))
+            return {
+                "version": 1,
+                "running": [journal(self._slot_req[s],
+                                    self._prefilled[s] if self._chunked
+                                    else 0)
+                            for s in running],
+                "queued": [journal(r) for r in self._queue],
+            }
+
+    def restore(self, snap: dict) -> list[Request]:
+        """Resume a :meth:`snapshot` on THIS engine (typically a fresh
+        replica after a crash/restart).  Every journaled request re-enters
+        the queue through the preemption-resume path: prompt + already-
+        emitted tokens are teacher-forced by (chunked) prefill recompute,
+        then position-derived sampling keys continue the stream exactly —
+        a serve completed after restore() emits token-identical output to
+        one that was never interrupted.  Deadlines restart from restore
+        time (the dead replica's clock is gone).  Returns the resumed
+        Request objects (in admission order: running work first)."""
+        if snap.get("version") != 1:
+            raise ValueError(f"unknown snapshot version "
+                             f"{snap.get('version')!r} (expected 1)")
+        with RecordEvent("serving/restore"):
+            out: list[Request] = []
+            for j in snap["running"] + snap["queued"]:
+                req = Request(
+                    rid=j["rid"],
+                    prompt_ids=np.asarray(j["prompt_ids"], np.int32),
+                    max_new_tokens=j["max_new_tokens"],
+                    eos_token_id=j["eos_token_id"],
+                    temperature=j["temperature"], top_p=j["top_p"],
+                    seed=j["seed"], deadline_s=j["deadline_s"])
+                req.output_ids = list(j["output_ids"])
+                if req.output_ids:
+                    # the preempt-resume contract: stored tokens are
+                    # teacher-forced, the continuation redraws exactly
+                    req._resume_ids = np.concatenate(
+                        [np.asarray(req.prompt_ids, np.int32).ravel(),
+                         np.asarray(req.output_ids, np.int32)])
+                req._submit_s = time.perf_counter()
+                self._reqs[req.rid] = req
+                self._queue.append(req)
+                out.append(req)
+            return out
 
     def _maybe_audit(self):
         if self._audit_every_step:
@@ -1225,8 +1729,42 @@ class ContinuousBatchingEngine:
         speculation on and at least one slot drafting, a single multi-token
         verify step; with chunked prefill on and at least one prompt still
         streaming, a single unified mixed prefill/decode step).  Returns
-        False when idle."""
+        False when idle.
+
+        Graceful mode: no per-request fault escapes this method — the
+        offending request terminates (pages and cache refs released) and
+        every survivor's token stream is identical to a run that never
+        contained it (each slot's stream depends only on its own
+        (seed, position) keys and its own pages)."""
+        self._step_no += 1          # fault-plan step key (1-based)
+        if self._graceful:
+            self._expire_overdue()
         self._admit()
+        if (self._graceful and self.paged and self._queue
+                and all(r is None for r in self._slot_req)):
+            # admission made no progress with NOTHING resident: no future
+            # step can free pages (zero-ref cache leaves were already fair
+            # game inside _alloc_to), so waiting is a livelock.  Tolerate a
+            # few consecutive stuck steps (a transient injected alloc fault
+            # clears), then fail the head request — ladder rung 5 applied
+            # at admission.
+            self._admit_stalls += 1
+            if self._admit_stalls > self._kernel_err_limit:
+                req = self._queue.pop(0)
+                ids = getattr(req, "_resume_ids", None)
+                s0 = (np.asarray(req.prompt_ids, np.int32).ravel().size
+                      if ids is None else ids.size)
+                with RecordEvent("serving/failed"):
+                    self._terminal(
+                        req, "FAILED",
+                        f"pool exhausted at admission: rid={req.rid} needs "
+                        f"{self._blocks_needed(s0 - 1)} block(s) for its "
+                        f"{s0}-token stream, {len(self._free)} free + "
+                        f"{self._evictable()} evictable of "
+                        f"{self.num_blocks} total")
+                self._admit_stalls = 0
+        else:
+            self._admit_stalls = 0
         self._maybe_audit()
         if self._chunked and any(i is not None for i in self._prefill_ids):
             # at least one prompt is streaming in: ONE mixed launch advances
@@ -1237,12 +1775,28 @@ class ContinuousBatchingEngine:
             return self._mixed_step()
         if self._spec is not None:
             drafts = self._draft_proposals()
+            if drafts is not None and self._graceful and self.paged:
+                qlens = np.ones(self.max_batch, np.int64)
+                for s, d in drafts.items():
+                    qlens[s] = 1 + d.size
+                if self._degrade_reclaim(qlens) > 0:
+                    # ladder rung 2: this step's speculative appends do not
+                    # fit even after rung 1's eviction — suspend speculation
+                    # for the step (growth drops to one token per slot)
+                    # before anyone is preempted.  Token streams are
+                    # unaffected: speculation only changes how many tokens
+                    # each round-trip banks, never which ones.
+                    with RecordEvent("serving/degrade_spec_off"):
+                        self.stats["degrade_spec_off"] += 1
+                    drafts = None
             if drafts is not None:
                 return self._spec_step(drafts)
             # no slot drafted: fall through to the ordinary decode path —
             # a drafter miss must cost nothing (same step shape as spec-off)
         k = self.chunk
         if self.paged:
+            if self._graceful:
+                self._degrade_reclaim(k)    # ladder rung 1 before rung 4
             self._ensure_growth(k)  # may preempt the youngest slot
         active_np = np.asarray([r is not None for r in self._slot_req])
         if not active_np.any():
@@ -1252,11 +1806,27 @@ class ContinuousBatchingEngine:
         # greedy-only resident set takes the sampler-free compiled variant
         any_sampled = bool((self._temp * active_np).max() > 0)
         decode = self._decode_sampling if any_sampled else self._decode_greedy
-        toks, self.cache_k, self.cache_v = decode(
-            self.params, self.cache_k, self.cache_v,
-            jnp.asarray(self._last_tok), jnp.asarray(self._pos),
-            jnp.asarray(active_np), jnp.asarray(self._temp),
-            jnp.asarray(self._topp), jnp.asarray(self._seed), *extra)
+        self._arm_poison()
+        try:
+            self._host_fault("kernel_error")   # dispatch seam: pre-launch
+            if self._graceful:
+                toks, bad, self.cache_k, self.cache_v = decode(
+                    self.params, self.cache_k, self.cache_v,
+                    jnp.asarray(self._last_tok), jnp.asarray(self._pos),
+                    jnp.asarray(active_np), jnp.asarray(self._temp),
+                    jnp.asarray(self._topp), jnp.asarray(self._seed),
+                    *extra, poison=jnp.asarray(self._poison))
+                bad_np = np.asarray(bad)    # [k, B] guard flags
+            else:
+                toks, self.cache_k, self.cache_v = decode(
+                    self.params, self.cache_k, self.cache_v,
+                    jnp.asarray(self._last_tok), jnp.asarray(self._pos),
+                    jnp.asarray(active_np), jnp.asarray(self._temp),
+                    jnp.asarray(self._topp), jnp.asarray(self._seed), *extra)
+        except FaultInjected as e:
+            return self._retry_launch(e)
+        self._kernel_err_streak = 0
+        self._poison[:] = False
         toks_np = np.asarray(toks)  # [k, B] — ONE host round-trip per chunk
         self.stats["decode_time_s"] += time.perf_counter() - t0
         self.stats["decode_steps"] += k
@@ -1269,23 +1839,45 @@ class ContinuousBatchingEngine:
             # chunk steps are trustworthy
             valid = min(k, self.max_seq - old_pos)
             done = False
-            for j in range(valid):
-                tok = int(toks_np[j, slot])
-                req.output_ids.append(tok)
-                if req.ttft_s is None:
-                    # time-to-first-token: the cached-prefix admission's
-                    # headline win (prefill skipped, decode starts sooner)
-                    req.ttft_s = (time.perf_counter()
-                                  - getattr(req, "_submit_s", t0))
-                # count only tokens a caller actually receives: chunk steps
-                # past EOS / the token budget / max_seq are trimmed here, so
-                # they must not inflate decode_tokens_per_s (the headline)
-                self.stats["decode_tokens"] += 1
-                if (len(req.output_ids) >= req.max_new_tokens
-                        or (req.eos_token_id is not None
-                            and tok == req.eos_token_id)):
-                    done = True
-                    break
+            fail_err = None
+            try:
+                self._host_fault("slot_error", slot=slot, rid=req.rid)
+            except FaultInjected as e:
+                if not self._graceful:
+                    raise
+                fail_err = str(e)
+            if fail_err is None:
+                for j in range(valid):
+                    if self._graceful and bad_np[j, slot]:
+                        # quarantine: tokens from the poisoned scan step on
+                        # are sampled from a zeroed row — never banked
+                        self.stats["nan_guard_trips"] += 1
+                        fail_err = (f"non-finite logits at position "
+                                    f"{old_pos + j} (in-graph guard)")
+                        break
+                    tok = int(toks_np[j, slot])
+                    req.output_ids.append(tok)
+                    if req.ttft_s is None:
+                        # time-to-first-token: the cached-prefix admission's
+                        # headline win (prefill skipped, decode starts
+                        # sooner)
+                        req.ttft_s = (time.perf_counter()
+                                      - getattr(req, "_submit_s", t0))
+                    # count only tokens a caller actually receives: chunk
+                    # steps past EOS / the token budget / max_seq are
+                    # trimmed here, so they must not inflate
+                    # decode_tokens_per_s (the headline)
+                    self.stats["decode_tokens"] += 1
+                    if (len(req.output_ids) >= req.max_new_tokens
+                            or (req.eos_token_id is not None
+                                and tok == req.eos_token_id)):
+                        done = True
+                        break
+            if fail_err is not None:
+                # per-request isolation: fail THIS slot, free its pages;
+                # the other lanes' tokens (already fetched) bank normally
+                self._fail_slot(slot, "FAILED", fail_err, donate=False)
+                continue
             self._pos[slot] = old_pos + k  # device advanced k regardless
             # maximum, not overwrite: a prior verify step's rejected drafts
             # may have written past old_pos+k, and the high-water mark must
@@ -1346,6 +1938,24 @@ class ContinuousBatchingEngine:
             active[s] = True
             growth[s] = n
             chunk_rows[s] = n
+        if self._graceful and self._degrade_reclaim(growth) > 0:
+            # ladder rungs 1 + 3: the step's FULL growth (decode lanes'
+            # one-token appends + every packed prefill chunk) must fit —
+            # _degrade_reclaim already evicted cache leaves (rung 1); if
+            # still short, shrink this step's prefill rows to the 1-token
+            # floor (prompts crawl, decode never stalls, nobody is
+            # preempted for a prompt that could simply wait).  Only when
+            # even the floor-packed step does not fit does _ensure_growth
+            # below preempt (rung 4).
+            shrinkable = [s for s, n in chunk_rows.items() if n > 1]
+            if shrinkable:
+                with RecordEvent("serving/degrade_budget_shrink"):
+                    self.stats["degrade_budget_shrink"] += 1
+                for s in shrinkable:
+                    tokens[s, 1:] = 0
+                    q_lens[s] = 1
+                    growth[s] = 1
+                    chunk_rows[s] = 1
         # the auditor's I7 cross-checks the packing stayed disjoint
         self._last_pack = (tuple(decode_slots), tuple(sorted(chunk_rows)))
         self._ensure_growth(growth)  # may preempt the youngest slot
@@ -1358,11 +1968,29 @@ class ContinuousBatchingEngine:
         t0 = time.perf_counter()
         any_sampled = bool((self._temp * active).max() > 0)
         mixed = self._mixed_sampling if any_sampled else self._mixed_greedy
-        nxt, self.cache_k, self.cache_v = mixed(
-            self.params, self.cache_k, self.cache_v, jnp.asarray(tokens),
-            jnp.asarray(pos), jnp.asarray(active), jnp.asarray(q_lens),
-            jnp.asarray(self._temp), jnp.asarray(self._topp),
-            jnp.asarray(self._seed), jnp.asarray(self._table))
+        self._arm_poison()
+        try:
+            self._host_fault("kernel_error")   # dispatch seam: pre-launch
+            if self._graceful:
+                nxt, bad, self.cache_k, self.cache_v = mixed(
+                    self.params, self.cache_k, self.cache_v,
+                    jnp.asarray(tokens), jnp.asarray(pos),
+                    jnp.asarray(active), jnp.asarray(q_lens),
+                    jnp.asarray(self._temp), jnp.asarray(self._topp),
+                    jnp.asarray(self._seed), jnp.asarray(self._table),
+                    poison=jnp.asarray(self._poison))
+                bad_np = np.asarray(bad)    # [B] emit-row guard flags
+            else:
+                nxt, self.cache_k, self.cache_v = mixed(
+                    self.params, self.cache_k, self.cache_v,
+                    jnp.asarray(tokens), jnp.asarray(pos),
+                    jnp.asarray(active), jnp.asarray(q_lens),
+                    jnp.asarray(self._temp), jnp.asarray(self._topp),
+                    jnp.asarray(self._seed), jnp.asarray(self._table))
+        except FaultInjected as e:
+            return self._retry_launch(e)
+        self._kernel_err_streak = 0
+        self._poison[:] = False
         nxt_np = np.asarray(nxt)   # [B] — ONE host round-trip for the step
         self.stats["decode_time_s"] += time.perf_counter() - t0
         self.stats["decode_steps"] += 1
@@ -1372,6 +2000,20 @@ class ContinuousBatchingEngine:
             req = self._slot_req[s]
             if req is None:
                 continue            # preempted by _ensure_growth
+            if self._graceful and bad_np[s]:
+                self.stats["nan_guard_trips"] += 1
+                self._fail_slot(s, "FAILED",
+                                f"non-finite logits at position "
+                                f"{int(self._pos[s])} (in-graph guard)",
+                                donate=False)
+                continue
+            try:
+                self._host_fault("slot_error", slot=s, rid=req.rid)
+            except FaultInjected as e:
+                if not self._graceful:
+                    raise
+                self._fail_slot(s, "FAILED", str(e), donate=False)
+                continue
             old_pos = int(self._pos[s])
             self._pos[s] = old_pos + 1
             self._written[s] = max(int(self._written[s]),
@@ -1384,6 +2026,16 @@ class ContinuousBatchingEngine:
             req = self._slot_req[s]
             if req is None:
                 continue            # preempted after packing
+            if self._graceful and bad_np[s]:
+                # a poisoned prefill lane: the forward pass that computed
+                # this chunk's K/V is not trusted — quarantine the request
+                # before any of its progress (or blocks) is banked
+                self.stats["nan_guard_trips"] += 1
+                self._fail_slot(s, "FAILED",
+                                f"non-finite logits while prefilling "
+                                f"(cursor {int(self._prefilled[s])}; "
+                                f"in-graph guard)", donate=False)
+                continue
             ids = self._prefill_ids[s]
             new_cur = int(self._prefilled[s]) + n
             self._prefilled[s] = new_cur
@@ -1486,12 +2138,29 @@ class ContinuousBatchingEngine:
         t0 = time.perf_counter()
         any_sampled = bool((self._temp * active_np).max() > 0)
         verify = self._verify_sampling if any_sampled else self._verify_greedy
-        out, n_acc, self.cache_k, self.cache_v = verify(
-            self.params, self.cache_k, self.cache_v, jnp.asarray(tokens),
-            jnp.asarray(self._pos), jnp.asarray(active_np),
-            jnp.asarray(q_lens), jnp.asarray(self._temp),
-            jnp.asarray(self._topp), jnp.asarray(self._seed),
-            jnp.asarray(self._table))
+        self._arm_poison()
+        try:
+            self._host_fault("kernel_error")   # dispatch seam: pre-launch
+            if self._graceful:
+                out, n_acc, bad, self.cache_k, self.cache_v = verify(
+                    self.params, self.cache_k, self.cache_v,
+                    jnp.asarray(tokens), jnp.asarray(self._pos),
+                    jnp.asarray(active_np), jnp.asarray(q_lens),
+                    jnp.asarray(self._temp), jnp.asarray(self._topp),
+                    jnp.asarray(self._seed), jnp.asarray(self._table),
+                    poison=jnp.asarray(self._poison))
+                bad_np = np.asarray(bad)    # [B] per-slot guard flags
+            else:
+                out, n_acc, self.cache_k, self.cache_v = verify(
+                    self.params, self.cache_k, self.cache_v,
+                    jnp.asarray(tokens), jnp.asarray(self._pos),
+                    jnp.asarray(active_np), jnp.asarray(q_lens),
+                    jnp.asarray(self._temp), jnp.asarray(self._topp),
+                    jnp.asarray(self._seed), jnp.asarray(self._table))
+        except FaultInjected as e:
+            return self._retry_launch(e)
+        self._kernel_err_streak = 0
+        self._poison[:] = False
         out_np = np.asarray(out)
         n_np = np.asarray(n_acc)
         self.stats["decode_time_s"] += time.perf_counter() - t0
@@ -1501,6 +2170,22 @@ class ContinuousBatchingEngine:
             if req is None:
                 continue
             old_pos = int(self._pos[slot])
+            if self._graceful and bad_np[slot]:
+                # the whole verify output for this slot is discarded (its
+                # correction token came from a zeroed row); quarantine it
+                self.stats["nan_guard_trips"] += 1
+                self._fail_slot(slot, "FAILED",
+                                f"non-finite logits at position {old_pos} "
+                                f"(verify step; in-graph guard)",
+                                donate=False)
+                continue
+            try:
+                self._host_fault("slot_error", slot=slot, rid=req.rid)
+            except FaultInjected as e:
+                if not self._graceful:
+                    raise
+                self._fail_slot(slot, "FAILED", str(e), donate=False)
+                continue
             n = int(n_np[slot])        # 1..q_lens: accepted run + correction
             drafted = int(q_lens[slot]) - 1
             self.stats["spec_drafted_tokens"] += drafted
@@ -1540,11 +2225,27 @@ class ContinuousBatchingEngine:
         return self.stats["spec_accepted_tokens"] / d if d > 0 else 0.0
 
     def serve(self, requests: list[Request]) -> dict[int, list[int]]:
-        """Run all requests to completion; returns {rid: generated tokens}."""
-        for r in requests:
-            self._validate(r)  # all-or-nothing: no request enqueued if any is bad
-        for r in requests:
-            self.add_request(r)
+        """Run all requests to completion; returns {rid: generated tokens}.
+
+        Graceful mode (the default): an invalid request is marked
+        ``REJECTED`` (with ``error``) and the rest are served — one bad
+        sampling param must not zero a whole batch's goodput.  With
+        ``PADDLE_TPU_GRACEFUL=0`` validation is all-or-nothing: any bad
+        request raises before anything is enqueued (the pre-fault-tolerance
+        contract)."""
+        if self._graceful:
+            for r in requests:
+                try:
+                    self.add_request(r)
+                except ValueError as e:
+                    self._reqs[r.rid] = r
+                    with RecordEvent("serving/rejected"):
+                        self._terminal(r, "REJECTED", str(e))
+        else:
+            for r in requests:
+                self._validate(r)  # all-or-nothing: nothing enqueued if any is bad
+            for r in requests:
+                self.add_request(r)
         while self.step() or self._queue:
             pass
         return {r.rid: r.output_ids for r in requests}

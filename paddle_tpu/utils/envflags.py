@@ -24,9 +24,17 @@ through :func:`env_bool`, which enforces the '0'/'1' vocabulary):
   mixed prefill/decode step (docs/chunked_prefill.md); ``0`` forces it off
   even when the engine was constructed with ``enable_chunked_prefill=True``,
   reverting to the bucketed whole-prompt prefill path byte-for-byte.
+* ``PADDLE_TPU_GRACEFUL`` (default on) — fault-tolerant serving
+  (docs/fault_tolerance.md): per-request failure isolation, the overload
+  degradation ladder, the in-graph NaN/inf logit guard, and graceful
+  rejection in ``serve()``; ``0`` restores the pre-fault-tolerance engine
+  byte-identically (faults raise out of ``step()`` again).
 
 (``PADDLE_TPU_DISABLE_PALLAS`` is the token-set switch; its vocabulary lives
-with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``.)
+with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``.
+``PADDLE_TPU_FAULT_INJECT`` is the structured fault-injection plan; its
+clause grammar is validated by :func:`env_fault_spec` and its fault-kind
+vocabulary lives with the injector — inference/faults.py ``KNOWN_KINDS``.)
 """
 
 from __future__ import annotations
@@ -35,7 +43,7 @@ import difflib
 import os
 import warnings
 
-__all__ = ["env_token_set", "env_bool", "BOOL_FLAGS"]
+__all__ = ["env_token_set", "env_bool", "env_fault_spec", "BOOL_FLAGS"]
 
 #: '0'/'1' switches -> their library defaults (documentation + test anchor;
 #: callers still pass the default explicitly at the read site so a flag read
@@ -45,6 +53,7 @@ BOOL_FLAGS = {
     "PADDLE_TPU_ENGINE_AUDIT": False,
     "PADDLE_TPU_SPECULATE": True,
     "PADDLE_TPU_CHUNKED_PREFILL": True,
+    "PADDLE_TPU_GRACEFUL": True,
 }
 
 _warned: set[tuple[str, str]] = set()
@@ -93,3 +102,57 @@ def env_bool(name: str, default: bool) -> bool:
                f"{name}={raw!r} is not '0' or '1'; using the default "
                f"({'1' if default else '0'})")
     return default
+
+
+def env_fault_spec(name: str, known_kinds, known_keys) -> list[dict]:
+    """Parse a fault-injection plan: ``kind@key=val,key=val;kind@...``
+    (e.g. ``alloc_fail@step=7;nan_logits@slot=2,step=11``).  Returns one dict
+    per clause — ``{"kind": ..., <int-valued keys>}`` (``p`` parses as float).
+
+    A fault plan is an operator-facing chaos lever: an unknown kind, unknown
+    key, or malformed clause warns ONCE with a did-you-mean and returns []
+    — injection disabled, the engine serves normally.  Partial acceptance
+    would be worse than none: a typo'd clause silently skipped while its
+    siblings fire would make a chaos run's evidence unreadable."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return []
+
+    def _reject(msg: str) -> list[dict]:
+        _warn_once(name, raw, f"{name}={raw!r}: {msg}; fault injection "
+                              f"DISABLED (the engine serves normally)")
+        return []
+
+    out: list[dict] = []
+    for clause in raw.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        kind, sep, tail = clause.partition("@")
+        kind = kind.strip()
+        if kind not in known_kinds:
+            close = difflib.get_close_matches(kind, known_kinds, n=1,
+                                              cutoff=0.5)
+            hint = f" (did you mean {close[0]!r}?)" if close else ""
+            return _reject(f"unknown fault kind {kind!r}{hint}; known: "
+                           f"{sorted(known_kinds)}")
+        kv: dict = {"kind": kind}
+        for item in tail.split(",") if sep else []:
+            item = item.strip()
+            if not item:
+                continue
+            k, eq, v = item.partition("=")
+            k = k.strip()
+            if not eq or k not in known_keys:
+                close = difflib.get_close_matches(k, known_keys, n=1,
+                                                  cutoff=0.5)
+                hint = f" (did you mean {close[0]!r}?)" if close else ""
+                return _reject(f"bad clause key {k!r}{hint} in {clause!r}; "
+                               f"known: {sorted(known_keys)}")
+            try:
+                kv[k] = float(v) if k == "p" else int(v)
+            except ValueError:
+                return _reject(f"non-numeric value {v.strip()!r} for key "
+                               f"{k!r} in {clause!r}")
+        out.append(kv)
+    return out

@@ -209,16 +209,18 @@ def test_preempt_resume_mid_prefill():
                rs.randint(0, 128, (40,)).astype(np.int32)]
 
     def build():
-        return [Request(rid=0, prompt_ids=prompts[0], max_new_tokens=25),
+        return [Request(rid=0, prompt_ids=prompts[0], max_new_tokens=35),
                 Request(rid=1, prompt_ids=prompts[1], max_new_tokens=5)]
 
     ref = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
                                    chunk=1, paged=True, block_size=8,
                                    num_blocks=16).serve(build())
-    # pool of 8: slot 0 (1 page) + slot 1's prompt (5 pages) leave 2 free;
-    # slot 0's decode claims them at positions 8 and 16, then position 24
-    # evicts slot 1 — whose 40-token prompt at 1 budgeted row/step is still
-    # mid-stream at that point
+    # pool of 8 under chunk-granular allocation (graceful mode maps pages
+    # only up to the prefill cursor): slot 1's 40-token prompt streams at
+    # 1 budgeted row/step while slot 0 decodes toward position 40, so the
+    # combined demand — ceil((5+t)/8) decode + ceil(t/8) cursor — crosses
+    # the pool near t≈29 and evicts slot 1 while its prompt is still
+    # mid-stream
     ch = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
                                   chunk=1, paged=True, block_size=8,
                                   num_blocks=8, enable_chunked_prefill=True,

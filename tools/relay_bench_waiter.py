@@ -63,6 +63,12 @@ TARGETS = {
         "llama_cb_decode_tokens_per_sec/cb_chunked_prefill_mixed",
     "cb_chunked_prefill_off":
         "llama_cb_decode_tokens_per_sec/cb_chunked_prefill_off",
+    # round-10 evidence rung: fault-tolerant serving under overload —
+    # open-loop 2x-oversubscribed arrivals + injected allocator faults,
+    # headline = GOODPUT tokens/s over FINISHED requests, per-status counts
+    # and degradation-ladder trips in detail (docs/fault_tolerance.md)
+    "cb_overload_degrade":
+        "llama_cb_decode_tokens_per_sec/cb_overload_degrade",
 }
 
 
