@@ -420,7 +420,8 @@ def run_decode_rung(name, cfg, batch, prompt, new, max_seq):
 
 
 def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
-                quant=None, paged=False, ragged=False, paged_kernel=True):
+                quant=None, paged=False, ragged=False, paged_kernel=True,
+                tensor_parallel=1, block_size=64):
     """Continuous-batching throughput: staggered prompt lengths through the
     slot-pool scheduler (inference/serving.py), the serving pattern behind the
     reference's block_multihead_attention stack (fused_ops.yaml:45).
@@ -430,7 +431,14 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
     walk wins most over the gather-to-max path.  ``paged_kernel=False`` pins
     the paged rung to the gather oracle (PADDLE_TPU_DISABLE_PALLAS=
     paged_attention at trace time) so kernel/gather A-B pairs share one
-    rung family."""
+    rung family.  ``tensor_parallel`` (ISSUE 8, docs/tp_serving.md): shard
+    the SAME engine over a ("tp",) mesh — because the tp rungs run through
+    this one function, they consume the identical RandomState(0) warm/
+    request stream as their matched single-chip rung by construction, so
+    cb_tp2/cb_tp4 headline directly against cb_full_chunk8_paged_kernel;
+    detail then adds the TP cost model's one budget line, per-step
+    all-reduce bytes (2 psum boundaries x layers x slots x chunk rows x
+    hidden at the model dtype)."""
     import numpy as np
     import jax
 
@@ -438,8 +446,13 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
     from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
     from paddle_tpu.inference.serving import _bucket
 
+    if tensor_parallel > 1 and jax.device_count() < tensor_parallel:
+        raise RuntimeError(
+            f"{name}: tensor_parallel={tensor_parallel} needs "
+            f"{tensor_parallel} device(s), have {jax.device_count()}")
     log(f"cb rung {name}: building (slots={max_batch} requests={n_requests} "
-        f"quant={quant} ragged={ragged} paged_kernel={paged_kernel})")
+        f"quant={quant} ragged={ragged} paged_kernel={paged_kernel}"
+        + (f" tp={tensor_parallel}" if tensor_parallel > 1 else "") + ")")
     def pow2_buckets(lo_len, hi_len):
         lo_b, hi_b = min(_bucket(lo_len), max_seq), min(_bucket(hi_len), max_seq)
         buckets, b = [], lo_b
@@ -478,7 +491,8 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
         params = llama.init_params(cfg, jax.random.key(0))
         eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
                                        max_seq=max_seq, chunk=chunk, quant=quant,
-                                       paged=paged)
+                                       paged=paged, block_size=block_size,
+                                       tensor_parallel=tensor_parallel)
         del params  # quantized rungs: free the fp tree (4.5GB at 3B) before serving
         # warm the decode step plus one prefill per bucket the timed requests
         # can land in, so no XLA compile lands inside the timed region
@@ -511,23 +525,34 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
                 os.environ.pop(env_key, None)
             else:
                 os.environ[env_key] = saved_env
+    detail = {"rung": name, "slots": max_batch, "requests": n_requests,
+              "total_new_tokens": total, "wall_s": round(wall, 2),
+              "decode_steps": eng.stats["decode_steps"], "chunk": chunk,
+              "quant": quant, "paged": paged, "ragged": ragged,
+              # per-rung deltas (flash pattern, bench.py run_rung): the
+              # A/B evidence of which attention path this rung traced
+              "paged_kernel_calls": _pa.KERNEL_CALLS - pk0,
+              "paged_fallback_calls": _pa.FALLBACK_CALLS - pf0,
+              # expected: one decode variant per sampling mode used +
+              # one prefill per warmed bucket; growth = in-serve churn
+              "n_traces": eng.n_traces(),
+              "backend": jax.default_backend()}
+    if tensor_parallel > 1:
+        import jax.numpy as jnp
+
+        # per compiled-launch ICI budget: every decode-scan row crosses
+        # the mesh twice per layer (attention-out + mlp-out psums),
+        # nothing else does (docs/tp_serving.md)
+        ar = (2 * cfg.num_hidden_layers * max_batch * chunk
+              * cfg.hidden_size * jnp.zeros((), cfg.dtype).dtype.itemsize)
+        detail.update(tp=tensor_parallel, allreduce_bytes_per_step=ar,
+                      allreduce_mib_per_step=round(ar / 2**20, 3))
     return {
         "metric": "llama_cb_decode_tokens_per_sec",
         "value": round(eng.decode_tokens_per_s, 1),
         "unit": "tok/s",
         "vs_baseline": 0.0,
-        "detail": {"rung": name, "slots": max_batch, "requests": n_requests,
-                   "total_new_tokens": total, "wall_s": round(wall, 2),
-                   "decode_steps": eng.stats["decode_steps"], "chunk": chunk,
-                   "quant": quant, "paged": paged, "ragged": ragged,
-                   # per-rung deltas (flash pattern, bench.py run_rung): the
-                   # A/B evidence of which attention path this rung traced
-                   "paged_kernel_calls": _pa.KERNEL_CALLS - pk0,
-                   "paged_fallback_calls": _pa.FALLBACK_CALLS - pf0,
-                   # expected: one decode variant per sampling mode used +
-                   # one prefill per warmed bucket; growth = in-serve churn
-                   "n_traces": eng.n_traces(),
-                   "backend": jax.default_backend()},
+        "detail": detail,
     }
 
 
@@ -698,6 +723,16 @@ def run_cb_spec_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq,
 
 
 def decode_ladder_main(compact: bool = False) -> int:
+    # the TP cpu-mesh smoke needs a multi-device host platform; forcing
+    # virtual CPU devices only works before the backend initializes
+    # (mirrors tests/conftest.py) and is harmless on TPU — the flag only
+    # shapes the HOST platform, which the TPU rungs never schedule on
+    if "jax" not in sys.modules:
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8")
+
     import jax
 
     from paddle_tpu.models import llama
@@ -891,6 +926,32 @@ def decode_ladder_main(compact: bool = False) -> int:
             banked += 1
         except Exception as e:
             log(f"cb overload rung {rung[0]} failed: {e}\n"
+                f"{traceback.format_exc()}")
+            continue
+    # tensor-parallel rungs (ISSUE 8, docs/tp_serving.md): the matched
+    # single-chip paged-kernel workload — run_cb_rung with tensor_parallel
+    # set, so the warm/request RandomState(0) stream is IDENTICAL to
+    # cb_full_chunk8_paged_kernel by construction and the headline reads
+    # directly against that rung's banked number.  full_cfg has kv_heads=4,
+    # so tp=2 and tp=4 both divide; the cpu smoke runs the same path on 2
+    # virtual host devices (forced above).  (rung tuple: run_cb_rung's,
+    # ending chunk, quant, paged, ragged, paged_kernel, tensor_parallel
+    # [, block_size])
+    tp_rungs = ([
+        ("cb_tp2", full_cfg, 8, 24, 128, 64, 512, 8, None, True, False,
+         True, 2),
+        ("cb_tp4", full_cfg, 8, 24, 128, 64, 512, 8, None, True, False,
+         True, 4),
+    ] if on_tpu else [
+        ("cb_tp_cpu_smoke", llama.LlamaConfig.tiny(), 2, 4, 16, 8, 64, 2,
+         None, True, False, True, 2, 8),
+    ])
+    for rung in tp_rungs:
+        try:
+            emit(run_cb_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"cb tp rung {rung[0]} failed: {e}\n"
                 f"{traceback.format_exc()}")
             continue
     return 0 if banked else 1

@@ -18,6 +18,16 @@ def main(argv=None) -> int:
     # the relay is down).  Effective only when the backend is not yet
     # initialized — the canonical invocation sets JAX_PLATFORMS=cpu anyway.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # multi-device targets (serving_tp_step) need a host mesh: force the
+    # virtual CPU device count like tests/conftest.py.  XLA_FLAGS is read
+    # at BACKEND init, not jax import (running as ``-m`` already imported
+    # the package, hence jax), so setting it here still works; it is
+    # harmless if the backend is somehow already up — the target then
+    # reports a build failure instead of tracing the wrong mesh.
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8")
     import jax
 
     try:

@@ -13,6 +13,10 @@ Invariants (paged mode):
 
 I1  page partition — every pool page is in exactly one of {free list, a
     slot's private blocks, the prefix cache}; no duplicates, total == pool.
+    Under tensor parallelism (docs/tp_serving.md) the device pools must
+    shard ONLY the kv_heads axis — the page axis stays whole per shard, so
+    this host-side partition is exact on every shard (one allocator,
+    tp-many replicas of its accounting).
 I2  block-table rows — row[i] mirrors [shared pages..., private pages...] in
     order; every remaining entry is the unallocated sentinel.
 I3  refcounts — each cached block's refcount equals the number of slot
@@ -144,6 +148,23 @@ def audit_engine(eng) -> None:
         extra = sorted(set(everything) - set(range(nb)))
         _fail("I1", f"pool accounting does not close: missing={missing} "
                     f"out-of-range={extra}")
+    if getattr(eng, "tp", 1) > 1:
+        # I1 under tensor parallelism (docs/tp_serving.md): the host
+        # partition above is only exact PER SHARD if the device pool
+        # shards kv_heads alone — a spec that touched the page axis would
+        # give shards different page capacities and the single host
+        # allocator would silently misaccount every one of them.
+        for nm, pool in (("cache_k", eng.cache_k), ("cache_v", eng.cache_v)):
+            spec = tuple(getattr(pool.sharding, "spec", ()) or ())
+            axes = spec + (None,) * (pool.ndim - len(spec))
+            kv_ax = axes[2]
+            if kv_ax not in ("tp", ("tp",)):
+                _fail("I1", f"TP pool {nm} does not shard kv_heads: "
+                            f"spec={spec}")
+            if any(a is not None for i, a in enumerate(axes) if i != 2):
+                _fail("I1", f"TP pool {nm} shards a non-kv_heads axis "
+                            f"(per-shard page accounting breaks): "
+                            f"spec={spec}")
 
     # I4: cached pages are read-only — never simultaneously private
     leaked = set(cached_pages) & set(private)

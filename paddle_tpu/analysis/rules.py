@@ -24,10 +24,13 @@ Rules
 ``host_sync``      callback-class primitives (pure/io/debug callbacks,
                    infeed/outfeed) — host round-trips; severity escalates to
                    error inside scan/while bodies (the hot loop).
-``resharding``     all-gathers the SPMD partitioner inserted that the program
-                   never asked for — eqns whose in/out shardings force an
-                   implicit gather of a large operand (compiled-HLO scan,
-                   multi-device meshes only).
+``resharding``     large collectives in the compiled HLO (multi-device meshes
+                   only): all-gathers/all-to-alls the SPMD partitioner
+                   inserted that the program never asked for — eqns whose
+                   in/out shardings force an implicit gather — plus
+                   all-reduces, so deliberate psum boundaries (the TP serving
+                   engine's two per layer) stay pinned behind reasoned
+                   allowlist entries and any new large reduce fails the gate.
 """
 
 from __future__ import annotations
@@ -418,11 +421,12 @@ _DTYPE_BYTES = {"f64": 8, "f32": 4, "f16": 2, "bf16": 2, "s64": 8, "u64": 8,
                 "pred": 1, "f8e4m3fn": 1, "f8e5m2": 1}
 _HLO_OP_RE = re.compile(
     r"%?[\w.-]+\s*=\s*([a-z0-9]+)\[([\d,]*)\][^=]*"
-    r"\s(all-gather|all-to-all)(?:-start)?\(")
+    r"\s(all-gather|all-to-all|all-reduce)(?:-start)?\(")
 # combined/tuple-result form the all-gather combiner emits:
 #   %ag = (f32[1024,64], bf16[512,64]) all-gather(%a, %b)
 _HLO_TUPLE_OP_RE = re.compile(
-    r"%?[\w.-]+\s*=\s*\(([^)]*)\)[^=]*\s(all-gather|all-to-all)(?:-start)?\(")
+    r"%?[\w.-]+\s*=\s*\(([^)]*)\)[^=]*"
+    r"\s(all-gather|all-to-all|all-reduce)(?:-start)?\(")
 _SHAPE_RE = re.compile(r"([a-z0-9]+)\[([\d,]*)\]")
 _META_RE = re.compile(r'op_name="([^"]*)"')
 
@@ -466,12 +470,18 @@ def _mesh_devices_of(closed, args=()) -> int:
 def check_resharding(fn, args, closed=None, target: str = "",
                      min_bytes: int = 1 << 20) -> list[Finding]:
     """Compile under the fn's own mesh and scan the post-SPMD HLO for
-    all-gather/all-to-all ops over large tensors.  These are the collectives
-    GSPMD *inserted* — the program never wrote them; each one is an eqn whose
-    in/out shardings don't compose, silently paying ICI bandwidth (the
-    'involuntary rematerialization' class the GQA KV replication note in
-    models/llama.param_specs documents).  Skipped on single-device meshes
-    (nothing to reshard)."""
+    all-gather/all-to-all/all-reduce ops over large tensors.
+    Gathers/all-to-alls are the collectives GSPMD *inserted* — the program
+    never wrote them; each one is an eqn whose in/out shardings don't
+    compose, silently paying ICI bandwidth (the 'involuntary
+    rematerialization' class the GQA KV replication note in
+    models/llama.param_specs documents).  All-reduces are reported too so
+    DELIBERATE reduction boundaries stay budgeted: a program that means to
+    pay one (the TP serving engine's two per-layer psums,
+    docs/tp_serving.md) carries a reasoned allowlist entry, and any other
+    large reduce — a sharding change widening a psum operand, a new
+    replicated reduction — fails the gate instead of shipping silently.
+    Skipped on single-device meshes (nothing to reshard)."""
     if closed is not None and _mesh_devices_of(closed, args) <= 1:
         return []
     try:
@@ -503,10 +513,18 @@ def check_resharding(fn, args, closed=None, target: str = "",
         if nbytes < min_bytes:
             continue
         meta = _META_RE.search(line)
+        if op == "all-reduce":
+            # reduces are often intended (psum boundaries) — the message
+            # points at the allowlist instead of calling them implicit
+            message = (f"{op} of {shape} ({nbytes / 2**20:.1f} MiB) "
+                       f"crosses the mesh — a deliberate reduction boundary "
+                       f"needs a reasoned allowlist entry, anything else is "
+                       f"paying unbudgeted ICI bandwidth")
+        else:
+            message = (f"SPMD partitioner inserted {op} of {shape} "
+                       f"({nbytes / 2**20:.1f} MiB) — in/out shardings "
+                       f"force an implicit gather")
         findings.append(Finding(
-            rule="resharding", severity=Severity.WARNING,
-            message=(f"SPMD partitioner inserted {op} of {shape} "
-                     f"({nbytes / 2**20:.1f} MiB) — in/out shardings force "
-                     f"an implicit gather"),
+            rule="resharding", severity=Severity.WARNING, message=message,
             where=(meta.group(1)[:160] if meta else ""), target=target))
     return findings

@@ -64,7 +64,7 @@ def _t_moe_train_step() -> AnalysisTarget:
                           (params, opt_state, ids, labels))
 
 
-def _serving_engine(_force_flags=(), **kwargs):
+def _serving_engine(_force_flags=(), _cfg_kwargs=None, **kwargs):
     import contextlib
     import os
     import jax
@@ -72,8 +72,8 @@ def _serving_engine(_force_flags=(), **kwargs):
     from ..models import llama
     from ..inference.serving import ContinuousBatchingEngine
 
-    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=32, layers=2, heads=4,
-                                 kv_heads=2, inter=64)
+    cfg = llama.LlamaConfig.tiny(**(_cfg_kwargs or dict(
+        vocab=128, hidden=32, layers=2, heads=4, kv_heads=2, inter=64)))
     params = llama.init_params(cfg, jax.random.key(0))
     # the lint gate analyzes a feature's compiled program even when the
     # operator's kill switch (e.g. PADDLE_TPU_CHUNKED_PREFILL=0) has it off
@@ -91,6 +91,16 @@ def _serving_engine(_force_flags=(), **kwargs):
             stack.callback(lambda f=flag, p=prev: (
                 os.environ.__setitem__(f, p) if p is not None
                 else os.environ.pop(f, None)))
+        # an ambient PADDLE_TPU_TP would OVERRIDE every builder's
+        # tensor_parallel (the env wins by design) — e.g. PADDLE_TPU_TP=1
+        # would collapse serving_tp_step to a single-chip program whose
+        # resharding gate polices nothing, and PADDLE_TPU_TP=2 would turn
+        # the single-chip targets into TP engines.  The gate must analyze
+        # exactly the program each target declares: clear the override.
+        prev_tp = os.environ.pop("PADDLE_TPU_TP", None)
+        if prev_tp is not None:
+            stack.callback(lambda: os.environ.__setitem__("PADDLE_TPU_TP",
+                                                          prev_tp))
         return ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
                                         chunk=2, paged=True, block_size=8,
                                         **kwargs)
@@ -183,6 +193,49 @@ def _t_serving_mixed_step() -> AnalysisTarget:
          temp, topp, seeds, table))
 
 
+def _t_serving_tp_step() -> AnalysisTarget:
+    import jax
+    import jax.numpy as jnp
+
+    if jax.device_count() < 2:
+        # RuntimeError, not SystemExit: lint_gate.py's per-target handler
+        # must classify this as "FAILED to build/trace" (exit 2) instead
+        # of the exception tunneling past it — both CLI entry points force
+        # an 8-device host platform pre-init, so this only fires when the
+        # backend initialized single-device before the gate ran
+        raise RuntimeError(
+            "serving_tp_step needs >= 2 devices; run under the test "
+            "harness (tests/conftest.py forces 8 CPU devices) or set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    # the TP mixed prefill/decode step over a 2-shard ("tp",) mesh — the
+    # one compiled program whose collectives the resharding rule must
+    # police (ISSUE 8).  Config sized so the layer's psum operand
+    # [B, T, h] (2*64*512 bf16 = 128 KiB) clears the target's lowered
+    # gather threshold: the production bar is >=1MiB, and a lint-sized
+    # model translates it the way every target here translates shape
+    # bounds — same rule, proportionally smaller floor (analyze_kwargs).
+    eng = _serving_engine(
+        _force_flags=("PADDLE_TPU_CHUNKED_PREFILL",),
+        _cfg_kwargs=dict(vocab=128, hidden=512, layers=2, heads=4,
+                         kv_heads=2, inter=256),
+        enable_chunked_prefill=True, prefill_chunk=64, tensor_parallel=2)
+    B = eng.max_batch
+    T = eng._prefill_chunk
+    tokens = jnp.zeros((B, T), jnp.int32)
+    pos = jnp.asarray([5, 0], jnp.int32)
+    active = jnp.asarray([True, True])
+    q_lens = jnp.asarray([1, T], jnp.int32)
+    temp = jnp.zeros((B,), jnp.float32)
+    topp = jnp.ones((B,), jnp.float32)
+    seeds = jnp.zeros((B,), jnp.int32)
+    table = jnp.asarray(eng._table)
+    return AnalysisTarget(
+        "serving_tp_step", eng._mixed_greedy,
+        (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active, q_lens,
+         temp, topp, seeds, table),
+        analyze_kwargs={"min_gather_bytes": 1 << 16})
+
+
 TARGETS = {
     "llama_train_step": _t_llama_train_step,
     "moe_llama_train_step": _t_moe_train_step,
@@ -190,6 +243,7 @@ TARGETS = {
     "serving_prefill_step": _t_serving_prefill_step,
     "serving_verify_step": _t_serving_verify_step,
     "serving_mixed_step": _t_serving_mixed_step,
+    "serving_tp_step": _t_serving_tp_step,
 }
 
 # the CI gate runs every registered target; kept as an explicit list so an
@@ -197,7 +251,8 @@ TARGETS = {
 # slowing the tier-1 suite
 GATE_TARGETS = ("llama_train_step", "moe_llama_train_step",
                 "serving_decode_step", "serving_prefill_step",
-                "serving_verify_step", "serving_mixed_step")
+                "serving_verify_step", "serving_mixed_step",
+                "serving_tp_step")
 
 
 def build(name: str) -> AnalysisTarget:

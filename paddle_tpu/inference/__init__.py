@@ -247,7 +247,7 @@ def create_predictor(config: Config) -> Predictor:
 # ---------------------------------------------------------------------------
 
 def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos,
-                      sin, attend_fn=None):
+                      sin, attend_fn=None, tp_axis=None):
     """Cache-threading transformer body shared by GenerationEngine and the
     continuous-batching engine (serving.py) — one copy of the GQA attend +
     rms/rope/swiglu scan so masking/grouping fixes can't diverge.
@@ -262,10 +262,20 @@ def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos,
     ragged paged-attention kernel here, with write_fn returning the RAW
     paged pool (no gathered view) as k_view/v_view; ``mask`` is then unused.
     Returns (final-normed hidden [b, s, h], all_k, all_v).
+
+    ``tp_axis`` (docs/tp_serving.md): name of the mesh axis when this body
+    runs INSIDE a shard_map region of the continuous-batching engine's
+    ``tensor_parallel`` mode.  ``cfg`` then carries tp-LOCAL head counts
+    (nh/tp query heads, nkv/tp kv heads over the same full head_dim), the
+    caches/params are the local shards, and the residual stream stays
+    replicated through the two per-layer psum boundaries the shared decoder
+    halves insert (models/llama.decoder_attn_residual /
+    decoder_mlp_residual).  ``tp_axis=None`` (every single-chip engine)
+    traces the exact pre-TP program.
     """
+    from ..models.llama import decoder_attn_residual, decoder_mlp_residual
     from ..ops.pallas import rms_norm as rms
     from ..ops.pallas import rope as rope_mod
-    from ..ops.pallas import swiglu as swiglu_mod
 
     b, s = x.shape[:2]
     nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
@@ -309,10 +319,12 @@ def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos,
         q, k = rope_mod.apply_rotary_pos_emb(q, k, cos, sin)
         ck, k_att = write_fn(ck, k)
         cv, v_att = write_fn(cv, v)
-        x = x + attend(q, k_att, v_att) @ wmat(lp["wo"], dt)
-        xn = rms.rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-        x = x + swiglu_mod.swiglu(xn @ wmat(lp["w_gate"], dt),
-                                  xn @ wmat(lp["w_up"], dt)) @ wmat(lp["w_down"], dt)
+        # the two decoder halves (attn-out projection + residual, mlp +
+        # residual) are the factored sharded forward shared with training
+        # (models/llama.py) — under TP they hold the layer's two psums
+        x = decoder_attn_residual(x, attend(q, k_att, v_att), lp, wmat=wmat,
+                                  tp_axis=tp_axis)
+        x = decoder_mlp_residual(cfg, x, lp, wmat=wmat, tp_axis=tp_axis)
         return x, (ck, cv)
 
     x, (all_k, all_v) = jax.lax.scan(body, x, (params["layers"], cache_k, cache_v))

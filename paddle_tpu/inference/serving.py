@@ -115,6 +115,21 @@ recompute — the replica-restart primitive the fleet tier needs.  Faults are
 injected deterministically at the allocator / kernel-dispatch / sampler
 seams via ``PADDLE_TPU_FAULT_INJECT`` (faults.py).
 
+``tensor_parallel=N`` (docs/tp_serving.md; paged mode only, kill/override
+knob ``PADDLE_TPU_TP``) fans the whole engine across N devices on a 1-D
+``("tp",)`` mesh: weights take the Megatron column/row split
+(models/llama.serving_param_specs), the paged KV pool and every new-page
+append shard along **kv_heads** — the one axis the ragged paged-attention
+kernels' page walk never crosses, so decode/verify/prefill kernel bodies
+run byte-unchanged per shard inside shard_map — and each layer pays exactly
+two psum boundaries (attention output, MLP output).  Block tables, the
+scheduler, the prefix cache, the fault ladder and drafter state stay
+replicated host-side, so prefix caching, speculation, chunked prefill,
+graceful degradation and snapshot/restore all compose with TP by
+construction; TP=1 builds the byte-identical single-chip engine and TP>1
+is token-identical to it (every shard computes the same full-vocab logits
+row after the psums, so the in-graph sampler agrees by construction).
+
 Per-request sampling (reference: ``top_p_sampling``, ops.yaml:4947) runs
 inside the jitted step: temperature/top-p/seed are per-slot DATA vectors, so
 one compiled program serves mixed greedy/sampled batches, and RNG keys
@@ -131,11 +146,14 @@ from __future__ import annotations
 import functools
 import math
 import time
+import warnings
 from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..profiler import RecordEvent
 from .faults import FaultInjected
@@ -186,6 +204,27 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+class _TPShardView:
+    """Per-shard config view inside the ``("tp",)`` shard_map region
+    (docs/tp_serving.md): the compiled-step bodies read head counts off the
+    config, and inside the region every shard holds nh/tp query heads and
+    nkv/tp kv heads of the SAME full head_dim — so the view pins tp-local
+    counts and the true head_dim (the dataclass property would miscompute
+    it from hidden_size // local_heads) and proxies everything else
+    (dtype, rope_theta, layer count, ...) to the real config.  The GQA
+    group ratio nh/nkv is tp-invariant, which is why the paged-attention
+    kernels run byte-unchanged per shard."""
+
+    def __init__(self, cfg, tp: int):
+        self._cfg = cfg
+        self.num_attention_heads = cfg.num_attention_heads // tp
+        self.num_key_value_heads = cfg.num_key_value_heads // tp
+        self.head_dim = cfg.head_dim
+
+    def __getattr__(self, name):
+        return getattr(self._cfg, name)
+
+
 class ContinuousBatchingEngine:
     """Slot-pool continuous batching over a Llama-family model.
 
@@ -200,7 +239,7 @@ class ContinuousBatchingEngine:
                  enable_speculation: bool = False, num_draft_tokens: int = 4,
                  spec_ngram: int = 3, enable_chunked_prefill: bool = False,
                  prefill_chunk: int = 128, token_budget: int | None = None,
-                 max_queue: int | None = None):
+                 max_queue: int | None = None, tensor_parallel: int = 1):
         """``chunk``: decode steps per compiled call.  Tokens feed back
         on-device inside a lax.scan and the host fetches ``chunk`` tokens per
         round-trip — the lever against host-device latency (one RTT per token
@@ -239,7 +278,21 @@ class ContinuousBatchingEngine:
         holds this many requests, ``add_request`` marks the newcomer
         ``REJECTED`` (with ``error``) instead of queueing it; None (the
         default) keeps the queue unbounded.  Preemption re-inserts are
-        exempt: accepted work is never rejected."""
+        exempt: accepted work is never rejected.
+        ``tensor_parallel``: shard the engine over N devices on a 1-D
+        ``("tp",)`` mesh (docs/tp_serving.md; paged mode only).  Weights
+        take the Megatron column/row split (models/llama.
+        serving_param_specs), the paged KV pool and every new-page append
+        shard along **kv_heads**, and each compiled step runs the
+        single-chip per-shard programs inside shard_map with exactly two
+        psum boundaries per layer (attention output, MLP output) — block
+        tables, scheduler, prefix cache, fault ladder and drafter state
+        stay replicated host-side, so every feature above composes with TP
+        by construction and TP>1 is token-identical to TP=1.  N must
+        divide num_key_value_heads (and intermediate_size) and not exceed
+        the visible device count.  ``PADDLE_TPU_TP=<int>`` overrides this
+        value (validated: an invalid degree warns once with the valid
+        divisors and falls back to 1 — utils/envflags.env_tp)."""
         from ..models import llama as _llama  # noqa: F401  (cfg type lives there)
 
         self.cfg = cfg
@@ -255,6 +308,83 @@ class ContinuousBatchingEngine:
         self.paged = bool(paged)
         L = cfg.num_hidden_layers
         nkv, hd = cfg.num_key_value_heads, cfg.head_dim
+        # ---- tensor parallelism (docs/tp_serving.md) ----
+        # resolve the degree FIRST: the KV pool is created already sharded
+        # and every compiled program below is built per-shard.  tp == 1
+        # must construct the exact pre-TP engine (no mesh, no device_put,
+        # no shard_map) — every TP behavior hangs off self.tp > 1.
+        from ..utils.envflags import env_tp
+
+        tp = int(tensor_parallel)
+        if tp < 1:
+            # a caller's arithmetic bug (devices // n == 0) must raise,
+            # not degrade to a nonsense degree (env typos degrade instead
+            # — env_tp already floors those at 1 with a warning)
+            raise ValueError(f"tensor_parallel must be >= 1, got {tp}")
+        tp_env = env_tp(nkv, jax.device_count())
+        if tp_env is not None:
+            tp = tp_env     # operator override replaces the ctor value
+        if tp > 1:
+            problems = []
+            if not paged:
+                problems.append(
+                    "tensor_parallel > 1 requires paged=True (TP shards "
+                    "the paged KV pool along kv_heads)")
+            if nkv % tp:
+                divs = sorted(d for d in range(1, nkv + 1) if nkv % d == 0)
+                problems.append(
+                    f"tensor_parallel={tp} does not divide "
+                    f"num_key_value_heads={nkv} — a sub-head split would "
+                    f"break the shard-local page walk (valid divisors: "
+                    f"{divs})")
+            if cfg.intermediate_size % tp:
+                problems.append(
+                    f"tensor_parallel={tp} does not divide "
+                    f"intermediate_size={cfg.intermediate_size} (the MLP "
+                    f"column split needs an even ffn slice per shard)")
+            if tp > jax.device_count():
+                problems.append(
+                    f"tensor_parallel={tp} exceeds the "
+                    f"{jax.device_count()} visible device(s)")
+            if problems:
+                if tp_env is not None:
+                    # an env override must degrade to the single-chip
+                    # engine, never crash the serve (same contract as
+                    # env_tp's own validation)
+                    warnings.warn(f"PADDLE_TPU_TP={tp}: "
+                                  + "; ".join(problems)
+                                  + "; falling back to tensor_parallel=1")
+                    tp = 1
+                else:
+                    raise ValueError("; ".join(problems))
+        self.tp = tp
+        self._tp_axis = None
+        self._mesh = None
+        self._body_cfg = cfg       # the cfg the compiled-step bodies read
+        if tp > 1:
+            from jax.sharding import Mesh, NamedSharding
+            from jax.sharding import PartitionSpec as _P
+
+            self._mesh = Mesh(np.asarray(jax.devices()[:tp]), ("tp",))
+            self._tp_axis = "tp"
+            # inside the shard_map region every step body sees tp-local
+            # head counts over the same head_dim (GQA ratio unchanged —
+            # the Pallas kernels run byte-identically per shard)
+            self._body_cfg = _TPShardView(cfg, tp)
+            specs = _llama.serving_param_specs(cfg, quant=quant)
+            if "lm_head" not in params:
+                specs.pop("lm_head", None)
+            self._param_specs = specs
+            self._param_shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self._mesh, s), specs,
+                is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec))
+            # pool layout [L, num_blocks, nkv, bs, hd]: ONLY kv_heads
+            # shards — per-shard page capacity equals num_blocks, so the
+            # host allocator's accounting holds exactly on every shard
+            self._cache_spec = _P(None, None, "tp")
+            self._cache_sharding = NamedSharding(self._mesh,
+                                                 self._cache_spec)
+            self.params = jax.device_put(self.params, self._param_shardings)
         if paged:
             assert max_seq % block_size == 0, (max_seq, block_size)
             self.block_size = block_size
@@ -286,6 +416,11 @@ class ContinuousBatchingEngine:
             shape = (L, max_batch, nkv, max_seq, hd)
         self.cache_k = jnp.zeros(shape, cfg.dtype)
         self.cache_v = jnp.zeros(shape, cfg.dtype)
+        if self.tp > 1:
+            # the pool lives sharded from birth; donation keeps it sharded
+            # through every step, so no per-step resharding ever happens
+            self.cache_k = jax.device_put(self.cache_k, self._cache_sharding)
+            self.cache_v = jax.device_put(self.cache_v, self._cache_sharding)
         # automatic prefix cache (content-addressed KV block reuse).  The
         # cache-off path must stay byte-identical to the plain paged engine,
         # so EVERY cache behavior hangs off self._pcache being non-None.
@@ -306,15 +441,25 @@ class ContinuousBatchingEngine:
 
             self._pcache = PrefixCache(block_size)
             # page-granular COW: duplicate pool page src into dst across
-            # all layers (donated — no full-pool copy materializes)
+            # all layers (donated — no full-pool copy materializes).  TP:
+            # page indices address the unsharded num_blocks axis, so the
+            # copy is shard-local; the output pins the pool sharding so
+            # GSPMD can never decide to re-lay the donated buffer out.
             self._copy_page = jax.jit(
                 lambda c, dst, src: c.at[:, dst].set(c[:, src]),
-                donate_argnums=(0,))
+                donate_argnums=(0,),
+                **({"out_shardings": self._cache_sharding}
+                   if self.tp > 1 else {}))
             # partial-bucket prefill: compiled per bucket; start/length
             # are DATA so one program serves every hit depth
-            self._prefill_prefix = jax.jit(
-                self._prefill_impl_paged_prefix, donate_argnums=(2, 3),
-                static_argnums=(7,))
+            if self.tp == 1:
+                self._prefill_prefix = jax.jit(
+                    self._prefill_impl_paged_prefix, donate_argnums=(2, 3),
+                    static_argnums=(7,))
+            else:
+                self._prefill_prefix = jax.jit(
+                    self._tp_shard_prefill(self._prefill_impl_paged_prefix),
+                    donate_argnums=(2, 3), static_argnums=(7,))
         # slot state (host side)
         self._slot_req: list[Request | None] = [None] * max_batch
         self._pos = np.zeros(max_batch, np.int32)      # next write position
@@ -364,17 +509,22 @@ class ContinuousBatchingEngine:
         # sort/softmax/categorical of the sampler must not run (XLA cannot
         # DCE work behind a data-dependent where) when every resident slot
         # is greedy — the bench headline's configuration
-        self._decode_greedy = jax.jit(
-            functools.partial(impl, sampling=False, graceful=self._graceful),
-            donate_argnums=(1, 2))
-        self._decode_sampling = jax.jit(
-            functools.partial(impl, sampling=True, graceful=self._graceful),
-            donate_argnums=(1, 2))
+        self._decode_greedy = self._jit_step(
+            impl, n_rep=2 if self._graceful else 1, sampling=False,
+            graceful=self._graceful)
+        self._decode_sampling = self._jit_step(
+            impl, n_rep=2 if self._graceful else 1, sampling=True,
+            graceful=self._graceful)
         # prefill writes its lane directly into the donated pool arrays —
         # no slice-out/scatter-back copies of the full pool per admission
         pimpl = self._prefill_impl_paged if paged else self._prefill_impl
-        self._prefill = jax.jit(pimpl, donate_argnums=(2, 3),
-                                static_argnums=(6,))
+        if self.tp == 1:
+            self._prefill = jax.jit(pimpl, donate_argnums=(2, 3),
+                                    static_argnums=(6,))
+        else:
+            self._prefill = jax.jit(self._tp_shard_prefill(pimpl),
+                                    donate_argnums=(2, 3),
+                                    static_argnums=(6,))
         # speculative decoding (prompt-lookup drafting + multi-token verify).
         # Like the prefix cache, EVERY spec behavior hangs off self._spec
         # being non-None, and the env kill switch is checked FIRST so
@@ -395,14 +545,12 @@ class ContinuousBatchingEngine:
             # raggedness is the q_lens data vector): one compiled variant
             # per sampling mode for the whole serve, no shape-family churn
             self._spec_qmax = int(num_draft_tokens) + 1
-            self._verify_greedy = jax.jit(
-                functools.partial(self._verify_impl_paged, sampling=False,
-                                  graceful=self._graceful),
-                donate_argnums=(1, 2))
-            self._verify_sampling = jax.jit(
-                functools.partial(self._verify_impl_paged, sampling=True,
-                                  graceful=self._graceful),
-                donate_argnums=(1, 2))
+            self._verify_greedy = self._jit_step(
+                self._verify_impl_paged, n_rep=3 if self._graceful else 2,
+                sampling=False, graceful=self._graceful)
+            self._verify_sampling = self._jit_step(
+                self._verify_impl_paged, n_rep=3 if self._graceful else 2,
+                sampling=True, graceful=self._graceful)
         # chunked prefill + unified mixed prefill/decode step (stall-free
         # continuous batching; docs/chunked_prefill.md).  Like the prefix
         # cache and speculation, EVERY chunked behavior hangs off
@@ -441,14 +589,12 @@ class ContinuousBatchingEngine:
             # ONE compiled [B, T] program per sampling mode for the whole
             # serve: chunk packing / per-slot progress are q_lens/pos DATA,
             # so prefill goes from log2(max_seq) bucketed variants to O(1)
-            self._mixed_greedy = jax.jit(
-                functools.partial(self._mixed_impl_paged, sampling=False,
-                                  graceful=self._graceful),
-                donate_argnums=(1, 2))
-            self._mixed_sampling = jax.jit(
-                functools.partial(self._mixed_impl_paged, sampling=True,
-                                  graceful=self._graceful),
-                donate_argnums=(1, 2))
+            self._mixed_greedy = self._jit_step(
+                self._mixed_impl_paged, n_rep=2 if self._graceful else 1,
+                sampling=False, graceful=self._graceful)
+            self._mixed_sampling = self._jit_step(
+                self._mixed_impl_paged, n_rep=2 if self._graceful else 1,
+                sampling=True, graceful=self._graceful)
         self.stats = {"decode_steps": 0, "decode_tokens": 0,
                       "prefills": 0, "decode_time_s": 0.0, "preemptions": 0,
                       # prefix-cache observability (all zero with caching off;
@@ -487,6 +633,80 @@ class ContinuousBatchingEngine:
 
         self._audit_every_step = audit_enabled()
 
+    # ------------- tensor-parallel wrapping (docs/tp_serving.md) -----------
+
+    def _jit_step(self, impl, n_rep: int, **statics):
+        """jit one ``(params, cache_k, cache_v, *data[, poison=...])``
+        compiled step with the standard cache donation.  Single-chip
+        (``tp == 1``): exactly the pre-TP ``jax.jit(functools.partial(...))``
+        — byte-identical programs.  TP: the SAME per-shard body runs inside
+        shard_map (``_tp_shard``); ``n_rep`` is the number of leading
+        replicated outputs before the two cache pools."""
+        body = functools.partial(impl, **statics)
+        if self.tp == 1:
+            return jax.jit(body, donate_argnums=(1, 2))
+        return jax.jit(self._tp_shard(body, n_rep), donate_argnums=(1, 2))
+
+    def _tp_shard(self, body, n_rep: int):
+        """shard_map a compiled-step body over the 1-D ``("tp",)`` mesh.
+
+        Operand contract: ``params`` take the Megatron specs
+        (models/llama.serving_param_specs — QKV/gate/up column-split,
+        O/down row-split, embed/norms/lm_head replicated), the two KV pools
+        shard **kv_heads** (the axis the paged-attention page walk is
+        blind to), and every other operand — tokens, positions, active
+        mask, sampling knobs, the block table, poison bits — replicates:
+        the scheduler stays host-side and identical on every shard.
+        Outputs: ``n_rep`` replicated leaves (tokens/counts/guard flags —
+        every shard computes the identical full [B, V] logits row after
+        the per-layer psums, so the sampler's choice agrees by
+        construction) followed by the two sharded pools.  The body is the
+        byte-same single-chip program over tp-local head counts; its only
+        collectives are transformer_apply's two per-layer psums."""
+        from jax.sharding import PartitionSpec as P
+
+        mesh, pspec, cspec = self._mesh, self._param_specs, self._cache_spec
+
+        def run(params, cache_k, cache_v, *data, poison=None):
+            extra = (poison,) if poison is not None else ()
+            if poison is None:
+                fn = body
+            else:
+                def fn(*a):
+                    return body(*a[:-1], poison=a[-1])
+            in_specs = ((pspec, cspec, cspec)
+                        + (P(),) * (len(data) + len(extra)))
+            out_specs = (P(),) * n_rep + (cspec, cspec)
+            return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)(
+                params, cache_k, cache_v, *data, *extra)
+
+        return run
+
+    def _tp_shard_prefill(self, impl):
+        """shard_map wrapper for the prefill-family impls
+        ``(params, ids, cache_k, cache_v, *data, bucket)`` — same operand
+        contract as ``_tp_shard`` (ids/table rows/lengths replicate, pools
+        shard kv_heads, no replicated outputs), with the trailing static
+        ``bucket`` closed over so the shard_map region is purely
+        array-in/array-out."""
+        from jax.sharding import PartitionSpec as P
+
+        mesh, pspec, cspec = self._mesh, self._param_specs, self._cache_spec
+
+        def run(params, ids, cache_k, cache_v, *rest):
+            data, bucket = rest[:-1], rest[-1]
+
+            def fn(p, i, ck, cv, *d):
+                return impl(p, i, ck, cv, *d, bucket)
+
+            in_specs = (pspec, P(), cspec, cspec) + (P(),) * len(data)
+            return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=(cspec, cspec), check_rep=False)(
+                params, ids, cache_k, cache_v, *data)
+
+        return run
+
     # ---------------- compiled programs ----------------
 
     def _decode_one(self, params, cache_k, cache_v, tokens, pos, active,
@@ -505,7 +725,7 @@ class ContinuousBatchingEngine:
         from .. import inference as _inf
         from ..ops.pallas import rope as rope_mod
 
-        cfg = self.cfg
+        cfg = self._body_cfg    # TP: tp-local head counts (else self.cfg)
         B = self.max_batch
         S = self.max_seq
         nkv, hd = cfg.num_key_value_heads, cfg.head_dim
@@ -574,7 +794,8 @@ class ContinuousBatchingEngine:
 
         x, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
                                            write, mask, cos, sin,
-                                           attend_fn=attend_fn)
+                                           attend_fn=attend_fn,
+                                           tp_axis=self._tp_axis)
         return _inf.lm_head_logits(cfg, params, x[:, -1]), ak, av
 
     def _sample_tokens(self, logits, pos, temp, topp, seeds):
@@ -682,7 +903,7 @@ class ContinuousBatchingEngine:
         from .. import inference as _inf
         from ..ops.pallas import rope as rope_mod
 
-        cfg = self.cfg
+        cfg = self._body_cfg    # TP: tp-local head counts (else self.cfg)
         S = self.max_seq
         x = jnp.take(params["embed"], ids, axis=0).astype(cfg.dtype)
         cos_full, sin_full = rope_mod.rope_cos_sin(S, cfg.head_dim,
@@ -701,13 +922,14 @@ class ContinuousBatchingEngine:
         kv_pos = jnp.arange(S)[None, None, None, None, :]
         mask = (kv_pos <= q_pos) & (kv_pos < length)
         _, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
-                                           write, mask, cos, sin)
+                                           write, mask, cos, sin,
+                                           tp_axis=self._tp_axis)
         return ak, av
 
     def _prefill_impl(self, params, ids, cache_k, cache_v, slot, length, bucket):
         """Prefill one request (batch 1, prompt padded to ``bucket``) directly
         into lane ``slot`` of the (donated) cache pools."""
-        cfg = self.cfg
+        cfg = self._body_cfg    # TP: tp-local head counts (else self.cfg)
         S = self.max_seq
         nkv = cfg.num_key_value_heads
 
@@ -737,7 +959,7 @@ class ContinuousBatchingEngine:
         """Prefill into the slot's pages: prompt position j writes page
         table_row[j // bs] offset j % bs; padding positions whose page is
         the unallocated sentinel drop (and are masked from attention)."""
-        cfg = self.cfg
+        cfg = self._body_cfg    # TP: tp-local head counts (else self.cfg)
         S = self.max_seq
         bs_ = self.block_size
         nkv, hd = cfg.num_key_value_heads, cfg.head_dim
@@ -769,7 +991,7 @@ class ContinuousBatchingEngine:
         decode position's block is private too).  Embed/rope/mask come from
         the shared ``_prefill_body`` (its ``start`` mode) — only the
         position-offset page scatter lives here."""
-        cfg = self.cfg
+        cfg = self._body_cfg    # TP: tp-local head counts (else self.cfg)
         S = self.max_seq
         bs_ = self.block_size
         nkv, hd = cfg.num_key_value_heads, cfg.head_dim
@@ -808,7 +1030,7 @@ class ContinuousBatchingEngine:
         from ..ops import decode_attention as _da
         from ..ops.pallas import rope as rope_mod
 
-        cfg = self.cfg
+        cfg = self._body_cfg    # TP: tp-local head counts (else self.cfg)
         B = self.max_batch
         S = self.max_seq
         Q = tokens.shape[1]
@@ -852,7 +1074,8 @@ class ContinuousBatchingEngine:
 
         x, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
                                            write, None, cos, sin,
-                                           attend_fn=attend_fn)
+                                           attend_fn=attend_fn,
+                                           tp_axis=self._tp_axis)
         return _inf.lm_head_logits(cfg, params, x), ak, av
 
     def _verify_impl_paged(self, params, cache_k, cache_v, tokens, pos,
@@ -930,7 +1153,7 @@ class ContinuousBatchingEngine:
         from ..ops import decode_attention as _da
         from ..ops.pallas import rope as rope_mod
 
-        cfg = self.cfg
+        cfg = self._body_cfg    # TP: tp-local head counts (else self.cfg)
         B = self.max_batch
         S = self.max_seq
         T = tokens.shape[1]
@@ -973,7 +1196,8 @@ class ContinuousBatchingEngine:
 
         x, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
                                            write, None, cos, sin,
-                                           attend_fn=attend_fn)
+                                           attend_fn=attend_fn,
+                                           tp_axis=self._tp_axis)
         last = jnp.take_along_axis(
             x, (q_lens - 1).astype(jnp.int32)[:, None, None], axis=1)[:, 0]
         return _inf.lm_head_logits(cfg, params, last), ak, av
@@ -1638,6 +1862,37 @@ class ContinuousBatchingEngine:
             self._terminal(req, "CANCELLED", "cancelled by caller")
         return True
 
+    def _topology(self) -> dict:
+        """Engine topology/config fingerprint journaled into snapshots
+        (v2): everything a restore target must agree on for the journal to
+        be replayable — the model identity (a mismatched model would
+        teacher-force the wrong logits silently), serving geometry
+        (max_seq/paged/block_size/quant) — plus the tp degree, which is
+        recorded for diagnosis but deliberately NOT enforced: the KV pool
+        is never captured, so teacher-forced recompute makes a
+        cross-degree restore token-identical by construction
+        (docs/tp_serving.md)."""
+        cfg = self.cfg
+        # every field that changes the teacher-forced recompute's logits
+        # belongs in the id — shapes alone would let a rope_theta or dtype
+        # mismatch resume silently wrong
+        return {
+            "model": (f"llama:v{cfg.vocab_size}:h{cfg.hidden_size}"
+                      f":L{cfg.num_hidden_layers}"
+                      f":nh{cfg.num_attention_heads}"
+                      f":nkv{cfg.num_key_value_heads}"
+                      f":i{cfg.intermediate_size}"
+                      f":tie{int(bool(cfg.tie_word_embeddings))}"
+                      f":dt{jnp.dtype(cfg.dtype).name}"
+                      f":rope{cfg.rope_theta:g}"
+                      f":eps{cfg.rms_norm_eps:g}"),
+            "quant": self.quant,
+            "paged": self.paged,
+            "block_size": self.block_size if self.paged else None,
+            "max_seq": int(self.max_seq),
+            "tp": int(self.tp),
+        }
+
     def snapshot(self) -> dict:
         """Serialize accepted-but-unfinished work: queue order plus a
         per-request journal (prompt, emitted tokens, sampling params,
@@ -1646,7 +1901,11 @@ class ContinuousBatchingEngine:
         teacher-forced recompute (the preemption path), which is exact for
         greedy AND seeded sampling, so a snapshot costs bytes proportional
         to the token streams, not the HBM pool.  The replica-restart
-        primitive the fleet tier needs (ROADMAP item 2)."""
+        primitive the fleet tier needs (ROADMAP item 2).
+
+        v2 adds the ``engine`` topology block (:meth:`_topology`) so
+        :meth:`restore` can refuse a mismatched replica instead of
+        resuming silently wrong."""
 
         def journal(req, prefilled=0):
             return {
@@ -1674,7 +1933,8 @@ class ContinuousBatchingEngine:
             if self.paged:
                 running.sort(key=lambda s: int(self._slot_age[s]))
             return {
-                "version": 1,
+                "version": 2,
+                "engine": self._topology(),
                 "running": [journal(self._slot_req[s],
                                     self._prefilled[s] if self._chunked
                                     else 0)
@@ -1691,10 +1951,36 @@ class ContinuousBatchingEngine:
         a serve completed after restore() emits token-identical output to
         one that was never interrupted.  Deadlines restart from restore
         time (the dead replica's clock is gone).  Returns the resumed
-        Request objects (in admission order: running work first)."""
-        if snap.get("version") != 1:
+        Request objects (in admission order: running work first).
+
+        v2 snapshots carry the source engine's topology (:meth:`_topology`)
+        and restore onto a mismatched engine raises a diagnosable
+        ``ValueError`` naming every differing field — a journal replayed
+        through the wrong model or serving geometry would resume silently
+        wrong.  The ONE deliberate exception is the tensor-parallel
+        degree: the journal holds tokens, not KV bytes, and teacher-forced
+        recompute is degree-independent, so a tp=4 snapshot legally
+        restores onto a tp=1 (or tp=2) replica token-identically — the
+        fleet-tier elasticity primitive.  v1 snapshots (pre-topology)
+        restore as before, unchecked."""
+        if snap.get("version") not in (1, 2):
             raise ValueError(f"unknown snapshot version "
-                             f"{snap.get('version')!r} (expected 1)")
+                             f"{snap.get('version')!r} (expected 1 or 2)")
+        src = snap.get("engine")
+        if snap.get("version") == 2 and src is not None:
+            mine = self._topology()
+            mismatch = {k: (src.get(k), mine[k]) for k in mine
+                        if k != "tp" and src.get(k) != mine[k]}
+            if mismatch:
+                diff = "; ".join(
+                    f"{k}: snapshot={a!r} vs engine={b!r}"
+                    for k, (a, b) in sorted(mismatch.items()))
+                raise ValueError(
+                    f"snapshot topology does not match this engine "
+                    f"({diff}); restoring across topologies would resume "
+                    f"silently wrong — only the tensor-parallel degree "
+                    f"may differ (snapshot tp={src.get('tp')!r}, engine "
+                    f"tp={self.tp})")
         with RecordEvent("serving/restore"):
             out: list[Request] = []
             for j in snap["running"] + snap["queued"]:

@@ -124,22 +124,115 @@ def param_specs(cfg: LlamaConfig, pp: bool = False, mp: int = 1) -> dict:
     # replicate unless mp divides the kv heads evenly (mp > kv_heads is the
     # common case, but any non-dividing mp sub-head-splits too)
     kv_col = None if cfg.num_key_value_heads % mp != 0 else "mp"
+
+    def mat(name):
+        # column/row assignment comes from the shared Megatron table
+        # (MEGATRON_SPLIT) — the same one serving_param_specs reads
+        tensor = kv_col if name in ("wk", "wv") else "mp"
+        if MEGATRON_SPLIT[name] == "col":
+            return P(layer_dim, "sharding", tensor)
+        return P(layer_dim, tensor, "sharding")
+
     return {
         "embed": P("mp", "sharding"),          # vocab-parallel embedding
         "final_norm": P(None),
         "layers": {
             "input_norm": P(layer_dim, None),
             "post_norm": P(layer_dim, None),
-            "wq": P(layer_dim, "sharding", "mp"),   # column parallel
-            "wk": P(layer_dim, "sharding", kv_col),
-            "wv": P(layer_dim, "sharding", kv_col),
-            "wo": P(layer_dim, "mp", "sharding"),   # row parallel
-            "w_gate": P(layer_dim, "sharding", "mp"),
-            "w_up": P(layer_dim, "sharding", "mp"),
-            "w_down": P(layer_dim, "mp", "sharding"),
+            **{name: mat(name) for name in MEGATRON_SPLIT},
         },
         "lm_head": P("sharding", "mp"),
     }
+
+
+#: the Megatron split per decoder matmul leaf — the ONE table the training
+#: specs above and the serving TP specs below both read, so the two spec
+#: surfaces cannot disagree about which dim a weight shards on.
+#: 'col' = ColumnParallelLinear (output dim over the tensor axis),
+#: 'row' = RowParallelLinear (input dim over the tensor axis).
+MEGATRON_SPLIT = {"wq": "col", "wk": "col", "wv": "col",
+                  "w_gate": "col", "w_up": "col",
+                  "wo": "row", "w_down": "row"}
+
+
+def serving_param_specs(cfg: LlamaConfig, quant: str | None = None,
+                        axis: str = "tp") -> dict:
+    """PartitionSpecs for the SERVING param tree over a 1-D ``(axis,)`` mesh
+    (docs/tp_serving.md) — the continuous-batching engine's
+    ``tensor_parallel=N`` mode.
+
+    Unlike the training map (:func:`param_specs`), serving keeps the
+    residual stream, embedding, norms and lm_head REPLICATED: every shard
+    computes the full [B, V] logits row identically, so the sampler and the
+    host scheduler see exactly the single-chip values and the only
+    cross-shard traffic is the two per-layer psums
+    (:func:`decoder_attn_residual` / :func:`decoder_mlp_residual`).
+    Column-parallel leaves split heads/ffn on their OUTPUT dim, row-parallel
+    ones their INPUT dim (:data:`MEGATRON_SPLIT`); K/V projections split
+    along kv_heads — the same axis the paged KV pool shards on, which is
+    what keeps the paged-attention kernels' page walk shard-local.
+
+    ``quant`` (None | 'int8' | 'int4'): the engine's weight-only mode stores
+    matmul leaves as ``{'qweight': [L, out, in], 'scale': [L, out]}``
+    (nn/quant layout) — the split dim maps through the transpose, and a
+    row-parallel leaf's per-out-channel scales replicate so dequant-on-read
+    stays shard-local."""
+    def leaf(name):
+        split = MEGATRON_SPLIT.get(name)
+        if split == "col":
+            return ({"qweight": P(None, axis, None), "scale": P(None, axis)}
+                    if quant else P(None, None, axis))
+        if split == "row":
+            return ({"qweight": P(None, None, axis), "scale": P(None, None)}
+                    if quant else P(None, axis, None))
+        return P()      # norms: replicated
+    specs = {
+        "embed": P(),
+        "final_norm": P(),
+        "layers": {k: leaf(k) for k in
+                   ("input_norm", "post_norm", "wq", "wk", "wv", "wo",
+                    "w_gate", "w_up", "w_down")},
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P()
+    return specs
+
+
+def _tp_psum(y, tp_axis, scope):
+    """The tensor-parallel all-reduce boundary.  ``tp_axis=None`` is the
+    single-chip path (no collective, byte-identical program); with an axis
+    name the caller is inside a shard_map region holding a row-parallel
+    partial sum.  The named scope lands in HLO op_name metadata so the
+    analysis resharding rule can allowlist exactly these two collectives
+    per layer and flag everything else (docs/tp_serving.md)."""
+    if tp_axis is None:
+        return y
+    with jax.named_scope(scope):
+        return jax.lax.psum(y, tp_axis)
+
+
+def decoder_attn_residual(x, attn, lp, wmat=None, tp_axis=None):
+    """Attention output projection + residual — ONE home for serving
+    (inference.transformer_apply) and training (``_layer_forward`` here and
+    in moe_llama), so the Megatron row-parallel contract cannot drift:
+    ``wo`` splits its INPUT (heads) dim over tp, each shard's
+    ``attn_local @ wo_local`` is a partial sum, and the psum here is TP
+    boundary 1 of the layer's exactly-two.  ``wmat(leaf, dtype)`` resolves
+    weight-only-quantized leaves (serving); None reads the leaf raw."""
+    wo = lp["wo"] if wmat is None else wmat(lp["wo"], x.dtype)
+    return x + _tp_psum(attn @ wo, tp_axis, "tp_allreduce_attn_out")
+
+
+def decoder_mlp_residual(cfg, x, lp, wmat=None, tp_axis=None):
+    """post-norm + swiglu MLP + residual, the layer's second half and TP
+    boundary 2: w_gate/w_up are column-parallel (each shard computes a
+    ffn/tp slice), w_down row-parallel, and the psum completes the down
+    projection.  Shared by serving and training like
+    :func:`decoder_attn_residual`."""
+    w = (lambda n: lp[n] if wmat is None else wmat(lp[n], x.dtype))
+    xn = rms.rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+    y = swiglu_mod.swiglu(xn @ w("w_gate"), xn @ w("w_up")) @ w("w_down")
+    return x + _tp_psum(y, tp_axis, "tp_allreduce_mlp_out")
 
 
 def _layer_forward(cfg: LlamaConfig, x, layer_params, cos, sin, use_flash=True,
@@ -163,15 +256,8 @@ def _layer_forward(cfg: LlamaConfig, x, layer_params, cos, sin, use_flash=True,
         attn = fa.flash_attention_bshd(q, kk, vv, causal=True)
     else:
         attn = fa._composed_attention(q, kk, vv, None, True, 1.0 / math.sqrt(hd))
-    attn = attn.reshape(b, s, nh * hd)
-    x = x + attn @ lp["wo"]
-
-    # mlp (swiglu)
-    xn = rms.rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-    gate = xn @ lp["w_gate"]
-    up = xn @ lp["w_up"]
-    x = x + swiglu_mod.swiglu(gate, up) @ lp["w_down"]
-    return x
+    x = decoder_attn_residual(x, attn.reshape(b, s, nh * hd), lp)
+    return decoder_mlp_residual(cfg, x, lp)
 
 
 def _embed_rope(cfg: LlamaConfig, params, input_ids):

@@ -160,6 +160,52 @@ def param_specs(cfg: MoEConfig, mp: int = 1) -> dict:
     }
 
 
+def serving_param_specs(cfg: MoEConfig, axis: str = "tp") -> dict:
+    """Serving-mesh TP *placement layout* for the MoE param tree: residual
+    stream / embed / norms / router / lm_head replicated, attention split
+    along (kv_)heads via the shared MEGATRON_SPLIT table, shared-expert ffn
+    column/row-split, routed experts split on the EXPERT dim (expert
+    compute shard-local, all-to-all dispatch/combine between shards).
+
+    WEIGHT LAYOUT ONLY — no forward in this module consumes it yet: the
+    continuous-batching engine's TP mode (docs/tp_serving.md) runs the
+    dense llama decoder, whose shard_map bodies insert the per-layer psum
+    boundaries themselves (llama.decoder_attn_residual /
+    decoder_mlp_residual).  A sharded MoE serve additionally needs those
+    reductions plus the expert dispatch collectives wired into
+    ``_layer_forward``/``moe_ffn`` — the fleet-tier work this layout is
+    staged for (ROADMAP item 2).  Sharding params with these specs and
+    calling the existing single-chip forward inside a manual mesh region
+    would produce unreduced partial sums."""
+    from .llama import MEGATRON_SPLIT
+
+    def mat(name):
+        if MEGATRON_SPLIT[name] == "col":
+            return P(None, None, axis)
+        return P(None, axis, None)
+
+    return {
+        "embed": P(),
+        "final_norm": P(),
+        "lm_head": P(),
+        "layers": {
+            "input_norm": P(None, None),
+            "post_norm": P(None, None),
+            "wq": mat("wq"), "wk": mat("wk"), "wv": mat("wv"),
+            "wo": mat("wo"),
+            # shared (dense) experts: same column/row split as llama's mlp
+            "s_gate": P(None, None, axis),
+            "s_up": P(None, None, axis),
+            "s_down": P(None, axis, None),
+            "router": P(None, None, None),      # replicated: routing must
+                                                # agree across shards
+            "e_gate": P(None, axis, None, None),   # expert dim over tp
+            "e_up": P(None, axis, None, None),
+            "e_down": P(None, axis, None, None),
+        },
+    }
+
+
 # auto dispatch switches to the sort path above this expert count: at E<=8
 # the dense one-hot einsums are small and shard perfectly over EP meshes; past
 # that the O(tokens*E*C) dispatch FLOPs dominate step time (round-3 verdict:
@@ -336,7 +382,12 @@ def _layer_forward(cfg: MoEConfig, x, lp, cos, sin, use_flash=True):
         import math
 
         attn = fa._composed_attention(q, kk, vv, None, True, 1.0 / math.sqrt(hd))
-    x = x + attn.reshape(b, s, nh * hd) @ lp["wo"]
+    # shared sharded decoder half (models/llama.py): the attention output
+    # projection + residual — and, under tensor parallelism, TP boundary 1 —
+    # have one home for the dense and MoE decoders alike
+    from .llama import decoder_attn_residual
+
+    x = decoder_attn_residual(x, attn.reshape(b, s, nh * hd), lp)
 
     xn = rms.rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
     shared = swiglu_mod.swiglu(xn @ lp["s_gate"], xn @ lp["s_up"]) @ lp["s_down"]

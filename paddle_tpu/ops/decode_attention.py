@@ -19,6 +19,18 @@ only each slot's live pages; the gather oracle/fallback lives in
 functions are functional:
 caches are inputs AND outputs (donated under jit), matching JAX's
 no-mutation model rather than the reference's in-place `_` ops.
+
+Tensor parallelism (docs/tp_serving.md): the serving engine's
+``tensor_parallel`` mode calls these front doors from INSIDE a shard_map
+region over a 1-D ("tp",) mesh, with ``num_kv_heads`` (and the grouped
+query heads) already tp-LOCAL slices — the KV pools shard along kv_heads,
+block tables and seq_lens replicate, and since attention is independent
+per kv-head group and the GQA ratio nh/nkv is tp-invariant, every function
+here (and the Pallas kernels they dispatch to) runs byte-unchanged
+per-shard with zero collectives.  No axis_name ever reaches this layer by
+design: the only cross-shard traffic of the TP step lives at the decoder's
+two psum boundaries (models/llama.decoder_attn_residual /
+decoder_mlp_residual).
 """
 
 from __future__ import annotations

@@ -62,6 +62,15 @@ mask), never the later rows.  Unlike verify it also carries the decode
 kernel's dequant-on-read for int8 / packed-int4 KV pages (a KV-quantized
 pool must be prefillable through the same kernel family that decodes it).
 Separate KERNEL/FALLBACK counters; decode and verify stay byte-untouched.
+
+Tensor-parallel serving (docs/tp_serving.md) needs NO kernel variant: the
+engine shards the KV pools along kv_heads and calls the kernel family
+inside a shard_map region with tp-local head counts — the grid's kv_heads
+dim simply shrinks, the block-table page walk (pages address the UNSHARDED
+num_blocks axis) and the per-(slot, head) online softmax are untouched, and
+``kernel_supported`` evaluates on the local counts (head_dim and the GQA
+ratio are tp-invariant, so support never changes with the degree).  All
+three kernel bodies are byte-identical to the single-chip engine's.
 """
 
 from __future__ import annotations

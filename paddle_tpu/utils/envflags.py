@@ -34,7 +34,15 @@ through :func:`env_bool`, which enforces the '0'/'1' vocabulary):
 with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``.
 ``PADDLE_TPU_FAULT_INJECT`` is the structured fault-injection plan; its
 clause grammar is validated by :func:`env_fault_spec` and its fault-kind
-vocabulary lives with the injector — inference/faults.py ``KNOWN_KINDS``.)
+vocabulary lives with the injector — inference/faults.py ``KNOWN_KINDS``.
+``PADDLE_TPU_TP`` is the integer tensor-parallel override for the serving
+engine (docs/tp_serving.md): when set it REPLACES the
+``ContinuousBatchingEngine(tensor_parallel=...)`` ctor value, the
+operator's one-knob way to fan an existing deployment across a mesh.
+Validated by :func:`env_tp`: a non-integer value, a degree that does not
+divide the model's kv_heads, or a degree exceeding the device count warns
+once — naming the valid divisors — and falls back to 1 (single chip), the
+same never-silently-misconfigure contract as the switches above.)
 """
 
 from __future__ import annotations
@@ -43,7 +51,8 @@ import difflib
 import os
 import warnings
 
-__all__ = ["env_token_set", "env_bool", "env_fault_spec", "BOOL_FLAGS"]
+__all__ = ["env_token_set", "env_bool", "env_fault_spec", "env_tp",
+           "BOOL_FLAGS"]
 
 #: '0'/'1' switches -> their library defaults (documentation + test anchor;
 #: callers still pass the default explicitly at the read site so a flag read
@@ -102,6 +111,45 @@ def env_bool(name: str, default: bool) -> bool:
                f"{name}={raw!r} is not '0' or '1'; using the default "
                f"({'1' if default else '0'})")
     return default
+
+
+def env_tp(kv_heads: int, device_count: int,
+           name: str = "PADDLE_TPU_TP") -> int | None:
+    """Tensor-parallel degree override for the serving engine.  Returns
+    None when the variable is unset (the ctor's ``tensor_parallel`` value
+    stands); otherwise the validated degree.  An invalid value — not an
+    integer, < 1, not a divisor of ``kv_heads`` (the paged KV pool and the
+    K/V projections shard along kv_heads, so a non-divisor would sub-head
+    split), or more shards than devices — warns ONCE naming the valid
+    degrees and falls back to 1: an operator typo must degrade to the
+    single-chip engine, never crash the serve or silently sub-shard."""
+    raw = os.environ.get(name, "")
+    if raw == "":
+        return None
+    valid = sorted(d for d in range(1, max(kv_heads, 1) + 1)
+                   if kv_heads % d == 0 and d <= device_count)
+
+    def _fallback(msg: str) -> int:
+        _warn_once(name, raw,
+                   f"{name}={raw!r}: {msg}; falling back to tensor_parallel"
+                   f"=1 (valid degrees for kv_heads={kv_heads} on "
+                   f"{device_count} device(s): {valid})")
+        return 1
+
+    try:
+        tp = int(raw)
+    except ValueError:
+        return _fallback("not an integer")
+    if tp < 1:
+        return _fallback(f"degree {tp} < 1")
+    if kv_heads % tp != 0:
+        return _fallback(f"degree {tp} does not divide kv_heads={kv_heads} "
+                         f"(a sub-head split would break the shard-local "
+                         f"paged-attention page walk)")
+    if tp > device_count:
+        return _fallback(f"degree {tp} exceeds the {device_count} visible "
+                         f"device(s)")
+    return tp
 
 
 def env_fault_spec(name: str, known_kinds, known_keys) -> list[dict]:

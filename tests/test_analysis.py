@@ -224,6 +224,33 @@ def test_resharding_positive_implicit_all_gather(eight_devices):
     assert "all-gather" in hits[0].message
 
 
+def test_resharding_positive_large_all_reduce(eight_devices):
+    """Deliberate reduction boundaries are reported too (ISSUE 8): a psum
+    inside shard_map — the TP serving engine's per-layer boundary shape —
+    must surface as an all-reduce finding so only a reasoned allowlist
+    entry can keep it (the serving_tp_step gate pins exactly two)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh1d(eight_devices)
+    fn = jax.jit(shard_map(
+        lambda a, b: jax.lax.psum(a @ b, "x"), mesh=mesh,
+        in_specs=(P(None, "x"), P("x", None)), out_specs=P(None, None),
+        check_rep=False))
+    # committed sharded operands, the TP engine's calling convention (the
+    # rule reads the mesh off the args)
+    a = jax.device_put(jnp.ones((64, 16)),
+                       NamedSharding(mesh, P(None, "x")))
+    b = jax.device_put(jnp.ones((16, 32)),
+                       NamedSharding(mesh, P("x", None)))
+    r = analyze(fn, a, b,
+                rules=("resharding",), allowlist=[], min_gather_bytes=1024)
+    hits = r.by_rule("resharding")
+    assert hits, "a large deliberate all-reduce must be reported"
+    assert "all-reduce" in hits[0].message
+    assert "allowlist" in hits[0].message
+
+
 def test_resharding_negative_sharding_composes(eight_devices):
     from jax.sharding import NamedSharding, PartitionSpec as P
 

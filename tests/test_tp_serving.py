@@ -1,0 +1,371 @@
+"""Tensor-parallel serving tests (ISSUE 8, docs/tp_serving.md).
+
+The correctness bar: ``tensor_parallel=N`` over the conftest's forced
+8-device CPU mesh must be TOKEN-IDENTICAL to the single-chip engine —
+greedy AND seeded sampling — with every composed feature (prefix cache,
+speculation, chunked prefill, graceful degradation) exercised under TP,
+and TP=1 must build the byte-identical pre-TP engine (no mesh, no
+shard_map, same jaxpr).  Host-side state (allocator, block tables,
+scheduler) is degree-invariant: the pool shards only kv_heads, so
+accounting closes exactly on every shard.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
+from paddle_tpu.models import llama
+
+# kv_heads=4 so every degree in the acceptance matrix {1, 2, 4} divides;
+# head_dim = 64/8 = 8 keeps the Pallas kernels' shape support; f32 for
+# exact-parity comparisons (the perf path runs bf16 anyway)
+_CFG = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=8,
+                              kv_heads=4, inter=128)
+_CFG.dtype = jnp.float32
+_PARAMS = None
+
+
+def _tiny():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = llama.init_params(_CFG, jax.random.key(0))
+    return _CFG, _PARAMS
+
+
+def _engine(tp, **kw):
+    cfg, params = _tiny()
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("block_size", 8)
+    return ContinuousBatchingEngine(cfg, params, paged=True,
+                                    tensor_parallel=tp, **kw)
+
+
+def _pool_closes(eng):
+    cached = (list(eng._pcache.resident_pages())
+              if eng._pcache is not None else [])
+    private = [p for row in eng._slot_blocks for p in row]
+    assert sorted(eng._free + cached + private) == list(
+        range(eng.num_blocks))
+
+
+# ---------------- token identity across degrees ----------------
+
+def _mixed_requests():
+    rs = np.random.RandomState(3)
+    shared = np.arange(16, dtype=np.int32)
+    reqs = []
+    for i in range(4):
+        tail = rs.randint(0, 128, (6,)).astype(np.int32)
+        reqs.append(Request(rid=i, prompt_ids=np.concatenate([shared, tail]),
+                            max_new_tokens=8,
+                            temperature=0.7 if i % 2 else 0.0, seed=11 + i))
+    # a long prompt that streams through the chunked-prefill mixed step
+    reqs.append(Request(rid=99,
+                        prompt_ids=rs.randint(0, 128, (40,))
+                        .astype(np.int32), max_new_tokens=5))
+    return reqs
+
+
+def test_tp_token_identity_all_features(monkeypatch):
+    """The acceptance matrix: TP in {1, 2, 4}, prefix cache + speculation +
+    chunked prefill all enabled, greedy and seeded sampled requests in one
+    batch — token-identical streams, identical feature counters, identical
+    n_traces (TP adds no compile variants), audit green, pool closes."""
+    monkeypatch.setenv("PADDLE_TPU_ENGINE_AUDIT", "1")
+    outs, stats, traces = {}, {}, {}
+    for tp in (1, 2, 4):
+        eng = _engine(tp, num_blocks=24, enable_prefix_caching=True,
+                      enable_speculation=True, num_draft_tokens=3,
+                      enable_chunked_prefill=True, prefill_chunk=8)
+        outs[tp] = eng.serve(_mixed_requests())
+        stats[tp] = {k: eng.stats[k] for k in
+                     ("prefix_hits", "mixed_steps", "spec_steps",
+                      "decode_steps", "preemptions")}
+        traces[tp] = eng.n_traces()
+        _pool_closes(eng)
+    assert outs[1] == outs[2] == outs[4]
+    assert stats[1] == stats[2] == stats[4]
+    # n_traces must NOT grow with the degree: TP wraps the byte-same
+    # per-shard programs in shard_map, it does not add variants
+    assert traces[1] == traces[2] == traces[4]
+
+
+def test_tp1_engine_is_byte_identical():
+    """tensor_parallel=1 must construct the pre-TP engine: no mesh, and the
+    compiled decode program traces the identical jaxpr (compared modulo
+    closure memory addresses, the only nondeterminism in jaxpr printing)."""
+    e0 = _engine(1)
+    ed = ContinuousBatchingEngine(*_tiny(), max_batch=2, max_seq=64,
+                                  paged=True, block_size=8)
+    assert e0._mesh is None and e0.tp == 1
+    B = 2
+    args = (ed.params, ed.cache_k, ed.cache_v, jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B,), jnp.int32), jnp.ones((B,), bool),
+            jnp.zeros((B,), jnp.float32), jnp.ones((B,), jnp.float32),
+            jnp.zeros((B,), jnp.int32), jnp.asarray(ed._table))
+    wash = lambda s: re.sub(r"0x[0-9a-f]+", "0x", s)
+    j_default = wash(str(jax.make_jaxpr(ed._decode_greedy)(*args)))
+    j_tp1 = wash(str(jax.make_jaxpr(e0._decode_greedy)(*args)))
+    assert j_default == j_tp1
+
+
+# ---------------- composed features under TP ----------------
+
+def test_tp_prefix_cache_hit_and_cow():
+    """Block-aligned identical prompts under tp=2: full match + COW copy of
+    the last matched block, streams identical to the cache-on tp=1 engine,
+    divergent seeded continuations stay divergent."""
+    rs = np.random.RandomState(3)
+    prompt = rs.randint(0, 128, (16,)).astype(np.int32)  # exactly 2 blocks
+
+    def warm():
+        return [Request(rid=0, prompt_ids=prompt, max_new_tokens=6)]
+
+    def build():
+        return [Request(rid=1, prompt_ids=prompt, max_new_tokens=6,
+                        temperature=1.1, seed=5),
+                Request(rid=2, prompt_ids=prompt, max_new_tokens=6,
+                        temperature=1.1, seed=9)]
+
+    res = {}
+    for tp in (1, 2):
+        eng = _engine(tp, max_batch=3, num_blocks=12,
+                      enable_prefix_caching=True)
+        res[tp] = {**eng.serve(warm()), **eng.serve(build())}
+        assert eng.stats["cow_copies"] >= 2, tp
+        assert eng.stats["prefix_hits"] >= 2, tp
+        _pool_closes(eng)
+    assert res[1] == res[2]
+    assert res[2][1] != res[2][2]    # seeds diverge through shared prefix
+
+
+def test_tp_speculation_accept_and_reject():
+    """Cyclic greedy output under tp=2: the n-gram drafter accepts runs
+    (fewer device steps than tokens) and rejections roll back — streams
+    token-identical to the spec-off tp=2 engine and the tp=1 spec engine."""
+    rs = np.random.RandomState(7)
+    prompts = [rs.randint(0, 128, (7,)).astype(np.int32) for _ in range(2)]
+
+    def build():
+        return [Request(rid=i, prompt_ids=p, max_new_tokens=40)
+                for i, p in enumerate(prompts)]
+
+    base = _engine(2, max_seq=128, num_blocks=32)
+    ref = base.serve(build())
+    got_by_tp = {}
+    for tp in (1, 2):
+        spec = _engine(tp, max_seq=128, num_blocks=32,
+                       enable_speculation=True, num_draft_tokens=4)
+        got_by_tp[tp] = spec.serve(build())
+        assert spec.stats["spec_drafted_tokens"] > 0, tp
+        assert spec.stats["spec_accepted_tokens"] > 0, tp
+        if tp == 2:
+            # the speculative win survives sharding: fewer round-trips
+            assert (spec.stats["decode_steps"]
+                    < base.stats["decode_steps"])
+    assert got_by_tp[2] == ref
+    assert got_by_tp[1] == got_by_tp[2]
+
+
+def test_tp_chunked_prefill_mid_stream():
+    """A near-max prompt arrives while short requests decode (the stall
+    regime): under tp=2 the prompt streams through mixed steps and every
+    stream matches tp=1; decode never stalls."""
+    rs = np.random.RandomState(5)
+    short = [rs.randint(0, 128, (6,)).astype(np.int32) for _ in range(2)]
+    long_p = rs.randint(0, 128, (40,)).astype(np.int32)
+
+    def run(tp):
+        eng = _engine(tp, num_blocks=20, enable_chunked_prefill=True,
+                      prefill_chunk=8)
+        reqs = [Request(rid=i, prompt_ids=p, max_new_tokens=10)
+                for i, p in enumerate(short)]
+        for r in reqs:
+            eng.add_request(r)
+        for _ in range(3):
+            eng.step()           # short requests mid-decode
+        late = Request(rid=9, prompt_ids=long_p, max_new_tokens=4)
+        eng.add_request(late)
+        while eng.step() or eng._queue:
+            pass
+        assert eng.stats["mixed_steps"] > 0
+        assert eng.stats["decode_stall_steps"] == 0
+        _pool_closes(eng)
+        return {r.rid: r.output_ids for r in reqs + [late]}
+
+    assert run(1) == run(2)
+
+
+def test_tp_graceful_ladder_rung1_evicts(monkeypatch):
+    """Pool pressure with zero-ref cache residents under tp=2: rung 1
+    evicts leaves ahead of the allocator (degrade_evict ticks), nothing is
+    preempted or failed, and the stream matches tp=1."""
+    monkeypatch.setenv("PADDLE_TPU_ENGINE_AUDIT", "1")
+
+    def run(tp):
+        rs = np.random.RandomState(10)
+        eng = _engine(tp, num_blocks=8, enable_prefix_caching=True)
+        warm = Request(rid=0, prompt_ids=rs.randint(0, 128, (17,))
+                       .astype(np.int32), max_new_tokens=2)
+        eng.serve([warm])
+        assert eng._pcache.evictable_count() > 0
+        req = Request(rid=1, prompt_ids=rs.randint(0, 128, (30,))
+                      .astype(np.int32), max_new_tokens=30)
+        got = eng.serve([req])
+        assert req.status == "FINISHED" and len(got[1]) == 30
+        assert eng.stats["degrade_evict"] >= 1, tp
+        assert eng.stats["preemptions"] == 0
+        assert eng.stats["requests_failed"] == 0
+        return got
+
+    assert run(1) == run(2)
+
+
+# ---------------- sharding geometry / accounting ----------------
+
+def test_tp_pool_shards_only_kv_heads():
+    """The device pools shard kv_heads alone: every shard holds the WHOLE
+    page axis (the host allocator's accounting is exact per shard) and a
+    1/tp slice of kv heads; params follow the Megatron split."""
+    eng = _engine(4, num_blocks=16)
+    L = _CFG.num_hidden_layers
+    for pool in (eng.cache_k, eng.cache_v):
+        shards = pool.addressable_shards
+        assert len(shards) == 4
+        for sh in shards:
+            assert sh.data.shape == (L, 16, _CFG.num_key_value_heads // 4,
+                                     8, _CFG.head_dim)
+    # column-parallel wq: output (heads) dim split; row-parallel wo: input
+    wq = eng.params["layers"]["wq"]
+    wo = eng.params["layers"]["wo"]
+    nh_hd = _CFG.num_attention_heads * _CFG.head_dim
+    assert wq.addressable_shards[0].data.shape == (L, _CFG.hidden_size,
+                                                   nh_hd // 4)
+    assert wo.addressable_shards[0].data.shape == (L, nh_hd // 4,
+                                                   _CFG.hidden_size)
+    # lm_head / embed / norms replicated
+    assert eng.params["embed"].addressable_shards[0].data.shape == \
+        eng.params["embed"].shape
+
+
+def test_tp_int8_weight_only_parity():
+    """Weight-only int8 under TP: quantized {qweight, scale} leaves shard
+    through the transposed layout (dequant-on-read stays shard-local) and
+    the stream matches the single-chip int8 engine exactly."""
+    rs = np.random.RandomState(2)
+    reqs = lambda: [Request(rid=i, prompt_ids=rs2.randint(0, 128, (7,))
+                            .astype(np.int32), max_new_tokens=5)
+                    for i in range(2)]
+    outs = {}
+    for tp in (1, 2):
+        rs2 = np.random.RandomState(2)
+        outs[tp] = _engine(tp, quant="int8").serve(reqs())
+    assert outs[1] == outs[2]
+
+
+# ---------------- validation / env override ----------------
+
+def test_tp_ctor_validation_raises_with_divisors():
+    with pytest.raises(ValueError, match=r"valid divisors: \[1, 2, 4\]"):
+        _engine(3)
+    with pytest.raises(ValueError, match="requires paged=True"):
+        ContinuousBatchingEngine(*_tiny(), max_batch=2, max_seq=64,
+                                 paged=False, tensor_parallel=2)
+    # a caller's arithmetic bug (devices // n == 0) raises, never builds
+    # a nonsense-degree engine
+    with pytest.raises(ValueError, match=">= 1"):
+        _engine(0)
+
+
+def test_tp_env_override_and_fallback(monkeypatch):
+    import paddle_tpu.utils.envflags as envflags
+
+    # a valid override replaces the ctor value
+    monkeypatch.setenv("PADDLE_TPU_TP", "2")
+    assert _engine(1).tp == 2
+    # non-integer: warn once, fall back to 1
+    envflags._warned.clear()
+    monkeypatch.setenv("PADDLE_TPU_TP", "two")
+    with pytest.warns(UserWarning, match="not an integer"):
+        assert _engine(4).tp == 1
+    # non-divisor of kv_heads: warn with the valid degrees, fall back to 1
+    envflags._warned.clear()
+    monkeypatch.setenv("PADDLE_TPU_TP", "3")
+    with pytest.warns(UserWarning, match="does not divide kv_heads"):
+        assert _engine(4).tp == 1
+    # more shards than devices (a kv_heads-compatible degree, so the
+    # device check is the one that fires)
+    envflags._warned.clear()
+    monkeypatch.setenv("PADDLE_TPU_TP", "16")
+    with pytest.warns(UserWarning, match="exceeds"):
+        assert envflags.env_tp(kv_heads=16, device_count=8) == 1
+
+
+# ---------------- snapshot / restore topology ----------------
+
+def test_snapshot_records_topology_and_cross_degree_restore():
+    """Snapshot under tp=2 mid-serve, restore onto a tp=4 replica: the
+    journal carries the topology block, the cross-degree restore is legal
+    (teacher-forced recompute is degree-independent) and the completed
+    stream is token-identical to an uninterrupted tp=1 serve."""
+    rs = np.random.RandomState(3)
+    p = rs.randint(0, 128, (9,)).astype(np.int32)
+    mk = lambda: Request(rid=0, prompt_ids=p, max_new_tokens=8,
+                         temperature=0.6, seed=5)
+    ref = _engine(1).serve([mk()])
+    e1 = _engine(2)
+    r = mk()
+    e1.add_request(r)
+    for _ in range(3):
+        e1.step()
+    snap = e1.snapshot()
+    assert snap["version"] == 2
+    assert snap["engine"]["tp"] == 2
+    assert snap["engine"]["block_size"] == 8
+    assert snap["engine"]["model"].startswith("llama:v128:")
+    e2 = _engine(4)
+    restored = e2.restore(snap)
+    while e2.step() or e2._queue:
+        pass
+    assert restored[0].output_ids == ref[0]
+
+
+def test_restore_mismatched_topology_raises():
+    """A snapshot whose model id / geometry does not match the restoring
+    engine must raise a diagnosable error naming every differing field —
+    never resume silently wrong.  (Pre-topology v1 snapshots restore
+    unchecked, as before.)"""
+    eng = _engine(1)
+    snap = eng.snapshot()
+    bad = dict(snap)
+    bad["engine"] = dict(snap["engine"], model="llama:other", block_size=16)
+    with pytest.raises(ValueError) as ei:
+        _engine(1).restore(bad)
+    msg = str(ei.value)
+    assert "model" in msg and "block_size" in msg
+    assert "tensor-parallel degree" in msg     # points at the one legal diff
+    # v1 (no topology block) still restores
+    legacy = {"version": 1, "running": [], "queued": []}
+    assert _engine(1).restore(legacy) == []
+
+
+def test_restore_rejects_numerics_mismatch():
+    """The model id covers everything that changes the teacher-forced
+    recompute's logits — same shapes but a different rope_theta (or dtype)
+    must refuse to restore, not resume silently wrong."""
+    import dataclasses
+
+    snap = _engine(1).snapshot()
+    other_cfg = dataclasses.replace(_CFG, rope_theta=123.0)
+    other = ContinuousBatchingEngine(
+        other_cfg, llama.init_params(other_cfg, jax.random.key(0)),
+        max_batch=2, max_seq=64, paged=True, block_size=8)
+    with pytest.raises(ValueError, match="rope"):
+        other.restore(snap)

@@ -63,6 +63,12 @@ if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the multi-device target (serving_tp_step) needs a host mesh: force
+    # the virtual CPU device count like tests/conftest.py (pre-init only)
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
     import jax
 
     try:

@@ -69,6 +69,13 @@ TARGETS = {
     # and degradation-ladder trips in detail (docs/fault_tolerance.md)
     "cb_overload_degrade":
         "llama_cb_decode_tokens_per_sec/cb_overload_degrade",
+    # round-11 evidence rungs: tensor-parallel serving over a ("tp",) mesh
+    # (docs/tp_serving.md) — the SAME workload as the matched single-chip
+    # rung cb_full_chunk8_paged_kernel, at tp=2 and tp=4 (per-step
+    # all-reduce bytes, kernel counters and n_traces in detail); exact
+    # keys so one degree can never satisfy the other's evidence
+    "cb_tp2": "llama_cb_decode_tokens_per_sec/cb_tp2",
+    "cb_tp4": "llama_cb_decode_tokens_per_sec/cb_tp4",
 }
 
 
