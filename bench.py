@@ -954,6 +954,37 @@ def decode_ladder_main(compact: bool = False) -> int:
             log(f"cb tp rung {rung[0]} failed: {e}\n"
                 f"{traceback.format_exc()}")
             continue
+    # fleet rungs (ISSUE 9, docs/fleet_serving.md): open-loop arrivals over
+    # >= 3 full-feature replicas behind the prefix-affinity router, with ONE
+    # injected replica_crash mid-serve — headline is goodput AT the
+    # TTFT/TBT SLO (tokens/s over FINISHED requests that also met both
+    # latency bounds; ROADMAP item 2 says report goodput-at-SLO, not raw
+    # tokens/s, because a failover that wrecks tail latency should show).
+    # The cpu-smoke-sized rung runs on BOTH arms (it is the CI twin AND a
+    # cheap on-hardware fleet sanity rung, so its exact waiter key banks).
+    # (rung tuple: cfg, n_replicas, slots/replica, n_requests, prompt, new,
+    # max_seq, num_blocks, block_size, max_queue, arrive_every, fault_spec,
+    # ttft_slo_s, tbt_slo_s[, prefill_chunk])
+    # prompt sizes leave each family's shared prefix (prompt - 8 unique
+    # tail tokens) at >= one full block, so affinity routing has chains
+    smoke_fleet = ("cb_fleet_cpu_smoke", llama.LlamaConfig.tiny(), 3, 2, 8,
+                   20, 8, 64, 12, 8, 4, 1,
+                   "replica_crash@step=8,replica=1;"
+                   "replica_stall@replica=2,count=4",
+                   60.0, 60.0, 8)
+    fleet_rungs = ([
+        ("cb_fleet_chaos", full_cfg, 3, 8, 48, 96, 48, 512, 48, 64, 16, 2,
+         "replica_crash@step=40,replica=1", 10.0, 2.0, 32),
+        smoke_fleet,
+    ] if on_tpu else [smoke_fleet])
+    for rung in fleet_rungs:
+        try:
+            emit(run_cb_fleet_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"cb fleet rung {rung[0]} failed: {e}\n"
+                f"{traceback.format_exc()}")
+            continue
     return 0 if banked else 1
 
 
@@ -1208,6 +1239,151 @@ def run_cb_overload_rung(name, cfg, max_batch, n_requests, prompt, new,
                    "kernel_error_retries":
                        eng.stats["kernel_error_retries"],
                    "n_traces": eng.n_traces(),
+                   "backend": jax.default_backend()},
+    }
+
+
+def run_cb_fleet_rung(name, cfg, n_replicas, max_batch, n_requests, prompt,
+                      new, max_seq, num_blocks, block_size, max_queue,
+                      arrive_every, fault_spec, ttft_slo_s, tbt_slo_s,
+                      prefill_chunk=32):
+    """Fleet-serving rung (ISSUE 9, docs/fleet_serving.md): open-loop
+    arrivals (one new request every ``arrive_every`` fleet steps,
+    regardless of completions) over ``n_replicas`` full-feature replicas
+    behind the health-checked prefix-affinity FleetRouter, with replica-
+    scoped chaos (``fault_spec`` — at least one ``replica_crash``)
+    injected mid-serve.  Prompts draw from a few shared "system prompt"
+    families so cache-affinity routing has chains to key on.
+
+    Headline = goodput AT the SLO: tokens/s counting only requests that
+    FINISHED *and* met the ``ttft_slo_s`` / ``tbt_slo_s`` latency bounds
+    (max inter-token gap) — a failover that preserves streams but blows
+    the tail out of the SLO window must show up in the headline, not hide
+    in a raw-throughput number.  Router counters (routed_affinity /
+    routed_spill / failovers / hedges / replayed_tokens / fleet_rejected),
+    per-replica engine stats and final health states ride in detail."""
+    import os
+
+    import numpy as np
+    import jax
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.inference.fleet import FleetRouter
+    from paddle_tpu.inference.serving import Request, TERMINAL_STATUSES
+
+    log(f"cb fleet rung {name}: building ({n_replicas} replicas x "
+        f"{max_batch} slots, {n_requests} requests, spec={fault_spec!r})")
+    rs = np.random.RandomState(0)
+    params = llama.init_params(cfg, jax.random.key(0))
+    fleet = FleetRouter(cfg, params, n_replicas=n_replicas,
+                        max_batch=max_batch, max_seq=max_seq, chunk=1,
+                        paged=True, block_size=block_size,
+                        num_blocks=num_blocks,
+                        enable_prefix_caching=True,
+                        enable_speculation=True,
+                        enable_chunked_prefill=True,
+                        prefill_chunk=min(prompt, prefill_chunk),
+                        max_queue=max_queue)
+    del params
+    # warm EVERY replica's compiled programs (each engine jits its own
+    # partials): no XLA compile may land inside the timed chaos window
+    t_c = time.perf_counter()
+    for r, eng in enumerate(fleet.replicas):
+        eng.serve([Request(rid=-1 - r, prompt_ids=rs.randint(
+            0, cfg.vocab_size, (prompt,)).astype(np.int32),
+            max_new_tokens=2)])
+    log(f"cb fleet rung {name}: compile {time.perf_counter() - t_c:.1f}s")
+    for eng in fleet.replicas:
+        for key in ("decode_steps", "decode_tokens", "prefills",
+                    "prefill_chunks", "mixed_steps"):
+            eng.stats[key] = 0
+        eng.stats["decode_time_s"] = 0.0
+        eng._step_no = 0
+    # arm the chaos AFTER warmup, with the fleet-step clock reset: the
+    # plan's step keys are relative to the timed serve (the replayable
+    # contract a chaos run's evidence needs)
+    os.environ["PADDLE_TPU_FAULT_INJECT"] = fault_spec
+    try:
+        fleet._arm_faults_from_env()
+    finally:
+        os.environ.pop("PADDLE_TPU_FAULT_INJECT", None)
+    fleet._step_no = 0
+    # a few shared prompt families (multi-tenant system prompts): requests
+    # within a family share a prefix block chain — the router's affinity key
+    families = [rs.randint(0, cfg.vocab_size, (prompt,)).astype(np.int32)
+                for _ in range(4)]
+    reqs = []
+    for i in range(n_requests):
+        fam = families[i % len(families)]
+        p = np.concatenate([fam[:prompt - 8], rs.randint(
+            0, cfg.vocab_size, (8,)).astype(np.int32)])
+        reqs.append(Request(rid=i, prompt_ids=p, max_new_tokens=new))
+    pending = list(reqs)
+    seen = {r.rid: 0 for r in reqs}
+    arrivals: dict[int, list] = {r.rid: [] for r in reqs}
+    steps = 0
+    t0 = time.perf_counter()
+    while True:
+        busy = fleet.step()
+        steps += 1
+        now = time.perf_counter()
+        for r in reqs:
+            if len(r.output_ids) > seen[r.rid]:
+                seen[r.rid] = len(r.output_ids)
+                arrivals[r.rid].append(now)
+        if pending and steps % arrive_every == 0:
+            fleet.add_request(pending.pop(0))  # open loop: arrivals don't wait
+            continue
+        if not busy and not pending:
+            break
+    wall = time.perf_counter() - t0
+    statuses = {st: sum(1 for r in reqs if r.status == st)
+                for st in sorted(TERMINAL_STATUSES)}
+    assert sum(statuses.values()) == n_requests, statuses  # all terminal
+
+    def met_slo(r):
+        if r.status != "FINISHED" or r.ttft_s is None:
+            return False
+        if r.ttft_s > ttft_slo_s:
+            return False
+        gaps = [b_ - a for a, b_ in zip(arrivals[r.rid],
+                                        arrivals[r.rid][1:])]
+        return not gaps or max(gaps) <= tbt_slo_s
+
+    slo_ok = [r for r in reqs if met_slo(r)]
+    good_toks = sum(len(r.output_ids) for r in slo_ok)
+    replica_detail = [
+        None if eng is None else {
+            "decode_tokens": eng.stats["decode_tokens"],
+            "preemptions": eng.stats["preemptions"],
+            "prefix_hits": eng.stats["prefix_hits"],
+            "n_traces": eng.n_traces(),
+        } for eng in fleet.replicas]
+    return {
+        "metric": "llama_cb_decode_tokens_per_sec",
+        "value": round(good_toks / wall, 1) if wall > 0 else 0.0,
+        "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "detail": {"rung": name, "n_replicas": n_replicas,
+                   "slots_per_replica": max_batch,
+                   "requests": n_requests, "prompt": prompt,
+                   "new_tokens": new, "wall_s": round(wall, 2),
+                   "headline_is_goodput_at_slo": True,
+                   "ttft_slo_s": ttft_slo_s, "tbt_slo_s": tbt_slo_s,
+                   "slo_met_requests": len(slo_ok),
+                   "finished_requests": statuses["FINISHED"],
+                   "goodput_tokens": good_toks,
+                   "fault_spec": fault_spec,
+                   "max_queue": max_queue, "num_blocks": num_blocks,
+                   "statuses": statuses,
+                   "routed_affinity": fleet.stats["routed_affinity"],
+                   "routed_spill": fleet.stats["routed_spill"],
+                   "failovers": fleet.stats["failovers"],
+                   "hedges": fleet.stats["hedges"],
+                   "replayed_tokens": fleet.stats["replayed_tokens"],
+                   "fleet_rejected": fleet.stats["fleet_rejected"],
+                   "health": list(fleet.health),
+                   "replicas": replica_detail,
                    "backend": jax.default_backend()},
     }
 

@@ -48,6 +48,16 @@ I8  terminal ownership (docs/fault_tolerance.md) — a request in a terminal
     violation means a failed request's pages leaked or a zombie is still
     being scheduled.
 
+I9  fleet ownership (docs/fleet_serving.md; :func:`audit_fleet`, run by the
+    FleetRouter after every fleet step) — every LIVE fleet rid is owned by
+    exactly one replica: the owner is alive (not DEAD) and holds a
+    replica-local copy; a hedge-pending rid counts as the primary's until
+    first-writer-wins resolves, and its only extra copy lives on the
+    recorded hedge target; no replica engine serves a rid the router does
+    not route to it (a copy on a third replica is double ownership — the
+    fleet would bank one stream twice); terminal fleet requests appear in
+    no routing registry.
+
 Dense (non-paged) engines only get I6's bounds check and I8 — there is no
 allocator to corrupt.  The audit is O(pool + slots·blocks) pure-host work per step:
 cheap next to a device step, but nonzero, hence opt-in (a debug validator,
@@ -58,7 +68,8 @@ from __future__ import annotations
 
 from ..utils.envflags import env_bool
 
-__all__ = ["EngineAuditError", "audit_engine", "audit_enabled"]
+__all__ = ["EngineAuditError", "audit_engine", "audit_fleet",
+           "audit_enabled"]
 
 
 class EngineAuditError(AssertionError):
@@ -277,3 +288,73 @@ def audit_engine(eng) -> None:
                             f"parent {str(e.parent)[:8]} != previous "
                             f"{str(parent)[:8]}")
             parent = h
+
+
+def audit_fleet(router) -> None:
+    """I9 — fleet single-ownership (docs/fleet_serving.md): cross-check a
+    FleetRouter's routing registries against its replicas' live request
+    journals.  Every live fleet rid is owned by EXACTLY one replica (a
+    hedge-pending rid counts as the primary's until first-writer-wins
+    resolves — the hedge target is the one sanctioned extra copy), owners
+    are alive and actually hold the work, and no replica serves a rid the
+    router does not route to it.  Raises :class:`EngineAuditError` on the
+    first violation.  Note: this checks the ROUTER's invariants only —
+    each replica engine audits its own I1–I8 via :func:`audit_engine`."""
+    from ..inference.serving import TERMINAL_STATUSES
+
+    for rid, req in router._reqs.items():
+        if req.status in TERMINAL_STATUSES:
+            _fail("I9", f"rid {rid} is {req.status} (terminal) but still "
+                        f"in the fleet's live registry (zombie: it would "
+                        f"keep an owner and copies)")
+        owner = router._owner.get(rid)
+        if owner is None:
+            _fail("I9", f"live rid {rid} has no owning replica (orphaned: "
+                        f"no one will ever step it)")
+        if router.replicas[owner] is None or router.health[owner] == "DEAD":
+            _fail("I9", f"live rid {rid} is owned by DEAD replica {owner}")
+        copies = router._copies.get(rid, {})
+        if owner not in copies:
+            _fail("I9", f"live rid {rid}'s owner (replica {owner}) holds "
+                        f"no copy of it")
+        hedge = router._hedge.get(rid)
+        if hedge == owner:
+            _fail("I9", f"rid {rid} hedged onto its own owner (replica "
+                        f"{owner}): first-writer-wins could never resolve")
+        sanctioned = {owner} | ({hedge} if hedge is not None else set())
+        extra = set(copies) - sanctioned
+        if extra:
+            _fail("I9", f"rid {rid} has copies on replica(s) "
+                        f"{sorted(extra)} beyond owner {owner}"
+                        + (f" and hedge {hedge}" if hedge is not None
+                           else "")
+                        + " — double ownership banks one stream twice")
+    for rid in router._owner:
+        if rid not in router._reqs:
+            _fail("I9", f"owner-map entry for rid {rid} which is not a "
+                        f"live fleet request")
+    for rid in router._hedge:
+        if rid not in router._reqs:
+            _fail("I9", f"hedge-map entry for rid {rid} which is not a "
+                        f"live fleet request")
+    for rid, copies in router._copies.items():
+        if rid not in router._reqs:
+            # each leaked copy pins a Request (full prompt+output token
+            # lists) for the router's lifetime — the retention class the
+            # engine's rid-journal pruning fixed
+            _fail("I9", f"replica-local copies (on replica(s) "
+                        f"{sorted(copies)}) registered for rid {rid} "
+                        f"which is not a live fleet request")
+    for r, eng in enumerate(router.replicas):
+        if eng is None:
+            continue
+        for rid in eng._reqs:
+            if rid < 0:
+                continue        # warmup rids (bench convention) are unrouted
+            if rid not in router._reqs:
+                _fail("I9", f"replica {r} serves rid {rid} unknown to the "
+                            f"router (a cancelled/failed-over copy was "
+                            f"never released)")
+            if r not in router._copies.get(rid, {}):
+                _fail("I9", f"replica {r} serves rid {rid} but the router "
+                            f"records no copy there (untracked ownership)")

@@ -38,6 +38,9 @@ __all__ = [
     "save_inference_model",
     "load_inference_model",
     "GenerationEngine",
+    "ContinuousBatchingEngine",
+    "Request",
+    "FleetRouter",
 ]
 
 
@@ -453,3 +456,19 @@ class GenerationEngine:
                                                     cache_v, nxt, pos)
             pos += 1
         return np.asarray(jnp.concatenate(out, axis=1))
+
+
+def __getattr__(name):
+    # lazy serving-tier exports: the continuous-batching engine and the
+    # fleet router pull in the whole paged/serving stack (serving.py,
+    # fleet.py), which plain Predictor/GenerationEngine users never need —
+    # importing paddle_tpu.inference stays cheap until the first touch
+    if name in ("ContinuousBatchingEngine", "Request"):
+        from . import serving
+
+        return getattr(serving, name)
+    if name == "FleetRouter":
+        from .fleet import FleetRouter
+
+        return FleetRouter
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

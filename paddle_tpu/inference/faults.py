@@ -27,19 +27,40 @@ plus two host-side seams that exercise per-request isolation:
   batch;
 * ``cache_error`` — raises inside prefix-cache block registration; the
   graceful engine degrades (the block stays private, a future request
-  misses where it could have hit) without failing any request.
+  misses where it could have hit) without failing any request;
+
+and — ISSUE 9, docs/fleet_serving.md — three REPLICA-scoped kinds the
+:class:`~paddle_tpu.inference.fleet.FleetRouter` polls once per replica per
+fleet step (never the engine: a replica dying is a fleet-tier event):
+
+* ``replica_crash`` — the replica dies mid-serve: the router marks it DEAD
+  and replays its journal onto survivors by teacher-forced recompute;
+* ``replica_stall`` — the replica makes no progress for the fired step
+  (its compiled step "hangs"); enough consecutive stalls trigger hedged
+  re-dispatch with first-writer-wins dedup;
+* ``replica_slow`` — the replica's step completes but its latency
+  heartbeat is elevated; a streak degrades its health so the router stops
+  preferring it for new work.
+
+Replica-scoped kinds are rejected when no fleet is running
+(``FaultPlan.from_env(fleet=False)``, the engine's parse): the clause would
+otherwise be a silent no-op — the worst failure mode for a chaos lever — so
+the parse warns once naming the fleet requirement and disables injection
+entirely, exactly like a typo'd kind (utils/envflags.env_fault_spec).
 
 Grammar (validated by ``utils/envflags.env_fault_spec``; a typo warns with a
 did-you-mean and disables injection entirely)::
 
     PADDLE_TPU_FAULT_INJECT="alloc_fail@step=7;nan_logits@slot=2,step=11"
+    PADDLE_TPU_FAULT_INJECT="replica_crash@step=9,replica=1"   # fleet only
 
-Clause keys: ``step`` (engine step number, 1-based; omitted = any step),
-``slot`` / ``rid`` (omitted = first match polled), ``count`` (firings before
-the clause exhausts; default 1, ``-1`` = unlimited), and ``p`` + ``seed``
-for probabilistic chaos — each matching poll fires with probability ``p``
-drawn from a ``seed``-keyed private stream, so a randomized chaos run is
-still exactly replayable.
+Clause keys: ``step`` (engine step number, 1-based — for replica-scoped
+clauses the FLEET step number; omitted = any step), ``slot`` / ``rid`` /
+``replica`` (omitted = first match polled; ``replica`` is fleet-only),
+``count`` (firings before the clause exhausts; default 1, ``-1`` =
+unlimited), and ``p`` + ``seed`` for probabilistic chaos — each matching
+poll fires with probability ``p`` drawn from a ``seed``-keyed private
+stream, so a randomized chaos run is still exactly replayable.
 """
 
 from __future__ import annotations
@@ -48,8 +69,8 @@ import dataclasses
 
 import numpy as np
 
-__all__ = ["KNOWN_KINDS", "KNOWN_KEYS", "FaultClause", "FaultPlan",
-           "FaultInjected"]
+__all__ = ["KNOWN_KINDS", "KNOWN_KEYS", "REPLICA_KINDS", "FaultClause",
+           "FaultPlan", "FaultInjected"]
 
 
 class FaultInjected(RuntimeError):
@@ -66,7 +87,12 @@ class FaultInjected(RuntimeError):
 KNOWN_KINDS = frozenset({"alloc_fail", "kernel_error", "nan_logits",
                          "slot_error", "cache_error"})
 
-#: clause keys the grammar accepts
+#: fleet-tier fault kinds the FleetRouter polls for (ISSUE 9); rejected by
+#: the engine's own parse — a replica-scoped clause with no fleet running
+#: would be a silent no-op
+REPLICA_KINDS = frozenset({"replica_crash", "replica_stall", "replica_slow"})
+
+#: clause keys the grammar accepts (``replica`` is fleet-only, same contract)
 KNOWN_KEYS = frozenset({"step", "slot", "rid", "count", "p", "seed"})
 
 
@@ -79,6 +105,7 @@ class FaultClause:
     step: int | None = None
     slot: int | None = None
     rid: int | None = None
+    replica: int | None = None
     count: int = 1
     p: float = 1.0
     seed: int = 0
@@ -88,7 +115,7 @@ class FaultClause:
         # replayable and independent of every other clause's draw order
         self._rng = np.random.RandomState(self.seed)
 
-    def matches(self, kind: str, step, slot, rid) -> bool:
+    def matches(self, kind: str, step, slot, rid, replica=None) -> bool:
         if self.kind != kind or self.count == 0:
             return False
         if self.step is not None and step != self.step:
@@ -96,6 +123,8 @@ class FaultClause:
         if self.slot is not None and slot != self.slot:
             return False
         if self.rid is not None and rid != self.rid:
+            return False
+        if self.replica is not None and replica != self.replica:
             return False
         return True
 
@@ -110,27 +139,40 @@ class FaultPlan:
                          for c in clauses]
 
     @classmethod
-    def from_env(cls) -> "FaultPlan":
+    def from_env(cls, fleet: bool = False) -> "FaultPlan":
         """Parse ``PADDLE_TPU_FAULT_INJECT`` (validated; malformed specs warn
-        once and disable injection — utils/envflags.py)."""
+        once and disable injection — utils/envflags.py).  ``fleet=True``
+        (the FleetRouter's parse) admits the replica-scoped vocabulary —
+        the ``replica_*`` kinds and the ``replica`` clause key; the default
+        engine parse REJECTS those with a warning naming the fleet
+        requirement, because a replica-scoped clause polled by nobody would
+        make a chaos run's evidence silently incomplete."""
         from ..utils.envflags import env_fault_spec
 
+        if fleet:
+            return cls(env_fault_spec("PADDLE_TPU_FAULT_INJECT",
+                                      KNOWN_KINDS | REPLICA_KINDS,
+                                      KNOWN_KEYS | {"replica"}))
         return cls(env_fault_spec("PADDLE_TPU_FAULT_INJECT", KNOWN_KINDS,
-                                  KNOWN_KEYS))
+                                  KNOWN_KEYS,
+                                  fleet_only_kinds=REPLICA_KINDS,
+                                  fleet_only_keys=frozenset({"replica"})))
 
     def __bool__(self) -> bool:
         return bool(self._clauses)
 
     def fire(self, kind: str, *, step: int | None = None,
-             slot: int | None = None, rid: int | None = None) -> bool:
+             slot: int | None = None, rid: int | None = None,
+             replica: int | None = None) -> bool:
         """Poll one seam: True exactly when a clause matches and fires.
-        Polling order is the engine's deterministic scan order, so a clause
-        with an omitted ``slot`` fires on the first matching poll — the plan
-        stays replayable without pinning every key."""
+        Polling order is the engine's deterministic scan order (the fleet's
+        is replica-index order), so a clause with an omitted ``slot`` /
+        ``replica`` fires on the first matching poll — the plan stays
+        replayable without pinning every key."""
         if not self._clauses:
             return False
         for c in self._clauses:
-            if not c.matches(kind, step, slot, rid):
+            if not c.matches(kind, step, slot, rid, replica):
                 continue
             if c.p < 1.0 and float(c._rng.random_sample()) >= c.p:
                 continue

@@ -204,6 +204,47 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+def journal_entry(req: Request, prefilled: int = 0,
+                  now: float | None = None) -> dict:
+    """One request's snapshot-journal entry — THE schema
+    :meth:`ContinuousBatchingEngine.snapshot` emits and
+    :meth:`ContinuousBatchingEngine.adopt` consumes (docs/
+    fault_tolerance.md "Snapshot / restore").  Shared with the fleet
+    router's journal fallback (inference/fleet.py) so the field set and
+    coercions can never diverge between the two producers.
+
+    ``deadline_remaining_s`` is the UNSPENT wall-clock budget at ``now``:
+    adoption re-arms the deadline with what is actually left, so a
+    restored request expires at ~100% of its original SLO, never ~180%
+    (``deadline_s`` stays as provenance)."""
+    if now is None:
+        now = time.perf_counter()
+    if req.deadline_s is None:
+        remaining = None
+    else:
+        remaining = max(0.0, float(req.deadline_s)
+                        - (now - getattr(req, "_submit_s", now)))
+    return {
+        "rid": int(req.rid),
+        "prompt_ids": np.asarray(req.prompt_ids,
+                                 np.int32).ravel().tolist(),
+        "output_ids": [int(t) for t in req.output_ids],
+        "max_new_tokens": int(req.max_new_tokens),
+        "eos_token_id": (None if req.eos_token_id is None
+                         else int(req.eos_token_id)),
+        "temperature": float(req.temperature or 0.0),
+        "top_p": float(1.0 if req.top_p is None else req.top_p),
+        "seed": None if req.seed is None else int(req.seed),
+        "deadline_s": (None if req.deadline_s is None
+                       else float(req.deadline_s)),
+        "deadline_remaining_s": remaining,
+        # the chunk cursor: restore re-prefills from the first uncached
+        # token, so this is provenance (how far the dead replica got),
+        # not a resume offset into lost KV bytes
+        "prefilled": int(prefilled),
+    }
+
+
 class _TPShardView:
     """Per-shard config view inside the ``("tp",)`` shard_map region
     (docs/tp_serving.md): the compiled-step bodies read head counts off the
@@ -1905,28 +1946,12 @@ class ContinuousBatchingEngine:
 
         v2 adds the ``engine`` topology block (:meth:`_topology`) so
         :meth:`restore` can refuse a mismatched replica instead of
-        resuming silently wrong."""
+        resuming silently wrong, and ``deadline_remaining_s`` — the
+        UNSPENT wall-clock budget at snapshot time — so :meth:`adopt`
+        re-arms a restored deadline with what is actually left rather
+        than granting the full budget again."""
 
-        def journal(req, prefilled=0):
-            return {
-                "rid": int(req.rid),
-                "prompt_ids": np.asarray(req.prompt_ids,
-                                         np.int32).ravel().tolist(),
-                "output_ids": [int(t) for t in req.output_ids],
-                "max_new_tokens": int(req.max_new_tokens),
-                "eos_token_id": (None if req.eos_token_id is None
-                                 else int(req.eos_token_id)),
-                "temperature": float(req.temperature or 0.0),
-                "top_p": float(1.0 if req.top_p is None else req.top_p),
-                "seed": None if req.seed is None else int(req.seed),
-                "deadline_s": (None if req.deadline_s is None
-                               else float(req.deadline_s)),
-                # the chunk cursor: restore re-prefills from the first
-                # uncached token, so this is provenance (how far the dead
-                # replica got), not a resume offset into lost KV bytes
-                "prefilled": int(prefilled),
-            }
-
+        now = time.perf_counter()
         with RecordEvent("serving/snapshot"):
             running = [s for s in range(self.max_batch)
                        if self._slot_req[s] is not None]
@@ -1935,12 +1960,49 @@ class ContinuousBatchingEngine:
             return {
                 "version": 2,
                 "engine": self._topology(),
-                "running": [journal(self._slot_req[s],
-                                    self._prefilled[s] if self._chunked
-                                    else 0)
+                "running": [journal_entry(self._slot_req[s],
+                                          self._prefilled[s]
+                                          if self._chunked else 0, now)
                             for s in running],
-                "queued": [journal(r) for r in self._queue],
+                "queued": [journal_entry(r, 0, now) for r in self._queue],
             }
+
+    def adopt(self, j: dict) -> Request:
+        """Adopt ONE journaled request (an entry of :meth:`snapshot`'s
+        ``running``/``queued`` lists) into this engine's queue — the fleet
+        tier's per-request failover/hedge primitive (inference/fleet.py),
+        and the loop body :meth:`restore` runs over a whole snapshot.
+
+        The request re-enters through the preemption-resume path: prompt +
+        already-emitted tokens are teacher-forced by (chunked) prefill
+        recompute, then position-derived sampling keys continue the stream
+        exactly.  Deliberately EXEMPT from ``max_queue`` backpressure:
+        journaled work was already accepted once (by the dead or stalled
+        replica), and accepted work is never rejected — the same contract
+        preemption re-inserts enjoy.  The deadline re-arms with the
+        journaled ``deadline_remaining_s`` (v2): the budget the original
+        replica already burned stays burned.  (Journals without the field —
+        v1 snapshots — fall back to the full ``deadline_s``, the historical
+        behavior.)"""
+        req = Request(
+            rid=j["rid"],
+            prompt_ids=np.asarray(j["prompt_ids"], np.int32),
+            max_new_tokens=j["max_new_tokens"],
+            eos_token_id=j["eos_token_id"],
+            temperature=j["temperature"], top_p=j["top_p"],
+            seed=j["seed"],
+            deadline_s=j.get("deadline_remaining_s", j["deadline_s"]))
+        req.output_ids = list(j["output_ids"])
+        if req.output_ids:
+            # the preempt-resume contract: stored tokens are
+            # teacher-forced, the continuation redraws exactly
+            req._resume_ids = np.concatenate(
+                [np.asarray(req.prompt_ids, np.int32).ravel(),
+                 np.asarray(req.output_ids, np.int32)])
+        req._submit_s = time.perf_counter()
+        self._reqs[req.rid] = req
+        self._queue.append(req)
+        return req
 
     def restore(self, snap: dict) -> list[Request]:
         """Resume a :meth:`snapshot` on THIS engine (typically a fresh
@@ -1949,9 +2011,11 @@ class ContinuousBatchingEngine:
         emitted tokens are teacher-forced by (chunked) prefill recompute,
         then position-derived sampling keys continue the stream exactly —
         a serve completed after restore() emits token-identical output to
-        one that was never interrupted.  Deadlines restart from restore
-        time (the dead replica's clock is gone).  Returns the resumed
-        Request objects (in admission order: running work first).
+        one that was never interrupted.  Deadlines re-arm from restore
+        time with the journaled REMAINING budget (the dead replica's
+        clock is gone, but the budget it burned stays burned —
+        :meth:`adopt`).  Returns the resumed Request objects (in
+        admission order: running work first).
 
         v2 snapshots carry the source engine's topology (:meth:`_topology`)
         and restore onto a mismatched engine raises a diagnosable
@@ -1982,27 +2046,7 @@ class ContinuousBatchingEngine:
                     f"may differ (snapshot tp={src.get('tp')!r}, engine "
                     f"tp={self.tp})")
         with RecordEvent("serving/restore"):
-            out: list[Request] = []
-            for j in snap["running"] + snap["queued"]:
-                req = Request(
-                    rid=j["rid"],
-                    prompt_ids=np.asarray(j["prompt_ids"], np.int32),
-                    max_new_tokens=j["max_new_tokens"],
-                    eos_token_id=j["eos_token_id"],
-                    temperature=j["temperature"], top_p=j["top_p"],
-                    seed=j["seed"], deadline_s=j["deadline_s"])
-                req.output_ids = list(j["output_ids"])
-                if req.output_ids:
-                    # the preempt-resume contract: stored tokens are
-                    # teacher-forced, the continuation redraws exactly
-                    req._resume_ids = np.concatenate(
-                        [np.asarray(req.prompt_ids, np.int32).ravel(),
-                         np.asarray(req.output_ids, np.int32)])
-                req._submit_s = time.perf_counter()
-                self._reqs[req.rid] = req
-                self._queue.append(req)
-                out.append(req)
-            return out
+            return [self.adopt(j) for j in snap["running"] + snap["queued"]]
 
     def _maybe_audit(self):
         if self._audit_every_step:

@@ -34,7 +34,12 @@ through :func:`env_bool`, which enforces the '0'/'1' vocabulary):
 with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``.
 ``PADDLE_TPU_FAULT_INJECT`` is the structured fault-injection plan; its
 clause grammar is validated by :func:`env_fault_spec` and its fault-kind
-vocabulary lives with the injector — inference/faults.py ``KNOWN_KINDS``.
+vocabulary lives with the injector — inference/faults.py ``KNOWN_KINDS``
+for the engine seams, plus ``REPLICA_KINDS`` and the ``replica`` clause key
+for the fleet tier (inference/fleet.py): replica-scoped clauses are only
+accepted by the FleetRouter's parse — the single-engine parse rejects them
+with a warning naming the fleet requirement, because a clause nobody polls
+would make a chaos run's evidence silently incomplete.
 ``PADDLE_TPU_TP`` is the integer tensor-parallel override for the serving
 engine (docs/tp_serving.md): when set it REPLACES the
 ``ContinuousBatchingEngine(tensor_parallel=...)`` ctor value, the
@@ -152,7 +157,9 @@ def env_tp(kv_heads: int, device_count: int,
     return tp
 
 
-def env_fault_spec(name: str, known_kinds, known_keys) -> list[dict]:
+def env_fault_spec(name: str, known_kinds, known_keys,
+                   fleet_only_kinds=frozenset(),
+                   fleet_only_keys=frozenset()) -> list[dict]:
     """Parse a fault-injection plan: ``kind@key=val,key=val;kind@...``
     (e.g. ``alloc_fail@step=7;nan_logits@slot=2,step=11``).  Returns one dict
     per clause — ``{"kind": ..., <int-valued keys>}`` (``p`` parses as float).
@@ -161,7 +168,15 @@ def env_fault_spec(name: str, known_kinds, known_keys) -> list[dict]:
     key, or malformed clause warns ONCE with a did-you-mean and returns []
     — injection disabled, the engine serves normally.  Partial acceptance
     would be worse than none: a typo'd clause silently skipped while its
-    siblings fire would make a chaos run's evidence unreadable."""
+    siblings fire would make a chaos run's evidence unreadable.
+
+    ``fleet_only_kinds`` / ``fleet_only_keys`` name the replica-scoped
+    vocabulary (inference/faults.REPLICA_KINDS, the ``replica`` key) for a
+    parse where NO fleet is running: those clauses get the same
+    warn-and-disable treatment, with the message naming the FleetRouter
+    requirement instead of a did-you-mean — a replica-scoped clause the
+    single-engine serve would never poll must not be a silent no-op (and
+    must not crash the engine either)."""
     raw = os.environ.get(name, "")
     if not raw:
         return []
@@ -178,9 +193,15 @@ def env_fault_spec(name: str, known_kinds, known_keys) -> list[dict]:
             continue
         kind, sep, tail = clause.partition("@")
         kind = kind.strip()
+        if kind in fleet_only_kinds:
+            return _reject(
+                f"fault kind {kind!r} is replica-scoped and requires a "
+                f"running FleetRouter (inference/fleet.py) to poll it — "
+                f"no fleet is running, so the clause could never fire")
         if kind not in known_kinds:
-            close = difflib.get_close_matches(kind, known_kinds, n=1,
-                                              cutoff=0.5)
+            close = difflib.get_close_matches(
+                kind, set(known_kinds) | set(fleet_only_kinds), n=1,
+                cutoff=0.5)
             hint = f" (did you mean {close[0]!r}?)" if close else ""
             return _reject(f"unknown fault kind {kind!r}{hint}; known: "
                            f"{sorted(known_kinds)}")
@@ -191,9 +212,15 @@ def env_fault_spec(name: str, known_kinds, known_keys) -> list[dict]:
                 continue
             k, eq, v = item.partition("=")
             k = k.strip()
+            if eq and k in fleet_only_keys:
+                return _reject(
+                    f"clause key {k!r} in {clause!r} is replica-scoped and "
+                    f"requires a running FleetRouter (inference/fleet.py) — "
+                    f"no fleet is running, so the scope could never match")
             if not eq or k not in known_keys:
-                close = difflib.get_close_matches(k, known_keys, n=1,
-                                                  cutoff=0.5)
+                close = difflib.get_close_matches(
+                    k, set(known_keys) | set(fleet_only_keys), n=1,
+                    cutoff=0.5)
                 hint = f" (did you mean {close[0]!r}?)" if close else ""
                 return _reject(f"bad clause key {k!r}{hint} in {clause!r}; "
                                f"known: {sorted(known_keys)}")

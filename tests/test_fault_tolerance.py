@@ -687,6 +687,41 @@ def test_snapshot_restore_mid_prefill_chunked(monkeypatch):
     assert restored[0].output_ids == ref[0]
 
 
+def test_snapshot_journals_remaining_deadline_and_restore_rearms(
+        monkeypatch):
+    """Satellite regression (ISSUE 9): ``snapshot()`` used to journal the
+    ORIGINAL ``deadline_s`` only, so a restored request got its full
+    budget again (~180% of the SLO when snapshotted at 80%).  The journal
+    now carries ``deadline_remaining_s`` and restore re-arms with exactly
+    that — expiry lands at ~100% of the original budget."""
+    cfg, params = _tiny()
+    rs = np.random.RandomState(28)
+    eng1 = _engine(cfg, params)
+    req = Request(rid=0, prompt_ids=rs.randint(0, 128, (9,))
+                  .astype(np.int32), max_new_tokens=10_000, deadline_s=10.0)
+    eng1.add_request(req)
+    eng1.step()
+    import time as _time
+    req._submit_s = _time.perf_counter() - 8.0   # exactly 80% burned
+    snap = eng1.snapshot()
+    j = snap["running"][0]
+    assert j["deadline_s"] == 10.0          # original grant: provenance
+    assert 1.5 < j["deadline_remaining_s"] < 2.1    # ~20% left
+    eng2 = _engine(cfg, params)
+    restored = eng2.restore(snap)[0]
+    # re-armed with the REMAINING budget, not the full grant
+    assert restored.deadline_s < 2.5
+    restored._submit_s -= restored.deadline_s + 0.1  # remaining now spent
+    eng2.step()
+    assert restored.status == "EXPIRED"     # ~100% of the SLO, not ~180%
+    # a v1-era journal entry (no remaining field) falls back to the full
+    # grant — the historical behavior, never a KeyError
+    del j["deadline_remaining_s"]
+    eng3 = _engine(cfg, params)
+    legacy = eng3.adopt(j)
+    assert legacy.deadline_s == 10.0
+
+
 def test_restore_rejects_unknown_version():
     cfg, params = _tiny()
     eng = _engine(cfg, params, paged=False)

@@ -76,6 +76,15 @@ TARGETS = {
     # keys so one degree can never satisfy the other's evidence
     "cb_tp2": "llama_cb_decode_tokens_per_sec/cb_tp2",
     "cb_tp4": "llama_cb_decode_tokens_per_sec/cb_tp4",
+    # round-13 evidence rungs: fleet serving behind the prefix-affinity
+    # router (docs/fleet_serving.md) — open-loop arrivals over 3 replicas
+    # with one injected replica_crash, headline = goodput AT the TTFT/TBT
+    # SLO (router failover/hedge counters in detail).  Exact keys; the
+    # smoke-sized rung runs on BOTH arms (CI twin + cheap on-hardware fleet
+    # sanity), so its key banks from a TPU sweep too.
+    "cb_fleet_chaos": "llama_cb_decode_tokens_per_sec/cb_fleet_chaos",
+    "cb_fleet_cpu_smoke":
+        "llama_cb_decode_tokens_per_sec/cb_fleet_cpu_smoke",
 }
 
 
