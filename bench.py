@@ -486,7 +486,10 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
     saved_env = os.environ.get(env_key)
     if paged and not paged_kernel:
         os.environ[env_key] = (saved_env + "," if saved_env else "") + "paged_attention"
-    pk0, pf0 = _pa.KERNEL_CALLS, _pa.FALLBACK_CALLS
+    # counter hygiene (ISSUE 10): the kernel/fallback counters are module
+    # state that persists across engine constructions — zero them so this
+    # rung's detail (absolute counts below) is exactly this rung's traces
+    _pa.reset_kernel_counters()
     try:
         params = llama.init_params(cfg, jax.random.key(0))
         eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
@@ -519,6 +522,10 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
         eng.serve(reqs)
         wall = time.perf_counter() - t0
         total = sum(len(r.output_ids) for r in reqs)
+        # snapshot UNDER THIS RUNG'S env (trace-time state): after the
+        # restore below a paged_kernel=False rung would re-trace the
+        # kernel program instead of the gather one it measured
+        launches = eng.decode_step_launches()
     finally:
         if paged and not paged_kernel:
             if saved_env is None:
@@ -529,10 +536,16 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
               "total_new_tokens": total, "wall_s": round(wall, 2),
               "decode_steps": eng.stats["decode_steps"], "chunk": chunk,
               "quant": quant, "paged": paged, "ragged": ragged,
-              # per-rung deltas (flash pattern, bench.py run_rung): the
-              # A/B evidence of which attention path this rung traced
-              "paged_kernel_calls": _pa.KERNEL_CALLS - pk0,
-              "paged_fallback_calls": _pa.FALLBACK_CALLS - pf0,
+              # per-rung counters (reset at rung start): the A/B evidence
+              # of which attention path this rung traced
+              "paged_kernel_calls": _pa.KERNEL_CALLS,
+              "paged_fallback_calls": _pa.FALLBACK_CALLS,
+              # split-K / fused decode-step evidence (ISSUE 10): which
+              # decode path traced and the shard fan-out it chose
+              "flash_kernel_calls": _pa.FLASH_KERNEL_CALLS,
+              "fused_kernel_calls": _pa.FUSED_KERNEL_CALLS,
+              "flash_combine_shards": _pa.LAST_FLASH_SHARDS,
+              "decode_step_launches": launches,
               # expected: one decode variant per sampling mode used +
               # one prefill per warmed bucket; growth = in-serve churn
               "n_traces": eng.n_traces(),
@@ -903,6 +916,33 @@ def decode_ladder_main(compact: bool = False) -> int:
             log(f"cb chunked rung {rung[0]} failed: {e}\n"
                 f"{traceback.format_exc()}")
             continue
+    # long-context flash-decode A/B (ISSUE 10, docs/paged_attention.md):
+    # 2 near-32k-context requests decode beside 6 short ones — the skew
+    # where the sequential page walk serializes ~500 pages per step while
+    # the short slots wait.  The seq arm pins the PRE-PR decode path
+    # (flash_decode AND fused_decode_step disabled); the flash arm runs
+    # the split-K + fused default.  Headline = decode TBT p99 ms (lower
+    # is better); flash must beat seq (acceptance).  Both arms run through
+    # ONE function, so the RandomState(0) workload is matched by
+    # construction.  (rung tuple: cfg, slots, n_long, n_short, long_prompt,
+    # short_prompt, new, max_seq, num_blocks[, block_size, flash])
+    longctx_rungs = ([
+        ("cb_longctx_flash", full_cfg, 8, 2, 6, 32000, 64, 48, 32768, 1088,
+         64, True),
+        ("cb_longctx_seq", full_cfg, 8, 2, 6, 32000, 64, 48, 32768, 1088,
+         64, False),
+    ] if on_tpu else [
+        ("cb_longctx_cpu_smoke", llama.LlamaConfig.tiny(), 3, 1, 2, 100, 8,
+         6, 128, 24, 8, True),
+    ])
+    for rung in longctx_rungs:
+        try:
+            emit(run_cb_longctx_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"cb longctx rung {rung[0]} failed: {e}\n"
+                f"{traceback.format_exc()}")
+            continue
     # fault-tolerance rung (ISSUE 6): open-loop 2x-oversubscribed arrivals
     # + injected allocator faults over the full-feature engine — headline is
     # GOODPUT (tokens/s over requests that actually FINISHED), the number
@@ -991,6 +1031,15 @@ def decode_ladder_main(compact: bool = False) -> int:
 # ---------------------------------------------------------------------------
 # vision ladder (ResNet-50 training — BASELINE.md config ladder row #2)
 # ---------------------------------------------------------------------------
+
+def _tbt_pctile_ms(gaps, p):
+    """p-th percentile of a SORTED token-arrival-gap list, in ms (None when
+    empty) — the ONE copy the chunked and longctx TBT rungs share, so their
+    headline percentiles can never drift apart."""
+    if not gaps:
+        return None
+    return round(1e3 * gaps[min(len(gaps) - 1, int(p * (len(gaps) - 1)))], 3)
+
 
 def run_cb_chunked_rung(name, cfg, max_batch, n_decode, n_long, short_prompt,
                         long_prompt, new, max_seq, num_blocks, chunked=True,
@@ -1085,9 +1134,7 @@ def run_cb_chunked_rung(name, cfg, max_batch, n_decode, n_long, short_prompt,
     gaps = [b_ - a for r in deco for a, b_ in zip(arrivals[r.rid],
                                                   arrivals[r.rid][1:])]
     gaps = sorted(gaps)
-    pct = (lambda p: round(
-        1e3 * gaps[min(len(gaps) - 1, int(p * (len(gaps) - 1)))], 3)
-        if gaps else None)
+    pct = lambda p: _tbt_pctile_ms(gaps, p)
     ttfts = [r.ttft_s for r in longs if r.ttft_s is not None]
     # headline = generated tokens over the WHOLE timed serve, measured
     # identically on both arms.  (engine decode_tokens_per_s would bias the
@@ -1125,6 +1172,135 @@ def run_cb_chunked_rung(name, cfg, max_batch, n_decode, n_long, short_prompt,
                        _pa.PREFILL_KERNEL_CALLS - pk0,
                    "prefill_fallback_calls":
                        _pa.PREFILL_FALLBACK_CALLS - pf0,
+                   "n_traces": eng.n_traces(),
+                   "backend": jax.default_backend()},
+    }
+
+
+def run_cb_longctx_rung(name, cfg, max_batch, n_long, n_short, long_prompt,
+                        short_prompt, new, max_seq, num_blocks,
+                        block_size=64, flash=True):
+    """Long-context skew rung family ``cb_longctx_{flash,seq}`` (ISSUE 10):
+    ``n_long`` near-``max_seq``-context requests decode alongside
+    ``n_short`` short ones in the same batch.  Sequential-walk arm
+    (``flash=False`` — PADDLE_TPU_DISABLE_PALLAS=flash_decode,
+    fused_decode_step, i.e. the pre-PR decode path): every decode step
+    serializes the long slots' whole page walk while the short slots sit
+    finished — the inter-token gap every request pays.  Flash arm: split-K
+    shards the long walks and the fused step drops the per-layer
+    rope/scatter dispatches.  Both arms run through this ONE function with
+    the same RandomState(0) stream, so the workload is matched by
+    construction.  Headline = decode TBT p99 (ms, LOWER is better) over
+    per-request token-arrival gaps; ``flash_combine_shards`` and the
+    launch-count detail (``decode_step_launches``: traced eqns /
+    pallas_calls / scatters per step) ride in detail.  chunk=1 so TBT gaps
+    are per-token, not per-scan."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              Request)
+    from paddle_tpu.ops.pallas import paged_attention as _pa
+
+    log(f"cb longctx rung {name}: building (slots={max_batch} "
+        f"long={n_long}x{long_prompt} short={n_short}x{short_prompt} "
+        f"flash={flash})")
+    # pin the two decode kill switches to EXACTLY what this arm declares
+    # (mirroring analysis/targets.py): an ambient flash_decode /
+    # fused_decode_step opt-out left over from troubleshooting would
+    # silently turn the flash arm into a second seq arm and void the A/B
+    env_key = "PADDLE_TPU_DISABLE_PALLAS"
+    saved_env = os.environ.get(env_key)
+    tokens = ({t.strip() for t in (saved_env or "").split(",") if t.strip()}
+              - {"flash_decode", "fused_decode_step"})
+    if not flash:
+        tokens |= {"flash_decode", "fused_decode_step"}
+    if tokens:
+        os.environ[env_key] = ",".join(sorted(tokens))
+    else:
+        os.environ.pop(env_key, None)
+    _pa.reset_kernel_counters()
+    rs = np.random.RandomState(0)
+    try:
+        params = llama.init_params(cfg, jax.random.key(0))
+        eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
+                                       max_seq=max_seq, chunk=1, paged=True,
+                                       block_size=block_size,
+                                       num_blocks=num_blocks)
+        del params
+        # warm every prefill bucket a timed request can land in + decode
+        t_c = time.perf_counter()
+        warm_lens = sorted({short_prompt, long_prompt})
+        for wi, wl in enumerate(warm_lens):
+            eng.serve([Request(rid=-1 - wi,
+                               prompt_ids=rs.randint(0, cfg.vocab_size,
+                                                     (wl,)).astype(np.int32),
+                               max_new_tokens=2)])
+        log(f"cb longctx rung {name}: compile "
+            f"{time.perf_counter() - t_c:.1f}s")
+        eng.stats.update(decode_steps=0, decode_tokens=0, decode_time_s=0.0,
+                         prefills=0)
+        longs = [Request(rid=i, prompt_ids=rs.randint(
+                     0, cfg.vocab_size, (long_prompt,)).astype(np.int32),
+                     max_new_tokens=new) for i in range(n_long)]
+        shorts = [Request(rid=100 + i, prompt_ids=rs.randint(
+                      0, cfg.vocab_size, (short_prompt,)).astype(np.int32),
+                      max_new_tokens=new) for i in range(n_short)]
+        reqs = longs + shorts
+        for r in reqs:
+            eng.add_request(r)
+        seen = {r.rid: 0 for r in reqs}
+        arrivals = {r.rid: [] for r in reqs}
+        t0 = time.perf_counter()
+        while eng.step() or eng._queue:
+            now = time.perf_counter()
+            for r in reqs:
+                if len(r.output_ids) > seen[r.rid]:
+                    seen[r.rid] = len(r.output_ids)
+                    arrivals[r.rid].append(now)
+        wall = time.perf_counter() - t0
+        # snapshot the launch telemetry UNDER THIS ARM'S env — the method
+        # re-traces, and the kill switches are trace-time state: calling it
+        # after the finally restore would describe the wrong program on
+        # the seq arm
+        launches = eng.decode_step_launches()
+    finally:
+        if saved_env is None:
+            os.environ.pop(env_key, None)
+        else:
+            os.environ[env_key] = saved_env
+    # TBT = gaps between consecutive token arrivals per request (first
+    # arrival is TTFT, excluded); the long slots' serialized page walk
+    # shows up in EVERY lane's gap, which is what p99 reads
+    gaps = sorted(b_ - a for r in reqs
+                  for a, b_ in zip(arrivals[r.rid], arrivals[r.rid][1:]))
+    pct = lambda p: _tbt_pctile_ms(gaps, p)
+    toks_total = sum(len(r.output_ids) for r in reqs)
+    return {
+        "metric": "llama_cb_decode_tbt_p99_ms",
+        "value": pct(0.99),
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "detail": {"rung": name, "slots": max_batch,
+                   "long_requests": n_long, "short_requests": n_short,
+                   "long_prompt": long_prompt, "short_prompt": short_prompt,
+                   "new_tokens": new, "max_seq": max_seq,
+                   "wall_s": round(wall, 2),
+                   "tokens_generated": toks_total,
+                   "tokens_per_s": round(toks_total / wall, 1)
+                   if wall > 0 else 0.0,
+                   "flash": flash,
+                   "tbt_p50_ms": pct(0.50), "tbt_p99_ms": pct(0.99),
+                   "tbt_max_ms": (round(1e3 * gaps[-1], 3) if gaps
+                                  else None),
+                   "flash_kernel_calls": _pa.FLASH_KERNEL_CALLS,
+                   "fused_kernel_calls": _pa.FUSED_KERNEL_CALLS,
+                   "seq_kernel_calls": _pa.KERNEL_CALLS,
+                   "paged_fallback_calls": _pa.FALLBACK_CALLS,
+                   "flash_combine_shards": _pa.LAST_FLASH_SHARDS,
+                   "decode_step_launches": launches,
+                   "preemptions": eng.stats["preemptions"],
                    "n_traces": eng.n_traces(),
                    "backend": jax.default_backend()},
     }

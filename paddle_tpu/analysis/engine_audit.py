@@ -16,7 +16,10 @@ I1  page partition — every pool page is in exactly one of {free list, a
     Under tensor parallelism (docs/tp_serving.md) the device pools must
     shard ONLY the kv_heads axis — the page axis stays whole per shard, so
     this host-side partition is exact on every shard (one allocator,
-    tp-many replicas of its accounting).
+    tp-many replicas of its accounting).  Under the fused decode step
+    (docs/paged_attention.md) the device pool carries exactly ONE spill
+    page past the allocator's range — dropped writes' trash can, never
+    handed out, never accounted — and none otherwise.
 I2  block-table rows — row[i] mirrors [shared pages..., private pages...] in
     order; every remaining entry is the unallocated sentinel.
 I3  refcounts — each cached block's refcount equals the number of slot
@@ -159,6 +162,20 @@ def audit_engine(eng) -> None:
         extra = sorted(set(everything) - set(range(nb)))
         _fail("I1", f"pool accounting does not close: missing={missing} "
                     f"out-of-range={extra}")
+    # I1 under the fused decode step (docs/paged_attention.md "Fused decode
+    # step"): the device pool carries exactly one SPILL page past the
+    # allocator's range iff fused mode is on.  The spill page is dropped
+    # writes' trash can — it must exist when the fused kernel targets it
+    # (a missing page means dropped writes corrupt page num_blocks - 1) and
+    # must NOT exist otherwise (a stray page means the pool layout drifted
+    # from the compiled programs').  The partition above already proves the
+    # allocator never hands it out (everything == range(num_blocks)).
+    phys = int(eng.cache_k.shape[1])
+    want = nb + (1 if getattr(eng, "_fused", False) else 0)
+    if phys != want:
+        _fail("I1", f"device pool has {phys} physical pages, expected "
+                    f"{want} (num_blocks={nb}, fused decode "
+                    f"{'on' if getattr(eng, '_fused', False) else 'off'})")
     if getattr(eng, "tp", 1) > 1:
         # I1 under tensor parallelism (docs/tp_serving.md): the host
         # partition above is only exact PER SHARD if the device pool

@@ -64,7 +64,8 @@ def _t_moe_train_step() -> AnalysisTarget:
                           (params, opt_state, ids, labels))
 
 
-def _serving_engine(_force_flags=(), _cfg_kwargs=None, **kwargs):
+def _serving_engine(_force_flags=(), _cfg_kwargs=None, _disable_pallas=(),
+                    **kwargs):
     import contextlib
     import os
     import jax
@@ -91,6 +92,26 @@ def _serving_engine(_force_flags=(), _cfg_kwargs=None, **kwargs):
             stack.callback(lambda f=flag, p=prev: (
                 os.environ.__setitem__(f, p) if p is not None
                 else os.environ.pop(f, None)))
+        # the per-path decode kill switches (flash_decode /
+        # fused_decode_step) are trace-time state like the flags above:
+        # every serving target pins them to EXACTLY what it declares —
+        # serving_decode_step disables both (the pre-fusion program whose
+        # lint shape is locked in), serving_flash_decode_step enables both
+        # (the production default) — so an operator's ambient opt-out can
+        # never swap which program the gate analyzes.
+        prev_dp = os.environ.get("PADDLE_TPU_DISABLE_PALLAS")
+        tokens = set(_disable_pallas)
+        if prev_dp:
+            tokens |= {t.strip() for t in prev_dp.split(",")
+                       if t.strip()} - {"flash_decode", "fused_decode_step"}
+        if tokens:
+            os.environ["PADDLE_TPU_DISABLE_PALLAS"] = ",".join(sorted(tokens))
+        else:
+            os.environ.pop("PADDLE_TPU_DISABLE_PALLAS", None)
+        stack.callback(lambda p=prev_dp: (
+            os.environ.__setitem__("PADDLE_TPU_DISABLE_PALLAS", p)
+            if p is not None
+            else os.environ.pop("PADDLE_TPU_DISABLE_PALLAS", None)))
         # an ambient PADDLE_TPU_TP would OVERRIDE every builder's
         # tensor_parallel (the env wins by design) — e.g. PADDLE_TPU_TP=1
         # would collapse serving_tp_step to a single-chip program whose
@@ -109,7 +130,11 @@ def _serving_engine(_force_flags=(), _cfg_kwargs=None, **kwargs):
 def _t_serving_decode_step() -> AnalysisTarget:
     import jax.numpy as jnp
 
-    eng = _serving_engine()
+    # the PRE-fusion decode program (rope + KV scatters + sequential paged
+    # kernel): its lint shape stays pinned even though production now
+    # defaults to the fused/split-K path (serving_flash_decode_step below)
+    eng = _serving_engine(_disable_pallas=("flash_decode",
+                                           "fused_decode_step"))
     B = eng.max_batch
     tokens = jnp.zeros((B,), jnp.int32)
     pos = jnp.asarray([5, 0], jnp.int32)
@@ -120,6 +145,30 @@ def _t_serving_decode_step() -> AnalysisTarget:
     table = jnp.asarray(eng._table)
     return AnalysisTarget(
         "serving_decode_step", eng._decode_greedy,
+        (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active,
+         temp, topp, seeds, table))
+
+
+def _t_serving_flash_decode_step() -> AnalysisTarget:
+    import jax.numpy as jnp
+
+    # the production-default decode program (ISSUE 10): fused rope +
+    # KV-append + split-K attention with the log-sum-exp combine.  The
+    # gate polices it like every hot path: the combine's f32 online-
+    # softmax dots are the ONLY allowlisted upcasts (allowlist.toml), and
+    # any other collective/upcast that sneaks into the fused step fails CI.
+    eng = _serving_engine()
+    assert eng._fused, "flash target must build the fused decode engine"
+    B = eng.max_batch
+    tokens = jnp.zeros((B,), jnp.int32)
+    pos = jnp.asarray([5, 0], jnp.int32)
+    active = jnp.asarray([True, False])
+    temp = jnp.zeros((B,), jnp.float32)
+    topp = jnp.ones((B,), jnp.float32)
+    seeds = jnp.zeros((B,), jnp.int32)
+    table = jnp.asarray(eng._table)
+    return AnalysisTarget(
+        "serving_flash_decode_step", eng._decode_greedy,
         (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active,
          temp, topp, seeds, table))
 
@@ -240,6 +289,7 @@ TARGETS = {
     "llama_train_step": _t_llama_train_step,
     "moe_llama_train_step": _t_moe_train_step,
     "serving_decode_step": _t_serving_decode_step,
+    "serving_flash_decode_step": _t_serving_flash_decode_step,
     "serving_prefill_step": _t_serving_prefill_step,
     "serving_verify_step": _t_serving_verify_step,
     "serving_mixed_step": _t_serving_mixed_step,
@@ -250,9 +300,9 @@ TARGETS = {
 # expensive future target (multi-device compile) can register without
 # slowing the tier-1 suite
 GATE_TARGETS = ("llama_train_step", "moe_llama_train_step",
-                "serving_decode_step", "serving_prefill_step",
-                "serving_verify_step", "serving_mixed_step",
-                "serving_tp_step")
+                "serving_decode_step", "serving_flash_decode_step",
+                "serving_prefill_step", "serving_verify_step",
+                "serving_mixed_step", "serving_tp_step")
 
 
 def build(name: str) -> AnalysisTarget:

@@ -250,7 +250,7 @@ def create_predictor(config: Config) -> Predictor:
 # ---------------------------------------------------------------------------
 
 def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos,
-                      sin, attend_fn=None, tp_axis=None):
+                      sin, attend_fn=None, tp_axis=None, fused_fn=None):
     """Cache-threading transformer body shared by GenerationEngine and the
     continuous-batching engine (serving.py) — one copy of the GQA attend +
     rms/rope/swiglu scan so masking/grouping fixes can't diverge.
@@ -265,6 +265,15 @@ def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos,
     ragged paged-attention kernel here, with write_fn returning the RAW
     paged pool (no gathered view) as k_view/v_view; ``mask`` is then unused.
     Returns (final-normed hidden [b, s, h], all_k, all_v).
+
+    ``fused_fn(q_pre, k_pre, v, cache_k_layer, cache_v_layer) ->
+    (attn_out [b, s, nh*hd], new_cache_k, new_cache_v)`` replaces the whole
+    rope -> write_fn -> attend sequence with ONE call — the paged decode
+    path passes the fused rope+append+attention Pallas step here
+    (ops/pallas/paged_attention.fused_decode_step, docs/paged_attention.md
+    "Fused decode step"); q/k arrive PRE-rope and ``mask``/``write_fn``/
+    ``attend_fn`` are unused.  ``fused_fn=None`` (every other engine)
+    traces the exact pre-fusion program.
 
     ``tp_axis`` (docs/tp_serving.md): name of the mesh axis when this body
     runs INSIDE a shard_map region of the continuous-batching engine's
@@ -319,13 +328,18 @@ def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos,
         q = (xn @ wmat(lp["wq"], dt)).reshape(b, s, nh, hd)
         k = (xn @ wmat(lp["wk"], dt)).reshape(b, s, nkv, hd)
         v = (xn @ wmat(lp["wv"], dt)).reshape(b, s, nkv, hd)
-        q, k = rope_mod.apply_rotary_pos_emb(q, k, cos, sin)
-        ck, k_att = write_fn(ck, k)
-        cv, v_att = write_fn(cv, v)
+        if fused_fn is not None:
+            # rope + KV append + attention in one fused launch (q/k pre-rope)
+            attn, ck, cv = fused_fn(q, k, v, ck, cv)
+        else:
+            q, k = rope_mod.apply_rotary_pos_emb(q, k, cos, sin)
+            ck, k_att = write_fn(ck, k)
+            cv, v_att = write_fn(cv, v)
+            attn = attend(q, k_att, v_att)
         # the two decoder halves (attn-out projection + residual, mlp +
         # residual) are the factored sharded forward shared with training
         # (models/llama.py) — under TP they hold the layer's two psums
-        x = decoder_attn_residual(x, attend(q, k_att, v_att), lp, wmat=wmat,
+        x = decoder_attn_residual(x, attn, lp, wmat=wmat,
                                   tp_axis=tp_axis)
         x = decoder_mlp_residual(cfg, x, lp, wmat=wmat, tp_axis=tp_axis)
         return x, (ck, cv)

@@ -39,6 +39,21 @@ dry the youngest slot is preempted vLLM-style (blocks freed, request
 requeued with prompt+generated so far; the stored tokens are teacher-forced
 on resume, which makes the recompute exact for greedy AND sampled decode).
 
+Long-context flash-decode + the fused decode step (docs/paged_attention.md)
+are the paged decode path's pure-speed levers, both on by default and both
+token-identical to the paths they replace: decode attention dispatches
+split-K (a long slot's page walk runs as S parallel shards merged by an
+exact log-sum-exp combine — ``PADDLE_TPU_DISABLE_PALLAS=flash_decode``
+restores the sequential walk), and the whole per-layer decode prologue —
+RoPE, the two KV-append scatters and the attention kernel — runs as ONE
+fused Pallas launch (``PADDLE_TPU_DISABLE_PALLAS=fused_decode_step``
+rebuilds the unfused engine byte-identically; in fused mode the pool
+carries one extra SPILL page dropped writes land on, since a Pallas output
+index map cannot drop).  Verify/prefill/mixed programs are byte-untouched;
+TP, speculation, chunked prefill, prefix-cache COW and the graceful ladder
+compose with both by construction (the fused launch runs per shard inside
+shard_map exactly like the rest of the kernel family).
+
 ``enable_prefix_caching=True`` (paged mode only) layers an automatic prefix
 cache over the block pool (prefix_cache.py, docs/prefix_cache.md): every full
 block gets a hash-chained content id, admission maps the longest cached
@@ -426,6 +441,7 @@ class ContinuousBatchingEngine:
             self._cache_sharding = NamedSharding(self._mesh,
                                                  self._cache_spec)
             self.params = jax.device_put(self.params, self._param_shardings)
+        self._fused = False   # fused decode step: paged-mode only, see below
         if paged:
             assert max_seq % block_size == 0, (max_seq, block_size)
             self.block_size = block_size
@@ -439,7 +455,26 @@ class ContinuousBatchingEngine:
             assert self.num_blocks >= self.max_blocks, (
                 f"pool of {self.num_blocks} blocks cannot hold one full "
                 f"request ({self.max_blocks} blocks)")
-            shape = (L, self.num_blocks, nkv, block_size, hd)
+            # fused decode step (docs/paged_attention.md "Fused decode
+            # step"): rope + KV-append + attention in ONE Pallas launch per
+            # layer on the plain decode path.  Decided at ctor time because
+            # the pool grows a SPILL page (physical index num_blocks) that
+            # dropped writes land on — Pallas output index maps cannot
+            # drop.  The allocator never hands the spill page out (its free
+            # list stays range(num_blocks)), reads of sentinel table rows
+            # resolve to it (finite garbage, masked), and every other
+            # compiled program treats it exactly like `.at[...,
+            # mode='drop']` did.  PADDLE_TPU_DISABLE_PALLAS=
+            # fused_decode_step (or =paged_attention, or an unsupported
+            # shape) rebuilds the pre-fusion engine byte-identically:
+            # no spill page, unfused rope + scatter + attention decode.
+            from ..ops.pallas import paged_attention as _pa_mod
+
+            self._fused = (_pa_mod.kernel_supported(
+                cfg.num_attention_heads, nkv, hd, block_size)
+                and not _pa_mod.kernel_disabled("fused_decode_step"))
+            shape = (L, self.num_blocks + (1 if self._fused else 0), nkv,
+                     block_size, hd)
             # host allocator state
             self._free: list[int] = list(range(self.num_blocks))
             self._slot_blocks: list[list[int]] = [[] for _ in range(max_batch)]
@@ -762,7 +797,10 @@ class ContinuousBatchingEngine:
         table[b, pos//bs] at offset pos%bs and attention reads a gathered
         [B, nkv, max_seq, hd] view of each slot's pages (the reference's
         block_multihead_attention memory model; the gather fuses into the
-        attention contraction)."""
+        attention contraction).  On the fused default (``self._fused``,
+        docs/paged_attention.md) rope + the page append + split-K
+        attention run as ONE Pallas launch per layer instead — dropped
+        writes land on the pool's spill page."""
         from .. import inference as _inf
         from ..ops.pallas import rope as rope_mod
 
@@ -783,6 +821,7 @@ class ContinuousBatchingEngine:
         lane = jnp.arange(B)
         writeable = active & (pos < S)
         attend_fn = None
+        fused_fn = None
 
         if table is None:
             def write(ck, k):
@@ -821,7 +860,23 @@ class ContinuousBatchingEngine:
                 view = view.transpose(0, 2, 1, 3, 4).reshape(B, nkv, S, hd)
                 return out, view
 
-            if use_kernel:
+            if self._fused and use_kernel:
+                # decode megastep stage 1: rope + page append + split-K
+                # attention in ONE Pallas launch per layer (docs/
+                # paged_attention.md "Fused decode step").  Dropped writes
+                # (inactive lanes, pos >= max_seq) land on the pool's
+                # spill page — the ctor sized the pool with it.
+                spill = jnp.int32(self.num_blocks)
+                wblk = jnp.where(writeable, jnp.minimum(blk, spill), spill)
+                lens_pre = safe_pos   # append position; inactive lanes 0
+
+                def fused_fn(q, k, v, ck, cv):
+                    # q [B, 1, nh, hd] / k, v [B, 1, nkv, hd] PRE-rope
+                    o, ck, cv = _da.fused_paged_decode_step(
+                        q[:, 0], k[:, 0], v[:, 0], cos[:, 0], sin[:, 0],
+                        ck, cv, table, lens_pre, wblk, writeable)
+                    return o.reshape(B, 1, nh * hd), ck, cv
+            elif use_kernel:
                 seq_now = safe_pos + 1  # incl. the token written this step
 
                 def attend_fn(q, k_pool, v_pool):
@@ -836,7 +891,8 @@ class ContinuousBatchingEngine:
         x, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
                                            write, mask, cos, sin,
                                            attend_fn=attend_fn,
-                                           tp_axis=self._tp_axis)
+                                           tp_axis=self._tp_axis,
+                                           fused_fn=fused_fn)
         return _inf.lm_head_logits(cfg, params, x[:, -1]), ak, av
 
     def _sample_tokens(self, logits, pos, temp, topp, seeds):
@@ -2608,3 +2664,66 @@ class ContinuousBatchingEngine:
             # replaces the bucketed path's log2(max_seq) prefill family
             fns += [self._mixed_greedy, self._mixed_sampling]
         return _n(*fns)
+
+    def decode_step_launches(self) -> dict:
+        """Static dispatch-tax telemetry for ONE greedy decode step: trace
+        the decode program (no compile, no device time) and count its
+        equations plus the per-layer launch-shaped primitives — every
+        ``pallas_call`` and every scatter (the KV appends).  The fused
+        decode step's win is visible here before any wall clock: the
+        unfused paged path traces 1 pallas_call + 2 scatters per layer
+        (plus the rope/gather glue XLA must fuse around them), the fused
+        path traces 1 pallas_call and 0 scatters — the bench rungs report
+        this dict as the launch-count detail (eqns inside the chunk scan's
+        per-step body count once, matching the per-layer dispatch they
+        model)."""
+        from ..analysis.rules import _sub_jaxprs
+
+        B = self.max_batch
+        zi = jnp.zeros((B,), jnp.int32)
+        body = functools.partial(
+            self._decode_impl_paged if self.paged else self._decode_impl,
+            sampling=False, graceful=self._graceful)
+        args = [self.params, self.cache_k, self.cache_v, zi, zi,
+                jnp.ones((B,), bool), jnp.zeros((B,), jnp.float32),
+                jnp.ones((B,), jnp.float32), zi]
+        if self.paged:
+            args.append(jnp.asarray(self._table))
+        if self.tp > 1:
+            body = self._tp_shard(body, n_rep=2 if self._graceful else 1)
+        # telemetry must not contaminate the dispatch counters: the trace
+        # below executes the kernels' Python dispatch, which would tick
+        # KERNEL/FLASH/FUSED_*_CALLS by one launch the serve never ran —
+        # exactly the per-rung contamination reset_kernel_counters() exists
+        # to prevent.  Snapshot and restore around the trace.
+        from ..ops.pallas import paged_attention as _pa
+
+        counter_names = ("KERNEL_CALLS", "FALLBACK_CALLS",
+                         "FLASH_KERNEL_CALLS", "LAST_FLASH_SHARDS",
+                         "FUSED_KERNEL_CALLS", "FUSED_FALLBACK_CALLS")
+        saved = {n: getattr(_pa, n) for n in counter_names}
+        try:
+            closed = jax.make_jaxpr(body)(*args)
+        finally:
+            for n, v in saved.items():
+                setattr(_pa, n, v)
+
+        counts = {"eqns": 0, "pallas_calls": 0, "scatters": 0}
+
+        def walk(jx):
+            counts["eqns"] += len(jx.eqns)
+            for e in jx.eqns:
+                nm = e.primitive.name
+                if nm == "pallas_call":
+                    # a pallas_call is ONE launch however large its body:
+                    # do not descend (in-kernel eqns are not dispatches)
+                    counts["pallas_calls"] += 1
+                    continue
+                if nm.startswith("scatter"):
+                    counts["scatters"] += 1
+                for sub in _sub_jaxprs(e):
+                    walk(sub)
+
+        walk(closed.jaxpr)
+        counts["fused_decode"] = bool(self._fused)
+        return counts

@@ -46,6 +46,7 @@ __all__ = [
     "paged_decode_attention",
     "paged_verify_attention",
     "paged_prefill_attention",
+    "fused_paged_decode_step",
     "append_to_block_cache",
 ]
 
@@ -115,16 +116,22 @@ def append_to_block_cache(key_cache, value_cache, k, v, block_tables, seq_lens):
 
 def paged_decode_attention(q, key_cache, value_cache, block_tables, seq_lens,
                            scale=None, kv_quant=None, k_scale=None,
-                           v_scale=None):
+                           v_scale=None, num_shards=None):
     """Ragged paged-attention decode (the CB engine's ``paged=True`` hot op).
 
     GQA-aware front door over the Pallas kernel
     (`ops/pallas/paged_attention.py`): q may carry ``num_heads`` grouped
     query heads over ``num_kv_heads`` cache heads, and the caches may be
     weight-only-style quantized (``kv_quant`` in {'int8', 'int4'} with
-    per-page scales).  Dispatches to the kernel — which walks only each
-    slot's LIVE block-table pages, so HBM bytes scale with the tokens
-    actually resident, not with the longest request — and falls back to the
+    per-page scales).  Dispatches to the SPLIT-K flash-decode kernel when
+    the per-launch shard heuristic fans out (a long slot's page walk runs
+    as S parallel shards merged by an exact log-sum-exp combine —
+    docs/paged_attention.md "Split-K flash-decode";
+    ``PADDLE_TPU_DISABLE_PALLAS=flash_decode`` restores the sequential
+    walk; ``num_shards`` overrides the heuristic), to the sequential
+    kernel otherwise — both walk only each slot's LIVE block-table pages,
+    so HBM bytes scale with the tokens actually resident, not with the
+    longest request — and falls back to the
     :func:`block_multihead_attention`-style gather oracle off-TPU-shapes or
     under ``PADDLE_TPU_DISABLE_PALLAS=paged_attention``.
 
@@ -135,7 +142,37 @@ def paged_decode_attention(q, key_cache, value_cache, block_tables, seq_lens,
 
     return _pa.paged_attention_decode(
         q, key_cache, value_cache, block_tables, seq_lens, scale=scale,
-        kv_quant=kv_quant, k_scale=k_scale, v_scale=v_scale)
+        kv_quant=kv_quant, k_scale=k_scale, v_scale=v_scale,
+        num_shards=num_shards)
+
+
+def fused_paged_decode_step(q, k_new, v_new, cos, sin, key_cache,
+                            value_cache, block_tables, seq_lens, write_blk,
+                            writeable, scale=None, num_shards=None):
+    """Fused RoPE + KV-append + paged attention for one decode token per
+    slot — decode megastep stage 1 (docs/paged_attention.md "Fused decode
+    step"; the MPK paper's answer to per-layer dispatch tax).  The unfused
+    decode path runs rope (XLA), two one-row scatters and the attention
+    kernel per layer; this front door runs ONE Pallas launch that rotates
+    q/k in-kernel, inserts the new k/v into the slot's write page
+    in-register before the score dot, and commits the page through an
+    aliased pool output.  fp pools only; in the serving engine the pools
+    carry one extra SPILL page (physical index num_blocks) that dropped
+    writes land on.  Falls back to the rope+scatter+gather-oracle
+    composition off-TPU-shapes or under
+    ``PADDLE_TPU_DISABLE_PALLAS=fused_decode_step``.
+
+    Shapes: q [b, nh, hd] PRE-rope; k_new/v_new [b, nkv, hd] pre-rope;
+    cos/sin [b, hd] rope rows at each slot's append position; caches
+    [num_blocks(+1), nkv, block_size, hd]; block_tables [b, max_blocks];
+    seq_lens [b] PRE-append lengths; write_blk [b] physical append page
+    (spill when dropped); writeable [b].  Returns
+    (out [b, nh, hd], key_cache, value_cache)."""
+    from .pallas import paged_attention as _pa
+
+    return _pa.fused_decode_step(
+        q, k_new, v_new, cos, sin, key_cache, value_cache, block_tables,
+        seq_lens, write_blk, writeable, scale=scale, num_shards=num_shards)
 
 
 def paged_verify_attention(q, key_cache, value_cache, block_tables, seq_lens,

@@ -33,7 +33,8 @@ def interpret_mode() -> bool:
 # ('paged_attn') warns with a did-you-mean instead of silently keeping the
 # kernel it was meant to disable (utils/envflags.py)
 KNOWN_KERNELS = frozenset({"all", "flash_attention", "rms_norm", "rope",
-                           "swiglu", "paged_attention"})
+                           "swiglu", "paged_attention", "flash_decode",
+                           "fused_decode_step"})
 
 
 def kernel_disabled(name: str) -> bool:

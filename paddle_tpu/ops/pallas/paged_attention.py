@@ -71,6 +71,43 @@ num_blocks axis) and the per-(slot, head) online softmax are untouched, and
 ``kernel_supported`` evaluates on the local counts (head_dim and the GQA
 ratio are tp-invariant, so support never changes with the degree).  All
 three kernel bodies are byte-identical to the single-chip engine's.
+
+Long-context flash-decode (docs/paged_attention.md "Split-K flash-decode")
+adds a SPLIT-K member, :func:`_flash_decode_kernel`: the decode grid grows a
+page-shard axis — ``(slots, kv_heads, shards, pages_per_shard)`` — so a
+32k-context slot's page walk is processed by S independent shards instead of
+one serial chain (the load-balancing core of the Ragged Paged Attention
+paper, PAPERS.md).  Each shard keeps its own partial online-softmax
+accumulator ``(m, l, acc)`` over its page range and emits it raw; a small
+XLA combine pass (:func:`_flash_combine`, an exact log-sum-exp merge) folds
+the S partials into the same softmax the sequential walk computes.  Shard
+count is chosen per-launch from the table width — the MAX live page count a
+slot can reach (:func:`flash_decode_shards`) — and the dispatch in
+:func:`paged_attention_decode` prefers split-K whenever it is enabled and
+S > 1, keeping BOTH the sequential kernel and the gather reference as
+oracles.  Opt-out: ``PADDLE_TPU_DISABLE_PALLAS=flash_decode`` restores the
+sequential kernel byte-for-byte (``paged_attention`` still opts the whole
+family out to the gather path).
+
+Decode megastep stage 1 (docs/paged_attention.md "Fused decode step") is
+:func:`_fused_decode_kernel`: RoPE application, the KV-page append and the
+split-K paged attention of ONE decode token fused into a single Pallas
+launch per layer (the MPK paper's case against per-op dispatch, PAPERS.md).
+The kernel takes PRE-rope q/k, rotates them in-kernel against per-slot
+cos/sin rows, inserts the roped k (and raw v) into the slot's write page
+in-register BEFORE the score dot — so attention sees the appended token
+without a separate scatter — and commits the updated page through an
+ALIASED pool output whose index map targets exactly the write page (one
+page write per (slot, head), the same bytes the scatter wrote).  Lanes that
+must not write (inactive, or past max_seq) direct their page flush at a
+dedicated SPILL page the caller appends to the pool — Pallas output index
+maps cannot drop, so the drop semantics of ``.at[].set(mode='drop')``
+materialize as one trash-can page the allocator never hands out.  fp pools
+only (the serving engine's KV pools are bf16/f32 — kv_quant stays an
+op-level feature of the unfused kernels).  Opt-out:
+``PADDLE_TPU_DISABLE_PALLAS=fused_decode_step`` (the engine then rebuilds
+the unfused rope + scatter + attention decode path byte-identically,
+spill page gone).
 """
 
 from __future__ import annotations
@@ -105,6 +142,31 @@ VERIFY_FALLBACK_CALLS = 0
 # ditto the ragged chunked-prefill variant (the mixed prefill/decode step)
 PREFILL_KERNEL_CALLS = 0
 PREFILL_FALLBACK_CALLS = 0
+# split-K flash-decode (docs/paged_attention.md): FLASH counts launches that
+# took the page-sharded grid, LAST_FLASH_SHARDS records the shard count the
+# most recent flash trace chose (bench rung detail: flash_combine_shards)
+FLASH_KERNEL_CALLS = 0
+LAST_FLASH_SHARDS = 0
+# fused rope+append+attention decode step (decode megastep stage 1)
+FUSED_KERNEL_CALLS = 0
+FUSED_FALLBACK_CALLS = 0
+
+
+def reset_kernel_counters() -> None:
+    """Zero every module-level kernel/fallback counter.  The counters are
+    trace-time telemetry that persists across engine constructions (they
+    live on the module, not the engine), so per-rung bench detail and
+    "did not fall back" test assertions must reset them at setup or prior
+    rungs/tests contaminate the delta."""
+    global KERNEL_CALLS, FALLBACK_CALLS, VERIFY_KERNEL_CALLS, \
+        VERIFY_FALLBACK_CALLS, PREFILL_KERNEL_CALLS, PREFILL_FALLBACK_CALLS, \
+        FLASH_KERNEL_CALLS, LAST_FLASH_SHARDS, FUSED_KERNEL_CALLS, \
+        FUSED_FALLBACK_CALLS
+    KERNEL_CALLS = FALLBACK_CALLS = 0
+    VERIFY_KERNEL_CALLS = VERIFY_FALLBACK_CALLS = 0
+    PREFILL_KERNEL_CALLS = PREFILL_FALLBACK_CALLS = 0
+    FLASH_KERNEL_CALLS = LAST_FLASH_SHARDS = 0
+    FUSED_KERNEL_CALLS = FUSED_FALLBACK_CALLS = 0
 
 # MXU/VPU rows: the q-head group is padded up to this many rows so the
 # logits tile and the scratch accumulators keep a full sublane
@@ -315,6 +377,196 @@ def _paged_attention_kernel_call(q, key_cache, value_cache, block_tables,
 
 
 # ---------------------------------------------------------------------------
+# split-K flash-decode (page-sharded grid + log-sum-exp combine)
+# ---------------------------------------------------------------------------
+
+#: auto shard sizing: one shard per this many table pages, capped — a
+#: 512-page (32k-context @ bs=64) table gets 8 shards of 64 pages, a tiny
+#: 8-page test table gets 2; tables under 2*_FLASH_PAGES_PER_SHARD stay on
+#: the sequential kernel (S == 1 has nothing to parallelize)
+_FLASH_PAGES_PER_SHARD = 4
+_FLASH_MAX_SHARDS = 8
+
+
+def flash_decode_shards(max_blocks: int, num_shards: int | None = None) -> int:
+    """Shard count for a split-K decode launch.  ``max_blocks`` (the block
+    table's width) is the MAX live page count any slot can reach — the only
+    static bound available at trace time, and the per-launch knob the ISSUE
+    names: a long-context engine (wide table) fans out, a short one stays
+    sequential.  ``num_shards`` overrides (tests force shard-count > live
+    pages); always clamped to [1, max_blocks]."""
+    if num_shards is None:
+        num_shards = min(_FLASH_MAX_SHARDS,
+                         max_blocks // _FLASH_PAGES_PER_SHARD)
+    return max(1, min(int(num_shards), max_blocks))
+
+
+def _online_softmax_update(q, k, v, j, bs, length, m_scr, l_scr, acc_scr,
+                           scale):
+    """One page's update of the split-K online-softmax state: score dot,
+    column mask against ``length``, max/rescale recurrence into the
+    (m, l, acc) scratch.  The ONE copy shared by the split-K flash kernel
+    and the fused decode kernel, so a masking or rescaling fix can never
+    make the two diverge (the byte-pinned sequential/verify/prefill
+    kernels keep their own frozen copies by design).  ``q``/``k``/``v``
+    are f32 tiles ([rows, hd] / [bs, hd])."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # [rows, bs]
+    cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(cols < length, s, NEG_INF)
+    m_prev = m_scr[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.where(s > 0.5 * NEG_INF, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.where(m_prev > 0.5 * NEG_INF, jnp.exp(m_prev - m_new), 0.0)
+    l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+
+
+def _flash_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
+                  scale, bs, kv_quant, pages_per_shard):
+    """Grid: (slots, kv_heads, shards, pages_per_shard) — the decode
+    kernel's page walk with a page-shard axis: shard s owns logical pages
+    [s*P, (s+1)*P) and runs the SAME online-softmax recurrence over them,
+    but instead of finalizing it emits its raw partial ``(m, l, acc)`` —
+    the combine pass (:func:`_flash_combine`) merges the S partials
+    exactly.  Shards wholly past a slot's live pages skip compute (their
+    DMA re-fetches the last live page, which Mosaic elides) and emit the
+    empty accumulator (m = NEG_INF, l = 0)."""
+    if kv_quant:
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    m_ref, l_ref, acc_ref, m_scr, l_scr, acc_scr = rest
+    b = pl.program_id(0)
+    s_id = pl.program_id(2)
+    p = pl.program_id(3)
+    j = s_id * pages_per_shard + p                        # logical page
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = lens_ref[b]
+
+    @pl.when(j * bs < length)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)               # [group, hd]
+        k = _dequant_page(k_ref[0, 0], ks_ref[0, 0] if kv_quant else None,
+                          kv_quant)                       # [bs, hd]
+        v = _dequant_page(v_ref[0, 0], vs_ref[0, 0] if kv_quant else None,
+                          kv_quant)
+        _online_softmax_update(q, k, v, j, bs, length, m_scr, l_scr,
+                               acc_scr, scale)
+
+    @pl.when(p == pages_per_shard - 1)
+    def _emit_partial():
+        m_ref[0, 0, 0] = m_scr[:]
+        l_ref[0, 0, 0] = l_scr[:]
+        acc_ref[0, 0, 0] = acc_scr[:]
+
+
+def _flash_page_index_map(bs: int, num_blocks: int, pages_per_shard: int):
+    # the sequential kernel's physical-page resolution over the GLOBAL
+    # logical page index j = s*P + p; shards past the live range remap to
+    # the last live page (copy elided) exactly like the sequential tail
+    def idx(b, h, s, p, tables_ref, lens_ref):
+        j = s * pages_per_shard + p
+        return (_resolve_page(b, j, tables_ref, lens_ref, bs, num_blocks),
+                h, 0, 0)
+
+    return idx
+
+
+def _flash_scale_index_map(bs: int, num_blocks: int, pages_per_shard: int):
+    def idx(b, h, s, p, tables_ref, lens_ref):
+        j = s * pages_per_shard + p
+        return (_resolve_page(b, j, tables_ref, lens_ref, bs, num_blocks), h)
+
+    return idx
+
+
+def _flash_combine(m, l, acc):
+    """Log-sum-exp merge of per-shard partial accumulators — the "small
+    combine pass".  m/l: [b, nkv, S, group, 1] f32, acc: [b, nkv, S, group,
+    hd] f32.  Mathematically exact: each shard's softmax contribution is
+    rescaled to the global max before the weighted sum, so the result
+    equals the sequential walk's softmax (same f32 numerics floor).  All
+    shards empty (seq_len == 0 slot) -> l_tot == 0 -> zeros, matching the
+    sequential kernel's empty-accumulator finalize."""
+    m_max = jnp.max(m, axis=2, keepdims=True)             # [b, nkv, 1, g, 1]
+    w = jnp.where(m > 0.5 * NEG_INF, jnp.exp(m - m_max), 0.0)
+    l_tot = jnp.sum(w * l, axis=2)                        # [b, nkv, g, 1]
+    acc_tot = jnp.sum(w * acc, axis=2)                    # [b, nkv, g, hd]
+    l_safe = jnp.where(l_tot == 0.0, 1.0, l_tot)
+    return acc_tot / l_safe
+
+
+def _flash_decode_kernel_call(q, key_cache, value_cache, block_tables,
+                              seq_lens, scale, kv_quant, k_scale, v_scale,
+                              num_shards):
+    """Split-K launch: q [b, nkv, group, hd] (group padded to sublane rows);
+    caches [num_blocks, nkv, bs, hd_store].  Returns [b, nkv, group, hd]
+    (partials merged by :func:`_flash_combine`)."""
+    b, nkv, group, hd = q.shape
+    num_blocks, _, bs, _ = key_cache.shape
+    max_blocks = block_tables.shape[1]
+    S = num_shards
+    P = -(-max_blocks // S)                               # pages per shard
+
+    kernel = functools.partial(_flash_kernel, scale=scale, bs=bs,
+                               kv_quant=kv_quant, pages_per_shard=P)
+    kv_spec = pl.BlockSpec((1, 1, bs, key_cache.shape[-1]),
+                           _flash_page_index_map(bs, num_blocks, P))
+    in_specs = [
+        pl.BlockSpec((1, 1, group, hd),
+                     lambda b, h, s, p, t, l: (b, h, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    args = [q, key_cache, value_cache]
+    if kv_quant:
+        sc_spec = pl.BlockSpec((1, 1), _flash_scale_index_map(bs, num_blocks,
+                                                              P))
+        in_specs += [sc_spec, sc_spec]
+        args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+
+    part_spec = pl.BlockSpec((1, 1, 1, group, 1),
+                             lambda b, h, s, p, t, l: (b, h, s, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nkv, S, P),
+        in_specs=in_specs,
+        out_specs=[
+            part_spec,
+            part_spec,
+            pl.BlockSpec((1, 1, 1, group, hd),
+                         lambda b, h, s, p, t, l: (b, h, s, 0, 0)),
+        ],
+        scratch_shapes=[
+            _VMEM((group, 1), jnp.float32),
+            _VMEM((group, 1), jnp.float32),
+            _VMEM((group, hd), jnp.float32),
+        ],
+    )
+    m, l, acc = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nkv, S, group, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, nkv, S, group, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, nkv, S, group, hd), jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), *args)
+    return _flash_combine(m, l, acc).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
 # pure-JAX reference (fallback + test oracle)
 # ---------------------------------------------------------------------------
 
@@ -373,10 +625,12 @@ def paged_attention_reference(q, key_cache, value_cache, block_tables,
 # ---------------------------------------------------------------------------
 
 def _dispatch(q, key_cache, value_cache, block_tables, seq_lens, k_scale,
-              v_scale, scale, kv_quant):
-    """Forward dispatch: Pallas kernel when supported, gather oracle
-    otherwise (and the trace-time path counters)."""
-    global KERNEL_CALLS, FALLBACK_CALLS
+              v_scale, scale, kv_quant, num_shards=None):
+    """Forward dispatch: split-K flash-decode when enabled and the shard
+    heuristic fans out, the sequential Pallas kernel otherwise, gather
+    oracle off-TPU-shapes (and the trace-time path counters)."""
+    global KERNEL_CALLS, FALLBACK_CALLS, FLASH_KERNEL_CALLS, \
+        LAST_FLASH_SHARDS
     b, nh, hd = q.shape
     num_blocks, nkv, bs, _ = key_cache.shape
     if not kernel_supported(nh, nkv, hd, bs):
@@ -384,7 +638,6 @@ def _dispatch(q, key_cache, value_cache, block_tables, seq_lens, k_scale,
         return paged_attention_reference(
             q, key_cache, value_cache, block_tables, seq_lens, scale=scale,
             kv_quant=kv_quant, k_scale=k_scale, v_scale=v_scale)
-    KERNEL_CALLS += 1
 
     rep = nh // nkv
     group = _round_up(rep, _MIN_GROUP_ROWS)
@@ -393,31 +646,45 @@ def _dispatch(q, key_cache, value_cache, block_tables, seq_lens, k_scale,
         # pad the q-head group to a full sublane; padded rows attend over
         # the same pages (finite logits) and are sliced off below
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, group - rep), (0, 0)))
-    out = _paged_attention_kernel_call(
-        qg, key_cache, value_cache, block_tables, seq_lens, scale,
-        kv_quant, k_scale, v_scale)
+
+    # split-K dispatch: the kill switch wins over an explicit num_shards
+    # (the operator's escape hatch must always restore the sequential walk)
+    S = 1
+    if not kernel_disabled("flash_decode"):
+        S = flash_decode_shards(block_tables.shape[1], num_shards)
+    if S > 1:
+        FLASH_KERNEL_CALLS += 1
+        LAST_FLASH_SHARDS = S
+        out = _flash_decode_kernel_call(
+            qg, key_cache, value_cache, block_tables, seq_lens, scale,
+            kv_quant, k_scale, v_scale, S)
+    else:
+        KERNEL_CALLS += 1
+        out = _paged_attention_kernel_call(
+            qg, key_cache, value_cache, block_tables, seq_lens, scale,
+            kv_quant, k_scale, v_scale)
     return out[:, :, :rep].reshape(b, nh, hd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
 def _paged_core(q, key_cache, value_cache, block_tables, seq_lens, k_scale,
-                v_scale, scale, kv_quant):
+                v_scale, scale, kv_quant, num_shards):
     # custom_vjp so the eager tape / jit-grad compose (the repo's kernel
     # contract, ops/pallas/__init__.py): pallas_call has no AD rule, so the
     # backward recomputes through the pure-JAX reference instead
     return _dispatch(q, key_cache, value_cache, block_tables, seq_lens,
-                     k_scale, v_scale, scale, kv_quant)
+                     k_scale, v_scale, scale, kv_quant, num_shards)
 
 
 def _paged_core_fwd(q, key_cache, value_cache, block_tables, seq_lens,
-                    k_scale, v_scale, scale, kv_quant):
+                    k_scale, v_scale, scale, kv_quant, num_shards):
     out = _dispatch(q, key_cache, value_cache, block_tables, seq_lens,
-                    k_scale, v_scale, scale, kv_quant)
+                    k_scale, v_scale, scale, kv_quant, num_shards)
     return out, (q, key_cache, value_cache, block_tables, seq_lens,
                  k_scale, v_scale)
 
 
-def _paged_core_bwd(scale, kv_quant, res, g):
+def _paged_core_bwd(scale, kv_quant, num_shards, res, g):
     q, key_cache, value_cache, block_tables, seq_lens, k_scale, v_scale = res
     zero = lambda x: None if x is None else jnp.zeros_like(x)
     if kv_quant is None:
@@ -445,7 +712,7 @@ _paged_core.defvjp(_paged_core_fwd, _paged_core_bwd)
 
 def paged_attention_decode(q, key_cache, value_cache, block_tables, seq_lens,
                            scale=None, kv_quant=None, k_scale=None,
-                           v_scale=None):
+                           v_scale=None, num_shards=None):
     """Ragged paged-attention decode over a block-table KV cache.
 
     Args:
@@ -459,10 +726,17 @@ def paged_attention_decode(q, key_cache, value_cache, block_tables, seq_lens,
         slot's live pages may be arbitrary/sentinel (they are never read).
       seq_lens: [b] int32 valid KV length per slot (0 → output zeros).
       k_scale/v_scale: [num_blocks, num_kv_heads] f32 (quantized caches).
+      num_shards: split-K override — None picks
+        :func:`flash_decode_shards`' per-launch count from the table width
+        (the max live page count); an explicit value forces that many page
+        shards (clamped to [1, max_blocks]; 1 = the sequential walk).
 
-    Returns [b, num_heads, head_dim] in q's dtype.  Dispatches to the Pallas
-    kernel when :func:`kernel_supported`; otherwise (or under
-    ``PADDLE_TPU_DISABLE_PALLAS=paged_attention``) to the gather reference.
+    Returns [b, num_heads, head_dim] in q's dtype.  Dispatches to the
+    split-K flash-decode kernel when the shard heuristic fans out (opt-out
+    ``PADDLE_TPU_DISABLE_PALLAS=flash_decode`` restores the sequential
+    kernel), the sequential Pallas kernel otherwise when
+    :func:`kernel_supported`, and (or under
+    ``PADDLE_TPU_DISABLE_PALLAS=paged_attention``) the gather reference.
     """
     assert kv_quant in (None, "int8", "int4"), kv_quant
     b, nh, hd = q.shape
@@ -477,7 +751,8 @@ def paged_attention_decode(q, key_cache, value_cache, block_tables, seq_lens,
     if scale is None:
         scale = 1.0 / math.sqrt(hd)
     return _paged_core(q, key_cache, value_cache, block_tables, seq_lens,
-                       k_scale, v_scale, scale, kv_quant)
+                       k_scale, v_scale, scale, kv_quant,
+                       None if num_shards is None else int(num_shards))
 
 
 # ---------------------------------------------------------------------------
@@ -923,3 +1198,299 @@ def paged_attention_prefill(q, key_cache, value_cache, block_tables,
                                k_scale, v_scale)
     out = out[:, :, :qmax * rep].reshape(b, nkv, qmax, rep, hd)
     return out.transpose(0, 2, 1, 3, 4).reshape(b, qmax, nh, hd)
+
+
+# ---------------------------------------------------------------------------
+# fused rope + KV-append + attention decode step (decode megastep stage 1)
+# ---------------------------------------------------------------------------
+
+def _rotate_half_rows(x, half: int):
+    """rotate-half along the last (head_dim) axis of a 2-D tile."""
+    return jnp.concatenate([-x[:, half:], x[:, :half]], axis=-1)
+
+
+def _fused_decode_kernel(tables_ref, lens_ref, wblk_ref, wable_ref,
+                         q_ref, k_ref, v_ref, cos_ref, sin_ref,
+                         kp_ref, vp_ref,
+                         m_ref, l_ref, acc_ref, kp_out_ref, vp_out_ref,
+                         m_scr, l_scr, acc_scr, q_scr,
+                         *, scale, bs, pages_per_shard):
+    """Grid: (slots, kv_heads, shards, pages_per_shard) — the split-K page
+    walk with the whole decode-token prologue folded in:
+
+    - RoPE: q (the slot's padded head group) is rotated ONCE per (slot,
+      head) into f32 scratch at the first grid step; the new k row is
+      rotated at the write step.  cos/sin arrive as per-slot rows (the
+      caller gathers them from its position table — a [b, hd] operand, not
+      a launch).
+    - append: at the write step (logical page ``lens // bs``) the roped k
+      and raw v are inserted into the fetched page tile IN-REGISTER before
+      the score dot — attention sees the appended token without a separate
+      scatter — and the updated tile is committed through the ALIASED pool
+      output, whose index map pins the slot's write page (``wblk``).  One
+      page write per (slot, head): the same bytes the XLA scatter wrote.
+    - lanes with ``wable == 0`` (inactive / past max_seq) never insert;
+      their pool-output flush lands on the caller's SPILL page (``wblk`` =
+      spill) and commits ZEROS — the materialized form of ``mode='drop'``,
+      kept deterministic so a sentinel-page gather can never read
+      uninitialized (possibly NaN) bits off the spill page.
+
+    Scalar-prefetch refs: tables [b, max_blocks], lens [b] PRE-append
+    length (the append position), wblk [b] physical write page (spill when
+    dropped), wable [b] 0/1.  Attention masks columns < lens + 1."""
+    b = pl.program_id(0)
+    s_id = pl.program_id(2)
+    p = pl.program_id(3)
+    j = s_id * pages_per_shard + p                        # logical page
+    length = lens_ref[b] + 1                              # incl. appended tok
+    half = q_scr.shape[-1] // 2
+
+    @pl.when((s_id == 0) & (p == 0))
+    def _rope_q():
+        # rope in the INPUT dtype, exactly like the unfused path's
+        # apply_rotary_pos_emb (bf16 operands -> bf16 math): the fused
+        # program must feed the score dot the same rounded values the
+        # kill-switched program reads, or near-tied argmaxes could flip
+        q = q_ref[0, 0]                                   # [group, hd]
+        cos = cos_ref[0][None, :]                         # [1, hd]
+        sin = sin_ref[0][None, :]
+        q_r = (q * cos + _rotate_half_rows(q, half) * sin).astype(q.dtype)
+        q_scr[:] = q_r.astype(jnp.float32)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j * bs < length)
+    def _compute():
+        k_page = kp_ref[0, 0].astype(jnp.float32)         # [bs, hd]
+        v_page = vp_ref[0, 0].astype(jnp.float32)
+        w_on = wable_ref[b] == 1
+        is_wpage = j == lens_ref[b] // bs                 # walked by EVERY
+        is_wstep = w_on & is_wpage                        # lane (lens 0 -> 0)
+        # rope the new k in the INPUT dtype (matching apply_rotary_pos_emb)
+        # and round through the POOL dtype before the dot: the fused score
+        # must see exactly the bytes the unfused path would read back from
+        # its scatter — not an unrounded f32 row
+        cos = cos_ref[0][None, :]                         # [1, hd]
+        sin = sin_ref[0][None, :]
+        k_new = k_ref[0, 0][None, :]                      # [1, hd]
+        k_roped = (k_new * cos + _rotate_half_rows(k_new, half) * sin
+                   ).astype(k_new.dtype).astype(kp_ref.dtype)[0]
+        v_new = v_ref[0, 0].astype(vp_ref.dtype)          # [hd]
+        rows = jax.lax.broadcasted_iota(jnp.int32, k_page.shape, 0)
+        ins = is_wstep & (rows == lens_ref[b] % bs)
+        k_eff = jnp.where(ins, k_roped.astype(jnp.float32)[None, :], k_page)
+        v_eff = jnp.where(ins, v_new.astype(jnp.float32)[None, :], v_page)
+
+        @pl.when(is_wpage)
+        def _commit():
+            # non-inserted rows round-trip f32 exactly (bf16/f32 storage)
+            # and the inserted row was roped in the input dtype and rounded
+            # through the pool dtype above — the committed page holds the
+            # same values the unfused path's scatter wrote (modulo FMA
+            # contraction choices the compiler makes per program).
+            # Dropped lanes (w_on == 0) write ZEROS: their flush lands on
+            # the caller's spill page, and the output VMEM buffer would
+            # otherwise carry uninitialized bits on hardware — a NaN
+            # pattern parked on the spill page would poison every later
+            # sentinel-page gather through the masked softmax's 0*NaN
+            # (the guarantee jnp.take(..., fill_value=0) used to give).
+            zero = jnp.zeros_like(k_eff)
+            kp_out_ref[0, 0] = jnp.where(w_on, k_eff,
+                                         zero).astype(kp_out_ref.dtype)
+            vp_out_ref[0, 0] = jnp.where(w_on, v_eff,
+                                         zero).astype(vp_out_ref.dtype)
+
+        _online_softmax_update(q_scr[:], k_eff, v_eff, j, bs, length,
+                               m_scr, l_scr, acc_scr, scale)
+
+    @pl.when(p == pages_per_shard - 1)
+    def _emit_partial():
+        m_ref[0, 0, 0] = m_scr[:]
+        l_ref[0, 0, 0] = l_scr[:]
+        acc_ref[0, 0, 0] = acc_scr[:]
+
+
+def _fused_page_index_map(bs: int, nbp: int, pages_per_shard: int):
+    # the split-K physical-page resolution over length + 1 (the walk must
+    # include the append page); sentinel table entries clip to nbp - 1 —
+    # the caller's SPILL page in fused pools, so an unseated lane's reads
+    # can never alias a live slot's write page
+    def idx(b, h, s, p, tables_ref, lens_ref, wblk_ref, wable_ref):
+        j = s * pages_per_shard + p
+        n_live = jnp.maximum((lens_ref[b] + 1 + bs - 1) // bs, 1)
+        j_eff = jnp.minimum(j, n_live - 1)
+        return (jnp.clip(tables_ref[b, j_eff], 0, nbp - 1), h, 0, 0)
+
+    return idx
+
+
+def _fused_decode_kernel_call(qg, k_new, v_new, cos, sin, key_cache,
+                              value_cache, block_tables, seq_lens,
+                              write_blk, writeable, scale, num_shards):
+    """qg: [b, nkv, group, hd] PRE-rope (group padded to sublane rows);
+    k_new/v_new: [b, nkv, hd]; cos/sin: [b, hd]; pools [nbp, nkv, bs, hd].
+    Returns (m, l, acc partials, new key pool, new value pool)."""
+    b, nkv, group, hd = qg.shape
+    nbp, _, bs, _ = key_cache.shape
+    max_blocks = block_tables.shape[1]
+    S = num_shards
+    P = -(-max_blocks // S)                               # pages per shard
+
+    kernel = functools.partial(_fused_decode_kernel, scale=scale, bs=bs,
+                               pages_per_shard=P)
+    kv_spec = pl.BlockSpec((1, 1, bs, hd), _fused_page_index_map(bs, nbp, P))
+    pool_out_spec = pl.BlockSpec(
+        (1, 1, bs, hd), lambda b, h, s, p, t, l, w, a: (w[b], h, 0, 0))
+    part_spec = pl.BlockSpec((1, 1, 1, group, 1),
+                             lambda b, h, s, p, t, l, w, a: (b, h, s, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, nkv, S, P),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, hd),
+                         lambda b, h, s, p, t, l, w, a: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, hd),
+                         lambda b, h, s, p, t, l, w, a: (b, h, 0)),
+            pl.BlockSpec((1, 1, hd),
+                         lambda b, h, s, p, t, l, w, a: (b, h, 0)),
+            pl.BlockSpec((1, hd),
+                         lambda b, h, s, p, t, l, w, a: (b, 0)),
+            pl.BlockSpec((1, hd),
+                         lambda b, h, s, p, t, l, w, a: (b, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=[
+            part_spec,
+            part_spec,
+            pl.BlockSpec((1, 1, 1, group, hd),
+                         lambda b, h, s, p, t, l, w, a: (b, h, s, 0, 0)),
+            pool_out_spec,
+            pool_out_spec,
+        ],
+        scratch_shapes=[
+            _VMEM((group, 1), jnp.float32),
+            _VMEM((group, 1), jnp.float32),
+            _VMEM((group, hd), jnp.float32),
+            _VMEM((group, hd), jnp.float32),    # roped q
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nkv, S, group, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, nkv, S, group, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, nkv, S, group, hd), jnp.float32),
+            jax.ShapeDtypeStruct(key_cache.shape, key_cache.dtype),
+            jax.ShapeDtypeStruct(value_cache.shape, value_cache.dtype),
+        ],
+        # pool inputs (global operand indices 9/10: four scalar-prefetch
+        # refs then five small operands precede them) alias the pool
+        # outputs — the append is in-place, no pool copy materializes
+        input_output_aliases={9: 3, 10: 4},
+        interpret=interpret_mode(),
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      write_blk.astype(jnp.int32), writeable.astype(jnp.int32),
+      qg, k_new, v_new, cos, sin, key_cache, value_cache)
+
+
+def fused_decode_step_reference(q, k_new, v_new, cos, sin, key_cache,
+                                value_cache, block_tables, seq_lens,
+                                write_blk, writeable, scale=None):
+    """Oracle for the fused decode step: the unfused composition — rope in
+    the INPUT dtype (exactly ``apply_rotary_pos_emb``'s math, which the
+    kernel mirrors), one-row scatter append, gather-oracle attention over
+    ``seq_lens + 1``.  Same signature and return contract as the kernel
+    path; lanes with ``writeable == 0`` drop their append (scatter
+    mode='drop' via an out-of-range index)."""
+    from . import rope as rope_mod
+
+    b, nh, hd = q.shape
+    nbp, nkv, bs, _ = key_cache.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+
+    # the ONE rotate-half implementation (ops/pallas/rope.py) — the oracle
+    # is the unfused composition by definition, so it must rope through
+    # the same function the unfused engine path calls
+    q_r, k_r = rope_mod.apply_rotary_pos_emb(
+        q[:, None], k_new[:, None], cos[:, None, :], sin[:, None, :])
+    q_r, k_r = q_r[:, 0], k_r[:, 0]                       # [b, {nh,nkv}, hd]
+    off = seq_lens % bs
+    drop = jnp.where(writeable.astype(bool), write_blk, nbp)  # oob -> drop
+    kc = key_cache.at[drop, :, off].set(k_r.astype(key_cache.dtype),
+                                        mode="drop")
+    vc = value_cache.at[drop, :, off].set(
+        v_new.astype(value_cache.dtype), mode="drop")
+    out = paged_attention_reference(q_r, kc, vc,
+                                    block_tables, seq_lens + 1, scale=scale)
+    return out, kc, vc
+
+
+def fused_decode_step(q, k_new, v_new, cos, sin, key_cache, value_cache,
+                      block_tables, seq_lens, write_blk, writeable,
+                      scale=None, num_shards=None):
+    """Fused RoPE + KV-page append + split-K paged attention for ONE decode
+    token per slot — the serving engine's decode-path megastep stage 1
+    (docs/paged_attention.md "Fused decode step").
+
+    Args:
+      q: [b, num_heads, head_dim] PRE-rope query (GQA like decode).
+      k_new/v_new: [b, num_kv_heads, head_dim] PRE-rope key / value of the
+        token being appended.
+      cos/sin: [b, head_dim] rope rows at each slot's append position.
+      key_cache/value_cache: [nbp, num_kv_heads, block_size, head_dim] fp
+        pools.  In the serving engine nbp = num_blocks + 1: the last page
+        is the SPILL page dropped writes land on (Pallas output index maps
+        cannot drop).  kv_quant pools are not supported here — appending
+        would dirty the per-page scale (quant stays on the unfused path).
+      block_tables: [b, max_blocks] int32 physical page ids.
+      seq_lens: [b] int32 PRE-append lengths (the append position).
+      write_blk: [b] int32 physical append page — the slot's own private
+        page for writeable lanes, the spill page otherwise.
+      writeable: [b] bool/int32 — 0 drops the append (inactive lane or
+        position past max_seq) and masks the insert.
+
+    Returns ``(out [b, num_heads, head_dim], key_cache, value_cache)`` —
+    attention over columns < seq_lens + 1 (the appended token included)
+    plus the updated pools (aliased: donated callers update in place).
+    Dispatches to the fused kernel when :func:`kernel_supported`; the
+    ``PADDLE_TPU_DISABLE_PALLAS=fused_decode_step`` opt-out (or an
+    unsupported shape) routes to the unfused reference composition.
+    Forward-only: serving never differentiates through the KV cache."""
+    global FUSED_KERNEL_CALLS, FUSED_FALLBACK_CALLS, LAST_FLASH_SHARDS
+    b, nh, hd = q.shape
+    nbp, nkv, bs, hd_store = key_cache.shape
+    assert hd_store == hd, (hd_store, hd)
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    if (not kernel_supported(nh, nkv, hd, bs)
+            or kernel_disabled("fused_decode_step")):
+        FUSED_FALLBACK_CALLS += 1
+        return fused_decode_step_reference(
+            q, k_new, v_new, cos, sin, key_cache, value_cache, block_tables,
+            seq_lens, write_blk, writeable, scale=scale)
+    FUSED_KERNEL_CALLS += 1
+
+    # the fused walk shares the split-K fan-out (S == 1 when flash_decode
+    # is killed: sequential walk, trivially-merged single partial)
+    S = 1
+    if not kernel_disabled("flash_decode"):
+        S = flash_decode_shards(block_tables.shape[1], num_shards)
+    if S > 1:
+        LAST_FLASH_SHARDS = S
+    rep = nh // nkv
+    group = _round_up(rep, _MIN_GROUP_ROWS)
+    qg = q.reshape(b, nkv, rep, hd)
+    if group != rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, group - rep), (0, 0)))
+    m, l, acc, kc, vc = _fused_decode_kernel_call(
+        qg, k_new, v_new, cos, sin, key_cache, value_cache, block_tables,
+        seq_lens, write_blk, writeable, scale, S)
+    out = _flash_combine(m, l, acc).astype(q.dtype)
+    return out[:, :, :rep].reshape(b, nh, hd), kc, vc

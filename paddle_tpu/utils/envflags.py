@@ -31,7 +31,14 @@ through :func:`env_bool`, which enforces the '0'/'1' vocabulary):
   byte-identically (faults raise out of ``step()`` again).
 
 (``PADDLE_TPU_DISABLE_PALLAS`` is the token-set switch; its vocabulary lives
-with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``.
+with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``.  Two of its
+tokens are per-path decode kill switches rather than whole-kernel opt-outs
+(docs/paged_attention.md): ``flash_decode`` pins the paged decode kernel to
+the sequential page walk (split-K off), and ``fused_decode_step`` rebuilds
+the serving engine's unfused rope + KV-scatter + attention decode path
+(``paged_attention`` still opts the whole family out to the gather oracle).
+Both are registered in ``KNOWN_KERNELS`` so a typo gets the did-you-mean
+warning instead of silently leaving the kernel it meant to disable running.
 ``PADDLE_TPU_FAULT_INJECT`` is the structured fault-injection plan; its
 clause grammar is validated by :func:`env_fault_spec` and its fault-kind
 vocabulary lives with the injector — inference/faults.py ``KNOWN_KINDS``

@@ -236,11 +236,16 @@ def test_tp_pool_shards_only_kv_heads():
     1/tp slice of kv heads; params follow the Megatron split."""
     eng = _engine(4, num_blocks=16)
     L = _CFG.num_hidden_layers
+    # page axis whole per shard: 16 allocator pages (+ the fused decode
+    # step's spill page when that mode is on — PR 9 / ISSUE 10, docs/
+    # paged_attention.md) — the spill page rides the unsharded axis too
+    pages = 16 + (1 if eng._fused else 0)
     for pool in (eng.cache_k, eng.cache_v):
         shards = pool.addressable_shards
         assert len(shards) == 4
         for sh in shards:
-            assert sh.data.shape == (L, 16, _CFG.num_key_value_heads // 4,
+            assert sh.data.shape == (L, pages,
+                                     _CFG.num_key_value_heads // 4,
                                      8, _CFG.head_dim)
     # column-parallel wq: output (heads) dim split; row-parallel wo: input
     wq = eng.params["layers"]["wq"]

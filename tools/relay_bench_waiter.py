@@ -85,6 +85,12 @@ TARGETS = {
     "cb_fleet_chaos": "llama_cb_decode_tokens_per_sec/cb_fleet_chaos",
     "cb_fleet_cpu_smoke":
         "llama_cb_decode_tokens_per_sec/cb_fleet_cpu_smoke",
+    # round-14 evidence rungs: long-context flash-decode A/B (PR 9 /
+    # ISSUE 10, docs/paged_attention.md) — decode TBT p99 (ms) on the
+    # 32k-skew workload; exact keys so the flash arm can never satisfy the
+    # seq arm's wait (the acceptance criterion compares the two)
+    "cb_longctx_flash": "llama_cb_decode_tbt_p99_ms/cb_longctx_flash",
+    "cb_longctx_seq": "llama_cb_decode_tbt_p99_ms/cb_longctx_seq",
 }
 
 
