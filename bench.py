@@ -419,6 +419,30 @@ def run_decode_rung(name, cfg, batch, prompt, new, max_seq):
     }
 
 
+def _obs_detail(obj):
+    """Observability snapshot for a cb/fleet rung's detail (ISSUE 11,
+    docs/observability.md): the Prometheus exposition of the engine's (or
+    the fleet's shared) MetricsRegistry plus per-name request-span counts.
+    Metrics-off runs (PADDLE_TPU_METRICS=0) embed nulls, never fake
+    zeros — absent evidence must read as absent."""
+    reg = getattr(obj, "metrics", None)
+    counts = {}
+
+    def _merge(tr):
+        if tr is not None:
+            for k, v in tr.counts.items():
+                counts[k] = counts.get(k, 0) + v
+
+    _merge(getattr(obj, "_tracer", None))
+    for tr in getattr(obj, "_tracers", []):      # fleet: router link lanes
+        _merge(tr)
+    for eng in getattr(obj, "replicas", []):     # fleet: replica span traffic
+        if eng is not None:
+            _merge(getattr(eng, "_tracer", None))
+    return {"metrics_exposition": reg.expose() if reg is not None else None,
+            "span_counts": counts or None}
+
+
 def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
                 quant=None, paged=False, ragged=False, paged_kernel=True,
                 tensor_parallel=1, block_size=64):
@@ -550,6 +574,7 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
               # one prefill per warmed bucket; growth = in-serve churn
               "n_traces": eng.n_traces(),
               "backend": jax.default_backend()}
+    detail.update(_obs_detail(eng))
     if tensor_parallel > 1:
         import jax.numpy as jnp
 
@@ -651,7 +676,8 @@ def run_cb_prefix_rung(name, cfg, max_batch, n_requests, shared_len,
                                              4),
                    "preemptions": eng.stats["preemptions"],
                    "n_traces": eng.n_traces(),
-                   "backend": jax.default_backend()},
+                   "backend": jax.default_backend(),
+                   **_obs_detail(eng)},
     }
 
 
@@ -731,7 +757,8 @@ def run_cb_spec_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq,
                    "spec_acceptance_rate": round(eng.spec_acceptance_rate, 4),
                    "preemptions": eng.stats["preemptions"],
                    "n_traces": eng.n_traces(),
-                   "backend": jax.default_backend()},
+                   "backend": jax.default_backend(),
+                   **_obs_detail(eng)},
     }
 
 
@@ -1173,7 +1200,8 @@ def run_cb_chunked_rung(name, cfg, max_batch, n_decode, n_long, short_prompt,
                    "prefill_fallback_calls":
                        _pa.PREFILL_FALLBACK_CALLS - pf0,
                    "n_traces": eng.n_traces(),
-                   "backend": jax.default_backend()},
+                   "backend": jax.default_backend(),
+                   **_obs_detail(eng)},
     }
 
 
@@ -1302,7 +1330,8 @@ def run_cb_longctx_rung(name, cfg, max_batch, n_long, n_short, long_prompt,
                    "decode_step_launches": launches,
                    "preemptions": eng.stats["preemptions"],
                    "n_traces": eng.n_traces(),
-                   "backend": jax.default_backend()},
+                   "backend": jax.default_backend(),
+                   **_obs_detail(eng)},
     }
 
 
@@ -1415,7 +1444,8 @@ def run_cb_overload_rung(name, cfg, max_batch, n_requests, prompt, new,
                    "kernel_error_retries":
                        eng.stats["kernel_error_retries"],
                    "n_traces": eng.n_traces(),
-                   "backend": jax.default_backend()},
+                   "backend": jax.default_backend(),
+                   **_obs_detail(eng)},
     }
 
 
@@ -1475,6 +1505,14 @@ def run_cb_fleet_rung(name, cfg, n_replicas, max_batch, n_requests, prompt,
             eng.stats[key] = 0
         eng.stats["decode_time_s"] = 0.0
         eng._step_no = 0
+    # span hygiene (same contract as reset_kernel_counters): the profiler
+    # host buffer is module state shared by every rung — drain it so the
+    # exported chaos trace holds exactly THIS rung's spans, and so earlier
+    # rungs can never have filled the cap and silenced the fleet's own
+    # spans (the artifact this rung exists to produce)
+    from paddle_tpu import profiler as _prof
+
+    _prof.clear_host_events()
     # arm the chaos AFTER warmup, with the fleet-step clock reset: the
     # plan's step keys are relative to the timed serve (the replayable
     # contract a chaos run's evidence needs)
@@ -1516,6 +1554,18 @@ def run_cb_fleet_rung(name, cfg, n_replicas, max_batch, n_requests, prompt,
     statuses = {st: sum(1 for r in reqs if r.status == st)
                 for st in sorted(TERMINAL_STATUSES)}
     assert sum(statuses.values()) == n_requests, statuses  # all terminal
+    # one chrome trace for the whole chaos run: every replica's request
+    # spans + the router's cross-replica failover links on one timeline
+    trace_path = None
+    try:
+        import tempfile
+
+        trace_path = os.path.join(tempfile.gettempdir(),
+                                  f"{name}_trace.json")
+        fleet.export_trace(trace_path)
+    except Exception as e:
+        log(f"cb fleet rung {name}: trace export failed: {e}")
+        trace_path = None
 
     def met_slo(r):
         if r.status != "FINISHED" or r.ttft_s is None:
@@ -1528,6 +1578,20 @@ def run_cb_fleet_rung(name, cfg, n_replicas, max_batch, n_requests, prompt,
 
     slo_ok = [r for r in reqs if met_slo(r)]
     good_toks = sum(len(r.output_ids) for r in slo_ok)
+    # first-class goodput (ISSUE 11, docs/observability.md): the fleet's
+    # SLOTracker computes the figure this rung used to hand-roll from its
+    # poll loop.  The headline is the TRACKER's number; the hand-rolled
+    # arithmetic above stays as the cross-check — the two must agree on
+    # the SLO-met request set and its token count.
+    slo_report = (fleet.slo.goodput_at(ttft_slo_s, tbt_slo_s)
+                  if fleet.slo is not None else None)
+    if slo_report is not None:
+        hand = {r.rid for r in slo_ok}
+        assert (slo_report["tokens"] == good_toks
+                and set(slo_report["rids"]) == hand), (
+            f"SLOTracker goodput diverged from the hand-rolled figure: "
+            f"tracker={slo_report} hand tokens={good_toks} rids={sorted(hand)}")
+        good_toks = slo_report["tokens"]
     replica_detail = [
         None if eng is None else {
             "decode_tokens": eng.stats["decode_tokens"],
@@ -1560,7 +1624,13 @@ def run_cb_fleet_rung(name, cfg, n_replicas, max_batch, n_requests, prompt,
                    "fleet_rejected": fleet.stats["fleet_rejected"],
                    "health": list(fleet.health),
                    "replicas": replica_detail,
-                   "backend": jax.default_backend()},
+                   "slo_tracker": slo_report,
+                   "chrome_trace": trace_path,
+                   "flight_dumps": ([d["reason"]
+                                     for d in fleet._flight.dumps]
+                                    if fleet._flight is not None else None),
+                   "backend": jax.default_backend(),
+                   **_obs_detail(fleet)},
     }
 
 

@@ -84,9 +84,14 @@ def _serving_engine(_force_flags=(), _cfg_kwargs=None, _disable_pallas=(),
     # programs carry the in-graph NaN/inf logit guard, and the host_sync
     # rule must see exactly what production traces (the guard's flags ride
     # back with the step's tokens — a callback sneaking in would be the
-    # regression the gate exists to catch)
+    # regression the gate exists to catch).  PADDLE_TPU_METRICS is forced
+    # for the same reason (ISSUE 11): observability's recording contract
+    # is host-side post-step — the gate analyzes the metrics-ON engine so
+    # a metric recorded via callback from INSIDE a compiled step would
+    # fail host_sync here, not in production.
     with contextlib.ExitStack() as stack:
-        for flag in (*_force_flags, "PADDLE_TPU_GRACEFUL"):
+        for flag in (*_force_flags, "PADDLE_TPU_GRACEFUL",
+                     "PADDLE_TPU_METRICS"):
             prev = os.environ.get(flag)
             os.environ[flag] = "1"
             stack.callback(lambda f=flag, p=prev: (

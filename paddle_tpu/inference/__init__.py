@@ -41,6 +41,9 @@ __all__ = [
     "ContinuousBatchingEngine",
     "Request",
     "FleetRouter",
+    "MetricsRegistry",
+    "SLOTracker",
+    "FlightRecorder",
 ]
 
 
@@ -485,4 +488,8 @@ def __getattr__(name):
         from .fleet import FleetRouter
 
         return FleetRouter
+    if name in ("MetricsRegistry", "SLOTracker", "FlightRecorder"):
+        from . import observability
+
+        return getattr(observability, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

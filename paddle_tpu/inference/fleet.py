@@ -90,6 +90,10 @@ import numpy as np
 
 from ..profiler import RecordEvent
 from .faults import KNOWN_KEYS, KNOWN_KINDS, REPLICA_KINDS, FaultPlan
+from .observability import (FLEET_STAT_SCHEMA, FlightRecorder,
+                            MetricsRegistry, RequestTracer, SLOTracker,
+                            StatsView, flight_recorder_enabled,
+                            metrics_enabled)
 from .serving import (TERMINAL_STATUSES, ContinuousBatchingEngine, Request,
                       journal_entry)
 
@@ -141,6 +145,20 @@ class FleetRouter:
                 f"before the replica is declared dead")
         self.slow_after = int(slow_after)
         self.heal_after = int(heal_after)
+        # observability (ISSUE 11, docs/observability.md): ONE shared
+        # registry — every replica's engine registers the same metric
+        # families with a {"replica": k} label set, so metrics.expose()
+        # is the whole fleet's Prometheus snapshot; the fleet's own
+        # stats/SLO/flight tiers layer on top with fleet-prefixed names.
+        self._metrics_on = metrics_enabled()
+        self.metrics = engine_kw.pop("metrics", None)
+        if self.metrics is None and self._metrics_on:
+            self.metrics = MetricsRegistry()
+        # metrics-off: self.metrics stays None (absent evidence must read
+        # as absent — bench embeds null, never an empty exposition).
+        # The router owns the replica label — a caller-provided label set
+        # would collapse N replicas onto one labelled series.
+        engine_kw.pop("metrics_labels", None)
         # the engines must NOT parse a fleet chaos spec themselves: a
         # replica-scoped clause would (correctly) disable their whole plan
         # with a warning.  The router parses once with the full vocabulary
@@ -148,8 +166,10 @@ class FleetRouter:
         spec = os.environ.pop("PADDLE_TPU_FAULT_INJECT", None)
         try:
             self.replicas: list[ContinuousBatchingEngine | None] = [
-                ContinuousBatchingEngine(cfg, params, **engine_kw)
-                for _ in range(self.n_replicas)]
+                ContinuousBatchingEngine(cfg, params, metrics=self.metrics,
+                                         metrics_labels={"replica": str(r)},
+                                         **engine_kw)
+                for r in range(self.n_replicas)]
         finally:
             if spec is not None:
                 os.environ["PADDLE_TPU_FAULT_INJECT"] = spec
@@ -179,20 +199,29 @@ class FleetRouter:
         self._slow_streak = [0] * self.n_replicas
         self._ok_streak = [0] * self.n_replicas
         self._step_no = 0          # fleet step counter (replica-clause key)
-        self.stats = {
-            # routing: affinity = a cached chain decided the target,
-            # spill = least-loaded fallback
-            "routed_affinity": 0, "routed_spill": 0,
-            # one per replica death (however detected)
-            "failovers": 0,
-            # hedged re-dispatches of a stalled replica's in-flight work
-            "hedges": 0,
-            # journaled tokens teacher-forced onto survivors (replay+hedge)
-            "replayed_tokens": 0,
-            # fleet-level rejections (backpressure with every routable
-            # replica full, invalid request, fleet fully dead)
-            "fleet_rejected": 0,
-        }
+        # fleet stats on the shared registry behind the same dict view the
+        # engines use (keys + help: observability.FLEET_STAT_SCHEMA);
+        # PADDLE_TPU_METRICS=0 restores the plain pre-observability dict.
+        # The fleet SLO tracker is the authority the chaos bench's
+        # goodput-at-SLO headline now reads from (fed in _mirror with the
+        # SAME timestamps that set each request's ttft_s).
+        if self._metrics_on:
+            self.stats = StatsView(self.metrics, FLEET_STAT_SCHEMA,
+                                   prefix="paddle_tpu_fleet")
+            self.slo = SLOTracker(self.metrics, prefix="paddle_tpu_fleet")
+        else:
+            self.stats = {k: 0 for k in FLEET_STAT_SCHEMA}
+            self.slo = None
+        # one flow-link tracer per replica lane (the engines' own tracers
+        # already own the span traffic on those pids; the router only adds
+        # the cross-replica failover/hedge arrows and health markers)
+        self._tracers = [RequestTracer(enabled=self._metrics_on, pid=r)
+                         for r in range(self.n_replicas)]
+        self._flow_seq = 0
+        self._flight = (FlightRecorder(registry=(self.metrics
+                                                 if self._metrics_on
+                                                 else None), name="fleet")
+                        if flight_recorder_enabled() else None)
         self._faults = FaultPlan()
         self._arm_faults_from_env()
         from ..analysis.engine_audit import audit_enabled
@@ -279,6 +308,11 @@ class FleetRouter:
             req.finished = True
             req.error = msg
             self.stats["fleet_rejected"] += 1
+            if self.slo is not None:
+                self.slo.finish(req.rid, "REJECTED", time.perf_counter())
+            if self._flight is not None:
+                self._flight.record("terminal", rid=req.rid,
+                                    status="REJECTED", error=msg)
 
     @staticmethod
     def _copy_req(req: Request) -> Request:
@@ -293,7 +327,7 @@ class FleetRouter:
             max_new_tokens=req.max_new_tokens,
             eos_token_id=req.eos_token_id,
             temperature=req.temperature, top_p=req.top_p, seed=req.seed,
-            deadline_s=req.deadline_s)
+            deadline_s=req.deadline_s, trace_id=req.trace_id)
 
     def add_request(self, req: Request) -> None:
         """Route one request into the fleet (or shed it as REJECTED when
@@ -302,6 +336,10 @@ class FleetRouter:
             raise ValueError(f"request {req.rid}: rid already live in the "
                              f"fleet")
         req._submit_s = time.perf_counter()
+        if req.trace_id is None:
+            req.trace_id = f"req-{req.rid:x}"
+        if self.slo is not None:
+            self.slo.begin(req.rid, req._submit_s)
         probe = next((e for e in self.replicas if e is not None), None)
         if probe is None:
             self._reject(req, "every replica is DEAD (fleet lost)")
@@ -333,6 +371,9 @@ class FleetRouter:
             self._reject(req, msg)
             return
         self.stats["routed_affinity" if m > 0 else "routed_spill"] += 1
+        if self._flight is not None:
+            self._flight.record("route", rid=req.rid, replica=target,
+                                match_blocks=int(m))
         copy = self._copy_req(req)
         self.replicas[target].add_request(copy)
         if copy.status == "REJECTED":       # defensive: _route pre-filtered
@@ -363,6 +404,8 @@ class FleetRouter:
         f.status = "CANCELLED"
         f.finished = True
         f.error = "cancelled by caller"
+        if self.slo is not None:
+            self.slo.finish(rid, "CANCELLED", time.perf_counter())
         return True
 
     # ---------------- health + failover (pillar 2) ----------------
@@ -374,11 +417,26 @@ class FleetRouter:
         / scale-in primitive."""
         if self.replicas[replica] is None or self.health[replica] == "DEAD":
             raise ValueError(f"replica {replica} is DEAD")
-        self.health[replica] = "DRAINING"
+        self._health_to(replica, "DRAINING", "drain() by operator")
 
     def _has_live(self, r: int) -> bool:
         eng = self.replicas[r]
         return eng is not None and bool(eng._reqs)
+
+    def _health_to(self, r: int, state: str, why: str) -> None:
+        """Single choke point for health transitions, so every one lands
+        in the flight recorder and on the replica's trace lane."""
+        prev = self.health[r]
+        if prev == state:
+            return
+        self.health[r] = state
+        now = time.perf_counter()
+        if self._flight is not None:
+            self._flight.record("health", replica=r, frm=prev, to=state,
+                                why=why)
+        self._tracers[r].instant(0, f"health:{state}", now,
+                                 args={"replica": r, "from": prev,
+                                       "why": why})
 
     def _note_heartbeat(self, r: int, ok: bool) -> None:
         """Latency-heartbeat bookkeeping: a slow/stalled step degrades
@@ -390,13 +448,16 @@ class FleetRouter:
             self._slow_streak[r] = 0
             if (self.health[r] == "DEGRADED"
                     and self._ok_streak[r] >= self.heal_after):
-                self.health[r] = "HEALTHY"
+                self._health_to(r, "HEALTHY",
+                                f"{self._ok_streak[r]} clean heartbeats")
         else:
             self._slow_streak[r] += 1
             self._ok_streak[r] = 0
             if (self.health[r] == "HEALTHY"
                     and self._slow_streak[r] >= self.slow_after):
-                self.health[r] = "DEGRADED"
+                self._health_to(r, "DEGRADED",
+                                f"{self._slow_streak[r]} slow/stalled "
+                                f"heartbeats")
 
     def _journal_entry(self, r: int, rid: int) -> dict:
         """The journal entry to replay for ``rid`` of replica ``r``: the
@@ -412,11 +473,16 @@ class FleetRouter:
                 return e
         return journal_entry(self._reqs[rid])
 
-    def _replay(self, rid: int, entry: dict, exclude: set) -> int | None:
+    def _replay(self, rid: int, entry: dict, exclude: set,
+                source: int | None = None,
+                link: str = "failover") -> int | None:
         """Adopt one journal entry onto the best survivor (affinity over
         the full prompt+generated stream, since retired generated blocks
         are content-addressed too).  Returns the target replica or None
-        when nothing survives."""
+        when nothing survives.  ``source`` (the dead/stalled replica)
+        draws the cross-replica trace link: a chrome flow arrow from the
+        source's lane to the adopting replica's, so a failover/hedge reads
+        as one continuous request line across the fleet timeline."""
         ids = np.asarray(list(entry["prompt_ids"])
                          + list(entry["output_ids"]), np.int32)
         target, _ = self._route(ids, exclude=exclude, accepted=True)
@@ -425,6 +491,15 @@ class FleetRouter:
         copy = self.replicas[target].adopt(entry)
         self._copies.setdefault(rid, {})[target] = copy
         self.stats["replayed_tokens"] += len(entry["output_ids"])
+        if source is not None:
+            now = time.perf_counter()
+            self._flow_seq += 1
+            fid = f"{link}-{rid}-{self._flow_seq}"
+            self._tracers[source].flow_out(rid, link, now, fid)
+            self._tracers[target].flow_in(rid, link, now + 1e-6, fid)
+        if self._flight is not None:
+            self._flight.record(link, rid=rid, frm=source, to=target,
+                                replayed_tokens=len(entry["output_ids"]))
         return target
 
     def _kill(self, r: int, reason: str) -> None:
@@ -436,7 +511,8 @@ class FleetRouter:
         FAILED (the fleet is lost; accepted work cannot outlive every
         replica)."""
         with RecordEvent("fleet/failover"):
-            self.health[r] = "DEAD"
+            dead_eng = self.replicas[r]   # for the flight-recorder dump
+            self._health_to(r, "DEAD", reason)
             self.replicas[r] = None
             self.stats["failovers"] += 1
             for rid, h in list(self._hedge.items()):
@@ -453,7 +529,7 @@ class FleetRouter:
                     self._owner[rid] = h
                     continue
                 entry = self._journal_entry(r, rid)
-                target = self._replay(rid, entry, exclude={r})
+                target = self._replay(rid, entry, exclude={r}, source=r)
                 if target is None:
                     f = self._reqs.pop(rid)
                     self._owner.pop(rid, None)
@@ -462,8 +538,24 @@ class FleetRouter:
                     f.finished = True
                     f.error = (f"replica {r} died ({reason}) with no "
                                f"surviving replica to replay onto")
+                    if self.slo is not None:
+                        self.slo.finish(rid, "FAILED",
+                                        time.perf_counter())
                     continue
                 self._owner[rid] = target
+            # replica death is a flight-recorder dump trigger: the
+            # router's recent events + the DEAD replica's own ring + a
+            # fleet metrics snapshot, so chaos triage reads what the
+            # engine was doing when it died without a rerun
+            if self._flight is not None:
+                self._flight.dump(
+                    f"replica {r} DEAD: {reason}",
+                    extra={"replica": r,
+                           "engine_events": (
+                               dead_eng._flight.events()
+                               if dead_eng is not None
+                               and dead_eng._flight is not None
+                               else None)})
             # every live entry is replayed: holding the dead replica's
             # final snapshot past this point would retain its requests'
             # full token lists for the router's lifetime (the retention
@@ -493,13 +585,15 @@ class FleetRouter:
                               f"(stall_dead_steps={self.stall_dead_steps})")
                 continue
             if self.health[r] == "HEALTHY":
-                self.health[r] = "DEGRADED"
+                self._health_to(r, "DEGRADED",
+                                f"no progress for {gap} fleet steps")
             for rid in [rid for rid, o in self._owner.items() if o == r]:
                 if rid in self._hedge:
                     continue               # already hedge-pending
                 with RecordEvent("fleet/hedge"):
                     entry = self._journal_entry(r, rid)
-                    target = self._replay(rid, entry, exclude={r})
+                    target = self._replay(rid, entry, exclude={r},
+                                          source=r, link="hedge")
                     if target is None:
                         continue           # nobody to hedge onto: wait
                     self._hedge[rid] = target
@@ -544,6 +638,8 @@ class FleetRouter:
         f.status = copy.status
         f.finished = True
         f.error = copy.error
+        if self.slo is not None:
+            self.slo.finish(rid, copy.status, time.perf_counter())
 
     def _mirror(self, r: int) -> None:
         """After replica ``r`` steps: bank its copies' new tokens onto the
@@ -559,12 +655,18 @@ class FleetRouter:
             if len(c.output_ids) > len(f.output_ids):
                 if rid in self._hedge:
                     self._resolve_hedge(rid, winner=r)
+                delta = len(c.output_ids) - len(f.output_ids)
                 f.output_ids.extend(c.output_ids[len(f.output_ids):])
+                now = time.perf_counter()
                 if f.ttft_s is None:
                     # fleet-level TTFT: includes routing + queueing +
                     # (on failover) replay recompute — the number an SLO
                     # is written against
-                    f.ttft_s = time.perf_counter() - f._submit_s
+                    f.ttft_s = now - f._submit_s
+                if self.slo is not None:
+                    # the SAME `now` that stamps ttft_s: the SLO tracker's
+                    # records are exactly the figures the caller observes
+                    self.slo.tokens(rid, delta, now)
             if self._owner.get(rid) != r:
                 # hedge twin that has not won: a self-inflicted terminal
                 # (failed/expired on the hedge target) just drops the hedge
@@ -635,10 +737,26 @@ class FleetRouter:
             busy = busy or stepped or self._has_live(r)
         self._detect_stalls()
         if self._audit_every_step:
-            from ..analysis.engine_audit import audit_fleet
+            from ..analysis.engine_audit import (EngineAuditError,
+                                                 audit_fleet)
 
-            audit_fleet(self)
+            try:
+                audit_fleet(self)
+            except EngineAuditError:
+                if self._flight is not None:
+                    self._flight.dump("fleet_audit_error")
+                raise
         return busy or bool(self._reqs)
+
+    def export_trace(self, path: str) -> None:
+        """Export (and drain) the buffered host spans — every replica's
+        request-lifecycle spans plus the router's cross-replica
+        failover/hedge flow links and health markers — as ONE chrome
+        trace (chrome://tracing / Perfetto): pid = replica lane, tid =
+        request lane (docs/observability.md)."""
+        from ..profiler import Profiler
+
+        Profiler().export(path)
 
     # ---------------- serve loop ----------------
 

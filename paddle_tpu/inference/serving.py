@@ -210,6 +210,10 @@ class Request:
     # TERMINAL_STATUSES; ``error`` is set for every non-FINISHED terminal
     status: str = "PENDING"
     error: str | None = None
+    # request-lifecycle trace id (docs/observability.md): assigned at
+    # admission when None; replica copies and failover replays carry the
+    # SAME id, so one request's spans correlate across the whole fleet
+    trace_id: str | None = None
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -295,7 +299,8 @@ class ContinuousBatchingEngine:
                  enable_speculation: bool = False, num_draft_tokens: int = 4,
                  spec_ngram: int = 3, enable_chunked_prefill: bool = False,
                  prefill_chunk: int = 128, token_budget: int | None = None,
-                 max_queue: int | None = None, tensor_parallel: int = 1):
+                 max_queue: int | None = None, tensor_parallel: int = 1,
+                 metrics=None, metrics_labels: dict | None = None):
         """``chunk``: decode steps per compiled call.  Tokens feed back
         on-device inside a lax.scan and the host fetches ``chunk`` tokens per
         round-trip — the lever against host-device latency (one RTT per token
@@ -348,7 +353,14 @@ class ContinuousBatchingEngine:
         divide num_key_value_heads (and intermediate_size) and not exceed
         the visible device count.  ``PADDLE_TPU_TP=<int>`` overrides this
         value (validated: an invalid degree warns once with the valid
-        divisors and falls back to 1 — utils/envflags.env_tp)."""
+        divisors and falls back to 1 — utils/envflags.env_tp).
+        ``metrics`` / ``metrics_labels`` (docs/observability.md): an
+        optional shared :class:`~paddle_tpu.inference.observability.
+        MetricsRegistry` plus constant label set (e.g. ``{"replica": k}``
+        — how the FleetRouter aggregates N replicas into one exposition);
+        by default the engine creates its own registry.  Ignored with
+        ``PADDLE_TPU_METRICS=0``, which restores the plain pre-
+        observability ``stats`` dict."""
         from ..models import llama as _llama  # noqa: F401  (cfg type lives there)
 
         self.cfg = cfg
@@ -671,36 +683,57 @@ class ContinuousBatchingEngine:
             self._mixed_sampling = self._jit_step(
                 self._mixed_impl_paged, n_rep=2 if self._graceful else 1,
                 sampling=True, graceful=self._graceful)
-        self.stats = {"decode_steps": 0, "decode_tokens": 0,
-                      "prefills": 0, "decode_time_s": 0.0, "preemptions": 0,
-                      # prefix-cache observability (all zero with caching off;
-                      # prefill token counters tick on every engine so hot/cold
-                      # A-Bs read straight off stats)
-                      "prefix_hits": 0, "prefix_blocks_reused": 0,
-                      "prefix_evictions": 0, "cow_copies": 0,
-                      "prefill_tokens_computed": 0, "prefill_tokens_cached": 0,
-                      # speculative-decoding observability (all zero spec-off;
-                      # acceptance ticks at the device level — EOS/budget
-                      # host trimming does not retroactively un-accept)
-                      "spec_steps": 0, "spec_drafted_tokens": 0,
-                      "spec_accepted_tokens": 0, "spec_rejected_tokens": 0,
-                      # chunked-prefill observability: prefill_chunks /
-                      # mixed_steps tick only with chunking on;
-                      # decode_stall_steps ticks on EVERY engine — with
-                      # chunking off it counts whole-prompt prefills
-                      # dispatched while decode slots sat waiting (the TBT
-                      # spike this feature erases: must be 0 chunked-on)
-                      "prefill_chunks": 0, "mixed_steps": 0,
-                      "decode_stall_steps": 0,
-                      # fault-tolerance observability (docs/
-                      # fault_tolerance.md): terminal-status counters plus
-                      # one counter per degradation-ladder rung, in ladder
-                      # order — a healthy serve keeps all of these 0
-                      "requests_failed": 0, "requests_rejected": 0,
-                      "requests_cancelled": 0, "requests_expired": 0,
-                      "degrade_evict": 0, "degrade_spec_off": 0,
-                      "degrade_budget_shrink": 0, "degrade_preempt": 0,
-                      "nan_guard_trips": 0, "kernel_error_retries": 0}
+        # ---- observability (ISSUE 11, docs/observability.md) ----
+        # stats live on a typed MetricsRegistry behind a dict-compatible
+        # view (keys + help strings: observability.ENGINE_STAT_SCHEMA), so
+        # every existing ``eng.stats[...]`` read keeps working while the
+        # same counters show up labelled in ``metrics.expose()``; the SLO
+        # tracker and request tracer feed off the same host events.  ALL
+        # recording is host-side post-step — the compiled programs above
+        # are untouched either way, so token streams are byte-identical
+        # with PADDLE_TPU_METRICS=0 (which restores the plain dict) or 1.
+        from .observability import (ENGINE_STAT_SCHEMA, FlightRecorder,
+                                    MetricsRegistry, RequestTracer,
+                                    SLOTracker, StatsView,
+                                    flight_recorder_enabled, metrics_enabled)
+
+        self._obs_labels = dict(metrics_labels or {})
+        replica = self._obs_labels.get("replica")
+        obs_name = (f"replica-{replica}" if replica is not None
+                    else "engine")
+        if metrics_enabled():
+            self.metrics = (metrics if metrics is not None
+                            else MetricsRegistry())
+            self.stats = StatsView(self.metrics, ENGINE_STAT_SCHEMA,
+                                   self._obs_labels)
+            self.slo = SLOTracker(self.metrics, self._obs_labels)
+            self._h_hostgap = self.metrics.histogram(
+                "paddle_tpu_serving_host_gap_seconds",
+                "Host-side gap between the end of one compiled serving "
+                "step and the next launch (scheduler/drafter/router time "
+                "the device sits idle — ROADMAP item 5's target)"
+            ).labels(**self._obs_labels)
+            self._h_step = self.metrics.histogram(
+                "paddle_tpu_serving_step_seconds",
+                "Wall seconds per compiled serving step (launch to host "
+                "fetch)").labels(**self._obs_labels)
+            self._tracer = RequestTracer(
+                enabled=True,
+                pid=int(replica) if replica is not None else 0,
+                process_name=obs_name)
+        else:
+            self.metrics = None
+            self.slo = None
+            self._h_hostgap = self._h_step = None
+            self._tracer = RequestTracer(enabled=False)
+            self.stats = {k: (0.0 if kind == "gauge" else 0)
+                          for k, (kind, _) in ENGINE_STAT_SCHEMA.items()}
+        self._last_step_end = None     # host-gap histogram anchor
+        # flight recorder: bounded ring of recent engine events, dumped
+        # (with a metrics snapshot) on request failure / audit error —
+        # chaos triage without a rerun.  Independent kill switch.
+        self._flight = (FlightRecorder(registry=self.metrics, name=obs_name)
+                        if flight_recorder_enabled() else None)
         # opt-in runtime invariant auditor (PADDLE_TPU_ENGINE_AUDIT=1):
         # cross-checks allocator / block-table / prefix-cache bookkeeping
         # after admission and after every decode chunk, raising
@@ -1350,6 +1383,9 @@ class ContinuousBatchingEngine:
             # pages may be free — drives the overload ladder adversarially
             # without needing a genuinely tiny pool.  Polled only when a
             # real grab would happen, so no-op calls never consume firings.
+            if self._flight is not None:
+                self._flight.record("fault", fault="alloc_fail", slot=slot,
+                                    step=self._step_no)
             return False
         while base + len(owned) < n_blocks:
             if not self._free and not self._reclaim(1):
@@ -1368,6 +1404,8 @@ class ContinuousBatchingEngine:
         if pages:
             self._free.extend(pages)
             self.stats["prefix_evictions"] += len(pages)
+            if self._flight is not None:
+                self._flight.record("evict", pages=len(pages))
         return len(pages)
 
     def _evictable(self) -> int:
@@ -1491,6 +1529,9 @@ class ContinuousBatchingEngine:
         req.status = "PENDING"   # back in the queue; re-seated by _admit
         self._queue.insert(0, req)
         self.stats["preemptions"] += 1
+        if self._flight is not None:
+            self._flight.record("degrade", rung=4, what="preempt",
+                                rid=req.rid, slot=slot)
         if self._graceful:
             # every preemption is pool-pressure-driven, so in graceful mode
             # it IS ladder rung 4 (rungs 1-3 already ran and left a deficit)
@@ -1569,6 +1610,10 @@ class ContinuousBatchingEngine:
     def add_request(self, req: Request):
         self._validate(req)
         req._submit_s = time.perf_counter()  # TTFT epoch (bench rung detail)
+        if req.trace_id is None:
+            req.trace_id = f"req-{req.rid:x}"
+        if self.slo is not None:
+            self.slo.begin(req.rid, req._submit_s)
         self._reqs[req.rid] = req
         if (self.max_queue is not None
                 and len(self._queue) >= self.max_queue):
@@ -1717,14 +1762,19 @@ class ContinuousBatchingEngine:
                 # logits come from the decode step (standard split)
                 slot_arg = (jnp.asarray(self._table[slot]) if self.paged
                             else jnp.asarray(slot, jnp.int32))
+                t_pf = time.perf_counter()
                 self.cache_k, self.cache_v = self._prefill(
                     self.params, jnp.asarray(padded), self.cache_k,
                     self.cache_v, slot_arg, jnp.asarray(s0 - 1, jnp.int32),
                     bucket)
                 self.stats["prefills"] += 1
                 self.stats["decode_stall_steps"] += int(stalls)
+                self._tracer.span(req.rid, "prefill", t_pf,
+                                  time.perf_counter(),
+                                  args={"bucket": bucket, "tokens": s0 - 1})
             elif plen > 0:
                 # partial-bucket prefill over the uncached tail only
+                t_pf = time.perf_counter()
                 with RecordEvent("prefix_cache/partial_prefill"):
                     bucket = min(_bucket(plen), self.max_seq)
                     padded = np.zeros((1, bucket), np.int32)
@@ -1736,6 +1786,10 @@ class ContinuousBatchingEngine:
                         jnp.asarray(s0 - 1, jnp.int32), bucket)
                 self.stats["prefills"] += 1
                 self.stats["decode_stall_steps"] += int(stalls)
+                self._tracer.span(req.rid, "prefill", t_pf,
+                                  time.perf_counter(),
+                                  args={"bucket": bucket, "tokens": plen,
+                                        "cached": start})
             # else: full hit — nothing to compute, decode starts immediately
             if self.paged and self._pcache is not None and not self._chunked:
                 # share this admission's freshly-computed full prompt blocks
@@ -1743,6 +1797,20 @@ class ContinuousBatchingEngine:
                 self._register_prefix_blocks(slot, ids, s0 - 1)
             self._slot_req[slot] = req
             req.status = "RUNNING"
+            # lifecycle observability: queue-wait span closes at seating
+            # (docs/observability.md — the decode span opens here too)
+            now = time.perf_counter()
+            req._admit_s = now
+            if self.slo is not None:
+                self.slo.admitted(req.rid, now)
+            self._tracer.span(req.rid, "queued",
+                              getattr(req, "_submit_s", now), now,
+                              args={"rid": req.rid, "slot": slot,
+                                    "cached_tokens": int(start)})
+            if self._flight is not None:
+                self._flight.record("admit", rid=req.rid, slot=slot,
+                                    prompt=int(s0),
+                                    cached_tokens=int(start))
             if self._chunked:
                 # the prefill cursor IS the position state: pos/_written
                 # advance with each chunk, so preemption's trusted-content
@@ -1798,6 +1866,29 @@ class ContinuousBatchingEngine:
         # caller keeps its own reference; cancel() on a terminal rid
         # correctly reports False via the journal miss)
         self._reqs.pop(req.rid, None)
+        # lifecycle observability: close the SLO record, emit the decode
+        # span (admission -> terminal) + terminal marker, and — for a
+        # FAILED request — dump the flight recorder so triage reads the
+        # engine's last seconds instead of rerunning the chaos
+        now = time.perf_counter()
+        if self.slo is not None:
+            self.slo.finish(req.rid, status, now)
+        if self._tracer.enabled:
+            t_admit = getattr(req, "_admit_s", None)
+            if t_admit is not None:
+                self._tracer.span(req.rid, "decode", t_admit, now,
+                                  args={"tokens": len(req.output_ids),
+                                        "status": status})
+            self._tracer.instant(
+                req.rid, f"terminal:{status}", now,
+                args={"rid": req.rid,
+                      **({"error": error} if error else {})})
+        if self._flight is not None:
+            self._flight.record("terminal", rid=req.rid, status=status,
+                                tokens=len(req.output_ids),
+                                **({"error": error} if error else {}))
+            if status == "FAILED":
+                self._flight.dump(f"request_failed rid={req.rid}")
 
     def _fail_slot(self, slot: int, status: str, error: str,
                    donate: bool = False):
@@ -1833,6 +1924,13 @@ class ContinuousBatchingEngine:
                                               slot=slot, rid=rid):
             where = "".join((f", slot {slot}" if slot is not None else "",
                              f", rid {rid}" if rid is not None else ""))
+            if self._flight is not None:
+                self._flight.record("fault", fault=kind,
+                                    step=self._step_no,
+                                    **({"slot": slot}
+                                       if slot is not None else {}),
+                                    **({"rid": rid}
+                                       if rid is not None else {}))
             raise FaultInjected(
                 f"injected {kind} (step {self._step_no}{where})")
 
@@ -1861,6 +1959,10 @@ class ContinuousBatchingEngine:
             raise err
         self._kernel_err_streak += 1
         self.stats["kernel_error_retries"] += 1
+        if self._flight is not None:
+            self._flight.record("fault", fault="kernel_error",
+                                streak=self._kernel_err_streak,
+                                step=self._step_no)
         if self._kernel_err_streak > self._kernel_err_limit:
             raise err
         with RecordEvent("serving/kernel_error_retry"):
@@ -1896,6 +1998,9 @@ class ContinuousBatchingEngine:
             with RecordEvent("serving/degrade_evict"):
                 if self._reclaim(short) > 0:
                     self.stats["degrade_evict"] += 1
+                    if self._flight is not None:
+                        self._flight.record("degrade", rung=1, what="evict",
+                                            short=int(short))
         return need - len(self._free)
 
     def _expire_overdue(self):
@@ -2056,6 +2161,16 @@ class ContinuousBatchingEngine:
                 [np.asarray(req.prompt_ids, np.int32).ravel(),
                  np.asarray(req.output_ids, np.int32)])
         req._submit_s = time.perf_counter()
+        if req.trace_id is None:
+            req.trace_id = f"req-{req.rid:x}"
+        if self.slo is not None:
+            self.slo.begin(req.rid, req._submit_s)
+        self._tracer.instant(req.rid, "adopt", req._submit_s,
+                             args={"rid": req.rid,
+                                   "replayed_tokens": len(req.output_ids)})
+        if self._flight is not None:
+            self._flight.record("adopt", rid=req.rid,
+                                replayed_tokens=len(req.output_ids))
         self._reqs[req.rid] = req
         self._queue.append(req)
         return req
@@ -2106,9 +2221,33 @@ class ContinuousBatchingEngine:
 
     def _maybe_audit(self):
         if self._audit_every_step:
-            from ..analysis.engine_audit import audit_engine
+            from ..analysis.engine_audit import (EngineAuditError,
+                                                 audit_engine)
 
-            audit_engine(self)
+            try:
+                audit_engine(self)
+            except EngineAuditError:
+                # triage-without-a-rerun: the flight recorder's last
+                # N events + a metrics snapshot accompany the raise
+                if self._flight is not None:
+                    self._flight.dump("engine_audit_error")
+                raise
+
+    # ------------- per-step latency accounting (docs/observability.md) ----
+
+    def _note_launch(self, t0: float):
+        """Called at each compiled launch's dispatch time: the gap since
+        the previous step's host fetch is pure host-side work (packing,
+        drafting, journal upkeep) the device spent idle — the host-gap
+        histogram ROADMAP item 5 will optimize against."""
+        if self._h_hostgap is not None and self._last_step_end is not None:
+            self._h_hostgap.observe(t0 - self._last_step_end)
+
+    def _note_step_done(self, t0: float):
+        end = time.perf_counter()
+        if self._h_step is not None:
+            self._h_step.observe(end - t0)
+        self._last_step_end = end
 
     def step(self) -> bool:
         """One admit + decode iteration (a chunked decode scan; with
@@ -2174,6 +2313,10 @@ class ContinuousBatchingEngine:
                     # each round-trip banks, never which ones.
                     with RecordEvent("serving/degrade_spec_off"):
                         self.stats["degrade_spec_off"] += 1
+                        if self._flight is not None:
+                            self._flight.record("degrade", rung=2,
+                                                what="spec_off",
+                                                step=self._step_no)
                     drafts = None
             if drafts is not None:
                 return self._spec_step(drafts)
@@ -2188,6 +2331,7 @@ class ContinuousBatchingEngine:
         if not active_np.any():
             return False
         t0 = time.perf_counter()
+        self._note_launch(t0)
         extra = (jnp.asarray(self._table),) if self.paged else ()
         # greedy-only resident set takes the sampler-free compiled variant
         any_sampled = bool((self._temp * active_np).max() > 0)
@@ -2215,6 +2359,8 @@ class ContinuousBatchingEngine:
         self._poison[:] = False
         toks_np = np.asarray(toks)  # [k, B] — ONE host round-trip per chunk
         self.stats["decode_time_s"] += time.perf_counter() - t0
+        self._note_step_done(t0)
+        now = self._last_step_end   # banking-event timestamp (SLO tracker)
         self.stats["decode_steps"] += k
         for slot, req in enumerate(self._slot_req):
             if req is None:
@@ -2225,6 +2371,7 @@ class ContinuousBatchingEngine:
             # chunk steps are trustworthy
             valid = min(k, self.max_seq - old_pos)
             done = False
+            banked = 0
             fail_err = None
             try:
                 self._host_fault("slot_error", slot=slot, rid=req.rid)
@@ -2243,6 +2390,7 @@ class ContinuousBatchingEngine:
                         break
                     tok = int(toks_np[j, slot])
                     req.output_ids.append(tok)
+                    banked += 1
                     if req.ttft_s is None:
                         # time-to-first-token: the cached-prefix admission's
                         # headline win (prefill skipped, decode starts
@@ -2264,6 +2412,9 @@ class ContinuousBatchingEngine:
                 # the other lanes' tokens (already fetched) bank normally
                 self._fail_slot(slot, "FAILED", fail_err, donate=False)
                 continue
+            if self.slo is not None and banked:
+                # one banking event: the whole chunk arrives in one fetch
+                self.slo.tokens(req.rid, banked, now)
             self._pos[slot] = old_pos + k  # device advanced k regardless
             # maximum, not overwrite: a prior verify step's rejected drafts
             # may have written past old_pos+k, and the high-water mark must
@@ -2337,6 +2488,10 @@ class ContinuousBatchingEngine:
             if shrinkable:
                 with RecordEvent("serving/degrade_budget_shrink"):
                     self.stats["degrade_budget_shrink"] += 1
+                    if self._flight is not None:
+                        self._flight.record("degrade", rung=3,
+                                            what="budget_shrink",
+                                            slots=len(shrinkable))
                 for s in shrinkable:
                     tokens[s, 1:] = 0
                     q_lens[s] = 1
@@ -2352,6 +2507,14 @@ class ContinuousBatchingEngine:
         if not active.any():
             return bool(self._queue)
         t0 = time.perf_counter()
+        self._note_launch(t0)
+        if self._flight is not None:
+            # step-packing summary: O(1) per step, the flight recorder's
+            # picture of what the scheduler chose when things went wrong
+            self._flight.record("pack", step=self._step_no,
+                                decode=len(decode_slots),
+                                prefill=len(chunk_rows),
+                                prefill_rows=int(sum(chunk_rows.values())))
         any_sampled = bool((self._temp * active).max() > 0)
         mixed = self._mixed_sampling if any_sampled else self._mixed_greedy
         self._arm_poison()
@@ -2379,6 +2542,7 @@ class ContinuousBatchingEngine:
         self._poison[:] = False
         nxt_np = np.asarray(nxt)   # [B] — ONE host round-trip for the step
         self.stats["decode_time_s"] += time.perf_counter() - t0
+        self._note_step_done(t0)
         self.stats["decode_steps"] += 1
         self.stats["mixed_steps"] += 1
         self.stats["prefill_chunks"] += len(chunk_rows)
@@ -2425,6 +2589,10 @@ class ContinuousBatchingEngine:
             ids = self._prefill_ids[s]
             new_cur = int(self._prefilled[s]) + n
             self._prefilled[s] = new_cur
+            self._tracer.span(req.rid, "prefill_chunk", t0,
+                              self._last_step_end,
+                              args={"rows": n, "cursor": new_cur,
+                                    "prompt": int(ids.size)})
             self._pos[s] = new_cur
             self._written[s] = max(int(self._written[s]),
                                    min(new_cur, self.max_seq))
@@ -2454,6 +2622,10 @@ class ContinuousBatchingEngine:
         req.output_ids.append(tok)
         if req.ttft_s is None:
             req.ttft_s = time.perf_counter() - getattr(req, "_submit_s", t0)
+        if self.slo is not None:
+            self.slo.tokens(req.rid, 1, self._last_step_end
+                            if self._last_step_end is not None
+                            else time.perf_counter())
         self.stats["decode_tokens"] += 1
         self._last_tok[slot] = tok
         if (len(req.output_ids) >= req.max_new_tokens
@@ -2522,6 +2694,7 @@ class ContinuousBatchingEngine:
             tokens[s, 1:1 + d.size] = d
             q_lens[s] = 1 + d.size
         t0 = time.perf_counter()
+        self._note_launch(t0)
         any_sampled = bool((self._temp * active_np).max() > 0)
         verify = self._verify_sampling if any_sampled else self._verify_greedy
         self._arm_poison()
@@ -2550,6 +2723,8 @@ class ContinuousBatchingEngine:
         out_np = np.asarray(out)
         n_np = np.asarray(n_acc)
         self.stats["decode_time_s"] += time.perf_counter() - t0
+        self._note_step_done(t0)
+        now = self._last_step_end   # banking-event timestamp (SLO tracker)
         self.stats["decode_steps"] += 1
         self.stats["spec_steps"] += 1
         for slot, req in enumerate(self._slot_req):
@@ -2578,9 +2753,11 @@ class ContinuousBatchingEngine:
             self.stats["spec_accepted_tokens"] += n - 1
             self.stats["spec_rejected_tokens"] += drafted - (n - 1)
             done = False
+            banked = 0
             for j in range(n):
                 tok = int(out_np[slot, j])
                 req.output_ids.append(tok)
+                banked += 1
                 if req.ttft_s is None:
                     req.ttft_s = (time.perf_counter()
                                   - getattr(req, "_submit_s", t0))
@@ -2590,6 +2767,9 @@ class ContinuousBatchingEngine:
                             and tok == req.eos_token_id)):
                     done = True
                     break
+            if self.slo is not None and banked:
+                # one banking event: the accepted run arrives in one fetch
+                self.slo.tokens(req.rid, banked, now)
             # rejection rollback: pos advances only past ACCEPTED tokens;
             # the high-water mark remembers how far the device EVER wrote
             # (a shorter draft after a long rejected one must not shrink it)

@@ -27,6 +27,11 @@ __all__ = [
     "make_scheduler",
     "export_chrome_tracing",
     "load_profiler_result",
+    "add_trace_event",
+    "host_events_len",
+    "host_events_dropped",
+    "set_host_event_capacity",
+    "clear_host_events",
 ]
 
 
@@ -47,6 +52,69 @@ class ProfilerTarget(Enum):
 _events_lock = threading.Lock()
 _events: list[dict] = []
 _recording = threading.local()
+
+# host-span buffer bound (ISSUE 11): a long-lived serving engine emits
+# spans forever, and an unbounded list is a slow memory leak.  At capacity
+# new events are DROPPED (and counted) rather than evicting old ones —
+# chrome traces render contiguous history better than one with holes, and
+# export drains the buffer anyway, so steady-state exporters never hit the
+# cap.  ``set_host_event_capacity`` exists for tests; the drop counter is
+# surfaced by ``host_events_dropped`` and in every export's metadata.
+_MAX_HOST_EVENTS_DEFAULT = 65536
+_capacity = _MAX_HOST_EVENTS_DEFAULT
+_dropped = 0
+# bumped on every drain (export/clear): emitters holding one-shot metadata
+# (e.g. the request tracer's process_name lane labels) watch this to know
+# their metadata left with a previous export and must be re-emitted
+_generation = 0
+
+
+def host_events_generation() -> int:
+    return _generation
+
+
+def add_trace_event(ev: dict) -> bool:
+    """Append one raw chrome-trace event dict to the host buffer,
+    honoring the capacity cap.  Returns False when the event was dropped.
+    The request-lifecycle tracer (inference/observability.py) writes
+    through here so its spans ride the same export path RecordEvent spans
+    always did."""
+    global _dropped
+    with _events_lock:
+        if len(_events) >= _capacity:
+            _dropped += 1
+            return False
+        _events.append(ev)
+    return True
+
+
+def host_events_len() -> int:
+    with _events_lock:
+        return len(_events)
+
+
+def host_events_dropped() -> int:
+    return _dropped
+
+
+def set_host_event_capacity(n: int) -> int:
+    """Set the host-span buffer cap (>= 1); returns the previous value."""
+    global _capacity
+    if int(n) < 1:
+        raise ValueError(f"capacity must be >= 1, got {n}")
+    prev = _capacity
+    _capacity = int(n)
+    return prev
+
+
+def clear_host_events() -> None:
+    """Drop buffered host events and reset the drop counter (tests and
+    rung isolation; export drains implicitly)."""
+    global _dropped, _generation
+    with _events_lock:
+        _events.clear()
+    _dropped = 0
+    _generation += 1
 
 # Native host tracer (paddle_tpu/native/src/tracer.cc — the analog of the
 # reference's C++ host_tracer).  When the library is available, spans are
@@ -132,18 +200,17 @@ class RecordEvent:
             self._t0 = None
             return
         t1 = _now_us()
-        with _events_lock:
-            _events.append(
-                {
-                    "name": self.name,
-                    "ph": "X",
-                    "ts": self._t0,
-                    "dur": t1 - self._t0,
-                    "pid": os.getpid(),
-                    "tid": threading.get_ident() % 100000,
-                    "cat": "host",
-                }
-            )
+        add_trace_event(
+            {
+                "name": self.name,
+                "ph": "X",
+                "ts": self._t0,
+                "dur": t1 - self._t0,
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % 100000,
+                "cat": "host",
+            }
+        )
         self._t0 = None
 
     def __enter__(self):
@@ -254,9 +321,22 @@ class Profiler:
             self.on_trace_ready(self)
 
     def export(self, path: str, format: str = "json"):
+        """Write the buffered host spans (python + native tracer) as one
+        chrome trace and DRAIN them: export is the buffer's consumer, so a
+        long-lived engine that exports periodically never hits the span
+        cap.  The drop counter (spans lost while the buffer was full) is
+        written as a metadata event and reset."""
+        global _dropped, _generation
         with _events_lock:
             events = list(_events)
-        events += _native_events()
+            _events.clear()
+            dropped, _dropped = _dropped, 0
+            _generation += 1
+        events += _native_events(clear=True)
+        if dropped:
+            events.append({"name": "host_events_dropped", "ph": "M",
+                           "pid": os.getpid(),
+                           "args": {"dropped": dropped}})
         with open(path, "w") as f:
             json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
 
@@ -272,6 +352,11 @@ class Profiler:
             lines.append(
                 f"{name[:50]:<50} {len(durs):>8} {sum(durs)/1000:>12.3f} {sum(durs)/len(durs)/1000:>12.3f}"
             )
+        if _dropped:
+            # the buffer is bounded (see add_trace_event): a summary over a
+            # buffer that overflowed must say so, not read as complete
+            lines.append(f"[{_dropped} span(s) dropped at the "
+                         f"{_capacity}-event buffer cap; export() drains]")
         return "\n".join(lines)
 
     def __enter__(self):

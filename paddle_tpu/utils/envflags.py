@@ -29,6 +29,16 @@ through :func:`env_bool`, which enforces the '0'/'1' vocabulary):
   degradation ladder, the in-graph NaN/inf logit guard, and graceful
   rejection in ``serve()``; ``0`` restores the pre-fault-tolerance engine
   byte-identically (faults raise out of ``step()`` again).
+* ``PADDLE_TPU_METRICS`` (default on) — serving observability
+  (inference/observability.py, docs/observability.md): the typed
+  MetricsRegistry behind ``engine.stats``/``fleet.stats``, request-
+  lifecycle tracing spans, and SLO (TTFT/TBT/queue-wait) accounting.
+  All recording is host-side post-step, so token streams are identical
+  either way; ``0`` restores the plain pre-observability stats dicts.
+* ``PADDLE_TPU_FLIGHT_RECORDER`` (default on) — the bounded ring buffer
+  of recent engine/fleet events dumped (with a metrics snapshot) on
+  request failure, ``EngineAuditError``, or replica death; ``0`` disables
+  the recorder and its dumps entirely.
 
 (``PADDLE_TPU_DISABLE_PALLAS`` is the token-set switch; its vocabulary lives
 with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``.  Two of its
@@ -75,6 +85,8 @@ BOOL_FLAGS = {
     "PADDLE_TPU_SPECULATE": True,
     "PADDLE_TPU_CHUNKED_PREFILL": True,
     "PADDLE_TPU_GRACEFUL": True,
+    "PADDLE_TPU_METRICS": True,
+    "PADDLE_TPU_FLIGHT_RECORDER": True,
 }
 
 _warned: set[tuple[str, str]] = set()

@@ -1,0 +1,583 @@
+"""Serving observability tests (ISSUE 11, docs/observability.md).
+
+Covers the four pillars and their contracts:
+
+* MetricsRegistry — typed counters/gauges/log2-bucket histograms, labels,
+  Prometheus exposition, and the dict-compatible StatsView the engines'
+  ``stats`` migrated onto (every counter key read anywhere in tests/bench
+  must be registered with a help string — enforced by a source scan);
+* request-lifecycle tracing — queued/prefill/decode spans + terminal
+  markers per request, cross-replica failover/hedge flow links, one chrome
+  trace per fleet chaos run, and the profiler host-buffer cap (bounded,
+  drop-counted, drained on export);
+* SLOTracker — streaming TTFT/TBT/queue-wait accounting whose
+  ``goodput_at`` matches a hand-rolled poll-loop computation exactly;
+* FlightRecorder — bounded ring, dumps (with metrics snapshot) on request
+  FAILURE, EngineAuditError, and replica death.
+
+THE overriding contract: recording is host-side post-step, so token
+streams are byte-identical with observability on vs the
+``PADDLE_TPU_METRICS=0`` / ``PADDLE_TPU_FLIGHT_RECORDER=0`` kill switches
+— asserted with prefix cache + speculation + chunked prefill + graceful +
+TP all on — and a metric recorded via callback from INSIDE a jitted step
+fails the host_sync lint gate.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import profiler
+from paddle_tpu.inference.observability import (ENGINE_STAT_SCHEMA,
+                                                FLEET_STAT_SCHEMA,
+                                                FlightRecorder,
+                                                MetricsRegistry, SLOTracker,
+                                                StatsView)
+from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
+from paddle_tpu.models import llama
+
+_CFG = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=8,
+                              kv_heads=4, inter=128)
+_CFG.dtype = jnp.float32
+_PARAMS = None
+
+
+def _tiny():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = llama.init_params(_CFG, jax.random.key(0))
+    return _CFG, _PARAMS
+
+
+def _engine(**kw):
+    cfg, params = _tiny()
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("paged", True)
+    return ContinuousBatchingEngine(cfg, params, **kw)
+
+
+def _requests(n=3, new=5, seed=0):
+    rs = np.random.RandomState(seed)
+    return [Request(rid=i,
+                    prompt_ids=rs.randint(0, 128, (10 + i,)).astype(np.int32),
+                    max_new_tokens=new) for i in range(n)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_host_events():
+    profiler.clear_host_events()
+    yield
+    profiler.set_host_event_capacity(65536)
+    profiler.clear_host_events()
+
+
+# ---------------- MetricsRegistry units ----------------
+
+def test_counter_gauge_exposition_format():
+    reg = MetricsRegistry()
+    c = reg.counter("t_requests", "requests served").labels(replica="0")
+    c.inc()
+    c.inc(2)
+    g = reg.gauge("t_time_s", "wall seconds").labels()
+    g.set(1.5)
+    text = reg.expose()
+    assert "# HELP t_requests requests served" in text
+    assert "# TYPE t_requests counter" in text
+    assert 't_requests{replica="0"} 3' in text
+    assert "# TYPE t_time_s gauge" in text
+    assert "t_time_s 1.5" in text
+
+
+def test_histogram_log2_buckets_and_cumulative_counts():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", "latency", lo=-2, hi=2).labels()
+    # bounds: 0.25, 0.5, 1, 2, 4, +Inf
+    for v in (0.1, 0.25, 0.26, 1.0, 3.0, 100.0):
+        h.observe(v)
+    assert h.count == 6 and h.sum == pytest.approx(104.61)
+    pairs = dict(h.buckets(-2))
+    assert pairs["0.25"] == 2          # 0.1 and 0.25 (boundary inclusive)
+    assert pairs["0.5"] == 3           # + 0.26
+    assert pairs["1"] == 4             # + 1.0 (boundary inclusive)
+    assert pairs["4"] == 5             # + 3.0
+    assert pairs["+Inf"] == 6          # + 100.0 (past the top bound)
+    text = reg.expose()
+    assert 't_lat_seconds_bucket{le="+Inf"} 6' in text
+    assert "t_lat_seconds_count 6" in text
+
+
+def test_histogram_nonpositive_and_nan_land_in_first_bucket():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_h", "h", lo=-2, hi=2).labels()
+    h.observe(0.0)
+    h.observe(-1.0)
+    h.observe(float("nan"))
+    assert dict(h.buckets(-2))["0.25"] == 3
+
+
+def test_registry_reregistration_same_family_and_mismatch_raises():
+    reg = MetricsRegistry()
+    a = reg.counter("t_x", "help")
+    b = reg.counter("t_x", "help")
+    assert a is b
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("t_x", "help")
+    with pytest.raises(ValueError, match="help"):
+        reg.counter("t_y", "")
+
+
+# ---------------- StatsView dict compatibility ----------------
+
+def test_stats_view_behaves_like_the_old_dict():
+    reg = MetricsRegistry()
+    view = StatsView(reg, ENGINE_STAT_SCHEMA, {"replica": "1"})
+    view["decode_tokens"] += 3
+    view["decode_time_s"] += 0.5
+    assert view["decode_tokens"] == 3 and isinstance(view["decode_tokens"],
+                                                     int)
+    view.update(decode_steps=0, decode_tokens=0, decode_time_s=0.0)
+    assert view["decode_tokens"] == 0
+    d = dict(view)
+    assert set(d) == set(ENGINE_STAT_SCHEMA)
+    assert d["decode_time_s"] == 0.0
+    # the same number is visible in the exposition, labelled
+    view["prefix_hits"] += 2
+    assert ('paddle_tpu_serving_prefix_hits{replica="1"} 2'
+            in reg.expose())
+    with pytest.raises(TypeError):
+        del view["decode_tokens"]
+    with pytest.raises(KeyError):
+        view["no_such_stat"]
+    # dynamic keys register on the fly (dict compatibility never raises)
+    view["adhoc_counter"] = 7
+    assert view["adhoc_counter"] == 7
+
+
+def test_every_stats_key_read_in_tests_and_bench_is_registered():
+    """Introspection satellite: scan tests/ + bench.py for stats["..."]
+    reads and require each key in a schema, with a non-empty help."""
+    root = pathlib.Path(__file__).resolve().parent.parent
+    pat = re.compile(r"stats\[[\"']([a-z_]+)[\"']\]")
+    keys: set[str] = set()
+    for path in [*sorted((root / "tests").glob("test_*.py")),
+                 root / "bench.py"]:
+        keys |= set(pat.findall(path.read_text()))
+    known = set(ENGINE_STAT_SCHEMA) | set(FLEET_STAT_SCHEMA)
+    assert keys <= known, f"unregistered stat keys: {sorted(keys - known)}"
+    for schema in (ENGINE_STAT_SCHEMA, FLEET_STAT_SCHEMA):
+        for key, (kind, help) in schema.items():
+            assert kind in ("counter", "gauge"), (key, kind)
+            assert help.strip(), f"{key} needs a help string"
+
+
+def test_engine_stats_keys_match_schema_exactly():
+    eng = _engine()
+    assert set(eng.stats) == set(ENGINE_STAT_SCHEMA)
+    helps = eng.metrics.describe()
+    for key in ENGINE_STAT_SCHEMA:
+        assert helps[f"paddle_tpu_serving_{key}"].strip()
+
+
+# ---------------- kill switches ----------------
+
+def test_metrics_off_restores_plain_dict(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_METRICS", "0")
+    eng = _engine()
+    assert type(eng.stats) is dict
+    assert eng.metrics is None and eng.slo is None
+    assert set(eng.stats) == set(ENGINE_STAT_SCHEMA)
+    assert eng.stats["decode_time_s"] == 0.0
+
+
+def test_flight_recorder_off(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_RECORDER", "0")
+    eng = _engine()
+    assert eng._flight is None
+    eng.serve(_requests(2))     # serving still works, nothing recorded
+
+
+def test_flags_registered_and_typo_warns(monkeypatch):
+    from paddle_tpu.utils import envflags
+    from paddle_tpu.utils.envflags import BOOL_FLAGS, env_bool
+
+    assert BOOL_FLAGS["PADDLE_TPU_METRICS"] is True
+    assert BOOL_FLAGS["PADDLE_TPU_FLIGHT_RECORDER"] is True
+    for flag in ("PADDLE_TPU_METRICS", "PADDLE_TPU_FLIGHT_RECORDER"):
+        monkeypatch.setenv(flag, "off")
+        envflags._warned.clear()
+        with pytest.warns(UserWarning, match=flag):
+            assert env_bool(flag, True) is True    # typo -> default
+
+
+def test_token_identity_with_observability_on_vs_off(monkeypatch):
+    """THE acceptance bar: greedy AND seeded sampled streams byte-identical
+    with metrics/tracing/flight-recorder on vs both kill switches, with
+    prefix cache + speculation + chunked prefill + graceful + TP=2 all
+    on (the conftest forces an 8-device CPU mesh)."""
+    rs = np.random.RandomState(7)
+    shared = np.arange(16, dtype=np.int32)
+
+    def reqs():
+        out = []
+        for i in range(4):
+            tail = rs.randint(0, 128, (6,)).astype(np.int32)
+            out.append(Request(rid=i,
+                               prompt_ids=np.concatenate([shared, tail]),
+                               max_new_tokens=8,
+                               temperature=0.7 if i % 2 else 0.0,
+                               seed=11 + i))
+        return out
+    rs_state = rs.get_state()
+    outs = {}
+    for obs_on in (True, False):
+        rs.set_state(rs_state)
+        if obs_on:
+            monkeypatch.delenv("PADDLE_TPU_METRICS", raising=False)
+            monkeypatch.delenv("PADDLE_TPU_FLIGHT_RECORDER", raising=False)
+        else:
+            monkeypatch.setenv("PADDLE_TPU_METRICS", "0")
+            monkeypatch.setenv("PADDLE_TPU_FLIGHT_RECORDER", "0")
+        eng = _engine(num_blocks=24, enable_prefix_caching=True,
+                      enable_speculation=True, num_draft_tokens=3,
+                      enable_chunked_prefill=True, prefill_chunk=8,
+                      tensor_parallel=2)
+        outs[obs_on] = eng.serve(reqs())
+    assert outs[True] == outs[False]
+
+
+# ---------------- lifecycle tracing ----------------
+
+def test_request_spans_emitted_and_export_drains(tmp_path):
+    eng = _engine(enable_chunked_prefill=True, prefill_chunk=4)
+    eng.serve(_requests(2, new=4))
+    path = tmp_path / "trace.json"
+    profiler.Profiler().export(str(path))
+    events = json.load(open(path))["traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"queued", "prefill_chunk", "decode"} <= names
+    assert any(e["name"].startswith("terminal:FINISHED") for e in events)
+    # spans carry the request id as their thread lane
+    decode_tids = {e["tid"] for e in events if e["name"] == "decode"}
+    assert decode_tids == {0, 1}
+    # drain-on-export: the buffer is the export's, not a leak
+    assert profiler.host_events_len() == 0
+    # span counts are mirrored on the tracer (bench rung detail)
+    assert eng._tracer.counts["decode"] == 2
+    assert eng._tracer.counts["queued"] == 2
+
+
+def test_trace_ids_assigned_and_stable():
+    eng = _engine()
+    reqs = _requests(2)
+    eng.serve(reqs)
+    assert reqs[0].trace_id == "req-0" and reqs[1].trace_id == "req-1"
+
+
+def test_profiler_buffer_cap_drops_and_counts(tmp_path):
+    prev = profiler.set_host_event_capacity(8)
+    try:
+        for i in range(20):
+            with profiler.RecordEvent(f"span{i}"):
+                pass
+        native = profiler._native_lib() is not None
+        if not native:
+            # pure-python buffer: capped exactly, overflow counted
+            assert profiler.host_events_len() == 8
+            assert profiler.host_events_dropped() == 12
+        path = tmp_path / "t.json"
+        profiler.Profiler().export(str(path))
+        events = json.load(open(path))["traceEvents"]
+        if not native:
+            assert any(e.get("name") == "host_events_dropped"
+                       and e["args"]["dropped"] == 12 for e in events)
+        # export drained and reset the drop counter
+        assert profiler.host_events_len() == 0
+        assert profiler.host_events_dropped() == 0
+        profiler.add_trace_event({"name": "after", "ph": "i", "ts": 0})
+        assert profiler.host_events_len() == 1
+    finally:
+        profiler.set_host_event_capacity(prev)
+
+
+# ---------------- SLOTracker ----------------
+
+def test_slo_tracker_streaming_accounting():
+    t = SLOTracker()
+    t.begin(1, 100.0)
+    t.admitted(1, 100.5)
+    t.tokens(1, 1, 101.0)       # ttft = 1.0
+    t.tokens(1, 2, 101.2)       # gap 0.2
+    t.tokens(1, 1, 103.0)       # gap 1.8 (the max)
+    t.finish(1, "FINISHED", 103.1)
+    t.begin(2, 100.0)
+    t.tokens(2, 1, 109.0)       # ttft 9.0: blows a 5s TTFT SLO
+    t.finish(2, "FINISHED", 109.1)
+    t.begin(3, 100.0)
+    t.finish(3, "FAILED", 101.0)    # non-FINISHED never counts
+    rec = {r["rid"]: r for r in t.records}
+    assert rec[1]["ttft_s"] == pytest.approx(1.0)
+    assert rec[1]["max_gap_s"] == pytest.approx(1.8)
+    assert rec[1]["tokens"] == 4
+    assert rec[3]["ttft_s"] is None
+    g = t.goodput_at(ttft_slo_s=5.0, tbt_slo_s=2.0)
+    assert g == {"requests": 1, "tokens": 4, "rids": (1,)}
+    # tighter TBT SLO kills request 1's 1.8s gap
+    assert t.goodput_at(5.0, 1.0)["requests"] == 0
+    # looser TTFT admits request 2 (single arrival -> no gap to judge)
+    assert t.goodput_at(10.0, 2.0)["tokens"] == 5
+
+
+def test_engine_slo_histograms_and_records():
+    eng = _engine()
+    eng.serve(_requests(3, new=4))
+    assert len(eng.slo.records) == 3
+    assert all(r["status"] == "FINISHED" and r["tokens"] == 4
+               for r in eng.slo.records)
+    g = eng.slo.goodput_at(60.0, 60.0)
+    assert g["requests"] == 3 and g["tokens"] == 12
+    text = eng.metrics.expose()
+    assert "paddle_tpu_serving_ttft_seconds_count 3" in text
+    assert "paddle_tpu_serving_queue_wait_seconds_count 3" in text
+    # host-gap + step-time histograms observed at least one step
+    assert re.search(r"paddle_tpu_serving_step_seconds_count [1-9]", text)
+
+
+# ---------------- flight recorder ----------------
+
+def test_flight_recorder_ring_bounds_and_drop_counter():
+    fr = FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record("e", i=i)
+    assert len(fr) == 4 and fr.dropped == 6
+    assert [e["i"] for e in fr.events()] == [6, 7, 8, 9]
+    d = fr.dump("why")
+    assert d["events_dropped"] == 6 and len(d["events"]) == 4
+    assert fr.dumps[-1] is d
+    json.loads(fr.dump_json("again"))       # serializable
+
+
+def test_flight_dump_on_request_failure(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FAULT_INJECT", "slot_error@step=3")
+    eng = _engine()
+    reqs = _requests(2, new=6)
+    eng.serve(reqs)
+    assert sum(r.status == "FAILED" for r in reqs) == 1
+    assert len(eng._flight.dumps) == 1
+    d = eng._flight.dumps[0]
+    assert d["reason"].startswith("request_failed")
+    assert "paddle_tpu_serving_requests_failed" in d["metrics"]
+    kinds = {e["kind"] for e in d["events"]}
+    assert {"admit", "fault", "terminal"} <= kinds
+
+
+def test_flight_dump_on_engine_audit_error(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_ENGINE_AUDIT", "1")
+    from paddle_tpu.analysis import EngineAuditError
+
+    eng = _engine(num_blocks=16, enable_prefix_caching=True)
+    eng.serve([Request(rid=0, prompt_ids=np.arange(1, 20, dtype=np.int32),
+                       max_new_tokens=4)])
+    assert eng._pcache.resident_blocks() > 0
+    victim = next(iter(eng._pcache._by_hash.values()))
+    victim.refcount += 1        # inject: a ref no slot holds
+    eng.add_request(Request(rid=1,
+                            prompt_ids=np.arange(1, 9, dtype=np.int32),
+                            max_new_tokens=2))
+    with pytest.raises(EngineAuditError):
+        while eng.step() or eng._queue:
+            pass
+    assert [d["reason"] for d in eng._flight.dumps] == ["engine_audit_error"]
+
+
+# ---------------- fleet: links, dumps, SLO parity ----------------
+
+def _fleet(n=3, fault=None, **kw):
+    import os
+
+    from paddle_tpu.inference.fleet import FleetRouter
+
+    cfg, params = _tiny()
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("paged", True)
+    if fault is not None:
+        os.environ["PADDLE_TPU_FAULT_INJECT"] = fault
+    try:
+        return FleetRouter(cfg, params, n_replicas=n, **kw)
+    finally:
+        os.environ.pop("PADDLE_TPU_FAULT_INJECT", None)
+
+
+def _poll_serve(fleet, reqs):
+    """Bench-style poll loop: per-request arrival timestamps recorded after
+    every fleet step — the hand-rolled TTFT/TBT evidence the SLOTracker
+    must reproduce."""
+    import time as _time
+
+    for r in reqs:
+        fleet.add_request(r)
+    seen = {r.rid: 0 for r in reqs}
+    arrivals = {r.rid: [] for r in reqs}
+    while fleet.step():
+        now = _time.perf_counter()
+        for r in reqs:
+            if len(r.output_ids) > seen[r.rid]:
+                seen[r.rid] = len(r.output_ids)
+                arrivals[r.rid].append(now)
+    return arrivals
+
+
+def test_fleet_chaos_produces_all_four_artifacts(tmp_path):
+    """Acceptance criterion: a fleet chaos run yields (1) one chrome trace
+    with cross-replica failover links, (2) a Prometheus snapshot, (3) a
+    flight-recorder dump on the injected replica death, (4) an SLOTracker
+    goodput figure matching the hand-rolled poll-loop computation."""
+    fleet = _fleet(fault="replica_crash@step=6,replica=1",
+                   enable_prefix_caching=True, enable_chunked_prefill=True,
+                   prefill_chunk=8)
+    reqs = _requests(5, new=6, seed=3)
+    arrivals = _poll_serve(fleet, reqs)
+    assert all(r.status == "FINISHED" for r in reqs)
+    assert fleet.stats["failovers"] == 1
+
+    # (4) SLOTracker goodput == hand-rolled figure (generous SLOs: every
+    # FINISHED request qualifies on both arms, so the sets must be equal)
+    ttft_slo, tbt_slo = 120.0, 120.0
+
+    def met(r):
+        if r.status != "FINISHED" or r.ttft_s is None or r.ttft_s > ttft_slo:
+            return False
+        gaps = [b - a for a, b in zip(arrivals[r.rid], arrivals[r.rid][1:])]
+        return not gaps or max(gaps) <= tbt_slo
+
+    hand_ok = [r for r in reqs if met(r)]
+    g = fleet.slo.goodput_at(ttft_slo, tbt_slo)
+    assert set(g["rids"]) == {r.rid for r in hand_ok}
+    assert g["tokens"] == sum(len(r.output_ids) for r in hand_ok)
+    # tracker TTFT is byte-equal to the caller-visible Request.ttft_s
+    recs = {r["rid"]: r for r in fleet.slo.records}
+    for r in reqs:
+        assert recs[r.rid]["ttft_s"] == r.ttft_s
+
+    # (2) Prometheus snapshot over the shared registry: fleet + per-replica
+    text = fleet.metrics.expose()
+    assert "paddle_tpu_fleet_failovers 1" in text
+    assert 'paddle_tpu_serving_decode_tokens{replica="0"}' in text
+
+    # (3) flight-recorder dump on the replica death, with the dead
+    # engine's own ring attached
+    assert len(fleet._flight.dumps) == 1
+    d = fleet._flight.dumps[0]
+    assert "replica 1 DEAD" in d["reason"]
+    assert d["replica"] == 1 and d["engine_events"]
+    kinds = {e["kind"] for e in d["events"]}
+    assert {"route", "health", "failover"} <= kinds
+
+    # (1) one chrome trace with cross-replica failover links
+    path = tmp_path / "fleet.json"
+    fleet.export_trace(str(path))
+    events = json.load(open(path))["traceEvents"]
+    outs = [e for e in events if e["ph"] == "s" and e["name"] == "failover"]
+    ins = {e["id"]: e for e in events
+           if e["ph"] == "f" and e["name"] == "failover"}
+    assert outs and all(o["id"] in ins for o in outs)
+    for o in outs:
+        assert o["pid"] == 1                      # from the dead replica
+        assert ins[o["id"]]["pid"] != 1           # onto a survivor
+    # replica process lanes are named for the timeline
+    pnames = {e["args"]["name"] for e in events
+              if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert {"replica-0", "replica-1", "replica-2"} <= pnames
+
+
+def test_fleet_hedge_emits_linked_spans():
+    fleet = _fleet(n=2, fault="replica_stall@replica=0,count=8",
+                   stall_steps=2, stall_dead_steps=50)
+    reqs = _requests(2, new=4, seed=5)
+    _poll_serve(fleet, reqs)
+    assert fleet.stats["hedges"] >= 1
+    assert all(r.status == "FINISHED" for r in reqs)
+    # hedge flow links: out on the stalled replica, in on the survivor
+    outs = [c for t in fleet._tracers for c in [t.counts.get("hedge", 0)]]
+    assert outs[0] >= 1 and outs[1] >= 1
+    kinds = {e["kind"] for e in fleet._flight.events()}
+    assert "hedge" in kinds and "health" in kinds
+
+
+def test_fleet_metrics_off_plain_dicts(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_METRICS", "0")
+    fleet = _fleet(n=2)
+    assert type(fleet.stats) is dict and fleet.slo is None
+    # absent evidence reads as absent: no registry, so bench embeds null
+    # exposition rather than an empty string
+    assert fleet.metrics is None
+    reqs = _requests(2, new=3, seed=9)
+    got = fleet.serve(reqs)
+    assert all(len(v) == 3 for v in got.values())
+
+
+def test_process_names_survive_drain_on_export(tmp_path):
+    """Periodic-export regression: the replica lane-name metadata must
+    re-emit after export() drains the buffer, or every trace after the
+    first renders bare pids."""
+    eng = _engine()
+    eng.serve(_requests(1, new=2))
+    profiler.Profiler().export(str(tmp_path / "t1.json"))
+    eng.serve(_requests(1, new=2, seed=1))
+    path2 = tmp_path / "t2.json"
+    profiler.Profiler().export(str(path2))
+    events = json.load(open(path2))["traceEvents"]
+    assert any(e.get("ph") == "M" and e.get("name") == "process_name"
+               for e in events)
+
+
+# ---------------- lint gate ----------------
+
+def test_serving_target_host_sync_clean_with_metrics_on(monkeypatch):
+    """The gate's serving programs stay callback-free with metrics ON
+    (targets force PADDLE_TPU_METRICS=1, so an ambient =0 cannot hide a
+    regression)."""
+    monkeypatch.setenv("PADDLE_TPU_METRICS", "0")    # ambient kill switch
+    from paddle_tpu.analysis import targets
+
+    t = targets.build("serving_decode_step")
+    from paddle_tpu.analysis import analyze
+
+    r = analyze(t.fn, *t.args, target=t.name, rules=("host_sync",),
+                allowlist=[])
+    assert r.by_rule("host_sync") == []
+
+
+def test_metric_recorded_via_callback_inside_jit_fails_gate():
+    """Positive control: recording a metric through a callback from INSIDE
+    a compiled step is exactly the host-sync regression the gate exists to
+    catch."""
+    from paddle_tpu.analysis import analyze
+
+    reg = MetricsRegistry()
+    c = reg.counter("t_bad_inline", "recorded from inside jit").labels()
+
+    def bad_step(x):
+        def body(carry, _):
+            jax.debug.callback(lambda: c.inc())
+            return carry * 2.0, None
+        y, _ = jax.lax.scan(body, x, None, length=4)
+        return y
+
+    r = analyze(bad_step, jnp.float32(1.0), rules=("host_sync",),
+                allowlist=[])
+    hits = r.by_rule("host_sync")
+    assert hits and any(f in ("warning", "error")
+                        for f in {h.severity for h in hits})
+    assert r.gating(), "a callback inside a jitted step must gate"
