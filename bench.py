@@ -548,8 +548,13 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
         total = sum(len(r.output_ids) for r in reqs)
         # snapshot UNDER THIS RUNG'S env (trace-time state): after the
         # restore below a paged_kernel=False rung would re-trace the
-        # kernel program instead of the gather one it measured
-        launches = eng.decode_step_launches()
+        # kernel program instead of the gather one it measured.  The card
+        # embeds the same launch census decode_step_launches() reports —
+        # derive that detail key from it rather than tracing twice.
+        program_card = eng.decode_step_card()
+        launches = {k: program_card[k]
+                    for k in ("eqns", "pallas_calls", "scatters",
+                              "fused_decode")}
     finally:
         if paged and not paged_kernel:
             if saved_env is None:
@@ -570,6 +575,11 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
               "fused_kernel_calls": _pa.FUSED_KERNEL_CALLS,
               "flash_combine_shards": _pa.LAST_FLASH_SHARDS,
               "decode_step_launches": launches,
+              # static program card of the decode step (ISSUE 12,
+              # analysis/cost_model.py): peak HBM / VMEM-fit / census
+              # figures the budget gate enforces, riding with the rung
+              # they explain
+              "program_card": program_card,
               # expected: one decode variant per sampling mode used +
               # one prefill per warmed bucket; growth = in-serve churn
               "n_traces": eng.n_traces(),
@@ -1291,8 +1301,11 @@ def run_cb_longctx_rung(name, cfg, max_batch, n_long, n_short, long_prompt,
         # snapshot the launch telemetry UNDER THIS ARM'S env — the method
         # re-traces, and the kill switches are trace-time state: calling it
         # after the finally restore would describe the wrong program on
-        # the seq arm
-        launches = eng.decode_step_launches()
+        # the seq arm (launch census derived from the card — one trace)
+        program_card = eng.decode_step_card()
+        launches = {k: program_card[k]
+                    for k in ("eqns", "pallas_calls", "scatters",
+                              "fused_decode")}
     finally:
         if saved_env is None:
             os.environ.pop(env_key, None)
@@ -1328,6 +1341,7 @@ def run_cb_longctx_rung(name, cfg, max_batch, n_long, n_short, long_prompt,
                    "paged_fallback_calls": _pa.FALLBACK_CALLS,
                    "flash_combine_shards": _pa.LAST_FLASH_SHARDS,
                    "decode_step_launches": launches,
+                   "program_card": program_card,
                    "preemptions": eng.stats["preemptions"],
                    "n_traces": eng.n_traces(),
                    "backend": jax.default_backend(),

@@ -31,11 +31,16 @@ import jax
 from .report import (AllowRule, Finding, Report, Severity, load_allowlist,
                      DEFAULT_ALLOWLIST)
 from . import rules as _rules
+from .cost_model import (ProgramCard, BudgetEntry, build_card, card_findings,
+                         check_budgets, load_budgets, eqn_census,
+                         DEFAULT_BUDGETS)
 from .engine_audit import EngineAuditError, audit_engine, audit_enabled
 
 __all__ = ["analyze", "Report", "Finding", "Severity", "AllowRule",
            "load_allowlist", "audit_engine", "audit_enabled",
-           "EngineAuditError", "n_traces", "ALL_RULES"]
+           "EngineAuditError", "n_traces", "ALL_RULES", "ProgramCard",
+           "BudgetEntry", "build_card", "card_findings", "check_budgets",
+           "load_budgets", "eqn_census", "DEFAULT_BUDGETS"]
 
 ALL_RULES = ("dtype_upcast", "donation", "recompile", "host_sync",
              "resharding")
@@ -44,12 +49,23 @@ ALL_RULES = ("dtype_upcast", "donation", "recompile", "host_sync",
 def analyze(fn, *args, target: str = "", rules=None, allowlist=None,
             allowlist_path: str | None = None,
             min_donation_bytes: int = 1 << 20,
-            min_gather_bytes: int = 1 << 20) -> Report:
+            min_gather_bytes: int = 1 << 20,
+            card: bool = False, vmem_cap: int | None = None) -> Report:
     """Trace ``fn(*args)`` and lint the program.  ``fn`` may be jit-wrapped
     (donation/sharding metadata is read off the pjit eqn) or a plain
     callable.  ``rules`` restricts to a subset of :data:`ALL_RULES`;
     ``allowlist`` takes parsed :class:`AllowRule` s (or ``allowlist_path`` a
-    TOML file; default: the packaged ``allowlist.toml``)."""
+    TOML file; default: the packaged ``allowlist.toml``).
+
+    ``card=True`` additionally derives the static :class:`ProgramCard`
+    (cost_model.py) in the same pass — reusing this trace, the recompile
+    rule's signature count, and (on multi-device programs) the ONE compiled
+    HLO the resharding rule reads — and attaches it as ``report.card``;
+    card-level gating findings (a Pallas launch over the ``vmem_cap``)
+    join the report's findings and go through the allowlist like any rule's.
+    Budget ceilings are checked by the callers that hold the full card set
+    (``tools/lint_gate.py``, the ``--cards`` CLI) via
+    :func:`check_budgets`."""
     active = set(rules if rules is not None else ALL_RULES)
     unknown = active - set(ALL_RULES)
     if unknown:
@@ -64,6 +80,10 @@ def analyze(fn, *args, target: str = "", rules=None, allowlist=None,
     closed = trace()
     findings: list[Finding] = []
     n_sigs = None
+    hlo = hlo_err = None
+    if (card or "resharding" in active) \
+            and _rules._mesh_devices_of(closed, args) > 1:
+        hlo, hlo_err = _rules.compiled_hlo(fn, args)
     if "dtype_upcast" in active:
         findings += _rules.check_dtype_upcast(closed, args, target=target)
     if "donation" in active:
@@ -78,11 +98,22 @@ def analyze(fn, *args, target: str = "", rules=None, allowlist=None,
     if "resharding" in active:
         findings += _rules.check_resharding(fn, args, closed=closed,
                                             target=target,
-                                            min_bytes=min_gather_bytes)
+                                            min_bytes=min_gather_bytes,
+                                            hlo=hlo, hlo_error=hlo_err)
+    built_card = None
+    if card:
+        # compile_collectives=False: the one compile this pass needed
+        # already happened above — a failure must not be retried per card
+        built_card = build_card(fn, args, target=target, closed=closed,
+                                hlo=hlo, trace_families=n_sigs,
+                                vmem_cap=vmem_cap, compile_collectives=False)
+        findings += card_findings(built_card)
     sev = {Severity.ERROR: 0, Severity.WARNING: 1, Severity.INFO: 2}
     findings.sort(key=lambda f: (sev[f.severity], f.rule, f.where))
-    return Report(target or getattr(fn, "__name__", "anonymous"), findings,
-                  allowlist=allowlist, n_traces=n_sigs)
+    report = Report(target or getattr(fn, "__name__", "anonymous"), findings,
+                    allowlist=allowlist, n_traces=n_sigs)
+    report.card = built_card
+    return report
 
 
 def n_traces(*jitted) -> int | None:

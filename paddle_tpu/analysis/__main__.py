@@ -4,6 +4,16 @@ Traces the named target (or ``--all``) and prints the findings; exit status
 0 = clean or fully allowlisted, 1 = gating findings, making the module
 directly usable as a pre-submit check.  ``tools/lint_gate.py`` is the CI
 wrapper over the same registry.
+
+``--cards`` switches to the program-card mode (cost_model.py): derive each
+selected target's static ProgramCard and gate it against the checked-in
+``analysis/budgets.toml`` ceilings (exit 1 on any over-budget field,
+missing entry, stale entry, or over-VMEM-cap launch);
+``--cards --update-budgets`` instead rewrites the budget file at the
+measured values (preserving existing reasons) and exits 0 — the documented
+workflow for a PR that legitimately moves a figure.  ``--json`` emits
+machine-readable findings/cards on stdout in either mode; exit codes are
+unchanged.
 """
 
 from __future__ import annotations
@@ -38,6 +48,7 @@ def main(argv=None) -> int:
     from . import load_allowlist
     from .targets import GATE_TARGETS, TARGETS
     from .targets import run as run_target
+    from .targets import run_card
 
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
@@ -52,6 +63,17 @@ def main(argv=None) -> int:
                    help="allowlist TOML (default: packaged allowlist.toml)")
     p.add_argument("--no-allowlist", action="store_true",
                    help="show findings the allowlist would suppress")
+    p.add_argument("--cards", action="store_true",
+                   help="program-card mode: derive static cost/memory cards "
+                        "and gate them against budgets.toml")
+    p.add_argument("--update-budgets", action="store_true",
+                   help="with --cards: rewrite budgets.toml at the measured "
+                        "values (reasons preserved) instead of gating")
+    p.add_argument("--budgets", default=None,
+                   help="budgets TOML (default: packaged budgets.toml)")
+    p.add_argument("--json", action="store_true",
+                   help="emit machine-readable findings/cards on stdout "
+                        "(exit codes unchanged)")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="also print allowlisted findings with reasons")
     args = p.parse_args(argv)
@@ -61,22 +83,93 @@ def main(argv=None) -> int:
             gate = " [gate]" if name in GATE_TARGETS else ""
             print(f"{name}{gate}")
         return 0
-    names = list(args.target) or (list(GATE_TARGETS) if args.all else [])
+    if args.update_budgets and not args.cards:
+        p.error("--update-budgets requires --cards")
+    names = list(args.target) or (
+        list(GATE_TARGETS) if (args.all or args.cards) else [])
     if not names:
         p.error("pass --target <name> (repeatable), --all, or --list")
 
+    if args.cards:
+        return _cards_main(args, names, run_card, TARGETS)
+
     allowlist = [] if args.no_allowlist else load_allowlist(args.allowlist)
     rc = 0
+    reports = []
     for name in names:
         report = run_target(name, allowlist=allowlist)
-        print(report.render(verbose=args.verbose))
+        reports.append(report)
+        if not args.json:
+            print(report.render(verbose=args.verbose))
         if not report.ok:
             rc = 1
-    if rc:
+    if args.json:
+        import dataclasses
+        import json
+
+        print(json.dumps({"reports": [
+            {"target": r.target, "ok": r.ok, "n_traces": r.n_traces,
+             "findings": [dataclasses.asdict(f) for f in r.findings],
+             "allowlisted": [{**dataclasses.asdict(f), "reason": a.reason}
+                             for f, a in r.allowlisted]}
+            for r in reports]}, indent=2))
+    if rc and not args.json:
         print("\nlint FAILED: fix the findings above or allowlist them in "
               "paddle_tpu/analysis/allowlist.toml with a reason",
               file=sys.stderr)
     return rc
+
+
+def _cards_main(args, names, run_card, TARGETS) -> int:
+    """--cards: derive the selected targets' ProgramCards, then either
+    rewrite budgets.toml (--update-budgets) or gate against it.  The stale
+    check (budget entries naming no registered target) needs only the
+    registry, so it runs regardless of which targets were selected.
+    Gating policy lives in ONE place — ``cost_model.gate_cards`` — shared
+    with ``tools/lint_gate.py --cards-only``; ``-v`` additionally prints
+    the card findings the allowlist suppressed, with their reasons, like
+    the lint mode."""
+    from . import Report, load_allowlist
+    from .cost_model import (card_findings, gate_cards, load_budgets,
+                             update_budgets_file)
+
+    cards = {name: run_card(name) for name in names}
+    if args.update_budgets:
+        # registered=TARGETS: entries for targets NOT selected this run are
+        # kept verbatim (a partial --target update must not delete the
+        # rest); only unregistered (stale) entries retire
+        path = update_budgets_file(cards, args.budgets, registered=TARGETS)
+        print(f"wrote {len(cards)} budget entr"
+              f"{'y' if len(cards) == 1 else 'ies'} to {path}")
+        return 0
+    allowlist = [] if args.no_allowlist else load_allowlist(args.allowlist)
+    findings = gate_cards(cards, load_budgets(args.budgets),
+                          allowlist=allowlist, registered=TARGETS)
+    gating = [f for f in findings if f.severity != "info"]
+    if args.json:
+        import dataclasses
+        import json
+
+        print(json.dumps(
+            {"cards": {n: c.summary() for n, c in cards.items()},
+             "findings": [dataclasses.asdict(f) for f in findings],
+             "ok": not gating}, indent=2))
+    else:
+        for name in sorted(cards):
+            print(cards[name].render())
+            if args.verbose:
+                rep = Report(name, card_findings(cards[name]),
+                             allowlist=allowlist)
+                for f, a in rep.allowlisted:
+                    print(f"   ALLOWED {f.render().strip()}  "
+                          f"(reason: {a.reason})")
+        for f in findings:
+            print(f.render() + (f"  <{f.target}>" if f.target else ""))
+        if gating:
+            print("\ncard gate FAILED: fix the regression or re-run "
+                  "--cards --update-budgets and justify the new ceilings "
+                  "in paddle_tpu/analysis/budgets.toml", file=sys.stderr)
+    return 1 if gating else 0
 
 
 if __name__ == "__main__":

@@ -77,27 +77,31 @@ class AllowRule:
                 or self.match in f.message)
 
 
-def _parse_mini_toml(text: str) -> list[dict]:
-    """Parse the allowlist's TOML subset: ``[[allow]]`` array-of-tables with
-    ``key = "string"`` pairs and ``#`` comments.  Anything else is a loud
-    error — a silently ignored allowlist line would un-suppress findings."""
+def _parse_mini_toml(text: str, header: str = "allow") -> list[dict]:
+    """Parse the allowlist/budgets TOML subset: ``[[<header>]]``
+    array-of-tables with ``key = "string"`` or ``key = <int>`` pairs and
+    ``#`` comments.  Anything else is a loud error — a silently ignored
+    allowlist line would un-suppress findings (and a silently ignored
+    budget line would un-gate a ceiling)."""
     entries: list[dict] = []
     current: dict | None = None
     for ln, raw in enumerate(text.splitlines(), 1):
         line = raw.strip()
         if not line or line.startswith("#"):
             continue
-        if line == "[[allow]]":
+        if line == f"[[{header}]]":
             current = {}
             entries.append(current)
             continue
-        m = re.match(r'^([A-Za-z_][A-Za-z0-9_]*)\s*=\s*"((?:[^"\\]|\\.)*)"'
+        m = re.match(r'^([A-Za-z_][A-Za-z0-9_]*)\s*=\s*'
+                     r'(?:"((?:[^"\\]|\\.)*)"|(-?\d+))'
                      r'\s*(?:#.*)?$', line)
         if m is None or current is None:
             raise ValueError(
-                f"allowlist parse error at line {ln}: {raw!r} (expected "
-                f'[[allow]] or key = "value")')
-        current[m.group(1)] = m.group(2).replace('\\"', '"')
+                f"{header} table parse error at line {ln}: {raw!r} "
+                f'(expected [[{header}]], key = "value", or key = <int>)')
+        current[m.group(1)] = (int(m.group(3)) if m.group(3) is not None
+                               else re.sub(r'\\(["\\])', r"\1", m.group(2)))
     return entries
 
 
@@ -118,6 +122,10 @@ def load_allowlist(path: str | None = None) -> list[AllowRule]:
         unknown = set(e) - {"rule", "target", "match", "reason"}
         if unknown:
             raise ValueError(f"allowlist entry {i}: unknown keys {unknown}")
+        bad = {k for k, v in e.items() if not isinstance(v, str)}
+        if bad:
+            raise ValueError(f"allowlist entry {i}: non-string value(s) for "
+                             f"{sorted(bad)} (budgets live in budgets.toml)")
         if not e.get("reason"):
             raise ValueError(
                 f"allowlist entry {i} ({e}): every suppression needs a "
@@ -134,6 +142,7 @@ class Report:
                  n_traces: int | None = None):
         self.target = target
         self.n_traces = n_traces  # distinct trace signatures seen (churn rule)
+        self.card = None          # ProgramCard when analyze(card=True)
         self.findings: list[Finding] = []       # active (not allowlisted)
         self.allowlisted: list[tuple[Finding, AllowRule]] = []
         for f in findings:

@@ -330,6 +330,18 @@ def _permute_dicts(args):
     return rec(tuple(args))
 
 
+def signature_families(args) -> int:
+    """Distinct jit cache signatures across the equivalence perturbations
+    (python-scalar vs array provenance, dict insertion order) — 1 means the
+    program compiles exactly one trace family for these inputs.  This is
+    the ``trace_families`` figure on a :class:`~.cost_model.ProgramCard`;
+    :func:`check_recompile` reports the same count alongside its per-leaf
+    findings."""
+    base = _cache_signature(args)
+    return len({base, _cache_signature(_strongify(args)),
+                _cache_signature(_permute_dicts(args))})
+
+
 def check_recompile(fn, args, target: str = "", trace=None,
                     baseline=None) -> tuple[list[Finding], int]:
     """Signature stability under equivalent-input perturbations, plus a
@@ -467,8 +479,25 @@ def _mesh_devices_of(closed, args=()) -> int:
     return best
 
 
+def compiled_hlo(fn, args) -> tuple[str | None, Exception | None]:
+    """Post-SPMD compiled HLO text of ``fn(*args)`` — (text, None) on
+    success, (None, error) when the backend can't compile (e.g. device
+    limits).  Shared by the resharding rule and the program card's
+    collective-bytes attribution so one multi-device target pays exactly
+    one compile per gate run."""
+    import jax
+
+    try:
+        lowered = fn.lower(*args) if hasattr(fn, "lower") \
+            else jax.jit(fn).lower(*args)
+        return lowered.compile().as_text(), None
+    except Exception as e:
+        return None, e
+
+
 def check_resharding(fn, args, closed=None, target: str = "",
-                     min_bytes: int = 1 << 20) -> list[Finding]:
+                     min_bytes: int = 1 << 20, hlo: str | None = None,
+                     hlo_error: Exception | None = None) -> list[Finding]:
     """Compile under the fn's own mesh and scan the post-SPMD HLO for
     all-gather/all-to-all/all-reduce ops over large tensors.
     Gathers/all-to-alls are the collectives GSPMD *inserted* — the program
@@ -481,13 +510,16 @@ def check_resharding(fn, args, closed=None, target: str = "",
     docs/tp_serving.md) carries a reasoned allowlist entry, and any other
     large reduce — a sharding change widening a psum operand, a new
     replicated reduction — fails the gate instead of shipping silently.
-    Skipped on single-device meshes (nothing to reshard)."""
+    Skipped on single-device meshes (nothing to reshard).  ``hlo`` /
+    ``hlo_error`` carry a precomputed :func:`compiled_hlo` result (the
+    card-building path in ``analyze`` shares one compile); when neither is
+    given the rule compiles here."""
     if closed is not None and _mesh_devices_of(closed, args) <= 1:
         return []
-    try:
-        hlo = jax.jit(fn).lower(*args).compile().as_text() \
-            if not hasattr(fn, "lower") else fn.lower(*args).compile().as_text()
-    except Exception as e:  # compile unavailable (backend limits) — skip
+    if hlo is None and hlo_error is None:
+        hlo, hlo_error = compiled_hlo(fn, args)
+    if hlo is None:  # compile unavailable (backend limits) — skip, visibly
+        e = hlo_error
         return [Finding(rule="resharding", severity=Severity.INFO,
                         message=f"sharding check skipped: compile failed "
                                 f"({type(e).__name__}: {str(e)[:120]})",

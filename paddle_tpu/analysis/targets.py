@@ -14,12 +14,14 @@ serving decode path off the fast path fails CI, not a later bench round.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import typing
 
 import numpy as np
 
-__all__ = ["AnalysisTarget", "TARGETS", "GATE_TARGETS", "build", "run"]
+__all__ = ["AnalysisTarget", "TARGETS", "GATE_TARGETS", "build", "run",
+           "run_card"]
 
 
 @dataclasses.dataclass
@@ -28,6 +30,34 @@ class AnalysisTarget:
     fn: typing.Any
     args: tuple
     analyze_kwargs: dict = dataclasses.field(default_factory=dict)
+    #: env pins to hold while ANALYZING (value None = unset).  Kill
+    #: switches are trace-time state, and analysis re-traces the target
+    #: AFTER its builder returned — without re-pinning here, an ambient
+    #: PADDLE_TPU_DISABLE_PALLAS (or a bare environment) would silently
+    #: swap which decode program the gate traces (e.g. the pre-fusion
+    #: serving_decode_step picking up the flash kernel), and the program
+    #: card would drift with whatever ran before it.
+    env: dict = dataclasses.field(default_factory=dict)
+
+
+@contextlib.contextmanager
+def _pinned_env(env: dict):
+    import os
+
+    saved = {k: os.environ.get(k) for k in env}
+    try:
+        for k, v in env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        yield
+    finally:
+        for k, p in saved.items():
+            if p is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = p
 
 
 def _t_llama_train_step() -> AnalysisTarget:
@@ -97,18 +127,18 @@ def _serving_engine(_force_flags=(), _cfg_kwargs=None, _disable_pallas=(),
             stack.callback(lambda f=flag, p=prev: (
                 os.environ.__setitem__(f, p) if p is not None
                 else os.environ.pop(f, None)))
-        # the per-path decode kill switches (flash_decode /
-        # fused_decode_step) are trace-time state like the flags above:
-        # every serving target pins them to EXACTLY what it declares —
-        # serving_decode_step disables both (the pre-fusion program whose
-        # lint shape is locked in), serving_flash_decode_step enables both
-        # (the production default) — so an operator's ambient opt-out can
-        # never swap which program the gate analyzes.
+        # the Pallas kill switches are trace-time state like the flags
+        # above: every serving target pins PADDLE_TPU_DISABLE_PALLAS to
+        # EXACTLY the token set it declares — serving_decode_step
+        # disables flash/fused (the pre-fusion program whose lint shape
+        # is locked in), serving_flash_decode_step declares none (the
+        # production default), and an operator's ambient opt-out for ANY
+        # kernel is cleared rather than merged: the gate only traces
+        # (never executes a kernel), so ambient paged_attention must not
+        # demote a target to the gather oracle, flip the ctor's fused
+        # mode, or fail the budget gate spuriously.
         prev_dp = os.environ.get("PADDLE_TPU_DISABLE_PALLAS")
         tokens = set(_disable_pallas)
-        if prev_dp:
-            tokens |= {t.strip() for t in prev_dp.split(",")
-                       if t.strip()} - {"flash_decode", "fused_decode_step"}
         if tokens:
             os.environ["PADDLE_TPU_DISABLE_PALLAS"] = ",".join(sorted(tokens))
         else:
@@ -127,9 +157,30 @@ def _serving_engine(_force_flags=(), _cfg_kwargs=None, _disable_pallas=(),
         if prev_tp is not None:
             stack.callback(lambda: os.environ.__setitem__("PADDLE_TPU_TP",
                                                           prev_tp))
-        return ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
-                                        chunk=2, paged=True, block_size=8,
-                                        **kwargs)
+        eng = ContinuousBatchingEngine(cfg, params, max_batch=2, max_seq=64,
+                                       chunk=2, paged=True, block_size=8,
+                                       **kwargs)
+        # the pins above only cover CONSTRUCTION (this stack unwinds on
+        # return) — but the kill switches are also read at TRACE time,
+        # and analysis traces the target later.  Record pins on the
+        # engine so the AnalysisTarget can re-apply them around
+        # analyze()/build_card() (AnalysisTarget.env): otherwise an
+        # ambient opt-out — or its absence — swaps which program the
+        # gate traces after the builder already returned.  The pinned
+        # token set is the target's DECLARED tokens only, not the
+        # construction-time ambient merge: analysis is pure tracing
+        # (never executes a kernel), so an operator's ambient
+        # paged_attention opt-out must not demote the gate's traced
+        # program to the gather oracle and fail the budget gate
+        # spuriously.
+        eng._lint_env = {
+            **{flag: "1" for flag in (*_force_flags, "PADDLE_TPU_GRACEFUL",
+                                      "PADDLE_TPU_METRICS")},
+            "PADDLE_TPU_DISABLE_PALLAS": (",".join(sorted(_disable_pallas))
+                                          if _disable_pallas else None),
+            "PADDLE_TPU_TP": None,
+        }
+        return eng
 
 
 def _t_serving_decode_step() -> AnalysisTarget:
@@ -151,7 +202,7 @@ def _t_serving_decode_step() -> AnalysisTarget:
     return AnalysisTarget(
         "serving_decode_step", eng._decode_greedy,
         (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active,
-         temp, topp, seeds, table))
+         temp, topp, seeds, table), env=eng._lint_env)
 
 
 def _t_serving_flash_decode_step() -> AnalysisTarget:
@@ -175,7 +226,7 @@ def _t_serving_flash_decode_step() -> AnalysisTarget:
     return AnalysisTarget(
         "serving_flash_decode_step", eng._decode_greedy,
         (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active,
-         temp, topp, seeds, table))
+         temp, topp, seeds, table), env=eng._lint_env)
 
 
 def _t_serving_prefill_step() -> AnalysisTarget:
@@ -195,7 +246,8 @@ def _t_serving_prefill_step() -> AnalysisTarget:
 
     return AnalysisTarget(
         "serving_prefill_step", prefill,
-        (eng.params, ids, eng.cache_k, eng.cache_v, table_row, length))
+        (eng.params, ids, eng.cache_k, eng.cache_v, table_row, length),
+        env=eng._lint_env)
 
 
 def _t_serving_verify_step() -> AnalysisTarget:
@@ -219,7 +271,7 @@ def _t_serving_verify_step() -> AnalysisTarget:
     return AnalysisTarget(
         "serving_verify_step", eng._verify_greedy,
         (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active, q_lens,
-         temp, topp, seeds, table))
+         temp, topp, seeds, table), env=eng._lint_env)
 
 
 def _t_serving_mixed_step() -> AnalysisTarget:
@@ -244,7 +296,7 @@ def _t_serving_mixed_step() -> AnalysisTarget:
     return AnalysisTarget(
         "serving_mixed_step", eng._mixed_greedy,
         (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active, q_lens,
-         temp, topp, seeds, table))
+         temp, topp, seeds, table), env=eng._lint_env)
 
 
 def _t_serving_tp_step() -> AnalysisTarget:
@@ -287,7 +339,7 @@ def _t_serving_tp_step() -> AnalysisTarget:
         "serving_tp_step", eng._mixed_greedy,
         (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active, q_lens,
          temp, topp, seeds, table),
-        analyze_kwargs={"min_gather_bytes": 1 << 16})
+        analyze_kwargs={"min_gather_bytes": 1 << 16}, env=eng._lint_env)
 
 
 TARGETS = {
@@ -321,9 +373,25 @@ def build(name: str) -> AnalysisTarget:
 
 
 def run(name: str, **overrides):
-    """Build and analyze one registered target."""
+    """Build and analyze one registered target (under its env pins — the
+    trace must see exactly the program the target declares)."""
     from . import analyze
 
     t = build(name)
     kwargs = {**t.analyze_kwargs, **overrides}
-    return analyze(t.fn, *t.args, target=t.name, **kwargs)
+    with _pinned_env(t.env):
+        return analyze(t.fn, *t.args, target=t.name, **kwargs)
+
+
+def run_card(name: str, **card_kwargs):
+    """Build one registered target and derive just its ProgramCard —
+    the cards-only path (``--cards`` CLI, the card-gate tier-1 test): no
+    lint rules, no perturbation re-traces; multi-device targets still pay
+    one compile for the collective-bytes attribution unless
+    ``compile_collectives=False``.  Runs under the target's env pins like
+    :func:`run`."""
+    from .cost_model import build_card
+
+    t = build(name)
+    with _pinned_env(t.env):
+        return build_card(t.fn, t.args, target=t.name, **card_kwargs)

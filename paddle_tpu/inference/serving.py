@@ -744,6 +744,11 @@ class ContinuousBatchingEngine:
 
     # ------------- tensor-parallel wrapping (docs/tp_serving.md) -----------
 
+    #: argnums every compiled step donates (cache_k, cache_v) — shared
+    #: between _jit_step and the static-telemetry trace, which rebuilds
+    #: the donation mask for an unjitted trace of the same program
+    _STEP_DONATE_ARGNUMS = (1, 2)
+
     def _jit_step(self, impl, n_rep: int, **statics):
         """jit one ``(params, cache_k, cache_v, *data[, poison=...])``
         compiled step with the standard cache donation.  Single-chip
@@ -752,9 +757,10 @@ class ContinuousBatchingEngine:
         shard_map (``_tp_shard``); ``n_rep`` is the number of leading
         replicated outputs before the two cache pools."""
         body = functools.partial(impl, **statics)
+        donate = self._STEP_DONATE_ARGNUMS
         if self.tp == 1:
-            return jax.jit(body, donate_argnums=(1, 2))
-        return jax.jit(self._tp_shard(body, n_rep), donate_argnums=(1, 2))
+            return jax.jit(body, donate_argnums=donate)
+        return jax.jit(self._tp_shard(body, n_rep), donate_argnums=donate)
 
     def _tp_shard(self, body, n_rep: int):
         """shard_map a compiled-step body over the 1-D ``("tp",)`` mesh.
@@ -2845,20 +2851,17 @@ class ContinuousBatchingEngine:
             fns += [self._mixed_greedy, self._mixed_sampling]
         return _n(*fns)
 
-    def decode_step_launches(self) -> dict:
-        """Static dispatch-tax telemetry for ONE greedy decode step: trace
-        the decode program (no compile, no device time) and count its
-        equations plus the per-layer launch-shaped primitives — every
-        ``pallas_call`` and every scatter (the KV appends).  The fused
-        decode step's win is visible here before any wall clock: the
-        unfused paged path traces 1 pallas_call + 2 scatters per layer
-        (plus the rope/gather glue XLA must fuse around them), the fused
-        path traces 1 pallas_call and 0 scatters — the bench rungs report
-        this dict as the launch-count detail (eqns inside the chunk scan's
-        per-step body count once, matching the per-layer dispatch they
-        model)."""
-        from ..analysis.rules import _sub_jaxprs
-
+    def _decode_step_trace(self):
+        """Trace ONE greedy decode step to a ClosedJaxpr (no compile, no
+        device time) under the CURRENT trace-time state (kill switches,
+        fused/flash config) — the shared substrate of the static
+        telemetry: :meth:`decode_step_launches` runs the launch census
+        over it and :meth:`decode_step_card` the full program card.
+        Returns ``(closed, donated)``: the impl is traced unjitted, so the
+        production program's cache donation (``_jit_step``'s
+        ``donate_argnums=(1, 2)``) is reconstructed as a per-leaf mask for
+        the card's peak-HBM pass — without it the KV pools would count
+        both as caller-held inputs and as fresh outputs."""
         B = self.max_batch
         zi = jnp.zeros((B,), jnp.int32)
         body = functools.partial(
@@ -2887,23 +2890,45 @@ class ContinuousBatchingEngine:
         finally:
             for n, v in saved.items():
                 setattr(_pa, n, v)
+        donated = tuple(i in self._STEP_DONATE_ARGNUMS
+                        for i, a in enumerate(args)
+                        for _ in jax.tree_util.tree_leaves(a))
+        return closed, donated
 
-        counts = {"eqns": 0, "pallas_calls": 0, "scatters": 0}
+    def decode_step_launches(self) -> dict:
+        """Static dispatch-tax telemetry for ONE greedy decode step: trace
+        the decode program and count its equations plus the per-layer
+        launch-shaped primitives — every ``pallas_call`` and every scatter
+        (the KV appends) — via the ONE census implementation the static
+        program card uses (``analysis.cost_model.eqn_census``; a parity
+        test pins static card == this telemetry).  The fused decode step's
+        win is visible here before any wall clock: the unfused paged path
+        traces 1 pallas_call + 2 scatters per layer (plus the rope/gather
+        glue XLA must fuse around them), the fused path traces 1
+        pallas_call and 0 scatters — the bench rungs report this dict as
+        the launch-count detail (eqns inside the chunk scan's per-step
+        body count once, matching the per-layer dispatch they model)."""
+        from ..analysis.cost_model import eqn_census
 
-        def walk(jx):
-            counts["eqns"] += len(jx.eqns)
-            for e in jx.eqns:
-                nm = e.primitive.name
-                if nm == "pallas_call":
-                    # a pallas_call is ONE launch however large its body:
-                    # do not descend (in-kernel eqns are not dispatches)
-                    counts["pallas_calls"] += 1
-                    continue
-                if nm.startswith("scatter"):
-                    counts["scatters"] += 1
-                for sub in _sub_jaxprs(e):
-                    walk(sub)
-
-        walk(closed.jaxpr)
+        closed, _ = self._decode_step_trace()
+        counts = eqn_census(closed)
         counts["fused_decode"] = bool(self._fused)
         return counts
+
+    def decode_step_card(self) -> dict:
+        """Static ProgramCard summary of ONE greedy decode step
+        (analysis/cost_model.py): peak live HBM, launch census, per-launch
+        VMEM fit — embedded by the cb bench rungs next to
+        ``decode_step_launches`` so a rung's detail carries the program's
+        static cost alongside its measured wall clock.  Trace-only, like
+        the launch telemetry; collective bytes are not compiled here (the
+        TP gate target owns that figure) and trace-family accounting lives
+        with ``n_traces()``."""
+        from ..analysis.cost_model import build_card
+
+        closed, donated = self._decode_step_trace()
+        card = build_card(None, (), target="decode_step", closed=closed,
+                          donated=donated, compile_collectives=False)
+        d = card.summary()
+        d["fused_decode"] = bool(self._fused)
+        return d

@@ -64,7 +64,13 @@ operator's one-knob way to fan an existing deployment across a mesh.
 Validated by :func:`env_tp`: a non-integer value, a degree that does not
 divide the model's kv_heads, or a degree exceeding the device count warns
 once — naming the valid divisors — and falls back to 1 (single chip), the
-same never-silently-misconfigure contract as the switches above.)
+same never-silently-misconfigure contract as the switches above.
+``PADDLE_TPU_VMEM_CAP_MIB`` is the integer override for the per-generation
+VMEM ceiling the program-card gate checks every Pallas launch against
+(analysis/cost_model.py, docs/analysis.md §"Program cards & budgets";
+default: the 16 MiB v4 floor from ``VMEM_CAPS``).  Parsed by
+:func:`env_int`: a non-integer or sub-minimum value warns once and keeps
+the default — a typo'd cap must not silently stop gating VMEM fits.)
 """
 
 from __future__ import annotations
@@ -74,7 +80,7 @@ import os
 import warnings
 
 __all__ = ["env_token_set", "env_bool", "env_fault_spec", "env_tp",
-           "BOOL_FLAGS"]
+           "env_int", "BOOL_FLAGS"]
 
 #: '0'/'1' switches -> their library defaults (documentation + test anchor;
 #: callers still pass the default explicitly at the read site so a flag read
@@ -135,6 +141,29 @@ def env_bool(name: str, default: bool) -> bool:
                f"{name}={raw!r} is not '0' or '1'; using the default "
                f"({'1' if default else '0'})")
     return default
+
+
+def env_int(name: str, default: int, minimum: int | None = None) -> int:
+    """Integer knob: '' -> default; a non-integer value, or one below
+    ``minimum``, warns once and falls back to the default — the same
+    never-silently-misconfigure contract as :func:`env_bool` (used by the
+    program-card gate's ``PADDLE_TPU_VMEM_CAP_MIB`` VMEM-cap override)."""
+    raw = os.environ.get(name, "")
+    if raw == "":
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        _warn_once(name, raw,
+                   f"{name}={raw!r} is not an integer; using the default "
+                   f"({default})")
+        return default
+    if minimum is not None and value < minimum:
+        _warn_once(name, raw,
+                   f"{name}={raw!r} is below the minimum ({minimum}); "
+                   f"using the default ({default})")
+        return default
+    return value
 
 
 def env_tp(kv_heads: int, device_count: int,

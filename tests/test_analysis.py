@@ -480,14 +480,18 @@ def test_engine_audit_env_typo_warns(monkeypatch):
 def test_lint_gate_over_registered_targets():
     """The gate itself, in-process: every registered target must be clean or
     fully allowlisted — this is the test that makes fast-path regressions
-    (f32 leak, dropped donation, cache churn, stray callback) fail tier-1."""
+    (f32 leak, dropped donation, cache churn, stray callback) fail tier-1.
+    Since ISSUE 12 the same pass derives every target's ProgramCard and
+    gates it against budgets.toml, and --strict-allowlist additionally
+    fails on packaged allowlist entries that suppress nothing (stale
+    pragmas; tests/test_program_cards.py covers the negatives)."""
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
         "lint_gate", os.path.join(REPO, "tools", "lint_gate.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    assert mod.main([]) == 0
+    assert mod.main(["--strict-allowlist"]) == 0
 
 
 @pytest.mark.slow  # subprocess pays a fresh ~30s paddle_tpu import; the

@@ -1,20 +1,37 @@
 #!/usr/bin/env python
-"""CI gate: run the jaxpr-level TPU lint over every registered target.
+"""CI gate: jaxpr-level TPU lint + static program-card budgets over every
+registered target.
 
-Exits 0 when every target is clean or fully allowlisted
-(``paddle_tpu/analysis/allowlist.toml``), nonzero otherwise — wired into the
-tier-1 suite (tests/test_analysis.py::test_lint_gate_over_registered_targets)
-so a change that knocks a train step or the serving hot path off the TPU
-fast path (f32 upcast, dropped donation, cache-key churn, a stray callback)
-fails the suite instead of surfacing as bench drift rounds later.
+Per target the gate runs the five lint rules AND derives the static
+ProgramCard (peak live HBM, launch census, collective bytes, VMEM fit,
+trace families — ``paddle_tpu/analysis/cost_model.py``) in one build/trace
+pass; cards are then checked against the reasoned per-target ceilings in
+``paddle_tpu/analysis/budgets.toml``.  Exits 0 when every target is clean
+(or fully allowlisted) AND within budget — wired into the tier-1 suite
+(tests/test_analysis.py::test_lint_gate_over_registered_targets,
+tests/test_program_cards.py::test_card_gate_over_registered_targets) so a
+change that knocks a hot path off the fast path (f32 upcast, dropped
+donation, cache-key churn, a stray callback) OR regresses its static cost
+(a scatter back on the fused decode path, peak HBM growth, a doubled trace
+family, an over-VMEM launch) fails the suite instead of surfacing as bench
+drift rounds later.
 
 Usage::
 
     JAX_PLATFORMS=cpu python tools/lint_gate.py [--verbose]
+        [--strict-allowlist] [--cards-only]
+        [--allowlist PATH] [--budgets PATH]
 
-Exit codes: 0 clean, 1 gating findings, 2 a target failed to build/trace
-(a broken target is a gate failure, not a skip — otherwise a refactor that
-renames a traced function silently turns the gate off).
+``--strict-allowlist`` turns stale allowlist entries (suppressions that
+matched NO finding across all targets — a reviewed-and-fixed leak whose
+pragma lingers) from a warning into a gate failure.  ``--cards-only``
+skips the lint rules and runs just the card/budget layer.  The PATH
+overrides exist for tests; CI runs the packaged files.
+
+Exit codes: 0 clean, 1 gating findings (lint, budget, or strict-stale),
+2 a target failed to build/trace (a broken target is a gate failure, not a
+skip — otherwise a refactor that renames a traced function silently turns
+the gate off).
 """
 
 from __future__ import annotations
@@ -23,32 +40,134 @@ import sys
 import traceback
 
 
+def _parse_argv(argv):
+    """Strict argparse flag parsing (no abbreviations): an unrecognized
+    token — a CI job typo like ``--strict_allowlist`` — exits 2 rather
+    than running the gate under the wrong configuration and reporting
+    success."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python tools/lint_gate.py", allow_abbrev=False,
+        description="CI gate: TPU lint + program-card budgets over every "
+                    "registered analysis target")
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.add_argument("--strict-allowlist", action="store_true",
+                   help="stale allowlist entries gate instead of warning")
+    p.add_argument("--cards-only", action="store_true",
+                   help="skip the lint rules; run just the card/budget gate")
+    p.add_argument("--allowlist", default=None, metavar="PATH")
+    p.add_argument("--budgets", default=None, metavar="PATH")
+    return p.parse_args(argv)
+
+
 def main(argv=None) -> int:
     """Pure gate logic: assumes paddle_tpu is importable and the backend is
     already configured (the ``__main__`` block does both for script use;
-    the in-process tier-1 test runs under conftest's CPU-forced config) —
+    the in-process tier-1 tests run under conftest's CPU-forced config) —
     no process-global mutation here, so an in-process caller's environment
     survives the gate."""
-    argv = sys.argv[1:] if argv is None else argv
-    verbose = "--verbose" in argv or "-v" in argv
+    args = _parse_argv(sys.argv[1:] if argv is None else list(argv))
+    verbose = args.verbose
+    strict_allowlist = args.strict_allowlist
+    cards_only = args.cards_only
+    allowlist_path = args.allowlist
+    budgets_path = args.budgets
 
-    from paddle_tpu.analysis.targets import GATE_TARGETS, run
+    if cards_only and strict_allowlist:
+        # the stale-allowlist sweep needs the lint reports the cards-only
+        # path never produces — accepting the combination would be a
+        # silent no-op reporting success under the wrong configuration
+        print("lint gate: --strict-allowlist requires the lint pass; "
+              "drop --cards-only", file=sys.stderr)
+        return 2
 
+    from paddle_tpu.analysis import load_allowlist
+    from paddle_tpu.analysis.cost_model import (check_budgets, gate_cards,
+                                                load_budgets)
+    from paddle_tpu.analysis.targets import (GATE_TARGETS, TARGETS, run,
+                                             run_card)
+
+    # load both config files BEFORE the (minutes-long) target loop: a
+    # typoed --allowlist/--budgets path or a malformed file must fail
+    # immediately with the documented exit contract, not as an uncaught
+    # traceback after all the work
+    try:
+        allowlist = load_allowlist(allowlist_path)
+        budgets = load_budgets(budgets_path)
+    except Exception as e:
+        print(f"lint gate: cannot load allowlist/budgets: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
     rc = 0
+    cards = {}
+    reports = []
     for name in GATE_TARGETS:
         try:
-            report = run(name)
+            if cards_only:
+                # the cards-only path IS targets.run_card (build + env
+                # pins + build_card) — one implementation, two gates
+                cards[name] = run_card(name)
+                continue
+            # targets.run applies the target's env pins + analyze_kwargs —
+            # the single implementation every gate entry point shares
+            report = run(name, card=True, allowlist=allowlist)
         except Exception:
             print(f"== {name}: FAILED to build/trace ==", file=sys.stderr)
             traceback.print_exc()
             rc = max(rc, 2)
             continue
+        reports.append(report)
+        if report.card is not None:
+            cards[name] = report.card
         print(report.render(verbose=verbose))
         if not report.ok:
             rc = max(rc, 1)
+
+    # --- program-card budget gate (cost_model.py, budgets.toml) ---------
+    if cards_only:
+        # the ONE cards-gate policy, shared with the --cards CLI (card
+        # findings pass the allowlist exactly like the full-gate path)
+        budget_findings = gate_cards(cards, budgets, allowlist=allowlist,
+                                     registered=TARGETS)
+    else:
+        # analyze() already folded card findings into each report
+        budget_findings = check_budgets(cards, budgets, registered=TARGETS)
+    for f in budget_findings:
+        print("  " + f.render() + (f"  <{f.target}>" if f.target else ""))
+        if f.severity != "info":
+            rc = max(rc, 1)
+
+    # --- stale-allowlist detection (suppressions covering nothing) ------
+    if rc >= 2:
+        # a target that failed to build produced no report: its live
+        # allowlist entries would be falsely reported stale with
+        # "delete the entry" advice — skip the sweep; the exit code
+        # already signals the broken gate
+        print("  (stale-allowlist sweep skipped: a target failed to "
+              "build, its suppressions cannot be attributed)")
+    elif not cards_only:
+        used = {id(a) for r in reports for _, a in r.allowlisted}
+        stale = [a for a in allowlist if id(a) not in used]
+        for a in stale:
+            line = (f"allowlist entry matched no finding across all "
+                    f"registered targets (rule={a.rule!r} "
+                    f"target={a.target!r} match={a.match!r}) — the "
+                    f"suppressed finding was fixed or renamed; delete the "
+                    f"entry (reason on file: {a.reason[:80]})")
+            if strict_allowlist:
+                print(f"  ERROR   stale_allowlist: {line}")
+                rc = max(rc, 1)
+            else:
+                print(f"  warning stale_allowlist: {line} "
+                      f"(gating under --strict-allowlist)")
+
     if rc == 1:
-        print("\nlint gate FAILED: fix the findings or allowlist them in "
-              "paddle_tpu/analysis/allowlist.toml (with a reason)",
+        print("\nlint gate FAILED: fix the findings, allowlist them in "
+              "paddle_tpu/analysis/allowlist.toml (with a reason), or — "
+              "for budget regressions you mean to keep — re-run "
+              "`python -m paddle_tpu.analysis --cards --update-budgets` "
+              "and justify the new ceilings in budgets.toml",
               file=sys.stderr)
     return rc
 
