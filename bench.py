@@ -691,6 +691,124 @@ def run_cb_prefix_rung(name, cfg, max_batch, n_requests, shared_len,
     }
 
 
+def _warm_tier_write(eng):
+    """Compile the host-KV-tier H2D pool write outside a rung's timed
+    window: one donated write per pool into a FREE page (whose content is
+    dead by definition).  Shared by the hosttier and fleet rungs so the
+    warm-up contract lives in one place."""
+    import jax.numpy as jnp
+
+    if getattr(eng, "_tier", None) is None or not eng._free:
+        return
+    L_, _nb, nkv_, bs_, hd_ = eng.cache_k.shape
+    z = jnp.zeros((L_, nkv_, bs_, hd_), eng.cfg.dtype)
+    d = jnp.asarray(eng._free[0], jnp.int32)
+    eng.cache_k = eng._tier_write(eng.cache_k, d, z)
+    eng.cache_v = eng._tier_write(eng.cache_v, d, z)
+
+
+def run_cb_hosttier_rung(name, cfg, max_batch, n_families, rounds,
+                         shared_len, unique_len, new, max_seq, chunk,
+                         num_blocks, tier_mib, tier=True, block_size=64,
+                         prefill_chunk=64):
+    """Hierarchical-KV A/B rung (ISSUE 13, docs/kv_tier.md): ``n_families``
+    distinct system prompts whose combined chains are ~4x the HBM pool
+    round-robin through a deliberately small cache — the regime where PR 2's
+    LRU constantly evicts.  With the host tier ON, evicted chains demote
+    D2H and re-admit on the next family revisit (H2D page restores driven
+    by the chunked-prefill cursor); OFF, every revisit is a full re-prefill.
+    Headline is tokens/s with TTFT and prefix hit-rate in detail — the tier
+    arm must beat the off arm on both (acceptance), because skipped prefill
+    compute moves time-to-first-token and frees the mixed step for decode
+    rows."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.inference.kv_tier import HostKVTier
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
+
+    log(f"cb hosttier rung {name}: building (slots={max_batch} "
+        f"families={n_families} x{rounds} shared={shared_len} "
+        f"blocks={num_blocks} tier={tier})")
+    rs = np.random.RandomState(0)
+    total = shared_len + unique_len
+    families = [rs.randint(0, cfg.vocab_size, (shared_len,)).astype(np.int32)
+                for _ in range(n_families)]
+    params = llama.init_params(cfg, jax.random.key(0))
+    host_tier = HostKVTier(budget_bytes=tier_mib << 20) if tier else None
+    eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
+                                   max_seq=max_seq, chunk=chunk, paged=True,
+                                   block_size=block_size,
+                                   num_blocks=num_blocks,
+                                   enable_prefix_caching=True,
+                                   enable_chunked_prefill=True,
+                                   prefill_chunk=prefill_chunk,
+                                   enable_host_kv_tier=tier,
+                                   host_tier=host_tier)
+    del params
+    t_c = time.perf_counter()
+    # warm every compiled program incl. the tier's H2D pool write, so no
+    # XLA compile lands inside the timed pressure window
+    eng.serve([Request(rid=-1, prompt_ids=rs.randint(
+        0, cfg.vocab_size, (total,)).astype(np.int32), max_new_tokens=2)])
+    _warm_tier_write(eng)
+    log(f"cb hosttier rung {name}: compile {time.perf_counter() - t_c:.1f}s")
+    eng.stats.update(decode_steps=0, decode_tokens=0, decode_time_s=0.0,
+                     prefix_hits=0, prefix_blocks_reused=0,
+                     prefix_evictions=0, cow_copies=0,
+                     prefill_tokens_computed=0, prefill_tokens_cached=0,
+                     tier_demotions=0, tier_readmits=0, tier_hits=0)
+    reqs = [Request(rid=r * n_families + f,
+                    prompt_ids=np.concatenate(
+                        [families[f], rs.randint(0, cfg.vocab_size,
+                                                 (unique_len,))
+                         .astype(np.int32)]),
+                    max_new_tokens=new)
+            for r in range(rounds) for f in range(n_families)]
+    t0 = time.perf_counter()
+    eng.serve(reqs)
+    wall = time.perf_counter() - t0
+    ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
+    computed = eng.stats["prefill_tokens_computed"]
+    cached = eng.stats["prefill_tokens_cached"]
+    bs_blocks = (shared_len // block_size) * n_families
+    return {
+        "metric": "llama_cb_decode_tokens_per_sec",
+        "value": round(eng.decode_tokens_per_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "detail": {"rung": name, "slots": max_batch,
+                   "requests": len(reqs), "families": n_families,
+                   "shared_prefix_tokens": shared_len,
+                   "prompt_tokens": total, "new_tokens": new,
+                   "wall_s": round(wall, 2), "chunk": chunk,
+                   "host_tier": tier, "tier_mib": tier_mib,
+                   "num_blocks": num_blocks,
+                   "working_set_blocks": bs_blocks,
+                   "cache_pressure_x": round(bs_blocks
+                                             / max(num_blocks, 1), 2),
+                   "ttft_mean_s": round(sum(ttfts) / len(ttfts), 4)
+                   if ttfts else None,
+                   "ttft_max_s": round(max(ttfts), 4) if ttfts else None,
+                   "prefix_hits": eng.stats["prefix_hits"],
+                   "prefix_evictions": eng.stats["prefix_evictions"],
+                   "prefill_tokens_computed": computed,
+                   "prefill_tokens_cached": cached,
+                   "prefill_hit_rate": round(cached / max(computed + cached,
+                                                          1), 4),
+                   "tier_hits": eng.stats["tier_hits"],
+                   "tier_readmits": eng.stats["tier_readmits"],
+                   "tier_demotions": eng.stats["tier_demotions"],
+                   "tier": (eng._tier.stats() if eng._tier is not None
+                            else None),
+                   "preemptions": eng.stats["preemptions"],
+                   "n_traces": eng.n_traces(),
+                   "backend": jax.default_backend(),
+                   **_obs_detail(eng)},
+    }
+
+
 def run_cb_spec_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq,
                      chunk, num_blocks, speculate=True, num_draft_tokens=4,
                      workload="hot", block_size=64):
@@ -903,6 +1021,35 @@ def decode_ladder_main(compact: bool = False) -> int:
             log(f"cb prefix rung {rung[0]} failed: {e}\n"
                 f"{traceback.format_exc()}")
             continue
+    # hierarchical-KV A/B (ISSUE 13, docs/kv_tier.md): 32 system-prompt
+    # families x 7 blocks = 224 chain blocks cycling through a 56-block
+    # pool (4x cache pressure) — the tier arm demotes evictions D2H and
+    # re-admits on revisit, the off arm re-prefills every time.  Headline
+    # tokens/s, acceptance reads TTFT + prefill_hit_rate in detail (tier
+    # must beat off on both).  tier_mib sized to hold the whole working
+    # set (224 blocks x ~1.5 MiB for full_cfg).  (rung tuple: cfg, slots,
+    # families, rounds, shared, unique, new, max_seq, chunk, num_blocks,
+    # tier_mib, tier[, block_size, prefill_chunk])
+    # (the smoke runs on BOTH arms — CI twin + cheap on-hardware sanity —
+    # so its exact waiter key banks from either backend, the fleet-smoke
+    # convention)
+    smoke_hosttier = ("cb_hosttier_cpu_smoke", llama.LlamaConfig.tiny(),
+                      2, 8, 2, 16, 8, 8, 64, 2, 10, 64, True, 8, 8)
+    hosttier_rungs = ([
+        ("cb_hosttier_pressure", full_cfg, 8, 32, 2, 448, 32, 32, 512, 8,
+         56, 768, True),
+        ("cb_hosttier_off", full_cfg, 8, 32, 2, 448, 32, 32, 512, 8,
+         56, 768, False),
+        smoke_hosttier,
+    ] if on_tpu else [smoke_hosttier])
+    for rung in hosttier_rungs:
+        try:
+            emit(run_cb_hosttier_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"cb hosttier rung {rung[0]} failed: {e}\n"
+                f"{traceback.format_exc()}")
+            continue
     # speculative-decoding A/B (ISSUE 4): self-similar prompts where the
     # prompt-lookup drafter hits (hot) vs i.i.d. prompts (cold, the overhead
     # bound), plus the SAME hot workload with speculation off — the matched
@@ -1049,11 +1196,24 @@ def decode_ladder_main(compact: bool = False) -> int:
                    "replica_crash@step=8,replica=1;"
                    "replica_stall@replica=2,count=4",
                    60.0, 60.0, 8)
+    # fleet host-tier arm (ISSUE 13): same chaos shape over a SMALLER
+    # per-replica pool (evictions guaranteed) with ONE shared host tier —
+    # affinity misses and the crash's failover replay re-admit demoted
+    # chains H2D; acceptance reads tier_cross_readmits > 0 in detail.
+    # Like the fleet smoke, the host-tier smoke runs on BOTH arms so its
+    # exact waiter key banks even when the TPU backend is flaky.
+    smoke_fleet_tier = ("cb_fleet_hosttier_cpu_smoke",
+                        llama.LlamaConfig.tiny(), 3, 2, 8, 20, 8, 64, 10,
+                        8, 4, 1, "replica_crash@step=8,replica=1",
+                        60.0, 60.0, 8, True)
     fleet_rungs = ([
         ("cb_fleet_chaos", full_cfg, 3, 8, 48, 96, 48, 512, 48, 64, 16, 2,
          "replica_crash@step=40,replica=1", 10.0, 2.0, 32),
+        ("cb_fleet_hosttier", full_cfg, 3, 8, 48, 96, 48, 512, 32, 64, 16,
+         2, "replica_crash@step=40,replica=1", 10.0, 2.0, 32, True),
         smoke_fleet,
-    ] if on_tpu else [smoke_fleet])
+        smoke_fleet_tier,
+    ] if on_tpu else [smoke_fleet, smoke_fleet_tier])
     for rung in fleet_rungs:
         try:
             emit(run_cb_fleet_rung(*rung))
@@ -1466,7 +1626,7 @@ def run_cb_overload_rung(name, cfg, max_batch, n_requests, prompt, new,
 def run_cb_fleet_rung(name, cfg, n_replicas, max_batch, n_requests, prompt,
                       new, max_seq, num_blocks, block_size, max_queue,
                       arrive_every, fault_spec, ttft_slo_s, tbt_slo_s,
-                      prefill_chunk=32):
+                      prefill_chunk=32, host_tier=False):
     """Fleet-serving rung (ISSUE 9, docs/fleet_serving.md): open-loop
     arrivals (one new request every ``arrive_every`` fleet steps,
     regardless of completions) over ``n_replicas`` full-feature replicas
@@ -1481,7 +1641,13 @@ def run_cb_fleet_rung(name, cfg, n_replicas, max_batch, n_requests, prompt,
     the tail out of the SLO window must show up in the headline, not hide
     in a raw-throughput number.  Router counters (routed_affinity /
     routed_spill / failovers / hedges / replayed_tokens / fleet_rejected),
-    per-replica engine stats and final health states ride in detail."""
+    per-replica engine stats and final health states ride in detail.
+
+    ``host_tier=True`` (ISSUE 13, docs/kv_tier.md) shares ONE host KV
+    tier across the replicas: affinity misses and failover replays
+    re-admit demoted chains H2D instead of re-prefilling, and the rung's
+    acceptance evidence is ``tier.cross_readmits > 0`` — a replica
+    restoring pages ANOTHER replica computed — riding in detail."""
     import os
 
     import numpy as np
@@ -1503,7 +1669,8 @@ def run_cb_fleet_rung(name, cfg, n_replicas, max_batch, n_requests, prompt,
                         enable_speculation=True,
                         enable_chunked_prefill=True,
                         prefill_chunk=min(prompt, prefill_chunk),
-                        max_queue=max_queue)
+                        max_queue=max_queue,
+                        enable_host_kv_tier=host_tier)
     del params
     # warm EVERY replica's compiled programs (each engine jits its own
     # partials): no XLA compile may land inside the timed chaos window
@@ -1512,6 +1679,7 @@ def run_cb_fleet_rung(name, cfg, n_replicas, max_batch, n_requests, prompt,
         eng.serve([Request(rid=-1 - r, prompt_ids=rs.randint(
             0, cfg.vocab_size, (prompt,)).astype(np.int32),
             max_new_tokens=2)])
+        _warm_tier_write(eng)
     log(f"cb fleet rung {name}: compile {time.perf_counter() - t_c:.1f}s")
     for eng in fleet.replicas:
         for key in ("decode_steps", "decode_tokens", "prefills",
@@ -1611,6 +1779,7 @@ def run_cb_fleet_rung(name, cfg, n_replicas, max_batch, n_requests, prompt,
             "decode_tokens": eng.stats["decode_tokens"],
             "preemptions": eng.stats["preemptions"],
             "prefix_hits": eng.stats["prefix_hits"],
+            "tier_readmits": eng.stats["tier_readmits"],
             "n_traces": eng.n_traces(),
         } for eng in fleet.replicas]
     return {
@@ -1638,6 +1807,12 @@ def run_cb_fleet_rung(name, cfg, n_replicas, max_batch, n_requests, prompt,
                    "fleet_rejected": fleet.stats["fleet_rejected"],
                    "health": list(fleet.health),
                    "replicas": replica_detail,
+                   "host_tier": host_tier,
+                   "tier": (fleet.host_tier.stats()
+                            if fleet.host_tier is not None else None),
+                   "tier_cross_readmits": (fleet.host_tier.cross_readmits
+                                           if fleet.host_tier is not None
+                                           else None),
                    "slo_tracker": slo_report,
                    "chrome_trace": trace_path,
                    "flight_dumps": ([d["reason"]

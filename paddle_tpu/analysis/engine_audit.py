@@ -51,6 +51,25 @@ I8  terminal ownership (docs/fault_tolerance.md) — a request in a terminal
     violation means a failed request's pages leaked or a zombie is still
     being scheduled.
 
+I10 hierarchical-KV tier (docs/kv_tier.md; engines with a host tier
+    attached) — every cached block is in exactly one of {HBM pool, host
+    tier, dead}: demotion MOVES a block D2H (the victim leaves the prefix
+    cache as its page ships) and re-admission moves it back, so a
+    **private** tier never holds a hash that is simultaneously resident
+    in the engine's prefix cache (a **shared** fleet tier deliberately
+    relaxes this to per-replica accounting: replica A's demoted copy may
+    coexist with replica B's HBM-resident one — byte-identical by the
+    content-address contract — so the exclusivity clause is skipped and
+    the remaining clauses carry the invariant).  Tier accounting must
+    close exactly: every entry is keyed by its own hash, byte usage sums
+    to ``used_bytes`` within the budget, pins are non-negative, and every
+    hash in a slot's pending match-to-restore plan is still tier-resident
+    (pins protect the match-to-restore window; only a ``tier_drop``
+    injection may break it, and that seam drops the plan atomically).
+    "Dead" is the explicit third state: a block in neither structure —
+    the tier refused it (budget) or LRU-dropped it — which is exactly the
+    pre-tier eviction, never an accounting hole.
+
 I9  fleet ownership (docs/fleet_serving.md; :func:`audit_fleet`, run by the
     FleetRouter after every fleet step) — every LIVE fleet rid is owned by
     exactly one replica: the owner is alive (not DEAD) and holds a
@@ -72,7 +91,7 @@ from __future__ import annotations
 from ..utils.envflags import env_bool
 
 __all__ = ["EngineAuditError", "audit_engine", "audit_fleet",
-           "audit_enabled"]
+           "audit_tier", "audit_enabled"]
 
 
 class EngineAuditError(AssertionError):
@@ -305,6 +324,72 @@ def audit_engine(eng) -> None:
                             f"parent {str(e.parent)[:8]} != previous "
                             f"{str(parent)[:8]}")
             parent = h
+
+    # I10: hierarchical-KV tier (docs/kv_tier.md) — block in exactly one
+    # of {HBM pool, host tier, dead}
+    tier = getattr(eng, "_tier", None)
+    if tier is not None:
+        audit_tier(tier)
+        if not tier.shared:
+            # private tier: strict move semantics — demotion removes the
+            # hash from the prefix cache as its page ships D2H, and
+            # re-admission removes the tier entry as the page comes back.
+            # (A fleet-shared tier relaxes this: another replica's
+            # demotion may coexist with this replica's HBM residency.)
+            both = set(by_hash) & set(tier._by_hash)
+            if both:
+                _fail("I10", f"block(s) {sorted(h[:8] for h in both)} "
+                             f"resident in BOTH the HBM prefix cache and "
+                             f"the private host tier — demote/re-admit "
+                             f"must MOVE a block, never fork it")
+        for s in range(B):
+            plan = getattr(eng, "_tier_plan", None)
+            if plan is None:
+                break
+            for b, h, _p in plan[s]:
+                if eng._slot_req[s] is None:
+                    _fail("I10", f"slot {s} holds a tier-restore plan "
+                                 f"with no request seated (plan leak: "
+                                 f"its pins would starve the tier LRU)")
+                if h not in tier._by_hash and h not in by_hash:
+                    _fail("I10", f"slot {s} plans to restore block "
+                                 f"{h[:8]} which is resident in neither "
+                                 f"the tier nor the HBM cache (the pin "
+                                 f"window broke: only a tier_drop "
+                                 f"injection may discard a pinned entry, "
+                                 f"and that seam drops the plan "
+                                 f"atomically)")
+
+
+def audit_tier(tier) -> None:
+    """I10's tier-internal half (docs/kv_tier.md): cross-check a
+    :class:`~paddle_tpu.inference.kv_tier.HostKVTier`'s byte accounting
+    and entry bookkeeping.  Every entry must be keyed by its own hash,
+    entry bytes must sum exactly to ``used_bytes`` within the budget, and
+    pins must be non-negative — a mismatch means demote/re-admit/evict
+    bookkeeping corrupted the store (the failure class that silently
+    serves one prompt's KV bytes to another).  Raises
+    :class:`EngineAuditError` on the first violation."""
+    total = 0
+    for h, e in tier._by_hash.items():
+        if e.hash != h:
+            _fail("I10", f"tier entry keyed {h[:8]} carries hash "
+                         f"{e.hash[:8]} (content address forged: ship_in "
+                         f"would restore the wrong bytes)")
+        if e.pins < 0:
+            _fail("I10", f"tier entry {h[:8]} has negative pin count "
+                         f"{e.pins} (unbalanced pin/unpin)")
+        if e.nbytes <= 0:
+            _fail("I10", f"tier entry {h[:8]} accounts {e.nbytes} bytes "
+                         f"(empty payload)")
+        total += e.nbytes
+    if total != tier.used_bytes:
+        _fail("I10", f"tier byte accounting does not close: entries sum "
+                     f"to {total} but used_bytes={tier.used_bytes}")
+    if tier.used_bytes > tier.budget_bytes:
+        _fail("I10", f"tier over budget: used_bytes={tier.used_bytes} > "
+                     f"budget_bytes={tier.budget_bytes} (eviction must "
+                     f"run BEFORE insert, never after)")
 
 
 def audit_fleet(router) -> None:

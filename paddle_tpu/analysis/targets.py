@@ -299,6 +299,28 @@ def _t_serving_mixed_step() -> AnalysisTarget:
          temp, topp, seeds, table), env=eng._lint_env)
 
 
+def _t_serving_tier_restore() -> AnalysisTarget:
+    import jax.numpy as jnp
+
+    # the host-KV-tier re-admit program (ISSUE 13, docs/kv_tier.md): the
+    # donated H2D pool write ship_in dispatches per restored page.  The
+    # gate pins its shape — ONE in-place dynamic-update per pool, no
+    # callbacks: the H2D itself happens OUTSIDE jit (jnp.asarray on the
+    # host payload), so the compiled program must stay host_sync-clean,
+    # and a device-to-host sync sneaking into the restore hot path is
+    # exactly the regression this target exists to catch.
+    eng = _serving_engine(
+        _force_flags=("PADDLE_TPU_PREFIX_CACHE", "PADDLE_TPU_HOST_KV_TIER"),
+        enable_prefix_caching=True, enable_host_kv_tier=True)
+    assert eng._tier is not None, "tier target must build the tier engine"
+    L, _nb, nkv, bs, hd = eng.cache_k.shape
+    page = jnp.zeros((L, nkv, bs, hd), eng.cfg.dtype)
+    dst = jnp.asarray(0, jnp.int32)
+    return AnalysisTarget(
+        "serving_tier_restore", eng._tier_write,
+        (eng.cache_k, dst, page), env=eng._lint_env)
+
+
 def _t_serving_tp_step() -> AnalysisTarget:
     import jax
     import jax.numpy as jnp
@@ -350,6 +372,7 @@ TARGETS = {
     "serving_prefill_step": _t_serving_prefill_step,
     "serving_verify_step": _t_serving_verify_step,
     "serving_mixed_step": _t_serving_mixed_step,
+    "serving_tier_restore": _t_serving_tier_restore,
     "serving_tp_step": _t_serving_tp_step,
 }
 
@@ -359,7 +382,8 @@ TARGETS = {
 GATE_TARGETS = ("llama_train_step", "moe_llama_train_step",
                 "serving_decode_step", "serving_flash_decode_step",
                 "serving_prefill_step", "serving_verify_step",
-                "serving_mixed_step", "serving_tp_step")
+                "serving_mixed_step", "serving_tier_restore",
+                "serving_tp_step")
 
 
 def build(name: str) -> AnalysisTarget:

@@ -44,6 +44,7 @@ __all__ = [
     "MetricsRegistry",
     "SLOTracker",
     "FlightRecorder",
+    "HostKVTier",
 ]
 
 
@@ -492,4 +493,8 @@ def __getattr__(name):
         from . import observability
 
         return getattr(observability, name)
+    if name == "HostKVTier":
+        from .kv_tier import HostKVTier
+
+        return HostKVTier
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

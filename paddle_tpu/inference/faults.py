@@ -28,6 +28,12 @@ plus two host-side seams that exercise per-request isolation:
 * ``cache_error`` — raises inside prefix-cache block registration; the
   graceful engine degrades (the block stays private, a future request
   misses where it could have hit) without failing any request;
+* ``tier_drop`` — a host-KV-tier entry vanishes between the admission's
+  tier match and the ship_in restore (docs/kv_tier.md): the poll fires at
+  the restore seam and force-discards the entry (pins ignored — exactly
+  what a lost host buffer looks like), so the engine must fall back to
+  ordinary prefill compute for the remaining blocks, never hang or
+  corrupt — token streams are identical either way;
 
 and — ISSUE 9, docs/fleet_serving.md — three REPLICA-scoped kinds the
 :class:`~paddle_tpu.inference.fleet.FleetRouter` polls once per replica per
@@ -85,7 +91,7 @@ class FaultInjected(RuntimeError):
 
 #: fault kinds the engine polls for (the env_fault_spec vocabulary)
 KNOWN_KINDS = frozenset({"alloc_fail", "kernel_error", "nan_logits",
-                         "slot_error", "cache_error"})
+                         "slot_error", "cache_error", "tier_drop"})
 
 #: fleet-tier fault kinds the FleetRouter polls for (ISSUE 9); rejected by
 #: the engine's own parse — a replica-scoped clause with no fleet running

@@ -68,11 +68,21 @@ injector (scope one with ``replica=k``); a replica-scoped clause with NO
 fleet running is rejected by the engine's parse (warn once, injection
 disabled) instead of being a silent no-op.
 
-Non-goals (docs/fleet_serving.md): the router does not move KV bytes
-between replicas (failover recomputes — exact, and cheap next to losing
-the stream), does not rebalance running work (only failure moves it), and
-trusts one process's clock (it is an in-process fleet — the distributed-
-systems problems it models are scheduling ones, not Byzantine ones).
+With ``enable_host_kv_tier=True`` (ISSUE 13, docs/kv_tier.md) the fleet
+shares ONE :class:`~paddle_tpu.inference.kv_tier.HostKVTier` across its
+replicas — the fleet-wide prefix store.  Chain hashes are already the
+routing key, so a chain any replica computed and demoted is re-admittable
+by every other replica: affinity misses stop being full prefills, and
+failover replay restores the dead replica's demoted chains page-by-page
+through the ordinary tier-extended admission (O(pages shipped) for the
+covered prefix; only the uncovered tail is teacher-forced).
+
+Non-goals (docs/fleet_serving.md): the router does not move *live* KV
+bytes between replicas (failover replays the journal; the shared host
+tier moves only content-addressed finished pages), does not rebalance
+running work (only failure moves it), and trusts one process's clock (it
+is an in-process fleet — the distributed-systems problems it models are
+scheduling ones, not Byzantine ones).
 
 Audited invariant **I9** (``PADDLE_TPU_ENGINE_AUDIT=1``,
 analysis/engine_audit.audit_fleet): every live rid is owned by exactly one
@@ -159,6 +169,35 @@ class FleetRouter:
         # The router owns the replica label — a caller-provided label set
         # would collapse N replicas onto one labelled series.
         engine_kw.pop("metrics_labels", None)
+        # hierarchical KV (ISSUE 13, docs/kv_tier.md): the fleet shares
+        # ONE host tier across its replicas — chain hashes are already
+        # the routing key, so a chain ANY replica computed and demoted is
+        # re-admittable by every other replica (affinity misses stop
+        # being full prefills, and adopt() failover restores the dead
+        # replica's demoted chains in O(pages shipped) instead of
+        # teacher-forced recompute).  shared=True switches ship_in to
+        # keep-resident semantics and relaxes the I10 exclusivity check
+        # to per-replica accounting (content-addressed duplicates across
+        # replicas are byte-identical by construction).
+        from ..utils.envflags import env_bool as _env_bool
+
+        self.host_tier = engine_kw.pop("host_tier", None)
+        if not _env_bool("PADDLE_TPU_HOST_KV_TIER", True):
+            # the kill switch neutralizes the fleet tier TOTALLY — even an
+            # explicitly-passed tier object is dropped (and left
+            # unmutated), so `router.host_tier is None` is a truthful
+            # "tier off" signal and the bench detail never presents a
+            # live-but-idle store in a kill-switched run (the engines
+            # would each disable it anyway)
+            self.host_tier = None
+        elif self.host_tier is not None:
+            self.host_tier.shared = True
+        elif engine_kw.get("enable_host_kv_tier"):
+            from .kv_tier import HostKVTier
+
+            self.host_tier = HostKVTier(shared=True)
+        if self.host_tier is not None:
+            engine_kw["host_tier"] = self.host_tier
         # the engines must NOT parse a fleet chaos spec themselves: a
         # replica-scoped clause would (correctly) disable their whole plan
         # with a warning.  The router parses once with the full vocabulary

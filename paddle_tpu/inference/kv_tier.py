@@ -1,0 +1,303 @@
+"""Hierarchical KV: the host-RAM spill tier behind the prefix cache
+(ISSUE 13 tentpole; docs/kv_tier.md; ROADMAP item 2).
+
+Prefix-cache capacity used to be hard-capped at leftover HBM: LRU eviction
+(PR 2) *freed* zero-ref chains, so every evicted system prompt was a full
+re-prefill, and PR 8's failover recomputed KV teacher-forced because
+finished pages could not move between replicas.  :class:`HostKVTier` is the
+missing tier — a host-memory page store keyed by the prefix cache's
+content-address hash chain, holding demoted KV pages under a byte budget
+(``PADDLE_TPU_HOST_TIER_MIB``) with its own LRU.  Host RAM is roughly an
+order of magnitude larger than leftover HBM per chip, so the set of
+resident system prompts scales with the host, not the accelerator.
+
+The transport contract (the piece ROADMAP item 1's disaggregated
+prefill/decode shipping consumes unchanged):
+
+* :meth:`ship_out` — device -> host.  One **page** (one pool block's K and
+  V slabs, ``[L, nkv, block_size, hd]`` each — every layer's bytes for
+  that block, the unit the block table addresses) moves D2H under its
+  chain hash.  Quantized pools ship their per-page scales alongside the
+  payload (``k_scale``/``v_scale``), so a dequant-on-read pool stays
+  byte-exact through the round trip.  Content-addressed: shipping a hash
+  the tier already holds refreshes recency and returns the existing entry
+  (identical bytes by the hash-chain contract — the vLLM trade PR 2
+  documents).
+* :meth:`ship_in` — host -> device.  Looks the hash up, refreshes recency
+  and returns the entry whose host arrays the caller uploads (the engine
+  dispatches them through a donated jitted pool write, so the H2D overlaps
+  the next compiled step by JAX async dispatch).  A **private** tier
+  (single engine) removes the entry — demotion *moves* a block D2H and
+  re-admission moves it back, the exactly-one-home contract audit
+  invariant I10 checks; a **shared** tier (``shared=True``, the
+  :class:`~paddle_tpu.inference.fleet.FleetRouter`'s fleet-wide prefix
+  store) keeps it, because the same chain must stay re-admittable by every
+  other replica (content-addressed duplicates across replicas are
+  byte-identical by construction, so exclusivity deliberately relaxes —
+  docs/kv_tier.md "I10").
+
+Eviction is plain LRU over unpinned entries, byte-accounted: an insert
+that would exceed the budget evicts least-recently-used entries first and
+refuses (returns None — the block goes *dead*, exactly what the
+pre-tier engine did on every eviction) when even that cannot fit the
+page.  :meth:`pin`/:meth:`unpin` protect entries an engine has matched
+but not yet restored (the chunked-prefill cursor restores one block per
+mixed step, so a match-to-restore window spans steps); ``discard``
+force-drops an entry regardless of pins — the ``tier_drop`` fault
+injection seam (inference/faults.py), which the engine must survive by
+falling back to ordinary prefill.
+
+Everything here is host-side bookkeeping plus numpy buffers; no JAX in
+this module.  The device halves of the transport (the D2H gather, the
+donated H2D pool write) live with the engine, which owns the pools.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+import numpy as np
+
+__all__ = ["HostKVTier", "TierEntry", "DEFAULT_TIER_MIB"]
+
+#: default byte budget (MiB) when ``PADDLE_TPU_HOST_TIER_MIB`` is unset —
+#: small enough for CI hosts, an order of magnitude beyond the test pools
+DEFAULT_TIER_MIB = 256
+
+
+def _tier_budget_bytes() -> int:
+    """Parse ``PADDLE_TPU_HOST_TIER_MIB`` (validated: a non-integer or
+    sub-1 value warns once and keeps the default — utils/envflags.env_int,
+    the same never-silently-misconfigure contract as every other
+    PADDLE_TPU_* knob)."""
+    from ..utils.envflags import env_int
+
+    return env_int("PADDLE_TPU_HOST_TIER_MIB", DEFAULT_TIER_MIB,
+                   minimum=1) * (1 << 20)
+
+
+class TierEntry:
+    """One demoted page: host copies of a block's K and V slabs (plus
+    per-page quant scales when the pool is dequant-on-read), keyed by the
+    block's chain hash.  ``owner`` records the last demoter (the replica
+    label) so a shared tier can count cross-replica re-admits."""
+
+    __slots__ = ("hash", "k", "v", "k_scale", "v_scale", "nbytes", "pins",
+                 "last_used", "owner")
+
+    def __init__(self, hash_: str, k: np.ndarray, v: np.ndarray,
+                 k_scale: np.ndarray | None, v_scale: np.ndarray | None,
+                 owner=None):
+        self.hash = hash_
+        # ascontiguousarray, not asarray: the engine demotes a BATCH of
+        # pages with one gathered D2H and hands this ctor per-page numpy
+        # VIEWS of the slab — storing the view would pin the entire batch
+        # slab in host RAM per entry while nbytes counts only the slice,
+        # silently unbounding the byte budget.  A contiguous copy owns
+        # exactly the bytes it accounts (no-op for already-owned arrays).
+        self.k = np.ascontiguousarray(k)
+        self.v = np.ascontiguousarray(v)
+        self.k_scale = (None if k_scale is None
+                        else np.ascontiguousarray(k_scale))
+        self.v_scale = (None if v_scale is None
+                        else np.ascontiguousarray(v_scale))
+        self.nbytes = int(self.k.nbytes + self.v.nbytes
+                          + (self.k_scale.nbytes
+                             if self.k_scale is not None else 0)
+                          + (self.v_scale.nbytes
+                             if self.v_scale is not None else 0))
+        self.pins = 0
+        self.last_used = 0
+        self.owner = owner
+
+    def __repr__(self):  # debugging aid only
+        return (f"TierEntry({self.hash[:8]}, {self.nbytes}B, "
+                f"pins={self.pins})")
+
+
+class HostKVTier:
+    """Byte-budgeted host-RAM page store keyed by chain hash (module
+    docstring; docs/kv_tier.md).
+
+    ``budget_bytes``: LRU ceiling; ``None`` reads
+    ``PADDLE_TPU_HOST_TIER_MIB`` (default :data:`DEFAULT_TIER_MIB`).
+    ``shared=True`` marks the fleet-wide prefix store: :meth:`ship_in`
+    keeps the entry resident so other replicas can still re-admit it, and
+    the I10 audit relaxes HBM/tier exclusivity to per-replica accounting
+    (a private tier enforces strict move semantics).
+
+    Counters (host-side, read by the engines' stats mirrors and the bench
+    rungs): ``demotions`` / ``readmits`` / ``cross_readmits`` (shared tier:
+    re-admits of a chain a *different* replica demoted) / ``evictions``
+    (budget-pressure LRU drops) / ``drops`` (ship_out refusals: the block
+    went dead because even an empty-but-pinned tier could not fit it)."""
+
+    def __init__(self, budget_bytes: int | None = None,
+                 shared: bool = False):
+        self.budget_bytes = (int(budget_bytes) if budget_bytes is not None
+                             else _tier_budget_bytes())
+        if self.budget_bytes < 1:
+            raise ValueError(
+                f"budget_bytes must be >= 1, got {self.budget_bytes}")
+        self.shared = bool(shared)
+        self._by_hash: dict[str, TierEntry] = {}
+        self.used_bytes = 0
+        self._tick = 0
+        # lazy min-heap of (last_used, hash): stale records (re-touched,
+        # pinned, already gone) are skipped on pop — same amortized-O(log n)
+        # pattern as the prefix cache's eviction heap
+        self._lru_heap: list[tuple[int, str]] = []
+        self.demotions = 0
+        self.readmits = 0
+        self.cross_readmits = 0
+        self.evictions = 0
+        self.drops = 0
+
+    # ---------------- internals ----------------
+
+    def _touch(self, e: TierEntry) -> None:
+        self._tick += 1
+        e.last_used = self._tick
+        heapq.heappush(self._lru_heap, (e.last_used, e.hash))
+
+    def _remove(self, e: TierEntry) -> None:
+        del self._by_hash[e.hash]
+        self.used_bytes -= e.nbytes
+
+    def _evict_for(self, need: int) -> bool:
+        """Pop LRU unpinned entries until ``need`` bytes fit under the
+        budget; False when they cannot (everything left is pinned)."""
+        while self.used_bytes + need > self.budget_bytes:
+            evicted = False
+            while self._lru_heap:
+                tick, h = heapq.heappop(self._lru_heap)
+                victim = self._by_hash.get(h)
+                if (victim is None or victim.last_used != tick
+                        or victim.pins > 0):
+                    continue            # stale heap record / pinned
+                self._remove(victim)
+                self.evictions += 1
+                evicted = True
+                break
+            if not evicted:
+                return False
+        return True
+
+    # ---------------- transport (the ROADMAP item 1 contract) ----------
+
+    def ship_out(self, hash_: str, k_page, v_page, *, k_scale=None,
+                 v_scale=None, owner=None) -> TierEntry | None:
+        """Device -> host: demote one page under its chain hash.  Arrays
+        are materialized to host numpy (``np.asarray`` on a device array IS
+        the D2H copy); quantized pools pass their per-page scales so the
+        round trip is byte-exact.  Returns the resident entry, or None
+        when the page cannot fit even after LRU eviction (the block is
+        dead — the caller frees the device page exactly as the pre-tier
+        engine did).  Shipping an already-resident hash refreshes recency
+        and returns the existing entry (content-addressed dedup: the chain
+        hash IS a digest of the bytes)."""
+        e = self._by_hash.get(hash_)
+        if e is not None:
+            # content-addressed dedup: identical bytes by the chain-hash
+            # contract — refresh recency, RE-STAMP the owner (the contract
+            # is "last demoter", and a stale owner would make the new
+            # demoter's own later re-admit count as cross-replica), and
+            # count the demotion event so the tier's counter agrees with
+            # the engines' per-demotion stats mirrors
+            e.owner = owner
+            self.demotions += 1
+            self._touch(e)
+            return e
+        e = TierEntry(hash_,
+                      np.asarray(k_page), np.asarray(v_page),
+                      None if k_scale is None else np.asarray(k_scale),
+                      None if v_scale is None else np.asarray(v_scale),
+                      owner=owner)
+        if not self._evict_for(e.nbytes):
+            self.drops += 1
+            return None
+        self._by_hash[hash_] = e
+        self.used_bytes += e.nbytes
+        self.demotions += 1
+        self._touch(e)
+        return e
+
+    def ship_in(self, hash_: str, *, owner=None,
+                keep: bool | None = None) -> TierEntry | None:
+        """Host -> device half: look one page up for re-admission.  The
+        caller uploads ``entry.k``/``entry.v`` (and scales) through its own
+        donated pool write — the tier never touches a device.  Returns
+        None on a miss (evicted, or a ``tier_drop`` injection discarded
+        it): the caller MUST fall back to ordinary prefill, never hang.
+
+        ``keep`` defaults to ``self.shared``: a private tier removes the
+        entry (move semantics — the exactly-one-home half of invariant
+        I10), a shared tier keeps it resident so every other replica can
+        still re-admit the same chain."""
+        e = self._by_hash.get(hash_)
+        if e is None:
+            return None
+        self.readmits += 1
+        if (self.shared and e.owner is not None and owner is not None
+                and e.owner != owner):
+            self.cross_readmits += 1
+        if keep is None:
+            keep = self.shared
+        if keep:
+            self._touch(e)
+        else:
+            self._remove(e)
+        return e
+
+    # ---------------- pinning / invalidation ----------------
+
+    def pin(self, hash_: str) -> bool:
+        """Protect an entry from LRU eviction while an engine holds a
+        match-to-restore plan over it (the chunked cursor paces restores
+        by the step token budget, so a long plan's window spans many
+        steps).  False on a miss."""
+        e = self._by_hash.get(hash_)
+        if e is None:
+            return False
+        e.pins += 1
+        return True
+
+    def unpin(self, hash_: str) -> None:
+        e = self._by_hash.get(hash_)
+        if e is not None and e.pins > 0:
+            e.pins -= 1
+            if e.pins == 0:
+                # re-enter the LRU race at current recency
+                self._touch(e)
+
+    def discard(self, hash_: str) -> bool:
+        """Force-drop an entry regardless of pins — the ``tier_drop``
+        fault seam (a tier entry vanishing between match and ship_in) and
+        the private-tier dedup when an engine re-computes a block fresh.
+        True when something was removed."""
+        e = self._by_hash.get(hash_)
+        if e is None:
+            return False
+        self._remove(e)
+        return True
+
+    # ---------------- introspection ----------------
+
+    def __contains__(self, hash_: str) -> bool:
+        return hash_ in self._by_hash
+
+    def __len__(self) -> int:
+        return len(self._by_hash)
+
+    def stats(self) -> dict:
+        """Host-side counter snapshot (bench rung detail)."""
+        return {
+            "entries": len(self._by_hash),
+            "used_bytes": int(self.used_bytes),
+            "budget_bytes": int(self.budget_bytes),
+            "demotions": int(self.demotions),
+            "readmits": int(self.readmits),
+            "cross_readmits": int(self.cross_readmits),
+            "evictions": int(self.evictions),
+            "drops": int(self.drops),
+        }

@@ -313,6 +313,17 @@ ENGINE_STAT_SCHEMA = {
                                    "quarantines"),
     "kernel_error_retries": ("counter", "Kernel-dispatch faults retried "
                                         "with state untouched"),
+    "tier_demotions": ("counter", "Evicted prefix-cache blocks shipped "
+                                  "D2H into the host KV tier"),
+    "tier_readmits": ("counter", "Tier blocks restored H2D into the pool "
+                                 "(prefill compute skipped)"),
+    "tier_hits": ("counter", "Admissions whose prefix match extended "
+                             "through the host tier"),
+    "tier_evictions": ("counter", "Tier entries LRU-dropped under the "
+                                  "byte budget (mirrors the possibly "
+                                  "fleet-shared tier's global counter)"),
+    "tier_bytes": ("gauge", "Host KV tier bytes resident (mirrors the "
+                            "possibly fleet-shared tier's global gauge)"),
 }
 
 #: fleet router ``stats`` keys -> (metric kind, help); same contract.

@@ -198,14 +198,21 @@ class PrefixCache:
         incrementally — the engine calls this per admission attempt."""
         return self._n_zero_ref
 
-    def evict(self, n: int) -> list[int]:
+    def evict(self, n: int) -> list[tuple[str, int]]:
         """Reclaim up to ``n`` pages, least-recently-used zero-ref leaves
         first.  Pops the lazy heap, skipping stale records (re-acquired,
         re-parented, already evicted, or superseded by a fresher tick);
         evicting a leaf may turn its parent into a leaf, which is pushed
-        immediately so chains drain oldest-first without any index scan."""
-        pages: list[int] = []
-        while len(pages) < n and self._evict_heap:
+        immediately so chains drain oldest-first without any index scan.
+
+        Returns ``(hash, page)`` pairs, NOT bare page ids: the hash is the
+        victim's content address, which a demotion consumer — the host KV
+        tier (inference/kv_tier.py) ships each victim's page D2H under its
+        chain hash before the engine recycles the page — needs to keep the
+        block re-admittable.  (Bare ids silently dropped the hash, making
+        every eviction an unconditional kill.)"""
+        pairs: list[tuple[str, int]] = []
+        while len(pairs) < n and self._evict_heap:
             tick, h = heapq.heappop(self._evict_heap)
             victim = self._by_hash.get(h)
             if (victim is None or victim.refcount != 0
@@ -220,8 +227,8 @@ class PrefixCache:
                     if pe.children == 0 and pe.refcount == 0:
                         heapq.heappush(self._evict_heap,
                                        (pe.last_used, pe.hash))
-            pages.append(victim.page)
-        return pages
+            pairs.append((victim.hash, victim.page))
+        return pairs
 
     # ---------------- accounting / introspection ----------------
 

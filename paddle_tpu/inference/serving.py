@@ -66,6 +66,20 @@ kernel reads shared pages unchanged — sharing is purely block-table aliasing.
 Opt-out: ``PADDLE_TPU_PREFIX_CACHE=0``; with caching off (the default) the
 engine is byte-identical to the PR 1 engine.
 
+``enable_host_kv_tier=True`` (paged + prefix-cache only) layers the
+hierarchical-KV host tier under the cache (kv_tier.py, docs/kv_tier.md):
+LRU eviction DEMOTES zero-ref chains to a byte-budgeted host-RAM page
+store (``PADDLE_TPU_HOST_TIER_MIB``) instead of freeing them, and
+admission's prefix match extends through that tier — a tier hit re-admits
+pages by async H2D copy driven by the chunked-prefill cursor, so
+"restoring from host" is scheduled exactly like "prefilling" (one cursor,
+zero new compiled step shapes, chunk-granular preemption/cancel compose
+for free).  Resident-prefix capacity then scales with host RAM rather
+than leftover HBM, and the same ``ship_out``/``ship_in`` page transport
+is the fleet tier's shared prefix store and ROADMAP item 1's
+prefill/decode shipping primitive.  Opt-out: ``PADDLE_TPU_HOST_KV_TIER=0``
+restores the pre-tier engine byte-identically.
+
 ``enable_speculation=True`` (paged mode only) adds draft-model-free
 speculative decoding (speculative.py, docs/speculative.md; reference: the
 ``speculate_*`` op family in paddle/phi/ops/yaml): a host-side prompt-lookup
@@ -300,6 +314,7 @@ class ContinuousBatchingEngine:
                  spec_ngram: int = 3, enable_chunked_prefill: bool = False,
                  prefill_chunk: int = 128, token_budget: int | None = None,
                  max_queue: int | None = None, tensor_parallel: int = 1,
+                 enable_host_kv_tier: bool = False, host_tier=None,
                  metrics=None, metrics_labels: dict | None = None):
         """``chunk``: decode steps per compiled call.  Tokens feed back
         on-device inside a lax.scan and the host fetches ``chunk`` tokens per
@@ -354,6 +369,21 @@ class ContinuousBatchingEngine:
         the visible device count.  ``PADDLE_TPU_TP=<int>`` overrides this
         value (validated: an invalid degree warns once with the valid
         divisors and falls back to 1 — utils/envflags.env_tp).
+        ``enable_host_kv_tier`` (docs/kv_tier.md; requires paged mode AND
+        ``enable_prefix_caching``): hierarchical KV — prefix-cache
+        eviction DEMOTES zero-ref chains to a byte-budgeted host-RAM page
+        store (``PADDLE_TPU_HOST_TIER_MIB``) instead of freeing them, and
+        admission's prefix match extends through that tier: a tier hit
+        re-admits pages by async H2D copy scheduled through the
+        chunked-prefill cursor exactly like prefilling (one cursor, zero
+        new compiled shapes).  ``host_tier`` passes a pre-built
+        :class:`~paddle_tpu.inference.kv_tier.HostKVTier` — how the
+        FleetRouter shares ONE tier across replicas so any replica
+        re-admits chains another replica computed.  Kill switch:
+        ``PADDLE_TPU_HOST_KV_TIER=0`` forces it off regardless
+        (byte-identical to the pre-tier engine), and
+        ``PADDLE_TPU_PREFIX_CACHE=0`` neutralizes it too (no content
+        address, nothing to demote).
         ``metrics`` / ``metrics_labels`` (docs/observability.md): an
         optional shared :class:`~paddle_tpu.inference.observability.
         MetricsRegistry` plus constant label set (e.g. ``{"replica": k}``
@@ -548,6 +578,49 @@ class ContinuousBatchingEngine:
                 self._prefill_prefix = jax.jit(
                     self._tp_shard_prefill(self._prefill_impl_paged_prefix),
                     donate_argnums=(2, 3), static_argnums=(7,))
+        # hierarchical KV: host-RAM spill tier behind the prefix cache
+        # (ISSUE 13, docs/kv_tier.md).  EVERY tier behavior hangs off
+        # self._tier being non-None, and the env kill switch is checked
+        # FIRST so PADDLE_TPU_HOST_KV_TIER=0 neutralizes the feature
+        # totally — tier-off the engine is byte-identical to the pre-tier
+        # engine (eviction frees, admission stops at the HBM match).
+        self._tier = None
+        if ((enable_host_kv_tier or host_tier is not None)
+                and env_bool("PADDLE_TPU_HOST_KV_TIER", True)):
+            if not paged or not enable_prefix_caching:
+                raise ValueError(
+                    "enable_host_kv_tier requires paged=True and "
+                    "enable_prefix_caching=True (the tier is keyed by the "
+                    "prefix cache's chain hashes and holds its evicted "
+                    "pages)")
+            if self._pcache is not None:
+                # PADDLE_TPU_PREFIX_CACHE=0 neutralizes the tier too:
+                # with no content address there is nothing to demote to
+                # or match through — the engine runs tier-off rather than
+                # raising, honoring "forces it off regardless"
+                from .kv_tier import HostKVTier
+
+                self._tier = (host_tier if host_tier is not None
+                              else HostKVTier())
+                # donated H2D page write (ship_in's device half): upload
+                # one host page into pool page dst across all layers.
+                # TP: page indices address the unsharded num_blocks axis
+                # and the replicated page operand shards onto the pool's
+                # kv_heads spec in-graph; out_shardings pins the layout
+                # so the donated buffer is never re-laid out (the same
+                # contract as _copy_page).
+                self._tier_write = jax.jit(
+                    lambda c, dst, page: c.at[:, dst].set(page),
+                    donate_argnums=(0,),
+                    **({"out_shardings": self._cache_sharding}
+                       if self.tp > 1 else {}))
+                # per-slot match-to-restore plans: [(block_idx, hash,
+                # parent), ...] — consumed front-first by the chunked
+                # cursor at the step token budget's pace (restores bill
+                # like prefill rows, one-block floor), dropped whole on
+                # preempt/cancel/terminal or a tier miss (see
+                # _tier_restore_step / _drop_tier_plan)
+                self._tier_plan: list[list] = [[] for _ in range(max_batch)]
         # slot state (host side)
         self._slot_req: list[Request | None] = [None] * max_batch
         self._pos = np.zeros(max_batch, np.int32)      # next write position
@@ -717,6 +790,13 @@ class ContinuousBatchingEngine:
                 "paddle_tpu_serving_step_seconds",
                 "Wall seconds per compiled serving step (launch to host "
                 "fetch)").labels(**self._obs_labels)
+            self._h_h2d = (self.metrics.histogram(
+                "paddle_tpu_serving_h2d_restore_seconds",
+                "Host->device dispatch seconds per tier page restore "
+                "(kv_tier ship_in: two donated pool writes, overlapped "
+                "with the next compiled step by async dispatch)")
+                .labels(**self._obs_labels) if self._tier is not None
+                else None)
             self._tracer = RequestTracer(
                 enabled=True,
                 pid=int(replica) if replica is not None else 0,
@@ -724,7 +804,7 @@ class ContinuousBatchingEngine:
         else:
             self.metrics = None
             self.slo = None
-            self._h_hostgap = self._h_step = None
+            self._h_hostgap = self._h_step = self._h_h2d = None
             self._tracer = RequestTracer(enabled=False)
             self.stats = {k: (0.0 if kind == "gauge" else 0)
                           for k, (kind, _) in ENGINE_STAT_SCHEMA.items()}
@@ -1394,30 +1474,214 @@ class ContinuousBatchingEngine:
                                     step=self._step_no)
             return False
         while base + len(owned) < n_blocks:
-            if not self._free and not self._reclaim(1):
-                return False
+            if not self._free:
+                # with the tier attached, reclaim the WHOLE remaining
+                # deficit in one call: eviction demotes D2H, and one
+                # batched gather per admission beats a serialized
+                # per-page transfer ladder.  Tier-off keeps the one-page
+                # pre-PR reclaim so page-assignment order — hence the
+                # pool layout — stays byte-identical to the pre-tier
+                # engine.
+                want = (n_blocks - base - len(owned)
+                        if self._tier is not None else 1)
+                if not self._reclaim(want):
+                    return False
             b = self._free.pop()
             self._table[slot, base + len(owned)] = b
             owned.append(b)
         return True
 
     def _reclaim(self, n: int) -> int:
-        """Evict up to n zero-ref cached blocks into the free list."""
+        """Evict up to n zero-ref cached blocks into the free list.  With
+        the host tier attached (docs/kv_tier.md), eviction DEMOTES instead
+        of killing: every victim's page ships D2H under its chain hash
+        before the page is recycled, so the chain stays re-admittable —
+        the whole point of returning (hash, page) pairs from evict()."""
         if self._pcache is None:
             return 0
         with RecordEvent("prefix_cache/evict"):
-            pages = self._pcache.evict(n)
-        if pages:
-            self._free.extend(pages)
-            self.stats["prefix_evictions"] += len(pages)
+            pairs = self._pcache.evict(n)
+        if pairs:
+            if self._tier is not None:
+                self._demote(pairs)
+            self._free.extend(page for _, page in pairs)
+            self.stats["prefix_evictions"] += len(pairs)
             if self._flight is not None:
-                self._flight.record("evict", pages=len(pages))
-        return len(pages)
+                self._flight.record("evict", pages=len(pairs))
+        return len(pairs)
+
+    # -------- hierarchical KV: demote / re-admit (docs/kv_tier.md) --------
+
+    def _demote(self, pairs) -> None:
+        """ship_out the evicted pages: ONE gathered device read for the
+        whole batch, then per-page host slices into the tier.  np.asarray
+        blocks on the D2H, so a later compiled step can never overwrite a
+        page mid-demotion — the pages re-enter the free list only after
+        their bytes are safe on the host.  A page the tier cannot fit
+        (budget exhausted by pinned entries) goes dead, exactly the
+        pre-tier eviction, counted by the tier's ``drops``."""
+        with RecordEvent("kv_tier/demote"):
+            idx = jnp.asarray([page for _, page in pairs], jnp.int32)
+            k_slab = np.asarray(self.cache_k[:, idx])
+            v_slab = np.asarray(self.cache_v[:, idx])
+            owner = self._obs_labels.get("replica")
+            for i, (h, _page) in enumerate(pairs):
+                if self._tier.ship_out(h, k_slab[:, i], v_slab[:, i],
+                                       owner=owner) is not None:
+                    self.stats["tier_demotions"] += 1
+        self.stats["tier_bytes"] = self._tier.used_bytes
+        self.stats["tier_evictions"] = self._tier.evictions
+        if self._flight is not None:
+            self._flight.record("tier_demote", pages=len(pairs),
+                                tier_bytes=int(self._tier.used_bytes))
+
+    def _restore_tier_block(self, slot: int, req, ids, b: int, h: str,
+                            parent: str | None) -> bool:
+        """Re-admit ONE demoted block: allocate a free page, dispatch the
+        async H2D pool writes (ship_in's device half), and register the
+        block into the prefix cache with this slot holding a reference —
+        from here on it is indistinguishable from a freshly-prefilled
+        shared block.  False when the restore cannot proceed (pool dry,
+        tier miss / injected ``tier_drop``, private pages ahead of the
+        shared front): the caller falls back to ordinary prefill compute
+        for the block — token-identical either way, the tier only ever
+        changes who produces the bytes, never which bytes."""
+        bs_ = self.block_size
+        if self._faults and self._faults.fire("tier_drop",
+                                              step=self._step_no,
+                                              slot=slot, rid=req.rid):
+            # chaos seam (faults.py): the entry vanishes between match
+            # and ship_in — the engine must fall back to normal prefill,
+            # never hang or corrupt
+            self._tier.discard(h)
+            if self._flight is not None:
+                self._flight.record("fault", fault="tier_drop", slot=slot,
+                                    step=self._step_no)
+        if h in self._pcache._by_hash:
+            # another slot restored or computed the same chain block since
+            # this plan was made: map the HBM-resident copy instead (a
+            # late HBM hit — strictly cheaper than the H2D)
+            e = self._pcache._by_hash[h]
+            self._pcache.acquire(e)
+            self._table[slot, len(self._slot_shared[slot])] = e.page
+            self._slot_shared[slot].append(h)
+            return True
+        if not self._free or self._slot_blocks[slot]:
+            # pool pressure, or unregistered private pages ahead of the
+            # shared front (a cache_error degradation left them there —
+            # appending shared past them would break the [shared...,
+            # private...] row layout): compute instead
+            return False
+        entry = self._tier.ship_in(h,
+                                   owner=self._obs_labels.get("replica"))
+        if entry is None:
+            return False        # dropped or LRU-evicted: compute instead
+        dst = self._free.pop()
+        t0 = time.perf_counter()
+        with RecordEvent("kv_tier/restore"):
+            d = jnp.asarray(dst, jnp.int32)
+            self.cache_k = self._tier_write(self.cache_k, d,
+                                            jnp.asarray(entry.k))
+            self.cache_v = self._tier_write(self.cache_v, d,
+                                            jnp.asarray(entry.v))
+        e = self._pcache.register(parent, ids[b * bs_:(b + 1) * bs_], dst,
+                                  refcount=1)
+        if e is None:
+            # defensive: the parent left the index between plan and
+            # restore — the page would be unreachable by radix descent;
+            # hand it back and compute the block instead
+            self._free.append(dst)
+            return False
+        self._table[slot, len(self._slot_shared[slot])] = dst
+        self._slot_shared[slot].append(h)
+        self.stats["tier_readmits"] += 1
+        self.stats["tier_bytes"] = self._tier.used_bytes
+        if self._h_h2d is not None:
+            self._h_h2d.observe(time.perf_counter() - t0)
+        if self._flight is not None:
+            self._flight.record("tier_readmit", rid=req.rid, slot=slot,
+                                block=b, page=dst)
+        return True
+
+    def _tier_restore_step(self, s: int, ids,
+                           budget: int) -> tuple[int, int, bool]:
+        """Advance slot ``s``'s prefill cursor through its pending
+        tier-restore plan (the chunked path's ship_in driver): plan blocks
+        the cursor already passed (computed by a fallback chunk) drop;
+        while the cursor sits exactly at a planned block's boundary,
+        restore it by H2D page copy and advance the cursor a whole block.
+        "Restoring from host" is thereby scheduled exactly like
+        "prefilling" — one cursor, zero new compiled step shapes,
+        chunk-granular preemption/cancel compose for free, AND restores
+        are paced by the step's token budget exactly like prefill rows
+        (each restored block bills ``block_size`` tokens, with a
+        one-block-per-step floor so plans always drain — a long demoted
+        chain must not burst hundreds of H2D uploads into one step and
+        recreate the decode stall chunked prefill exists to erase).  The
+        H2D dispatch is async: donation order guarantees this step's
+        mixed launch reads the restored pages, while the bytes stream in
+        parallel with the host's packing work.  Returns ``(cursor,
+        remaining budget, pending)`` — ``pending`` means a planned block
+        still sits AT the cursor (deferred by the budget), so the caller
+        must idle the lane this step instead of computing the block a
+        later step will restore."""
+        bs_ = self.block_size
+        req = self._slot_req[s]
+        plan = self._tier_plan[s]
+        cur = int(self._prefilled[s])
+        restored = 0
+        while plan:
+            b, h, _parent = plan[0]
+            if b * bs_ < cur:
+                plan.pop(0)                 # computed by a fallback chunk
+                self._tier.unpin(h)
+                continue
+            if b * bs_ != cur:
+                break                       # mid-block cursor: compute on
+            if restored > 0 and budget < bs_:
+                # budget drained: defer the rest of the plan to the next
+                # step (the floor above already banked one block, so the
+                # plan strictly drains — no livelock on a tiny budget)
+                return cur, budget, True
+            if not self._restore_tier_block(s, req, ids, b, h, _parent):
+                # pool dry this step, or the entry vanished (tier_drop /
+                # LRU): drop the WHOLE plan and fall back to prefill
+                # compute — token-identical, never a hang
+                self._drop_tier_plan(s)
+                break
+            plan.pop(0)
+            self._tier.unpin(h)
+            restored += 1
+            budget = max(budget - bs_, 0)
+            cur += bs_
+            self._prefilled[s] = cur
+            self._pos[s] = cur
+            self._written[s] = max(int(self._written[s]), cur)
+            # admission pre-counted the whole uncovered tail as computed
+            # (it could not know which blocks the cursor would restore):
+            # move this block's tokens to the cached column so the
+            # prefill hit-rate reads what actually happened
+            self.stats["prefill_tokens_computed"] -= bs_
+            self.stats["prefill_tokens_cached"] += bs_
+        return cur, budget, False
+
+    def _drop_tier_plan(self, slot: int) -> None:
+        """Invalidate a slot's pending tier-restore plan (preempt, cancel,
+        terminal, restore fallback): unpin every remaining entry so the
+        tier's LRU may reclaim them.  The cursor keeps whatever progress
+        restores already banked — the blocks it covered are ordinary
+        shared cache blocks now."""
+        if self._tier is None:
+            return
+        for _b, h, _p in self._tier_plan[slot]:
+            self._tier.unpin(h)
+        self._tier_plan[slot] = []
 
     def _evictable(self) -> int:
         return self._pcache.evictable_count() if self._pcache is not None else 0
 
     def _release(self, slot: int):
+        self._drop_tier_plan(slot)  # no-op tier-off / plan already drained
         self._free.extend(self._slot_blocks[slot])
         self._slot_blocks[slot] = []
         if self._slot_shared[slot]:
@@ -1463,6 +1727,12 @@ class ContinuousBatchingEngine:
                 # invariant ever breaks, keeping the page private (freed by
                 # _release) is the safe degradation
                 break
+            if self._tier is not None and not self._tier.shared:
+                # a freshly-computed block whose demoted twin still sits
+                # in a PRIVATE tier: drop the stale host copy — demote/
+                # re-admit is move semantics there (I10's exactly-one
+                # home; a shared tier keeps it for the other replicas)
+                self._tier.discard(e.hash)
             parent = e.hash
             self._slot_blocks[slot].pop(0)
             self._slot_shared[slot].append(e.hash)
@@ -1496,6 +1766,9 @@ class ContinuousBatchingEngine:
                 tokens = seq[b * bs_:(b + 1) * bs_]
                 e = self._pcache.register(parent, tokens, page, refcount=0)
                 if e is not None:
+                    if self._tier is not None and not self._tier.shared:
+                        # same private-tier dedup as _register_prefix_blocks
+                        self._tier.discard(e.hash)
                     parent = e.hash
                     continue               # ownership moved to the cache
                 # duplicate content (identical stream retired earlier): the
@@ -1691,6 +1964,61 @@ class ContinuousBatchingEngine:
                 for i, e in enumerate(matched[:n_map]):
                     self._table[slot, i] = e.page
                     self._slot_shared[slot].append(e.hash)
+                # hierarchical KV (docs/kv_tier.md): extend the prefix
+                # match THROUGH the host tier.  Walk the chain past the
+                # HBM-resident blocks — every hash the tier holds is a
+                # block this admission re-admits by H2D copy instead of
+                # prefill compute.  The walk stops strictly below the
+                # first decode write position (s0-1): a restored block
+                # the decode step would write into would need COW, so
+                # skipping it costs at most one block of prefill and
+                # keeps the restore path write-free; COW admissions
+                # (full HBM match) have no tail to extend.
+                tier_plan: list[tuple[int, str, str | None]] = []
+                if self._tier is not None and not cow:
+                    parent = matched[-1].hash if m else None
+                    b = m
+                    bs_t = self.block_size
+                    while (b + 1) * bs_t <= s0 - 1:
+                        h = self._pcache.chain_hash(
+                            parent, ids[b * bs_t:(b + 1) * bs_t])
+                        if h not in self._tier:
+                            break
+                        tier_plan.append((b, h, parent))
+                        parent = h
+                        b += 1
+                    if tier_plan:
+                        self.stats["tier_hits"] += 1
+                        for _b, h, _p in tier_plan:
+                            # pinned until restored or dropped: the
+                            # tier's LRU must not reclaim a matched
+                            # entry mid-plan (the chunked cursor spans
+                            # steps between match and restore)
+                            self._tier.pin(h)
+                        if self._flight is not None:
+                            self._flight.record("tier_match", rid=req.rid,
+                                                blocks=len(tier_plan))
+                n_restored = 0
+                if tier_plan and not (self._chunked and self._graceful):
+                    # bucketed engines — and chunked GRACEFUL-OFF ones,
+                    # whose admission allocates the whole prompt's
+                    # private pages upfront, leaving no block boundary
+                    # the cursor-driven restore could append shared
+                    # pages at — restore at admission: each block takes
+                    # a free page and registers into the prefix cache
+                    # exactly like a freshly-prefilled block, then
+                    # prefill (bucketed, or the cursor from ``start``)
+                    # begins past the restored coverage.  A mid-walk
+                    # failure (pool dry, tier_drop) falls back to
+                    # prefill for the remainder — never a hang.
+                    for b, h, parent in tier_plan:
+                        if not self._restore_tier_block(slot, req, ids, b,
+                                                        h, parent):
+                            break
+                        n_restored += 1
+                    for _b, h, _p in tier_plan:
+                        self._tier.unpin(h)
+                    tier_plan = []
                 if self._chunked and self._graceful:
                     # chunk-granular allocation (docs/fault_tolerance.md):
                     # a streaming prompt owns pages only as its cursor
@@ -1705,13 +2033,18 @@ class ContinuousBatchingEngine:
                     # allocation byte-identically.
                     need = m if cow else n_map
                 avail = len(self._free) + self._evictable()
-                if (avail < gate - n_map + headroom
+                if (avail < gate - (n_map + n_restored) + headroom
                         or not self._alloc_to(slot, need)):
                     # roll back refs + any partial allocation on this EMPTY
                     # slot — stranded pages/refs are invisible to every
-                    # release path
+                    # release path.  Restored tier blocks stay resident in
+                    # the HBM cache zero-ref (a retry hits them there);
+                    # a chunked plan's pins release so the tier's LRU may
+                    # reclaim the unconsumed entries.
                     if cow:
                         self._pcache.release(matched[-1].hash)
+                    for _b, h, _p in tier_plan:
+                        self._tier.unpin(h)
                     self._release(slot)
                     break  # pool dry: keep queue order, retry next step
                 if cow:
@@ -1728,10 +2061,11 @@ class ContinuousBatchingEngine:
                 if m:
                     self.stats["prefix_hits"] += 1
                     self.stats["prefix_blocks_reused"] += m
-                # cached positions: all of a shared/COW block's K/V is
-                # already in the pool — prefill starts at the first
-                # uncached token (never past s0-1, decode's first position)
-                start = min(m * self.block_size, s0 - 1)
+                # cached positions: all of a shared/COW/tier-restored
+                # block's K/V is already in the pool — prefill starts at
+                # the first uncached token (never past s0-1, decode's
+                # first position)
+                start = min((m + n_restored) * self.block_size, s0 - 1)
                 age = getattr(req, "_resume_age", None)
                 self._slot_age[slot] = self._admit_seq if age is None else age
                 self._admit_seq += 1
@@ -1760,6 +2094,12 @@ class ContinuousBatchingEngine:
                 # (docs/chunked_prefill.md "deliberate tradeoff")
                 self._prefill_ids[slot] = ids
                 self._prefilled[slot] = start
+                if self._tier is not None:
+                    # the match-to-restore plan: the mixed step's cursor
+                    # consumes it one block per boundary crossing
+                    # (_tier_restore_step), so "restore from host" and
+                    # "prefill" share one scheduler
+                    self._tier_plan[slot] = tier_plan
             elif start == 0:
                 bucket = min(_bucket(s0), self.max_seq)
                 padded = np.zeros((1, bucket), np.int32)
@@ -2468,9 +2808,23 @@ class ContinuousBatchingEngine:
         prefilling = sorted((s for s in range(B)
                              if self._prefill_ids[s] is not None),
                             key=lambda s: self._slot_age[s])
+        tier_progress = False
         for s in prefilling:
             ids = self._prefill_ids[s]
             cur = int(self._prefilled[s])
+            if self._tier is not None and self._tier_plan[s]:
+                # hierarchical KV (docs/kv_tier.md): consume this slot's
+                # tier-restore plan at the cursor — restored blocks
+                # advance the cursor like computed chunks, billed against
+                # the same token budget as prefill rows (no packed rows,
+                # just the H2D); a budget-deferred plan idles the lane
+                # rather than computing a block the next step restores
+                cur0 = cur
+                cur, budget, pending = self._tier_restore_step(s, ids,
+                                                               budget)
+                tier_progress = tier_progress or cur != cur0 or pending
+                if pending:
+                    continue
             n = min(T, ids.size - cur, budget)
             if n <= 0:
                 continue    # budget drained: the lane idles this step
@@ -2511,7 +2865,10 @@ class ContinuousBatchingEngine:
                 active[s] = False
                 chunk_rows.pop(s, None)
         if not active.any():
-            return bool(self._queue)
+            # tier restores are progress even when every lane's ROWS were
+            # deferred or drained (a restore-only step must keep the serve
+            # loop spinning until the plan finishes draining)
+            return bool(self._queue) or tier_progress
         t0 = time.perf_counter()
         self._note_launch(t0)
         if self._flight is not None:
@@ -2839,6 +3196,10 @@ class ContinuousBatchingEngine:
         fns = [self._decode_greedy, self._decode_sampling, self._prefill]
         if self._pcache is not None:
             fns += [self._prefill_prefix, self._copy_page]
+        if self._tier is not None:
+            # the ship_in pool write: ONE variant for the whole serve
+            # (page index and payload are data, shapes are static)
+            fns += [self._tier_write]
         if self._spec is not None:
             # the verify step's query width is static (K+1): exactly one
             # variant per sampling mode actually used, regardless of how

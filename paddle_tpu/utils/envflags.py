@@ -39,6 +39,14 @@ through :func:`env_bool`, which enforces the '0'/'1' vocabulary):
   of recent engine/fleet events dumped (with a metrics snapshot) on
   request failure, ``EngineAuditError``, or replica death; ``0`` disables
   the recorder and its dumps entirely.
+* ``PADDLE_TPU_HOST_KV_TIER`` (default on) — hierarchical KV: the
+  host-RAM spill tier behind the prefix cache (inference/kv_tier.py,
+  docs/kv_tier.md).  ``0`` forces it off even when the engine was
+  constructed with ``enable_host_kv_tier=True`` (or a FleetRouter shares
+  one), restoring the pre-tier engine byte-identically: eviction frees
+  pages again and admission stops at the HBM match.
+  ``PADDLE_TPU_PREFIX_CACHE=0`` neutralizes the tier too — with no
+  content address there is nothing to demote or match through.
 
 (``PADDLE_TPU_DISABLE_PALLAS`` is the token-set switch; its vocabulary lives
 with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``.  Two of its
@@ -70,7 +78,13 @@ VMEM ceiling the program-card gate checks every Pallas launch against
 (analysis/cost_model.py, docs/analysis.md §"Program cards & budgets";
 default: the 16 MiB v4 floor from ``VMEM_CAPS``).  Parsed by
 :func:`env_int`: a non-integer or sub-minimum value warns once and keeps
-the default — a typo'd cap must not silently stop gating VMEM fits.)
+the default — a typo'd cap must not silently stop gating VMEM fits.
+``PADDLE_TPU_HOST_TIER_MIB`` is the host-KV-tier byte budget in MiB
+(inference/kv_tier.py, docs/kv_tier.md; default 256): the ceiling the
+tier's own LRU evicts against.  Parsed by :func:`env_int` with minimum 1
+— a typo or non-integer warns once and keeps the default, so a
+misconfigured budget degrades to the documented one instead of silently
+zeroing (or unbounding) the tier.)
 """
 
 from __future__ import annotations
@@ -93,6 +107,7 @@ BOOL_FLAGS = {
     "PADDLE_TPU_GRACEFUL": True,
     "PADDLE_TPU_METRICS": True,
     "PADDLE_TPU_FLIGHT_RECORDER": True,
+    "PADDLE_TPU_HOST_KV_TIER": True,
 }
 
 _warned: set[tuple[str, str]] = set()

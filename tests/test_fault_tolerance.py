@@ -193,6 +193,49 @@ def test_chaos_cache_error_degrades_without_failing(monkeypatch):
     assert got == ref
 
 
+def test_chaos_tier_drop_degrades_without_failing(monkeypatch):
+    """A host-KV-tier entry vanishing between match and ship_in
+    (docs/kv_tier.md) DEGRADES — the engine falls back to ordinary
+    prefill for the dropped chain — with no request failed, audit green,
+    and every stream token-identical to a tier-free serve.  The workload
+    forces the seam: a chain is computed, demoted under pool pressure,
+    then revisited while every restore attempt finds its entry gone."""
+    rs = np.random.RandomState(5)
+    P = rs.randint(0, 128, (20,)).astype(np.int32)   # 2 full blocks + 4
+
+    def batches():
+        rs2 = np.random.RandomState(6)
+        first = [Request(rid=0, prompt_ids=P, max_new_tokens=4)]
+        pressure = [Request(rid=10 + i,
+                            prompt_ids=rs2.randint(0, 128, (40,))
+                            .astype(np.int32), max_new_tokens=4)
+                    for i in range(3)]
+        revisit = [Request(rid=1, prompt_ids=P, max_new_tokens=4,
+                           temperature=0.8, top_p=0.9, seed=13)]
+        return first, pressure, revisit
+
+    cfg, params = _tiny()
+    monkeypatch.setenv("PADDLE_TPU_ENGINE_AUDIT", "1")
+    monkeypatch.setenv("PADDLE_TPU_FAULT_INJECT", "tier_drop@count=-1")
+    kw = dict(max_batch=1, num_blocks=8, enable_prefix_caching=True,
+              enable_chunked_prefill=True, prefill_chunk=5,
+              enable_host_kv_tier=True)
+    eng = _engine(cfg, params, **kw)
+    got = {}
+    for batch in batches():
+        got.update(eng.serve(batch))
+    _pool_closes(eng)
+    assert eng.stats["requests_failed"] == 0
+    assert eng.stats["tier_demotions"] > 0, "pressure never demoted"
+    assert eng.stats["tier_readmits"] == 0, "a dropped entry restored"
+    monkeypatch.delenv("PADDLE_TPU_FAULT_INJECT")
+    ref_eng = _engine(cfg, params, **{**kw, "enable_host_kv_tier": False})
+    ref = {}
+    for batch in batches():
+        ref.update(ref_eng.serve(batch))
+    assert got == ref
+
+
 def test_chaos_spec_and_chunked_paths(monkeypatch):
     """The speculative verify and unified mixed steps carry the same guard:
     a nan_logits fault mid-serve on the full-feature engine fails only the

@@ -268,6 +268,9 @@ def test_hash_chain_non_collision_across_distinct_prefixes():
 
 
 def test_eviction_is_lru_and_leaf_first():
+    """evict() surfaces (hash, page) pairs — the hash is the content
+    address a demotion consumer (the host KV tier) files the page under;
+    bare page ids would silently drop it (ISSUE 13 satellite)."""
     pc = PrefixCache(block_size=4)
     a = np.arange(8, dtype=np.int32)
     h = pc.chain_hashes(a, 2)
@@ -276,13 +279,13 @@ def test_eviction_is_lru_and_leaf_first():
     other = pc.register(None, np.arange(100, 104, dtype=np.int32), page=2)
     # the chain root (page 0) is the oldest zero-ref block but has a cached
     # child: leaf-first means its leaf (page 1, older than page 2) goes first
-    assert pc.evict(1) == [1]
+    assert pc.evict(1) == [(h[1], 1)]
     # a referenced block is unevictable regardless of age; the root, now a
     # leaf itself, is reclaimable
     pc.acquire(other)
-    assert pc.evict(10) == [0]
+    assert pc.evict(10) == [(h[0], 0)]
     pc.release(other.hash)
-    assert pc.evict(10) == [2]
+    assert pc.evict(10) == [(other.hash, 2)]
     assert pc.resident_blocks() == 0
 
 

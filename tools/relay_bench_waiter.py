@@ -91,6 +91,22 @@ TARGETS = {
     # seq arm's wait (the acceptance criterion compares the two)
     "cb_longctx_flash": "llama_cb_decode_tbt_p99_ms/cb_longctx_flash",
     "cb_longctx_seq": "llama_cb_decode_tbt_p99_ms/cb_longctx_seq",
+    # round-17 evidence rungs: hierarchical KV (ISSUE 13, docs/kv_tier.md)
+    # — 4x-HBM cache pressure with the host tier on vs off (TTFT +
+    # prefill_hit_rate in detail; the tier arm must beat the off arm on
+    # both), plus the fleet arm where ONE shared tier absorbs
+    # cross-replica affinity misses (tier_cross_readmits > 0 in detail).
+    # Exact keys so the tier arm can never satisfy its own baseline; the
+    # smoke banks from either backend.
+    "cb_hosttier_pressure":
+        "llama_cb_decode_tokens_per_sec/cb_hosttier_pressure",
+    "cb_hosttier_off": "llama_cb_decode_tokens_per_sec/cb_hosttier_off",
+    "cb_hosttier_cpu_smoke":
+        "llama_cb_decode_tokens_per_sec/cb_hosttier_cpu_smoke",
+    "cb_fleet_hosttier":
+        "llama_cb_decode_tokens_per_sec/cb_fleet_hosttier",
+    "cb_fleet_hosttier_cpu_smoke":
+        "llama_cb_decode_tokens_per_sec/cb_fleet_hosttier_cpu_smoke",
 }
 
 
