@@ -580,6 +580,12 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
               # figures the budget gate enforces, riding with the rung
               # they explain
               "program_card": program_card,
+              # kernel-contract verdicts of the SAME decode program
+              # (ISSUE 14, analysis/kernel_contracts.py): bounds / race /
+              # alias status per pallas launch — a PROMOTED ALIAS of
+              # program_card["kernel_contracts"] (same object) so flat
+              # dashboards read it next to the card without digging
+              "kernel_contracts": program_card.get("kernel_contracts"),
               # expected: one decode variant per sampling mode used +
               # one prefill per warmed bucket; growth = in-serve churn
               "n_traces": eng.n_traces(),
@@ -1502,6 +1508,11 @@ def run_cb_longctx_rung(name, cfg, max_batch, n_long, n_short, long_prompt,
                    "flash_combine_shards": _pa.LAST_FLASH_SHARDS,
                    "decode_step_launches": launches,
                    "program_card": program_card,
+                   # kernel-contract summary of this arm's decode program
+                   # (ISSUE 14): the A/B rungs' flash vs seq programs each
+                   # carry their own bounds/race/alias verdicts — promoted
+                   # alias of program_card["kernel_contracts"]
+                   "kernel_contracts": program_card.get("kernel_contracts"),
                    "preemptions": eng.stats["preemptions"],
                    "n_traces": eng.n_traces(),
                    "backend": jax.default_backend(),

@@ -10,7 +10,12 @@ that keep a program on the TPU fast path:
 * ``recompile``    — jit cache-key instability under equivalent inputs;
 * ``host_sync``    — callback-class primitives / host round-trips in hot
   loops;
-* ``resharding``   — implicit all-gathers the SPMD partitioner inserted.
+* ``resharding``   — implicit all-gathers the SPMD partitioner inserted;
+* ``kernel_contracts`` — static Pallas verification (kernel_contracts.py):
+  every ``pallas_call``'s index maps proven in-bounds (``kernel_bounds``),
+  output maps race-free (``kernel_race`` / ``kernel_lost_write``), and
+  ``input_output_aliases`` pairs sound (``kernel_alias``), by concrete
+  grid enumeration on the same trace.
 
 Three surfaces (docs/analysis.md):
 
@@ -35,15 +40,19 @@ from .cost_model import (ProgramCard, BudgetEntry, build_card, card_findings,
                          check_budgets, load_budgets, eqn_census,
                          DEFAULT_BUDGETS)
 from .engine_audit import EngineAuditError, audit_engine, audit_enabled
+from .kernel_contracts import (check_kernel_contracts, contracts_summary,
+                               registry_drift_findings)
 
 __all__ = ["analyze", "Report", "Finding", "Severity", "AllowRule",
            "load_allowlist", "audit_engine", "audit_enabled",
            "EngineAuditError", "n_traces", "ALL_RULES", "ProgramCard",
            "BudgetEntry", "build_card", "card_findings", "check_budgets",
-           "load_budgets", "eqn_census", "DEFAULT_BUDGETS"]
+           "load_budgets", "eqn_census", "DEFAULT_BUDGETS",
+           "check_kernel_contracts", "contracts_summary",
+           "registry_drift_findings"]
 
 ALL_RULES = ("dtype_upcast", "donation", "recompile", "host_sync",
-             "resharding")
+             "resharding", "kernel_contracts")
 
 
 def analyze(fn, *args, target: str = "", rules=None, allowlist=None,
@@ -74,45 +83,76 @@ def analyze(fn, *args, target: str = "", rules=None, allowlist=None,
     if allowlist is None:
         allowlist = load_allowlist(allowlist_path)
 
+    n_traced = 0   # ACTUAL jaxpr traces of the target this pass performed
+    #                — a real counter, not a tally of enabled rules, so a
+    #                rule that silently starts re-tracing moves the figure
+
     def trace():
+        nonlocal n_traced
+        n_traced += 1
         return jax.make_jaxpr(fn)(*args)
 
+    import time as _time
+
+    t0 = _time.perf_counter()
     closed = trace()
     findings: list[Finding] = []
     n_sigs = None
     hlo = hlo_err = None
+    trace_reuse = 0   # tally of rule/card consumers SHARING the baseline
+    #                   trace (documents the single-trace design; the
+    #                   measured evidence is traces_performed below)
     if (card or "resharding" in active) \
             and _rules._mesh_devices_of(closed, args) > 1:
         hlo, hlo_err = _rules.compiled_hlo(fn, args)
     if "dtype_upcast" in active:
         findings += _rules.check_dtype_upcast(closed, args, target=target)
+        trace_reuse += 1
     if "donation" in active:
         findings += _rules.check_donation(closed, args, target=target,
                                           min_bytes=min_donation_bytes)
+        trace_reuse += 1
     if "recompile" in active:
         churn, n_sigs = _rules.check_recompile(fn, args, target=target,
                                                trace=trace, baseline=closed)
         findings += churn
+        trace_reuse += 1
     if "host_sync" in active:
         findings += _rules.check_host_sync(closed, target=target)
+        trace_reuse += 1
     if "resharding" in active:
         findings += _rules.check_resharding(fn, args, closed=closed,
                                             target=target,
                                             min_bytes=min_gather_bytes,
                                             hlo=hlo, hlo_error=hlo_err)
+        trace_reuse += 1
+    kc_sections = None
+    if "kernel_contracts" in active:
+        from .kernel_contracts import check_kernel_contracts
+
+        kc_findings, kc_sections = check_kernel_contracts(closed,
+                                                          target=target)
+        findings += kc_findings
+        trace_reuse += 1
     built_card = None
     if card:
         # compile_collectives=False: the one compile this pass needed
-        # already happened above — a failure must not be retried per card
+        # already happened above — a failure must not be retried per card;
+        # kernel_contracts reuses the verifier sections the rule derived
         built_card = build_card(fn, args, target=target, closed=closed,
                                 hlo=hlo, trace_families=n_sigs,
-                                vmem_cap=vmem_cap, compile_collectives=False)
+                                vmem_cap=vmem_cap, compile_collectives=False,
+                                kernel_contracts=kc_sections)
         findings += card_findings(built_card)
+        trace_reuse += 1
     sev = {Severity.ERROR: 0, Severity.WARNING: 1, Severity.INFO: 2}
     findings.sort(key=lambda f: (sev[f.severity], f.rule, f.where))
     report = Report(target or getattr(fn, "__name__", "anonymous"), findings,
                     allowlist=allowlist, n_traces=n_sigs)
     report.card = built_card
+    report.trace_reuse = trace_reuse
+    report.traces_performed = n_traced
+    report.seconds = _time.perf_counter() - t0
     return report
 
 

@@ -12,7 +12,10 @@ missing entry, stale entry, or over-VMEM-cap launch);
 ``--cards --update-budgets`` instead rewrites the budget file at the
 measured values (preserving existing reasons) and exits 0 — the documented
 workflow for a PR that legitimately moves a figure.  ``--json`` emits
-machine-readable findings/cards on stdout in either mode; exit codes are
+machine-readable findings/cards on stdout in either mode (lint mode
+additionally carries per-target ``seconds`` and the ``trace_reuse`` count
+— the number of rule/card consumers sharing each target's ONE trace, the
+CI evidence the gate is single-compile per target); exit codes are
 unchanged.
 """
 
@@ -21,6 +24,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 
 
 def main(argv=None) -> int:
@@ -96,8 +100,15 @@ def main(argv=None) -> int:
     allowlist = [] if args.no_allowlist else load_allowlist(args.allowlist)
     rc = 0
     reports = []
+    seconds = []
     for name in names:
+        # per-target wall time INCLUDING the target build (the analyze
+        # pass alone is report.seconds) — with trace_reuse in the JSON so
+        # CI logs show each target stayed single-trace: N rule/card
+        # consumers sharing the one ClosedJaxpr, not N traces
+        t0 = time.perf_counter()
         report = run_target(name, allowlist=allowlist)
+        seconds.append(time.perf_counter() - t0)
         reports.append(report)
         if not args.json:
             print(report.render(verbose=args.verbose))
@@ -109,10 +120,15 @@ def main(argv=None) -> int:
 
         print(json.dumps({"reports": [
             {"target": r.target, "ok": r.ok, "n_traces": r.n_traces,
+             "seconds": round(secs, 3),
+             "analyze_seconds": (round(r.seconds, 3)
+                                 if r.seconds is not None else None),
+             "trace_reuse": r.trace_reuse,
+             "traces_performed": r.traces_performed,
              "findings": [dataclasses.asdict(f) for f in r.findings],
              "allowlisted": [{**dataclasses.asdict(f), "reason": a.reason}
                              for f, a in r.allowlisted]}
-            for r in reports]}, indent=2))
+            for r, secs in zip(reports, seconds)]}, indent=2))
     if rc and not args.json:
         print("\nlint FAILED: fix the findings above or allowlist them in "
               "paddle_tpu/analysis/allowlist.toml with a reason",
@@ -133,7 +149,12 @@ def _cards_main(args, names, run_card, TARGETS) -> int:
     from .cost_model import (card_findings, gate_cards, load_budgets,
                              update_budgets_file)
 
-    cards = {name: run_card(name) for name in names}
+    card_seconds = {}
+    cards = {}
+    for name in names:
+        t0 = time.perf_counter()
+        cards[name] = run_card(name)
+        card_seconds[name] = round(time.perf_counter() - t0, 3)
     if args.update_budgets:
         # registered=TARGETS: entries for targets NOT selected this run are
         # kept verbatim (a partial --target update must not delete the
@@ -152,6 +173,7 @@ def _cards_main(args, names, run_card, TARGETS) -> int:
 
         print(json.dumps(
             {"cards": {n: c.summary() for n, c in cards.items()},
+             "seconds": card_seconds,
              "findings": [dataclasses.asdict(f) for f in findings],
              "ok": not gating}, indent=2))
     else:

@@ -44,6 +44,14 @@ Card fields
 ``trace_families``          distinct jit cache signatures under the
                             recompile rule's equivalence perturbations
                             (``rules.signature_families``).
+``kernel_contracts``        per-``pallas_call`` contract verdicts from the
+                            kernel-contract verifier (kernel_contracts.py:
+                            index-map bounds, output write races, alias
+                            safety) on the same trace; the aggregate
+                            ``kernel_contract_violations`` count is a
+                            budgeted field — the reviewed set of
+                            deliberate violations is a ceiling, so an
+                            unsound new kernel fails the card gate too.
 """
 
 from __future__ import annotations
@@ -75,9 +83,15 @@ VMEM_CAPS = {"v4": 16 << 20, "v5e": 16 << 20, "v5p": 16 << 20,
 #: card fields a budgets.toml entry may (and --update-budgets does) ceiling.
 #: ``eqns`` is deliberately NOT budgeted by default — it drifts with any
 #: innocuous refactor; the census still reports it on the card.
+#: ``kernel_contract_violations`` counts the RAW kernel-contract findings
+#: (kernel_contracts.py) before the allowlist: the ceiling pins the
+#: reviewed set of deliberate violations (0 for most targets; the fused
+#: decode step's allowlisted in-place append overlap for the flash
+#: target), so a NEW unsound kernel moves the figure even if someone
+#: over-broadens an allowlist entry.
 BUDGET_FIELDS = ("peak_hbm_bytes", "pallas_calls", "scatters",
                  "collective_bytes", "vmem_bytes_per_launch",
-                 "trace_families")
+                 "trace_families", "kernel_contract_violations")
 _CEILING_KEYS = BUDGET_FIELDS + ("eqns",)
 
 
@@ -295,21 +309,11 @@ def _pallas_vmem(eqn) -> dict:
 
 def vmem_estimates(closed) -> list[dict]:
     """One VMEM-fit estimate per ``pallas_call`` anywhere in the program
-    (descending scan/pjit/remat/shard_map bodies, in program order)."""
-    from .rules import _sub_jaxprs
+    (descending scan/pjit/remat/shard_map bodies, in program order — the
+    shared :func:`rules.iter_pallas_eqns` walk)."""
+    from .rules import iter_pallas_eqns
 
-    out: list[dict] = []
-
-    def walk(jx):
-        for e in jx.eqns:
-            if e.primitive.name == "pallas_call":
-                out.append(_pallas_vmem(e))
-                continue
-            for sub in _sub_jaxprs(e):
-                walk(sub)
-
-    walk(_as_jaxpr(closed))
-    return out
+    return [_pallas_vmem(e) for e in iter_pallas_eqns(closed)]
 
 
 # ---------------------------------------------------------------------------
@@ -357,9 +361,15 @@ class ProgramCard:
     vmem_cap_bytes: int
     trace_families: int | None        # None = no example args to perturb
     vmem: list = dataclasses.field(default_factory=list)  # per-call detail
+    #: per-pallas_call kernel-contract sections (kernel_contracts.py):
+    #: bounds / race / alias verdicts, grid points checked, finding count
+    kernel_contracts: list = dataclasses.field(default_factory=list)
 
     def summary(self) -> dict:
         """Compact dict for bench rung detail / --json."""
+        from .kernel_contracts import contracts_summary
+
+        kc = contracts_summary(self.kernel_contracts)
         return {"target": self.target,
                 "peak_hbm_bytes": self.peak_hbm_bytes,
                 "peak_hbm_mib": round(self.peak_hbm_bytes / 2**20, 3),
@@ -370,7 +380,9 @@ class ProgramCard:
                 "vmem_bytes_per_launch": self.vmem_bytes_per_launch,
                 "vmem_cap_bytes": self.vmem_cap_bytes,
                 "vmem_launch_sites": len(self.vmem),
-                "trace_families": self.trace_families}
+                "trace_families": self.trace_families,
+                "kernel_contracts": kc,
+                "kernel_contract_violations": kc["violations"]}
 
     def render(self) -> str:
         s = self.summary()
@@ -388,19 +400,30 @@ class ProgramCard:
                          f"grid={v['grid']} vmem={v['vmem_bytes']}B "
                          f"(blocks {v['block_bytes']} + scratch "
                          f"{v['scratch_bytes']}) [{v['where']}]")
+        for c in self.kernel_contracts:
+            lines.append(f"   contracts {c['kernel']} grid={c['grid']} "
+                         f"bounds={c['bounds']} race={c['race']} "
+                         f"alias={c['alias']} "
+                         f"({c['points_checked']}/{c['grid_points']} grid "
+                         f"point(s){', sampled' if c['sampled'] else ''})")
         return "\n".join(lines)
 
 
 def build_card(fn, args=(), *, target: str = "", closed=None, hlo=None,
                donated=None, trace_families=None, compile_collectives=True,
-               vmem_cap: int | None = None) -> ProgramCard:
+               vmem_cap: int | None = None,
+               kernel_contracts=None) -> ProgramCard:
     """Derive a :class:`ProgramCard` from a traced program.
 
     ``closed`` reuses an existing trace (else ``fn(*args)`` is traced);
     ``hlo`` reuses a compiled-HLO text for the collective attribution
     (else, on multi-device programs, one compile is attempted when
     ``compile_collectives`` and ``fn`` allow).  ``trace_families`` reuses
-    the recompile rule's signature count when the caller already ran it."""
+    the recompile rule's signature count when the caller already ran it;
+    ``kernel_contracts`` likewise reuses the verifier's per-kernel
+    sections when ``analyze()`` already ran the kernel_contracts rule on
+    this trace — else they are derived here (the cards-only gate and
+    ``engine.decode_step_card()`` paths), still on the same trace."""
     import jax
 
     from .rules import _mesh_devices_of, compiled_hlo, signature_families
@@ -409,6 +432,10 @@ def build_card(fn, args=(), *, target: str = "", closed=None, hlo=None,
         closed = jax.make_jaxpr(fn)(*args)
     census = eqn_census(closed)
     vm = vmem_estimates(closed)
+    if kernel_contracts is None:
+        from .kernel_contracts import check_kernel_contracts
+
+        _, kernel_contracts = check_kernel_contracts(closed, target=target)
     if trace_families is None and args:
         trace_families = signature_families(args)
     devices = _mesh_devices_of(closed, args)
@@ -428,7 +455,8 @@ def build_card(fn, args=(), *, target: str = "", closed=None, hlo=None,
         scatters=census["scatters"], collective_bytes=coll,
         vmem_bytes_per_launch=max((v["vmem_bytes"] for v in vm), default=0),
         vmem_cap_bytes=vmem_cap if vmem_cap is not None else vmem_cap_bytes(),
-        trace_families=trace_families, vmem=vm)
+        trace_families=trace_families, vmem=vm,
+        kernel_contracts=kernel_contracts)
 
 
 def card_findings(card: ProgramCard) -> list[Finding]:
@@ -598,7 +626,8 @@ _BUDGETS_HEADER = """\
 # (which preserves reasons) and re-justifies the entry in review; a PR
 # that grows one silently fails the gate with the offending field named.
 # Fields: peak_hbm_bytes, pallas_calls, scatters, collective_bytes,
-# vmem_bytes_per_launch, trace_families (docs/analysis.md).
+# vmem_bytes_per_launch, trace_families, kernel_contract_violations
+# (docs/analysis.md).
 """
 
 

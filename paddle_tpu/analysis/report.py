@@ -41,8 +41,11 @@ class Finding:
     """One typed lint finding.
 
     ``rule``: dtype_upcast | donation | recompile | host_sync | resharding |
-    engine_audit.  ``where`` is eqn provenance (``file.py:line (fn)``) when the
-    jaxpr carries source info, else a structural path (``params/layers/wq``).
+    engine_audit | program_card | budget | kernel_bounds | kernel_race |
+    kernel_lost_write | kernel_alias | kernel_registry (the last five:
+    kernel_contracts.py).  ``where`` is eqn provenance
+    (``file.py:line (fn)``) when the jaxpr carries source info, else a
+    structural path (``params/layers/wq``).
     """
 
     rule: str
@@ -143,6 +146,17 @@ class Report:
         self.target = target
         self.n_traces = n_traces  # distinct trace signatures seen (churn rule)
         self.card = None          # ProgramCard when analyze(card=True)
+        #: wall seconds of the analyze() pass; the number of rule/card
+        #: consumers that REUSED its one baseline trace; and the number
+        #: of jaxpr traces ACTUALLY performed (a live counter on the
+        #: trace closure — expected 2: the baseline plus the recompile
+        #: rule's deliberate determinism re-trace; any growth means a
+        #: rule started re-tracing).  Surfaced by
+        #: ``python -m paddle_tpu.analysis --json`` so CI logs show the
+        #: gate stayed single-trace/single-compile per target.
+        self.seconds: float | None = None
+        self.trace_reuse: int | None = None
+        self.traces_performed: int | None = None
         self.findings: list[Finding] = []       # active (not allowlisted)
         self.allowlisted: list[tuple[Finding, AllowRule]] = []
         for f in findings:

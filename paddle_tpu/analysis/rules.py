@@ -402,6 +402,26 @@ def _iter_all_eqns(jaxpr, path=()):
             yield from _iter_all_eqns(sub, path + (eqn.primitive.name,))
 
 
+def iter_pallas_eqns(closed):
+    """Every ``pallas_call`` eqn anywhere in a (Closed)Jaxpr, in program
+    order, descending scan/pjit/remat/cond/shard_map bodies but never a
+    kernel body (in-kernel eqns are not launches).  THE shared walk —
+    ``cost_model.vmem_estimates`` and the kernel-contract verifier both
+    consume it, so a traversal fix can never make the VMEM census and the
+    contract verdicts disagree about which launches exist."""
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+
+    def walk(jx):
+        for e in jx.eqns:
+            if e.primitive.name == "pallas_call":
+                yield e
+                continue
+            for sub in _sub_jaxprs(e):
+                yield from walk(sub)
+
+    yield from walk(jaxpr)
+
+
 # ---------------------------------------------------------------------------
 # rule 4: host-sync points
 # ---------------------------------------------------------------------------

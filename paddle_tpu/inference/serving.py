@@ -3279,12 +3279,15 @@ class ContinuousBatchingEngine:
     def decode_step_card(self) -> dict:
         """Static ProgramCard summary of ONE greedy decode step
         (analysis/cost_model.py): peak live HBM, launch census, per-launch
-        VMEM fit — embedded by the cb bench rungs next to
-        ``decode_step_launches`` so a rung's detail carries the program's
-        static cost alongside its measured wall clock.  Trace-only, like
-        the launch telemetry; collective bytes are not compiled here (the
-        TP gate target owns that figure) and trace-family accounting lives
-        with ``n_traces()``."""
+        VMEM fit, and the kernel-contract aggregate (bounds / race /
+        alias verdicts over every pallas launch,
+        analysis/kernel_contracts.py) — embedded by the cb bench rungs
+        next to ``decode_step_launches`` so a rung's detail carries the
+        program's static cost AND its kernel-soundness verdicts alongside
+        its measured wall clock.  Trace-only, like the launch telemetry;
+        collective bytes are not compiled here (the TP gate target owns
+        that figure) and trace-family accounting lives with
+        ``n_traces()``."""
         from ..analysis.cost_model import build_card
 
         closed, donated = self._decode_step_trace()

@@ -28,12 +28,19 @@ def interpret_mode() -> bool:
     return not on_tpu()
 
 
-# the full opt-out vocabulary: every kernel in this package plus 'all'.
-# kernel_disabled() validates against it at parse time so a typo
-# ('paged_attn') warns with a did-you-mean instead of silently keeping the
-# kernel it was meant to disable (utils/envflags.py)
-KNOWN_KERNELS = frozenset({"all", "flash_attention", "rms_norm", "rope",
-                           "swiglu", "paged_attention", "flash_decode",
+# the full opt-out vocabulary: every kernel_disabled() dispatch site in the
+# package plus 'all'.  kernel_disabled() validates against it at parse time
+# so a typo ('paged_attn') warns with a did-you-mean instead of silently
+# keeping the kernel it was meant to disable (utils/envflags.py).  The set
+# is cross-checked BOTH ways by the KNOWN_KERNELS drift lint
+# (analysis/kernel_contracts.registry_drift_findings, gated by
+# tools/lint_gate.py --strict-allowlist): a token with no dispatch site is
+# a dead kill switch, a dispatch site with no token loses the typo guard.
+# 'rope' and 'swiglu' were retired by that lint: both ops are pure jnp
+# (XLA fuses them; SURVEY.md §7) with no Pallas kernel to route around, so
+# their opt-outs disabled nothing — setting them now warns instead.
+KNOWN_KERNELS = frozenset({"all", "flash_attention", "rms_norm",
+                           "paged_attention", "flash_decode",
                            "fused_decode_step"})
 
 

@@ -312,9 +312,17 @@ def _resolve_page(b, j, tables_ref, lens_ref, bs: int, num_blocks: int):
     past the live count repeat the LAST live page, so the pipeline sees
     identical consecutive indices and elides the copy — that is where the
     ragged HBM saving comes from.  Single home of the remap so the KV and
-    scale fetches can never diverge."""
+    scale fetches can never diverge.  Every index is clamped — the table
+    column against the table's own width (the split-K walk's j = s*P + p
+    can exceed max_blocks when S*P rounds up, and a huge/negative
+    ``lens`` must not widen the walk), the fetched page id against the
+    pool — so NO runtime table content can take the map out of bounds:
+    the contract ``analysis/kernel_contracts.py`` verifies under
+    adversarial prefetch valuations (docs/analysis.md §"Kernel
+    contracts")."""
     n_live = jnp.maximum((lens_ref[b] + bs - 1) // bs, 1)
-    j_eff = jnp.minimum(j, n_live - 1)
+    j_eff = jnp.clip(jnp.minimum(j, n_live - 1), 0,
+                     tables_ref.shape[1] - 1)
     return jnp.clip(tables_ref[b, j_eff], 0, num_blocks - 1)
 
 
@@ -1318,11 +1326,15 @@ def _fused_page_index_map(bs: int, nbp: int, pages_per_shard: int):
     # the split-K physical-page resolution over length + 1 (the walk must
     # include the append page); sentinel table entries clip to nbp - 1 —
     # the caller's SPILL page in fused pools, so an unseated lane's reads
-    # can never alias a live slot's write page
+    # can never alias a live slot's write page.  The table column is
+    # clamped to the table width like _resolve_page (the kernel-contract
+    # bounds rule: j = s*P + p exceeds max_blocks when S*P rounds up, and
+    # lens is runtime data)
     def idx(b, h, s, p, tables_ref, lens_ref, wblk_ref, wable_ref):
         j = s * pages_per_shard + p
         n_live = jnp.maximum((lens_ref[b] + 1 + bs - 1) // bs, 1)
-        j_eff = jnp.minimum(j, n_live - 1)
+        j_eff = jnp.clip(jnp.minimum(j, n_live - 1), 0,
+                         tables_ref.shape[1] - 1)
         return (jnp.clip(tables_ref[b, j_eff], 0, nbp - 1), h, 0, 0)
 
     return idx
@@ -1343,8 +1355,15 @@ def _fused_decode_kernel_call(qg, k_new, v_new, cos, sin, key_cache,
     kernel = functools.partial(_fused_decode_kernel, scale=scale, bs=bs,
                                pages_per_shard=P)
     kv_spec = pl.BlockSpec((1, 1, bs, hd), _fused_page_index_map(bs, nbp, P))
+    # the write-page id is runtime data: clamp it to the pool like every
+    # other data-dependent index — the engine always passes a valid page
+    # (own page or spill), but the kernel-contract bounds rule
+    # (analysis/kernel_contracts.py) requires the map itself to be safe
+    # for ALL prefetch values, not safe-by-caller-convention
     pool_out_spec = pl.BlockSpec(
-        (1, 1, bs, hd), lambda b, h, s, p, t, l, w, a: (w[b], h, 0, 0))
+        (1, 1, bs, hd),
+        lambda b, h, s, p, t, l, w, a: (jnp.clip(w[b], 0, nbp - 1),
+                                        h, 0, 0))
     part_spec = pl.BlockSpec((1, 1, 1, group, 1),
                              lambda b, h, s, p, t, l, w, a: (b, h, s, 0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(

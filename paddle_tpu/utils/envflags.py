@@ -49,7 +49,11 @@ through :func:`env_bool`, which enforces the '0'/'1' vocabulary):
   content address there is nothing to demote or match through.
 
 (``PADDLE_TPU_DISABLE_PALLAS`` is the token-set switch; its vocabulary lives
-with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``.  Two of its
+with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``, cross-checked
+against the actual ``kernel_disabled()`` dispatch sites by the
+KNOWN_KERNELS drift lint (analysis/kernel_contracts.py, run by
+tools/lint_gate.py) so a retired kernel cannot leave a dead kill switch
+registered.  Two of its
 tokens are per-path decode kill switches rather than whole-kernel opt-outs
 (docs/paged_attention.md): ``flash_decode`` pins the paged decode kernel to
 the sequential page walk (split-K off), and ``fused_decode_step`` rebuilds
@@ -79,6 +83,14 @@ VMEM ceiling the program-card gate checks every Pallas launch against
 default: the 16 MiB v4 floor from ``VMEM_CAPS``).  Parsed by
 :func:`env_int`: a non-integer or sub-minimum value warns once and keeps
 the default — a typo'd cap must not silently stop gating VMEM fits.
+``PADDLE_TPU_KERNEL_VERIFY_SAMPLES`` is the integer grid-enumeration cap
+for the kernel-contract verifier (analysis/kernel_contracts.py,
+docs/analysis.md §"Kernel contracts"; default 2048): a ``pallas_call``
+grid at or under the cap is enumerated exhaustively, a larger one gets
+deterministic corner-plus-stratified sampling down to the cap.  Parsed by
+:func:`env_int` with minimum 16 — a typo or sub-minimum value warns once
+and keeps the default, so a misconfigured cap can neither explode gate
+time nor silently shrink coverage to nothing.
 ``PADDLE_TPU_HOST_TIER_MIB`` is the host-KV-tier byte budget in MiB
 (inference/kv_tier.py, docs/kv_tier.md; default 256): the ceiling the
 tier's own LRU evicts against.  Parsed by :func:`env_int` with minimum 1

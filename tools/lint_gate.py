@@ -2,11 +2,17 @@
 """CI gate: jaxpr-level TPU lint + static program-card budgets over every
 registered target.
 
-Per target the gate runs the five lint rules AND derives the static
-ProgramCard (peak live HBM, launch census, collective bytes, VMEM fit,
-trace families — ``paddle_tpu/analysis/cost_model.py``) in one build/trace
-pass; cards are then checked against the reasoned per-target ceilings in
-``paddle_tpu/analysis/budgets.toml``.  Exits 0 when every target is clean
+Per target the gate runs the six lint rules — including the
+kernel-contract verifier (``paddle_tpu/analysis/kernel_contracts.py``:
+index-map bounds, output write races, alias safety for every
+``pallas_call``) — AND derives the static ProgramCard (peak live HBM,
+launch census, collective bytes, VMEM fit, trace families,
+kernel-contract sections — ``paddle_tpu/analysis/cost_model.py``) in one
+build/trace pass; cards are then checked against the reasoned per-target
+ceilings in ``paddle_tpu/analysis/budgets.toml``.  The KNOWN_KERNELS
+drift lint (dead / unregistered kill switches) runs once after the target
+loop, gated like stale allowlist entries.  Exits 0 when every target is
+clean
 (or fully allowlisted) AND within budget — wired into the tier-1 suite
 (tests/test_analysis.py::test_lint_gate_over_registered_targets,
 tests/test_program_cards.py::test_card_gate_over_registered_targets) so a
@@ -137,6 +143,23 @@ def main(argv=None) -> int:
         print("  " + f.render() + (f"  <{f.target}>" if f.target else ""))
         if f.severity != "info":
             rc = max(rc, 1)
+
+    # --- KNOWN_KERNELS drift (dead / unregistered kill switches) --------
+    # cross-references the PADDLE_TPU_DISABLE_PALLAS vocabulary against
+    # the kernel_disabled() dispatch sites actually in the package
+    # (analysis/kernel_contracts.py); same policy as stale allowlist
+    # entries — warning by default, gating under --strict-allowlist, so a
+    # renamed or retired kernel cannot leave a dead kill switch behind
+    if not cards_only:
+        from paddle_tpu.analysis import registry_drift_findings
+
+        for f in registry_drift_findings():
+            if strict_allowlist:
+                print(f"  ERROR   {f.rule}: {f.message}")
+                rc = max(rc, 1)
+            else:
+                print(f"  warning {f.rule}: {f.message} "
+                      f"(gating under --strict-allowlist)")
 
     # --- stale-allowlist detection (suppressions covering nothing) ------
     if rc >= 2:
