@@ -554,7 +554,7 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
         program_card = eng.decode_step_card()
         launches = {k: program_card[k]
                     for k in ("eqns", "pallas_calls", "scatters",
-                              "fused_decode")}
+                              "fused_decode", "fused_mlp", "kv_quant")}
     finally:
         if paged and not paged_kernel:
             if saved_env is None:
@@ -1133,6 +1133,61 @@ def decode_ladder_main(compact: bool = False) -> int:
             log(f"cb longctx rung {rung[0]} failed: {e}\n"
                 f"{traceback.format_exc()}")
             continue
+    # quantized-pool fused-append A/B (ISSUE 15, docs/paged_attention.md
+    # "Megastep stage 2"): the SAME 32k-skew workload over int8 and
+    # packed-int4 KV pools — the production memory configuration — with
+    # the in-kernel requantized append on (0 scatters/step) vs off
+    # (requant-scatter pairs: 4 scatters/step + separate norm launches,
+    # the path quantized serving paid before stage 2).  The smoke runs
+    # BOTH arms of the int4 pair at tiny size (CI twin + on-hardware
+    # sanity; packed int4 exercises the nibble path).  (rung tuple: cfg,
+    # slots, n_long, n_short, long_prompt, short_prompt, new, max_seq,
+    # num_blocks, block_size, flash, kv_quant, quant_fused)
+    smoke_quant = [("cb_longctx_quant_cpu_smoke", llama.LlamaConfig.tiny(),
+                    3, 1, 2, 100, 8, 6, 128, 24, 8, True, "int4", True),
+                   ("cb_longctx_quant_scatter_cpu_smoke",
+                    llama.LlamaConfig.tiny(),
+                    3, 1, 2, 100, 8, 6, 128, 24, 8, True, "int4", False)]
+    quant_rungs = ([
+        ("cb_longctx_quant_fused", full_cfg, 8, 2, 6, 32000, 64, 48,
+         32768, 1088, 64, True, "int8", True),
+        ("cb_longctx_quant_scatter", full_cfg, 8, 2, 6, 32000, 64, 48,
+         32768, 1088, 64, True, "int8", False),
+        ("cb_longctx_quant_fused_int4", full_cfg, 8, 2, 6, 32000, 64, 48,
+         32768, 1088, 64, True, "int4", True),
+        ("cb_longctx_quant_scatter_int4", full_cfg, 8, 2, 6, 32000, 64,
+         48, 32768, 1088, 64, True, "int4", False),
+    ] + smoke_quant if on_tpu else smoke_quant)
+    for rung in quant_rungs:
+        try:
+            emit(run_cb_longctx_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"cb quant rung {rung[0]} failed: {e}\n"
+                f"{traceback.format_exc()}")
+            continue
+    # launch-bound rung (ISSUE 15): small batch, short context — the
+    # dispatch-tax regime where the per-layer launch count IS the
+    # inter-token latency.  Stage-2 default (two launches/layer) vs the
+    # stage-1 arm (fused_layer_mlp disabled: three launches/layer).
+    # (rung tuple: cfg, slots, requests, prompt, new, max_seq,
+    # num_blocks, block_size, fused_mlp)
+    smoke_launchbound = [("cb_launchbound_cpu_smoke",
+                          llama.LlamaConfig.tiny(),
+                          2, 2, 12, 10, 64, 12, 8, True)]
+    launchbound_rungs = ([
+        ("cb_launchbound", full_cfg, 2, 2, 32, 256, 512, 24, 64, True),
+        ("cb_launchbound_stage1", full_cfg, 2, 2, 32, 256, 512, 24, 64,
+         False),
+    ] + smoke_launchbound if on_tpu else smoke_launchbound)
+    for rung in launchbound_rungs:
+        try:
+            emit(run_cb_launchbound_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"cb launchbound rung {rung[0]} failed: {e}\n"
+                f"{traceback.format_exc()}")
+            continue
     # fault-tolerance rung (ISSUE 6): open-loop 2x-oversubscribed arrivals
     # + injected allocator faults over the full-feature engine — headline is
     # GOODPUT (tokens/s over requests that actually FINISHED), the number
@@ -1383,7 +1438,8 @@ def run_cb_chunked_rung(name, cfg, max_batch, n_decode, n_long, short_prompt,
 
 def run_cb_longctx_rung(name, cfg, max_batch, n_long, n_short, long_prompt,
                         short_prompt, new, max_seq, num_blocks,
-                        block_size=64, flash=True):
+                        block_size=64, flash=True, kv_quant=None,
+                        quant_fused=True):
     """Long-context skew rung family ``cb_longctx_{flash,seq}`` (ISSUE 10):
     ``n_long`` near-``max_seq``-context requests decode alongside
     ``n_short`` short ones in the same batch.  Sequential-walk arm
@@ -1398,7 +1454,18 @@ def run_cb_longctx_rung(name, cfg, max_batch, n_long, n_short, long_prompt,
     per-request token-arrival gaps; ``flash_combine_shards`` and the
     launch-count detail (``decode_step_launches``: traced eqns /
     pallas_calls / scatters per step) ride in detail.  chunk=1 so TBT gaps
-    are per-token, not per-scan."""
+    are per-token, not per-scan.
+
+    ``kv_quant`` ('int8'/'int4', ISSUE 15 — docs/paged_attention.md
+    "Megastep stage 2") runs the same skew workload over QUANTIZED KV
+    pools, the production memory configuration: the
+    ``cb_longctx_quant_fused`` vs ``cb_longctx_quant_scatter`` A/B pins
+    ``quant_fused`` on/off — off disables ONLY ``fused_quant_append``,
+    which sends the decode step back to the requant-scatter append (4
+    scatters/step: codes + per-page scale per pool) with separate
+    rms_norm launches, i.e. exactly the unfused path quantized serving
+    paid before stage 2.  ``quant_append_kernel_calls`` and the scatter
+    census in detail are the fused arm's 0-scatter evidence."""
     import numpy as np
     import jax
 
@@ -1409,17 +1476,25 @@ def run_cb_longctx_rung(name, cfg, max_batch, n_long, n_short, long_prompt,
 
     log(f"cb longctx rung {name}: building (slots={max_batch} "
         f"long={n_long}x{long_prompt} short={n_short}x{short_prompt} "
-        f"flash={flash})")
-    # pin the two decode kill switches to EXACTLY what this arm declares
+        f"flash={flash} kv_quant={kv_quant} quant_fused={quant_fused})")
+    # pin the decode kill switches to EXACTLY what this arm declares
     # (mirroring analysis/targets.py): an ambient flash_decode /
-    # fused_decode_step opt-out left over from troubleshooting would
-    # silently turn the flash arm into a second seq arm and void the A/B
+    # fused_decode_step / fused_layer_mlp / fused_quant_append opt-out
+    # left over from troubleshooting would silently turn the flash arm
+    # into a second seq arm (or the quant-fused arm into a second
+    # scatter arm) and void the A/B
     env_key = "PADDLE_TPU_DISABLE_PALLAS"
     saved_env = os.environ.get(env_key)
     tokens = ({t.strip() for t in (saved_env or "").split(",") if t.strip()}
-              - {"flash_decode", "fused_decode_step"})
+              - {"flash_decode", "fused_decode_step", "fused_layer_mlp",
+                 "fused_quant_append"})
     if not flash:
         tokens |= {"flash_decode", "fused_decode_step"}
+    if kv_quant is not None and not quant_fused:
+        # the quant A/B's scatter arm: ONLY the in-kernel requantized
+        # append goes (the whole fused step falls back with it — the
+        # ctor requires the append member for quant pools)
+        tokens |= {"fused_quant_append"}
     if tokens:
         os.environ[env_key] = ",".join(sorted(tokens))
     else:
@@ -1431,7 +1506,8 @@ def run_cb_longctx_rung(name, cfg, max_batch, n_long, n_short, long_prompt,
         eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
                                        max_seq=max_seq, chunk=1, paged=True,
                                        block_size=block_size,
-                                       num_blocks=num_blocks)
+                                       num_blocks=num_blocks,
+                                       kv_quant=kv_quant)
         del params
         # warm every prefill bucket a timed request can land in + decode
         t_c = time.perf_counter()
@@ -1471,7 +1547,7 @@ def run_cb_longctx_rung(name, cfg, max_batch, n_long, n_short, long_prompt,
         program_card = eng.decode_step_card()
         launches = {k: program_card[k]
                     for k in ("eqns", "pallas_calls", "scatters",
-                              "fused_decode")}
+                              "fused_decode", "fused_mlp", "kv_quant")}
     finally:
         if saved_env is None:
             os.environ.pop(env_key, None)
@@ -1498,11 +1574,17 @@ def run_cb_longctx_rung(name, cfg, max_batch, n_long, n_short, long_prompt,
                    "tokens_per_s": round(toks_total / wall, 1)
                    if wall > 0 else 0.0,
                    "flash": flash,
+                   "kv_quant": kv_quant, "quant_fused": quant_fused,
                    "tbt_p50_ms": pct(0.50), "tbt_p99_ms": pct(0.99),
                    "tbt_max_ms": (round(1e3 * gaps[-1], 3) if gaps
                                   else None),
                    "flash_kernel_calls": _pa.FLASH_KERNEL_CALLS,
                    "fused_kernel_calls": _pa.FUSED_KERNEL_CALLS,
+                   "mlp_kernel_calls": _pa.MLP_KERNEL_CALLS,
+                   "quant_append_kernel_calls":
+                       _pa.QUANT_APPEND_KERNEL_CALLS,
+                   "quant_append_fallback_calls":
+                       _pa.QUANT_APPEND_FALLBACK_CALLS,
                    "seq_kernel_calls": _pa.KERNEL_CALLS,
                    "paged_fallback_calls": _pa.FALLBACK_CALLS,
                    "flash_combine_shards": _pa.LAST_FLASH_SHARDS,
@@ -1514,6 +1596,122 @@ def run_cb_longctx_rung(name, cfg, max_batch, n_long, n_short, long_prompt,
                    # alias of program_card["kernel_contracts"]
                    "kernel_contracts": program_card.get("kernel_contracts"),
                    "preemptions": eng.stats["preemptions"],
+                   "n_traces": eng.n_traces(),
+                   "backend": jax.default_backend(),
+                   **_obs_detail(eng)},
+    }
+
+
+def run_cb_launchbound_rung(name, cfg, max_batch, n_requests, prompt, new,
+                            max_seq, num_blocks, block_size=64,
+                            fused_mlp=True):
+    """Launch-overhead-dominated rung ``cb_launchbound`` (ISSUE 15,
+    docs/paged_attention.md "Megastep stage 2"): a SMALL batch of
+    short-context requests decoding one token per step — the regime
+    where every launch is dispatch tax, not compute (tiny page walks,
+    [B, 1, h] activations), so the per-layer launch count IS the
+    inter-token latency.  The ``cb_launchbound_stage1`` arm pins
+    PADDLE_TPU_DISABLE_PALLAS=fused_layer_mlp — the stage-1 program
+    (fused attention launch + separate rms_norm launch + XLA-composed
+    MLP per layer) — while the default arm runs the stage-2 fused MLP
+    half (two launches per layer, input norm inlined).  Both arms run
+    through this ONE function with the same RandomState(0) stream.
+    Headline = decode TBT p99 (ms, LOWER is better); the launch census
+    (``decode_step_launches``) and MLP kernel counters in detail are
+    the per-layer-launch-drop evidence.  chunk=1 so gaps are per-token."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              Request)
+    from paddle_tpu.ops.pallas import paged_attention as _pa
+
+    log(f"cb launchbound rung {name}: building (slots={max_batch} "
+        f"requests={n_requests}x{prompt}+{new} fused_mlp={fused_mlp})")
+    # pin the stage-2 kill switches exactly like the longctx rungs: an
+    # ambient opt-out would silently void the stage-1-vs-stage-2 A/B
+    env_key = "PADDLE_TPU_DISABLE_PALLAS"
+    saved_env = os.environ.get(env_key)
+    tokens = ({t.strip() for t in (saved_env or "").split(",") if t.strip()}
+              - {"flash_decode", "fused_decode_step", "fused_layer_mlp",
+                 "fused_quant_append"})
+    if not fused_mlp:
+        tokens |= {"fused_layer_mlp"}
+    if tokens:
+        os.environ[env_key] = ",".join(sorted(tokens))
+    else:
+        os.environ.pop(env_key, None)
+    _pa.reset_kernel_counters()
+    rs = np.random.RandomState(0)
+    try:
+        params = llama.init_params(cfg, jax.random.key(0))
+        eng = ContinuousBatchingEngine(cfg, params, max_batch=max_batch,
+                                       max_seq=max_seq, chunk=1, paged=True,
+                                       block_size=block_size,
+                                       num_blocks=num_blocks)
+        del params
+        t_c = time.perf_counter()
+        eng.serve([Request(rid=-1, prompt_ids=rs.randint(
+            0, cfg.vocab_size, (prompt,)).astype(np.int32),
+            max_new_tokens=2)])
+        log(f"cb launchbound rung {name}: compile "
+            f"{time.perf_counter() - t_c:.1f}s")
+        eng.stats.update(decode_steps=0, decode_tokens=0, decode_time_s=0.0,
+                         prefills=0)
+        reqs = [Request(rid=i, prompt_ids=rs.randint(
+                    0, cfg.vocab_size, (prompt,)).astype(np.int32),
+                    max_new_tokens=new) for i in range(n_requests)]
+        for r in reqs:
+            eng.add_request(r)
+        seen = {r.rid: 0 for r in reqs}
+        arrivals = {r.rid: [] for r in reqs}
+        t0 = time.perf_counter()
+        while eng.step() or eng._queue:
+            now = time.perf_counter()
+            for r in reqs:
+                if len(r.output_ids) > seen[r.rid]:
+                    seen[r.rid] = len(r.output_ids)
+                    arrivals[r.rid].append(now)
+        wall = time.perf_counter() - t0
+        # snapshot UNDER THIS ARM'S env (trace-time kill switches), like
+        # the longctx rungs
+        program_card = eng.decode_step_card()
+        launches = {k: program_card[k]
+                    for k in ("eqns", "pallas_calls", "scatters",
+                              "fused_decode", "fused_mlp", "kv_quant")}
+    finally:
+        if saved_env is None:
+            os.environ.pop(env_key, None)
+        else:
+            os.environ[env_key] = saved_env
+    gaps = sorted(b_ - a for r in reqs
+                  for a, b_ in zip(arrivals[r.rid], arrivals[r.rid][1:]))
+    pct = lambda p: _tbt_pctile_ms(gaps, p)
+    toks_total = sum(len(r.output_ids) for r in reqs)
+    return {
+        "metric": "llama_cb_decode_tbt_p99_ms",
+        "value": pct(0.99),
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "detail": {"rung": name, "slots": max_batch,
+                   "requests": n_requests, "prompt": prompt,
+                   "new_tokens": new, "max_seq": max_seq,
+                   "wall_s": round(wall, 2),
+                   "tokens_generated": toks_total,
+                   "tokens_per_s": round(toks_total / wall, 1)
+                   if wall > 0 else 0.0,
+                   "fused_mlp_arm": fused_mlp,
+                   "tbt_p50_ms": pct(0.50), "tbt_p99_ms": pct(0.99),
+                   "tbt_max_ms": (round(1e3 * gaps[-1], 3) if gaps
+                                  else None),
+                   "fused_kernel_calls": _pa.FUSED_KERNEL_CALLS,
+                   "mlp_kernel_calls": _pa.MLP_KERNEL_CALLS,
+                   "mlp_fallback_calls": _pa.MLP_FALLBACK_CALLS,
+                   "seq_kernel_calls": _pa.KERNEL_CALLS,
+                   "decode_step_launches": launches,
+                   "program_card": program_card,
+                   "kernel_contracts": program_card.get("kernel_contracts"),
                    "n_traces": eng.n_traces(),
                    "backend": jax.default_backend(),
                    **_obs_detail(eng)},

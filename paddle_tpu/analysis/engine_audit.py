@@ -189,7 +189,17 @@ def audit_engine(eng) -> None:
     # must NOT exist otherwise (a stray page means the pool layout drifted
     # from the compiled programs').  The partition above already proves the
     # allocator never hands it out (everything == range(num_blocks)).
-    phys = int(eng.cache_k.shape[1])
+    # quantized pools (kv_quant engines) are {"q": codes, "scale": ...}
+    # pytrees: geometry and sharding checks read the code leaf (the scale
+    # leaf shares the page axis and shards the same kv_heads axis 2)
+    def _pool_leaves(pool):
+        if isinstance(pool, dict):
+            return [("q", pool["q"]), ("scale", pool["scale"])]
+        return [("", pool)]
+
+    pool_k = eng.cache_k["q"] if isinstance(eng.cache_k, dict) \
+        else eng.cache_k
+    phys = int(pool_k.shape[1])
     want = nb + (1 if getattr(eng, "_fused", False) else 0)
     if phys != want:
         _fail("I1", f"device pool has {phys} physical pages, expected "
@@ -202,16 +212,17 @@ def audit_engine(eng) -> None:
         # give shards different page capacities and the single host
         # allocator would silently misaccount every one of them.
         for nm, pool in (("cache_k", eng.cache_k), ("cache_v", eng.cache_v)):
-            spec = tuple(getattr(pool.sharding, "spec", ()) or ())
-            axes = spec + (None,) * (pool.ndim - len(spec))
-            kv_ax = axes[2]
-            if kv_ax not in ("tp", ("tp",)):
-                _fail("I1", f"TP pool {nm} does not shard kv_heads: "
-                            f"spec={spec}")
-            if any(a is not None for i, a in enumerate(axes) if i != 2):
-                _fail("I1", f"TP pool {nm} shards a non-kv_heads axis "
-                            f"(per-shard page accounting breaks): "
-                            f"spec={spec}")
+            for leaf_nm, leaf in _pool_leaves(pool):
+                spec = tuple(getattr(leaf.sharding, "spec", ()) or ())
+                axes = spec + (None,) * (leaf.ndim - len(spec))
+                kv_ax = axes[2]
+                if kv_ax not in ("tp", ("tp",)):
+                    _fail("I1", f"TP pool {nm}{'.' + leaf_nm if leaf_nm else ''} "
+                                f"does not shard kv_heads: spec={spec}")
+                if any(a is not None for i, a in enumerate(axes) if i != 2):
+                    _fail("I1", f"TP pool {nm}{'.' + leaf_nm if leaf_nm else ''} "
+                                f"shards a non-kv_heads axis (per-shard "
+                                f"page accounting breaks): spec={spec}")
 
     # I4: cached pages are read-only — never simultaneously private
     leaked = set(cached_pages) & set(private)

@@ -229,6 +229,58 @@ def _t_serving_flash_decode_step() -> AnalysisTarget:
          temp, topp, seeds, table), env=eng._lint_env)
 
 
+def _t_serving_quant_decode_step() -> AnalysisTarget:
+    import jax.numpy as jnp
+
+    # the quantized-pool decode program at the stage-2 default (ISSUE 15):
+    # fused rope + IN-KERNEL requantized append + dequant-on-read
+    # attention, plus the fused MLP layer half — scatters = 0 IS the
+    # contract (a requant scatter reappearing on this path is the
+    # regression the budget gate names), and the kernel-contract rule
+    # verifies the quant kernel's four aliased outputs every gate run.
+    eng = _serving_engine(kv_quant="int8")
+    assert eng._fused and eng._fused_mlp, (
+        "quant target must build the fused stage-2 engine")
+    B = eng.max_batch
+    tokens = jnp.zeros((B,), jnp.int32)
+    pos = jnp.asarray([5, 0], jnp.int32)
+    active = jnp.asarray([True, False])
+    temp = jnp.zeros((B,), jnp.float32)
+    topp = jnp.ones((B,), jnp.float32)
+    seeds = jnp.zeros((B,), jnp.int32)
+    table = jnp.asarray(eng._table)
+    return AnalysisTarget(
+        "serving_quant_decode_step", eng._decode_greedy,
+        (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active,
+         temp, topp, seeds, table), env=eng._lint_env)
+
+
+def _t_serving_quant_scatter_step() -> AnalysisTarget:
+    import jax.numpy as jnp
+
+    # the PINNED pre-fusion quantized decode program (the kill-switch
+    # oracle arm): requant-scatter append — two scatters per pool (codes
+    # + per-page scale), four per step — with sequential-kernel
+    # dequant-on-read attention.  This budget freezes the fallback's
+    # shape exactly like serving_decode_step does for fp pools.
+    eng = _serving_engine(_disable_pallas=("flash_decode",
+                                           "fused_decode_step"),
+                          kv_quant="int8")
+    assert not eng._fused and not eng._fused_mlp
+    B = eng.max_batch
+    tokens = jnp.zeros((B,), jnp.int32)
+    pos = jnp.asarray([5, 0], jnp.int32)
+    active = jnp.asarray([True, False])
+    temp = jnp.zeros((B,), jnp.float32)
+    topp = jnp.ones((B,), jnp.float32)
+    seeds = jnp.zeros((B,), jnp.int32)
+    table = jnp.asarray(eng._table)
+    return AnalysisTarget(
+        "serving_quant_scatter_step", eng._decode_greedy,
+        (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active,
+         temp, topp, seeds, table), env=eng._lint_env)
+
+
 def _t_serving_prefill_step() -> AnalysisTarget:
     import jax.numpy as jnp
 
@@ -369,6 +421,8 @@ TARGETS = {
     "moe_llama_train_step": _t_moe_train_step,
     "serving_decode_step": _t_serving_decode_step,
     "serving_flash_decode_step": _t_serving_flash_decode_step,
+    "serving_quant_decode_step": _t_serving_quant_decode_step,
+    "serving_quant_scatter_step": _t_serving_quant_scatter_step,
     "serving_prefill_step": _t_serving_prefill_step,
     "serving_verify_step": _t_serving_verify_step,
     "serving_mixed_step": _t_serving_mixed_step,
@@ -381,6 +435,7 @@ TARGETS = {
 # slowing the tier-1 suite
 GATE_TARGETS = ("llama_train_step", "moe_llama_train_step",
                 "serving_decode_step", "serving_flash_decode_step",
+                "serving_quant_decode_step", "serving_quant_scatter_step",
                 "serving_prefill_step", "serving_verify_step",
                 "serving_mixed_step", "serving_tier_restore",
                 "serving_tp_step")

@@ -254,7 +254,8 @@ def create_predictor(config: Config) -> Predictor:
 # ---------------------------------------------------------------------------
 
 def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos,
-                      sin, attend_fn=None, tp_axis=None, fused_fn=None):
+                      sin, attend_fn=None, tp_axis=None, fused_fn=None,
+                      mlp_fused_fn=None):
     """Cache-threading transformer body shared by GenerationEngine and the
     continuous-batching engine (serving.py) — one copy of the GQA attend +
     rms/rope/swiglu scan so masking/grouping fixes can't diverge.
@@ -279,6 +280,19 @@ def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos,
     ``attend_fn`` are unused.  ``fused_fn=None`` (every other engine)
     traces the exact pre-fusion program.
 
+    ``mlp_fused_fn(h_res, attn_y, lp) -> (h1, y)`` (decode megastep
+    stage 2, docs/paged_attention.md "Megastep stage 2") fuses the
+    post-attention half of each layer — residual add, post RMSNorm and
+    the SwiGLU MLP between the two TP psum boundaries — into ONE call
+    (the serving decode path passes ops/pallas/paged_attention.
+    fused_layer_mlp through models/llama.decoder_layer_tail's seam).
+    With it set, the per-layer INPUT rms_norm also runs as the inline
+    jnp composition (rms_norm_ref) instead of its own Pallas launch —
+    at decode's [B, 1, h] activations a separate launch is pure
+    dispatch tax, and XLA fuses the inline norm into the QKV matmuls —
+    so a fused decode layer traces exactly two Pallas launches.
+    ``mlp_fused_fn=None`` traces the pre-stage-2 program byte-for-byte.
+
     ``tp_axis`` (docs/tp_serving.md): name of the mesh axis when this body
     runs INSIDE a shard_map region of the continuous-batching engine's
     ``tensor_parallel`` mode.  ``cfg`` then carries tp-LOCAL head counts
@@ -289,7 +303,7 @@ def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos,
     decoder_mlp_residual).  ``tp_axis=None`` (every single-chip engine)
     traces the exact pre-TP program.
     """
-    from ..models.llama import decoder_attn_residual, decoder_mlp_residual
+    from ..models.llama import decoder_layer_tail
     from ..ops.pallas import rms_norm as rms
     from ..ops.pallas import rope as rope_mod
 
@@ -328,7 +342,13 @@ def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos,
         x = carry
         lp, ck, cv = layer_in
         dt = x.dtype
-        xn = rms.rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        if mlp_fused_fn is None:
+            xn = rms.rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        else:
+            # fused-layer mode: the input norm runs inline (XLA fuses it
+            # into the QKV matmuls) instead of as its own Pallas launch —
+            # at [B, 1, h] decode activations the launch IS the cost
+            xn = rms.rms_norm_ref(x, lp["input_norm"], cfg.rms_norm_eps)
         q = (xn @ wmat(lp["wq"], dt)).reshape(b, s, nh, hd)
         k = (xn @ wmat(lp["wk"], dt)).reshape(b, s, nkv, hd)
         v = (xn @ wmat(lp["wv"], dt)).reshape(b, s, nkv, hd)
@@ -340,12 +360,13 @@ def transformer_apply(cfg, params, x, cache_k, cache_v, write_fn, mask, cos,
             ck, k_att = write_fn(ck, k)
             cv, v_att = write_fn(cv, v)
             attn = attend(q, k_att, v_att)
-        # the two decoder halves (attn-out projection + residual, mlp +
-        # residual) are the factored sharded forward shared with training
-        # (models/llama.py) — under TP they hold the layer's two psums
-        x = decoder_attn_residual(x, attn, lp, wmat=wmat,
-                                  tp_axis=tp_axis)
-        x = decoder_mlp_residual(cfg, x, lp, wmat=wmat, tp_axis=tp_axis)
+        # the post-attention half routes through the ONE shared seam
+        # (models/llama.decoder_layer_tail): mlp_fn=None composes the
+        # factored decoder halves byte-identically (the pre-stage-2
+        # program, under TP holding the layer's two psums); the fused
+        # serving decode path passes the fused MLP launch here
+        x = decoder_layer_tail(cfg, x, attn, lp, wmat=wmat,
+                               tp_axis=tp_axis, mlp_fn=mlp_fused_fn)
         return x, (ck, cv)
 
     x, (all_k, all_v) = jax.lax.scan(body, x, (params["layers"], cache_k, cache_v))

@@ -308,6 +308,7 @@ class ContinuousBatchingEngine:
 
     def __init__(self, cfg, params, max_batch: int = 8, max_seq: int = 512,
                  chunk: int = 1, quant: str | None = None, paged: bool = False,
+                 kv_quant: str | None = None,
                  block_size: int = 64, num_blocks: int | None = None,
                  enable_prefix_caching: bool = False,
                  enable_speculation: bool = False, num_draft_tokens: int = 4,
@@ -324,6 +325,22 @@ class ContinuousBatchingEngine:
         request's EOS/budget inside a chunk are trimmed host-side.
         ``quant``: None | 'int8' | 'int4' — weight-only quantized matmuls
         (weights stream from HBM at 1/2 or 1/4 the bytes).
+        ``kv_quant``: None | 'int8' | 'int4' — QUANTIZED KV pools (paged
+        mode only; docs/paged_attention.md "Megastep stage 2"): pages
+        store int8 codes (int4 packs two nibbles per byte) plus per-
+        (page, kv_head) f32 scales, halving or quartering resident KV
+        bytes — the production memory configuration.  Every attention
+        path dequantizes on read (the kernels' ``kv_quant`` mode);
+        appends REQUANTIZE the dirty page (dequantize with the old
+        scale, insert, recompute the scale, rewrite) — in-kernel on the
+        fused decode path (``fused_quant_append``: zero scatters per
+        decode step), as a requant-scatter pair on the kill-switched
+        path, page-batched in XLA on prefill/verify/mixed writes.
+        Because requantization is lossy per write EVENT, the emitted
+        stream depends on event grouping (chunking/speculation change
+        quantization noise); the guaranteed identity is between the
+        fused, kill-switched and gather-oracle ARMS of one
+        configuration — each computes byte-identical pool contents.
         ``paged``: block-table KV cache (``block_size`` tokens per page,
         ``num_blocks`` pages shared by all slots; default num_blocks gives
         half the dense pool's capacity — the paged mode's point is serving
@@ -406,6 +423,19 @@ class ContinuousBatchingEngine:
         self.paged = bool(paged)
         L = cfg.num_hidden_layers
         nkv, hd = cfg.num_key_value_heads, cfg.head_dim
+        # quantized KV pools (docs/paged_attention.md "Megastep stage 2"):
+        # validated before any pool geometry is derived
+        if kv_quant is not None:
+            if kv_quant not in ("int8", "int4"):
+                raise ValueError(f"kv_quant must be None, 'int8' or "
+                                 f"'int4', got {kv_quant!r}")
+            if not paged:
+                raise ValueError("kv_quant requires paged=True (per-page "
+                                 "scales live on block-table pages)")
+            if kv_quant == "int4" and hd % 2:
+                raise ValueError(f"kv_quant='int4' needs an even head_dim "
+                                 f"(got {hd}): two nibbles pack per byte")
+        self.kv_quant = kv_quant
         # ---- tensor parallelism (docs/tp_serving.md) ----
         # resolve the degree FIRST: the KV pool is created already sharded
         # and every compiled program below is built per-shard.  tp == 1
@@ -484,6 +514,7 @@ class ContinuousBatchingEngine:
                                                  self._cache_spec)
             self.params = jax.device_put(self.params, self._param_shardings)
         self._fused = False   # fused decode step: paged-mode only, see below
+        self._fused_mlp = False   # fused MLP layer half: ditto (stage 2)
         if paged:
             assert max_seq % block_size == 0, (max_seq, block_size)
             self.block_size = block_size
@@ -515,8 +546,32 @@ class ContinuousBatchingEngine:
             self._fused = (_pa_mod.kernel_supported(
                 cfg.num_attention_heads, nkv, hd, block_size)
                 and not _pa_mod.kernel_disabled("fused_decode_step"))
-            shape = (L, self.num_blocks + (1 if self._fused else 0), nkv,
-                     block_size, hd)
+            if self.kv_quant is not None:
+                # quantized pools take the fused path only with the
+                # in-kernel requantized append (stage 2): killing
+                # fused_quant_append restores the requant-scatter decode
+                # (and drops the spill page) exactly like
+                # fused_decode_step does for fp pools
+                self._fused = (self._fused and not _pa_mod.kernel_disabled(
+                    "fused_quant_append"))
+            # decode megastep stage 2: fuse the post-attention layer half
+            # (residual + post RMSNorm + SwiGLU MLP) into one per-layer
+            # launch on the decode path.  Requires the fused attention
+            # step (so the kill-switched serving_decode_step program
+            # stays the exact pre-fusion oracle) and fp matmul leaves
+            # (weight-only-quant leaves resolve through wmat's dequant;
+            # streaming them dense through the kernel would defeat the
+            # quantized weight footprint).
+            self._fused_mlp = (self._fused and quant is None
+                               and _pa_mod.fused_mlp_supported(
+                                   cfg.hidden_size,
+                                   cfg.intermediate_size // self.tp))
+            nbp = self.num_blocks + (1 if self._fused else 0)
+            if self.kv_quant is None:
+                shape = (L, nbp, nkv, block_size, hd)
+            else:
+                hd_store = hd // 2 if self.kv_quant == "int4" else hd
+                shape = (L, nbp, nkv, block_size, hd_store)
             # host allocator state
             self._free: list[int] = list(range(self.num_blocks))
             self._slot_blocks: list[list[int]] = [[] for _ in range(max_batch)]
@@ -532,8 +587,18 @@ class ContinuousBatchingEngine:
             self._slot_age = np.zeros(max_batch, np.int64)
         else:
             shape = (L, max_batch, nkv, max_seq, hd)
-        self.cache_k = jnp.zeros(shape, cfg.dtype)
-        self.cache_v = jnp.zeros(shape, cfg.dtype)
+        if self.paged and self.kv_quant is not None:
+            # quantized pools: int8 codes + per-(page, head) f32 scales as
+            # ONE pytree per pool — compiled steps, donation, the COW
+            # copy and TP sharding all treat the pair as the cache
+            # operand, so the scheduler/allocator plumbing is untouched
+            self.cache_k = {"q": jnp.zeros(shape, jnp.int8),
+                            "scale": jnp.zeros(shape[:3], jnp.float32)}
+            self.cache_v = {"q": jnp.zeros(shape, jnp.int8),
+                            "scale": jnp.zeros(shape[:3], jnp.float32)}
+        else:
+            self.cache_k = jnp.zeros(shape, cfg.dtype)
+            self.cache_v = jnp.zeros(shape, cfg.dtype)
         if self.tp > 1:
             # the pool lives sharded from birth; donation keeps it sharded
             # through every step, so no per-step resharding ever happens
@@ -563,8 +628,12 @@ class ContinuousBatchingEngine:
             # page indices address the unsharded num_blocks axis, so the
             # copy is shard-local; the output pins the pool sharding so
             # GSPMD can never decide to re-lay the donated buffer out.
+            # tree_map so a quantized pool's codes AND per-page scales
+            # copy together (a bare fp pool maps through unchanged —
+            # identical jaxpr to the direct .at[] form)
             self._copy_page = jax.jit(
-                lambda c, dst, src: c.at[:, dst].set(c[:, src]),
+                lambda c, dst, src: jax.tree_util.tree_map(
+                    lambda a: a.at[:, dst].set(a[:, src]), c),
                 donate_argnums=(0,),
                 **({"out_shardings": self._cache_sharding}
                    if self.tp > 1 else {}))
@@ -609,8 +678,11 @@ class ContinuousBatchingEngine:
                 # kv_heads spec in-graph; out_shardings pins the layout
                 # so the donated buffer is never re-laid out (the same
                 # contract as _copy_page).
+                # tree_map like _copy_page: a quantized pool restores
+                # codes + scales in one donated write
                 self._tier_write = jax.jit(
-                    lambda c, dst, page: c.at[:, dst].set(page),
+                    lambda c, dst, page: jax.tree_util.tree_map(
+                        lambda a, p: a.at[:, dst].set(p), c, page),
                     donate_argnums=(0,),
                     **({"out_shardings": self._cache_sharding}
                        if self.tp > 1 else {}))
@@ -950,6 +1022,55 @@ class ContinuousBatchingEngine:
                                 ck[lane, :, safe_pos])
                 out = ck.at[lane, :, safe_pos].set(upd)
                 return out, out
+        elif self.kv_quant is not None:
+            # quantized KV pools (docs/paged_attention.md "Megastep
+            # stage 2"): pools are {"q": codes, "scale": per-page f32}
+            # pytrees.  The kill-switched arm appends via the requant-
+            # scatter composition (the scatter pair the fused path
+            # eliminates) and attends dequant-on-read through the paged
+            # front door (which itself falls back to the quant gather
+            # oracle off-TPU-shapes / under =paged_attention); the fused
+            # default runs rope + requantized append + attention in ONE
+            # launch with codes AND scales committed through aliased
+            # outputs.
+            from ..ops import decode_attention as _da
+            from ..ops.pallas import paged_attention as _pa
+
+            bs_ = self.block_size
+            kvq = self.kv_quant
+            nh = cfg.num_attention_heads
+            blk = table[lane, safe_pos // bs_]                   # [B]
+            off = safe_pos % bs_
+            seq_now = safe_pos + 1  # incl. the token written this step
+
+            def write(ck, k):
+                qp, sc = _pa.quant_append_decode(ck["q"], ck["scale"],
+                                                 k[:, 0], blk, off,
+                                                 writeable, kvq)
+                out = {"q": qp, "scale": sc}
+                return out, out
+
+            def attend_fn(q, k_pool, v_pool):
+                o = _da.paged_decode_attention(
+                    q[:, 0], k_pool["q"], v_pool["q"], table, seq_now,
+                    kv_quant=kvq, k_scale=k_pool["scale"],
+                    v_scale=v_pool["scale"])
+                return o.reshape(B, 1, nh * hd)
+
+            if self._fused:
+                spill = jnp.int32(self.num_blocks)
+                wblk = jnp.where(writeable, jnp.minimum(blk, spill), spill)
+                lens_pre = safe_pos   # append position; inactive lanes 0
+
+                def fused_fn(q, k, v, ck, cv):
+                    # q [B, 1, nh, hd] / k, v [B, 1, nkv, hd] PRE-rope
+                    o, kq, ksc, vq, vsc = _da.fused_paged_quant_decode_step(
+                        q[:, 0], k[:, 0], v[:, 0], cos[:, 0], sin[:, 0],
+                        ck["q"], ck["scale"], cv["q"], cv["scale"],
+                        table, lens_pre, wblk, writeable, kvq)
+                    return (o.reshape(B, 1, nh * hd),
+                            {"q": kq, "scale": ksc},
+                            {"q": vq, "scale": vsc})
         else:
             from ..ops import decode_attention as _da
             from ..ops.pallas import paged_attention as _pa
@@ -1007,12 +1128,66 @@ class ContinuousBatchingEngine:
                                                    table, seq_now)
                     return o.reshape(B, 1, nh * hd)
 
+        mlp_fused_fn = None
+        if table is not None and self._fused_mlp:
+            # decode megastep stage 2: the post-attention layer half
+            # (residual + post RMSNorm + SwiGLU MLP) as ONE launch per
+            # layer through the decoder_layer_tail seam — with it, a
+            # decode layer is two Pallas launches separated only by the
+            # TP psum boundaries.  PADDLE_TPU_DISABLE_PALLAS=
+            # fused_layer_mlp restores the stage-1 program byte-
+            # identically (mlp_fused_fn stays None).
+            from ..ops.pallas import paged_attention as _pa_mlp
+
+            def mlp_fused_fn(h_res, attn_y, lp):
+                # [B, 1, h] <-> [B, h]: the decode step's single live row
+                h1, y = _pa_mlp.fused_layer_mlp(
+                    h_res[:, 0], attn_y[:, 0], lp["post_norm"],
+                    lp["w_gate"], lp["w_up"], lp["w_down"],
+                    cfg.rms_norm_eps)
+                return h1[:, None], y[:, None]
+
         x, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
                                            write, mask, cos, sin,
                                            attend_fn=attend_fn,
                                            tp_axis=self._tp_axis,
-                                           fused_fn=fused_fn)
+                                           fused_fn=fused_fn,
+                                           mlp_fused_fn=mlp_fused_fn)
         return _inf.lm_head_logits(cfg, params, x[:, -1]), ak, av
+
+    def _quant_rows_write(self, table, row_pos, valid, view=True):
+        """write_fn factory for MULTI-row events into quantized KV pools
+        (docs/paged_attention.md "Megastep stage 2"): bucketed/prefix
+        prefill (``view=True`` — the dense attend reads a dequantized
+        gathered view of the slot's pages, batch-1) and the verify/mixed
+        steps (``view=False`` — the paged front doors read the raw pool
+        pytree).  The append itself is the page-batched requantize
+        (ops/pallas/paged_attention.quant_append_rows): only dirty pages
+        rewrite, so shared prefix pages keep their exact bytes."""
+        from ..ops.pallas import paged_attention as _pa
+
+        cfg = self._body_cfg    # TP: tp-local head counts (else self.cfg)
+        S = self.max_seq
+        nkv, hd = cfg.num_key_value_heads, cfg.head_dim
+        kvq = self.kv_quant
+
+        def write(ck, k):
+            qp, sc = _pa.quant_append_rows(ck["q"], ck["scale"], k, table,
+                                           row_pos, valid, kvq)
+            out = {"q": qp, "scale": sc}
+            if not view:
+                return out, out
+            # sentinel pages read as zeros (codes 0 * scale 0), matching
+            # the fp path's fill_value=0 gather
+            codes = jnp.take(qp, table[0], axis=0, mode="fill",
+                             fill_value=0)
+            scales = jnp.take(sc, table[0], axis=0, mode="fill",
+                              fill_value=0.0)
+            v = _pa._dequant_page_content(codes, scales, kvq)
+            v = v.transpose(1, 0, 2, 3).reshape(1, nkv, S, hd)
+            return out, v.astype(cfg.dtype)
+
+        return write
 
     def _sample_tokens(self, logits, pos, temp, topp, seeds):
         """Per-slot next-token choice inside the compiled step: greedy where
@@ -1183,14 +1358,23 @@ class ContinuousBatchingEngine:
         blk_j = table_row[j // bs_]                          # [bucket]
         off_j = j % bs_
 
-        def write(ck, k):
-            # k [1, bucket, nkv, hd] -> scatter each prompt position into
-            # its page; view = this slot's gathered pages, batch-1
-            out = ck.at[blk_j, :, off_j].set(k[0], mode="drop")
-            view = jnp.take(out, table_row, axis=0,          # [maxblk, nkv, bs, hd]
-                            mode="fill", fill_value=0)       # sentinel -> zeros
-            view = view.transpose(1, 0, 2, 3).reshape(1, nkv, S, hd)
-            return out, view
+        if self.kv_quant is not None:
+            # mask PAD rows (j >= length), not just oob ones: a requant
+            # write is not free like the fp scatter — a garbage pad row
+            # in the prompt's tail page would inflate that page's absmax
+            # scale and permanently coarsen the REAL rows' codes
+            write = self._quant_rows_write(
+                table_row[None], j[None, :],
+                ((j < length) & (j < S))[None, :])
+        else:
+            def write(ck, k):
+                # k [1, bucket, nkv, hd] -> scatter each prompt position
+                # into its page; view = this slot's gathered pages, batch-1
+                out = ck.at[blk_j, :, off_j].set(k[0], mode="drop")
+                view = jnp.take(out, table_row, axis=0,  # [maxblk,nkv,bs,hd]
+                                mode="fill", fill_value=0)  # sentinel -> 0
+                view = view.transpose(1, 0, 2, 3).reshape(1, nkv, S, hd)
+                return out, view
 
         return self._prefill_body(params, ids, cache_k, cache_v, length,
                                   bucket, write)
@@ -1219,12 +1403,17 @@ class ContinuousBatchingEngine:
                           self.num_blocks)
         off_j = safe_j % bs_
 
-        def write(ck, k):
-            out = ck.at[blk_j, :, off_j].set(k[0], mode="drop")
-            view = jnp.take(out, table_row, axis=0,  # [maxblk, nkv, bs, hd]
-                            mode="fill", fill_value=0)
-            view = view.transpose(1, 0, 2, 3).reshape(1, nkv, S, hd)
-            return out, view
+        if self.kv_quant is not None:
+            write = self._quant_rows_write(
+                table_row[None], pos_j[None, :],
+                ((pos_j < length) & (pos_j < S))[None, :])
+        else:
+            def write(ck, k):
+                out = ck.at[blk_j, :, off_j].set(k[0], mode="drop")
+                view = jnp.take(out, table_row, axis=0,  # [maxblk,nkv,bs,hd]
+                                mode="fill", fill_value=0)
+                view = view.transpose(1, 0, 2, 3).reshape(1, nkv, S, hd)
+                return out, view
 
         return self._prefill_body(params, ids, cache_k, cache_v, length,
                                   bucket, write, start=start)
@@ -1267,14 +1456,20 @@ class ContinuousBatchingEngine:
         off = safe_t % bs_
         drop_blk = jnp.where(valid_t, blk, self.num_blocks)    # oob -> drop
 
-        def write(ck, k):
-            # ck [num_blocks, nkv, bs, hd]; k [B, Q, nkv, hd].  Allocator
-            # invariant: distinct slots own disjoint pages, distinct rows hit
-            # distinct positions — no scatter collisions among live writes.
-            out = ck.at[drop_blk, :, off].set(k, mode="drop")
-            # the verify kernel reads the paged pool directly (no gathered
-            # view materializes; its fallback oracle gathers internally)
-            return out, out
+        if self.kv_quant is not None:
+            write = self._quant_rows_write(table, pos_t, valid_t,
+                                           view=False)
+        else:
+            def write(ck, k):
+                # ck [num_blocks, nkv, bs, hd]; k [B, Q, nkv, hd].
+                # Allocator invariant: distinct slots own disjoint pages,
+                # distinct rows hit distinct positions — no scatter
+                # collisions among live writes.
+                out = ck.at[drop_blk, :, off].set(k, mode="drop")
+                # the verify kernel reads the paged pool directly (no
+                # gathered view materializes; its fallback oracle gathers
+                # internally)
+                return out, out
 
         # total written length per slot incl. every draft; inactive lanes
         # attend one stale position (finite, masked out downstream like the
@@ -1284,8 +1479,19 @@ class ContinuousBatchingEngine:
 
         def attend_fn(q, k_pool, v_pool):
             # q [B, Q, nh, hd] post-rope
-            o = _da.paged_verify_attention(q, k_pool, v_pool, table,
-                                           seq_now, q_lens)
+            if self.kv_quant is not None:
+                # verify is the T = K+1 special case of the chunked-
+                # prefill kernel, and ONLY the prefill member carries
+                # dequant-on-read (docs/chunked_prefill.md) — quantized
+                # verify routes through it rather than growing a fourth
+                # kernel variant (identical mask law, same page walk)
+                o = _da.paged_prefill_attention(
+                    q, k_pool["q"], v_pool["q"], table, seq_now, q_lens,
+                    kv_quant=self.kv_quant, k_scale=k_pool["scale"],
+                    v_scale=v_pool["scale"])
+            else:
+                o = _da.paged_verify_attention(q, k_pool, v_pool, table,
+                                               seq_now, q_lens)
             return o.reshape(B, Q, nh * cfg.head_dim)
 
         x, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
@@ -1390,13 +1596,18 @@ class ContinuousBatchingEngine:
         off = safe_t % bs_
         drop_blk = jnp.where(valid_t, blk, self.num_blocks)    # oob -> drop
 
-        def write(ck, k):
-            # ck [num_blocks, nkv, bs, hd]; k [B, T, nkv, hd].  Allocator
-            # invariant: distinct slots own disjoint pages, distinct rows
-            # hit distinct positions — no scatter collisions among live
-            # writes; the kernel reads the paged pool directly.
-            out = ck.at[drop_blk, :, off].set(k, mode="drop")
-            return out, out
+        if self.kv_quant is not None:
+            write = self._quant_rows_write(table, pos_t, valid_t,
+                                           view=False)
+        else:
+            def write(ck, k):
+                # ck [num_blocks, nkv, bs, hd]; k [B, T, nkv, hd].
+                # Allocator invariant: distinct slots own disjoint pages,
+                # distinct rows hit distinct positions — no scatter
+                # collisions among live writes; the kernel reads the paged
+                # pool directly.
+                out = ck.at[drop_blk, :, off].set(k, mode="drop")
+                return out, out
 
         # total written length per slot incl. this chunk; inactive lanes
         # attend one stale position (finite, masked out downstream like the
@@ -1405,9 +1616,16 @@ class ContinuousBatchingEngine:
         seq_now = jnp.minimum(seq_base + jnp.where(active, q_lens, 1), S)
 
         def attend_fn(q, k_pool, v_pool):
-            # q [B, T, nh, hd] post-rope
-            o = _da.paged_prefill_attention(q, k_pool, v_pool, table,
-                                            seq_now, q_lens)
+            # q [B, T, nh, hd] post-rope (the prefill kernel's kv_quant
+            # mode dequantizes quantized pools on read)
+            if self.kv_quant is not None:
+                o = _da.paged_prefill_attention(
+                    q, k_pool["q"], v_pool["q"], table, seq_now, q_lens,
+                    kv_quant=self.kv_quant, k_scale=k_pool["scale"],
+                    v_scale=v_pool["scale"])
+            else:
+                o = _da.paged_prefill_attention(q, k_pool, v_pool, table,
+                                                seq_now, q_lens)
             return o.reshape(B, T, nh * cfg.head_dim)
 
         x, ak, av = _inf.transformer_apply(cfg, params, x, cache_k, cache_v,
@@ -1522,13 +1740,28 @@ class ContinuousBatchingEngine:
         pre-tier eviction, counted by the tier's ``drops``."""
         with RecordEvent("kv_tier/demote"):
             idx = jnp.asarray([page for _, page in pairs], jnp.int32)
-            k_slab = np.asarray(self.cache_k[:, idx])
-            v_slab = np.asarray(self.cache_v[:, idx])
             owner = self._obs_labels.get("replica")
-            for i, (h, _page) in enumerate(pairs):
-                if self._tier.ship_out(h, k_slab[:, i], v_slab[:, i],
-                                       owner=owner) is not None:
-                    self.stats["tier_demotions"] += 1
+            if self.kv_quant is not None:
+                # quantized pools demote codes + per-page scales together
+                # (the tier's transport has carried scales since PR 12 —
+                # byte-exact roundtrip asserted there)
+                k_slab = np.asarray(self.cache_k["q"][:, idx])
+                v_slab = np.asarray(self.cache_v["q"][:, idx])
+                ks_slab = np.asarray(self.cache_k["scale"][:, idx])
+                vs_slab = np.asarray(self.cache_v["scale"][:, idx])
+                for i, (h, _page) in enumerate(pairs):
+                    if self._tier.ship_out(h, k_slab[:, i], v_slab[:, i],
+                                           k_scale=ks_slab[:, i],
+                                           v_scale=vs_slab[:, i],
+                                           owner=owner) is not None:
+                        self.stats["tier_demotions"] += 1
+            else:
+                k_slab = np.asarray(self.cache_k[:, idx])
+                v_slab = np.asarray(self.cache_v[:, idx])
+                for i, (h, _page) in enumerate(pairs):
+                    if self._tier.ship_out(h, k_slab[:, i], v_slab[:, i],
+                                           owner=owner) is not None:
+                        self.stats["tier_demotions"] += 1
         self.stats["tier_bytes"] = self._tier.used_bytes
         self.stats["tier_evictions"] = self._tier.evictions
         if self._flight is not None:
@@ -1576,14 +1809,35 @@ class ContinuousBatchingEngine:
                                    owner=self._obs_labels.get("replica"))
         if entry is None:
             return False        # dropped or LRU-evicted: compute instead
+        # storage-format guard (docs/paged_attention.md "Megastep
+        # stage 2"): tier entries are keyed by token-chain hash alone, so
+        # a SHARED fleet tier can hold pages demoted by a replica with a
+        # different pool storage (fp vs int8 vs packed int4 — scales
+        # present/absent, hd vs hd//2 payload, bf16 vs int8 dtype).
+        # Restoring one would silently corrupt this engine's pool (the
+        # donated page write casts); treat a mismatched entry as a miss
+        # and compute the block instead — on a shared tier the entry
+        # stays for compatible replicas
+        pool = self.cache_k["q"] if self.kv_quant is not None \
+            else self.cache_k
+        page_shape = (pool.shape[0],) + pool.shape[2:]
+        if ((entry.k_scale is not None) != (self.kv_quant is not None)
+                or entry.k.shape != page_shape
+                or entry.k.dtype != np.dtype(pool.dtype)):
+            return False
         dst = self._free.pop()
         t0 = time.perf_counter()
         with RecordEvent("kv_tier/restore"):
             d = jnp.asarray(dst, jnp.int32)
-            self.cache_k = self._tier_write(self.cache_k, d,
-                                            jnp.asarray(entry.k))
-            self.cache_v = self._tier_write(self.cache_v, d,
-                                            jnp.asarray(entry.v))
+            if self.kv_quant is not None:
+                k_page = {"q": jnp.asarray(entry.k),
+                          "scale": jnp.asarray(entry.k_scale)}
+                v_page = {"q": jnp.asarray(entry.v),
+                          "scale": jnp.asarray(entry.v_scale)}
+            else:
+                k_page, v_page = jnp.asarray(entry.k), jnp.asarray(entry.v)
+            self.cache_k = self._tier_write(self.cache_k, d, k_page)
+            self.cache_v = self._tier_write(self.cache_v, d, v_page)
         e = self._pcache.register(parent, ids[b * bs_:(b + 1) * bs_], dst,
                                   refcount=1)
         if e is None:
@@ -2435,6 +2689,11 @@ class ContinuousBatchingEngine:
                       f":rope{cfg.rope_theta:g}"
                       f":eps{cfg.rms_norm_eps:g}"),
             "quant": self.quant,
+            # pool storage changes the teacher-forced logits (requantized
+            # appends are lossy), so a kv_quant mismatch must raise; old
+            # v2 snapshots lack the key and src.get() -> None == the fp
+            # engine's value, so pre-stage-2 journals restore unchanged
+            "kv_quant": self.kv_quant,
             "paged": self.paged,
             "block_size": self.block_size if self.paged else None,
             "max_seq": int(self.max_seq),
@@ -3244,7 +3503,10 @@ class ContinuousBatchingEngine:
 
         counter_names = ("KERNEL_CALLS", "FALLBACK_CALLS",
                          "FLASH_KERNEL_CALLS", "LAST_FLASH_SHARDS",
-                         "FUSED_KERNEL_CALLS", "FUSED_FALLBACK_CALLS")
+                         "FUSED_KERNEL_CALLS", "FUSED_FALLBACK_CALLS",
+                         "MLP_KERNEL_CALLS", "MLP_FALLBACK_CALLS",
+                         "QUANT_APPEND_KERNEL_CALLS",
+                         "QUANT_APPEND_FALLBACK_CALLS")
         saved = {n: getattr(_pa, n) for n in counter_names}
         try:
             closed = jax.make_jaxpr(body)(*args)
@@ -3274,6 +3536,8 @@ class ContinuousBatchingEngine:
         closed, _ = self._decode_step_trace()
         counts = eqn_census(closed)
         counts["fused_decode"] = bool(self._fused)
+        counts["fused_mlp"] = bool(self._fused_mlp)
+        counts["kv_quant"] = self.kv_quant
         return counts
 
     def decode_step_card(self) -> dict:
@@ -3295,4 +3559,6 @@ class ContinuousBatchingEngine:
                           donated=donated, compile_collectives=False)
         d = card.summary()
         d["fused_decode"] = bool(self._fused)
+        d["fused_mlp"] = bool(self._fused_mlp)
+        d["kv_quant"] = self.kv_quant
         return d

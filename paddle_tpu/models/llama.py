@@ -235,6 +235,34 @@ def decoder_mlp_residual(cfg, x, lp, wmat=None, tp_axis=None):
     return x + _tp_psum(y, tp_axis, "tp_allreduce_mlp_out")
 
 
+def decoder_layer_tail(cfg, x, attn, lp, wmat=None, tp_axis=None,
+                       mlp_fn=None):
+    """The whole post-attention half of a decoder layer — attention output
+    projection, TP psum boundary 1, residual add, post-norm + swiglu MLP,
+    TP psum boundary 2, residual add — in ONE seam shared by serving and
+    training (the stage-2 megastep seam; docs/paged_attention.md
+    "Megastep stage 2").
+
+    ``mlp_fn=None`` composes :func:`decoder_attn_residual` +
+    :func:`decoder_mlp_residual` exactly — byte-identical to calling the
+    two halves directly, which is what training and every unfused serving
+    program keep tracing.  With ``mlp_fn(h_res, attn_y, lp) -> (h1, y)``
+    the residual add + post RMSNorm + SwiGLU MLP between the two psum
+    boundaries run through the caller's fused implementation (the serving
+    decode path passes ops/pallas/paged_attention.fused_layer_mlp here):
+    ``h1 = h_res + attn_y`` is the layer's next residual anchor and ``y``
+    the UN-reduced down projection, so the two all-reduces stay exactly
+    where PR 7 put them — the only per-layer exits of the fused decode
+    layer."""
+    if mlp_fn is None:
+        x = decoder_attn_residual(x, attn, lp, wmat=wmat, tp_axis=tp_axis)
+        return decoder_mlp_residual(cfg, x, lp, wmat=wmat, tp_axis=tp_axis)
+    wo = lp["wo"] if wmat is None else wmat(lp["wo"], x.dtype)
+    attn_y = _tp_psum(attn @ wo, tp_axis, "tp_allreduce_attn_out")
+    h1, y = mlp_fn(x, attn_y, lp)
+    return h1 + _tp_psum(y, tp_axis, "tp_allreduce_mlp_out")
+
+
 def _layer_forward(cfg: LlamaConfig, x, layer_params, cos, sin, use_flash=True,
                    attn_fn=None):
     """One transformer block; x: [b, s, h].  ``attn_fn(q, k, v) -> out`` (all
@@ -256,8 +284,9 @@ def _layer_forward(cfg: LlamaConfig, x, layer_params, cos, sin, use_flash=True,
         attn = fa.flash_attention_bshd(q, kk, vv, causal=True)
     else:
         attn = fa._composed_attention(q, kk, vv, None, True, 1.0 / math.sqrt(hd))
-    x = decoder_attn_residual(x, attn.reshape(b, s, nh * hd), lp)
-    return decoder_mlp_residual(cfg, x, lp)
+    # the shared post-attention seam (mlp_fn=None: the exact two-half
+    # composition serving's unfused programs and TP both pin)
+    return decoder_layer_tail(cfg, x, attn.reshape(b, s, nh * hd), lp)
 
 
 def _embed_rope(cfg: LlamaConfig, params, input_ids):

@@ -384,7 +384,13 @@ def _layer_forward(cfg: MoEConfig, x, lp, cos, sin, use_flash=True):
         attn = fa._composed_attention(q, kk, vv, None, True, 1.0 / math.sqrt(hd))
     # shared sharded decoder half (models/llama.py): the attention output
     # projection + residual — and, under tensor parallelism, TP boundary 1 —
-    # have one home for the dense and MoE decoders alike
+    # have one home for the dense and MoE decoders alike.  The stage-2
+    # fused layer tail (llama.decoder_layer_tail's mlp_fn hook, docs/
+    # paged_attention.md "Megastep stage 2") is dense-decoder-only: the
+    # MoE MLP half is shared-expert + routed experts, not the single
+    # swiglu block the fused MLP kernel streams, so MoE keeps the
+    # explicit two-half composition until MoE serving (ROADMAP item 4)
+    # grows its own fused tail
     from .llama import decoder_attn_residual
 
     x = decoder_attn_residual(x, attn.reshape(b, s, nh * hd), lp)

@@ -47,6 +47,7 @@ __all__ = [
     "paged_verify_attention",
     "paged_prefill_attention",
     "fused_paged_decode_step",
+    "fused_paged_quant_decode_step",
     "append_to_block_cache",
 ]
 
@@ -173,6 +174,40 @@ def fused_paged_decode_step(q, k_new, v_new, cos, sin, key_cache,
     return _pa.fused_decode_step(
         q, k_new, v_new, cos, sin, key_cache, value_cache, block_tables,
         seq_lens, write_blk, writeable, scale=scale, num_shards=num_shards)
+
+
+def fused_paged_quant_decode_step(q, k_new, v_new, cos, sin, key_codes,
+                                  key_scale, value_codes, value_scale,
+                                  block_tables, seq_lens, write_blk,
+                                  writeable, kv_quant, scale=None,
+                                  num_shards=None):
+    """Fused RoPE + REQUANTIZED KV-page append + dequant-on-read paged
+    attention for one decode token per slot over int8/packed-int4 pools —
+    decode megastep stage 2's quantized-serving member (docs/
+    paged_attention.md "Megastep stage 2").  The unfused quantized decode
+    path pays a requant-scatter pair per pool per layer (a new row
+    dirties the page's absmax scale, so the whole page is dequantized,
+    rewritten and rescaled in XLA); this front door runs ONE Pallas
+    launch that recomputes the dirty page's scale in-register and commits
+    codes AND scale through aliased outputs.  Falls back to the
+    requant-scatter + gather-oracle composition off-TPU-shapes or under
+    ``PADDLE_TPU_DISABLE_PALLAS=fused_quant_append`` (or
+    ``fused_decode_step``) — pool bytes identical either way (the two
+    arms share one page-encode implementation).
+
+    Shapes: q [b, nh, hd] PRE-rope; k_new/v_new [b, nkv, hd] pre-rope;
+    cos/sin [b, hd]; key_codes/value_codes [num_blocks(+1), nkv,
+    block_size, hd_store] int8 (hd_store = hd, or hd // 2 packed int4)
+    with key_scale/value_scale [num_blocks(+1), nkv] f32; block_tables
+    [b, max_blocks]; seq_lens [b] PRE-append; write_blk/writeable [b].
+    Returns (out [b, nh, hd], key_codes, key_scale, value_codes,
+    value_scale)."""
+    from .pallas import paged_attention as _pa
+
+    return _pa.fused_quant_decode_step(
+        q, k_new, v_new, cos, sin, key_codes, key_scale, value_codes,
+        value_scale, block_tables, seq_lens, write_blk, writeable,
+        kv_quant, scale=scale, num_shards=num_shards)
 
 
 def paged_verify_attention(q, key_cache, value_cache, block_tables, seq_lens,

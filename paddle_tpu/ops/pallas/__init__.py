@@ -39,9 +39,16 @@ def interpret_mode() -> bool:
 # 'rope' and 'swiglu' were retired by that lint: both ops are pure jnp
 # (XLA fuses them; SURVEY.md §7) with no Pallas kernel to route around, so
 # their opt-outs disabled nothing — setting them now warns instead.
+# 'fused_layer_mlp' and 'fused_quant_append' are the decode-megastep
+# stage-2 per-path switches (docs/paged_attention.md "Megastep stage 2"):
+# the former restores the stage-1 per-layer program (rms_norm launch +
+# XLA MLP), the latter sends int8/int4 KV pools back to the
+# requant-scatter append ('fused_decode_step' disables both fused decode
+# members at once).
 KNOWN_KERNELS = frozenset({"all", "flash_attention", "rms_norm",
                            "paged_attention", "flash_decode",
-                           "fused_decode_step"})
+                           "fused_decode_step", "fused_layer_mlp",
+                           "fused_quant_append"})
 
 
 def kernel_disabled(name: str) -> bool:

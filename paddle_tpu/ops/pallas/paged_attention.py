@@ -108,6 +108,34 @@ op-level feature of the unfused kernels).  Opt-out:
 ``PADDLE_TPU_DISABLE_PALLAS=fused_decode_step`` (the engine then rebuilds
 the unfused rope + scatter + attention decode path byte-identically,
 spill page gone).
+
+Decode megastep stage 2 (docs/paged_attention.md "Megastep stage 2") adds
+two members:
+
+- :func:`_fused_mlp_kernel` / :func:`fused_layer_mlp` — the post-attention
+  half of a decoder layer (residual add, post RMSNorm, SwiGLU MLP) in ONE
+  Pallas launch: the MLP weights stream HBM→VMEM per grid step as
+  column/row blocks of the ffn dim (``fused_mlp_block_cols``), which the
+  Pallas pipeline double-buffers, while the [B, h] activations and the
+  f32 accumulator stay resident in VMEM.  With it, a decode layer is two
+  launches — the fused attention step and this one — separated only by
+  the TP psum boundaries (models/llama.decoder_layer_tail is the seam).
+  Opt-out ``PADDLE_TPU_DISABLE_PALLAS=fused_layer_mlp`` restores the
+  stage-1 per-layer program (rms_norm launch + XLA MLP) byte-identically.
+- :func:`_fused_quant_decode_kernel` / :func:`fused_quant_decode_step` —
+  the fused decode step for int8/packed-int4 KV pools: the append that
+  used to force quantized serving onto the scatter path (a new row dirties
+  the page's scale) runs IN-KERNEL — the write page is dequantized with
+  its old scale, the roped row inserted, the per-page scale recomputed
+  (absmax/bound, the same ``_quant_encode_page`` the XLA scatter arm
+  uses), and the requantized page plus its new scale committed through
+  the existing aliased-output mechanism (pool AND scale outputs aliased).
+  Attention at the write step reads the requantized bytes — exactly what
+  the scatter arm's dequant-on-read would see — so the fused program is
+  token-identical to the kill-switched one.  Opt-out:
+  ``PADDLE_TPU_DISABLE_PALLAS=fused_quant_append`` (quantized pools then
+  take the requant-scatter path; ``fused_decode_step`` disables both
+  fused decode members).
 """
 
 from __future__ import annotations
@@ -150,6 +178,15 @@ LAST_FLASH_SHARDS = 0
 # fused rope+append+attention decode step (decode megastep stage 1)
 FUSED_KERNEL_CALLS = 0
 FUSED_FALLBACK_CALLS = 0
+# fused post-attention layer half: residual + RMSNorm + SwiGLU MLP in one
+# launch (decode megastep stage 2)
+MLP_KERNEL_CALLS = 0
+MLP_FALLBACK_CALLS = 0
+# fused decode step with IN-KERNEL requantized KV append (int8/int4 pools;
+# stage 2's quantized-serving member); the fallback is the requant-scatter
+# composition (quant_append_decode)
+QUANT_APPEND_KERNEL_CALLS = 0
+QUANT_APPEND_FALLBACK_CALLS = 0
 
 
 def reset_kernel_counters() -> None:
@@ -161,12 +198,15 @@ def reset_kernel_counters() -> None:
     global KERNEL_CALLS, FALLBACK_CALLS, VERIFY_KERNEL_CALLS, \
         VERIFY_FALLBACK_CALLS, PREFILL_KERNEL_CALLS, PREFILL_FALLBACK_CALLS, \
         FLASH_KERNEL_CALLS, LAST_FLASH_SHARDS, FUSED_KERNEL_CALLS, \
-        FUSED_FALLBACK_CALLS
+        FUSED_FALLBACK_CALLS, MLP_KERNEL_CALLS, MLP_FALLBACK_CALLS, \
+        QUANT_APPEND_KERNEL_CALLS, QUANT_APPEND_FALLBACK_CALLS
     KERNEL_CALLS = FALLBACK_CALLS = 0
     VERIFY_KERNEL_CALLS = VERIFY_FALLBACK_CALLS = 0
     PREFILL_KERNEL_CALLS = PREFILL_FALLBACK_CALLS = 0
     FLASH_KERNEL_CALLS = LAST_FLASH_SHARDS = 0
     FUSED_KERNEL_CALLS = FUSED_FALLBACK_CALLS = 0
+    MLP_KERNEL_CALLS = MLP_FALLBACK_CALLS = 0
+    QUANT_APPEND_KERNEL_CALLS = QUANT_APPEND_FALLBACK_CALLS = 0
 
 # MXU/VPU rows: the q-head group is padded up to this many rows so the
 # logits tile and the scratch accumulators keep a full sublane
@@ -246,6 +286,123 @@ def dequantize_kv_cache(q, scale, mode: str, dtype=jnp.float32):
     else:
         x = q.astype(jnp.float32)
     return (x * scale[:, :, None, None]).astype(dtype)
+
+
+def _quant_encode_page(x, kv_quant: str):
+    """f32 page content ``[..., bs, hd]`` -> (codes ``[..., bs, hd_store]``
+    int8, scale ``[...]`` f32): the per-page symmetric-absmax quantization
+    of :func:`quantize_kv_cache`, factored so the requantized-append
+    family has exactly ONE encode implementation — the XLA scatter arm
+    (:func:`quant_append_decode` / :func:`quant_append_rows`) and the
+    fused kernel's in-register requantize both call it, which is what
+    makes the two arms byte-identical by construction rather than by
+    tolerance."""
+    bound = _QUANT_BOUND[kv_quant]
+    absmax = jnp.max(jnp.abs(x), axis=(-2, -1))
+    scale = (absmax / bound).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-10)[..., None, None]),
+                 -bound, bound)
+    if kv_quant == "int8":
+        return q.astype(jnp.int8), scale
+    # pack adjacent head-dim pairs two-nibbles-per-byte (element 2i low,
+    # 2i+1 high — quantize_kv_cache's layout, inverted by _unpack_int4);
+    # expressed as a reshape+index rather than strided slices so the same
+    # expression lowers inside a Pallas kernel body
+    qi = q.astype(jnp.int32)
+    pairs = qi.reshape(*qi.shape[:-1], qi.shape[-1] // 2, 2)
+    packed = (pairs[..., 0] & 0xF) | ((pairs[..., 1] & 0xF) << 4)
+    return packed.astype(jnp.int8), scale
+
+
+def _dequant_page_content(codes, scale, kv_quant: str):
+    """Inverse of :func:`_quant_encode_page` on page content: codes
+    ``[..., bs, hd_store]`` + scale ``[...]`` -> f32 ``[..., bs, hd]``."""
+    if kv_quant == "int4":
+        x = _unpack_int4(codes.astype(jnp.int32))
+    else:
+        x = codes.astype(jnp.float32)
+    return x * scale[..., None, None]
+
+
+def quant_append_decode(qpool, scale, rows, blk, off, writeable,
+                        kv_quant: str):
+    """Requantized single-row KV append into an int8/packed-int4 pool —
+    the XLA composition (gather page → dequantize with the old scale →
+    insert the row → recompute the per-page scale → requantize → scatter
+    page + scale back).  THE semantic the fused quant kernel reproduces
+    in-register, and the engine's kill-switched decode arm: its scatter
+    pair is exactly what ``fused_quant_append`` eliminates.
+
+    qpool: [nbp, nkv, bs, hd_store]; scale: [nbp, nkv] f32; rows:
+    [b, nkv, hd] (the roped k row or raw v row, any fp dtype); blk [b]
+    physical write page; off [b] row offset; writeable [b] — 0 drops the
+    append (page and scale untouched).  Returns (qpool, scale)."""
+    nbp = qpool.shape[0]
+    bs = qpool.shape[2]
+    safe = jnp.clip(blk, 0, nbp - 1)
+    page = jnp.take(qpool, safe, axis=0)              # [b, nkv, bs, hd_st]
+    sc = jnp.take(scale, safe, axis=0)                # [b, nkv]
+    deq = _dequant_page_content(page, sc, kv_quant)   # [b, nkv, bs, hd] f32
+    ins = (jax.lax.broadcasted_iota(jnp.int32, deq.shape, 2)
+           == off[:, None, None, None])
+    new = jnp.where(ins, rows.astype(jnp.float32)[:, :, None, :], deq)
+    codes, nsc = _quant_encode_page(new, kv_quant)
+    drop = jnp.where(writeable.astype(bool), blk, nbp)    # oob -> drop
+    return (qpool.at[drop].set(codes, mode="drop"),
+            scale.at[drop].set(nsc, mode="drop"))
+
+
+def quant_append_rows(qpool, scale, rows, table, row_pos, valid,
+                      kv_quant: str):
+    """Requantized MULTI-row KV append (one write event: a prefill bucket,
+    a chunked-prefill/mixed chunk, or a verify draft window) into an
+    int8/packed-int4 pool.  A slot's live rows are CONSECUTIVE positions
+    (every caller writes a cursor window), so the event touches at most
+    ``(T-1)//bs + 2`` logical pages; only that window of each slot's
+    table row is gathered and dequantized (the window width is static —
+    one trace family, and a verify/chunk event stays O(event) instead of
+    O(max_seq)), the event's rows inserted at their absolute positions,
+    the per-page scales recomputed, and ONLY the dirty pages (pages that
+    received at least one row) are scattered back — clean pages, in
+    particular shared prefix-cache pages, keep their exact bytes.
+    Allocator invariant (distinct slots own disjoint writable pages;
+    dirty pages are always private) guarantees scatter disjointness.
+
+    qpool: [nbp, nkv, bs, hd_store]; scale: [nbp, nkv] f32;
+    rows: [B, T, nkv, hd]; table: [B, max_blocks] physical page ids;
+    row_pos: [B, T] absolute position of each row; valid: [B, T] — rows
+    with 0 are dropped.  Returns (qpool, scale)."""
+    nbp = qpool.shape[0]
+    bs = qpool.shape[2]
+    B, maxblk = table.shape
+    T = rows.shape[1]
+    nwin = min(maxblk, (T - 1) // bs + 2)
+    safe_pos = jnp.where(valid, row_pos, 0)
+    lblk = safe_pos // bs                       # [B, T] logical page
+    loff = safe_pos % bs
+    # window start = the slot's first live logical page (0 if none live)
+    lmin = jnp.min(jnp.where(valid, lblk, maxblk), axis=1)
+    p0 = jnp.where(lmin == maxblk, 0, lmin)     # [B]
+    lane = jnp.arange(B)[:, None]
+    win = jnp.clip(p0[:, None] + jnp.arange(nwin), 0, maxblk - 1)
+    wtab = table[lane, win]                     # [B, nwin] physical ids
+    safe_tab = jnp.clip(wtab, 0, nbp - 1)
+    pages = jnp.take(qpool, safe_tab, axis=0)   # [B, nw, nkv, bs, hd_st]
+    sc = jnp.take(scale, safe_tab, axis=0)      # [B, nw, nkv]
+    deq = _dequant_page_content(pages, sc, kv_quant)  # [B,nw,nkv,bs,hd] f32
+    wblk_d = jnp.where(valid, lblk - p0[:, None], nwin)  # invalid rows drop
+    deq = deq.at[lane, wblk_d, :, loff].set(
+        rows.astype(jnp.float32), mode="drop")
+    codes, nsc = _quant_encode_page(deq, kv_quant)
+    # dirty = window slots that received >= 1 live row this event (a live
+    # row's wblk is always < nwin by the consecutive-positions contract,
+    # so the clip above can only alias CLEAN slots, which drop here)
+    dirty = (wblk_d[:, :, None]
+             == jnp.arange(nwin)[None, None, :]).any(axis=1)  # [B, nw]
+    phys_d = jnp.where(dirty, wtab, nbp)        # clean/sentinel -> drop
+    flat = lambda a: a.reshape((B * nwin,) + a.shape[2:])
+    return (qpool.at[flat(phys_d)].set(flat(codes), mode="drop"),
+            scale.at[flat(phys_d)].set(flat(nsc), mode="drop"))
 
 
 # ---------------------------------------------------------------------------
@@ -1322,22 +1479,89 @@ def _fused_decode_kernel(tables_ref, lens_ref, wblk_ref, wable_ref,
         acc_ref[0, 0, 0] = acc_scr[:]
 
 
+def _fused_walk_page(b, s, p, tables_ref, lens_ref, bs: int, nbp: int,
+                     pages_per_shard: int):
+    """The fused walk's physical-page resolution over length + 1 (the walk
+    must include the append page); sentinel table entries clip to nbp - 1
+    — the caller's SPILL page in fused pools, so an unseated lane's reads
+    can never alias a live slot's write page.  The table column is clamped
+    to the table width like _resolve_page (the kernel-contract bounds
+    rule: j = s*P + p exceeds max_blocks when S*P rounds up, and lens is
+    runtime data).  ONE implementation shared by the payload and scale
+    index maps — a page's codes and its scale can never diverge
+    mid-walk by construction, not by parallel edits."""
+    j = s * pages_per_shard + p
+    n_live = jnp.maximum((lens_ref[b] + 1 + bs - 1) // bs, 1)
+    j_eff = jnp.clip(jnp.minimum(j, n_live - 1), 0,
+                     tables_ref.shape[1] - 1)
+    return jnp.clip(tables_ref[b, j_eff], 0, nbp - 1)
+
+
 def _fused_page_index_map(bs: int, nbp: int, pages_per_shard: int):
-    # the split-K physical-page resolution over length + 1 (the walk must
-    # include the append page); sentinel table entries clip to nbp - 1 —
-    # the caller's SPILL page in fused pools, so an unseated lane's reads
-    # can never alias a live slot's write page.  The table column is
-    # clamped to the table width like _resolve_page (the kernel-contract
-    # bounds rule: j = s*P + p exceeds max_blocks when S*P rounds up, and
-    # lens is runtime data)
     def idx(b, h, s, p, tables_ref, lens_ref, wblk_ref, wable_ref):
-        j = s * pages_per_shard + p
-        n_live = jnp.maximum((lens_ref[b] + 1 + bs - 1) // bs, 1)
-        j_eff = jnp.clip(jnp.minimum(j, n_live - 1), 0,
-                         tables_ref.shape[1] - 1)
-        return (jnp.clip(tables_ref[b, j_eff], 0, nbp - 1), h, 0, 0)
+        return (_fused_walk_page(b, s, p, tables_ref, lens_ref, bs, nbp,
+                                 pages_per_shard), h, 0, 0)
 
     return idx
+
+
+def _fused_small_in_specs(group: int, hd: int):
+    """The five small per-slot operands every fused decode launch streams
+    whole — q group, new k/v rows, cos/sin.  ONE spec set shared by the
+    fp and quant call builders (like ``_fused_walk_page`` for the page
+    maps): a geometry or clamp fix lands in both by construction."""
+    return [
+        pl.BlockSpec((1, 1, group, hd),
+                     lambda b, h, s, p, t, l, w, a: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, hd),
+                     lambda b, h, s, p, t, l, w, a: (b, h, 0)),
+        pl.BlockSpec((1, 1, hd),
+                     lambda b, h, s, p, t, l, w, a: (b, h, 0)),
+        pl.BlockSpec((1, hd),
+                     lambda b, h, s, p, t, l, w, a: (b, 0)),
+        pl.BlockSpec((1, hd),
+                     lambda b, h, s, p, t, l, w, a: (b, 0)),
+    ]
+
+
+def _fused_partials(b: int, nkv: int, S: int, group: int, hd: int):
+    """Split-K partial plumbing shared by the fused decode call builders:
+    (m, l, acc) out specs, their shapes, and the m/l/acc/roped-q VMEM
+    scratch both kernels park their recurrence in."""
+    part_spec = pl.BlockSpec((1, 1, 1, group, 1),
+                             lambda b, h, s, p, t, l, w, a: (b, h, s, 0, 0))
+    acc_spec = pl.BlockSpec((1, 1, 1, group, hd),
+                            lambda b, h, s, p, t, l, w, a: (b, h, s, 0, 0))
+    out_shapes = [
+        jax.ShapeDtypeStruct((b, nkv, S, group, 1), jnp.float32),
+        jax.ShapeDtypeStruct((b, nkv, S, group, 1), jnp.float32),
+        jax.ShapeDtypeStruct((b, nkv, S, group, hd), jnp.float32),
+    ]
+    scratch = [
+        _VMEM((group, 1), jnp.float32),
+        _VMEM((group, 1), jnp.float32),
+        _VMEM((group, hd), jnp.float32),
+        _VMEM((group, hd), jnp.float32),    # roped q
+    ]
+    return [part_spec, part_spec, acc_spec], out_shapes, scratch
+
+
+def _fused_write_page_spec(nbp: int, block: tuple):
+    """ALIASED-output spec pinned to the slot's write page (pool payload
+    when ``block`` is 4-d, per-(page, head) scale when 2-d).  The page id
+    is runtime data: clamp it to the pool like every other data-dependent
+    index — the engine always passes a valid page (own page or spill),
+    but the kernel-contract bounds rule (analysis/kernel_contracts.py)
+    requires the map itself to be safe for ALL prefetch values, not
+    safe-by-caller-convention."""
+    if len(block) == 4:
+        return pl.BlockSpec(
+            block,
+            lambda b, h, s, p, t, l, w, a: (jnp.clip(w[b], 0, nbp - 1),
+                                            h, 0, 0))
+    return pl.BlockSpec(
+        block,
+        lambda b, h, s, p, t, l, w, a: (jnp.clip(w[b], 0, nbp - 1), h))
 
 
 def _fused_decode_kernel_call(qg, k_new, v_new, cos, sin, key_cache,
@@ -1355,56 +1579,19 @@ def _fused_decode_kernel_call(qg, k_new, v_new, cos, sin, key_cache,
     kernel = functools.partial(_fused_decode_kernel, scale=scale, bs=bs,
                                pages_per_shard=P)
     kv_spec = pl.BlockSpec((1, 1, bs, hd), _fused_page_index_map(bs, nbp, P))
-    # the write-page id is runtime data: clamp it to the pool like every
-    # other data-dependent index — the engine always passes a valid page
-    # (own page or spill), but the kernel-contract bounds rule
-    # (analysis/kernel_contracts.py) requires the map itself to be safe
-    # for ALL prefetch values, not safe-by-caller-convention
-    pool_out_spec = pl.BlockSpec(
-        (1, 1, bs, hd),
-        lambda b, h, s, p, t, l, w, a: (jnp.clip(w[b], 0, nbp - 1),
-                                        h, 0, 0))
-    part_spec = pl.BlockSpec((1, 1, 1, group, 1),
-                             lambda b, h, s, p, t, l, w, a: (b, h, s, 0, 0))
+    pool_out_spec = _fused_write_page_spec(nbp, (1, 1, bs, hd))
+    part_specs, part_shapes, scratch = _fused_partials(b, nkv, S, group, hd)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(b, nkv, S, P),
-        in_specs=[
-            pl.BlockSpec((1, 1, group, hd),
-                         lambda b, h, s, p, t, l, w, a: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, hd),
-                         lambda b, h, s, p, t, l, w, a: (b, h, 0)),
-            pl.BlockSpec((1, 1, hd),
-                         lambda b, h, s, p, t, l, w, a: (b, h, 0)),
-            pl.BlockSpec((1, hd),
-                         lambda b, h, s, p, t, l, w, a: (b, 0)),
-            pl.BlockSpec((1, hd),
-                         lambda b, h, s, p, t, l, w, a: (b, 0)),
-            kv_spec,
-            kv_spec,
-        ],
-        out_specs=[
-            part_spec,
-            part_spec,
-            pl.BlockSpec((1, 1, 1, group, hd),
-                         lambda b, h, s, p, t, l, w, a: (b, h, s, 0, 0)),
-            pool_out_spec,
-            pool_out_spec,
-        ],
-        scratch_shapes=[
-            _VMEM((group, 1), jnp.float32),
-            _VMEM((group, 1), jnp.float32),
-            _VMEM((group, hd), jnp.float32),
-            _VMEM((group, hd), jnp.float32),    # roped q
-        ],
+        in_specs=_fused_small_in_specs(group, hd) + [kv_spec, kv_spec],
+        out_specs=part_specs + [pool_out_spec, pool_out_spec],
+        scratch_shapes=scratch,
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((b, nkv, S, group, 1), jnp.float32),
-            jax.ShapeDtypeStruct((b, nkv, S, group, 1), jnp.float32),
-            jax.ShapeDtypeStruct((b, nkv, S, group, hd), jnp.float32),
+        out_shape=part_shapes + [
             jax.ShapeDtypeStruct(key_cache.shape, key_cache.dtype),
             jax.ShapeDtypeStruct(value_cache.shape, value_cache.dtype),
         ],
@@ -1513,3 +1700,454 @@ def fused_decode_step(q, k_new, v_new, cos, sin, key_cache, value_cache,
         seq_lens, write_blk, writeable, scale, S)
     out = _flash_combine(m, l, acc).astype(q.dtype)
     return out[:, :, :rep].reshape(b, nh, hd), kc, vc
+
+
+# ---------------------------------------------------------------------------
+# fused decode step with in-kernel requantized KV append (megastep stage 2:
+# int8/packed-int4 pools take the fused path instead of requant scatters)
+# ---------------------------------------------------------------------------
+
+def _fused_quant_scale_index_map(bs: int, nbp: int, pages_per_shard: int):
+    # the per-(page, head) scale operands resolve through the SAME
+    # _fused_walk_page as the payload map
+    def idx(b, h, s, p, tables_ref, lens_ref, wblk_ref, wable_ref):
+        return (_fused_walk_page(b, s, p, tables_ref, lens_ref, bs, nbp,
+                                 pages_per_shard), h)
+
+    return idx
+
+
+def _fused_quant_decode_kernel(tables_ref, lens_ref, wblk_ref, wable_ref,
+                               q_ref, k_ref, v_ref, cos_ref, sin_ref,
+                               kp_ref, vp_ref, ks_ref, vs_ref,
+                               m_ref, l_ref, acc_ref,
+                               kp_out_ref, vp_out_ref, ks_out_ref,
+                               vs_out_ref,
+                               m_scr, l_scr, acc_scr, q_scr,
+                               kw_scr, vw_scr,
+                               *, scale, bs, pages_per_shard, kv_quant):
+    """Grid: (slots, kv_heads, shards, pages_per_shard) — the fused decode
+    walk (:func:`_fused_decode_kernel`) over int8/packed-int4 pages:
+
+    - every walked page is dequantized with its per-(page, head) scale
+      before the score dot (the decode kernel's dequant-on-read);
+    - at the write step the page is dequantized with its OLD scale, the
+      roped k row (raw v row) inserted, the page's scale RECOMPUTED and
+      the page requantized (:func:`_quant_encode_page` — the same encode
+      the XLA scatter arm uses, so the committed bytes are identical),
+      then codes AND new scale commit through ALIASED outputs pinned to
+      the write page;
+    - attention at the write step reads the requantize→dequantize round
+      trip — exactly the bytes the scatter arm's dequant-on-read would
+      see, which is what makes fused vs kill-switched token-identical;
+    - dropped lanes (``wable == 0``) commit zero codes and a zero scale to
+      the caller's SPILL page/scale entry (deterministic trash can, like
+      the fp kernel)."""
+    b = pl.program_id(0)
+    s_id = pl.program_id(2)
+    p = pl.program_id(3)
+    j = s_id * pages_per_shard + p                        # logical page
+    length = lens_ref[b] + 1                              # incl. appended tok
+    half = q_scr.shape[-1] // 2
+
+    @pl.when((s_id == 0) & (p == 0))
+    def _rope_q():
+        # rope in the INPUT dtype, exactly like the fp fused kernel (and
+        # the unfused arm's apply_rotary_pos_emb)
+        q = q_ref[0, 0]                                   # [group, hd]
+        cos = cos_ref[0][None, :]
+        sin = sin_ref[0][None, :]
+        q_r = (q * cos + _rotate_half_rows(q, half) * sin).astype(q.dtype)
+        q_scr[:] = q_r.astype(jnp.float32)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j * bs < length)
+    def _compute():
+        w_on = wable_ref[b] == 1
+        is_wpage = j == lens_ref[b] // bs
+        is_wstep = w_on & is_wpage
+        sc_k = ks_ref[0, 0]                               # scalar f32
+        sc_v = vs_ref[0, 0]
+        k_deq = _dequant_page_content(kp_ref[0, 0], sc_k, kv_quant)
+        v_deq = _dequant_page_content(vp_ref[0, 0], sc_v, kv_quant)
+
+        @pl.when(is_wpage)
+        def _append_commit():
+            # rope + insert + requantize ONLY at the write page: the
+            # other pages of a long walk (the latency-critical bulk)
+            # pay the dequant alone.  The write page is the LAST live
+            # page of the length+1 walk, so exactly one compute step
+            # per (slot, head) lane lands here.
+            # rope the new k in the input dtype (matching the scatter
+            # arm's apply_rotary_pos_emb); the f32 cast below mirrors
+            # quant_append_decode's rows.astype(f32) insert
+            cos = cos_ref[0][None, :]
+            sin = sin_ref[0][None, :]
+            k_new = k_ref[0, 0][None, :]                  # [1, hd]
+            k_roped = (k_new * cos + _rotate_half_rows(k_new, half) * sin
+                       ).astype(k_new.dtype)[0]
+            rows = jax.lax.broadcasted_iota(jnp.int32, k_deq.shape, 0)
+            ins = rows == lens_ref[b] % bs
+            k_ins = jnp.where(ins, k_roped.astype(jnp.float32)[None, :],
+                              k_deq)
+            v_ins = jnp.where(ins,
+                              v_ref[0, 0].astype(jnp.float32)[None, :],
+                              v_deq)
+            k_q, k_nsc = _quant_encode_page(k_ins, kv_quant)
+            v_q, v_nsc = _quant_encode_page(v_ins, kv_quant)
+            # dropped lanes flush zero codes + zero scale at the spill
+            # page (deterministic — uninitialized VMEM bits must never
+            # park on the spill page, same contract as the fp kernel)
+            zq = jnp.zeros_like(k_q)
+            kp_out_ref[0, 0] = jnp.where(w_on, k_q, zq)
+            vp_out_ref[0, 0] = jnp.where(w_on, v_q, zq)
+            ks_out_ref[0, 0] = jnp.where(w_on, k_nsc, jnp.float32(0.0))
+            vs_out_ref[0, 0] = jnp.where(w_on, v_nsc, jnp.float32(0.0))
+            # stage the requantize→dequantize round trip for the score
+            # dot — exactly the bytes the scatter arm's dequant-on-read
+            # would see (fused vs kill-switched token identity)
+            kw_scr[:] = _dequant_page_content(k_q, k_nsc, kv_quant)
+            vw_scr[:] = _dequant_page_content(v_q, v_nsc, kv_quant)
+
+        # non-write steps select the plain dequant; the scratch operand
+        # is only ever READ at the write step (where select — garbage in
+        # the unselected branch is discarded lane-wise)
+        k_eff = jnp.where(is_wstep, kw_scr[:], k_deq)
+        v_eff = jnp.where(is_wstep, vw_scr[:], v_deq)
+        _online_softmax_update(q_scr[:], k_eff, v_eff, j, bs, length,
+                               m_scr, l_scr, acc_scr, scale)
+
+    @pl.when(p == pages_per_shard - 1)
+    def _emit_partial():
+        m_ref[0, 0, 0] = m_scr[:]
+        l_ref[0, 0, 0] = l_scr[:]
+        acc_ref[0, 0, 0] = acc_scr[:]
+
+
+def _fused_quant_decode_kernel_call(qg, k_new, v_new, cos, sin, kq, ksc,
+                                    vq, vsc, block_tables, seq_lens,
+                                    write_blk, writeable, scale, num_shards,
+                                    kv_quant):
+    """qg: [b, nkv, group, hd] PRE-rope (group padded to sublane rows);
+    kq/vq: [nbp, nkv, bs, hd_store] int8 codes; ksc/vsc: [nbp, nkv] f32.
+    Returns (m, l, acc partials, new key codes, new value codes, new key
+    scales, new value scales)."""
+    b, nkv, group, hd = qg.shape
+    nbp, _, bs, hd_store = kq.shape
+    max_blocks = block_tables.shape[1]
+    S = num_shards
+    P = -(-max_blocks // S)                               # pages per shard
+
+    kernel = functools.partial(_fused_quant_decode_kernel, scale=scale,
+                               bs=bs, pages_per_shard=P, kv_quant=kv_quant)
+    kv_spec = pl.BlockSpec((1, 1, bs, hd_store),
+                           _fused_page_index_map(bs, nbp, P))
+    sc_spec = pl.BlockSpec((1, 1), _fused_quant_scale_index_map(bs, nbp, P))
+    pool_out_spec = _fused_write_page_spec(nbp, (1, 1, bs, hd_store))
+    scale_out_spec = _fused_write_page_spec(nbp, (1, 1))
+    part_specs, part_shapes, scratch = _fused_partials(b, nkv, S, group, hd)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, nkv, S, P),
+        in_specs=_fused_small_in_specs(group, hd) + [
+            kv_spec,
+            kv_spec,
+            sc_spec,
+            sc_spec,
+        ],
+        out_specs=part_specs + [
+            pool_out_spec,
+            pool_out_spec,
+            scale_out_spec,
+            scale_out_spec,
+        ],
+        scratch_shapes=scratch + [
+            _VMEM((bs, hd), jnp.float32),       # write-page k round trip
+            _VMEM((bs, hd), jnp.float32),       # write-page v round trip
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=part_shapes + [
+            jax.ShapeDtypeStruct(kq.shape, kq.dtype),
+            jax.ShapeDtypeStruct(vq.shape, vq.dtype),
+            jax.ShapeDtypeStruct(ksc.shape, ksc.dtype),
+            jax.ShapeDtypeStruct(vsc.shape, vsc.dtype),
+        ],
+        # pool codes + scales (global operand indices 9-12: four scalar-
+        # prefetch refs then five small operands precede them) alias their
+        # outputs — the requantized append is in-place, no pool copy
+        input_output_aliases={9: 3, 10: 4, 11: 5, 12: 6},
+        interpret=interpret_mode(),
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      write_blk.astype(jnp.int32), writeable.astype(jnp.int32),
+      qg, k_new, v_new, cos, sin, kq, vq,
+      ksc.astype(jnp.float32), vsc.astype(jnp.float32))
+
+
+def fused_quant_decode_step_reference(q, k_new, v_new, cos, sin, kq, ksc,
+                                      vq, vsc, block_tables, seq_lens,
+                                      write_blk, writeable, kv_quant,
+                                      scale=None):
+    """Oracle for the quantized fused decode step: the unfused
+    composition — rope in the INPUT dtype (``apply_rotary_pos_emb``), the
+    requantized-append scatter pair (:func:`quant_append_decode`: the
+    same ``_quant_encode_page`` the kernel calls, so the pool bytes match
+    exactly), then dequant-on-read gather attention over
+    ``seq_lens + 1``."""
+    from . import rope as rope_mod
+
+    b, nh, hd = q.shape
+    nbp, nkv, bs, _ = kq.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    q_r, k_r = rope_mod.apply_rotary_pos_emb(
+        q[:, None], k_new[:, None], cos[:, None, :], sin[:, None, :])
+    q_r, k_r = q_r[:, 0], k_r[:, 0]
+    off = seq_lens % bs
+    kq2, ks2 = quant_append_decode(kq, ksc, k_r, write_blk, off, writeable,
+                                   kv_quant)
+    vq2, vs2 = quant_append_decode(vq, vsc, v_new, write_blk, off,
+                                   writeable, kv_quant)
+    out = paged_attention_reference(q_r, kq2, vq2, block_tables,
+                                    seq_lens + 1, scale=scale,
+                                    kv_quant=kv_quant, k_scale=ks2,
+                                    v_scale=vs2)
+    return out, kq2, ks2, vq2, vs2
+
+
+def fused_quant_decode_step(q, k_new, v_new, cos, sin, kq, ksc, vq, vsc,
+                            block_tables, seq_lens, write_blk, writeable,
+                            kv_quant, scale=None, num_shards=None):
+    """Fused RoPE + requantized KV-page append + split-K dequant-on-read
+    attention for ONE decode token per slot over int8/packed-int4 pools —
+    the quantized-serving member of decode megastep stage 2
+    (docs/paged_attention.md "Megastep stage 2").
+
+    Args mirror :func:`fused_decode_step` with the fp pools replaced by
+    quantized storage: ``kq``/``vq`` [nbp, nkv, block_size, hd_store]
+    int8 codes (hd_store = head_dim, or head_dim // 2 packed int4),
+    ``ksc``/``vsc`` [nbp, nkv] f32 per-(page, head) scales.  In the
+    serving engine nbp = num_blocks + 1 (the spill page — dropped lanes
+    commit zero codes and a zero scale there).
+
+    Returns ``(out [b, nh, hd], kq, ksc, vq, vsc)`` — attention over
+    columns < seq_lens + 1 with the pools and scales updated in place
+    (aliased).  Dispatch: the fused quant kernel when
+    :func:`kernel_supported`; ``PADDLE_TPU_DISABLE_PALLAS=
+    fused_quant_append`` (or ``fused_decode_step``, which kills the whole
+    fused decode family, or an unsupported shape) routes to the
+    requant-scatter reference composition — byte-identical pool contents
+    by construction (shared ``_quant_encode_page``)."""
+    global QUANT_APPEND_KERNEL_CALLS, QUANT_APPEND_FALLBACK_CALLS, \
+        LAST_FLASH_SHARDS
+    assert kv_quant in ("int8", "int4"), kv_quant
+    b, nh, hd = q.shape
+    nbp, nkv, bs, hd_store = kq.shape
+    if kv_quant == "int4":
+        assert hd_store * 2 == hd, (hd_store, hd)
+    else:
+        assert hd_store == hd, (hd_store, hd)
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    if (not kernel_supported(nh, nkv, hd, bs)
+            or kernel_disabled("fused_decode_step")
+            or kernel_disabled("fused_quant_append")):
+        QUANT_APPEND_FALLBACK_CALLS += 1
+        return fused_quant_decode_step_reference(
+            q, k_new, v_new, cos, sin, kq, ksc, vq, vsc, block_tables,
+            seq_lens, write_blk, writeable, kv_quant, scale=scale)
+    QUANT_APPEND_KERNEL_CALLS += 1
+
+    S = 1
+    if not kernel_disabled("flash_decode"):
+        S = flash_decode_shards(block_tables.shape[1], num_shards)
+    if S > 1:
+        LAST_FLASH_SHARDS = S
+    rep = nh // nkv
+    group = _round_up(rep, _MIN_GROUP_ROWS)
+    qg = q.reshape(b, nkv, rep, hd)
+    if group != rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, group - rep), (0, 0)))
+    m, l, acc, kq2, vq2, ks2, vs2 = _fused_quant_decode_kernel_call(
+        qg, k_new, v_new, cos, sin, kq, ksc, vq, vsc, block_tables,
+        seq_lens, write_blk, writeable, scale, S, kv_quant)
+    out = _flash_combine(m, l, acc).astype(q.dtype)
+    return out[:, :, :rep].reshape(b, nh, hd), kq2, ks2, vq2, vs2
+
+
+# ---------------------------------------------------------------------------
+# fused post-attention layer half: residual + RMSNorm + SwiGLU MLP
+# (decode megastep stage 2 — docs/paged_attention.md "Megastep stage 2")
+# ---------------------------------------------------------------------------
+
+#: ffn-column block the MLP weights stream in per grid step (HBM→VMEM,
+#: double-buffered by the Pallas pipeline); 256 keeps the three weight
+#: blocks of a production layer (2·h·F + F·h elements) well under the
+#: 16 MiB VMEM floor with headroom for the resident activations
+_MLP_BLOCK_COLS = 256
+
+
+def fused_mlp_block_cols(inter: int) -> int:
+    """ffn-dim block width for the fused MLP launch: the largest divisor
+    of ``inter`` that is <= :data:`_MLP_BLOCK_COLS` and a sublane multiple
+    (so the grid tiles the weights exactly); tiny/odd ffn widths fall back
+    to a single whole block."""
+    if inter <= _MLP_BLOCK_COLS:
+        return inter
+    for f in range(_MLP_BLOCK_COLS, 7, -8):
+        if inter % f == 0:
+            return f
+    return inter
+
+
+def fused_mlp_supported(hidden: int, inter: int) -> bool:
+    """Dispatch predicate for :func:`fused_layer_mlp` — pltpu
+    availability, sublane-aligned dims, and the operational opt-out
+    (``PADDLE_TPU_DISABLE_PALLAS=fused_layer_mlp``)."""
+    return (_VMEM is not None
+            and hidden % 8 == 0
+            and inter % 8 == 0
+            and not kernel_disabled("fused_layer_mlp"))
+
+
+def _fused_mlp_kernel(x_ref, ay_ref, w_ref, wg_ref, wu_ref, wd_ref,
+                      h1_ref, y_ref, xn_scr, acc_scr, *, eps):
+    """Grid: (ffn_blocks,) — the post-attention half of one decoder layer
+    for a decode step's [B, h] activations:
+
+    - step 0 computes the residual add ``h1 = x + attn_y`` (input dtype,
+      matching the XLA add) and the post RMSNorm in f32 (exactly
+      rms_norm's kernel math), parking the rounded ``xn`` in f32 scratch;
+    - every step streams one (h, F) block of w_gate/w_up and the matching
+      (F, h) block of w_down from HBM (the Pallas pipeline double-buffers
+      the fetches), computes the block's swiglu activation in the input
+      dtype (silu in f32 — swiglu's exact math) and accumulates the down
+      projection in f32 scratch;
+    - ``h1`` and the running ``y`` are written every step (consecutive
+      revisits of the same output block), so the final flush carries the
+      completed layer half."""
+    j = pl.program_id(0)
+    h1 = x_ref[:] + ay_ref[:]                     # residual add, input dtype
+
+    @pl.when(j == 0)
+    def _prologue():
+        xf = h1.astype(jnp.float32)
+        ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        inv = jax.lax.rsqrt(ms + eps)
+        xn = (xf * inv * w_ref[:].astype(jnp.float32)).astype(h1.dtype)
+        xn_scr[:] = xn.astype(jnp.float32)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # xn was rounded to the input dtype before parking in f32 scratch, so
+    # this cast is an exact round trip: the gate/up dots see the same
+    # operand bytes the unfused xn @ w_gate reads
+    xn = xn_scr[:].astype(h1.dtype)
+    g = xn @ wg_ref[:]                            # [B, F], input dtype
+    u = xn @ wu_ref[:]
+    act = (jax.nn.silu(g.astype(jnp.float32))
+           * u.astype(jnp.float32)).astype(h1.dtype)   # swiglu's math
+    acc_scr[:] += jax.lax.dot_general(
+        act, wd_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    h1_ref[:] = h1
+    y_ref[:] = acc_scr[:].astype(y_ref.dtype)
+
+
+def _fused_mlp_kernel_call(x, attn_y, norm_w, w_gate, w_up, w_down, eps):
+    Bp, h = x.shape
+    inter = w_gate.shape[1]
+    F = fused_mlp_block_cols(inter)
+    kernel = functools.partial(_fused_mlp_kernel, eps=eps)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(inter // F,),
+        in_specs=[
+            pl.BlockSpec((Bp, h), lambda j: (0, 0)),
+            pl.BlockSpec((Bp, h), lambda j: (0, 0)),
+            pl.BlockSpec((h,), lambda j: (0,)),
+            pl.BlockSpec((h, F), lambda j: (0, j)),
+            pl.BlockSpec((h, F), lambda j: (0, j)),
+            pl.BlockSpec((F, h), lambda j: (j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((Bp, h), lambda j: (0, 0)),
+            pl.BlockSpec((Bp, h), lambda j: (0, 0)),
+        ],
+        scratch_shapes=[
+            _VMEM((Bp, h), jnp.float32),
+            _VMEM((Bp, h), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((Bp, h), x.dtype),
+            jax.ShapeDtypeStruct((Bp, h), x.dtype),
+        ],
+        interpret=interpret_mode(),
+    )(x, attn_y, norm_w, w_gate, w_up, w_down)
+
+
+def fused_layer_mlp_reference(x, attn_y, norm_w, w_gate, w_up, w_down, eps):
+    """The unfused composition (oracle + fallback): residual add, the
+    rms_norm op (which itself dispatches the rms Pallas kernel — this IS
+    the pre-fusion program), swiglu MLP.  Returns ``(h1, y)`` with the
+    down projection UN-reduced: the caller owns the TP psum boundary and
+    the closing residual add (models/llama.decoder_layer_tail)."""
+    from . import rms_norm as rms
+    from . import swiglu as swiglu_mod
+
+    h1 = x + attn_y
+    xn = rms.rms_norm(h1, norm_w, eps)
+    y = swiglu_mod.swiglu(xn @ w_gate, xn @ w_up) @ w_down
+    return h1, y
+
+
+def fused_layer_mlp(x, attn_y, norm_w, w_gate, w_up, w_down, eps):
+    """Fused post-attention layer half for the decode hot path: residual
+    add + post RMSNorm + SwiGLU MLP in ONE Pallas launch, MLP weights
+    streamed HBM→VMEM in ffn-column blocks per grid step (double-buffered
+    by the pipeline).
+
+    Args:
+      x: [B, h] residual stream entering the layer half.
+      attn_y: [B, h] attention output projection AFTER the TP psum
+        (``psum(attn @ wo)`` — the kernel must see the completed sum, so
+        the all-reduce boundary stays outside, exactly where PR 7 put it).
+      norm_w: [h] post-norm weight; w_gate/w_up: [h, inter] column blocks
+        (tp-local slice under TP); w_down: [inter, h].
+      eps: rms epsilon.
+
+    Returns ``(h1 [B, h], y [B, h])``: ``h1 = x + attn_y`` (the layer's
+    next residual anchor) and ``y`` the UN-reduced down projection — the
+    caller closes the layer with ``h1 + psum(y)``.  Dispatches to the
+    Pallas kernel when :func:`fused_mlp_supported`; the
+    ``PADDLE_TPU_DISABLE_PALLAS=fused_layer_mlp`` opt-out (or an
+    unsupported shape) routes to the unfused reference composition."""
+    global MLP_KERNEL_CALLS, MLP_FALLBACK_CALLS
+    B, h = x.shape
+    inter = w_gate.shape[1]
+    if not fused_mlp_supported(h, inter):
+        MLP_FALLBACK_CALLS += 1
+        return fused_layer_mlp_reference(x, attn_y, norm_w, w_gate, w_up,
+                                         w_down, eps)
+    MLP_KERNEL_CALLS += 1
+    Bp = _round_up(B, _MIN_GROUP_ROWS)
+    xp, ayp = x, attn_y
+    if Bp != B:
+        # pad the row dim to a full sublane; zero rows rms-normalize to
+        # zeros (rsqrt(eps) * 0), sliced off below
+        pad = ((0, Bp - B), (0, 0))
+        xp = jnp.pad(x, pad)
+        ayp = jnp.pad(attn_y, pad)
+    h1, y = _fused_mlp_kernel_call(xp, ayp, norm_w, w_gate, w_up, w_down,
+                                   float(eps))
+    return h1[:B], y[:B]

@@ -24,11 +24,21 @@ def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
     o_ref[:] = (x * inv * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
 
 
+def rms_norm_ref(x, w, eps=1e-6):
+    """The pure-jnp composition (the kernel's exact f32 math, no Pallas
+    launch): the ``rms_norm`` kill-switch fallback, and the inline form
+    the fused-layer decode path uses where a separate launch on [B, 1, h]
+    activations is pure dispatch tax (inference.transformer_apply,
+    docs/paged_attention.md "Megastep stage 2" — XLA fuses this into the
+    neighboring matmuls)."""
+    xf = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+    return (xf * inv * w.astype(jnp.float32)).astype(x.dtype)
+
+
 def _rms_fwd_pallas(x2d, w, eps):
     if kernel_disabled("rms_norm"):
-        xf = x2d.astype(jnp.float32)
-        inv = jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
-        return (xf * inv * w.astype(jnp.float32)).astype(x2d.dtype)
+        return rms_norm_ref(x2d, w, eps)
     rows, d = x2d.shape
     br = min(rows, 256)
     # pad ragged row counts up to the block grid instead of collapsing to a

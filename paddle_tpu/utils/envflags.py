@@ -53,13 +53,20 @@ with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``, cross-checked
 against the actual ``kernel_disabled()`` dispatch sites by the
 KNOWN_KERNELS drift lint (analysis/kernel_contracts.py, run by
 tools/lint_gate.py) so a retired kernel cannot leave a dead kill switch
-registered.  Two of its
+registered.  Four of its
 tokens are per-path decode kill switches rather than whole-kernel opt-outs
 (docs/paged_attention.md): ``flash_decode`` pins the paged decode kernel to
-the sequential page walk (split-K off), and ``fused_decode_step`` rebuilds
-the serving engine's unfused rope + KV-scatter + attention decode path
-(``paged_attention`` still opts the whole family out to the gather oracle).
-Both are registered in ``KNOWN_KERNELS`` so a typo gets the did-you-mean
+the sequential page walk (split-K off), ``fused_decode_step`` rebuilds
+the serving engine's unfused rope + KV-scatter + attention decode path,
+``fused_layer_mlp`` restores the stage-1 per-layer program (separate
+rms_norm launch + XLA-composed MLP; "Megastep stage 2" in the doc), and
+``fused_quant_append`` unfuses the whole decode step for int8/packed-int4
+KV pools — the requant-scatter append comes back (4 scatters/step) along
+with the separate per-layer launches, exactly like ``fused_decode_step``
+does for fp pools; dequant-on-read attention itself survives in the
+unfused kernel (``paged_attention`` still opts the whole family out to the
+gather oracle).
+All four are registered in ``KNOWN_KERNELS`` so a typo gets the did-you-mean
 warning instead of silently leaving the kernel it meant to disable running.
 ``PADDLE_TPU_FAULT_INJECT`` is the structured fault-injection plan; its
 clause grammar is validated by :func:`env_fault_spec` and its fault-kind

@@ -725,3 +725,181 @@ def test_paged_prefill_under_jit_and_bf16():
         assert float(jnp.max(jnp.abs(
             out[b_, :ql].astype(jnp.float32)
             - ref[b_, :ql].astype(jnp.float32)))) <= 1e-2
+
+
+# ---------------------------------------------------------------------------
+# requantized KV append (decode megastep stage 2 — ISSUE 15,
+# docs/paged_attention.md "Megastep stage 2")
+# ---------------------------------------------------------------------------
+
+def _quant_fused_case(rs, mode, *, lens, nbl=10, nkv=2, bs=8, hd=16, nh=4,
+                      mb=4, dtype=jnp.float32):
+    """Quantized pools WITH a spill page + per-slot write geometry derived
+    from lens (None = inactive lane -> spill)."""
+    B = len(lens)
+    nbp = nbl + 1
+    kc = jnp.asarray(rs.randn(nbp, nkv, bs, hd), jnp.float32)
+    vc = jnp.asarray(rs.randn(nbp, nkv, bs, hd), jnp.float32)
+    kq, ks = pa.quantize_kv_cache(kc, mode)
+    vq, vs = pa.quantize_kv_cache(vc, mode)
+    tables = np.full((B, mb), nbl, np.int32)
+    pool = list(rs.permutation(nbl))
+    wblk, wable, lens_i = [], [], []
+    for b, ln in enumerate(lens):
+        if ln is None:
+            wblk.append(nbl)
+            wable.append(0)
+            lens_i.append(0)
+            continue
+        n_pages = ln // bs + 1
+        pages = [pool.pop() for _ in range(n_pages)]
+        tables[b, :n_pages] = pages
+        wblk.append(pages[ln // bs])
+        wable.append(1)
+        lens_i.append(ln)
+    q = jnp.asarray(rs.randn(B, nh, hd), dtype)
+    kn = jnp.asarray(rs.randn(B, nkv, hd), dtype)
+    vn = jnp.asarray(rs.randn(B, nkv, hd), dtype)
+    cos = jnp.asarray(rs.randn(B, hd), dtype)
+    sin = jnp.asarray(rs.randn(B, hd), dtype)
+    return (q, kn, vn, cos, sin, kq, ks, vq, vs, jnp.asarray(tables),
+            jnp.asarray(lens_i, jnp.int32), jnp.asarray(wblk, jnp.int32),
+            jnp.asarray(wable, jnp.int32))
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+@pytest.mark.parametrize("lens", [
+    [3, 15],          # mid-page appends
+    [8, 16],          # PAGE BOUNDARY: seq_len % bs == 0 -> fresh page, off 0
+    [7, 23],          # off == bs - 1: the append FILLS its page
+])
+def test_fused_quant_step_codes_and_scales_byte_vs_oracle(mode, lens):
+    """The fused quant kernel's committed page bytes AND recomputed
+    per-page scales match the requant-scatter oracle composition exactly
+    (both arms jitted: they share _quant_encode_page, so the pool state is
+    byte-identical by construction); attention output at f32 tolerance
+    (the split-K combine reorders the reduction)."""
+    rs = np.random.RandomState(60)
+    case = _quant_fused_case(rs, mode, lens=lens)
+    pa.reset_kernel_counters()
+    out, kq2, ks2, vq2, vs2 = jax.jit(
+        lambda *a: pa.fused_quant_decode_step(*a, mode))(*case)
+    assert pa.QUANT_APPEND_KERNEL_CALLS == 1, "kernel path not taken"
+    ref_o, kq_r, ks_r, vq_r, vs_r = jax.jit(
+        lambda *a: pa.fused_quant_decode_step_reference(*a, mode))(*case)
+    np.testing.assert_array_equal(np.asarray(kq2), np.asarray(kq_r))
+    np.testing.assert_array_equal(np.asarray(vq2), np.asarray(vq_r))
+    np.testing.assert_array_equal(np.asarray(ks2), np.asarray(ks_r))
+    np.testing.assert_array_equal(np.asarray(vs2), np.asarray(vs_r))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_o),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_fused_quant_step_spill_page_isolation(mode):
+    """Non-writeable lanes (inactive / pos >= max_seq) land on the spill
+    page: every REAL page's codes and scales are byte-untouched, and the
+    live lane still appends correctly."""
+    rs = np.random.RandomState(61)
+    case = _quant_fused_case(rs, mode, lens=[5, None])
+    kq0, ks0 = np.asarray(case[5]).copy(), np.asarray(case[6]).copy()
+    nbl = kq0.shape[0] - 1
+    pa.reset_kernel_counters()
+    out, kq2, ks2, vq2, vs2 = jax.jit(
+        lambda *a: pa.fused_quant_decode_step(*a, mode))(*case)
+    assert pa.QUANT_APPEND_KERNEL_CALLS == 1
+    wblk = int(case[11][0])
+    touched = {wblk, nbl}                       # live write page + spill
+    for p in range(nbl):
+        if p not in touched:
+            np.testing.assert_array_equal(np.asarray(kq2)[p], kq0[p])
+            np.testing.assert_array_equal(np.asarray(ks2)[p], ks0[p])
+    # the dropped lane's output is still finite (masked attention)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_fused_quant_step_disable_env_routes_to_oracle(mode, monkeypatch):
+    """PADDLE_TPU_DISABLE_PALLAS=fused_quant_append routes to the
+    requant-scatter reference with byte-identical pool state (counter
+    evidence both ways); =fused_decode_step kills the quant member too."""
+    rs = np.random.RandomState(62)
+    case = _quant_fused_case(rs, mode, lens=[3, 12])
+    monkeypatch.delenv("PADDLE_TPU_DISABLE_PALLAS", raising=False)
+    pa.reset_kernel_counters()
+    _, kq_on, ks_on, _, _ = pa.fused_quant_decode_step(*case, mode)
+    assert pa.QUANT_APPEND_KERNEL_CALLS == 1
+
+    for token in ("fused_quant_append", "fused_decode_step"):
+        monkeypatch.setenv("PADDLE_TPU_DISABLE_PALLAS", token)
+        pa.reset_kernel_counters()
+        o, kq2, ks2, vq2, vs2 = pa.fused_quant_decode_step(*case, mode)
+        assert (pa.QUANT_APPEND_FALLBACK_CALLS == 1
+                and pa.QUANT_APPEND_KERNEL_CALLS == 0), token
+        _, kq_r, ks_r, _, _ = pa.fused_quant_decode_step_reference(*case,
+                                                                   mode)
+        np.testing.assert_array_equal(np.asarray(kq2), np.asarray(kq_r))
+        np.testing.assert_array_equal(np.asarray(ks2), np.asarray(ks_r))
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_quant_append_rows_rewrites_only_dirty_pages(mode):
+    """The multi-row append (prefill bucket / chunk / verify window)
+    recomputes scales for DIRTY pages only: pages receiving no row this
+    event — shared prefix-cache pages — keep their exact bytes, and each
+    dirty page matches the dequant-insert-encode oracle computed once over
+    the whole event."""
+    rs = np.random.RandomState(63)
+    nbl, nkv, bs, hd, mb = 8, 2, 8, 16, 4
+    nbp = nbl + 1
+    kc = jnp.asarray(rs.randn(nbp, nkv, bs, hd), jnp.float32)
+    qpool, sc = pa.quantize_kv_cache(kc, mode)
+    q0, s0 = np.asarray(qpool).copy(), np.asarray(sc).copy()
+    table = jnp.asarray(rs.permutation(nbl)[:2 * mb].reshape(2, mb),
+                        jnp.int32)
+    # slot 0: rows 13..18 (crosses the page-1/page-2 boundary); slot 1:
+    # 2 valid rows + 4 masked
+    T = 6
+    row_pos = jnp.asarray([[13, 14, 15, 16, 17, 18],
+                           [3, 4, 0, 0, 0, 0]], jnp.int32)
+    valid = jnp.asarray([[1, 1, 1, 1, 1, 1],
+                         [1, 1, 0, 0, 0, 0]], jnp.bool_)
+    rows = jnp.asarray(rs.randn(2, T, nkv, hd), jnp.float32)
+    out_q, out_s = pa.quant_append_rows(qpool, sc, rows, table, row_pos,
+                                        valid, mode)
+    out_q, out_s = np.asarray(out_q), np.asarray(out_s)
+    dirty = {}     # phys page -> [(local off, (slot, row))]
+    for b in range(2):
+        for t in range(T):
+            if bool(valid[b, t]):
+                phys = int(table[b, int(row_pos[b, t]) // bs])
+                dirty.setdefault(phys, []).append(
+                    (int(row_pos[b, t]) % bs, (b, t)))
+    for p in range(nbp):
+        if p not in dirty:
+            np.testing.assert_array_equal(out_q[p], q0[p], str(p))
+            np.testing.assert_array_equal(out_s[p], s0[p], str(p))
+    for p, hits in dirty.items():
+        deq = np.array(pa._dequant_page_content(
+            jnp.asarray(q0[p]), jnp.asarray(s0[p]), mode))
+        for off, (b, t) in hits:
+            deq[:, off, :] = np.asarray(rows[b, t])
+        want_q, want_s = pa._quant_encode_page(jnp.asarray(deq), mode)
+        np.testing.assert_array_equal(out_q[p], np.asarray(want_q), str(p))
+        np.testing.assert_array_equal(out_s[p], np.asarray(want_s), str(p))
+
+
+def test_quant_encode_page_matches_quantize_kv_cache():
+    """_quant_encode_page (the ONE encode implementation the scatter arm
+    and the fused kernel share) reproduces quantize_kv_cache's codes,
+    scales and int4 nibble layout on whole-pool content."""
+    rs = np.random.RandomState(64)
+    kc = jnp.asarray(rs.randn(5, 3, 8, 16), jnp.float32)
+    for mode in ("int8", "int4"):
+        want_q, want_s = pa.quantize_kv_cache(kc, mode)
+        got_q, got_s = pa._quant_encode_page(kc.astype(jnp.float32), mode)
+        np.testing.assert_array_equal(np.asarray(got_q), np.asarray(want_q))
+        np.testing.assert_array_equal(np.asarray(got_s), np.asarray(want_s))
+        back = pa._dequant_page_content(got_q, got_s, mode)
+        tol = 0.03 if mode == "int8" else 0.5
+        assert float(jnp.max(jnp.abs(back - kc))) < tol

@@ -107,6 +107,33 @@ TARGETS = {
         "llama_cb_decode_tokens_per_sec/cb_fleet_hosttier",
     "cb_fleet_hosttier_cpu_smoke":
         "llama_cb_decode_tokens_per_sec/cb_fleet_hosttier_cpu_smoke",
+    # round-19 evidence rungs: decode megastep stage 2 (ISSUE 15,
+    # docs/paged_attention.md "Megastep stage 2").  (a) quantized-pool
+    # fused-append A/B on the 32k-skew workload — int8 and packed-int4
+    # pools with the in-kernel requantized append on (0 scatters/step)
+    # vs off (the requant-scatter path quantized serving paid before
+    # stage 2); exact keys so the fused arm can never satisfy its own
+    # scatter baseline.  (b) the launch-bound pair — small-batch
+    # short-context dispatch-tax regime, stage-2 fused MLP (2 launches/
+    # layer) vs the stage-1 arm (3); exact keys for the same reason.
+    # The cpu smokes run on BOTH backends (fleet-smoke convention).
+    "cb_longctx_quant_fused":
+        "llama_cb_decode_tbt_p99_ms/cb_longctx_quant_fused",
+    "cb_longctx_quant_scatter":
+        "llama_cb_decode_tbt_p99_ms/cb_longctx_quant_scatter",
+    "cb_longctx_quant_fused_int4":
+        "llama_cb_decode_tbt_p99_ms/cb_longctx_quant_fused_int4",
+    "cb_longctx_quant_scatter_int4":
+        "llama_cb_decode_tbt_p99_ms/cb_longctx_quant_scatter_int4",
+    "cb_longctx_quant_cpu_smoke":
+        "llama_cb_decode_tbt_p99_ms/cb_longctx_quant_cpu_smoke",
+    "cb_longctx_quant_scatter_cpu_smoke":
+        "llama_cb_decode_tbt_p99_ms/cb_longctx_quant_scatter_cpu_smoke",
+    "cb_launchbound": "llama_cb_decode_tbt_p99_ms/cb_launchbound",
+    "cb_launchbound_stage1":
+        "llama_cb_decode_tbt_p99_ms/cb_launchbound_stage1",
+    "cb_launchbound_cpu_smoke":
+        "llama_cb_decode_tbt_p99_ms/cb_launchbound_cpu_smoke",
 }
 
 
