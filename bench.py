@@ -1283,6 +1283,47 @@ def decode_ladder_main(compact: bool = False) -> int:
             log(f"cb fleet rung {rung[0]} failed: {e}\n"
                 f"{traceback.format_exc()}")
             continue
+    # async-host-runtime A/B rungs (ISSUE 16, docs/async_runtime.md): the
+    # SAME open-loop fleet workload with the async host runtime ON
+    # (incremental journal + pipelined stepping) vs OFF (serial
+    # fetch-then-bookkeep loop + per-step full snapshot() rebuilds) —
+    # headline is decode TBT p99, detail carries host_gap_seconds
+    # p50/p99/mean and the journal counters; acceptance reads the async
+    # arm's host_gap figures strictly below the off arm's with
+    # journal_full_rebuilds == 0.  cb_fleet_asynchost re-arms the fleet
+    # chaos crash on the async arm: failover replays through the
+    # incremental journal, not a snapshot rebuild.  Both CPU smokes run
+    # on BOTH arms — the A/B needs both sides banked to compare.
+    # (rung tuple: cfg, n_replicas, slots/replica, n_requests, prompt,
+    # new, max_seq, num_blocks, block_size, max_queue, arrive_every,
+    # async_on, fault_spec[, prefill_chunk])
+    # The plain A/B arms run a SINGLE saturated replica (arrive_every=1,
+    # queue sized for every request): pooling gaps across replicas would
+    # count replica A's device time as replica B's "host gap" and drown
+    # the journal tax in idle noise.  The chaos variant keeps 3 replicas
+    # — its job is the failover path, not the gap figure.
+    smoke_async = [
+        ("cb_asynchost_cpu_smoke", llama.LlamaConfig.tiny(), 1, 4, 48,
+         20, 24, 64, 40, 8, 44, 1, True, "", 8),
+        ("cb_asynchost_off_cpu_smoke", llama.LlamaConfig.tiny(), 1, 4,
+         48, 20, 24, 64, 40, 8, 44, 1, False, "", 8),
+    ]
+    asynchost_rungs = ([
+        ("cb_asynchost", full_cfg, 1, 8, 48, 96, 48, 512, 48, 64, 48, 1,
+         True, "", 32),
+        ("cb_asynchost_off", full_cfg, 1, 8, 48, 96, 48, 512, 48, 64,
+         48, 1, False, "", 32),
+        ("cb_fleet_asynchost", full_cfg, 3, 8, 48, 96, 48, 512, 48, 64,
+         16, 2, True, "replica_crash@step=40,replica=1", 32),
+    ] + smoke_async if on_tpu else smoke_async)
+    for rung in asynchost_rungs:
+        try:
+            emit(run_cb_asynchost_rung(*rung))
+            banked += 1
+        except Exception as e:
+            log(f"cb asynchost rung {rung[0]} failed: {e}\n"
+                f"{traceback.format_exc()}")
+            continue
     return 0 if banked else 1
 
 
@@ -2027,6 +2068,270 @@ def run_cb_fleet_rung(name, cfg, n_replicas, max_batch, n_requests, prompt,
                    "flight_dumps": ([d["reason"]
                                      for d in fleet._flight.dumps]
                                     if fleet._flight is not None else None),
+                   "backend": jax.default_backend(),
+                   **_obs_detail(fleet)},
+    }
+
+
+def _hist_stats_s(hists):
+    """Pooled (p50_s, p99_s, mean_s, count) across log2-bucket histogram
+    children (observability._HistValue).  Percentiles report the bucket
+    UPPER bound where the pooled cumulative count crosses p — coarse by
+    design (factor-2 buckets); the mean is exact (sum/count), so it is
+    the figure the asynchost A/B's strictly-lower comparison reads."""
+    import math
+
+    hs = [h for h in hists if h is not None and h.count]
+    if not hs:
+        return None, None, None, 0
+    lo = hs[0]._lo
+    n = max(h._n for h in hs)
+    counts = [0] * n
+    for h in hs:
+        for i, c in enumerate(h.counts):
+            counts[i] += c
+    total = sum(counts)
+    mean = sum(h.sum for h in hs) / total
+
+    def pctile(p):
+        target = p * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= target:
+                return math.inf if i == n - 1 else 2.0 ** (lo + i)
+        return math.inf
+
+    return pctile(0.50), pctile(0.99), mean, total
+
+
+def _reset_hist(h):
+    """Zero one histogram child in place (post-warmup hygiene: the timed
+    window's host-gap figures must not include compile-time gaps)."""
+    if h is not None:
+        h.counts = [0] * h._n
+        h.sum = 0.0
+        h.count = 0
+
+
+class _GapTap:
+    """Drop-in for a histogram child that ALSO keeps every exact
+    observation.  The asynchost A/B needs exact host-gap percentiles —
+    the serial arm's journal tax is a fraction of a log2 bucket, so the
+    bucketed p99 cannot resolve it — and `_HistValue` is __slots__'d, so
+    the rung swaps the engine's `_h_hostgap` reference for this wrapper
+    instead of monkeypatching `observe`."""
+
+    def __init__(self, inner, acc):
+        self._inner = inner
+        self._acc = acc
+
+    def observe(self, v):
+        self._acc.append(float(v))
+        if self._inner is not None:
+            self._inner.observe(v)
+
+
+def _exact_stats_s(vals):
+    """(p50_s, p99_s, mean_s, n) of an exact observation list."""
+    if not vals:
+        return None, None, None, 0
+    s = sorted(vals)
+    n = len(s)
+    pick = lambda p: s[min(n - 1, max(0, int(round(p * (n - 1)))))]
+    return pick(0.50), pick(0.99), sum(s) / n, n
+
+
+def run_cb_asynchost_rung(name, cfg, n_replicas, max_batch, n_requests,
+                          prompt, new, max_seq, num_blocks, block_size,
+                          max_queue, arrive_every, async_on, fault_spec="",
+                          prefill_chunk=8):
+    """Async-host-runtime A/B rung (ISSUE 16, docs/async_runtime.md):
+    open-loop arrivals over a full-feature fleet with the async host
+    runtime ON (incremental journal + host/device pipelined stepping) vs
+    OFF (the serial loop: token fetch first, then bookkeeping, plus the
+    router's full per-step/per-dispatch snapshot() journal rebuilds —
+    exactly the host tax the fleet paid before this PR).  Fleet-based so
+    the serial arm genuinely pays the per-replica snapshot() rebuilds the
+    async arm eliminates.
+
+    Headline = decode TBT p99 (ms) over pooled per-request token-arrival
+    gaps — the figure host-side dispatch tax inflates.  Detail carries
+    ``host_gap_seconds`` p50/p99/mean (pooled across replicas, reset
+    after warmup so only the timed window counts), the journal counters
+    (``journal_full_rebuilds`` MUST be 0 on the async arm in steady
+    state — rebuilds only at adopt/restore boundaries) and
+    ``host_overlap_steps``.  ``fault_spec`` arms the chaos variant
+    (cb_fleet_asynchost): a replica_crash mid-serve, failover replaying
+    through the incremental journal."""
+    import os
+
+    import numpy as np
+    import jax
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.inference.fleet import FleetRouter
+    from paddle_tpu.inference.serving import Request, TERMINAL_STATUSES
+
+    log(f"cb asynchost rung {name}: building ({n_replicas} replicas x "
+        f"{max_batch} slots, {n_requests} requests, async={async_on}, "
+        f"spec={fault_spec!r})")
+    rs = np.random.RandomState(0)
+    params = llama.init_params(cfg, jax.random.key(0))
+    # the flag is read at ENGINE/ROUTER construction: pin it around the
+    # build, restore the ambient value after (a bench sweep must not leak
+    # one arm's setting into the next rung)
+    prev = os.environ.get("PADDLE_TPU_ASYNC_HOST")
+    os.environ["PADDLE_TPU_ASYNC_HOST"] = "1" if async_on else "0"
+    try:
+        fleet = FleetRouter(cfg, params, n_replicas=n_replicas,
+                            max_batch=max_batch, max_seq=max_seq, chunk=1,
+                            paged=True, block_size=block_size,
+                            num_blocks=num_blocks,
+                            enable_prefix_caching=True,
+                            enable_speculation=True,
+                            enable_chunked_prefill=True,
+                            prefill_chunk=min(prompt, prefill_chunk),
+                            max_queue=max_queue)
+    finally:
+        if prev is not None:
+            os.environ["PADDLE_TPU_ASYNC_HOST"] = prev
+        else:
+            os.environ.pop("PADDLE_TPU_ASYNC_HOST", None)
+    del params
+    assert all(eng._async_host == async_on for eng in fleet.replicas)
+    t_c = time.perf_counter()
+    for r, eng in enumerate(fleet.replicas):
+        eng.serve([Request(rid=-1 - r, prompt_ids=rs.randint(
+            0, cfg.vocab_size, (prompt,)).astype(np.int32),
+            max_new_tokens=2)])
+    log(f"cb asynchost rung {name}: compile "
+        f"{time.perf_counter() - t_c:.1f}s")
+    # post-warmup hygiene: zero the throughput/journal counters and the
+    # latency histograms so the A/B detail reads the timed window only
+    for eng in fleet.replicas:
+        for key in ("decode_steps", "decode_tokens", "prefills",
+                    "prefill_chunks", "mixed_steps",
+                    "journal_incremental_updates", "journal_full_rebuilds",
+                    "host_overlap_steps"):
+            eng.stats[key] = 0
+        eng.stats["decode_time_s"] = 0.0
+        eng._step_no = 0
+        eng._last_step_end = None
+        for h in (eng._h_hostgap, eng._h_step, eng._h_jupdate):
+            _reset_hist(h)
+    # exact host-gap capture: swap each engine's host-gap histogram for a
+    # tapping wrapper (bucketed log2 percentiles cannot resolve the
+    # serial arm's per-step journal tax; the A/B reads exact figures)
+    gap_exact: list[float] = []
+    for eng in fleet.replicas:
+        eng._h_hostgap = _GapTap(eng._h_hostgap, gap_exact)
+    _reset_hist(fleet._h_jupdate)
+    for key in ("journal_incremental_updates", "journal_full_rebuilds",
+                "host_overlap_steps"):
+        fleet.stats[key] = 0
+    from paddle_tpu import profiler as _prof
+
+    _prof.clear_host_events()
+    if fault_spec:
+        # arm chaos AFTER warmup with the fleet clock reset (the chaos
+        # rung convention: step keys are relative to the timed serve)
+        os.environ["PADDLE_TPU_FAULT_INJECT"] = fault_spec
+        try:
+            fleet._arm_faults_from_env()
+        finally:
+            os.environ.pop("PADDLE_TPU_FAULT_INJECT", None)
+    fleet._step_no = 0
+    families = [rs.randint(0, cfg.vocab_size, (prompt,)).astype(np.int32)
+                for _ in range(4)]
+    reqs = []
+    for i in range(n_requests):
+        fam = families[i % len(families)]
+        p = np.concatenate([fam[:prompt - 8], rs.randint(
+            0, cfg.vocab_size, (8,)).astype(np.int32)])
+        reqs.append(Request(rid=i, prompt_ids=p, max_new_tokens=new))
+    pending = list(reqs)
+    seen = {r.rid: 0 for r in reqs}
+    arrivals: dict[int, list] = {r.rid: [] for r in reqs}
+    steps = 0
+    t0 = time.perf_counter()
+    while True:
+        busy = fleet.step()
+        steps += 1
+        now = time.perf_counter()
+        for r in reqs:
+            if len(r.output_ids) > seen[r.rid]:
+                seen[r.rid] = len(r.output_ids)
+                arrivals[r.rid].append(now)
+        if pending and steps % arrive_every == 0:
+            fleet.add_request(pending.pop(0))  # open loop
+            continue
+        if not busy and not pending:
+            break
+    wall = time.perf_counter() - t0
+    statuses = {st: sum(1 for r in reqs if r.status == st)
+                for st in sorted(TERMINAL_STATUSES)}
+    assert sum(statuses.values()) == n_requests, statuses
+    gaps = sorted(b_ - a for r in reqs
+                  for a, b_ in zip(arrivals[r.rid], arrivals[r.rid][1:]))
+    live = [eng for eng in fleet.replicas if eng is not None]
+    gap_p50, gap_p99, gap_mean, gap_n = _exact_stats_s(gap_exact)
+    step_p50, step_p99, step_mean, _ = _hist_stats_s(
+        [eng._h_step for eng in live])
+    eng_sum = lambda key: sum(eng.stats[key] for eng in live)
+    full_rebuilds = eng_sum("journal_full_rebuilds")
+    # journal host seconds, split by WHERE they were paid: the router's
+    # refreshes sit on the critical path between launches (async-off: one
+    # snapshot() per step + per dispatch; async-on: only failover/hedge
+    # pulls — 0 in steady state), the engines' incremental flushes run
+    # inside the host-overlap window while the device step is in flight
+    fj = fleet._h_jupdate
+    jcrit_s = fj.sum if fj is not None else 0.0
+    jcrit_n = fj.count if fj is not None else 0
+    jover_s = sum(eng._h_jupdate.sum for eng in live
+                  if eng._h_jupdate is not None)
+    toks_total = sum(len(r.output_ids) for r in reqs)
+    return {
+        "metric": "llama_cb_decode_tbt_p99_ms",
+        "value": _tbt_pctile_ms(gaps, 0.99) or 0.0,
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "detail": {"rung": name, "n_replicas": n_replicas,
+                   "slots_per_replica": max_batch,
+                   "requests": n_requests, "prompt": prompt,
+                   "new_tokens": new, "wall_s": round(wall, 2),
+                   "async_host": async_on,
+                   "fault_spec": fault_spec or None,
+                   "tokens_generated": toks_total,
+                   "tokens_per_s": (round(toks_total / wall, 1)
+                                    if wall > 0 else 0.0),
+                   "tbt_p50_ms": _tbt_pctile_ms(gaps, 0.50),
+                   "tbt_p99_ms": _tbt_pctile_ms(gaps, 0.99),
+                   "host_gap_p50_s": gap_p50, "host_gap_p99_s": gap_p99,
+                   "host_gap_mean_s": gap_mean,
+                   "host_gap_observations": gap_n,
+                   "step_p50_s": step_p50, "step_p99_s": step_p99,
+                   "step_mean_s": step_mean,
+                   "fleet_steps": steps,
+                   "journal_critical_s": round(jcrit_s, 6),
+                   "journal_critical_refreshes": jcrit_n,
+                   "journal_critical_s_per_step":
+                       round(jcrit_s / steps, 9) if steps else 0.0,
+                   "journal_overlapped_s": round(jover_s, 6),
+                   "journal_incremental_updates":
+                       eng_sum("journal_incremental_updates"),
+                   "journal_full_rebuilds": full_rebuilds,
+                   "host_overlap_steps": eng_sum("host_overlap_steps"),
+                   "fleet_journal_incremental_updates":
+                       fleet.stats["journal_incremental_updates"],
+                   "fleet_journal_full_rebuilds":
+                       fleet.stats["journal_full_rebuilds"],
+                   "fleet_host_overlap_steps":
+                       fleet.stats["host_overlap_steps"],
+                   "failovers": fleet.stats["failovers"],
+                   "replayed_tokens": fleet.stats["replayed_tokens"],
+                   "statuses": statuses,
+                   "health": list(fleet.health),
                    "backend": jax.default_backend(),
                    **_obs_detail(fleet)},
     }

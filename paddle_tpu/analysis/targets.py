@@ -229,6 +229,34 @@ def _t_serving_flash_decode_step() -> AnalysisTarget:
          temp, topp, seeds, table), env=eng._lint_env)
 
 
+def _t_serving_async_step() -> AnalysisTarget:
+    import jax.numpy as jnp
+
+    # the production decode program as the ASYNC host runtime launches it
+    # (ISSUE 16, docs/async_runtime.md): PADDLE_TPU_ASYNC_HOST=1 pinned at
+    # construction AND trace time.  The async runtime is host-side only —
+    # journal upkeep and late token fetches never touch the jaxpr — so
+    # this target's compiled program must stay IDENTICAL to
+    # serving_flash_decode_step's (its budget mirrors that entry), and the
+    # host_sync rule polices exactly that: a device-blocking callback or
+    # sync sneaking into the overlapped step is the regression that would
+    # silently serialize the pipeline again.
+    eng = _serving_engine(_force_flags=("PADDLE_TPU_ASYNC_HOST",))
+    assert eng._async_host, "async target must build the async-host engine"
+    B = eng.max_batch
+    tokens = jnp.zeros((B,), jnp.int32)
+    pos = jnp.asarray([5, 0], jnp.int32)
+    active = jnp.asarray([True, False])
+    temp = jnp.zeros((B,), jnp.float32)
+    topp = jnp.ones((B,), jnp.float32)
+    seeds = jnp.zeros((B,), jnp.int32)
+    table = jnp.asarray(eng._table)
+    return AnalysisTarget(
+        "serving_async_step", eng._decode_greedy,
+        (eng.params, eng.cache_k, eng.cache_v, tokens, pos, active,
+         temp, topp, seeds, table), env=eng._lint_env)
+
+
 def _t_serving_quant_decode_step() -> AnalysisTarget:
     import jax.numpy as jnp
 
@@ -428,6 +456,7 @@ TARGETS = {
     "serving_mixed_step": _t_serving_mixed_step,
     "serving_tier_restore": _t_serving_tier_restore,
     "serving_tp_step": _t_serving_tp_step,
+    "serving_async_step": _t_serving_async_step,
 }
 
 # the CI gate runs every registered target; kept as an explicit list so an
@@ -438,7 +467,7 @@ GATE_TARGETS = ("llama_train_step", "moe_llama_train_step",
                 "serving_quant_decode_step", "serving_quant_scatter_step",
                 "serving_prefill_step", "serving_verify_step",
                 "serving_mixed_step", "serving_tier_restore",
-                "serving_tp_step")
+                "serving_tp_step", "serving_async_step")
 
 
 def build(name: str) -> AnalysisTarget:

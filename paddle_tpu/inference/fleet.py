@@ -234,6 +234,15 @@ class FleetRouter:
         # completed steps stale, so replay loses nothing the fleet had
         # mirrored
         self._journal: list[dict | None] = [None] * self.n_replicas
+        # async host runtime (docs/async_runtime.md): with the flag on the
+        # replicas maintain their journals incrementally (O(changed rids),
+        # flushed inside each engine's host-overlap window) and the router
+        # pulls them ONLY at the boundaries that consume them — replica
+        # death and stall hedging (_journal_pull) — instead of paying a
+        # full snapshot() rebuild per step and per dispatch.  Off, the
+        # historical per-step/per-dispatch snapshot() refreshes run
+        # byte-identically.
+        self._async_host = _env_bool("PADDLE_TPU_ASYNC_HOST", True)
         self._last_progress = [0] * self.n_replicas
         self._slow_streak = [0] * self.n_replicas
         self._ok_streak = [0] * self.n_replicas
@@ -248,9 +257,18 @@ class FleetRouter:
             self.stats = StatsView(self.metrics, FLEET_STAT_SCHEMA,
                                    prefix="paddle_tpu_fleet")
             self.slo = SLOTracker(self.metrics, prefix="paddle_tpu_fleet")
+            self._h_jupdate = self.metrics.histogram(
+                "paddle_tpu_fleet_journal_update_seconds",
+                "Host seconds per router journal refresh: async-on, one "
+                "incremental pull per consumption boundary (failover/"
+                "hedge); async-off, one full snapshot() rebuild per step "
+                "and per dispatch — the critical-path journal tax "
+                "(docs/async_runtime.md)"
+            ).labels()
         else:
             self.stats = {k: 0 for k in FLEET_STAT_SCHEMA}
             self.slo = None
+            self._h_jupdate = None
         # one flow-link tracer per replica lane (the engines' own tracers
         # already own the span traffic on those pids; the router only adds
         # the cross-replica failover/hedge arrows and health markers)
@@ -422,8 +440,17 @@ class FleetRouter:
         self._owner[req.rid] = target
         self._copies[req.rid] = {target: copy}
         # keep the journal current through dispatch, not just steps: a
-        # crash before the replica's next step must still replay this
-        self._journal[target] = self.replicas[target].snapshot()
+        # crash before the replica's next step must still replay this.
+        # Async host runtime: the replica's incremental journal already
+        # tracks the dispatch (add_request _jmarks the rid) and the
+        # router pulls it at the death/stall boundary instead — no full
+        # rebuild on the dispatch path.
+        if not self._async_host:
+            t0 = time.perf_counter()
+            self.stats["journal_full_rebuilds"] += 1
+            self._journal[target] = self.replicas[target].snapshot()
+            if self._h_jupdate is not None:
+                self._h_jupdate.observe(time.perf_counter() - t0)
 
     def cancel(self, rid: int) -> bool:
         """Fleet-level cancel: every replica copy (owner and any pending
@@ -498,6 +525,55 @@ class FleetRouter:
                                 f"{self._slow_streak[r]} slow/stalled "
                                 f"heartbeats")
 
+    def _journal_pull(self, r: int) -> None:
+        """Async host runtime: pull replica ``r``'s incrementally-
+        maintained journal — the O(changed rids) replacement for the
+        per-step/per-dispatch ``snapshot()`` rebuilds, taken only at the
+        boundaries that actually consume it (replica death, stall
+        hedging; docs/async_runtime.md)."""
+        eng = self.replicas[r]
+        if eng is None:
+            return
+        t0 = time.perf_counter()
+        self._journal[r] = (eng.journal() if eng._reqs
+                            else {"running": [], "queued": []})
+        self.stats["journal_incremental_updates"] += 1
+        if self._h_jupdate is not None:
+            self._h_jupdate.observe(time.perf_counter() - t0)
+        if self._flight is not None:
+            self._flight.record("journal_pull", replica=r)
+
+    def _audit_journal_equiv(self, r: int) -> None:
+        """Under PADDLE_TPU_ENGINE_AUDIT=1: assert replica ``r``'s
+        incremental journal and a freshly-built ``snapshot()`` agree —
+        the equivalence contract failover replay rides on once the
+        router stops rebuilding snapshots itself.
+        ``deadline_remaining_s`` is normalized out: both sides lazily
+        recompute it from ``time.perf_counter()`` at their own read
+        instants, so it legitimately differs by the nanoseconds between
+        the two calls."""
+        eng = self.replicas[r]
+        if eng is None or not eng._reqs:
+            return
+
+        def _norm(d: dict) -> dict:
+            return {**d,
+                    "running": [dict(e, deadline_remaining_s=None)
+                                for e in d["running"]],
+                    "queued": [dict(e, deadline_remaining_s=None)
+                               for e in d["queued"]]}
+
+        j, s = _norm(eng.journal()), _norm(eng.snapshot())
+        if j != s:
+            from ..analysis.engine_audit import EngineAuditError
+
+            if self._flight is not None:
+                self._flight.dump(f"journal_divergence replica={r}")
+            raise EngineAuditError(
+                f"incremental journal diverged from snapshot() on "
+                f"replica {r} (async host runtime): "
+                f"journal={j!r} snapshot={s!r}")
+
     def _journal_entry(self, r: int, rid: int) -> dict:
         """The journal entry to replay for ``rid`` of replica ``r``: the
         incrementally-maintained snapshot's, falling back to synthesizing
@@ -551,6 +627,11 @@ class FleetRouter:
         replica)."""
         with RecordEvent("fleet/failover"):
             dead_eng = self.replicas[r]   # for the flight-recorder dump
+            if self._async_host:
+                # the death boundary IS the async runtime's journal
+                # consumption point: pull the incremental journal while
+                # the engine object is still here, then replay from it
+                self._journal_pull(r)
             self._health_to(r, "DEAD", reason)
             self.replicas[r] = None
             self.stats["failovers"] += 1
@@ -626,6 +707,12 @@ class FleetRouter:
             if self.health[r] == "HEALTHY":
                 self._health_to(r, "DEGRADED",
                                 f"no progress for {gap} fleet steps")
+            if self._async_host:
+                # hedge boundary: refresh the stalled replica's journal
+                # from its incremental entries before replaying them
+                # (the stalled engine's host side is still reachable —
+                # it is the device step that is not completing)
+                self._journal_pull(r)
             for rid in [rid for rid, o in self._owner.items() if o == r]:
                 if rid in self._hedge:
                     continue               # already hedge-pending
@@ -729,6 +816,7 @@ class FleetRouter:
         idle."""
         self._step_no += 1
         busy = False
+        stepped_any = False    # any live replica stepped (overlap counter)
         for r in range(self.n_replicas):
             if self.replicas[r] is None:
                 continue
@@ -766,14 +854,35 @@ class FleetRouter:
             self._last_progress[r] = self._step_no
             self._note_heartbeat(r, ok=not slow)
             self._mirror(r)
-            # journal refresh: O(live tokens) host work per replica per
-            # step — bounded by max_batch x max_seq ints, small next to a
-            # device step, and the price of a journal that is never a
-            # completed step stale when its replica dies.  Idle replicas
-            # skip it (their journal is empty).
-            self._journal[r] = (eng.snapshot() if eng._reqs
-                                else {"running": [], "queued": []})
+            if self._async_host:
+                # async host runtime: the replica flushed its dirty rids
+                # inside its own host-overlap window; the router defers
+                # consumption to the death/stall boundaries
+                # (_journal_pull) — zero per-step rebuild cost here
+                stepped_any = True
+                if self._audit_every_step:
+                    self._audit_journal_equiv(r)
+            else:
+                # journal refresh: O(live tokens) host work per replica
+                # per step — bounded by max_batch x max_seq ints, small
+                # next to a device step, and the price of a journal that
+                # is never a completed step stale when its replica dies.
+                # Idle replicas skip it (their journal is empty).
+                # Timed into journal_update_seconds either way: with the
+                # flag off this histogram IS the critical-path journal
+                # tax per step the async runtime exists to remove (the
+                # asynchost A/B reads its sum).
+                if eng._reqs:
+                    t0 = time.perf_counter()
+                    self.stats["journal_full_rebuilds"] += 1
+                    self._journal[r] = eng.snapshot()
+                    if self._h_jupdate is not None:
+                        self._h_jupdate.observe(time.perf_counter() - t0)
+                else:
+                    self._journal[r] = {"running": [], "queued": []}
             busy = busy or stepped or self._has_live(r)
+        if self._async_host and stepped_any:
+            self.stats["host_overlap_steps"] += 1
         self._detect_stalls()
         if self._audit_every_step:
             from ..analysis.engine_audit import (EngineAuditError,

@@ -324,6 +324,18 @@ ENGINE_STAT_SCHEMA = {
                                   "fleet-shared tier's global counter)"),
     "tier_bytes": ("gauge", "Host KV tier bytes resident (mirrors the "
                             "possibly fleet-shared tier's global gauge)"),
+    "journal_incremental_updates": ("counter",
+                                    "Dirty-rid journal entries rebuilt "
+                                    "incrementally (O(changed) per step, "
+                                    "docs/async_runtime.md)"),
+    "journal_full_rebuilds": ("counter",
+                              "Full snapshot() journal rebuilds — steady-"
+                              "state async serving keeps this at adopt/"
+                              "restore boundaries only"),
+    "host_overlap_steps": ("counter",
+                           "Steps whose token-independent host work "
+                           "overlapped the in-flight device step (async "
+                           "host runtime)"),
 }
 
 #: fleet router ``stats`` keys -> (metric kind, help); same contract.
@@ -340,6 +352,19 @@ FLEET_STAT_SCHEMA = {
                                    "survivors (replay + hedge)"),
     "fleet_rejected": ("counter", "Fleet-level rejections (backpressure, "
                                   "invalid request, fleet lost)"),
+    "journal_incremental_updates": ("counter",
+                                    "Incremental journal() pulls consumed "
+                                    "from replicas (failover/hedge "
+                                    "boundaries, docs/async_runtime.md)"),
+    "journal_full_rebuilds": ("counter",
+                              "Full replica snapshot() rebuilds taken by "
+                              "the router (per step/dispatch with "
+                              "PADDLE_TPU_ASYNC_HOST=0; zero steady-state "
+                              "async)"),
+    "host_overlap_steps": ("counter",
+                           "Fleet steps driven with snapshot refreshes "
+                           "deferred to failover boundaries (async host "
+                           "runtime)"),
 }
 
 

@@ -869,6 +869,11 @@ class ContinuousBatchingEngine:
                 "with the next compiled step by async dispatch)")
                 .labels(**self._obs_labels) if self._tier is not None
                 else None)
+            self._h_jupdate = self.metrics.histogram(
+                "paddle_tpu_serving_journal_update_seconds",
+                "Host seconds per incremental journal flush (dirty-rid "
+                "entry rebuilds overlapped with the in-flight device "
+                "step, docs/async_runtime.md)").labels(**self._obs_labels)
             self._tracer = RequestTracer(
                 enabled=True,
                 pid=int(replica) if replica is not None else 0,
@@ -877,6 +882,7 @@ class ContinuousBatchingEngine:
             self.metrics = None
             self.slo = None
             self._h_hostgap = self._h_step = self._h_h2d = None
+            self._h_jupdate = None
             self._tracer = RequestTracer(enabled=False)
             self.stats = {k: (0.0 if kind == "gauge" else 0)
                           for k, (kind, _) in ENGINE_STAT_SCHEMA.items()}
@@ -893,6 +899,20 @@ class ContinuousBatchingEngine:
         from ..analysis.engine_audit import audit_enabled
 
         self._audit_every_step = audit_enabled()
+        # ---- async host runtime (docs/async_runtime.md) ----
+        # Incremental event-sourced journal: _jentries mirrors what
+        # snapshot() would emit per live rid, maintained in O(changed
+        # rids) — every admission / token bank / chunk-cursor advance /
+        # terminal marks the rid dirty and _jflush rebuilds just those
+        # entries.  The flush runs inside _host_overlap(), i.e. while
+        # the device executes the already-launched step, so steady-state
+        # journal upkeep costs the host-gap nothing.  The dirty marks
+        # themselves are unconditional (a set.add); the flag only gates
+        # the overlap window and the fleet's consumption, so
+        # PADDLE_TPU_ASYNC_HOST=0 leaves the serial loop byte-identical.
+        self._async_host = env_bool("PADDLE_TPU_ASYNC_HOST", True)
+        self._jentries: dict[int, dict] = {}
+        self._jdirty: set[int] = set()
 
     # ------------- tensor-parallel wrapping (docs/tp_serving.md) -----------
 
@@ -2061,6 +2081,7 @@ class ContinuousBatchingEngine:
             self._prefilled[slot] = 0
         req.status = "PENDING"   # back in the queue; re-seated by _admit
         self._queue.insert(0, req)
+        self._jmark(req.rid)
         self.stats["preemptions"] += 1
         if self._flight is not None:
             self._flight.record("degrade", rung=4, what="preempt",
@@ -2161,6 +2182,7 @@ class ContinuousBatchingEngine:
                 self._terminal(req, "REJECTED", msg)
             return
         self._queue.append(req)
+        self._jmark(req.rid)
 
     def _admit(self):
         """Fill free slots from the queue (prefill path).  Paged mode admits
@@ -2433,6 +2455,7 @@ class ContinuousBatchingEngine:
             # requests never share a stream
             self._seed[slot] = np.int32(
                 req.seed if req.seed is not None else req.rid)
+            self._jmark(req.rid)   # seating sets the journal's cursor
 
     def _retire(self, slot):
         self._terminal(self._slot_req[slot], "FINISHED")
@@ -2466,6 +2489,7 @@ class ContinuousBatchingEngine:
         # caller keeps its own reference; cancel() on a terminal rid
         # correctly reports False via the journal miss)
         self._reqs.pop(req.rid, None)
+        self._jdrop(req.rid)
         # lifecycle observability: close the SLO record, emit the decode
         # span (admission -> terminal) + terminal marker, and — for a
         # FAILED request — dump the flight recorder so triage reads the
@@ -2718,6 +2742,7 @@ class ContinuousBatchingEngine:
         than granting the full budget again."""
 
         now = time.perf_counter()
+        self.stats["journal_full_rebuilds"] += 1
         with RecordEvent("serving/snapshot"):
             running = [s for s in range(self.max_batch)
                        if self._slot_req[s] is not None]
@@ -2732,6 +2757,106 @@ class ContinuousBatchingEngine:
                             for s in running],
                 "queued": [journal_entry(r, 0, now) for r in self._queue],
             }
+
+    # ------------- incremental journal (docs/async_runtime.md) ------------
+
+    def _jmark(self, rid: int):
+        """Mark one rid's journal entry stale (admission, token bank,
+        chunk-cursor advance, adopt, preempt).  O(1) — the entry rebuild
+        happens in :meth:`_jflush`, inside the host-overlap window when
+        the async runtime is on."""
+        self._jdirty.add(rid)
+
+    def _jdrop(self, rid: int):
+        """Retire one rid's journal entry (terminal states)."""
+        self._jentries.pop(rid, None)
+        self._jdirty.discard(rid)
+
+    def _jflush(self, now: float | None = None):
+        """Rebuild the journal entries of every dirty rid — the O(changed
+        rids) incremental replacement for :meth:`snapshot`'s full scan.
+        Entries freeze ``deadline_remaining_s`` at flush time;
+        :meth:`journal` re-derives it at read time, so consumers always
+        see the live remaining budget."""
+        if not self._jdirty:
+            return
+        t0 = time.perf_counter()
+        if now is None:
+            now = t0
+        slot_of = {}
+        for s in range(self.max_batch):
+            r = self._slot_req[s]
+            if r is not None:
+                slot_of[r.rid] = s
+        n = 0
+        for rid in self._jdirty:
+            req = self._reqs.get(rid)
+            if req is None:
+                # terminal raced the mark (defensive; _terminal _jdrops)
+                self._jentries.pop(rid, None)
+                continue
+            s = slot_of.get(rid)
+            prefilled = (int(self._prefilled[s])
+                         if self._chunked and s is not None else 0)
+            self._jentries[rid] = journal_entry(req, prefilled, now)
+            n += 1
+        self._jdirty.clear()
+        if n:
+            self.stats["journal_incremental_updates"] += n
+            if self._h_jupdate is not None:
+                self._h_jupdate.observe(time.perf_counter() - t0)
+            if self._flight is not None:
+                self._flight.record("journal_flush", entries=n)
+
+    def journal(self) -> dict:
+        """:meth:`snapshot`-equivalent view assembled from the incremental
+        journal — the async host runtime's replacement for the router's
+        per-step/per-dispatch full rebuilds (docs/async_runtime.md).  The
+        fleet pulls this only at failover/hedge boundaries; equivalence
+        with :meth:`snapshot` is asserted every fleet step under
+        PADDLE_TPU_ENGINE_AUDIT=1 (fleet._audit_journal_equiv)."""
+        now = time.perf_counter()
+        self._jflush(now)
+
+        def _entry(req, prefilled: int) -> dict:
+            e = self._jentries.get(req.rid)
+            if e is None:
+                # defensive: a rid that never got marked (should not
+                # happen — every mutation site _jmarks) still journals
+                e = journal_entry(req, prefilled, now)
+                self._jentries[req.rid] = e
+            if e["deadline_s"] is not None:
+                e = dict(e)
+                e["deadline_remaining_s"] = max(
+                    0.0, e["deadline_s"]
+                    - (now - getattr(req, "_submit_s", now)))
+            return e
+
+        running = [s for s in range(self.max_batch)
+                   if self._slot_req[s] is not None]
+        if self.paged:
+            running.sort(key=lambda s: int(self._slot_age[s]))
+        return {
+            "version": 2,
+            "engine": self._topology(),
+            "running": [_entry(self._slot_req[s],
+                               int(self._prefilled[s])
+                               if self._chunked else 0) for s in running],
+            "queued": [_entry(r, 0) for r in self._queue],
+        }
+
+    def _host_overlap(self):
+        """The token-independent half of a step's host work, run between
+        the compiled launch and the first token fetch — while the device
+        executes the step (JAX async dispatch), so steady-state journal
+        upkeep costs the host gap nothing.  A no-op with
+        PADDLE_TPU_ASYNC_HOST=0: the serial loop defers all journal work
+        to explicit snapshot() calls, byte-identically to the pre-async
+        engine."""
+        if not self._async_host:
+            return
+        self.stats["host_overlap_steps"] += 1
+        self._jflush()
 
     def adopt(self, j: dict) -> Request:
         """Adopt ONE journaled request (an entry of :meth:`snapshot`'s
@@ -2778,6 +2903,7 @@ class ContinuousBatchingEngine:
                                 replayed_tokens=len(req.output_ids))
         self._reqs[req.rid] = req
         self._queue.append(req)
+        self._jmark(req.rid)
         return req
 
     def restore(self, snap: dict) -> list[Request]:
@@ -2951,6 +3077,11 @@ class ContinuousBatchingEngine:
                     jnp.asarray(active_np), jnp.asarray(self._temp),
                     jnp.asarray(self._topp), jnp.asarray(self._seed),
                     *extra, poison=jnp.asarray(self._poison))
+                # async host runtime: the token-independent host half
+                # (journal upkeep) runs while the device executes the
+                # launch above — the guard/token fetches below block as
+                # late as possible (docs/async_runtime.md)
+                self._host_overlap()
                 bad_np = np.asarray(bad)    # [k, B] guard flags
             else:
                 toks, self.cache_k, self.cache_v = decode(
@@ -2958,6 +3089,7 @@ class ContinuousBatchingEngine:
                     jnp.asarray(self._last_tok), jnp.asarray(self._pos),
                     jnp.asarray(active_np), jnp.asarray(self._temp),
                     jnp.asarray(self._topp), jnp.asarray(self._seed), *extra)
+                self._host_overlap()
         except FaultInjected as e:
             return self._retry_launch(e)
         self._kernel_err_streak = 0
@@ -3027,6 +3159,7 @@ class ContinuousBatchingEngine:
             self._written[slot] = max(int(self._written[slot]),
                                       min(old_pos + k, self.max_seq))
             self._last_tok[slot] = int(toks_np[-1, slot])
+            self._jmark(req.rid)   # token bank advanced the journal entry
             if done or old_pos + k >= self.max_seq:
                 self._retire(slot)
         self._maybe_audit()
@@ -3068,6 +3201,7 @@ class ContinuousBatchingEngine:
                              if self._prefill_ids[s] is not None),
                             key=lambda s: self._slot_age[s])
         tier_progress = False
+        t_r0 = None        # first tier-restore dispatch (host-gap anchor)
         for s in prefilling:
             ids = self._prefill_ids[s]
             cur = int(self._prefilled[s])
@@ -3079,9 +3213,13 @@ class ContinuousBatchingEngine:
                 # just the H2D); a budget-deferred plan idles the lane
                 # rather than computing a block the next step restores
                 cur0 = cur
+                if t_r0 is None:
+                    t_r0 = time.perf_counter()
                 cur, budget, pending = self._tier_restore_step(s, ids,
                                                                budget)
                 tier_progress = tier_progress or cur != cur0 or pending
+                if cur != cur0:
+                    self._jmark(self._slot_req[s].rid)  # cursor advanced
                 if pending:
                     continue
             n = min(T, ids.size - cur, budget)
@@ -3124,6 +3262,15 @@ class ContinuousBatchingEngine:
                 active[s] = False
                 chunk_rows.pop(s, None)
         if not active.any():
+            if tier_progress and t_r0 is not None:
+                # restore-only step: no compiled launch follows, but the
+                # H2D restore dispatches above ARE this step's device work
+                # — observe the host gap + step time here so the
+                # tier-restore family shows up in the histogram the async
+                # runtime's A/B measures (a silent family would make the
+                # overlap look better than it is)
+                self._note_launch(t_r0)
+                self._note_step_done(t_r0)
             # tier restores are progress even when every lane's ROWS were
             # deferred or drained (a restore-only step must keep the serve
             # loop spinning until the plan finishes draining)
@@ -3150,6 +3297,7 @@ class ContinuousBatchingEngine:
                     jnp.asarray(self._temp), jnp.asarray(self._topp),
                     jnp.asarray(self._seed), jnp.asarray(self._table),
                     poison=jnp.asarray(self._poison))
+                self._host_overlap()   # journal upkeep rides the launch
                 bad_np = np.asarray(bad)    # [B] emit-row guard flags
             else:
                 nxt, self.cache_k, self.cache_v = mixed(
@@ -3158,6 +3306,7 @@ class ContinuousBatchingEngine:
                     jnp.asarray(active), jnp.asarray(q_lens),
                     jnp.asarray(self._temp), jnp.asarray(self._topp),
                     jnp.asarray(self._seed), jnp.asarray(self._table))
+                self._host_overlap()
         except FaultInjected as e:
             return self._retry_launch(e)
         self._kernel_err_streak = 0
@@ -3211,6 +3360,7 @@ class ContinuousBatchingEngine:
             ids = self._prefill_ids[s]
             new_cur = int(self._prefilled[s]) + n
             self._prefilled[s] = new_cur
+            self._jmark(req.rid)   # chunk cursor advanced
             self._tracer.span(req.rid, "prefill_chunk", t0,
                               self._last_step_end,
                               args={"rows": n, "cursor": new_cur,
@@ -3250,6 +3400,7 @@ class ContinuousBatchingEngine:
                             else time.perf_counter())
         self.stats["decode_tokens"] += 1
         self._last_tok[slot] = tok
+        self._jmark(req.rid)   # token bank advanced the journal entry
         if (len(req.output_ids) >= req.max_new_tokens
                 or (req.eos_token_id is not None
                     and tok == req.eos_token_id)):
@@ -3330,6 +3481,7 @@ class ContinuousBatchingEngine:
                     jnp.asarray(self._temp), jnp.asarray(self._topp),
                     jnp.asarray(self._seed), jnp.asarray(self._table),
                     poison=jnp.asarray(self._poison))
+                self._host_overlap()   # journal upkeep rides the launch
                 bad_np = np.asarray(bad)    # [B] per-slot guard flags
             else:
                 out, n_acc, self.cache_k, self.cache_v = verify(
@@ -3338,6 +3490,7 @@ class ContinuousBatchingEngine:
                     jnp.asarray(active_np), jnp.asarray(q_lens),
                     jnp.asarray(self._temp), jnp.asarray(self._topp),
                     jnp.asarray(self._seed), jnp.asarray(self._table))
+                self._host_overlap()
         except FaultInjected as e:
             return self._retry_launch(e)
         self._kernel_err_streak = 0
@@ -3400,6 +3553,7 @@ class ContinuousBatchingEngine:
                                           self.max_seq))
             self._pos[slot] = old_pos + n
             self._last_tok[slot] = int(out_np[slot, n - 1])
+            self._jmark(req.rid)   # accepted run advanced the journal
             if done or old_pos + n >= self.max_seq:
                 self._retire(slot)
         self._maybe_audit()

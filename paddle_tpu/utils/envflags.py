@@ -47,6 +47,16 @@ through :func:`env_bool`, which enforces the '0'/'1' vocabulary):
   pages again and admission stops at the HBM match.
   ``PADDLE_TPU_PREFIX_CACHE=0`` neutralizes the tier too — with no
   content address there is nothing to demote or match through.
+* ``PADDLE_TPU_ASYNC_HOST`` (default on) — the async host runtime
+  (docs/async_runtime.md): the engine maintains its failover journal
+  incrementally (O(changed rids) per step instead of a full
+  ``snapshot()`` rebuild per fleet step/dispatch) and overlaps the
+  token-independent half of each step's host work (journal maintenance,
+  metrics, queue bookkeeping) with the in-flight device step via JAX
+  async dispatch, fetching tokens as late as possible.  Token streams
+  are identical either way — only host scheduling moves; ``0`` restores
+  the serial fetch-then-bookkeep loop and the per-step full-``snapshot``
+  fleet journal byte-identically.
 
 (``PADDLE_TPU_DISABLE_PALLAS`` is the token-set switch; its vocabulary lives
 with the kernels — ops/pallas/__init__.py ``KNOWN_KERNELS``, cross-checked
@@ -127,6 +137,7 @@ BOOL_FLAGS = {
     "PADDLE_TPU_METRICS": True,
     "PADDLE_TPU_FLIGHT_RECORDER": True,
     "PADDLE_TPU_HOST_KV_TIER": True,
+    "PADDLE_TPU_ASYNC_HOST": True,
 }
 
 _warned: set[tuple[str, str]] = set()

@@ -134,6 +134,23 @@ TARGETS = {
         "llama_cb_decode_tbt_p99_ms/cb_launchbound_stage1",
     "cb_launchbound_cpu_smoke":
         "llama_cb_decode_tbt_p99_ms/cb_launchbound_cpu_smoke",
+    # round-20 evidence rungs: async host runtime (ISSUE 16,
+    # docs/async_runtime.md).  The asynchost A/B — the open-loop fleet
+    # workload with the incremental journal + pipelined stepping ON vs
+    # the serial fetch-then-bookkeep loop with per-step full snapshot()
+    # rebuilds — plus the chaos variant (replica_crash mid-serve,
+    # failover replaying through the incremental journal).  Exact keys
+    # so the async arm can never satisfy its own serial baseline; the
+    # cpu smokes run BOTH arms on both backends (fleet-smoke
+    # convention) because the A/B needs both sides banked to compare.
+    "cb_asynchost": "llama_cb_decode_tbt_p99_ms/cb_asynchost",
+    "cb_asynchost_off": "llama_cb_decode_tbt_p99_ms/cb_asynchost_off",
+    "cb_fleet_asynchost":
+        "llama_cb_decode_tbt_p99_ms/cb_fleet_asynchost",
+    "cb_asynchost_cpu_smoke":
+        "llama_cb_decode_tbt_p99_ms/cb_asynchost_cpu_smoke",
+    "cb_asynchost_off_cpu_smoke":
+        "llama_cb_decode_tbt_p99_ms/cb_asynchost_off_cpu_smoke",
 }
 
 
