@@ -586,6 +586,11 @@ def run_cb_rung(name, cfg, max_batch, n_requests, prompt, new, max_seq, chunk=1,
               # program_card["kernel_contracts"] (same object) so flat
               # dashboards read it next to the card without digging
               "kernel_contracts": program_card.get("kernel_contracts"),
+              # host-contract verdicts of the engine that RAN this rung
+              # (ISSUE 18, analysis/host_contracts.py): overlap-window
+              # races/blocking + state-machine coverage — promoted alias
+              # of program_card["host_contracts"], same as above
+              "host_contracts": program_card.get("host_contracts"),
               # expected: one decode variant per sampling mode used +
               # one prefill per warmed bucket; growth = in-serve churn
               "n_traces": eng.n_traces(),
@@ -1636,6 +1641,9 @@ def run_cb_longctx_rung(name, cfg, max_batch, n_long, n_short, long_prompt,
                    # carry their own bounds/race/alias verdicts — promoted
                    # alias of program_card["kernel_contracts"]
                    "kernel_contracts": program_card.get("kernel_contracts"),
+                   # host-contract verdicts (ISSUE 18) — promoted alias
+                   # of program_card["host_contracts"]
+                   "host_contracts": program_card.get("host_contracts"),
                    "preemptions": eng.stats["preemptions"],
                    "n_traces": eng.n_traces(),
                    "backend": jax.default_backend(),
@@ -1753,6 +1761,7 @@ def run_cb_launchbound_rung(name, cfg, max_batch, n_requests, prompt, new,
                    "decode_step_launches": launches,
                    "program_card": program_card,
                    "kernel_contracts": program_card.get("kernel_contracts"),
+                   "host_contracts": program_card.get("host_contracts"),
                    "n_traces": eng.n_traces(),
                    "backend": jax.default_backend(),
                    **_obs_detail(eng)},

@@ -15,7 +15,13 @@ that keep a program on the TPU fast path:
   every ``pallas_call``'s index maps proven in-bounds (``kernel_bounds``),
   output maps race-free (``kernel_race`` / ``kernel_lost_write``), and
   ``input_output_aliases`` pairs sound (``kernel_alias``), by concrete
-  grid enumeration on the same trace.
+  grid enumeration on the same trace;
+* host contracts (``analyze(..., host=True)``; host_contracts.py) — AST
+  effect/race analysis of the async host runtime's ``_host_overlap()``
+  windows (``host_race`` / ``host_blocking``) plus exhaustive protocol
+  verification of the fleet health machine and request lifecycle against
+  their declared transition tables (``host_transition`` /
+  ``host_dead_edge`` / ``host_protocol``).
 
 Three surfaces (docs/analysis.md):
 
@@ -42,6 +48,8 @@ from .cost_model import (ProgramCard, BudgetEntry, build_card, card_findings,
 from .engine_audit import EngineAuditError, audit_engine, audit_enabled
 from .kernel_contracts import (check_kernel_contracts, contracts_summary,
                                registry_drift_findings)
+from .host_contracts import (check_host_contracts, host_contracts_summary,
+                             host_verify_depth)
 
 __all__ = ["analyze", "Report", "Finding", "Severity", "AllowRule",
            "load_allowlist", "audit_engine", "audit_enabled",
@@ -49,7 +57,8 @@ __all__ = ["analyze", "Report", "Finding", "Severity", "AllowRule",
            "BudgetEntry", "build_card", "card_findings", "check_budgets",
            "load_budgets", "eqn_census", "DEFAULT_BUDGETS",
            "check_kernel_contracts", "contracts_summary",
-           "registry_drift_findings"]
+           "registry_drift_findings", "check_host_contracts",
+           "host_contracts_summary", "host_verify_depth"]
 
 ALL_RULES = ("dtype_upcast", "donation", "recompile", "host_sync",
              "resharding", "kernel_contracts")
@@ -59,7 +68,8 @@ def analyze(fn, *args, target: str = "", rules=None, allowlist=None,
             allowlist_path: str | None = None,
             min_donation_bytes: int = 1 << 20,
             min_gather_bytes: int = 1 << 20,
-            card: bool = False, vmem_cap: int | None = None) -> Report:
+            card: bool = False, vmem_cap: int | None = None,
+            host: bool = False) -> Report:
     """Trace ``fn(*args)`` and lint the program.  ``fn`` may be jit-wrapped
     (donation/sharding metadata is read off the pjit eqn) or a plain
     callable.  ``rules`` restricts to a subset of :data:`ALL_RULES`;
@@ -74,7 +84,16 @@ def analyze(fn, *args, target: str = "", rules=None, allowlist=None,
     join the report's findings and go through the allowlist like any rule's.
     Budget ceilings are checked by the callers that hold the full card set
     (``tools/lint_gate.py``, the ``--cards`` CLI) via
-    :func:`check_budgets`."""
+    :func:`check_budgets`.
+
+    ``host=True`` additionally runs the host-contract pass
+    (host_contracts.py) — AST effect/race analysis of the serving
+    engine's ``_host_overlap()`` windows and exhaustive protocol
+    verification of the fleet/request state machines.  It is keyed off
+    the MODULE sources, not the traced program, so serving gate targets
+    enable it (targets.HOST_TARGETS) and train targets skip it; its
+    findings gate through the same allowlist and its sections land on the
+    card as ``host_contracts``."""
     active = set(rules if rules is not None else ALL_RULES)
     unknown = active - set(ALL_RULES)
     if unknown:
@@ -134,15 +153,21 @@ def analyze(fn, *args, target: str = "", rules=None, allowlist=None,
                                                           target=target)
         findings += kc_findings
         trace_reuse += 1
+    hc_sections = None
+    if host:
+        hc_findings, hc_sections = check_host_contracts(target=target)
+        findings += hc_findings
     built_card = None
     if card:
         # compile_collectives=False: the one compile this pass needed
         # already happened above — a failure must not be retried per card;
         # kernel_contracts reuses the verifier sections the rule derived
+        # (host_contracts likewise when the host pass ran)
         built_card = build_card(fn, args, target=target, closed=closed,
                                 hlo=hlo, trace_families=n_sigs,
                                 vmem_cap=vmem_cap, compile_collectives=False,
-                                kernel_contracts=kc_sections)
+                                kernel_contracts=kc_sections,
+                                host_contracts=hc_sections)
         findings += card_findings(built_card)
         trace_reuse += 1
     sev = {Severity.ERROR: 0, Severity.WARNING: 1, Severity.INFO: 2}

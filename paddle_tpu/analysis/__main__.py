@@ -17,6 +17,14 @@ additionally carries per-target ``seconds`` and the ``trace_reuse`` count
 — the number of rule/card consumers sharing each target's ONE trace, the
 CI evidence the gate is single-compile per target); exit codes are
 unchanged.
+
+``--host`` switches to the host-contracts mode (host_contracts.py): no
+target builds, no tracing — just the AST effect/race analysis of the
+serving engine's ``_host_overlap()`` windows and the exhaustive protocol
+verification of the fleet health machine and request lifecycle, gated
+through the same allowlist (exit 1 on any non-allowlisted finding).
+This is the CI entry point ISSUE 18 names: ``python -m
+paddle_tpu.analysis --host`` must stay green over engine + fleet.
 """
 
 from __future__ import annotations
@@ -70,6 +78,10 @@ def main(argv=None) -> int:
     p.add_argument("--cards", action="store_true",
                    help="program-card mode: derive static cost/memory cards "
                         "and gate them against budgets.toml")
+    p.add_argument("--host", action="store_true",
+                   help="host-contracts mode: AST effect/race analysis of "
+                        "the async host runtime + state-machine protocol "
+                        "verification (no tracing)")
     p.add_argument("--update-budgets", action="store_true",
                    help="with --cards: rewrite budgets.toml at the measured "
                         "values (reasons preserved) instead of gating")
@@ -89,10 +101,16 @@ def main(argv=None) -> int:
         return 0
     if args.update_budgets and not args.cards:
         p.error("--update-budgets requires --cards")
+    if args.host:
+        if args.cards or args.target or args.all:
+            p.error("--host is a standalone mode (module-scoped, not "
+                    "per-target); drop --cards/--target/--all")
+        return _host_main(args)
     names = list(args.target) or (
         list(GATE_TARGETS) if (args.all or args.cards) else [])
     if not names:
-        p.error("pass --target <name> (repeatable), --all, or --list")
+        p.error("pass --target <name> (repeatable), --all, --host, "
+                "or --list")
 
     if args.cards:
         return _cards_main(args, names, run_card, TARGETS)
@@ -134,6 +152,60 @@ def main(argv=None) -> int:
               "paddle_tpu/analysis/allowlist.toml with a reason",
               file=sys.stderr)
     return rc
+
+
+def _host_main(args) -> int:
+    """--host: the standalone host-contracts gate (host_contracts.py) —
+    pure AST over the shipped engine + fleet sources and their declared
+    transition tables, gated through the same allowlist as every lint
+    rule.  Prints the per-window / per-machine sections (or --json with
+    the raw section dicts) and exits 1 on any non-allowlisted finding."""
+    from . import Report, load_allowlist
+    from .host_contracts import check_host_contracts, host_contracts_summary
+
+    allowlist = [] if args.no_allowlist else load_allowlist(args.allowlist)
+    t0 = time.perf_counter()
+    findings, sections = check_host_contracts(target="host")
+    secs = time.perf_counter() - t0
+    report = Report("host", findings, allowlist=allowlist)
+    summary = host_contracts_summary(sections)
+    if args.json:
+        import dataclasses
+        import json
+
+        print(json.dumps(
+            {"host_contracts": summary, "sections": sections,
+             "seconds": round(secs, 3), "ok": report.ok,
+             "findings": [dataclasses.asdict(f) for f in report.findings],
+             "allowlisted": [{**dataclasses.asdict(f), "reason": a.reason}
+                             for f, a in report.allowlisted]}, indent=2))
+    else:
+        print(f"-- host contracts: {summary['methods']} overlap method(s) "
+              f"/ {summary['windows']} window(s), {summary['machines']} "
+              f"state machine(s) / {summary['sites']} transition site(s); "
+              f"{summary['races']} race(s), {summary['blocking']} blocking "
+              f"fetch(es), {summary['undeclared_transitions']} undeclared "
+              f"transition(s), {summary['dead_edges']} dead edge(s), "
+              f"{summary['protocol']} protocol finding(s) --")
+        for s in sections:
+            if s.get("kind") == "overlap":
+                print(f"   overlap {s['method']} "
+                      f"windows={s['windows']} "
+                      f"races={[r['field'] for r in s['races']]} "
+                      f"blocking={len(s['blocking'])} [{s['where']}]")
+            else:
+                print(f"   machine {s['machine']} sites={s['sites']} "
+                      f"edges {len(s['covered_edges'])}/"
+                      f"{len(s['declared_edges'])} covered "
+                      f"dead={s['dead_edges']} "
+                      f"undeclared={len(s['undeclared'])} "
+                      f"protocol={len(s['protocol'])}")
+        print(report.render(verbose=args.verbose))
+        if not report.ok:
+            print("\nhost-contract gate FAILED: fix the race/transition "
+                  "or allowlist it in paddle_tpu/analysis/allowlist.toml "
+                  "with a reason", file=sys.stderr)
+    return 0 if report.ok else 1
 
 
 def _cards_main(args, names, run_card, TARGETS) -> int:

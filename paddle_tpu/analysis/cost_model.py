@@ -89,9 +89,14 @@ VMEM_CAPS = {"v4": 16 << 20, "v5e": 16 << 20, "v5p": 16 << 20,
 #: decode step's allowlisted in-place append overlap for the flash
 #: target), so a NEW unsound kernel moves the figure even if someone
 #: over-broadens an allowlist entry.
+#: ``host_contract_violations`` is the host-side analog
+#: (host_contracts.py): raw pre-allowlist count of _host_overlap() races,
+#: blocking fetches, and state-machine protocol findings — nonzero only
+#: for serving targets, where it pins the reviewed journal-overlap set.
 BUDGET_FIELDS = ("peak_hbm_bytes", "pallas_calls", "scatters",
                  "collective_bytes", "vmem_bytes_per_launch",
-                 "trace_families", "kernel_contract_violations")
+                 "trace_families", "kernel_contract_violations",
+                 "host_contract_violations")
 _CEILING_KEYS = BUDGET_FIELDS + ("eqns",)
 
 
@@ -364,12 +369,19 @@ class ProgramCard:
     #: per-pallas_call kernel-contract sections (kernel_contracts.py):
     #: bounds / race / alias verdicts, grid points checked, finding count
     kernel_contracts: list = dataclasses.field(default_factory=list)
+    #: host-contract sections (host_contracts.py) when the host pass ran
+    #: for this target: per-overlap-window race/blocking verdicts and
+    #: per-state-machine coverage; None = host pass not applicable
+    host_contracts: list | None = None
 
     def summary(self) -> dict:
         """Compact dict for bench rung detail / --json."""
+        from .host_contracts import host_contracts_summary
         from .kernel_contracts import contracts_summary
 
         kc = contracts_summary(self.kernel_contracts)
+        hc = (host_contracts_summary(self.host_contracts)
+              if self.host_contracts is not None else None)
         return {"target": self.target,
                 "peak_hbm_bytes": self.peak_hbm_bytes,
                 "peak_hbm_mib": round(self.peak_hbm_bytes / 2**20, 3),
@@ -382,7 +394,10 @@ class ProgramCard:
                 "vmem_launch_sites": len(self.vmem),
                 "trace_families": self.trace_families,
                 "kernel_contracts": kc,
-                "kernel_contract_violations": kc["violations"]}
+                "kernel_contract_violations": kc["violations"],
+                "host_contracts": hc,
+                "host_contract_violations":
+                    hc["violations"] if hc is not None else 0}
 
     def render(self) -> str:
         s = self.summary()
@@ -406,13 +421,30 @@ class ProgramCard:
                          f"alias={c['alias']} "
                          f"({c['points_checked']}/{c['grid_points']} grid "
                          f"point(s){', sampled' if c['sampled'] else ''})")
+        for h in self.host_contracts or ():
+            if h.get("kind") == "overlap":
+                lines.append(
+                    f"   host-overlap {h['method']} "
+                    f"windows={len(h['windows'])} "
+                    f"races={[r['field'] for r in h['races']]} "
+                    f"blocking={len(h['blocking'])} [{h['where']}]")
+            elif h.get("kind") == "machine":
+                lines.append(
+                    f"   host-machine {h['machine']} "
+                    f"sites={h['sites']} "
+                    f"edges {len(h['covered_edges'])}/"
+                    f"{len(h['declared_edges'])} covered, "
+                    f"dead={h['dead_edges']} "
+                    f"undeclared={len(h['undeclared'])} "
+                    f"protocol={len(h['protocol'])}")
         return "\n".join(lines)
 
 
 def build_card(fn, args=(), *, target: str = "", closed=None, hlo=None,
                donated=None, trace_families=None, compile_collectives=True,
                vmem_cap: int | None = None,
-               kernel_contracts=None) -> ProgramCard:
+               kernel_contracts=None,
+               host_contracts=None) -> ProgramCard:
     """Derive a :class:`ProgramCard` from a traced program.
 
     ``closed`` reuses an existing trace (else ``fn(*args)`` is traced);
@@ -423,7 +455,12 @@ def build_card(fn, args=(), *, target: str = "", closed=None, hlo=None,
     ``kernel_contracts`` likewise reuses the verifier's per-kernel
     sections when ``analyze()`` already ran the kernel_contracts rule on
     this trace — else they are derived here (the cards-only gate and
-    ``engine.decode_step_card()`` paths), still on the same trace."""
+    ``engine.decode_step_card()`` paths), still on the same trace.
+    ``host_contracts`` attaches the host-contract pass's sections
+    (host_contracts.py); unlike kernel contracts it is NOT derived here —
+    the pass is module-scoped, not trace-scoped, so only callers that
+    know the target serves from the async host runtime opt in
+    (targets.HOST_TARGETS / ``analyze(host=True)``)."""
     import jax
 
     from .rules import _mesh_devices_of, compiled_hlo, signature_families
@@ -456,7 +493,8 @@ def build_card(fn, args=(), *, target: str = "", closed=None, hlo=None,
         vmem_bytes_per_launch=max((v["vmem_bytes"] for v in vm), default=0),
         vmem_cap_bytes=vmem_cap if vmem_cap is not None else vmem_cap_bytes(),
         trace_families=trace_families, vmem=vm,
-        kernel_contracts=kernel_contracts)
+        kernel_contracts=kernel_contracts,
+        host_contracts=host_contracts)
 
 
 def card_findings(card: ProgramCard) -> list[Finding]:
@@ -626,8 +664,8 @@ _BUDGETS_HEADER = """\
 # (which preserves reasons) and re-justifies the entry in review; a PR
 # that grows one silently fails the gate with the offending field named.
 # Fields: peak_hbm_bytes, pallas_calls, scatters, collective_bytes,
-# vmem_bytes_per_launch, trace_families, kernel_contract_violations
-# (docs/analysis.md).
+# vmem_bytes_per_launch, trace_families, kernel_contract_violations,
+# host_contract_violations (docs/analysis.md).
 """
 
 

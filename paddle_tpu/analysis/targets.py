@@ -20,8 +20,8 @@ import typing
 
 import numpy as np
 
-__all__ = ["AnalysisTarget", "TARGETS", "GATE_TARGETS", "build", "run",
-           "run_card"]
+__all__ = ["AnalysisTarget", "TARGETS", "GATE_TARGETS", "HOST_TARGETS",
+           "build", "run", "run_card"]
 
 
 @dataclasses.dataclass
@@ -469,6 +469,13 @@ GATE_TARGETS = ("llama_train_step", "moe_llama_train_step",
                 "serving_mixed_step", "serving_tier_restore",
                 "serving_tp_step", "serving_async_step")
 
+# targets that serve from the async host runtime: these additionally run
+# the module-scoped host-contract pass (host_contracts.py) — overlap-window
+# race/blocking analysis + state-machine protocol verification.  Train
+# steps have no host runtime, so they skip it; the pass is memoized, so
+# the N serving targets share one AST run per gate sweep.
+HOST_TARGETS = tuple(n for n in GATE_TARGETS if n.startswith("serving_"))
+
 
 def build(name: str) -> AnalysisTarget:
     try:
@@ -487,6 +494,7 @@ def run(name: str, **overrides):
 
     t = build(name)
     kwargs = {**t.analyze_kwargs, **overrides}
+    kwargs.setdefault("host", t.name in HOST_TARGETS)
     with _pinned_env(t.env):
         return analyze(t.fn, *t.args, target=t.name, **kwargs)
 
@@ -501,5 +509,10 @@ def run_card(name: str, **card_kwargs):
     from .cost_model import build_card
 
     t = build(name)
+    if name in HOST_TARGETS and "host_contracts" not in card_kwargs:
+        from .host_contracts import check_host_contracts
+
+        card_kwargs["host_contracts"] = \
+            check_host_contracts(target=name)[1]
     with _pinned_env(t.env):
         return build_card(t.fn, t.args, target=t.name, **card_kwargs)

@@ -107,10 +107,23 @@ from .observability import (FLEET_STAT_SCHEMA, FlightRecorder,
 from .serving import (TERMINAL_STATUSES, ContinuousBatchingEngine, Request,
                       journal_entry)
 
-__all__ = ["FleetRouter", "REPLICA_STATES"]
+__all__ = ["FleetRouter", "REPLICA_STATES", "HEALTH_EDGES"]
 
 #: replica health states, in degradation order (docs/fleet_serving.md)
 REPLICA_STATES = ("HEALTHY", "DEGRADED", "DRAINING", "DEAD")
+
+#: declared replica-health transition table, verified exhaustively against
+#: every ``self.health[...]`` write site by the host-contract pass
+#: (analysis/host_contracts.py; docs/analysis.md §"Host contracts").
+#: Transitions move strictly down the degradation ladder except the single
+#: declared heal edge DEGRADED->HEALTHY (_note_heartbeat after heal_after
+#: clean beats); DEAD is absorbing.  DRAINING->DEAD covers killing a
+#: replica mid-drain; HEALTHY/DEGRADED->DEAD is a hard _kill.
+HEALTH_EDGES = frozenset({
+    ("HEALTHY", "DEGRADED"), ("DEGRADED", "HEALTHY"),
+    ("HEALTHY", "DRAINING"), ("DEGRADED", "DRAINING"),
+    ("HEALTHY", "DEAD"), ("DEGRADED", "DEAD"), ("DRAINING", "DEAD"),
+})
 
 
 class FleetRouter:

@@ -187,13 +187,26 @@ from jax.experimental.shard_map import shard_map as _shard_map
 from ..profiler import RecordEvent
 from .faults import FaultInjected
 
-__all__ = ["Request", "ContinuousBatchingEngine", "TERMINAL_STATUSES"]
+__all__ = ["Request", "ContinuousBatchingEngine", "TERMINAL_STATUSES",
+           "REQUEST_EDGES"]
 
 #: terminal request statuses (docs/fault_tolerance.md status lifecycle);
 #: a request in one of these owns zero pages and zero cache refs — the
 #: runtime auditor's I8 (analysis/engine_audit.py)
 TERMINAL_STATUSES = frozenset({"FINISHED", "FAILED", "REJECTED", "CANCELLED",
                                "EXPIRED"})
+
+#: declared request-lifecycle transition table, verified exhaustively
+#: against every ``.status`` assignment site by the host-contract pass
+#: (analysis/host_contracts.py; docs/analysis.md §"Host contracts").
+#: PENDING<->RUNNING covers admission (_admit) and preemption (_preempt);
+#: both live states may fall to any terminal status (rejection and expiry
+#: can hit queued requests, failure/cancel/finish hit seated ones).
+#: Terminal statuses are absorbing — there is deliberately no edge out.
+REQUEST_EDGES = frozenset(
+    {("PENDING", "RUNNING"), ("RUNNING", "PENDING")}
+    | {(live, term) for live in ("PENDING", "RUNNING")
+       for term in TERMINAL_STATUSES})
 
 #: terminal status -> engine stats counter (FINISHED ticks decode counters
 #: through the normal retire path instead)
@@ -2163,6 +2176,11 @@ class ContinuousBatchingEngine:
 
     def add_request(self, req: Request):
         self._validate(req)
+        # normalize to a host int32 array at acceptance: journal_entry
+        # re-runs np.asarray on prompt_ids inside the _host_overlap()
+        # window, and a device-array prompt would turn that into a blocking
+        # transfer mid-pipeline (host_blocking, analysis/host_contracts.py)
+        req.prompt_ids = np.asarray(req.prompt_ids, np.int32).ravel()
         req._submit_s = time.perf_counter()  # TTFT epoch (bench rung detail)
         if req.trace_id is None:
             req.trace_id = f"req-{req.rid:x}"
@@ -3702,15 +3720,22 @@ class ContinuousBatchingEngine:
         analysis/kernel_contracts.py) — embedded by the cb bench rungs
         next to ``decode_step_launches`` so a rung's detail carries the
         program's static cost AND its kernel-soundness verdicts alongside
-        its measured wall clock.  Trace-only, like the launch telemetry;
-        collective bytes are not compiled here (the TP gate target owns
-        that figure) and trace-family accounting lives with
-        ``n_traces()``."""
+        its measured wall clock.  The host-contract sections
+        (analysis/host_contracts.py) ride along the same way: this engine
+        IS the async host runtime the pass verifies, so the rung detail
+        carries the overlap-window race/blocking verdicts and
+        state-machine coverage beside the kernel ones.  Trace-only, like
+        the launch telemetry; collective bytes are not compiled here (the
+        TP gate target owns that figure) and trace-family accounting
+        lives with ``n_traces()``."""
         from ..analysis.cost_model import build_card
+        from ..analysis.host_contracts import check_host_contracts
 
         closed, donated = self._decode_step_trace()
         card = build_card(None, (), target="decode_step", closed=closed,
-                          donated=donated, compile_collectives=False)
+                          donated=donated, compile_collectives=False,
+                          host_contracts=check_host_contracts(
+                              target="decode_step")[1])
         d = card.summary()
         d["fused_decode"] = bool(self._fused)
         d["fused_mlp"] = bool(self._fused_mlp)

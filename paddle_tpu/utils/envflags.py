@@ -108,6 +108,14 @@ deterministic corner-plus-stratified sampling down to the cap.  Parsed by
 :func:`env_int` with minimum 16 — a typo or sub-minimum value warns once
 and keeps the default, so a misconfigured cap can neither explode gate
 time nor silently shrink coverage to nothing.
+``PADDLE_TPU_HOST_VERIFY_DEPTH`` is the integer call-graph resolution
+depth for the host-contract verifier (analysis/host_contracts.py,
+docs/analysis.md §"Host contracts"; default 8): how many call edges the
+effect analysis follows from each ``_host_overlap()`` window (and each
+state-machine choke chain) when computing read/write closures.  Parsed
+by :func:`env_int` with minimum 1 — a typo or sub-minimum value warns
+once and keeps the default, so a misconfigured depth can neither hide
+races behind an unresolved call nor explode the closure.
 ``PADDLE_TPU_HOST_TIER_MIB`` is the host-KV-tier byte budget in MiB
 (inference/kv_tier.py, docs/kv_tier.md; default 256): the ceiling the
 tier's own LRU evicts against.  Parsed by :func:`env_int` with minimum 1

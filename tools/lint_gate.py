@@ -8,8 +8,13 @@ index-map bounds, output write races, alias safety for every
 ``pallas_call``) — AND derives the static ProgramCard (peak live HBM,
 launch census, collective bytes, VMEM fit, trace families,
 kernel-contract sections — ``paddle_tpu/analysis/cost_model.py``) in one
-build/trace pass; cards are then checked against the reasoned per-target
-ceilings in ``paddle_tpu/analysis/budgets.toml``.  The KNOWN_KERNELS
+build/trace pass; serving targets additionally run the host-contract pass
+(``paddle_tpu/analysis/host_contracts.py``: ``_host_overlap()`` race /
+blocking-fetch analysis + fleet/request state-machine protocol
+verification, memoized module-wide), whose findings gate through the same
+allowlist and whose sections ride the card; cards are then checked
+against the reasoned per-target ceilings in
+``paddle_tpu/analysis/budgets.toml``.  The KNOWN_KERNELS
 drift lint (dead / unregistered kill switches) runs once after the target
 loop, gated like stale allowlist entries.  Exits 0 when every target is
 clean
@@ -25,14 +30,18 @@ drift rounds later.
 Usage::
 
     JAX_PLATFORMS=cpu python tools/lint_gate.py [--verbose]
-        [--strict-allowlist] [--cards-only]
+        [--strict-allowlist] [--cards-only] [--json]
         [--allowlist PATH] [--budgets PATH]
 
 ``--strict-allowlist`` turns stale allowlist entries (suppressions that
 matched NO finding across all targets — a reviewed-and-fixed leak whose
 pragma lingers) from a warning into a gate failure.  ``--cards-only``
-skips the lint rules and runs just the card/budget layer.  The PATH
-overrides exist for tests; CI runs the packaged files.
+skips the lint rules and runs just the card/budget layer.  ``--json``
+replaces the text output with one machine-readable document — per-target
+findings/allowlisted plus the full card summary (``kernel_contracts`` and
+``host_contracts`` sections included), budget findings, drift and stale
+sweeps; exit codes are unchanged.  The PATH overrides exist for tests;
+CI runs the packaged files.
 
 Exit codes: 0 clean, 1 gating findings (lint, budget, or strict-stale),
 2 a target failed to build/trace (a broken target is a gate failure, not a
@@ -62,6 +71,9 @@ def _parse_argv(argv):
                    help="stale allowlist entries gate instead of warning")
     p.add_argument("--cards-only", action="store_true",
                    help="skip the lint rules; run just the card/budget gate")
+    p.add_argument("--json", action="store_true",
+                   help="emit one machine-readable document instead of "
+                        "text (exit codes unchanged)")
     p.add_argument("--allowlist", default=None, metavar="PATH")
     p.add_argument("--budgets", default=None, metavar="PATH")
     return p.parse_args(argv)
@@ -79,6 +91,11 @@ def main(argv=None) -> int:
     cards_only = args.cards_only
     allowlist_path = args.allowlist
     budgets_path = args.budgets
+    json_mode = args.json
+    # --json: text output is replaced wholesale by one document printed at
+    # the end; every section the text mode prints has a key here
+    doc = {"targets": [], "budget_findings": [], "registry_drift": [],
+           "stale_allowlist": []} if json_mode else None
 
     if cards_only and strict_allowlist:
         # the stale-allowlist sweep needs the lint reports the cards-only
@@ -114,6 +131,9 @@ def main(argv=None) -> int:
                 # the cards-only path IS targets.run_card (build + env
                 # pins + build_card) — one implementation, two gates
                 cards[name] = run_card(name)
+                if json_mode:
+                    doc["targets"].append(
+                        {"target": name, "card": cards[name].summary()})
                 continue
             # targets.run applies the target's env pins + analyze_kwargs —
             # the single implementation every gate entry point shares
@@ -126,7 +146,19 @@ def main(argv=None) -> int:
         reports.append(report)
         if report.card is not None:
             cards[name] = report.card
-        print(report.render(verbose=verbose))
+        if json_mode:
+            import dataclasses
+
+            doc["targets"].append({
+                "target": name, "ok": report.ok,
+                "card": (report.card.summary()
+                         if report.card is not None else None),
+                "findings": [dataclasses.asdict(f) for f in report.findings],
+                "allowlisted": [{**dataclasses.asdict(f),
+                                 "reason": a.reason}
+                                for f, a in report.allowlisted]})
+        else:
+            print(report.render(verbose=verbose))
         if not report.ok:
             rc = max(rc, 1)
 
@@ -140,7 +172,13 @@ def main(argv=None) -> int:
         # analyze() already folded card findings into each report
         budget_findings = check_budgets(cards, budgets, registered=TARGETS)
     for f in budget_findings:
-        print("  " + f.render() + (f"  <{f.target}>" if f.target else ""))
+        if json_mode:
+            import dataclasses
+
+            doc["budget_findings"].append(dataclasses.asdict(f))
+        else:
+            print("  " + f.render()
+                  + (f"  <{f.target}>" if f.target else ""))
         if f.severity != "info":
             rc = max(rc, 1)
 
@@ -154,12 +192,17 @@ def main(argv=None) -> int:
         from paddle_tpu.analysis import registry_drift_findings
 
         for f in registry_drift_findings():
-            if strict_allowlist:
+            if json_mode:
+                doc["registry_drift"].append(
+                    {"rule": f.rule, "message": f.message,
+                     "gating": strict_allowlist})
+            elif strict_allowlist:
                 print(f"  ERROR   {f.rule}: {f.message}")
-                rc = max(rc, 1)
             else:
                 print(f"  warning {f.rule}: {f.message} "
                       f"(gating under --strict-allowlist)")
+            if strict_allowlist:
+                rc = max(rc, 1)
 
     # --- stale-allowlist detection (suppressions covering nothing) ------
     if rc >= 2:
@@ -178,14 +221,25 @@ def main(argv=None) -> int:
                     f"target={a.target!r} match={a.match!r}) — the "
                     f"suppressed finding was fixed or renamed; delete the "
                     f"entry (reason on file: {a.reason[:80]})")
-            if strict_allowlist:
+            if json_mode:
+                doc["stale_allowlist"].append(
+                    {"rule": a.rule, "target": a.target, "match": a.match,
+                     "gating": strict_allowlist})
+            elif strict_allowlist:
                 print(f"  ERROR   stale_allowlist: {line}")
-                rc = max(rc, 1)
             else:
                 print(f"  warning stale_allowlist: {line} "
                       f"(gating under --strict-allowlist)")
+            if strict_allowlist:
+                rc = max(rc, 1)
 
-    if rc == 1:
+    if json_mode:
+        import json
+
+        doc["ok"] = rc == 0
+        doc["exit"] = rc
+        print(json.dumps(doc, indent=2))
+    if rc == 1 and not json_mode:
         print("\nlint gate FAILED: fix the findings, allowlist them in "
               "paddle_tpu/analysis/allowlist.toml (with a reason), or — "
               "for budget regressions you mean to keep — re-run "
